@@ -1,0 +1,8 @@
+#!/bin/bash
+# Dev-loop runner: CPU-only JAX with 8 virtual devices, axon TPU plugin
+# disabled (its import hook hangs when the TPU relay is unreachable).
+# Usage: ./dev.sh python -m pytest tests/ -x -q
+exec env -u PALLAS_AXON_POOL_IPS -u AXON_LOOPBACK_RELAY -u PALLAS_AXON_REMOTE_COMPILE \
+  JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}" \
+  "$@"

@@ -1,0 +1,60 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with MXNet's capabilities.
+
+Brand-new design on JAX/XLA/Pallas: the reference's threaded dependency engine
+becomes XLA async dispatch; NNVM graph passes become jit tracing; CUDA kernels
+become XLA ops + Pallas kernels; ps-lite KVStore becomes XLA collectives over
+a device mesh.  See SURVEY.md at the repo root for the full blueprint.
+
+Import surface mirrors ``import mxnet as mx``: mx.nd, mx.sym, mx.gluon,
+mx.autograd, mx.init, mx.io, mx.kv, mx.metric, mx.mod, ...
+"""
+__version__ = "0.1.0"
+
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus
+from . import base
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from . import random
+
+# seeded lazily to avoid importing jax at package import when unused
+seed = random.seed
+
+
+def __getattr__(name):
+    """Lazy submodule loading keeps `import mxnet_tpu` fast."""
+    import importlib
+
+    lazy = {
+        "sym": ".symbol",
+        "symbol": ".symbol",
+        "gluon": ".gluon",
+        "init": ".initializer",
+        "initializer": ".initializer",
+        "optimizer": ".optimizer",
+        "metric": ".metric",
+        "io": ".io",
+        "kv": ".kvstore",
+        "kvstore": ".kvstore",
+        "mod": ".module",
+        "module": ".module",
+        "callback": ".callback",
+        "lr_scheduler": ".lr_scheduler",
+        "model": ".model",
+        "profiler": ".profiler",
+        "recordio": ".recordio",
+        "image": ".image",
+        "test_utils": ".test_utils",
+        "parallel": ".parallel",
+        "executor": ".executor",
+        "monitor": ".monitor",
+        "visualization": ".visualization",
+        "contrib": ".contrib",
+        "engine": ".engine",
+    }
+    if name in lazy:
+        mod = importlib.import_module(lazy[name], __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

@@ -1,0 +1,291 @@
+"""Imperative autograd — record/replay tape over pure registry ops.
+
+TPU-native re-design of reference ``src/imperative/imperative.cc`` (RecordOp
+tape + nnvm Gradient pass) and ``python/mxnet/autograd.py``.  Eager op calls
+made inside a ``record()`` scope append (pure_fn, inputs, attrs, outputs)
+entries to a tape; ``backward()`` replays the tape as a pure function of the
+marked variables and differentiates it with ``jax.vjp``.  Replay recomputes
+forward activations — rematerialization, the TPU-friendly trade (HBM is the
+bottleneck; reference's MXNET_BACKWARD_DO_MIRROR made the same trade).
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = [
+    "record",
+    "pause",
+    "train_mode",
+    "predict_mode",
+    "is_recording",
+    "is_training",
+    "mark_variables",
+    "backward",
+    "grad",
+    "set_recording",
+    "set_training",
+    "Function",
+]
+
+_STATE = threading.local()
+
+
+def _st():
+    if not getattr(_STATE, "init", False):
+        _STATE.recording = False
+        _STATE.training = False
+        _STATE.tape = []
+        _STATE.marked = []
+        _STATE.init = True
+    return _STATE
+
+
+class _TapeEntry:
+    __slots__ = ("fn", "inputs", "input_vals", "attrs", "outputs")
+
+    def __init__(self, fn, inputs, input_vals, attrs, outputs):
+        self.fn = fn
+        self.inputs = inputs  # list of NDArray (strong refs keep graph alive)
+        self.input_vals = input_vals  # jax arrays at call time (pre-mutation snapshot)
+        self.attrs = attrs
+        self.outputs = outputs  # list of NDArray
+
+
+def _record_op(fn, inputs, input_vals, attrs, outputs):
+    """Called by the nd frontend after executing an op while recording
+    (the Imperative::RecordOp hook, reference imperative.cc:183)."""
+    _st().tape.append(_TapeEntry(fn, inputs, input_vals, attrs, outputs))
+
+
+def _mark_variable(arr):
+    st = _st()
+    if all(m() is not arr for m in st.marked if m() is not None):
+        import weakref
+
+        st.marked.append(weakref.ref(arr))
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Reference autograd.py:216 — associate grad buffers with variables."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v.grad = g
+        v._grad_req = req
+        _mark_variable(v)
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_recording(is_record):
+    prev = _st().recording
+    _st().recording = bool(is_record)
+    return prev
+
+
+def set_training(train):
+    prev = _st().training
+    _st().training = bool(train)
+    return prev
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train):
+        self._enter_record = is_record
+        self._enter_train = train
+        self._prev_r = None
+        self._prev_t = None
+
+    def __enter__(self):
+        st = _st()
+        if self._enter_record is not None:
+            self._prev_r = st.recording
+            if self._enter_record and not st.recording:
+                st.tape = []  # fresh graph per recording session
+            st.recording = self._enter_record
+        if self._enter_train is not None:
+            self._prev_t = st.training
+            st.training = self._enter_train
+        return self
+
+    def __exit__(self, *a):
+        st = _st()
+        if self._prev_r is not None:
+            st.recording = self._prev_r
+        if self._prev_t is not None:
+            st.training = self._prev_t
+
+
+def record(train_mode=True):
+    """``with autograd.record():`` — reference autograd.py:122."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    """``with autograd.pause():`` — reference autograd.py:146."""
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+def _collect_live_marked():
+    st = _st()
+    out = []
+    for ref in st.marked:
+        v = ref()
+        if v is not None and v._grad_req != "null":
+            out.append(v)
+    st.marked = [r for r in st.marked if r() is not None]
+    return out
+
+
+def _replay(tape, heads, var_list):
+    """Build pure fn: marked var values -> head values, by tape replay."""
+
+    def f(var_vals):
+        env = {id(v): val for v, val in zip(var_list, var_vals)}
+        for entry in tape:
+            args = []
+            for nd_in, snap in zip(entry.inputs, entry.input_vals):
+                args.append(env.get(id(nd_in), snap))
+            out = entry.fn(*args, **entry.attrs)
+            outs = out if isinstance(out, tuple) else (out,)
+            for nd_out, val in zip(entry.outputs, outs):
+                env[id(nd_out)] = val
+        return [env.get(id(h), h._data) for h in heads]
+
+    return f
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of heads w.r.t. marked variables (reference
+    Imperative::Backward, imperative.cc:270) and += / = them into ``.grad``."""
+    import jax
+    import jax.numpy as jnp
+
+    from .ndarray.ndarray import NDArray, _wrap
+
+    st = _st()
+    tape = st.tape
+    var_list = _collect_live_marked()
+    if not var_list:
+        raise ValueError("There are no variables attached with gradients (attach_grad).")
+    f = _replay(tape, heads, var_list)
+    var_vals = [v._data for v in var_list]
+    outs, vjp_fn = jax.vjp(f, var_vals)
+    if head_grads is None:
+        cts = [jnp.ones_like(o) for o in outs]
+    else:
+        cts = [
+            (g._data if isinstance(g, NDArray) else jnp.asarray(g)) if g is not None else jnp.ones_like(o)
+            for o, g in zip(outs, head_grads)
+        ]
+    (grads,) = vjp_fn(cts)
+    for v, g in zip(var_list, grads):
+        if v._grad_req == "add" and v.grad is not None:
+            v.grad._rebind(v.grad._data + g)
+        else:
+            v.grad = _wrap(g)
+    if not retain_graph:
+        st.tape = []
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False, train_mode=True):
+    """Functional-style grad (reference autograd.py:270). create_graph not yet supported."""
+    import jax
+    import jax.numpy as jnp
+
+    from .ndarray.ndarray import NDArray, _wrap
+
+    if create_graph:
+        raise NotImplementedError("higher-order autograd.grad(create_graph=True) is not supported yet")
+    heads = heads if isinstance(heads, (list, tuple)) else [heads]
+    variables = variables if isinstance(variables, (list, tuple)) else [variables]
+    st = _st()
+    f = _replay(st.tape, heads, variables)
+    outs, vjp_fn = jax.vjp(f, [v._data for v in variables])
+    if head_grads is None:
+        cts = [jnp.ones_like(o) for o in outs]
+    else:
+        hg = head_grads if isinstance(head_grads, (list, tuple)) else [head_grads]
+        cts = [g._data if isinstance(g, NDArray) else jnp.asarray(g) for g in hg]
+    (grads,) = vjp_fn(cts)
+    if retain_graph is False:
+        st.tape = []
+    return [_wrap(g) for g in grads]
+
+
+def get_symbol(x):
+    raise NotImplementedError("autograd.get_symbol is not supported; use symbol API directly")
+
+
+class Function:
+    """Custom differentiable function (reference autograd.py:363 Function).
+
+    Subclass and implement ``forward``/``backward`` on NDArrays.  Internally
+    wrapped as a jax.custom_vjp over the pure payloads.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        import jax
+
+        from .ndarray.ndarray import NDArray, _wrap
+
+        self_ref = self
+
+        @jax.custom_vjp
+        def _fn(*jargs):
+            return _run_fwd(*jargs)
+
+        def _run_fwd(*jargs):
+            nd_in = [_wrap(a) for a in jargs]
+            with pause():
+                out = self_ref.forward(*nd_in)
+            if isinstance(out, (list, tuple)):
+                return tuple(o._data for o in out)
+            return out._data
+
+        def _fwd(*jargs):
+            return _run_fwd(*jargs), jargs
+
+        def _bwd(res, g):
+            nd_g = [_wrap(x) for x in (g if isinstance(g, tuple) else (g,))]
+            with pause():
+                igrads = self_ref.backward(*nd_g)
+            if not isinstance(igrads, (list, tuple)):
+                igrads = (igrads,)
+            return tuple(x._data for x in igrads)
+
+        _fn.defvjp(_fwd, _bwd)
+
+        from .ndarray import _invoke_raw
+
+        return _invoke_raw(_fn, list(inputs), {})

@@ -1,0 +1,223 @@
+"""Core shared plumbing: dtypes, errors, name management, attribute parsing.
+
+TPU-native re-imagining of the reference's ``python/mxnet/base.py`` (ctypes
+loading, handle types, error checking — see reference base.py:532 op-module
+codegen driver).  There is no C ABI here: the "backend" is JAX/XLA, so this
+module only keeps the pieces that are about *semantics* (dtype tables, error
+types, name managers, string-attr parsing for Symbol JSON compatibility).
+"""
+from __future__ import annotations
+
+import re
+import threading
+
+import numpy as np
+
+__all__ = [
+    "MXNetError",
+    "DTYPE_NAMES",
+    "NAME_TO_DTYPE",
+    "string_types",
+    "numeric_types",
+    "integer_types",
+    "NameManager",
+    "AttrScope",
+]
+
+
+class MXNetError(RuntimeError):
+    """Framework error type (mirrors reference MXNetError semantics)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, np.generic)
+integer_types = (int, np.integer)
+
+# dtype universe — mirrors reference mshadow dtype enum plus TPU-first bfloat16.
+# (reference: include/mxnet/tensor_blob.h dtype switch; python base.py _DTYPE_NP_TO_MX)
+DTYPE_NAMES = (
+    "float32",
+    "float64",
+    "float16",
+    "bfloat16",
+    "uint8",
+    "int32",
+    "int8",
+    "int64",
+    "bool",
+)
+
+
+def _np_dtype(name):
+    if name == "bfloat16":
+        import jax.numpy as jnp
+
+        return jnp.bfloat16
+    return np.dtype(name)
+
+
+NAME_TO_DTYPE = {n: n for n in DTYPE_NAMES}
+
+
+def dtype_np(dtype):
+    """Normalize a user-provided dtype (str | np.dtype | jnp dtype) to a numpy dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        return _np_dtype(dtype)
+    return np.dtype(dtype) if not _is_bf16(dtype) else dtype
+
+
+def _is_bf16(dtype):
+    return getattr(dtype, "__name__", None) == "bfloat16" or str(dtype) == "bfloat16"
+
+
+def dtype_name(dtype):
+    """Canonical string name for a dtype."""
+    if isinstance(dtype, str):
+        return dtype
+    if _is_bf16(dtype):
+        return "bfloat16"
+    return np.dtype(dtype).name
+
+
+class NameManager:
+    """Automatic unique-name assignment for symbols/blocks.
+
+    Mirrors the reference ``python/mxnet/name.py`` NameManager (thread-local
+    current stack).
+    """
+
+    _current = threading.local()
+
+    def __init__(self):
+        self._counter = {}
+        self._old_manager = None
+
+    def get(self, name, hint):
+        if name:
+            return name
+        if hint not in self._counter:
+            self._counter[hint] = 0
+        name = "%s%d" % (hint, self._counter[hint])
+        self._counter[hint] += 1
+        return name
+
+    def __enter__(self):
+        if not hasattr(NameManager._current, "value"):
+            NameManager._current.value = NameManager()
+        self._old_manager = NameManager._current.value
+        NameManager._current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        NameManager._current.value = self._old_manager
+
+    @staticmethod
+    def current():
+        if not hasattr(NameManager._current, "value"):
+            NameManager._current.value = NameManager()
+        return NameManager._current.value
+
+
+class Prefix(NameManager):
+    """NameManager that attaches a prefix to all names."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        name = super().get(name, hint)
+        return self._prefix + name
+
+
+class AttrScope:
+    """Attribute manager for symbol attrs (``with AttrScope(ctx_group='dev1')``).
+
+    Mirrors reference ``python/mxnet/attribute.py``; the ``__ctx_group__`` attr
+    feeds sharding annotation the way group2ctx fed PlaceDevice
+    (reference src/executor/graph_executor.cc:407).
+    """
+
+    _current = threading.local()
+
+    def __init__(self, **kwargs):
+        self._old_scope = None
+        for value in kwargs.values():
+            if not isinstance(value, string_types):
+                raise ValueError("Attributes need to be a string")
+        self._attr = kwargs
+
+    def get(self, attr):
+        if self._attr:
+            ret = self._attr.copy()
+            if attr:
+                ret.update(attr)
+            return ret
+        return attr if attr else {}
+
+    def __enter__(self):
+        if not hasattr(AttrScope._current, "value"):
+            AttrScope._current.value = AttrScope()
+        self._old_scope = AttrScope._current.value
+        attr = AttrScope._current.value._attr.copy()
+        attr.update(self._attr)
+        self._attr = attr
+        AttrScope._current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        AttrScope._current.value = self._old_scope
+
+    @staticmethod
+    def current():
+        if not hasattr(AttrScope._current, "value"):
+            AttrScope._current.value = AttrScope()
+        return AttrScope._current.value
+
+
+# ---------------------------------------------------------------------------
+# String-attr parsing: ops accept kwargs either as native Python values or as
+# strings (Symbol JSON round-trip compatibility with the reference's
+# dmlc::Parameter string parsing — SURVEY §5.6 mechanism 2).
+# ---------------------------------------------------------------------------
+
+_TUPLE_RE = re.compile(r"^[\(\[].*[\)\]]$")
+
+
+def parse_attr(value):
+    """Parse a string attribute to a Python value (int/float/bool/tuple/str)."""
+    if not isinstance(value, str):
+        return value
+    s = value.strip()
+    low = s.lower()
+    if low in ("true", "1") and low == "true":
+        return True
+    if low == "false":
+        return False
+    if low == "none":
+        return None
+    if _TUPLE_RE.match(s):
+        inner = s[1:-1].strip()
+        if not inner:
+            return ()
+        return tuple(parse_attr(tok) for tok in inner.split(","))
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    return s
+
+
+def attr_str(value):
+    """Serialize a Python attr value to its canonical string form."""
+    if isinstance(value, bool):
+        return str(value)
+    if isinstance(value, (tuple, list)):
+        return "(" + ", ".join(attr_str(v) for v in value) + ")"
+    return str(value)

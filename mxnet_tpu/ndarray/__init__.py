@@ -1,0 +1,271 @@
+"""mx.nd — imperative operator frontend.
+
+Generated-from-registry op namespace, mirroring reference
+``python/mxnet/ndarray/register.py:29,156`` (which code-gens a Python function
+per C++ op).  Here the registry holds pure jax functions; the wrapper unwraps
+NDArrays, injects RNG keys / train-mode flags, executes eagerly (JAX async
+dispatch ≡ engine push), wraps outputs, and tapes the call for autograd
+(Imperative::Invoke + RecordOp, reference imperative.cc:87,183).
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+import numpy as np
+
+from ..base import parse_attr, dtype_np
+from ..context import current_context, Context
+from ..ops import registry as _registry
+from ..ops import _load_all  # noqa: F401  (populates the registry)
+from .ndarray import NDArray, array, empty, concatenate, waitall, _wrap, _to_device
+
+__all__ = [
+    "NDArray",
+    "array",
+    "empty",
+    "zeros",
+    "ones",
+    "full",
+    "arange",
+    "concatenate",
+    "waitall",
+    "save",
+    "load",
+    "op",
+    "random",
+]
+
+# attrs that only make sense engine-side in the reference; accepted and ignored
+_IGNORED_ATTRS = frozenset({"name", "attr", "__layout__", "cudnn_tune", "cudnn_off", "workspace"})
+
+# ops whose tuple return is partially hidden unless an attr asks for it
+_VISIBLE_RULES = {
+    "BatchNorm": lambda attrs: 3 if attrs.get("output_mean_var") else 1,
+    "LayerNorm": lambda attrs: 3 if attrs.get("output_mean_var") else 1,
+    "_sample_multinomial": lambda attrs: 2 if attrs.get("get_prob") else 1,
+}
+
+
+def _tape_if_recording(fn, nd_inputs, jargs, attrs, nd_outputs):
+    from .. import autograd
+
+    if autograd.is_recording():
+        autograd._record_op(fn, nd_inputs, jargs, attrs, nd_outputs)
+
+
+def _invoke_raw(fn, nd_args, attrs, visible=None, ctx=None):
+    """Execute a pure fn on NDArray args: unwrap → run → wrap → tape."""
+    jargs = []
+    nd_inputs = []
+    for a in nd_args:
+        if isinstance(a, NDArray):
+            jargs.append(a._data)
+            nd_inputs.append(a)
+        else:
+            jargs.append(a)
+            nd_inputs.append(None)
+    res = fn(*jargs, **attrs)
+    multi = isinstance(res, tuple)
+    outs = res if multi else (res,)
+    if ctx is not None:
+        outs = tuple(_to_device(o, ctx) for o in outs)
+    nd_outs = [_wrap(o, ctx) for o in outs]
+    _tape_if_recording(fn, nd_inputs, jargs, attrs, nd_outs)
+    if not multi:
+        return nd_outs[0]
+    if visible is not None:
+        nd_outs = nd_outs[:visible]
+    return nd_outs[0] if len(nd_outs) == 1 else nd_outs
+
+
+def _invoke(opdef, args, kwargs):
+    kwargs = dict(kwargs)
+    out_arr = kwargs.pop("out", None)
+    ctx = kwargs.pop("ctx", None)
+    for k in list(kwargs):
+        if k in _IGNORED_ATTRS:
+            kwargs.pop(k)
+    args = list(args)
+    # map named tensor args to positions
+    if not opdef.variadic and opdef.arg_names:
+        if len(args) > len(opdef.arg_names):
+            raise TypeError(
+                "%s takes at most %d tensor arguments (%d given)"
+                % (opdef.name, len(opdef.arg_names), len(args))
+            )
+        named = {}
+        for i, a in enumerate(args):
+            named[opdef.arg_names[i]] = a
+        for an in opdef.arg_names:
+            if an in kwargs:
+                named[an] = kwargs.pop(an)
+        args = [named.get(an, opdef.defaults.get(an)) for an in opdef.arg_names]
+        while args and args[-1] is None and opdef.arg_names[len(args) - 1] not in named:
+            args.pop()
+    # attrs
+    attrs = {}
+    for k, v in kwargs.items():
+        attrs[k] = parse_attr(v) if isinstance(v, str) else v
+    if "key" in opdef.attr_names and "key" not in attrs:
+        from .. import random as _rnd
+
+        attrs["key"] = _rnd.next_key()
+    if "training" in opdef.attr_names and "training" not in attrs:
+        from .. import autograd
+
+        attrs["training"] = autograd.is_training()
+    visible_rule = _VISIBLE_RULES.get(opdef.name)
+    visible = visible_rule(attrs) if visible_rule else None
+    result = _invoke_raw(opdef.fn, args, attrs, visible=visible, ctx=ctx)
+    if out_arr is not None:
+        target = result[0] if isinstance(result, list) else result
+        out_arr._rebind(target._data)
+        return out_arr
+    return result
+
+
+def _binary_dispatch(name, lhs, rhs, reverse=False):
+    opdef = _registry.get(name)
+    if isinstance(rhs, (np.ndarray, list, tuple)):
+        rhs = array(rhs, dtype=lhs.dtype)
+    a, b = (rhs, lhs) if reverse else (lhs, rhs)
+    return _invoke(opdef, (a, b), {})
+
+
+def _make_op_func(opdef, public_name):
+    def op_func(*args, **kwargs):
+        return _invoke(opdef, args, kwargs)
+
+    op_func.__name__ = public_name.lstrip("_")
+    op_func.__qualname__ = op_func.__name__
+    op_func.__doc__ = opdef.__doc__
+    op_func.opdef = opdef
+    return op_func
+
+
+# build the `op` namespace module with every registered op (incl. aliases)
+op = types.ModuleType(__name__ + ".op")
+op.__doc__ = "All registered operators (reference mx.nd.op namespace)."
+for _name in _registry.list_ops(include_aliases=True):
+    _f = _make_op_func(_registry.get(_name), _name)
+    setattr(op, _name, _f)
+    if not hasattr(sys.modules[__name__], _name):
+        setattr(sys.modules[__name__], _name, _f)
+sys.modules[op.__name__] = op
+
+
+# ---------------------------------------------------------------------------
+# creation functions with ctx handling (reference ndarray.py zeros/ones/...)
+# ---------------------------------------------------------------------------
+
+
+def zeros(shape, ctx=None, dtype="float32", **kwargs):
+    import jax.numpy as jnp
+
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    out = jnp.zeros(shape, dtype=dtype_np(dtype or "float32"))
+    return _wrap(_to_device(out, ctx) if ctx else out, ctx)
+
+
+def ones(shape, ctx=None, dtype="float32", **kwargs):
+    import jax.numpy as jnp
+
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    out = jnp.ones(shape, dtype=dtype_np(dtype or "float32"))
+    return _wrap(_to_device(out, ctx) if ctx else out, ctx)
+
+
+def full(shape, val, ctx=None, dtype="float32", **kwargs):
+    import jax.numpy as jnp
+
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    out = jnp.full(shape, val, dtype=dtype_np(dtype or "float32"))
+    return _wrap(_to_device(out, ctx) if ctx else out, ctx)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
+    import jax.numpy as jnp
+
+    out = jnp.arange(start, stop, step, dtype=dtype_np(dtype or "float32"))
+    if repeat != 1:
+        out = jnp.repeat(out, repeat)
+    return _wrap(_to_device(out, ctx) if ctx else out, ctx)
+
+
+def zeros_like(arr, **kw):
+    return _invoke(_registry.get("zeros_like"), (arr,), kw)
+
+
+def ones_like(arr, **kw):
+    return _invoke(_registry.get("ones_like"), (arr,), kw)
+
+
+# ---------------------------------------------------------------------------
+# serialization (reference MXNDArraySave/Load, src/c_api/c_api.cc:131-167)
+# ---------------------------------------------------------------------------
+
+
+def save(fname, data):
+    """Save NDArray | list | dict of NDArrays (reference nd.save).
+
+    Format: numpy .npz with a manifest key encoding list vs dict (portable,
+    replacing the reference's dmlc binary format).
+    """
+    if isinstance(data, NDArray):
+        np.savez(fname, __mx_format__="single", a0=data.asnumpy())
+    elif isinstance(data, (list, tuple)):
+        arrs = {"a%d" % i: a.asnumpy() for i, a in enumerate(data)}
+        np.savez(fname, __mx_format__="list", **arrs)
+    elif isinstance(data, dict):
+        arrs = {"k_" + k: v.asnumpy() for k, v in data.items()}
+        np.savez(fname, __mx_format__="dict", **arrs)
+    else:
+        raise TypeError(type(data))
+
+
+def load(fname):
+    """Load NDArrays saved by :func:`save`."""
+    with np.load(fname, allow_pickle=False) as z:
+        fmt = str(z["__mx_format__"])
+        if fmt == "single":
+            return [array(z["a0"])]
+        if fmt == "list":
+            n = len([k for k in z.files if k.startswith("a")])
+            return [array(z["a%d" % i]) for i in range(n)]
+        return {k[2:]: array(z[k]) for k in z.files if k.startswith("k_")}
+
+
+# ---------------------------------------------------------------------------
+# nd.random namespace (reference mxnet/ndarray/random.py)
+# ---------------------------------------------------------------------------
+
+random = types.ModuleType(__name__ + ".random")
+random.__doc__ = "Random distribution generators (reference nd.random)."
+
+
+def _make_random(fname, opname):
+    opdef = _registry.get(opname)
+
+    def rnd_func(*args, **kwargs):
+        return _invoke(opdef, args, kwargs)
+
+    rnd_func.__name__ = fname
+    rnd_func.__doc__ = opdef.__doc__
+    return rnd_func
+
+
+for _fname, _opname in [
+    ("uniform", "_random_uniform"),
+    ("normal", "_random_normal"),
+    ("gamma", "_random_gamma"),
+    ("exponential", "_random_exponential"),
+    ("poisson", "_random_poisson"),
+    ("negative_binomial", "_random_negative_binomial"),
+    ("generalized_negative_binomial", "_random_generalized_negative_binomial"),
+    ("randint", "_random_randint"),
+    ("multinomial", "_sample_multinomial"),
+    ("shuffle", "_shuffle"),
+]:
+    setattr(random, _fname, _make_random(_fname, _opname))
+sys.modules[random.__name__] = random

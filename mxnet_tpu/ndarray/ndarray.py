@@ -1,0 +1,522 @@
+"""NDArray — the imperative array type.
+
+TPU-native re-design of reference ``include/mxnet/ndarray.h`` +
+``python/mxnet/ndarray/ndarray.py:169``.  An NDArray wraps a ``jax.Array``;
+JAX's async dispatch provides the engine semantics the reference built with
+ThreadedEngine vars (SURVEY §7.1: wait_to_read ≡ block_until_ready).  In-place
+mutation (``a += b``, ``a[1:3] = x``) rebinds the wrapped buffer — a
+functional update under the hood, same observable semantics.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError, dtype_np, dtype_name
+from ..context import Context, current_context
+
+__all__ = ["NDArray", "array", "empty", "concatenate", "waitall"]
+
+
+class NDArray:
+    __slots__ = ("_data", "_ctx", "grad", "_grad_req", "_ag_node", "__weakref__")
+
+    # numpy operator dispatch defers to NDArray's reflected ops
+    __array_priority__ = 100.0
+
+    def __init__(self, data, ctx=None):
+        import jax
+
+        self._data = data
+        self._ctx = ctx
+        self.grad = None
+        self._grad_req = "null"
+        self._ag_node = None
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        dt = self._data.dtype
+        return np.dtype(dt) if dt.name != "bfloat16" else dt
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self):
+        if self._ctx is not None:
+            return self._ctx
+        try:
+            dev = list(self._data.devices())[0]
+        except Exception:
+            return current_context()
+        if dev.platform == "cpu":
+            return Context("cpu", dev.id)
+        return Context("tpu", dev.id)
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"
+
+    # -- sync / conversion --------------------------------------------------
+    def asnumpy(self):
+        """Block and copy to host (reference WaitToRead + CopyFromTo)."""
+        return np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def wait_to_read(self):
+        self._data.block_until_ready()
+
+    def astype(self, dtype, copy=True):
+        dt = dtype_np(dtype)
+        return self._taped(lambda a: a.astype(dt))
+
+    def copy(self):
+        return _wrap(self._data + 0 if self.dtype != np.dtype(bool) else self._data, self._ctx)
+
+    def copyto(self, other):
+        """Copy to another NDArray or Context (reference ndarray.py copyto)."""
+        if isinstance(other, NDArray):
+            other._rebind(_to_device(self._data, other.context))
+            return other
+        if isinstance(other, Context):
+            return _wrap(_to_device(self._data, other), other)
+        raise TypeError(type(other))
+
+    def as_in_context(self, context):
+        if context == self.context:
+            return self
+        return _wrap(_to_device(self._data, context), context)
+
+    def as_in_ctx(self, context):
+        return self.as_in_context(context)
+
+    def to_dlpack_for_read(self):
+        return self._data.__dlpack__()
+
+    # -- autograd -----------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        """Allocate gradient buffer & mark for autograd (reference autograd.mark_variables)."""
+        import jax.numpy as jnp
+
+        from .. import autograd
+
+        self.grad = _wrap(jnp.zeros_like(self._data), self._ctx)
+        self._grad_req = grad_req
+        autograd._mark_variable(self)
+
+    def detach(self):
+        out = _wrap(self._data, self._ctx)
+        return out
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+
+        autograd.backward(
+            [self], [out_grad] if out_grad is not None else None, retain_graph, train_mode
+        )
+
+    # -- mutation (functional rebind) ---------------------------------------
+    def _rebind(self, new_data):
+        if tuple(new_data.shape) != self.shape:
+            raise ValueError(
+                "inplace update shape mismatch: %s vs %s" % (new_data.shape, self.shape)
+            )
+        self._data = new_data.astype(self._data.dtype) if new_data.dtype != self._data.dtype else new_data
+
+    def __setitem__(self, key, value):
+        import jax.numpy as jnp
+
+        if isinstance(value, NDArray):
+            value = value._data
+        key = _index(key)
+        if key == slice(None) and not isinstance(value, (int, float)):
+            value = jnp.asarray(value, dtype=self._data.dtype)
+            self._rebind(jnp.broadcast_to(value, self.shape))
+            return
+        self._data = self._data.at[key].set(
+            value if isinstance(value, (int, float)) else jnp.asarray(value, dtype=self._data.dtype)
+        )
+
+    def _taped(self, fn):
+        """Run a pure unary fn through the frontend so autograd tapes it."""
+        from . import _invoke_raw
+
+        return _invoke_raw(fn, [self], {})
+
+    def __getitem__(self, key):
+        key = _index(key)
+        return self._taped(lambda a: a[key])
+
+    # -- shape ops ----------------------------------------------------------
+    def reshape(self, *shape, **kwargs):
+        from ..ops.matrix import infer_reshape
+
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        if not shape:
+            shape = kwargs.get("shape")
+        reverse = kwargs.get("reverse", False)
+        tgt = infer_reshape(self.shape, shape, reverse)
+        return self._taped(lambda a: a.reshape(tgt))
+
+    def reshape_like(self, other):
+        return self.reshape(other.shape)
+
+    def expand_dims(self, axis):
+        import jax.numpy as jnp
+
+        return self._taped(lambda a: jnp.expand_dims(a, axis))
+
+    def squeeze(self, axis=None):
+        import jax.numpy as jnp
+
+        return self._taped(lambda a: jnp.squeeze(a, axis))
+
+    def flatten(self):
+        return self.reshape((self.shape[0], -1))
+
+    def transpose(self, axes=None):
+        import jax.numpy as jnp
+
+        if axes is None:
+            axes = tuple(reversed(range(self.ndim)))
+        return self._taped(lambda a: jnp.transpose(a, axes))
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    def swapaxes(self, dim1, dim2):
+        import jax.numpy as jnp
+
+        return self._taped(lambda a: jnp.swapaxes(a, dim1, dim2))
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        from . import op
+
+        return op.split(self, num_outputs=num_outputs, axis=axis, squeeze_axis=squeeze_axis)
+
+    # -- reductions (ndarray methods mirror op names) -----------------------
+    def _reduce(self, name, axis=None, keepdims=False):
+        from . import op
+
+        return getattr(op, name)(self, axis=axis, keepdims=keepdims)
+
+    def sum(self, axis=None, keepdims=False):
+        return self._reduce("sum", axis, keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return self._reduce("mean", axis, keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return self._reduce("max", axis, keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return self._reduce("min", axis, keepdims)
+
+    def prod(self, axis=None, keepdims=False):
+        return self._reduce("prod", axis, keepdims)
+
+    def norm(self, **kwargs):
+        from . import op
+
+        return op.norm(self, **kwargs)
+
+    def argmax(self, axis=None):
+        from . import op
+
+        return op.argmax(self, axis=axis)
+
+    def argmin(self, axis=None):
+        from . import op
+
+        return op.argmin(self, axis=axis)
+
+    def clip(self, a_min, a_max):
+        from . import op
+
+        return op.clip(self, a_min=a_min, a_max=a_max)
+
+    def abs(self):
+        from . import op
+
+        return op.abs(self)
+
+    def sqrt(self):
+        from . import op
+
+        return op.sqrt(self)
+
+    def square(self):
+        from . import op
+
+        return op.square(self)
+
+    def sign(self):
+        from . import op
+
+        return op.sign(self)
+
+    def log_softmax(self, axis=-1):
+        from . import op
+
+        return op.log_softmax(self, axis=axis)
+
+    def softmax(self, axis=-1):
+        from . import op
+
+        return op.softmax(self, axis=axis)
+
+    def one_hot(self, depth, **kw):
+        from . import op
+
+        return op.one_hot(self, depth=depth, **kw)
+
+    def take(self, indices, axis=0, mode="clip"):
+        from . import op
+
+        return op.take(self, indices, axis=axis, mode=mode)
+
+    def topk(self, **kw):
+        from . import op
+
+        return op.topk(self, **kw)
+
+    def tile(self, reps):
+        from . import op
+
+        return op.tile(self, reps=reps)
+
+    def pad(self, **kw):
+        from . import op
+
+        return op.pad(self, **kw)
+
+    def slice_axis(self, axis, begin, end):
+        from . import op
+
+        return op.slice_axis(self, axis=axis, begin=begin, end=end)
+
+    def broadcast_to(self, shape):
+        from . import op
+
+        return op.broadcast_to(self, shape=shape)
+
+    def broadcast_like(self, other):
+        from . import op
+
+        return op.broadcast_like(self, other)
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self
+        from . import sparse
+
+        return sparse.cast_storage(self, stype=stype)
+
+    # -- python protocol ----------------------------------------------------
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("The truth value of an NDArray with multiple elements is ambiguous.")
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        arr = self.asnumpy()
+        return "\n%s\n<NDArray %s @%s>" % (arr, "x".join(map(str, self.shape)), self.context)
+
+    def __hash__(self):
+        return id(self)
+
+    # arithmetic — routed through the op registry so autograd tapes them
+    def _binop(self, name, other, reverse=False):
+        from . import _binary_dispatch
+
+        return _binary_dispatch(name, self, other, reverse)
+
+    def __add__(self, o):
+        return self._binop("broadcast_add", o)
+
+    def __radd__(self, o):
+        return self._binop("broadcast_add", o, True)
+
+    def __sub__(self, o):
+        return self._binop("broadcast_sub", o)
+
+    def __rsub__(self, o):
+        return self._binop("broadcast_sub", o, True)
+
+    def __mul__(self, o):
+        return self._binop("broadcast_mul", o)
+
+    def __rmul__(self, o):
+        return self._binop("broadcast_mul", o, True)
+
+    def __truediv__(self, o):
+        return self._binop("broadcast_div", o)
+
+    def __rtruediv__(self, o):
+        return self._binop("broadcast_div", o, True)
+
+    def __div__(self, o):
+        return self.__truediv__(o)
+
+    def __mod__(self, o):
+        return self._binop("broadcast_mod", o)
+
+    def __rmod__(self, o):
+        return self._binop("broadcast_mod", o, True)
+
+    def __pow__(self, o):
+        return self._binop("broadcast_power", o)
+
+    def __rpow__(self, o):
+        return self._binop("broadcast_power", o, True)
+
+    def __neg__(self):
+        from . import op
+
+        return op.negative(self)
+
+    def __eq__(self, o):
+        return self._binop("broadcast_equal", o)
+
+    def __ne__(self, o):
+        return self._binop("broadcast_not_equal", o)
+
+    def __gt__(self, o):
+        return self._binop("broadcast_greater", o)
+
+    def __ge__(self, o):
+        return self._binop("broadcast_greater_equal", o)
+
+    def __lt__(self, o):
+        return self._binop("broadcast_lesser", o)
+
+    def __le__(self, o):
+        return self._binop("broadcast_lesser_equal", o)
+
+    def __iadd__(self, o):
+        self._rebind(self.__add__(o)._data)
+        return self
+
+    def __isub__(self, o):
+        self._rebind(self.__sub__(o)._data)
+        return self
+
+    def __imul__(self, o):
+        self._rebind(self.__mul__(o)._data)
+        return self
+
+    def __itruediv__(self, o):
+        self._rebind(self.__truediv__(o)._data)
+        return self
+
+
+def _index(key):
+    """Normalize an index: NDArray indices → jax arrays."""
+    if isinstance(key, NDArray):
+        return key._data.astype("int32")
+    if isinstance(key, tuple):
+        return tuple(_index(k) for k in key)
+    return key
+
+
+def _wrap(jarr, ctx=None):
+    return NDArray(jarr, ctx)
+
+
+def _to_device(jarr, ctx):
+    import jax
+
+    return jax.device_put(jarr, ctx.jax_device)
+
+
+# ---------------------------------------------------------------------------
+# creation helpers (reference ndarray.py array/empty/...)
+# ---------------------------------------------------------------------------
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Create an NDArray from any array-like (reference ndarray.py:array)."""
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(source_array, NDArray):
+        src = source_array._data
+        if dtype is not None:
+            src = src.astype(dtype_np(dtype))
+        if ctx is not None:
+            src = _to_device(src, ctx)
+        return _wrap(src, ctx)
+    np_arr = np.asarray(source_array)
+    if dtype is None:
+        if np_arr.dtype == np.float64:
+            dtype = np.float32  # MXNet default_dtype convention
+        elif np_arr.dtype == np.int64:
+            dtype = np.int32  # TPU-native: x64 disabled under jit
+        else:
+            dtype = np_arr.dtype
+    jarr = jnp.asarray(np_arr, dtype=dtype_np(dtype) if isinstance(dtype, str) else dtype)
+    if ctx is not None:
+        jarr = _to_device(jarr, ctx)
+    return _wrap(jarr, ctx)
+
+
+def empty(shape, ctx=None, dtype="float32"):
+    import jax.numpy as jnp
+
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    jarr = jnp.zeros(shape, dtype=dtype_np(dtype or "float32"))
+    if ctx is not None:
+        jarr = _to_device(jarr, ctx)
+    return _wrap(jarr, ctx)
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    import jax.numpy as jnp
+
+    return _wrap(jnp.concatenate([a._data for a in arrays], axis=axis))
+
+
+def waitall():
+    """Block until all async computation completes (reference MXNDArrayWaitAll)."""
+    import jax
+
+    (jax.device_put(0.0) + 0).block_until_ready()

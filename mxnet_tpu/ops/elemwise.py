@@ -1,0 +1,239 @@
+"""Elementwise unary/binary/scalar operators.
+
+TPU-native equivalents of reference ``src/operator/tensor/elemwise_*`` and the
+mshadow functor library (``src/operator/mshadow_op.h``).  Every op is a pure
+jnp function; XLA fuses chains of these into single kernels (replacing the
+reference's manual Kernel<op,xpu>::Launch dispatch + engine bulking).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _unary(name, fn, aliases=(), doc=None):
+    def op(data):
+        return fn(data)
+
+    op.__name__ = name.lstrip("_")
+    op.__qualname__ = op.__name__
+    op.__doc__ = doc or ("Elementwise %s. Reference: src/operator/tensor/elemwise_unary_op_basic.cc" % name)
+    register(name, alias=aliases)(op)
+    return op
+
+
+def _binary(name, fn, aliases=(), doc=None):
+    def op(lhs, rhs):
+        return fn(lhs, rhs)
+
+    op.__name__ = name.lstrip("_")
+    op.__qualname__ = op.__name__
+    op.__doc__ = doc or ("Elementwise binary %s (auto-broadcasting). Reference: src/operator/tensor/elemwise_binary_op_basic.cc" % name)
+    register(name, alias=aliases)(op)
+    return op
+
+
+def _scalar_op(name, fn, aliases=()):
+    def op(data, *, scalar):
+        return fn(data, scalar)
+
+    op.__name__ = name.lstrip("_")
+    op.__qualname__ = op.__name__
+    op.__doc__ = "Scalar %s. Reference: src/operator/tensor/elemwise_binary_scalar_op_basic.cc" % name
+    register(name, alias=aliases)(op)
+    return op
+
+
+# ---------------------------------------------------------------------------
+# binary (the reference distinguishes elemwise_* [same-shape] from broadcast_*;
+# both map to jnp broadcasting semantics, registered under both families)
+# ---------------------------------------------------------------------------
+
+_binary("elemwise_add", jnp.add, aliases=["_plus", "_add"])
+_binary("elemwise_sub", jnp.subtract, aliases=["_minus", "_sub"])
+_binary("elemwise_mul", jnp.multiply, aliases=["_mul"])
+_binary("elemwise_div", jnp.divide, aliases=["_div"])
+_binary("_mod", jnp.mod)
+_binary("_power", jnp.power, aliases=["_pow"])
+_binary("_maximum", jnp.maximum, aliases=["_max"])
+_binary("_minimum", jnp.minimum, aliases=["_min"])
+_binary("_hypot", jnp.hypot)
+_binary("_equal", lambda a, b: (a == b).astype(_cmp_dtype(a)))
+_binary("_not_equal", lambda a, b: (a != b).astype(_cmp_dtype(a)))
+_binary("_greater", lambda a, b: (a > b).astype(_cmp_dtype(a)))
+_binary("_greater_equal", lambda a, b: (a >= b).astype(_cmp_dtype(a)))
+_binary("_lesser", lambda a, b: (a < b).astype(_cmp_dtype(a)))
+_binary("_lesser_equal", lambda a, b: (a <= b).astype(_cmp_dtype(a)))
+_binary("_logical_and", lambda a, b: jnp.logical_and(a, b).astype(_cmp_dtype(a)))
+_binary("_logical_or", lambda a, b: jnp.logical_or(a, b).astype(_cmp_dtype(a)))
+_binary("_logical_xor", lambda a, b: jnp.logical_xor(a, b).astype(_cmp_dtype(a)))
+
+
+def _cmp_dtype(a):
+    # MXNet comparisons return same-dtype 0/1 arrays (float32 typically)
+    dt = jnp.asarray(a).dtype
+    return dt if jnp.issubdtype(dt, jnp.floating) else jnp.float32
+
+
+broadcast_names = [
+    ("broadcast_add", jnp.add, ["broadcast_plus"]),
+    ("broadcast_sub", jnp.subtract, ["broadcast_minus"]),
+    ("broadcast_mul", jnp.multiply, []),
+    ("broadcast_div", jnp.divide, []),
+    ("broadcast_mod", jnp.mod, []),
+    ("broadcast_power", jnp.power, []),
+    ("broadcast_maximum", jnp.maximum, []),
+    ("broadcast_minimum", jnp.minimum, []),
+    ("broadcast_hypot", jnp.hypot, []),
+    ("broadcast_equal", lambda a, b: (a == b).astype(_cmp_dtype(a)), []),
+    ("broadcast_not_equal", lambda a, b: (a != b).astype(_cmp_dtype(a)), []),
+    ("broadcast_greater", lambda a, b: (a > b).astype(_cmp_dtype(a)), []),
+    ("broadcast_greater_equal", lambda a, b: (a >= b).astype(_cmp_dtype(a)), []),
+    ("broadcast_lesser", lambda a, b: (a < b).astype(_cmp_dtype(a)), []),
+    ("broadcast_lesser_equal", lambda a, b: (a <= b).astype(_cmp_dtype(a)), []),
+    ("broadcast_logical_and", lambda a, b: jnp.logical_and(a, b).astype(_cmp_dtype(a)), []),
+    ("broadcast_logical_or", lambda a, b: jnp.logical_or(a, b).astype(_cmp_dtype(a)), []),
+    ("broadcast_logical_xor", lambda a, b: jnp.logical_xor(a, b).astype(_cmp_dtype(a)), []),
+]
+for _n, _f, _a in broadcast_names:
+    _binary(_n, _f, aliases=_a)
+
+# ---------------------------------------------------------------------------
+# scalar ops
+# ---------------------------------------------------------------------------
+
+_scalar_op("_plus_scalar", lambda x, s: x + s)
+_scalar_op("_minus_scalar", lambda x, s: x - s)
+_scalar_op("_rminus_scalar", lambda x, s: s - x)
+_scalar_op("_mul_scalar", lambda x, s: x * s)
+_scalar_op("_div_scalar", lambda x, s: x / s)
+_scalar_op("_rdiv_scalar", lambda x, s: s / x)
+_scalar_op("_mod_scalar", lambda x, s: jnp.mod(x, s))
+_scalar_op("_rmod_scalar", lambda x, s: jnp.mod(jnp.full_like(x, s), x) if not jnp.isscalar(s) else jnp.mod(s, x))
+_scalar_op("_power_scalar", lambda x, s: jnp.power(x, s))
+_scalar_op("_rpower_scalar", lambda x, s: jnp.power(s, x))
+_scalar_op("_maximum_scalar", lambda x, s: jnp.maximum(x, s))
+_scalar_op("_minimum_scalar", lambda x, s: jnp.minimum(x, s))
+_scalar_op("_hypot_scalar", lambda x, s: jnp.hypot(x, jnp.asarray(s, x.dtype)))
+_scalar_op("_equal_scalar", lambda x, s: (x == s).astype(_cmp_dtype(x)))
+_scalar_op("_not_equal_scalar", lambda x, s: (x != s).astype(_cmp_dtype(x)))
+_scalar_op("_greater_scalar", lambda x, s: (x > s).astype(_cmp_dtype(x)))
+_scalar_op("_greater_equal_scalar", lambda x, s: (x >= s).astype(_cmp_dtype(x)))
+_scalar_op("_lesser_scalar", lambda x, s: (x < s).astype(_cmp_dtype(x)))
+_scalar_op("_lesser_equal_scalar", lambda x, s: (x <= s).astype(_cmp_dtype(x)))
+_scalar_op("_logical_and_scalar", lambda x, s: jnp.logical_and(x, s).astype(_cmp_dtype(x)))
+_scalar_op("_logical_or_scalar", lambda x, s: jnp.logical_or(x, s).astype(_cmp_dtype(x)))
+_scalar_op("_logical_xor_scalar", lambda x, s: jnp.logical_xor(x, s).astype(_cmp_dtype(x)))
+
+# ---------------------------------------------------------------------------
+# unary math (reference src/operator/mshadow_op.h functor zoo)
+# ---------------------------------------------------------------------------
+
+_unary("negative", jnp.negative, aliases=["_np_negative"])
+_unary("reciprocal", jnp.reciprocal)
+_unary("abs", jnp.abs)
+_unary("sign", jnp.sign)
+_unary("round", jnp.round)
+_unary("rint", jnp.rint)
+_unary("ceil", jnp.ceil)
+_unary("floor", jnp.floor)
+_unary("trunc", jnp.trunc)
+_unary("fix", jnp.trunc)
+_unary("square", jnp.square)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", lambda x: jax.lax.rsqrt(x))
+_unary("cbrt", jnp.cbrt)
+_unary("rcbrt", lambda x: 1.0 / jnp.cbrt(x))
+_unary("exp", jnp.exp)
+_unary("log", jnp.log)
+_unary("log10", jnp.log10)
+_unary("log2", jnp.log2)
+_unary("log1p", jnp.log1p)
+_unary("expm1", jnp.expm1)
+_unary("gamma", lambda x: jnp.exp(jax.scipy.special.gammaln(x)))
+_unary("gammaln", lambda x: jax.scipy.special.gammaln(x))
+_unary("erf", lambda x: jax.scipy.special.erf(x))
+_unary("erfinv", lambda x: jax.scipy.special.erfinv(x))
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("arcsin", jnp.arcsin)
+_unary("arccos", jnp.arccos)
+_unary("arctan", jnp.arctan)
+_unary("degrees", jnp.degrees)
+_unary("radians", jnp.radians)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("tanh", jnp.tanh)
+_unary("arcsinh", jnp.arcsinh)
+_unary("arccosh", jnp.arccosh)
+_unary("arctanh", jnp.arctanh)
+_unary("logical_not", lambda x: jnp.logical_not(x).astype(_cmp_dtype(x)))
+_unary("relu", lambda x: jnp.maximum(x, 0))
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("softsign", jax.nn.soft_sign)
+_unary("_copy", lambda x: x, aliases=["identity"])
+_unary(
+    "BlockGrad",
+    jax.lax.stop_gradient,
+    aliases=["stop_gradient"],
+    doc="Stop gradient flow (reference BlockGrad / make_loss.cc). Maps to lax.stop_gradient.",
+)
+
+
+@register("clip")
+def clip(data, *, a_min, a_max):
+    """Clip values to [a_min, a_max]. Reference: src/operator/tensor/matrix_op.cc clip."""
+    return jnp.clip(data, a_min, a_max)
+
+
+@register("smooth_l1")
+def smooth_l1(data, *, scalar=1.0):
+    """Smooth L1 loss transform (reference mshadow_op.h smooth_l1_loss; rcnn bbox regression)."""
+    sigma2 = scalar * scalar
+    absd = jnp.abs(data)
+    return jnp.where(absd < 1.0 / sigma2, 0.5 * sigma2 * data * data, absd - 0.5 / sigma2)
+
+
+@register("add_n", alias=["ElementWiseSum", "_sum"])
+def add_n(*args):
+    """Sum of n arrays (reference src/operator/tensor/elemwise_sum.cc)."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+@register("_scatter_elemwise_div")
+def _scatter_elemwise_div(lhs, rhs):
+    return lhs / rhs
+
+
+@register("cast", alias=["Cast"])
+def cast(data, *, dtype):
+    """Cast dtype (reference elemwise_unary_op_basic.cc Cast)."""
+    from ..base import dtype_np
+
+    return data.astype(dtype_np(dtype))
+
+
+@register("_zeros_like", alias=["zeros_like"])
+def zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register("_ones_like", alias=["ones_like"])
+def ones_like(data):
+    return jnp.ones_like(data)
+
+
+@register("_maximum_mask_scalar")
+def _maximum_mask_scalar(data, *, scalar):
+    return (data >= scalar).astype(data.dtype)

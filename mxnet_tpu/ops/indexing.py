@@ -1,0 +1,130 @@
+"""Indexing/gather/scatter ops — reference ``src/operator/tensor/indexing_op.h``
+(take, batch_take, Embedding, one_hot, gather_nd, scatter_nd) plus ordering
+ops from ``ordering_op-inl.h`` (sort/argsort/topk).
+
+TPU notes: gathers lower to XLA gather (fine on TPU); topk uses lax.top_k
+which maps to the TPU sort unit.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+from ..base import dtype_np
+
+
+@register("take")
+def take(a, indices, *, axis=0, mode="clip"):
+    """Take elements along axis (reference indexing_op.h Take)."""
+    idx = indices.astype(jnp.int32)
+    n = a.shape[axis]
+    if mode == "wrap":
+        idx = jnp.mod(idx, n)
+    elif mode == "clip":
+        idx = jnp.clip(idx, 0, n - 1)
+    return jnp.take(a, idx, axis=axis)
+
+
+@register("batch_take")
+def batch_take(a, indices):
+    """a[i, indices[i]] (reference indexing_op.h batch_take)."""
+    return jnp.take_along_axis(a, indices.astype(jnp.int32)[:, None], axis=1)[:, 0]
+
+
+@register("Embedding")
+def embedding(data, weight, *, input_dim, output_dim, dtype="float32", sparse_grad=False):
+    """Embedding lookup (reference indexing_op.h EmbeddingOp).
+
+    TPU note: one_hot-matmul can be faster for small vocab; XLA picks gather
+    here which is fine for large vocab.
+    """
+    idx = jnp.clip(data.astype(jnp.int32), 0, input_dim - 1)
+    return jnp.take(weight, idx, axis=0)
+
+
+@register("one_hot")
+def one_hot(indices, *, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    """One-hot encode (reference indexing_op.h OneHot)."""
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=dtype_np(dtype))
+    return oh * on_value + (1.0 - oh) * off_value
+
+
+@register("gather_nd")
+def gather_nd(data, indices):
+    """Gather by leading-dim index tuples (reference indexing_op.h GatherND).
+
+    indices: (M, ...) int array; output shape indices.shape[1:] + data.shape[M:].
+    """
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return data[tuple(idx[i] for i in range(m))]
+
+
+@register("scatter_nd")
+def scatter_nd(data, indices, *, shape):
+    """Scatter values into zeros of `shape` (reference indexing_op.h ScatterND)."""
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(shape, dtype=data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].set(data)
+
+
+@register("_scatter_set_nd")
+def _scatter_set_nd(lhs, indices, rhs, *, shape=None):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return lhs.at[tuple(idx[i] for i in range(m))].set(rhs)
+
+
+# ---------------------------------------------------------------------------
+# ordering ops
+# ---------------------------------------------------------------------------
+
+
+@register("sort")
+def sort(data, *, axis=-1, is_ascend=True):
+    """Sort values (reference ordering_op-inl.h SortOp)."""
+    out = jnp.sort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register("argsort")
+def argsort(data, *, axis=-1, is_ascend=True, dtype="float32"):
+    out = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(dtype_np(dtype))
+
+
+@register("topk")
+def topk(data, *, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    """Top-k along axis (reference ordering_op-inl.h TopKOp).
+
+    ret_typ: 'value' | 'indices' | 'mask' | 'both'.
+    TPU note: lax.top_k on the last axis maps to the hardware sort unit.
+    """
+    ax = axis % data.ndim
+    x = jnp.moveaxis(data, ax, -1)
+    if is_ascend:
+        vals, idxs = jax.lax.top_k(-x, k)
+        vals = -vals
+    else:
+        vals, idxs = jax.lax.top_k(x, k)
+    vals = jnp.moveaxis(vals, -1, ax)
+    idxs = jnp.moveaxis(idxs, -1, ax)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "indices":
+        return idxs.astype(dtype_np(dtype))
+    if ret_typ == "mask":
+        oh = jax.nn.one_hot(
+            jnp.moveaxis(idxs, ax, -1).astype(jnp.int32), data.shape[ax], dtype=data.dtype
+        )
+        return jnp.moveaxis(jnp.sum(oh, axis=-2), -1, ax)
+    if ret_typ == "both":
+        return vals, idxs.astype(dtype_np(dtype))
+    raise ValueError(ret_typ)

@@ -1,0 +1,43 @@
+"""Initialization ops (zeros/ones/full/arange/eye/linspace) — reference
+``src/operator/tensor/init_op.cc``.  These take no tensor inputs; the nd
+frontend fills ctx/dtype defaults.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+from ..base import dtype_np
+
+
+@register("_zeros", alias=["zeros"])
+def zeros(*, shape, dtype="float32"):
+    return jnp.zeros(shape, dtype=dtype_np(dtype or "float32"))
+
+
+@register("_ones", alias=["ones"])
+def ones(*, shape, dtype="float32"):
+    return jnp.ones(shape, dtype=dtype_np(dtype or "float32"))
+
+
+@register("_full", alias=["full"])
+def full(*, shape, value, dtype="float32"):
+    return jnp.full(shape, value, dtype=dtype_np(dtype or "float32"))
+
+
+@register("_arange", alias=["arange"])
+def arange(*, start=0, stop=None, step=1.0, repeat=1, dtype="float32"):
+    out = jnp.arange(start, stop, step, dtype=dtype_np(dtype or "float32"))
+    if repeat != 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("_eye", alias=["eye"])
+def eye(*, N, M=0, k=0, dtype="float32"):
+    return jnp.eye(N, M if M else N, k=k, dtype=dtype_np(dtype or "float32"))
+
+
+@register("_linspace", alias=["linspace"])
+def linspace(*, start, stop, num, endpoint=True, dtype="float32"):
+    return jnp.linspace(start, stop, num, endpoint=endpoint, dtype=dtype_np(dtype or "float32"))

@@ -1,0 +1,288 @@
+"""Shape/layout manipulation ops.
+
+TPU-native equivalents of reference ``src/operator/tensor/matrix_op.cc`` —
+Reshape (with MXNet's special shape codes), transpose, slicing, concat/split,
+tile/repeat/reverse, dot/batch_dot, where, pad, stack/squeeze.
+All static-shape, XLA-friendly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+
+def infer_reshape(src_shape, target, reverse=False):
+    """Resolve MXNet Reshape special codes against a source shape.
+
+    Codes (reference matrix_op-inl.h ReshapeParam):
+      0  : copy this dimension from input
+      -1 : infer from remaining elements
+      -2 : copy all remaining input dims
+      -3 : merge two consecutive input dims
+      -4 : split one input dim into the next two target values
+    """
+    src = list(src_shape)
+    if reverse:
+        src = src[::-1]
+        target = list(target)[::-1]
+        # -4's two factors come reversed too; handle by re-reversing at end
+    out = []
+    i = 0  # index into src
+    t = 0
+    target = list(target)
+    while t < len(target):
+        code = target[t]
+        if code == 0:
+            out.append(src[i])
+            i += 1
+        elif code == -1:
+            out.append(-1)
+            i += 1
+        elif code == -2:
+            out.extend(src[i:])
+            i = len(src)
+        elif code == -3:
+            out.append(src[i] * src[i + 1])
+            i += 2
+        elif code == -4:
+            d1, d2 = target[t + 1], target[t + 2]
+            if d1 == -1:
+                d1 = src[i] // d2
+            if d2 == -1:
+                d2 = src[i] // d1
+            out.extend([d1, d2])
+            i += 1
+            t += 2
+        else:
+            out.append(int(code))
+            i += 1
+        t += 1
+    if reverse:
+        out = out[::-1]
+    # resolve a single -1
+    if -1 in out:
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= d
+        total = int(np.prod(src_shape)) if src_shape else 1
+        out[out.index(-1)] = total // max(known, 1)
+    return tuple(out)
+
+
+@register("Reshape", alias=["reshape"])
+def reshape_op(data, *, shape=None, reverse=False):
+    """Reshape with MXNet special codes (reference matrix_op.cc Reshape)."""
+    return jnp.reshape(data, infer_reshape(data.shape, shape, reverse))
+
+
+@register("Flatten", alias=["flatten"])
+def flatten(data):
+    """Collapse all dims but the first (reference matrix_op.cc Flatten)."""
+    return jnp.reshape(data, (data.shape[0], -1) if data.ndim > 1 else (data.shape[0],))
+
+
+@register("transpose")
+def transpose(data, *, axes=None):
+    """Permute axes (reference matrix_op.cc transpose)."""
+    if axes is None or axes == ():
+        axes = tuple(reversed(range(data.ndim)))
+    return jnp.transpose(data, axes)
+
+
+@register("expand_dims")
+def expand_dims(data, *, axis):
+    return jnp.expand_dims(data, axis)
+
+
+@register("squeeze")
+def squeeze(data, *, axis=None):
+    return jnp.squeeze(data, axis=axis)
+
+
+@register("slice", alias=["crop"])
+def slice_op(data, *, begin, end, step=None):
+    """N-d slice (reference matrix_op.cc slice).  None entries = full range."""
+    nd = data.ndim
+    begin = tuple(begin) + (None,) * (nd - len(begin))
+    end = tuple(end) + (None,) * (nd - len(end))
+    step = tuple(step) + (None,) * (nd - len(step)) if step else (None,) * nd
+    idx = tuple(
+        slice(b, e, s if s != 0 else None) for b, e, s in zip(begin, end, step)
+    )
+    return data[idx]
+
+
+@register("slice_axis")
+def slice_axis(data, *, axis, begin, end):
+    """Slice along one axis (reference matrix_op.cc slice_axis)."""
+    if end is None:
+        end = data.shape[axis]
+    idx = [slice(None)] * data.ndim
+    idx[axis] = slice(begin, end)
+    return data[tuple(idx)]
+
+
+@register("slice_like")
+def slice_like(data, shape_like, *, axes=()):
+    """Slice data to the shape of shape_like on given axes (reference matrix_op.cc)."""
+    axes = axes or tuple(range(min(data.ndim, shape_like.ndim)))
+    idx = [slice(None)] * data.ndim
+    for ax in axes:
+        idx[ax] = slice(0, shape_like.shape[ax])
+    return data[tuple(idx)]
+
+
+@register("Concat", alias=["concat"])
+def concat(*args, dim=1):
+    """Concatenate along dim (reference src/operator/nn/concat.cc)."""
+    return jnp.concatenate(args, axis=dim)
+
+
+@register("stack")
+def stack(*args, axis=0):
+    return jnp.stack(args, axis=axis)
+
+
+@register("SliceChannel", alias=["split"])
+def split(data, *, num_outputs, axis=1, squeeze_axis=False):
+    """Split into equal parts (reference slice_channel.cc / split).
+
+    Returns a tuple of ``num_outputs`` arrays.
+    """
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("repeat")
+def repeat(data, *, repeats, axis=None):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register("tile")
+def tile(data, *, reps):
+    return jnp.tile(data, reps)
+
+
+@register("reverse", alias=["flip"])
+def reverse(data, *, axis):
+    ax = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.flip(data, axis=ax)
+
+
+@register("SwapAxis", alias=["swapaxes"])
+def swapaxes(data, *, dim1=0, dim2=0):
+    """Swap two axes (reference src/operator/swapaxis.cc)."""
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+@register("dot")
+def dot(lhs, rhs, *, transpose_a=False, transpose_b=False):
+    """Tensor dot over last axis of lhs and first axis of rhs (reference dot-inl.h).
+
+    TPU note: lowers straight onto the MXU; prefer bf16 inputs for throughput.
+    """
+    if transpose_a:
+        lhs = jnp.transpose(lhs, tuple(range(1, lhs.ndim)) + (0,)) if lhs.ndim > 1 else lhs
+    if transpose_b:
+        rhs = jnp.transpose(rhs, (rhs.ndim - 1,) + tuple(range(rhs.ndim - 1))) if rhs.ndim > 1 else rhs
+    return jnp.tensordot(lhs, rhs, axes=1)
+
+
+@register("batch_dot")
+def batch_dot(lhs, rhs, *, transpose_a=False, transpose_b=False):
+    """Batched matmul (reference dot-inl.h batch_dot); maps to MXU-batched dot."""
+    if transpose_a:
+        lhs = jnp.swapaxes(lhs, -1, -2)
+    if transpose_b:
+        rhs = jnp.swapaxes(rhs, -1, -2)
+    return jnp.matmul(lhs, rhs)
+
+
+@register("where")
+def where(condition, x, y):
+    """Select elements (reference control_flow.cc where)."""
+    if condition.ndim == 1 and x.ndim > 1 and condition.shape[0] == x.shape[0]:
+        condition = condition.reshape((-1,) + (1,) * (x.ndim - 1))
+    return jnp.where(condition.astype(bool), x, y)
+
+
+@register("broadcast_to")
+def broadcast_to(data, *, shape):
+    """Broadcast to shape; 0s in shape keep the input dim (reference broadcast_reduce_op.h)."""
+    tgt = tuple(s if s != 0 else d for s, d in zip(shape, data.shape))
+    return jnp.broadcast_to(data, tgt)
+
+
+@register("broadcast_axis", alias=["broadcast_axes"])
+def broadcast_axis(data, *, axis=(), size=()):
+    axis = (axis,) if isinstance(axis, int) else tuple(axis)
+    size = (size,) if isinstance(size, int) else tuple(size)
+    tgt = list(data.shape)
+    for a, s in zip(axis, size):
+        tgt[a] = s
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+@register("broadcast_like")
+def broadcast_like(lhs, rhs, *, lhs_axes=None, rhs_axes=None):
+    if lhs_axes is None:
+        return jnp.broadcast_to(lhs, rhs.shape)
+    tgt = list(lhs.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        tgt[la] = rhs.shape[ra]
+    return jnp.broadcast_to(lhs, tuple(tgt))
+
+
+@register("Pad", alias=["pad"])
+def pad(data, *, mode="constant", pad_width, constant_value=0.0):
+    """Pad 4D/5D arrays (reference src/operator/pad.cc).
+
+    pad_width is the flat MXNet form: 2 values per axis, first-two axes must be 0.
+    """
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    if mode == "constant":
+        return jnp.pad(data, pw, mode="constant", constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(data, pw, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(data, pw, mode="reflect")
+    raise ValueError("unsupported pad mode %r" % mode)
+
+
+@register("space_to_depth")
+def space_to_depth(data, *, block_size):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register("depth_to_space")
+def depth_to_space(data, *, block_size):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register("diag")
+def diag(data, *, k=0):
+    return jnp.diag(data, k=k) if data.ndim <= 2 else jnp.diagonal(data, offset=k, axis1=-2, axis2=-1)
+
+
+@register("shape_array")
+def shape_array(data):
+    return jnp.asarray(data.shape, dtype=jnp.int64 if False else jnp.int32)
+
+
+@register("size_array")
+def size_array(data):
+    return jnp.asarray([data.size], dtype=jnp.int32)

@@ -1,0 +1,132 @@
+"""Random sampling ops — reference ``src/operator/random/sample_op.cc`` et al.
+
+Design: every op takes an explicit ``key`` attribute (a jax PRNG key).  The nd
+frontend injects a fresh split of the global RNG state per call (see
+``mxnet_tpu.random``), making eager calls look stateful (MXNet semantics)
+while keeping the op pure/traceable — this replaces the reference's
+per-device Random resource (src/resource.cc:123) with counter-based keys,
+which is also exactly what the parallel RNG (random_generator.h) was doing.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from ..base import dtype_np
+
+
+def _dt(dtype):
+    return dtype_np(dtype or "float32")
+
+
+@register("_random_uniform", alias=["uniform", "random_uniform"])
+def random_uniform(*, low=0.0, high=1.0, shape=(1,), dtype="float32", key=None):
+    return jax.random.uniform(key, shape, minval=low, maxval=high, dtype=_dt(dtype))
+
+
+@register("_random_normal", alias=["normal", "random_normal"])
+def random_normal(*, loc=0.0, scale=1.0, shape=(1,), dtype="float32", key=None):
+    return loc + scale * jax.random.normal(key, shape, dtype=_dt(dtype))
+
+
+@register("_random_gamma", alias=["random_gamma"])
+def random_gamma(*, alpha=1.0, beta=1.0, shape=(1,), dtype="float32", key=None):
+    return jax.random.gamma(key, alpha, shape, dtype=_dt(dtype)) * beta
+
+
+@register("_random_exponential", alias=["random_exponential"])
+def random_exponential(*, lam=1.0, shape=(1,), dtype="float32", key=None):
+    return jax.random.exponential(key, shape, dtype=_dt(dtype)) / lam
+
+
+@register("_random_poisson", alias=["random_poisson"])
+def random_poisson(*, lam=1.0, shape=(1,), dtype="float32", key=None):
+    return jax.random.poisson(key, lam, shape).astype(_dt(dtype))
+
+
+@register("_random_negative_binomial", alias=["random_negative_binomial"])
+def random_negative_binomial(*, k=1, p=1.0, shape=(1,), dtype="float32", key=None):
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, k, shape) * ((1.0 - p) / p)
+    return jax.random.poisson(k2, lam, shape).astype(_dt(dtype))
+
+
+@register("_random_generalized_negative_binomial", alias=["random_generalized_negative_binomial"])
+def random_generalized_negative_binomial(*, mu=1.0, alpha=1.0, shape=(1,), dtype="float32", key=None):
+    k1, k2 = jax.random.split(key)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(k1, r, shape) * ((1.0 - p) / p)
+    return jax.random.poisson(k2, lam, shape).astype(_dt(dtype))
+
+
+@register("_random_randint", alias=["random_randint", "randint"])
+def random_randint(*, low, high, shape=(1,), dtype="int32", key=None):
+    return jax.random.randint(key, shape, low, high, dtype=_dt(dtype))
+
+
+@register("_sample_multinomial", alias=["sample_multinomial"])
+def sample_multinomial(data, *, shape=(), get_prob=False, dtype="int32", key=None):
+    """Sample categorical indices from prob rows (reference sample_multinomial_op.cc)."""
+    n = int(jnp.prod(jnp.asarray(shape))) if shape else 1
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    batch_shape = data.shape[:-1]
+    draw_shape = batch_shape + (tuple(shape) if shape else ())
+    samples = jax.random.categorical(
+        key, logits[..., None, :] if shape else logits, axis=-1,
+        shape=batch_shape + ((n,) if shape else ()),
+    )
+    samples = samples.reshape(draw_shape) if shape else samples
+    out = samples.astype(_dt(dtype))
+    if get_prob:
+        logp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits), samples.reshape(batch_shape + (-1,)).astype(jnp.int32), axis=-1
+        ).reshape(draw_shape)
+        return out, logp
+    return out
+
+
+def _sample_like(name, base):
+    """Per-element-distribution samplers: params are arrays, one draw each
+    (reference multisample_op.cc _sample_uniform etc.)."""
+
+    if name == "_sample_uniform":
+
+        @register(name)
+        def _s(low, high, *, shape=(), dtype="float32", key=None):
+            ext = tuple(shape) if shape else ()
+            tgt = low.shape + ext
+            u = jax.random.uniform(key, tgt, dtype=_dt(dtype))
+            lo = low.reshape(low.shape + (1,) * len(ext))
+            hi = high.reshape(high.shape + (1,) * len(ext))
+            return lo + u * (hi - lo)
+
+    elif name == "_sample_normal":
+
+        @register(name)
+        def _s(mu, sigma, *, shape=(), dtype="float32", key=None):
+            ext = tuple(shape) if shape else ()
+            tgt = mu.shape + ext
+            z = jax.random.normal(key, tgt, dtype=_dt(dtype))
+            return mu.reshape(mu.shape + (1,) * len(ext)) + z * sigma.reshape(sigma.shape + (1,) * len(ext))
+
+    elif name == "_sample_gamma":
+
+        @register(name)
+        def _s(alpha, beta, *, shape=(), dtype="float32", key=None):
+            ext = tuple(shape) if shape else ()
+            a = alpha.reshape(alpha.shape + (1,) * len(ext))
+            g = jax.random.gamma(key, jnp.broadcast_to(a, alpha.shape + ext), dtype=_dt(dtype))
+            return g * beta.reshape(beta.shape + (1,) * len(ext))
+
+
+for _n in ("_sample_uniform", "_sample_normal", "_sample_gamma"):
+    _sample_like(_n, None)
+
+
+@register("_shuffle", alias=["shuffle"])
+def shuffle(data, *, key=None):
+    """Shuffle along first axis (reference src/operator/random/shuffle_op.cc)."""
+    perm = jax.random.permutation(key, data.shape[0])
+    return jnp.take(data, perm, axis=0)

@@ -1,0 +1,122 @@
+"""Reduction ops — TPU-native equivalent of reference
+``src/operator/tensor/broadcast_reduce_op*`` (sum/mean/prod/max/min/norm with
+MXNet's axis/keepdims/exclude semantics, argmax/argmin, pick, L2Normalization).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+
+def _norm_axis(ndim, axis, exclude=False):
+    """Resolve MXNet axis attr (None | int | tuple, exclude flag) → tuple or None."""
+    if axis is None or axis == ():
+        ax = None if not exclude else ()
+    else:
+        ax = (axis,) if isinstance(axis, int) else tuple(axis)
+        ax = tuple(a % ndim for a in ax)
+    if exclude:
+        all_ax = set(range(ndim))
+        ax = tuple(sorted(all_ax - set(ax or ())))
+    return ax
+
+
+def _reduce(name, jfn, aliases=(), nan=False):
+    def op(data, *, axis=None, keepdims=False, exclude=False):
+        ax = _norm_axis(data.ndim, axis, exclude)
+        return jfn(data, axis=ax, keepdims=keepdims)
+
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = "Reduce %s (reference broadcast_reduce_op_value.cc)." % name
+    register(name, alias=aliases)(op)
+    return op
+
+
+_reduce("sum", jnp.sum, aliases=["sum_axis"])
+_reduce("mean", jnp.mean)
+_reduce("prod", jnp.prod)
+_reduce("nansum", jnp.nansum)
+_reduce("nanprod", jnp.nanprod)
+_reduce("max", jnp.max, aliases=["max_axis"])
+_reduce("min", jnp.min, aliases=["min_axis"])
+
+
+@register("norm")
+def norm(data, *, ord=2, axis=None, keepdims=False):
+    """L1/L2 norm reduce (reference broadcast_reduce_op norm)."""
+    ax = _norm_axis(data.ndim, axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=ax, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=keepdims))
+
+
+@register("argmax")
+def argmax(data, *, axis=None, keepdims=False):
+    """Argmax returning float (MXNet convention; reference broadcast_reduce_op_index.cc)."""
+    out = jnp.argmax(data, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.float32)
+
+
+@register("argmin")
+def argmin(data, *, axis=None, keepdims=False):
+    out = jnp.argmin(data, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.float32)
+
+
+@register("argmax_channel")
+def argmax_channel(data):
+    """Argmax over axis 1 (reference argmax_channel, used by old classifiers)."""
+    return jnp.argmax(data, axis=1).astype(jnp.float32)
+
+
+@register("pick")
+def pick(data, index, *, axis=-1, keepdims=False, mode="clip"):
+    """Pick elements along axis by index array (reference broadcast_reduce_op_index.cc pick)."""
+    idx = index.astype(jnp.int32)
+    if mode == "wrap":
+        idx = jnp.mod(idx, data.shape[axis])
+    else:
+        idx = jnp.clip(idx, 0, data.shape[axis] - 1)
+    out = jnp.take_along_axis(data, jnp.expand_dims(idx, axis % data.ndim), axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("L2Normalization")
+def l2_normalization(data, *, eps=1e-10, mode="instance"):
+    """L2 normalize (reference src/operator/l2_normalization.cc)."""
+    if mode == "instance":
+        ax = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        ax = (1,)
+    elif mode == "spatial":
+        ax = tuple(range(2, data.ndim))
+    else:
+        raise ValueError(mode)
+    denom = jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=True) + eps)
+    return data / denom
+
+
+@register("moments")
+def moments(data, *, axes=None, keepdims=False):
+    ax = _norm_axis(data.ndim, axes)
+    mean = jnp.mean(data, axis=ax, keepdims=keepdims)
+    var = jnp.var(data, axis=ax, keepdims=keepdims)
+    return mean, var
+
+
+@register("softmax_cross_entropy")
+def softmax_cross_entropy(data, label):
+    """Fused softmax CE (reference src/operator/loss_binary_op.cc)."""
+    logp = jax.nn.log_softmax(data, axis=-1)
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), data.shape[-1], dtype=data.dtype)
+    return -jnp.sum(onehot * logp)

@@ -1,0 +1,132 @@
+"""Operator registry — the TPU-native replacement for NNVM op registration.
+
+The reference registers ~570 ops C++-side (``NNVM_REGISTER_OP``) with attrs
+(FCompute kernels, shape/type inference, gradients) and code-gens Python
+functions per op at import time (reference python/mxnet/ndarray/register.py:29,
+base.py:532).  Here an op is a *pure, jax-traceable function* on jax arrays:
+
+    @register("Convolution", alias=["convolution"])
+    def convolution(data, weight, bias=None, *, kernel, num_filter, ...):
+        ...returns jnp array(s)...
+
+From this single registration both frontends are generated:
+
+* ``mxnet_tpu.ndarray`` gets an eager wrapper (unwrap NDArray → call → wrap,
+  autograd taping — the Imperative::Invoke path, reference imperative.cc:87).
+* ``mxnet_tpu.symbol`` gets a lazy graph-node builder (the Symbol path).
+
+Shape/dtype inference (reference infer_graph_attr_pass.cc) needs no separate
+rule tables: ``jax.eval_shape`` traces the same function abstractly.  Gradients
+(reference pass nnvm::Gradient) come from jax AD through the same function.
+XLA replaces PlanMemory/bulking/fusion.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+
+__all__ = ["register", "get", "list_ops", "OpDef", "alias"]
+
+_REGISTRY = {}
+
+
+class OpDef:
+    """A registered operator.
+
+    Attributes
+    ----------
+    name : canonical op name (MXNet-style, e.g. ``Convolution``).
+    fn : pure function ``fn(*arrays, **attrs) -> array | tuple(arrays)``.
+    num_inputs : int or None (None = variadic).
+    arg_names : positional tensor-argument names (for Symbol ``list_arguments``).
+    attr_names : keyword attribute names.
+    wrap_outputs : if int n > 1, op returns an n-tuple.
+    """
+
+    def __init__(self, name, fn, aliases=(), hint=None):
+        self.name = name
+        self.fn = fn
+        self.aliases = tuple(aliases)
+        self.hint = hint or name.lower().lstrip("_")
+        sig = inspect.signature(fn)
+        self.arg_names = []
+        self.attr_names = []
+        self.defaults = {}
+        self.variadic = False
+        for p in sig.parameters.values():
+            if p.kind == inspect.Parameter.VAR_POSITIONAL:
+                self.variadic = True
+            elif p.kind == inspect.Parameter.KEYWORD_ONLY:
+                self.attr_names.append(p.name)
+                if p.default is not inspect.Parameter.empty:
+                    self.defaults[p.name] = p.default
+            elif p.kind in (
+                inspect.Parameter.POSITIONAL_ONLY,
+                inspect.Parameter.POSITIONAL_OR_KEYWORD,
+            ):
+                self.arg_names.append(p.name)
+                if p.default is not inspect.Parameter.empty:
+                    self.defaults[p.name] = p.default
+        self.__doc__ = fn.__doc__
+
+    def __call__(self, *args, **kwargs):
+        return self.fn(*args, **kwargs)
+
+    def __repr__(self):
+        return "OpDef(%s)" % self.name
+
+
+def register(name, alias=(), hint=None):
+    """Decorator registering a pure jax function as a framework operator."""
+
+    def _reg(fn):
+        opdef = OpDef(name, fn, aliases=alias, hint=hint)
+        if name in _REGISTRY:
+            raise ValueError("duplicate op registration: %s" % name)
+        _REGISTRY[name] = opdef
+        for a in alias:
+            if a in _REGISTRY:
+                raise ValueError("duplicate op alias: %s" % a)
+            _REGISTRY[a] = opdef
+        fn.op = opdef
+        return fn
+
+    return _reg
+
+
+def alias(name, *aliases):
+    """Add aliases to an already-registered op."""
+    opdef = _REGISTRY[name]
+    for a in aliases:
+        _REGISTRY[a] = opdef
+
+
+def get(name):
+    """Look up an OpDef by name or alias; raises KeyError with suggestions."""
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        close = [k for k in _REGISTRY if k.lower() == name.lower()]
+        raise KeyError(
+            "Operator %r is not registered.%s"
+            % (name, (" Did you mean %s?" % close[0]) if close else "")
+        ) from None
+
+
+def exists(name):
+    return name in _REGISTRY
+
+
+def list_ops(include_aliases=False):
+    """All registered canonical op names (sorted)."""
+    if include_aliases:
+        return sorted(_REGISTRY)
+    return sorted({op.name for op in _REGISTRY.values()})
+
+
+def defs():
+    """Unique OpDefs (one per canonical name)."""
+    seen = {}
+    for op in _REGISTRY.values():
+        seen.setdefault(op.name, op)
+    return list(seen.values())

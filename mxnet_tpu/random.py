@@ -1,0 +1,46 @@
+"""Global RNG state — reference ``python/mxnet/random.py`` (mx.random.seed).
+
+Eager random ops draw fresh counter-based PRNG keys from this module, giving
+MXNet's stateful-looking API on top of JAX's functional RNG.  Per-device seed
+streams (the reference seeds each device's Random resource separately,
+src/resource.cc) correspond to folding the device ordinal into the key.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["seed", "next_key", "current_seed"]
+
+_state = threading.local()
+
+
+def _ensure():
+    if not getattr(_state, "init", False):
+        import jax
+
+        _state.key = jax.random.PRNGKey(0)
+        _state.seed_val = 0
+        _state.init = True
+
+
+def seed(seed_state, ctx="all"):
+    """Seed the global generator (reference random.py:seed).  ctx kept for API parity."""
+    import jax
+
+    _ensure()
+    _state.key = jax.random.PRNGKey(int(seed_state))
+    _state.seed_val = int(seed_state)
+
+
+def current_seed():
+    _ensure()
+    return _state.seed_val
+
+
+def next_key():
+    """Split off a fresh key (called by the nd frontend per random op)."""
+    import jax
+
+    _ensure()
+    _state.key, sub = jax.random.split(_state.key)
+    return sub

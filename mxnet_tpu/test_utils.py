@@ -1,0 +1,245 @@
+"""Test harness — TPU-native port of reference ``python/mxnet/test_utils.py``.
+
+Same testing philosophy as the reference (SURVEY §4): numpy oracles,
+dtype-aware tolerance tables (test_utils.py:470), finite-difference gradient
+checks (:792), symbolic fwd/bwd checks (:925, :999), and cross-backend
+``check_consistency`` (:1207) — here CPU-vs-TPU instead of CPU-vs-GPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .context import Context, cpu, current_context
+from .ndarray.ndarray import NDArray, array
+
+_rng = np.random.RandomState(1234)
+
+# dtype-aware default tolerances (reference test_utils.py:470 table)
+_DEFAULT_RTOL = {
+    np.dtype(np.float16): 1e-2,
+    np.dtype(np.float32): 1e-4,
+    np.dtype(np.float64): 1e-5,
+    np.dtype(np.bool_): 0,
+    np.dtype(np.int8): 0,
+    np.dtype(np.uint8): 0,
+    np.dtype(np.int32): 0,
+    np.dtype(np.int64): 0,
+}
+_DEFAULT_ATOL = {
+    np.dtype(np.float16): 1e-1,
+    np.dtype(np.float32): 1e-3,
+    np.dtype(np.float64): 1e-20,
+    np.dtype(np.bool_): 0,
+    np.dtype(np.int8): 0,
+    np.dtype(np.uint8): 0,
+    np.dtype(np.int32): 0,
+    np.dtype(np.int64): 0,
+}
+
+
+def default_context():
+    """Context under test; switched by env like the reference (test_utils.py:53)."""
+    import os
+
+    dev = os.environ.get("MXNET_TEST_DEVICE", "")
+    if dev.startswith("tpu") or dev.startswith("gpu"):
+        from .context import tpu
+
+        return tpu(0)
+    return current_context()
+
+
+def default_dtype():
+    return np.float32
+
+
+def get_atol(atol=None, dtype=np.dtype(np.float64)):
+    return _DEFAULT_ATOL[np.dtype(dtype)] if atol is None else atol
+
+
+def get_rtol(rtol=None, dtype=np.dtype(np.float64)):
+    return _DEFAULT_RTOL[np.dtype(dtype)] if rtol is None else rtol
+
+
+def _as_np(a):
+    if isinstance(a, NDArray):
+        return a.asnumpy()
+    return np.asarray(a)
+
+
+def same(a, b):
+    return np.array_equal(_as_np(a), _as_np(b))
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False):
+    a, b = _as_np(a), _as_np(b)
+    ct = np.promote_types(a.dtype, b.dtype)
+    return np.allclose(a, b, get_rtol(rtol, ct), get_atol(atol, ct), equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"), equal_nan=False):
+    """Elementwise closeness with the reference's relative-error report
+    (reference test_utils.py:470)."""
+    a, b = _as_np(a), _as_np(b)
+    ct = np.promote_types(a.dtype, b.dtype)
+    rtol, atol = get_rtol(rtol, ct), get_atol(atol, ct)
+    if np.allclose(a, b, rtol, atol, equal_nan):
+        return
+    denom = np.abs(a) + np.abs(b) + atol
+    rel = np.abs(a - b) / denom
+    idx = np.unravel_index(np.argmax(rel), rel.shape)
+    raise AssertionError(
+        "Error %f exceeds tolerance rtol=%e, atol=%e (max at %s: %s=%s, %s=%s)\n%s vs %s"
+        % (rel[idx], rtol, atol, idx, names[0], a[idx], names[1], b[idx], a.flatten()[:10], b.flatten()[:10])
+    )
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(_rng.randint(1, dim + 1, size=ndim))
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return _rng.randint(1, dim0 + 1), _rng.randint(1, dim1 + 1)
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return _rng.randint(1, dim0 + 1), _rng.randint(1, dim1 + 1), _rng.randint(1, dim2 + 1)
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None, ctx=None):
+    """Random NDArray (reference test_utils.py:339).  Sparse stypes return the
+    BCOO-backed sparse types when requested."""
+    dtype = dtype or np.float32
+    data = _rng.uniform(-1.0, 1.0, size=shape).astype(dtype)
+    if stype == "default":
+        return array(data, ctx=ctx)
+    from .ndarray import sparse
+
+    if density is not None:
+        mask = _rng.uniform(0, 1, size=shape) < density
+        data = data * mask
+    return sparse.cast_storage(array(data, ctx=ctx), stype=stype)
+
+
+def random_arrays(*shapes):
+    arrays = [np.array(_rng.randn(), dtype=np.float64) if len(s) == 0 else _rng.randn(*s).astype(np.float64) for s in shapes]
+    if len(arrays) == 1:
+        return arrays[0]
+    return arrays
+
+
+def check_numeric_gradient(
+    f,
+    locations,
+    grads=None,
+    rtol=1e-2,
+    atol=None,
+    eps=1e-4,
+    dtype=np.float64,
+):
+    """Finite-difference check of an NDArray function's autograd gradients
+    (reference test_utils.py:792 — here against the autograd tape instead of
+    executor backward).
+
+    f: callable taking NDArrays and returning one NDArray (scalar-reduced
+    internally if not already scalar).
+    locations: list of numpy arrays (the differentiable inputs).
+    """
+    from . import autograd
+    from .ndarray import ones as nd_ones
+
+    nd_inputs = [array(loc.astype(np.float32)) for loc in locations]
+    for x in nd_inputs:
+        x.attach_grad()
+    with autograd.record():
+        out = f(*nd_inputs)
+        loss = out.sum() if out.size != 1 else out
+    loss.backward()
+    sym_grads = [x.grad.asnumpy().astype(np.float64) for x in nd_inputs]
+
+    # numeric gradients via central differences on numpy copies
+    for gi, loc in enumerate(locations):
+        if grads is not None and gi not in grads:
+            continue
+        num_grad = np.zeros_like(loc, dtype=np.float64)
+        flat = loc.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps
+            plus = float(
+                f(*[array(l.astype(np.float32)) for l in locations]).sum().asscalar()
+            )
+            flat[i] = orig - eps
+            minus = float(
+                f(*[array(l.astype(np.float32)) for l in locations]).sum().asscalar()
+            )
+            flat[i] = orig
+            num_grad.reshape(-1)[i] = (plus - minus) / (2 * eps)
+        assert_almost_equal(
+            num_grad,
+            sym_grads[gi],
+            rtol=rtol,
+            atol=atol if atol is not None else 1e-3,
+            names=("numeric_grad_%d" % gi, "autograd_%d" % gi),
+        )
+
+
+def check_symbolic_forward(sym, locations, expected, rtol=1e-4, atol=1e-5, aux_states=None, ctx=None):
+    """Bind a Symbol, run forward, compare to expected numpy (reference :925)."""
+    from .executor import _simple_bind_for_test
+
+    exe = _simple_bind_for_test(sym, locations, aux_states=aux_states, ctx=ctx)
+    outs = exe.forward(is_train=False)
+    for o, e in zip(outs, expected):
+        assert_almost_equal(o.asnumpy(), e, rtol=rtol, atol=atol)
+    return [o.asnumpy() for o in outs]
+
+
+def check_symbolic_backward(sym, locations, out_grads, expected, rtol=1e-4, atol=1e-5, aux_states=None, ctx=None):
+    """Run backward, compare input grads to expected numpy (reference :999)."""
+    from .executor import _simple_bind_for_test
+
+    exe = _simple_bind_for_test(sym, locations, aux_states=aux_states, ctx=ctx, grad_req="write")
+    exe.forward(is_train=True)
+    exe.backward(out_grads=[array(g) for g in out_grads])
+    grads = {k: v.asnumpy() for k, v in zip(sym.list_arguments(), exe.grad_arrays) if v is not None}
+    if isinstance(expected, dict):
+        for name, e in expected.items():
+            assert_almost_equal(grads[name], e, rtol=rtol, atol=atol, names=("grad_" + name, "expected"))
+    else:
+        for (name, g), e in zip(sorted(grads.items()), expected):
+            assert_almost_equal(g, e, rtol=rtol, atol=atol)
+    return grads
+
+
+def check_consistency(f, inputs, ctx_list=None, rtol=None, atol=None):
+    """Run the same computation on each context and cross-compare
+    (reference test_utils.py:1207 — CPU vs TPU instead of CPU vs GPU)."""
+    ctx_list = ctx_list or [cpu(0), default_context()]
+    results = []
+    for ctx in ctx_list:
+        nd_in = [array(x, ctx=ctx) for x in inputs]
+        out = f(*nd_in)
+        results.append(out.asnumpy() if isinstance(out, NDArray) else [o.asnumpy() for o in out])
+    base = results[0]
+    for r in results[1:]:
+        if isinstance(base, list):
+            for a, b in zip(base, r):
+                assert_almost_equal(a, b, rtol=rtol, atol=atol)
+        else:
+            assert_almost_equal(base, r, rtol=rtol, atol=atol)
+    return results
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    from .executor import _simple_bind_for_test
+
+    exe = _simple_bind_for_test(sym, inputs, ctx=ctx)
+    outputs = exe.forward(is_train=is_train)
+    if len(outputs) == 1:
+        return outputs[0].asnumpy()
+    return [o.asnumpy() for o in outputs]
+
+
+def discard_stderr(fn):
+    return fn

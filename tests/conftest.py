@@ -1,0 +1,29 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU mesh BEFORE jax import, per the
+reference's pattern of simulating a cluster with local processes
+(SURVEY §4.1 — tools/launch.py local tracker); here virtual XLA host devices
+play the role of the N processes.  Real-TPU runs are the driver's job.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed_all(request):
+    """Per-test deterministic seeding (reference tests/python/unittest/common.py:97
+    @with_seed).  Seed is derived from the test name; printed on failure via -v."""
+    import mxnet_tpu as mx
+
+    seed = abs(hash(request.node.nodeid)) % (2**31)
+    seed = int(os.environ.get("MXNET_TEST_SEED", seed))
+    np.random.seed(seed)
+    mx.random.seed(seed)
+    yield

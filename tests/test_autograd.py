@@ -1,0 +1,144 @@
+"""Autograd tape tests — modeled on reference tests/python/unittest/test_autograd.py."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_chain_rule():
+    x = nd.array(np.random.rand(3, 4).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x)
+        z = (y * 2).sum()
+    z.backward()
+    assert_almost_equal(x.grad, 2 * np.exp(x.asnumpy()), rtol=1e-4, atol=1e-5)
+
+
+def test_grad_through_slicing_reshape():
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    x.attach_grad()
+    with autograd.record():
+        y = x[1:].reshape((1, 8)).sum()
+    y.backward()
+    expected = np.zeros((3, 4), dtype=np.float32)
+    expected[1:] = 1
+    assert_almost_equal(x.grad, expected)
+
+
+def test_multi_variable():
+    a = nd.array([2.0])
+    b = nd.array([3.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = a * b + a
+    c.backward()
+    assert_almost_equal(a.grad, np.array([4.0]))  # b + 1
+    assert_almost_equal(b.grad, np.array([2.0]))  # a
+
+
+def test_head_grads():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(out_grad=nd.array([10.0, 100.0]))
+    assert_almost_equal(x.grad, np.array([30.0, 300.0]))
+
+
+def test_grad_add_req():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = x * 2
+        y.backward()
+    assert_almost_equal(x.grad, np.array([6.0]))
+
+
+def test_pause_and_modes():
+    x = nd.array([1.0])
+    x.attach_grad()
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+        y = x * 2
+    y.backward()
+    assert_almost_equal(x.grad, np.array([2.0]))
+
+
+def test_detach_blocks_grad():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = nd.BlockGrad(y) * x
+    z.backward()
+    assert_almost_equal(x.grad, np.array([6.0]))  # only through the second factor
+
+
+def test_autograd_grad_function():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+    (g,) = autograd.grad([y], [x])
+    assert_almost_equal(g, np.array([12.0]))
+
+
+def test_softmax_grad_numeric():
+    check_numeric_gradient(
+        lambda x: nd.softmax(x, axis=-1).sum(axis=-1).sum() + (nd.softmax(x) * nd.softmax(x)).sum(),
+        [np.random.rand(2, 3)],
+        rtol=5e-2,
+    )
+
+
+def test_matmul_grad_numeric():
+    check_numeric_gradient(
+        lambda a, b: nd.dot(a, b).sum(),
+        [np.random.rand(2, 3), np.random.rand(3, 2)],
+        rtol=5e-2,
+    )
+
+
+def test_softmax_output_backward():
+    # SoftmaxOutput grad = (p - onehot) * scale, ignoring label grad
+    data = nd.array(np.random.rand(4, 5).astype(np.float32))
+    label = nd.array(np.array([0, 1, 2, 3], dtype=np.float32))
+    data.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(data, label)
+    out.backward()
+    p = np.exp(data.asnumpy()) / np.exp(data.asnumpy()).sum(axis=1, keepdims=True)
+    onehot = np.eye(5, dtype=np.float32)[label.asnumpy().astype(int)]
+    assert_almost_equal(data.grad, p - onehot, rtol=1e-4, atol=1e-5)
+
+
+def test_training_flag_dropout():
+    x = nd.ones((100, 100))
+    with autograd.record(train_mode=True):
+        y = nd.Dropout(x, p=0.5)
+    frac_zero = 1.0 - (y.asnumpy() != 0).mean()
+    assert 0.3 < frac_zero < 0.7
+    with autograd.record(train_mode=False):
+        y2 = nd.Dropout(x, p=0.5)
+    assert (y2.asnumpy() == 1).all()
+    y3 = nd.Dropout(x, p=0.5)  # outside record: inference
+    assert (y3.asnumpy() == 1).all()
