@@ -1,0 +1,346 @@
+"""Executor — compiled runtime for Symbol graphs.
+
+TPU-native replacement for the reference GraphExecutor
+(``src/executor/graph_executor.cc:513``): instead of NNVM passes + engine
+scheduling, ``bind`` composes the registry's pure functions over the DAG and
+hands the whole thing to ``jax.jit``.  XLA performs memory planning
+(PlanMemory), op fusion (bulking), and scheduling; gradients come from
+``jax.vjp`` (the nnvm::Gradient pass).  Aux states (BatchNorm moving stats)
+are extra functional outputs folded back after each training forward —
+replacing the reference's in-place aux mutation.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, _wrap, array
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    """Compiled forward/backward runner (reference include/mxnet/executor.h:53)."""
+
+    def __init__(self, symbol, ctx=None, args=None, args_grad=None, grad_req="write", aux_states=None):
+        self._symbol = symbol
+        self._ctx = ctx
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        self._out_names = symbol.list_outputs()
+        self.arg_dict = self._to_dict(args, self._arg_names, "args")
+        self.aux_dict = self._to_dict(aux_states, self._aux_names, "aux_states")
+        self.grad_dict = self._to_dict(args_grad, self._arg_names, "args_grad", allow_none=True) or {}
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in self._arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(self._arg_names, grad_req))
+        else:
+            self._grad_req = dict(grad_req or {})
+        self.outputs = []
+        self._monitor = None
+        self._fwd_cache = {}
+        self._bwd_cache = None
+        self._plan = self._make_plan()
+
+    # -- array plumbing -----------------------------------------------------
+    def _to_dict(self, arrays, names, what, allow_none=False):
+        if arrays is None:
+            if allow_none:
+                return None
+            return {}
+        if isinstance(arrays, dict):
+            return dict(arrays)
+        if isinstance(arrays, (list, tuple)):
+            if len(arrays) != len(names):
+                raise MXNetError(
+                    "%s length %d != expected %d (%s)" % (what, len(arrays), len(names), names)
+                )
+            return {n: a for n, a in zip(names, arrays) if a is not None}
+        raise TypeError(type(arrays))
+
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict.get(n) for n in self._arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict.get(n) for n in self._aux_names]
+
+    # -- plan ---------------------------------------------------------------
+    def _make_plan(self):
+        """Static execution plan: topological node list with resolved input
+        slots, random-key folding per stochastic node, aux-update metadata."""
+        nodes = self._symbol._walk()
+        from .symbol.symbol import _sym_out_name
+
+        plan = []
+        for node in nodes:
+            if node.is_var:
+                continue
+            in_names = [_sym_out_name(i) for i in node.inputs]
+            plan.append((node, in_names))
+        self._head_names = []
+        for node, idx in self._symbol._outputs_of():
+            base = node._base() if node.out_index is not None else node
+            self._head_names.append(_sym_out_name(node) if node.is_var else (
+                "%s_output%d" % (base.name, idx) if base.num_outputs > 1 else "%s_output" % base.name
+            ))
+        return plan
+
+    def _graph_fn(self, is_train, monitor=None):
+        """Pure fn (arg_vals, aux_vals, key) -> (head_vals, new_aux_vals).
+
+        ``monitor``: optional callback(name, jax_value) invoked per node output
+        — only used on the un-jitted path (reference ExecuteMonCallback,
+        graph_executor.cc:1562).
+        """
+        import zlib
+
+        import jax
+
+        from .symbol.symbol import _node_input_names
+
+        plan = self._plan
+        aux_names = list(self._aux_names)
+        arg_names = list(self._arg_names)
+
+        def fn(arg_vals, aux_vals, key):
+            env = {}
+            env.update(zip(arg_names, arg_vals))
+            env.update(zip(aux_names, aux_vals))
+            new_aux = dict(zip(aux_names, aux_vals))
+            for node, in_names in plan:
+                attrs = dict(node.attrs)
+                if "key" in node.op.attr_names and "key" not in attrs:
+                    # stable per-node stream: crc32 is process-independent
+                    # (PYTHONHASHSEED-proof), keeping seeded runs reproducible
+                    attrs["key"] = jax.random.fold_in(key, zlib.crc32(node.name.encode()))
+                if "training" in node.op.attr_names and "training" not in attrs:
+                    attrs["training"] = is_train
+                args = [env[n] for n in in_names]
+                res = node.op.fn(*args, **attrs)
+                outs = res if isinstance(res, tuple) else (res,)
+                if is_train and node.op.aux_update is not None:
+                    by_arg = dict(zip(_node_input_names(node), node.inputs))
+                    aux_in = {
+                        a: new_aux[by_arg[a].name]
+                        for a in node.op.aux
+                        if a in by_arg and by_arg[a].is_var and by_arg[a].name in new_aux
+                    }
+                    updated = node.op.aux_update(attrs, res, aux_in)
+                    for a, v in updated.items():
+                        new_aux[by_arg[a].name] = v
+                if len(outs) > 1 and node.num_outputs == 1:
+                    outs = outs[:1]  # hidden outputs (e.g. BatchNorm stats)
+                for i, o in enumerate(outs):
+                    nm = (
+                        "%s_output%d" % (node.name, i)
+                        if node.num_outputs > 1
+                        else "%s_output" % node.name
+                    )
+                    env[nm] = o
+                    if monitor is not None:
+                        monitor(nm, o)
+            heads = [env[h] for h in self._head_names]
+            return heads, [new_aux[n] for n in aux_names]
+
+        return fn
+
+    def _compiled(self, is_train):
+        import jax
+
+        if is_train not in self._fwd_cache:
+            fn = self._graph_fn(is_train)
+            self._fwd_cache[is_train] = jax.jit(fn)
+        return self._fwd_cache[is_train]
+
+    # -- API ----------------------------------------------------------------
+    def forward(self, is_train=False, **kwargs):
+        """Run forward (reference GraphExecutor::Forward → RunOps)."""
+        from . import random as _rnd
+
+        for k, v in kwargs.items():
+            if k not in self._arg_names:
+                raise MXNetError(
+                    "forward() got unknown argument %r; expected one of %s" % (k, self._arg_names)
+                )
+            self.arg_dict[k] = v if isinstance(v, NDArray) else array(v)
+        missing = [n for n in self._arg_names if n not in self.arg_dict]
+        if missing:
+            raise MXNetError("forward() missing bound values for arguments: %s" % missing)
+        arg_vals = [self.arg_dict[n]._data for n in self._arg_names]
+        aux_vals = [self.aux_dict[n]._data for n in self._aux_names]
+        key = _rnd.next_key()
+        if self._monitor is not None:
+            cb = self._monitor
+            heads, new_aux = self._graph_fn(
+                bool(is_train), monitor=lambda n, v: cb(n, _wrap(v))
+            )(arg_vals, aux_vals, key)
+        else:
+            heads, new_aux = self._compiled(bool(is_train))(arg_vals, aux_vals, key)
+        for n, v in zip(self._aux_names, new_aux):
+            self.aux_dict[n]._rebind(v)
+        self.outputs = [_wrap(h) for h in heads]
+        self._last_key = key
+        self._last_is_train = bool(is_train)
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        """Gradients into grad arrays per grad_req (reference
+        GraphExecutor::Backward; the Gradient pass is jax.vjp here)."""
+        import jax
+        import jax.numpy as jnp
+
+        diff_names = tuple(
+            n for n in self._arg_names if self._grad_req.get(n, "null") != "null" and n in self.grad_dict
+        )
+        if not diff_names:
+            return
+        aux_vals = [self.aux_dict[n]._data for n in self._aux_names]
+        key = getattr(self, "_last_key", None)
+        if key is None:
+            from . import random as _rnd
+
+            key = _rnd.next_key()
+        arg_vals = [self.arg_dict[n]._data for n in self._arg_names]
+        ones_ct = out_grads is None
+        if not ones_ct:
+            if isinstance(out_grads, (NDArray, np.ndarray)):
+                out_grads = [out_grads]
+            cts_in = [g._data if isinstance(g, NDArray) else jnp.asarray(g) for g in out_grads]
+        cache_key = (diff_names, ones_ct)
+        if self._bwd_cache is None or self._bwd_cache[0] != cache_key:
+            fn = self._graph_fn(True)
+            arg_names = list(self._arg_names)
+            dset = set(diff_names)
+            const_names = [n for n in arg_names if n not in dset]
+
+            def bwd(diff_vals, const_vals, aux_v, k, cts):
+                def f(dvals):
+                    merged = dict(zip(const_names, const_vals))
+                    merged.update(zip(diff_names, dvals))
+                    heads, _ = fn([merged[n] for n in arg_names], aux_v, k)
+                    return heads
+
+                heads, vjp_fn = jax.vjp(f, diff_vals)
+                c = [jnp.ones_like(h) for h in heads] if ones_ct else cts
+                (grads,) = vjp_fn(c)
+                return grads
+
+            self._bwd_cache = (cache_key, jax.jit(bwd))
+        bwd_fn = self._bwd_cache[1]
+        dset = set(diff_names)
+        grads = bwd_fn(
+            [self.arg_dict[n]._data for n in diff_names],
+            [v for n, v in zip(self._arg_names, arg_vals) if n not in dset],
+            aux_vals,
+            key,
+            [] if ones_ct else cts_in,
+        )
+        for n, g in zip(diff_names, grads):
+            req = self._grad_req.get(n, "write")
+            tgt = self.grad_dict.get(n)
+            if tgt is None:
+                continue
+            if req == "add":
+                tgt._rebind(tgt._data + g)
+            else:
+                tgt._rebind(g)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Re-bind with new shapes (reference GraphExecutor::Reshape:1053).
+
+        jit recompiles per shape signature automatically; only arrays need
+        re-allocation here.
+        """
+        from .ndarray import zeros as nd_zeros
+
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args = {}
+        for n, s in zip(self._arg_names, arg_shapes):
+            old = self.arg_dict.get(n)
+            if old is not None and tuple(old.shape) == tuple(s):
+                new_args[n] = old
+            else:
+                new_args[n] = nd_zeros(s, ctx=self._ctx)
+        new_grads = None
+        if self.grad_dict:
+            new_grads = {}
+            for n, s in zip(self._arg_names, arg_shapes):
+                if n in self.grad_dict:
+                    old = self.grad_dict[n]
+                    new_grads[n] = old if tuple(old.shape) == tuple(s) else nd_zeros(s, ctx=self._ctx)
+        new_aux = {}
+        for n, s in zip(self._aux_names, aux_shapes):
+            old = self.aux_dict.get(n)
+            new_aux[n] = old if old is not None and tuple(old.shape) == tuple(s) else nd_zeros(s, ctx=self._ctx)
+        return Executor(self._symbol, self._ctx, new_args, new_grads, self._grad_req, new_aux)
+
+    def copy_params_from(self, arg_params, aux_params=None, allow_extra_params=False):
+        for k, v in (arg_params or {}).items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._rebind(v._data if isinstance(v, NDArray) else array(v)._data)
+            elif not allow_extra_params:
+                raise MXNetError("unknown arg %s" % k)
+        for k, v in (aux_params or {}).items():
+            if k in self.aux_dict:
+                self.aux_dict[k]._rebind(v._data if isinstance(v, NDArray) else array(v)._data)
+            elif not allow_extra_params:
+                raise MXNetError("unknown aux %s" % k)
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        """Install per-output inspection (reference executor.h:172 monitor).
+        Forward runs un-jitted while a monitor is installed."""
+        self._monitor = callback
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._out_names, self.outputs))
+
+    def debug_str(self):
+        return self._symbol.debug_str()
+
+
+def _simple_bind_for_test(sym, locations, aux_states=None, ctx=None, grad_req="null"):
+    """Bind with concrete numpy/NDArray inputs (test_utils helper)."""
+    args = {}
+    if isinstance(locations, dict):
+        for k, v in locations.items():
+            args[k] = v if isinstance(v, NDArray) else array(v)
+    else:
+        for n, v in zip(sym.list_arguments(), locations):
+            args[n] = v if isinstance(v, NDArray) else array(v)
+    aux = {}
+    if aux_states:
+        if isinstance(aux_states, dict):
+            aux = {k: (v if isinstance(v, NDArray) else array(v)) for k, v in aux_states.items()}
+        else:
+            aux = {
+                n: (v if isinstance(v, NDArray) else array(v))
+                for n, v in zip(sym.list_auxiliary_states(), aux_states)
+            }
+    # fill any remaining args (params) with zeros via shape inference
+    known = {k: tuple(v.shape) for k, v in args.items()}
+    try:
+        arg_shapes, _, aux_shapes = sym.infer_shape(**known)
+        from .ndarray import zeros as nd_zeros
+
+        for n, s in zip(sym.list_arguments(), arg_shapes):
+            if n not in args and s is not None:
+                args[n] = nd_zeros(s, ctx=ctx)
+        for n, s in zip(sym.list_auxiliary_states(), aux_shapes):
+            if n not in aux and s is not None:
+                aux[n] = nd_zeros(s, ctx=ctx)
+    except MXNetError:
+        pass
+    grads = {n: None for n in args}
+    if grad_req != "null":
+        from .ndarray import zeros as nd_zeros
+
+        grads = {n: nd_zeros(a.shape, ctx=ctx) for n, a in args.items()}
+    return Executor(sym, ctx, args, grads if grad_req != "null" else None, grad_req, aux)

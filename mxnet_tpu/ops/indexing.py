@@ -33,7 +33,10 @@ def batch_take(a, indices):
     return jnp.take_along_axis(a, indices.astype(jnp.int32)[:, None], axis=1)[:, 0]
 
 
-@register("Embedding")
+@register(
+    "Embedding",
+    infer_params=lambda attrs, shapes: {"weight": (attrs["input_dim"], attrs["output_dim"])},
+)
 def embedding(data, weight, *, input_dim, output_dim, dtype="float32", sparse_grad=False):
     """Embedding lookup (reference indexing_op.h EmbeddingOp).
 

@@ -35,7 +35,18 @@ def _tup(v, n):
 # ---------------------------------------------------------------------------
 
 
-@register("FullyConnected")
+def _fc_inputs(attrs):
+    return ["data", "weight"] if attrs.get("no_bias") else ["data", "weight", "bias"]
+
+
+def _fc_params(attrs, shapes):
+    d = shapes["data"]
+    nh = attrs["num_hidden"]
+    in_dim = int(np.prod(d[1:])) if attrs.get("flatten", True) else d[-1]
+    return {"weight": (nh, in_dim), "bias": (nh,)}
+
+
+@register("FullyConnected", inputs_fn=_fc_inputs, infer_params=_fc_params)
 def fully_connected(data, weight, bias=None, *, num_hidden, no_bias=False, flatten=True):
     """Dense layer (reference src/operator/nn/fully_connected.cc).
 
@@ -57,7 +68,16 @@ def _conv_dims(kernel_ndim):
     return ("NC" + spatial, "OI" + spatial, "NC" + spatial)
 
 
-@register("Convolution")
+def _conv_params(attrs, shapes):
+    d = shapes["data"]
+    k = attrs["kernel"]
+    k = (k,) if isinstance(k, int) else tuple(k)
+    g = attrs.get("num_group", 1)
+    nf = attrs["num_filter"]
+    return {"weight": (nf, d[1] // g) + k, "bias": (nf,)}
+
+
+@register("Convolution", inputs_fn=_fc_inputs, infer_params=_conv_params)
 def convolution(
     data,
     weight,
@@ -104,7 +124,20 @@ def convolution(
     return out
 
 
-@register("Deconvolution")
+def _deconv_params(attrs, shapes):
+    d = shapes["data"]
+    k = tuple(attrs["kernel"])
+    g = attrs.get("num_group", 1)
+    nf = attrs["num_filter"]
+    return {"weight": (d[1], nf // g) + k, "bias": (nf,)}
+
+
+def _deconv_inputs(attrs):
+    # deconvolution's no_bias DEFAULTS TO TRUE (reference deconvolution-inl.h)
+    return ["data", "weight"] if attrs.get("no_bias", True) else ["data", "weight", "bias"]
+
+
+@register("Deconvolution", inputs_fn=_deconv_inputs, infer_params=_deconv_params)
 def deconvolution(
     data,
     weight,
@@ -274,7 +307,31 @@ def adaptive_avg_pooling(data, *, output_size=(1, 1)):
 # ---------------------------------------------------------------------------
 
 
-@register("BatchNorm")
+def _bn_params(attrs, shapes):
+    c = shapes["data"][attrs.get("axis", 1) % len(shapes["data"])]
+    return {"gamma": (c,), "beta": (c,), "moving_mean": (c,), "moving_var": (c,)}
+
+
+def _bn_aux_update(attrs, outputs, aux_vals):
+    """moving = m*moving + (1-m)*batch, the reference's in-place stat update."""
+    if attrs.get("use_global_stats"):
+        return aux_vals
+    _, mean, var = outputs
+    m = attrs.get("momentum", 0.9)
+    out = dict(aux_vals)
+    if "moving_mean" in out:
+        out["moving_mean"] = m * out["moving_mean"] + (1 - m) * mean
+    if "moving_var" in out:
+        out["moving_var"] = m * out["moving_var"] + (1 - m) * var
+    return out
+
+
+@register(
+    "BatchNorm",
+    aux=("moving_mean", "moving_var"),
+    infer_params=_bn_params,
+    aux_update=_bn_aux_update,
+)
 def batch_norm(
     data,
     gamma,
@@ -313,7 +370,12 @@ def batch_norm(
     return out, mean, var
 
 
-@register("LayerNorm")
+def _ln_params(attrs, shapes):
+    c = shapes["data"][attrs.get("axis", -1) % len(shapes["data"])]
+    return {"gamma": (c,), "beta": (c,)}
+
+
+@register("LayerNorm", infer_params=_ln_params)
 def layer_norm(data, gamma, beta, *, axis=-1, eps=1e-5, output_mean_var=False):
     """Layer normalization (reference src/operator/nn/layer_norm.cc)."""
     ax = axis % data.ndim
@@ -328,7 +390,7 @@ def layer_norm(data, gamma, beta, *, axis=-1, eps=1e-5, output_mean_var=False):
     return out
 
 
-@register("InstanceNorm")
+@register("InstanceNorm", infer_params=lambda attrs, shapes: {"gamma": (shapes["data"][1],), "beta": (shapes["data"][1],)})
 def instance_norm(data, gamma, beta, *, eps=1e-3):
     """Instance norm (reference src/operator/instance_norm.cc)."""
     red = tuple(range(2, data.ndim))
@@ -372,7 +434,11 @@ def activation(data, *, act_type):
     raise ValueError("unknown act_type %r" % act_type)
 
 
-@register("LeakyReLU")
+@register(
+    "LeakyReLU",
+    inputs_fn=lambda attrs: ["data", "gamma"] if attrs.get("act_type") == "prelu" else ["data"],
+    infer_params=lambda attrs, shapes: {"gamma": (shapes["data"][1],)},
+)
 def leaky_relu(data, gamma=None, *, act_type="leaky", slope=0.25, lower_bound=0.125, upper_bound=0.334, key=None):
     """Leaky/PReLU/ELU/SELU/GELU/RReLU (reference src/operator/leaky_relu.cc)."""
     if act_type == "leaky":
@@ -427,7 +493,14 @@ def softmax_activation(data, *, mode="instance"):
     return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
 
 
-@register("SoftmaxOutput", alias=["Softmax"])
+def _softmax_output_label_shape(attrs, shapes):
+    d = shapes["data"]
+    if attrs.get("multi_output"):
+        return {"label": (d[0],) + tuple(d[2:])}
+    return {"label": tuple(d[:-1])}
+
+
+@register("SoftmaxOutput", alias=["Softmax"], infer_params=_softmax_output_label_shape)
 def softmax_output(
     data,
     label,
@@ -521,18 +594,22 @@ def dropout(data, *, p=0.5, mode="training", axes=(), training=False, key=None):
 # ---------------------------------------------------------------------------
 
 
-@register("LinearRegressionOutput")
+def _same_as_data(attrs, shapes):
+    return {"label": tuple(shapes["data"])}
+
+
+@register("LinearRegressionOutput", infer_params=_same_as_data)
 def linear_regression_output(data, label, *, grad_scale=1.0):
     """Identity fwd, (pred-label)/batch grad (reference src/operator/regression_output.cc)."""
     return _regression_vjp(data, label, grad_scale, "linear")
 
 
-@register("MAERegressionOutput")
+@register("MAERegressionOutput", infer_params=_same_as_data)
 def mae_regression_output(data, label, *, grad_scale=1.0):
     return _regression_vjp(data, label, grad_scale, "mae")
 
 
-@register("LogisticRegressionOutput")
+@register("LogisticRegressionOutput", infer_params=_same_as_data)
 def logistic_regression_output(data, label, *, grad_scale=1.0):
     return _regression_vjp(data, label, grad_scale, "logistic")
 

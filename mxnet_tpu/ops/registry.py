@@ -43,11 +43,24 @@ class OpDef:
     wrap_outputs : if int n > 1, op returns an n-tuple.
     """
 
-    def __init__(self, name, fn, aliases=(), hint=None):
+    def __init__(self, name, fn, aliases=(), hint=None, aux=(), inputs_fn=None, infer_params=None, aux_update=None):
         self.name = name
         self.fn = fn
         self.aliases = tuple(aliases)
         self.hint = hint or name.lower().lstrip("_")
+        # aux: names of tensor args that are auxiliary states (BatchNorm moving_*)
+        self.aux = tuple(aux)
+        # aux_update(attrs, raw_outputs, {aux_name: value}) -> {aux_name: new_value}
+        # applied by executors during training forward (replaces the reference's
+        # in-place aux mutation inside kernels)
+        self.aux_update = aux_update
+        # inputs_fn(attrs) -> list of required tensor-arg names for these attrs
+        # (reference OperatorProperty::ListArguments; e.g. bias dropped by no_bias)
+        self.inputs_fn = inputs_fn
+        # infer_params(attrs, known_shapes: dict) -> dict of param-name -> shape
+        # (the partial shape inference jax.eval_shape can't do; reference
+        # infer_graph_attr_pass.cc solves the same problem graph-wide)
+        self.infer_params = infer_params
         sig = inspect.signature(fn)
         self.arg_names = []
         self.attr_names = []
@@ -76,11 +89,20 @@ class OpDef:
         return "OpDef(%s)" % self.name
 
 
-def register(name, alias=(), hint=None):
+def register(name, alias=(), hint=None, aux=(), inputs_fn=None, infer_params=None, aux_update=None):
     """Decorator registering a pure jax function as a framework operator."""
 
     def _reg(fn):
-        opdef = OpDef(name, fn, aliases=alias, hint=hint)
+        opdef = OpDef(
+            name,
+            fn,
+            aliases=alias,
+            hint=hint,
+            aux=aux,
+            inputs_fn=inputs_fn,
+            infer_params=infer_params,
+            aux_update=aux_update,
+        )
         if name in _REGISTRY:
             raise ValueError("duplicate op registration: %s" % name)
         _REGISTRY[name] = opdef

@@ -1,0 +1,27 @@
+"""mx.sym — symbolic graph frontend.
+
+TPU-native replacement for NNVM Symbol (reference nnvm::Symbol +
+``python/mxnet/symbol/symbol.py:53``).  A Symbol is a lightweight DAG of
+registry ops; ``bind`` compiles it with jax.jit (replacing GraphExecutor's
+PlanMemory/AttachOpExecs — XLA does both), ``Gradient`` comes from jax AD.
+"""
+from .symbol import Symbol, Variable, var, Group, load, load_json, zeros, ones, arange
+
+import sys
+import types
+
+from ..ops import registry as _registry
+from ..ops import _load_all  # noqa: F401
+from .symbol import _make_sym_op_func
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json", "zeros", "ones", "arange"]
+
+# generated symbolic op namespace (reference python/mxnet/symbol/register.py)
+op = types.ModuleType(__name__ + ".op")
+op.__doc__ = "All registered operators as Symbol builders."
+for _name in _registry.list_ops(include_aliases=True):
+    _f = _make_sym_op_func(_registry.get(_name), _name)
+    setattr(op, _name, _f)
+    if not hasattr(sys.modules[__name__], _name):
+        setattr(sys.modules[__name__], _name, _f)
+sys.modules[op.__name__] = op

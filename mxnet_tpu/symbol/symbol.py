@@ -1,0 +1,707 @@
+"""Symbol — lazy operator graph.
+
+Design: a Symbol is an immutable node (op, inputs, attrs, name) possibly
+exposing several outputs.  The graph is pure data; everything heavy
+(shape/type inference, compilation, gradients) is delegated to jax tracing of
+the composed registry functions — the TPU-native answer to the reference's
+NNVM passes (InferShape/InferType → jax.eval_shape; Gradient → jax.vjp;
+PlanMemory/fusion → XLA).  Serialization round-trips through JSON like the
+reference's tojson/load (legacy_json_util.cc versioning de-scoped to v1).
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..base import AttrScope, NameManager, MXNetError, parse_attr, attr_str, dtype_name, dtype_np
+from ..ops import registry as _registry
+
+__all__ = ["Symbol", "Variable", "var", "Group"]
+
+
+class Symbol:
+    __slots__ = ("op", "inputs", "attrs", "name", "num_outputs", "out_index", "_shape_hint", "_dtype_hint", "_user_attrs")
+
+    def __init__(self, op, inputs, attrs, name, num_outputs=1, out_index=None, user_attrs=None):
+        self.op = op  # OpDef or None for variables / group
+        self.inputs = inputs  # list[Symbol] (single-output view each)
+        self.attrs = attrs  # dict of static op attrs
+        self.name = name
+        self.num_outputs = num_outputs
+        self.out_index = out_index  # if not None: this Symbol is one output of a multi-output node
+        self._shape_hint = None
+        self._dtype_hint = None
+        self._user_attrs = user_attrs or {}
+
+    # -- graph structure ----------------------------------------------------
+    @property
+    def is_var(self):
+        return self.op is None and not self.is_group
+
+    @property
+    def is_group(self):
+        return self.op is None and self.attrs.get("__group__", False)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __len__(self):
+        if self.is_group:
+            return len(self.inputs)
+        return self.num_outputs
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            index = names.index(index)
+        if self.is_group:
+            return self.inputs[index]
+        if self.num_outputs == 1 and index == 0:
+            return self
+        if index >= self.num_outputs:
+            raise IndexError(index)
+        return Symbol(self.op, self.inputs, self.attrs, self.name, self.num_outputs, out_index=index)
+
+    def get_internals(self):
+        """All intermediate single-output views, addressable by name_output
+        (reference symbol.py get_internals)."""
+        seen = []
+        names = set()
+
+        def visit(s):
+            base = s._base()
+            key = base.name
+            if key in names:
+                return
+            names.add(key)
+            for inp in base.inputs:
+                visit(inp)
+            for i in range(base.num_outputs):
+                seen.append(base[i] if base.num_outputs > 1 else base)
+
+        visit(self)
+        return Group(seen)
+
+    def get_children(self):
+        base = self._base()
+        return Group(list(base.inputs)) if base.inputs else None
+
+    def _base(self):
+        """The underlying node ignoring out_index selection."""
+        if self.out_index is None:
+            return self
+        return Symbol(self.op, self.inputs, self.attrs, self.name, self.num_outputs)
+
+    # -- naming / listing ---------------------------------------------------
+    def _outputs_of(self):
+        """(node, out_index) pairs this symbol exposes."""
+        if self.is_group:
+            out = []
+            for s in self.inputs:
+                out.extend(s._outputs_of())
+            return out
+        if self.out_index is None and self.num_outputs > 1:
+            return [(self[i], i) for i in range(self.num_outputs)]
+        return [(self, self.out_index or 0)]
+
+    def list_outputs(self):
+        outs = []
+        for node, idx in self._outputs_of():
+            if node.is_var:
+                outs.append(node.name)
+            elif node.num_outputs > 1:
+                outs.append("%s_output%d" % (node.name, idx))
+            else:
+                outs.append("%s_output" % node.name)
+        return outs
+
+    def _walk(self):
+        """Topological DFS over unique base nodes (inputs before consumers)."""
+        visited = {}
+        order = []
+
+        def visit(s):
+            base = s if s.out_index is None else s._base()
+            key = id(base.op) if False else base.name
+            if key in visited:
+                return visited[key]
+            for inp in base.inputs:
+                visit(inp)
+            visited[key] = base
+            order.append(base)
+            return base
+
+        if self.is_group:
+            for s in self.inputs:
+                visit(s)
+        else:
+            visit(self)
+        return order
+
+    def list_arguments(self):
+        """Free variables in DFS order (reference symbol.py list_arguments),
+        excluding auxiliary states."""
+        aux = set(self.list_auxiliary_states())
+        return [n.name for n in self._walk() if n.is_var and n.name not in aux]
+
+    def list_auxiliary_states(self):
+        """Aux-state variable names (BatchNorm moving stats etc.)."""
+        aux_names = []
+        for node in self._walk():
+            if node.op is not None and node.op.aux:
+                arg_pos = {a: i for i, a in enumerate(node.op.arg_names)}
+                for aux_arg in node.op.aux:
+                    i = arg_pos.get(aux_arg)
+                    if i is not None and i < len(node.inputs) and node.inputs[i].is_var:
+                        aux_names.append(node.inputs[i].name)
+        return aux_names
+
+    def list_attr(self):
+        return dict(self._user_attrs)
+
+    def attr(self, key):
+        return self._user_attrs.get(key)
+
+    def attr_dict(self):
+        out = {}
+        for node in self._walk():
+            d = dict(node._user_attrs)
+            for k, v in node.attrs.items():
+                d[k] = attr_str(v)
+            if d:
+                out[node.name] = d
+        return out
+
+    def _set_attr(self, **kwargs):
+        self._user_attrs.update(kwargs)
+
+    # -- shape/type inference ----------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        """Infer (arg_shapes, out_shapes, aux_shapes) from partial shapes
+        (reference MXSymbolInferShape).  Uses per-op infer_params rules for
+        parameter vars + jax.eval_shape for everything else."""
+        try:
+            return self._infer_shape_impl(False, *args, **kwargs)
+        except MXNetError:
+            raise
+        except Exception as e:
+            raise MXNetError("infer_shape error: %s" % e) from e
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        import jax
+
+        arg_names = self.list_arguments()
+        known = {}
+        if args:
+            for n, s in zip(arg_names, args):
+                if s is not None:
+                    known[n] = tuple(s)
+        known.update({k: tuple(v) for k, v in kwargs.items() if v is not None})
+        shapes, dtypes = _infer_graph(self, known, {})
+        aux_names = self.list_auxiliary_states()
+        arg_shapes = [shapes.get(n) for n in arg_names]
+        aux_shapes = [shapes.get(n) for n in aux_names]
+        out_shapes = [shapes[o] for o in self.list_outputs()]
+        if not partial and any(s is None for s in arg_shapes + aux_shapes):
+            missing = [n for n in arg_names + aux_names if shapes.get(n) is None]
+            raise MXNetError("infer_shape incomplete; unknown for: %s" % missing)
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        """Infer (arg_types, out_types, aux_types).  Types ride along the same
+        eval_shape pass as shapes when var shapes are known/hinted; otherwise
+        falls back to the seeded/default dtype per name."""
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        known = {}
+        if args:
+            for n, t in zip(arg_names, args):
+                if t is not None:
+                    known[n] = dtype_np(t)
+        known.update({k: dtype_np(v) for k, v in kwargs.items() if v is not None})
+        arg_types = [np.dtype(known.get(n, np.float32)) for n in arg_names]
+        aux_types = [np.dtype(known.get(n, np.float32)) for n in aux_names]
+        out_types = None
+        shape_hints = {
+            n.name: n._shape_hint for n in self._walk() if n.is_var and n._shape_hint
+        }
+        try:
+            _, dtypes = _infer_graph(self, shape_hints, known)
+            out_types = [np.dtype(dtypes[o]) for o in self.list_outputs()]
+        except Exception:
+            out_types = [np.dtype(known.get(arg_names[0], np.float32)) if arg_names else np.float32
+                         for _ in self.list_outputs()]
+        return arg_types, out_types, aux_types
+
+    # -- composition / arithmetic -------------------------------------------
+    def _binop(self, opname, other, reverse=False):
+        opdef = _registry.get(opname)
+        if isinstance(other, Symbol):
+            a, b = (other, self) if reverse else (self, other)
+            return _create(opdef, [a, b], {}, None)
+        # scalar
+        scalar_ops = {
+            "broadcast_add": "_plus_scalar",
+            "broadcast_sub": "_rminus_scalar" if reverse else "_minus_scalar",
+            "broadcast_mul": "_mul_scalar",
+            "broadcast_div": "_rdiv_scalar" if reverse else "_div_scalar",
+            "broadcast_mod": "_rmod_scalar" if reverse else "_mod_scalar",
+            "broadcast_power": "_rpower_scalar" if reverse else "_power_scalar",
+            "broadcast_equal": "_equal_scalar",
+            "broadcast_not_equal": "_not_equal_scalar",
+            "broadcast_greater": "_lesser_scalar" if reverse else "_greater_scalar",
+            "broadcast_greater_equal": "_lesser_equal_scalar" if reverse else "_greater_equal_scalar",
+            "broadcast_lesser": "_greater_scalar" if reverse else "_lesser_scalar",
+            "broadcast_lesser_equal": "_greater_equal_scalar" if reverse else "_lesser_equal_scalar",
+        }
+        sop = _registry.get(scalar_ops[opname])
+        return _create(sop, [self], {"scalar": float(other)}, None)
+
+    def __add__(self, o):
+        return self._binop("broadcast_add", o)
+
+    def __radd__(self, o):
+        return self._binop("broadcast_add", o, True)
+
+    def __sub__(self, o):
+        return self._binop("broadcast_sub", o)
+
+    def __rsub__(self, o):
+        return self._binop("broadcast_sub", o, True)
+
+    def __mul__(self, o):
+        return self._binop("broadcast_mul", o)
+
+    def __rmul__(self, o):
+        return self._binop("broadcast_mul", o, True)
+
+    def __truediv__(self, o):
+        return self._binop("broadcast_div", o)
+
+    def __rtruediv__(self, o):
+        return self._binop("broadcast_div", o, True)
+
+    def __pow__(self, o):
+        return self._binop("broadcast_power", o)
+
+    def __neg__(self):
+        return self._binop("broadcast_mul", -1.0)
+
+    def __eq__(self, o):
+        return self._binop("broadcast_equal", o)
+
+    def __ne__(self, o):
+        return self._binop("broadcast_not_equal", o)
+
+    def __gt__(self, o):
+        return self._binop("broadcast_greater", o)
+
+    def __ge__(self, o):
+        return self._binop("broadcast_greater_equal", o)
+
+    def __lt__(self, o):
+        return self._binop("broadcast_lesser", o)
+
+    def __le__(self, o):
+        return self._binop("broadcast_lesser_equal", o)
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        if self.is_var:
+            return "<Symbol %s>" % self.name
+        return "<Symbol %s>" % self.name
+
+    def __call__(self, *args, **kwargs):
+        """Compose: replace variable inputs (reference symbol composition)."""
+        s = self._compose(*args, **kwargs)
+        return s
+
+    def _compose(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        mapping = {}
+        for n, a in zip(arg_names, args):
+            mapping[n] = a
+        mapping.update(kwargs)
+        return _substitute(self, mapping, {})
+
+    # -- attributes / common ops as methods ----------------------------------
+    def reshape(self, shape, **kw):
+        from . import op as symop
+
+        return symop.Reshape(self, shape=shape, **kw)
+
+    def astype(self, dtype):
+        from . import op as symop
+
+        return symop.cast(self, dtype=dtype_name(dtype))
+
+    def transpose(self, axes=None):
+        from . import op as symop
+
+        return symop.transpose(self, axes=axes)
+
+    def sum(self, axis=None, keepdims=False):
+        from . import op as symop
+
+        return symop.sum(self, axis=axis, keepdims=keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        from . import op as symop
+
+        return symop.mean(self, axis=axis, keepdims=keepdims)
+
+    def slice_axis(self, axis, begin, end):
+        from . import op as symop
+
+        return symop.slice_axis(self, axis=axis, begin=begin, end=end)
+
+    # -- evaluation ---------------------------------------------------------
+    def eval(self, ctx=None, **kwargs):
+        exe = self.bind(ctx, kwargs)
+        return exe.forward()
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write", aux_states=None, **ignore):
+        from ..executor import Executor
+
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None, **shapes):
+        """Allocate all arrays from shape inference and bind (reference
+        symbol.py:1287 → GraphExecutor::Init)."""
+        from ..executor import Executor
+        from ..ndarray import zeros as nd_zeros
+
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        arg_shapes, _, aux_shapes = self.infer_shape(**shapes)
+        args = {}
+        for n, s in zip(arg_names, arg_shapes):
+            dt = (type_dict or {}).get(n, "float32")
+            args[n] = nd_zeros(s, ctx=ctx, dtype=dt)
+        aux = {}
+        for n, s in zip(aux_names, aux_shapes):
+            aux[n] = nd_zeros(s, ctx=ctx)
+        grads = None
+        if grad_req != "null":
+            grads = {n: nd_zeros(s, ctx=ctx) for n, s in zip(arg_names, arg_shapes)}
+        return Executor(self, ctx, args, grads, grad_req, aux)
+
+    # -- serialization ------------------------------------------------------
+    def tojson(self):
+        """Serialize the graph to JSON (reference Symbol::ToJSON).
+
+        Node format mirrors the reference's {op, name, attrs, inputs} records
+        so tooling feels familiar; version tag "mxnet_tpu:1".
+        """
+        nodes = []
+        index = {}
+        for node in self._walk():
+            inputs = []
+            for inp in node.inputs:
+                base_name = inp._base().name if inp.out_index is not None else inp.name
+                inputs.append([index[base_name], inp.out_index or 0, 0])
+            nodes.append(
+                {
+                    "op": node.op.name if node.op else "null",
+                    "name": node.name,
+                    "attrs": {k: attr_str(v) for k, v in node.attrs.items()},
+                    "inputs": inputs,
+                }
+            )
+            index[node.name] = len(nodes) - 1
+        heads = []
+        for node, idx in self._outputs_of():
+            base = node._base() if node.out_index is not None else node
+            heads.append([index[base.name], idx, 0])
+        return json.dumps(
+            {"nodes": nodes, "heads": heads, "attrs": {"mxnet_tpu_version": 1}}, indent=2
+        )
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    def debug_str(self):
+        lines = []
+        for node in self._walk():
+            if node.is_var:
+                lines.append("Variable:%s" % node.name)
+            else:
+                lines.append(
+                    "Op:%s, Name=%s\nInputs:\n\t%s"
+                    % (node.op.name, node.name, "\n\t".join(i.name for i in node.inputs))
+                )
+        return "\n".join(lines)
+
+
+def _substitute(sym, mapping, memo):
+    key = id(sym)
+    if key in memo:
+        return memo[key]
+    if sym.is_var:
+        out = mapping.get(sym.name, sym)
+    else:
+        new_inputs = [_substitute(i, mapping, memo) for i in sym.inputs]
+        out = Symbol(sym.op, new_inputs, sym.attrs, sym.name, sym.num_outputs, sym.out_index)
+    memo[key] = out
+    return out
+
+
+def _num_outputs_of(opdef, attrs):
+    """Static output count by abstract evaluation is deferred; known multi-output
+    ops are special-cased, everything else is 1 until traced."""
+    if opdef.name == "SliceChannel":
+        return attrs.get("num_outputs", 1)
+    if opdef.name in ("BatchNorm",):
+        return 3 if attrs.get("output_mean_var") else 1
+    if opdef.name == "LayerNorm":
+        return 3 if attrs.get("output_mean_var") else 1
+    if opdef.name == "moments":
+        return 2
+    if opdef.name == "topk":
+        return 2 if attrs.get("ret_typ") == "both" else 1
+    return 1
+
+
+def _create(opdef, input_syms, attrs, name, user_attrs=None):
+    name = NameManager.current().get(name, opdef.hint)
+    scope_attrs = AttrScope.current().get(user_attrs)
+    n_out = _num_outputs_of(opdef, attrs)
+    node = Symbol(opdef, input_syms, attrs, name, num_outputs=n_out, user_attrs=scope_attrs)
+    return node
+
+
+def _make_sym_op_func(opdef, public_name):
+    def sym_func(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        kwargs.pop("ctx", None)
+        user_attrs = kwargs.pop("attr", None)
+        attrs = {}
+        tensor_args = list(args)
+        if opdef.variadic:
+            inputs = []
+            for a in tensor_args:
+                if not isinstance(a, Symbol):
+                    raise TypeError("variadic op %s expects Symbols" % opdef.name)
+                inputs.append(a)
+            for k, v in kwargs.items():
+                if isinstance(v, Symbol):
+                    inputs.append(v)
+                else:
+                    attrs[k] = parse_attr(v) if isinstance(v, str) else v
+            return _create(opdef, inputs, attrs, name, user_attrs)
+        named = {}
+        for i, a in enumerate(tensor_args):
+            named[opdef.arg_names[i]] = a
+        for k, v in list(kwargs.items()):
+            if k in opdef.arg_names and isinstance(v, Symbol):
+                named[k] = v
+            elif k in ("cudnn_tune", "cudnn_off", "workspace", "__layout__"):
+                pass
+            else:
+                attrs[k] = parse_attr(v) if isinstance(v, str) else v
+        # input list per attrs (ListArguments): auto-create missing vars
+        if opdef.inputs_fn is not None:
+            needed = opdef.inputs_fn(attrs)
+        else:
+            needed = [a for a in opdef.arg_names if a not in opdef.defaults or a in named]
+        name = NameManager.current().get(name, opdef.hint)
+        inputs = [
+            named[argname] if argname in named else Variable("%s_%s" % (name, argname))
+            for argname in needed
+        ]
+        return Symbol(
+            opdef,
+            inputs,
+            attrs,
+            name,
+            _num_outputs_of(opdef, attrs),
+            user_attrs=AttrScope.current().get(user_attrs),
+        )
+
+    sym_func.__name__ = public_name.lstrip("_")
+    sym_func.__qualname__ = sym_func.__name__
+    sym_func.__doc__ = opdef.__doc__
+    sym_func.opdef = opdef
+    return sym_func
+
+
+def Variable(name, attr=None, shape=None, dtype=None, init=None, **kwargs):
+    """Create a symbolic variable (reference symbol.py Variable)."""
+    user_attrs = AttrScope.current().get(attr)
+    if init is not None:
+        user_attrs = dict(user_attrs)
+        user_attrs["__init__"] = init if isinstance(init, str) else init.dumps()
+    s = Symbol(None, [], {}, name, user_attrs=user_attrs)
+    if shape is not None:
+        s._shape_hint = tuple(shape)
+    if dtype is not None:
+        s._dtype_hint = dtype_np(dtype)
+    return s
+
+
+var = Variable
+
+
+def Group(symbols):
+    """Group several symbols into a multi-output symbol (reference sym.Group)."""
+    flat = []
+    for s in symbols:
+        flat.append(s)
+    g = Symbol(None, flat, {"__group__": True}, "_group")
+    return g
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def load_json(json_str):
+    """Rebuild a Symbol from tojson output."""
+    from . import op as symop
+
+    data = json.loads(json_str)
+    nodes = data["nodes"]
+    built = []
+    for rec in nodes:
+        if rec["op"] == "null":
+            v = Variable(rec["name"])
+            built.append(v)
+        else:
+            opdef = _registry.get(rec["op"])
+            attrs = {k: parse_attr(v) for k, v in rec.get("attrs", {}).items()}
+            inputs = []
+            for i, oidx, _ in rec["inputs"]:
+                src = built[i]
+                inputs.append(src[oidx] if src.num_outputs > 1 else src)
+            node = Symbol(opdef, inputs, attrs, rec["name"], _num_outputs_of(opdef, attrs))
+            built.append(node)
+    heads = []
+    for i, oidx, _ in data["heads"]:
+        src = built[i]
+        heads.append(src[oidx] if src.num_outputs > 1 else src)
+    if len(heads) == 1:
+        return heads[0]
+    return Group(heads)
+
+
+# ---------------------------------------------------------------------------
+# graph-wide shape inference
+# ---------------------------------------------------------------------------
+
+
+def _infer_graph(sym, known_shapes, known_dtypes):
+    """Walk the graph inferring shapes/dtypes; fills parameter-var shapes from
+    per-op infer_params rules, propagates through ops with jax.eval_shape."""
+    import jax
+    import jax.numpy as jnp
+
+    shapes = dict(known_shapes)
+    dtypes = dict(known_dtypes)
+    out_shapes = {}
+    out_dtypes = {}
+
+    for node in sym._walk():
+        if node.is_var:
+            if node.name not in shapes and node._shape_hint is not None:
+                shapes[node.name] = node._shape_hint
+            if node.name in shapes:
+                out_shapes[node.name] = shapes[node.name]
+                out_dtypes[node.name] = dtypes.get(node.name, np.float32)
+            continue
+        # gather input shapes; fill parameter vars via infer_params
+        in_recs = []
+        arg_pos_names = _node_input_names(node)
+        have_all = True
+        known_by_argname = {}
+        for inp, argname in zip(node.inputs, arg_pos_names):
+            nm = _sym_out_name(inp)
+            if nm in out_shapes:
+                known_by_argname[argname] = out_shapes[nm]
+        if node.op.infer_params is not None:
+            try:
+                params = node.op.infer_params(node.attrs, known_by_argname)
+            except Exception:
+                params = {}
+            for inp, argname in zip(node.inputs, arg_pos_names):
+                nm = _sym_out_name(inp)
+                if nm not in out_shapes and inp.is_var and argname in params:
+                    shapes[inp.name] = tuple(params[argname])
+                    out_shapes[inp.name] = shapes[inp.name]
+                    out_dtypes[inp.name] = dtypes.get(inp.name, np.float32)
+        for inp in node.inputs:
+            nm = _sym_out_name(inp)
+            if nm not in out_shapes:
+                have_all = False
+                break
+            in_recs.append(
+                jax.ShapeDtypeStruct(out_shapes[nm], out_dtypes.get(nm, np.float32))
+            )
+        if not have_all:
+            continue
+        attrs = dict(node.attrs)
+        if "key" in node.op.attr_names and "key" not in attrs:
+            attrs["key"] = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        try:
+            res = jax.eval_shape(lambda *a: node.op.fn(*a, **attrs), *in_recs)
+        except Exception as e:
+            raise MXNetError(
+                "shape inference failed at op %s(%s): %s" % (node.op.name, node.name, e)
+            ) from e
+        outs = res if isinstance(res, tuple) else (res,)
+        if len(outs) > node.num_outputs:
+            outs = outs[: node.num_outputs]  # hidden outputs (BatchNorm stats)
+        for i, o in enumerate(outs):
+            nm = "%s_output%d" % (node.name, i) if node.num_outputs > 1 else "%s_output" % node.name
+            out_shapes[nm] = tuple(o.shape)
+            out_dtypes[nm] = o.dtype
+    merged = dict(out_shapes)
+    merged.update(shapes)
+    dt = dict(out_dtypes)
+    dt.update(dtypes)
+    return merged, dt
+
+
+def _node_input_names(node):
+    if node.op.inputs_fn is not None:
+        try:
+            return node.op.inputs_fn(node.attrs)
+        except Exception:
+            pass
+    if node.op.variadic:
+        return ["arg%d" % i for i in range(len(node.inputs))]
+    return node.op.arg_names[: len(node.inputs)]
+
+
+def _sym_out_name(s):
+    if s.is_var:
+        return s.name
+    if s.num_outputs > 1:
+        return "%s_output%d" % (s.name, s.out_index or 0)
+    return "%s_output" % s.name
+
+
+def zeros(shape, dtype="float32", **kw):
+    from . import op as symop
+
+    return symop._zeros(shape=shape, dtype=dtype, **kw)
+
+
+def ones(shape, dtype="float32", **kw):
+    from . import op as symop
+
+    return symop._ones(shape=shape, dtype=dtype, **kw)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, name=None, dtype="float32"):
+    from . import op as symop
+
+    return symop._arange(start=start, stop=stop, step=step, repeat=repeat, name=name, dtype=dtype)

@@ -1,0 +1,159 @@
+"""Symbol + Executor tests — modeled on reference tests/python/unittest/test_symbol.py
+and parts of test_operator.py's symbolic checks."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_variable_and_compose():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a + b
+    assert set(c.list_arguments()) == {"a", "b"}
+    assert c.list_outputs() == [c.name + "_output"]
+
+
+def test_mlp_structure():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, num_hidden=64, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act, num_hidden=10, name="fc2")
+    out = sym.SoftmaxOutput(fc2, name="softmax")
+    args = out.list_arguments()
+    assert args == ["data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias", "softmax_label"]
+
+
+def test_infer_shape():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, num_hidden=64, name="fc1")
+    arg_shapes, out_shapes, aux_shapes = fc1.infer_shape(data=(32, 100))
+    assert arg_shapes == [(32, 100), (64, 100), (64,)]
+    assert out_shapes == [(32, 64)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_conv_bn():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1), name="conv1")
+    bn = sym.BatchNorm(conv, name="bn1")
+    act = sym.Activation(bn, act_type="relu")
+    arg_shapes, out_shapes, aux_shapes = act.infer_shape(data=(2, 3, 16, 16))
+    assert out_shapes == [(2, 8, 16, 16)]
+    d = dict(zip(act.list_arguments(), arg_shapes))
+    assert d["conv1_weight"] == (8, 3, 3, 3)
+    assert d["bn1_gamma"] == (8,)
+    assert dict(zip(act.list_auxiliary_states(), aux_shapes))["bn1_moving_mean"] == (8,)
+
+
+def test_simple_bind_forward_backward():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a * b + a
+    exe = c.simple_bind(ctx=mx.cpu(), a=(3,), b=(3,))
+    exe.arg_dict["a"][:] = nd.array([1.0, 2.0, 3.0])
+    exe.arg_dict["b"][:] = nd.array([4.0, 5.0, 6.0])
+    outs = exe.forward()
+    assert_almost_equal(outs[0], np.array([5, 12, 21], dtype=np.float32))
+    exe.backward(out_grads=nd.ones((3,)))
+    assert_almost_equal(exe.grad_dict["a"], np.array([5, 6, 7], dtype=np.float32))
+    assert_almost_equal(exe.grad_dict["b"], np.array([1, 2, 3], dtype=np.float32))
+
+
+def test_executor_mlp_forward():
+    np.random.seed(0)
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=4, name="fc")
+    out = sym.softmax(fc, name="sm")
+    exe = out.simple_bind(ctx=mx.cpu(), data=(2, 3))
+    exe.arg_dict["data"][:] = nd.array(np.random.rand(2, 3))
+    exe.arg_dict["fc_weight"][:] = nd.array(np.random.rand(4, 3))
+    exe.arg_dict["fc_bias"][:] = nd.array(np.random.rand(4))
+    outs = exe.forward()
+    x = exe.arg_dict["data"].asnumpy()
+    w = exe.arg_dict["fc_weight"].asnumpy()
+    b = exe.arg_dict["fc_bias"].asnumpy()
+    logits = x @ w.T + b
+    p = np.exp(logits) / np.exp(logits).sum(axis=1, keepdims=True)
+    assert_almost_equal(outs[0], p, rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_aux_update():
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data, name="bn", momentum=0.5, fix_gamma=False)
+    exe = bn.simple_bind(ctx=mx.cpu(), data=(4, 2))
+    exe.arg_dict["bn_gamma"][:] = 1.0
+    exe.aux_dict["bn_moving_var"][:] = 1.0
+    x = np.random.rand(4, 2).astype(np.float32) * 3
+    exe.arg_dict["data"][:] = nd.array(x)
+    exe.forward(is_train=True)
+    mm = exe.aux_dict["bn_moving_mean"].asnumpy()
+    assert_almost_equal(mm, 0.5 * x.mean(axis=0), rtol=1e-3, atol=1e-4)
+    # eval mode uses moving stats, does not update them
+    exe.forward(is_train=False)
+    assert_almost_equal(exe.aux_dict["bn_moving_mean"].asnumpy(), mm)
+
+
+def test_group_and_internals():
+    a = sym.Variable("a")
+    b = a * 2
+    c = b + 1
+    g = sym.Group([b, c])
+    assert len(g.list_outputs()) == 2
+    internals = c.get_internals()
+    assert any("_output" in n or n == "a" for n in internals.list_outputs())
+
+
+def test_save_load_json(tmp_path):
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = sym.Activation(fc, act_type="relu", name="act1")
+    js = act.tojson()
+    act2 = sym.load_json(js)
+    assert act2.list_arguments() == act.list_arguments()
+    f = str(tmp_path / "sym.json")
+    act.save(f)
+    act3 = sym.load(f)
+    assert act3.list_arguments() == act.list_arguments()
+    # behavioral equivalence
+    exe = act3.simple_bind(ctx=mx.cpu(), data=(2, 4))
+    exe.arg_dict["data"][:] = 1.0
+    exe.arg_dict["fc1_weight"][:] = 0.5
+    out = exe.forward()[0]
+    assert out.shape == (2, 8)
+    assert_almost_equal(out, np.full((2, 8), 2.0))
+
+
+def test_multi_output_slicechannel():
+    data = sym.Variable("data")
+    parts = sym.SliceChannel(data, num_outputs=2, axis=1, name="slice")
+    assert len(parts.list_outputs()) == 2
+    first = parts[0]
+    exe = first.simple_bind(ctx=mx.cpu(), data=(2, 4))
+    exe.arg_dict["data"][:] = nd.array(np.arange(8).reshape(2, 4))
+    out = exe.forward()[0]
+    assert out.shape == (2, 2)
+    assert_almost_equal(out, np.array([[0, 1], [4, 5]], dtype=np.float32))
+
+
+def test_scalar_ops_on_symbols():
+    a = sym.Variable("a")
+    c = (a + 1) * 3 - 0.5
+    exe = c.simple_bind(ctx=mx.cpu(), a=(2,))
+    exe.arg_dict["a"][:] = nd.array([1.0, 2.0])
+    assert_almost_equal(exe.forward()[0], np.array([5.5, 8.5], dtype=np.float32))
+
+
+def test_dropout_deterministic_under_seed():
+    data = sym.Variable("data")
+    d = sym.Dropout(data, p=0.5, name="drop")
+    exe = d.simple_bind(ctx=mx.cpu(), data=(50, 50))
+    exe.arg_dict["data"][:] = 1.0
+    mx.random.seed(7)
+    o1 = exe.forward(is_train=True)[0].asnumpy()
+    mx.random.seed(7)
+    o2 = exe.forward(is_train=True)[0].asnumpy()
+    assert np.array_equal(o1, o2)
+    o3 = exe.forward(is_train=False)[0].asnumpy()
+    assert (o3 == 1).all()
