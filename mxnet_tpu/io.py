@@ -1,0 +1,380 @@
+"""Data iterators — reference ``python/mxnet/io.py`` (DataIter :182,
+NDArrayIter :546, MXDataIter :766, PrefetchingIter :349, ResizeIter) and the
+C++ iterator pipeline of ``src/io/`` (batching/shuffle/prefetch layers).
+
+TPU notes: the iterator yields host-side batches; device transfer happens at
+op execution (or sharded via parallel.device_put_sharded in the data-parallel
+trainer).  Background prefetch uses a thread (the reference's
+iter_prefetcher.h), overlapping host pipeline with device compute.
+"""
+from __future__ import annotations
+
+import collections
+import queue as _queue
+import threading
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, array
+
+__all__ = [
+    "DataDesc",
+    "DataBatch",
+    "DataIter",
+    "NDArrayIter",
+    "ResizeIter",
+    "PrefetchingIter",
+    "MXDataIter",
+    "CSVIter",
+]
+
+
+class DataDesc(collections.namedtuple("DataDesc", ["name", "shape"])):
+    """Data layout descriptor (reference io.py DataDesc)."""
+
+    def __new__(cls, name, shape, dtype=np.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    def __repr__(self):
+        return "DataDesc[%s,%s,%s,%s]" % (self.name, self.shape, self.dtype, self.layout)
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch:
+    """One batch: data list + label list + padding info (reference io.py DataBatch)."""
+
+    def __init__(self, data=None, label=None, pad=None, index=None, bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None and not isinstance(data, (list, tuple)):
+            data = [data]
+        if label is not None and not isinstance(label, (list, tuple)):
+            label = [label]
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        return "{}: data shapes: {} label shapes: {}".format(
+            self.__class__.__name__,
+            [d.shape for d in self.data or []],
+            [l.shape for l in self.label or []],
+        )
+
+
+class DataIter:
+    """Iterator base (reference io.py:182)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(
+                data=self.getdata(), label=self.getlabel(), pad=self.getpad(), index=self.getindex()
+            )
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize to list of (name, numpy) (reference io.py _init_data)."""
+    assert data is not None or allow_empty
+    if data is None:
+        data = []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, list):
+        if not allow_empty:
+            assert len(data) > 0
+        if len(data) == 1:
+            data = collections.OrderedDict([(default_name, data[0])])
+        else:
+            data = collections.OrderedDict(
+                [("_%d_%s" % (i, default_name), d) for i, d in enumerate(data)]
+            )
+    if not isinstance(data, dict):
+        raise TypeError("Input must be NDArray, numpy.ndarray, list or dict")
+    out = collections.OrderedDict()
+    for k, v in data.items():
+        out[k] = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
+    return list(out.items())
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (reference io.py:546).
+
+    Supports shuffle, pad/discard/roll_over last-batch handling.
+    """
+
+    def __init__(
+        self,
+        data,
+        label=None,
+        batch_size=1,
+        shuffle=False,
+        last_batch_handle="pad",
+        data_name="data",
+        label_name="softmax_label",
+    ):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+        self.idx = np.arange(self.data[0][1].shape[0])
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.num_data = self.idx.shape[0]
+        if last_batch_handle == "discard":
+            self.num_data = (self.num_data // batch_size) * batch_size
+        assert self.num_data >= batch_size, "batch_size needs to be smaller than data size"
+        self.cursor = -batch_size
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [
+            DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype) for k, v in self.data
+        ]
+
+    @property
+    def provide_label(self):
+        return [
+            DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype) for k, v in self.label
+        ]
+
+    def reset(self):
+        if self.shuffle:
+            np.random.shuffle(self.idx)
+        if self.last_batch_handle == "roll_over" and -self.batch_size < self.cursor < self.num_data:
+            self.cursor = -self.batch_size + (self.cursor % self.num_data) % self.batch_size
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def _getdata(self, data_source):
+        assert self.cursor < self.num_data
+        if self.cursor + self.batch_size <= self.num_data:
+            sel = self.idx[self.cursor : self.cursor + self.batch_size]
+            return [array(v[sel]) for _, v in data_source]
+        # padding: wrap around
+        pad = self.batch_size - (self.num_data - self.cursor)
+        sel = np.concatenate([self.idx[self.cursor :self.num_data], self.idx[:pad]])
+        return [array(v[sel]) for _, v in data_source]
+
+    def getdata(self):
+        return self._getdata(self.data)
+
+    def getlabel(self):
+        return self._getdata(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to `size` batches per epoch (reference io.py ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+        if hasattr(data_iter, "default_bucket_key"):
+            self.default_bucket_key = data_iter.default_bucket_key
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Thread-backed prefetcher over one or more iterators (reference io.py:349
+    and the C++ prefetcher iter_prefetcher.h)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None, prefetch_depth=2):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self._queue = _queue.Queue(maxsize=prefetch_depth)
+        self._stop = threading.Event()
+        self._thread = None
+        self.current_batch = None
+        self._start()
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum(
+            [
+                [DataDesc(r.get(d.name, d.name), d.shape, d.dtype) for d in i.provide_data]
+                for r, i in zip(self.rename_data, self.iters)
+            ],
+            [],
+        )
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum(
+            [
+                [DataDesc(r.get(d.name, d.name), d.shape, d.dtype) for d in i.provide_label]
+                for r, i in zip(self.rename_label, self.iters)
+            ],
+            [],
+        )
+
+    def _worker(self):
+        while not self._stop.is_set():
+            batches = []
+            try:
+                for it in self.iters:
+                    batches.append(it.next())
+            except StopIteration:
+                self._queue.put(None)
+                return
+            merged = DataBatch(
+                data=sum([b.data for b in batches], []),
+                label=sum([(b.label or []) for b in batches], []),
+                pad=batches[0].pad,
+                index=batches[0].index,
+            )
+            while not self._stop.is_set():
+                try:
+                    self._queue.put(merged, timeout=0.1)
+                    break
+                except _queue.Full:
+                    continue
+
+    def _start(self):
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+        while not self._queue.empty():
+            self._queue.get_nowait()
+        for it in self.iters:
+            it.reset()
+        self._start()
+
+    def iter_next(self):
+        batch = self._queue.get()
+        if batch is None:
+            return False
+        self.current_batch = batch
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+    def __del__(self):
+        self._stop.set()
+
+
+class CSVIter(NDArrayIter):
+    """CSV file iterator (reference src/io/iter_csv.cc, kept host-side)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,), batch_size=1, **kwargs):
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32)
+            label = label.reshape((-1,) + tuple(label_shape)) if label_shape != (1,) else label
+        super().__init__(data, label, batch_size=batch_size, **kwargs)
+
+
+def MXDataIter(*args, **kwargs):
+    raise MXNetError(
+        "MXDataIter wrapped C++ iterators in the reference; use ImageRecordIter / "
+        "NDArrayIter / gluon DataLoader here (see mxnet_tpu.image / mxnet_tpu.recordio)."
+    )

@@ -1,0 +1,447 @@
+"""Evaluation metrics — reference ``python/mxnet/metric.py`` (1,302 LoC;
+EvalMetric base :68, Accuracy :363, TopK, F1, MCC, Perplexity, MAE/MSE/RMSE,
+CrossEntropy, NLL, PearsonCorrelation, Loss, CompositeEvalMetric, custom np).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = [
+    "EvalMetric",
+    "CompositeEvalMetric",
+    "Accuracy",
+    "TopKAccuracy",
+    "F1",
+    "MCC",
+    "Perplexity",
+    "MAE",
+    "MSE",
+    "RMSE",
+    "CrossEntropy",
+    "NegativeLogLikelihood",
+    "PearsonCorrelation",
+    "Loss",
+    "CustomMetric",
+    "np",
+    "create",
+]
+
+_METRIC_REGISTRY = {}
+
+
+def register(klass, *names):
+    for n in names or (klass.__name__.lower(),):
+        _METRIC_REGISTRY[n] = klass
+    return klass
+
+
+def create(metric, *args, **kwargs):
+    """Create by name/callable/list (reference metric.py create)."""
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, (list, tuple)):
+        composite = CompositeEvalMetric()
+        for m in metric:
+            composite.add(create(m, *args, **kwargs))
+        return composite
+    if metric.lower() not in _METRIC_REGISTRY:
+        raise MXNetError("Metric %s not registered (have %s)" % (metric, sorted(_METRIC_REGISTRY)))
+    return _METRIC_REGISTRY[metric.lower()](*args, **kwargs)
+
+
+def _as_np(x):
+    return x.asnumpy() if isinstance(x, NDArray) else numpy.asarray(x)
+
+
+def check_label_shapes(labels, preds, shape=False):
+    if (hasattr(labels, "__len__") and hasattr(preds, "__len__")) and len(labels) != len(preds):
+        raise ValueError(
+            "Shape of labels %d does not match shape of predictions %d" % (len(labels), len(preds))
+        )
+
+
+class EvalMetric:
+    """Base metric (reference metric.py:68)."""
+
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = str(name)
+        self.output_names = output_names
+        self.label_names = label_names
+        self._kwargs = kwargs
+        self.reset()
+
+    def __str__(self):
+        return "EvalMetric: {}".format(dict(self.get_name_value()))
+
+    def get_config(self):
+        config = dict(self._kwargs)
+        config.update(
+            {"metric": self.__class__.__name__, "name": self.name, "output_names": self.output_names, "label_names": self.label_names}
+        )
+        return config
+
+    def update_dict(self, label, pred):
+        if self.output_names is not None:
+            pred = [pred[name] for name in self.output_names if name in pred]
+        else:
+            pred = list(pred.values())
+        if self.label_names is not None:
+            label = [label[name] for name in self.label_names if name in label]
+        else:
+            label = list(label.values())
+        self.update(label, pred)
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.sum_metric / self.num_inst)
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+
+class CompositeEvalMetric(EvalMetric):
+    """Manage multiple metrics (reference metric.py CompositeEvalMetric)."""
+
+    def __init__(self, metrics=None, name="composite", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+        self.metrics = [create(m) for m in (metrics or [])]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def get_metric(self, index):
+        return self.metrics[index]
+
+    def update_dict(self, labels, preds):
+        for metric in self.metrics:
+            metric.update_dict(labels, preds)
+
+    def update(self, labels, preds):
+        for metric in self.metrics:
+            metric.update(labels, preds)
+
+    def reset(self):
+        for metric in getattr(self, "metrics", []):
+            metric.reset()
+
+    def get(self):
+        names, values = [], []
+        for metric in self.metrics:
+            name, value = metric.get()
+            names.extend(name if isinstance(name, list) else [name])
+            values.extend(value if isinstance(value, list) else [value])
+        return (names, values)
+
+
+@register
+class Accuracy(EvalMetric):
+    """Classification accuracy (reference metric.py:363)."""
+
+    def __init__(self, axis=1, name="accuracy", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names, axis=axis)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _as_np(label)
+            pred = _as_np(pred)
+            if pred.ndim > label.ndim:
+                pred = numpy.argmax(pred, axis=self.axis)
+            pred = pred.astype(numpy.int32).flatten()
+            label = label.astype(numpy.int32).flatten()
+            check_label_shapes(label, pred)
+            self.sum_metric += (pred == label).sum()
+            self.num_inst += len(pred)
+
+
+@register
+class TopKAccuracy(EvalMetric):
+    """Top-k accuracy (reference metric.py TopKAccuracy)."""
+
+    def __init__(self, top_k=1, name="top_k_accuracy", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names, top_k=top_k)
+        self.top_k = top_k
+        assert top_k > 1, "Use Accuracy for top_k=1"
+        self.name += "_%d" % top_k
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for label, pred in zip(labels, preds):
+            label = _as_np(label).astype(numpy.int32)
+            pred = _as_np(pred)
+            assert pred.ndim == 2
+            topk_idx = numpy.argsort(pred, axis=1)[:, -self.top_k :]
+            self.sum_metric += (topk_idx == label.reshape(-1, 1)).any(axis=1).sum()
+            self.num_inst += label.shape[0]
+
+
+@register
+class F1(EvalMetric):
+    """Binary F1 (reference metric.py F1)."""
+
+    def __init__(self, name="f1", output_names=None, label_names=None, average="macro"):
+        super().__init__(name, output_names, label_names)
+        self.average = average
+
+    def reset(self):
+        super().reset()
+        self._tp = self._fp = self._fn = 0.0
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for label, pred in zip(labels, preds):
+            label = _as_np(label).flatten().astype(numpy.int32)
+            pred = _as_np(pred)
+            if pred.ndim > 1:
+                pred = numpy.argmax(pred, axis=1)
+            pred = pred.flatten().astype(numpy.int32)
+            self._tp += ((pred == 1) & (label == 1)).sum()
+            self._fp += ((pred == 1) & (label == 0)).sum()
+            self._fn += ((pred == 0) & (label == 1)).sum()
+            prec = self._tp / max(self._tp + self._fp, 1e-12)
+            rec = self._tp / max(self._tp + self._fn, 1e-12)
+            f1 = 2 * prec * rec / max(prec + rec, 1e-12)
+            self.sum_metric = f1
+            self.num_inst = 1
+
+
+@register
+class MCC(EvalMetric):
+    """Matthews correlation coefficient (reference metric.py MCC)."""
+
+    def __init__(self, name="mcc", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def reset(self):
+        super().reset()
+        self._tp = self._fp = self._fn = self._tn = 0.0
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for label, pred in zip(labels, preds):
+            label = _as_np(label).flatten().astype(numpy.int32)
+            pred = _as_np(pred)
+            if pred.ndim > 1:
+                pred = numpy.argmax(pred, axis=1)
+            pred = pred.flatten().astype(numpy.int32)
+            self._tp += ((pred == 1) & (label == 1)).sum()
+            self._fp += ((pred == 1) & (label == 0)).sum()
+            self._fn += ((pred == 0) & (label == 1)).sum()
+            self._tn += ((pred == 0) & (label == 0)).sum()
+            denom = math.sqrt(
+                (self._tp + self._fp) * (self._tp + self._fn) * (self._tn + self._fp) * (self._tn + self._fn)
+            )
+            mcc = ((self._tp * self._tn) - (self._fp * self._fn)) / max(denom, 1e-12)
+            self.sum_metric = mcc
+            self.num_inst = 1
+
+
+@register
+class Perplexity(EvalMetric):
+    """exp(mean NLL) (reference metric.py Perplexity)."""
+
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names, ignore_label=ignore_label, axis=axis)
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        loss = 0.0
+        num = 0
+        for label, pred in zip(labels, preds):
+            label = _as_np(label).astype(numpy.int32).flatten()
+            pred = _as_np(pred).reshape(-1, _as_np(pred).shape[-1])
+            probs = pred[numpy.arange(label.shape[0]), label]
+            if self.ignore_label is not None:
+                ignore = (label == self.ignore_label).astype(pred.dtype)
+                probs = probs * (1 - ignore) + ignore
+                num -= int(ignore.sum())
+            loss -= numpy.sum(numpy.log(numpy.maximum(1e-10, probs)))
+            num += label.shape[0]
+        self.sum_metric += loss
+        self.num_inst += num
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.exp(self.sum_metric / self.num_inst))
+
+
+class _RegressionMetric(EvalMetric):
+    def _err(self, label, pred):
+        raise NotImplementedError
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for label, pred in zip(labels, preds):
+            label = _as_np(label)
+            pred = _as_np(pred)
+            if label.ndim == 1:
+                label = label.reshape(label.shape[0], 1)
+            if pred.ndim == 1:
+                pred = pred.reshape(pred.shape[0], 1)
+            self.sum_metric += self._err(label, pred)
+            self.num_inst += 1
+
+
+@register
+class MAE(_RegressionMetric):
+    def __init__(self, name="mae", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def _err(self, label, pred):
+        return numpy.abs(label - pred).mean()
+
+
+@register
+class MSE(_RegressionMetric):
+    def __init__(self, name="mse", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def _err(self, label, pred):
+        return ((label - pred) ** 2).mean()
+
+
+@register
+class RMSE(_RegressionMetric):
+    def __init__(self, name="rmse", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def _err(self, label, pred):
+        return numpy.sqrt(((label - pred) ** 2).mean())
+
+
+@register
+class CrossEntropy(EvalMetric):
+    """CE of predicted prob at true class (reference metric.py CrossEntropy)."""
+
+    def __init__(self, eps=1e-12, name="cross-entropy", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names, eps=eps)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for label, pred in zip(labels, preds):
+            label = _as_np(label).ravel().astype(numpy.int32)
+            pred = _as_np(pred)
+            assert label.shape[0] == pred.shape[0]
+            prob = pred[numpy.arange(label.shape[0]), label]
+            self.sum_metric += (-numpy.log(prob + self.eps)).sum()
+            self.num_inst += label.shape[0]
+
+
+class NegativeLogLikelihood(CrossEntropy):
+    def __init__(self, eps=1e-12, name="nll-loss", output_names=None, label_names=None):
+        EvalMetric.__init__(self, name, output_names, label_names, eps=eps)
+        self.eps = eps
+
+
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name="pearsonr", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for label, pred in zip(labels, preds):
+            label = _as_np(label).ravel()
+            pred = _as_np(pred).ravel()
+            self.sum_metric += numpy.corrcoef(pred, label)[0, 1]
+            self.num_inst += 1
+
+
+@register
+class Loss(EvalMetric):
+    """Mean of a loss output (reference metric.py Loss)."""
+
+    def __init__(self, name="loss", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, _, preds):
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for pred in preds:
+            loss = _as_np(pred)
+            self.sum_metric += loss.sum()
+            self.num_inst += loss.size
+
+
+@register
+class CustomMetric(EvalMetric):
+    """Wrap a feval(label, pred) numpy function (reference metric.py CustomMetric)."""
+
+    def __init__(self, feval, name=None, allow_extra_outputs=False, output_names=None, label_names=None):
+        if name is None:
+            name = feval.__name__ if feval.__name__ != "<lambda>" else "custom"
+        super().__init__("custom(%s)" % name if "(" not in name else name, output_names, label_names)
+        self._feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        if not self._allow_extra_outputs:
+            check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            reval = self._feval(_as_np(label), _as_np(pred))
+            if isinstance(reval, tuple):
+                sum_metric, num_inst = reval
+                self.sum_metric += sum_metric
+                self.num_inst += num_inst
+            else:
+                self.sum_metric += reval
+                self.num_inst += 1
+
+
+def np(numpy_feval, name=None, allow_extra_outputs=False):
+    """Create a CustomMetric from a numpy function (reference metric.py np)."""
+
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+
+    feval.__name__ = numpy_feval.__name__
+    return CustomMetric(feval, name, allow_extra_outputs)
+
+
+register(NegativeLogLikelihood, "nll_loss")
+register(Accuracy, "acc", "accuracy")
+register(TopKAccuracy, "top_k_accuracy", "top_k_acc")
+register(MSE, "mse")
+register(RMSE, "rmse")
+register(MAE, "mae")
+register(CrossEntropy, "ce", "cross-entropy")
+register(F1, "f1")
+register(MCC, "mcc")
+register(Loss, "loss")
+register(Perplexity, "perplexity")
+register(PearsonCorrelation, "pearsonr")
+register(CompositeEvalMetric, "composite")

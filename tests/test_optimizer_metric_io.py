@@ -1,0 +1,185 @@
+"""Optimizer/metric/io/initializer tests — modeled on reference
+tests/python/unittest/{test_optimizer,test_metric,test_io,test_init}.py."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu import metric as metric_mod
+from mxnet_tpu.io import NDArrayIter, PrefetchingIter, ResizeIter
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _loss_and_grad(w):
+    # f(w) = 0.5*||w||^2 -> grad = w ; minimum at 0
+    return w
+
+
+def test_sgd_converges():
+    w = nd.array([10.0, -10.0])
+    sgd = opt.SGD(learning_rate=0.5, momentum=0.0)
+    state = sgd.create_state(0, w)
+    for _ in range(30):
+        sgd.update(0, w, _loss_and_grad(w), state)
+    assert float(nd.norm(w).asscalar()) < 1e-3
+
+
+def test_sgd_momentum_matches_formula():
+    w = nd.array([1.0])
+    g = nd.array([1.0])
+    sgd = opt.SGD(learning_rate=0.1, momentum=0.9)
+    state = sgd.create_state(0, w)
+    sgd.update(0, w, g, state)  # mom = -0.1; w = 0.9
+    assert_almost_equal(w, np.array([0.9], dtype=np.float32))
+    sgd.update(0, w, g, state)  # mom = 0.9*-0.1 - 0.1 = -0.19; w = 0.71
+    assert_almost_equal(w, np.array([0.71], dtype=np.float32), rtol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "name,kwargs",
+    [
+        ("sgd", {"learning_rate": 0.3, "momentum": 0.9}),
+        ("nag", {"learning_rate": 0.2, "momentum": 0.9}),
+        ("adam", {"learning_rate": 0.3}),
+        ("adagrad", {"learning_rate": 0.9}),
+        ("rmsprop", {"learning_rate": 0.3}),
+        ("adadelta", {"learning_rate": 1.0, "rho": 0.9, "epsilon": 1e-2}),
+        ("adamax", {"learning_rate": 0.4}),
+        ("nadam", {"learning_rate": 0.3}),
+        ("ftrl", {"learning_rate": 2.0}),
+        ("signum", {"learning_rate": 0.02}),
+        ("ftml", {"learning_rate": 0.3}),
+        ("test", {"learning_rate": 0.3}),
+    ],
+)
+def test_optimizers_reduce_quadratic(name, kwargs):
+    np.random.seed(0)
+    w = nd.array(np.random.rand(5).astype(np.float32) * 4 + 1)
+    o = opt.create(name, **kwargs)
+    state = o.create_state(0, w)
+    start = float(nd.norm(w).asscalar())
+    for _ in range(100):
+        o.update(0, w, w.copy(), state)
+    end = float(nd.norm(w).asscalar())
+    assert end < start * 0.5, "%s did not reduce ||w||: %f -> %f" % (name, start, end)
+
+
+def test_lr_scheduler():
+    from mxnet_tpu.lr_scheduler import FactorScheduler, MultiFactorScheduler, PolyScheduler
+
+    s = FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(0) == 1.0 and s(10) == 0.5 and s(20) == 0.25
+    m = MultiFactorScheduler(step=[5, 15], factor=0.1, base_lr=1.0)
+    assert m(0) == 1.0 and abs(m(6) - 0.1) < 1e-9 and abs(m(16) - 0.01) < 1e-9
+    p = PolyScheduler(max_update=100, base_lr=1.0, pwr=1)
+    assert p(0) == 1.0 and abs(p(50) - 0.5) < 1e-6 and p(100) == 0.0
+
+
+def test_updater_and_serialization():
+    w = nd.array([4.0])
+    g = nd.array([1.0])
+    o = opt.SGD(learning_rate=0.1, momentum=0.9)
+    upd = opt.get_updater(o)
+    upd(0, g, w)
+    st = upd.get_states()
+    upd2 = opt.get_updater(opt.SGD(learning_rate=0.1, momentum=0.9))
+    upd2.set_states(st)
+    upd(0, g, w)
+    assert 0 in upd2.states
+
+
+def test_accuracy_metric():
+    m = metric_mod.create("acc")
+    pred = nd.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = nd.array([1, 0, 0])
+    m.update([label], [pred])
+    assert abs(m.get()[1] - 2.0 / 3) < 1e-6
+    m.reset()
+    assert np.isnan(m.get()[1])
+
+
+def test_topk_f1_mse():
+    m = metric_mod.create("top_k_accuracy", top_k=2)
+    pred = nd.array([[0.1, 0.5, 0.4], [0.7, 0.2, 0.1]])
+    label = nd.array([0, 1])  # row0: top2={1,2} miss; row1: top2={0,1} hit
+    m.update([label], [pred])
+    assert abs(m.get()[1] - 0.5) < 1e-6
+    mse = metric_mod.create("mse")
+    mse.update([nd.array([1.0, 2.0])], [nd.array([1.5, 2.5])])
+    assert abs(mse.get()[1] - 0.25) < 1e-6
+
+
+def test_composite_metric():
+    m = metric_mod.create(["acc", "mse"])
+    assert isinstance(m, metric_mod.CompositeEvalMetric)
+
+
+def test_custom_metric():
+    m = metric_mod.np(lambda label, pred: float(np.abs(label - pred).mean()))
+    m.update([nd.array([1.0])], [nd.array([2.0])])
+    assert abs(m.get()[1] - 1.0) < 1e-6
+
+
+def test_ndarray_iter():
+    data = np.arange(40).reshape(10, 4).astype(np.float32)
+    label = np.arange(10).astype(np.float32)
+    it = NDArrayIter(data, label, batch_size=3, shuffle=False, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4  # ceil(10/3)
+    assert batches[0].data[0].shape == (3, 4)
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 4
+    # discard mode
+    it2 = NDArrayIter(data, label, batch_size=3, last_batch_handle="discard")
+    assert len(list(it2)) == 3
+    # dict data
+    it3 = NDArrayIter({"a": data}, {"lab": label}, batch_size=5)
+    assert it3.provide_data[0].name == "a"
+    assert it3.provide_data[0].shape == (5, 4)
+
+
+def test_resize_and_prefetch_iter():
+    data = np.random.rand(8, 2).astype(np.float32)
+    base = NDArrayIter(data, batch_size=2)
+    r = ResizeIter(NDArrayIter(data, batch_size=2), size=2)
+    assert len(list(r)) == 2
+    p = PrefetchingIter(NDArrayIter(data, batch_size=2))
+    batches = list(p)
+    assert len(batches) == 4
+    p.reset()
+    assert len(list(p)) == 4
+
+
+def test_initializers():
+    from mxnet_tpu import initializer as init
+
+    w = nd.zeros((4, 4))
+    init.Xavier()("fc_weight", w)
+    assert float(nd.norm(w).asscalar()) > 0
+    b = nd.ones((4,))
+    init.Xavier()("fc_bias", b)
+    assert float(nd.norm(b).asscalar()) == 0  # bias -> zero
+    g = nd.zeros((4,))
+    init.Uniform()("bn_gamma", g)
+    assert (g.asnumpy() == 1).all()  # gamma -> one
+    c = nd.zeros((2, 2))
+    init.Constant(3.0)("custom_weight", c)
+    assert (c.asnumpy() == 3).all()
+    o = nd.zeros((4, 8))
+    init.Orthogonal()("q_weight", o)
+    q = o.asnumpy()
+    assert_almost_equal(q @ q.T, (1.414**2) * np.eye(4), rtol=1e-3, atol=1e-4)
+
+
+def test_mixed_initializer():
+    from mxnet_tpu import initializer as init
+
+    w1 = nd.zeros((2, 2))
+    w2 = nd.zeros((2, 2))
+    mixed = init.Mixed([".*special.*", ".*"], [init.Constant(7.0), init.Zero()])
+    mixed("special_weight", w1)
+    mixed("plain_weight", w2)
+    assert (w1.asnumpy() == 7).all()
+    assert (w2.asnumpy() == 0).all()
