@@ -7,10 +7,20 @@ play the role of the N processes.  Real-TPU runs are the driver's job.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU (overriding any ambient JAX_PLATFORMS, e.g. a tunnelled TPU) unless
+# the user explicitly opts into device testing with MXNET_TEST_DEVICE=tpu.
+if not os.environ.get("MXNET_TEST_DEVICE", "").startswith(("tpu", "gpu")):
+    os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # The env var alone is not honored under tunnelled-TPU plugins (axon);
+    # the config knob is, as long as it's set before backend init.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest
