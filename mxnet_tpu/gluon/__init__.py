@@ -1,0 +1,20 @@
+"""mx.gluon — imperative neural-network API.
+
+TPU-native re-design of reference ``python/mxnet/gluon/``: Blocks run eagerly
+on JAX arrays; ``hybridize()`` captures the block body as ONE pure jitted
+function (the CachedOp analog, reference src/imperative/cached_op.cc) so the
+whole network compiles to a single XLA computation per shape signature.
+"""
+from . import parameter
+from . import block
+from . import nn
+from . import loss
+from . import trainer
+from . import utils
+from . import data
+from . import rnn
+from . import model_zoo
+
+from .parameter import Parameter, ParameterDict, Constant, DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer

@@ -1,0 +1,93 @@
+"""DataLoader — reference ``python/mxnet/gluon/data/dataloader.py:239``.
+
+The reference forks worker processes and rebuilds NDArrays over POSIX shared
+memory (dataloader.py:26-97).  On TPU the input pipeline is host-side numpy
+until the final device put, so workers here are *threads*: decode/augment in
+PIL/numpy release the GIL, there is no CUDA context to protect, and skipping
+process forking avoids the fork-vs-XLA-client hazard entirely (the reference
+itself has engine fork handlers for this, src/initialize.cc:31-64).
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ...ndarray.ndarray import NDArray
+from ...ndarray import array as nd_array
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference dataloader.py:126)."""
+    if isinstance(data[0], NDArray):
+        return nd_array(np.stack([d.asnumpy() for d in data]))
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(list(i)) for i in data]
+    data = np.asarray(data)
+    return nd_array(data)
+
+
+class DataLoader:
+    """Iterate a Dataset in mini-batches (reference dataloader.py:239)."""
+
+    def __init__(
+        self,
+        dataset,
+        batch_size=None,
+        shuffle=False,
+        sampler=None,
+        last_batch=None,
+        batch_sampler=None,
+        batchify_fn=None,
+        num_workers=0,
+        pin_memory=False,
+        prefetch=None,
+    ):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless batch_sampler is specified")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must not be specified if sampler is specified")
+            batch_sampler = BatchSampler(sampler, batch_size, last_batch or "keep")
+        elif batch_size is not None or shuffle or sampler is not None or last_batch is not None:
+            raise ValueError(
+                "batch_size, shuffle, sampler and last_batch must not be specified if batch_sampler is specified."
+            )
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None else 2 * self._num_workers)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def _load_batch(self, indices):
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._load_batch(indices)
+            return
+        with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
+            futures = []
+            it = iter(self._batch_sampler)
+            try:
+                for _ in range(self._prefetch or self._num_workers):
+                    futures.append(pool.submit(self._load_batch, next(it)))
+            except StopIteration:
+                pass
+            while futures:
+                f = futures.pop(0)
+                try:
+                    futures.append(pool.submit(self._load_batch, next(it)))
+                except StopIteration:
+                    pass
+                yield f.result()

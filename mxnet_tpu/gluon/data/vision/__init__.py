@@ -1,0 +1,3 @@
+"""Vision datasets + transforms (reference ``python/mxnet/gluon/data/vision/``)."""
+from .datasets import MNIST, FashionMNIST, CIFAR10, CIFAR100, ImageRecordDataset, ImageFolderDataset
+from . import transforms

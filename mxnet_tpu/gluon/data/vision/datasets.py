@@ -1,0 +1,205 @@
+"""Vision datasets — reference ``python/mxnet/gluon/data/vision/datasets.py``.
+
+Datasets read from local files (this image has no network egress); formats
+match the reference loaders (MNIST idx, CIFAR binary, RecordIO packs).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ....ndarray import array as nd_array
+from ..dataset import Dataset, RecordFileDataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100", "ImageRecordDataset", "ImageFolderDataset"]
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, transform):
+        self._transform = transform
+        self._data = None
+        self._label = None
+        self._root = os.path.expanduser(root)
+        if not os.path.isdir(self._root):
+            os.makedirs(self._root, exist_ok=True)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(nd_array(self._data[idx]), self._label[idx])
+        return nd_array(self._data[idx]), self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST from idx-format files (reference datasets.py:45; loader format
+    matches reference src/io/iter_mnist.cc:80)."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "mnist"), train=True, transform=None):
+        self._train = train
+        self._train_data = ("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz")
+        self._test_data = ("t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz")
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        data_file, label_file = self._train_data if self._train else self._test_data
+        data_path = os.path.join(self._root, data_file)
+        label_path = os.path.join(self._root, label_file)
+
+        def _open(p):
+            return gzip.open(p, "rb") if p.endswith(".gz") else open(p, "rb")
+
+        with _open(label_path) as fin:
+            struct.unpack(">II", fin.read(8))
+            label = np.frombuffer(fin.read(), dtype=np.uint8).astype(np.int32)
+        with _open(data_path) as fin:
+            _, num, rows, cols = struct.unpack(">IIII", fin.read(16))
+            data = np.frombuffer(fin.read(), dtype=np.uint8)
+            data = data.reshape(num, rows, cols, 1)
+        self._data = data
+        self._label = label
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "fashion-mnist"), train=True, transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR-10 from the python pickle batches or binary format (reference
+    datasets.py:120)."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar10"), train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _read_pickle(self, files):
+        data, label = [], []
+        for fname in files:
+            with open(fname, "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            data.append(d[b"data"].reshape(-1, 3, 32, 32))
+            label.append(np.asarray(d[b"labels" if b"labels" in d else b"fine_labels"]))
+        data = np.concatenate(data).transpose(0, 2, 3, 1)  # NHWC uint8
+        return data, np.concatenate(label).astype(np.int32)
+
+    def _read_binary(self, files, rec_len=3073):
+        data, label = [], []
+        for fname in files:
+            raw = np.fromfile(fname, dtype=np.uint8).reshape(-1, rec_len)
+            label.append(raw[:, 0].astype(np.int32))
+            data.append(raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+        return np.concatenate(data), np.concatenate(label)
+
+    def _get_data(self):
+        py_dir = os.path.join(self._root, "cifar-10-batches-py")
+        bin_dir = os.path.join(self._root, "cifar-10-batches-bin")
+        for tarname in ("cifar-10-python.tar.gz", "cifar-10-binary.tar.gz"):
+            t = os.path.join(self._root, tarname)
+            if os.path.exists(t) and not (os.path.isdir(py_dir) or os.path.isdir(bin_dir)):
+                with tarfile.open(t) as tf:
+                    tf.extractall(self._root)
+        if os.path.isdir(py_dir):
+            if self._train:
+                files = [os.path.join(py_dir, "data_batch_%d" % i) for i in range(1, 6)]
+            else:
+                files = [os.path.join(py_dir, "test_batch")]
+            self._data, self._label = self._read_pickle(files)
+        elif os.path.isdir(bin_dir):
+            if self._train:
+                files = [os.path.join(bin_dir, "data_batch_%d.bin" % i) for i in range(1, 6)]
+            else:
+                files = [os.path.join(bin_dir, "test_batch.bin")]
+            self._data, self._label = self._read_binary(files)
+        else:
+            raise IOError(
+                "CIFAR-10 data not found under %s; place cifar-10-python.tar.gz or the "
+                "extracted batches there (no network egress in this environment)." % self._root
+            )
+
+
+class CIFAR100(CIFAR10):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar100"), fine_label=True, train=True, transform=None):
+        self._fine = fine_label
+        super().__init__(root, train, transform)
+
+    def _get_data(self):
+        py_dir = os.path.join(self._root, "cifar-100-python")
+        t = os.path.join(self._root, "cifar-100-python.tar.gz")
+        if os.path.exists(t) and not os.path.isdir(py_dir):
+            with tarfile.open(t) as tf:
+                tf.extractall(self._root)
+        if not os.path.isdir(py_dir):
+            raise IOError("CIFAR-100 data not found under %s" % self._root)
+        fname = os.path.join(py_dir, "train" if self._train else "test")
+        with open(fname, "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        self._data = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        key = b"fine_labels" if self._fine else b"coarse_labels"
+        self._label = np.asarray(d[key]).astype(np.int32)
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """Images stored in a RecordIO pack (reference datasets.py:177)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from ....recordio import unpack_img
+
+        record = super().__getitem__(idx)
+        header, img = unpack_img(record, iscolor=self._flag)
+        if self._transform is not None:
+            return self._transform(nd_array(img), header.label)
+        return nd_array(img), header.label
+
+
+class ImageFolderDataset(Dataset):
+    """folder/label/xxx.jpg layout (reference datasets.py:208)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                ext = os.path.splitext(filename)[1]
+                if ext.lower() not in self._exts:
+                    continue
+                self.items.append((os.path.join(path, filename), label))
+
+    def __getitem__(self, idx):
+        from ....image import imread
+
+        img = imread(self.items[idx][0], flag=self._flag)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

@@ -1,0 +1,260 @@
+"""Vision transforms — reference ``python/mxnet/gluon/data/vision/transforms.py``.
+
+Transforms run host-side on numpy/NDArray samples before device put — the
+TPU input pipeline wants full batches staged on host, then one transfer.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ....ndarray.ndarray import NDArray
+from ....ndarray import array as nd_array
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential
+
+__all__ = [
+    "Compose",
+    "Cast",
+    "ToTensor",
+    "Normalize",
+    "RandomResizedCrop",
+    "CenterCrop",
+    "Resize",
+    "RandomFlipLeftRight",
+    "RandomFlipTopBottom",
+    "RandomBrightness",
+    "RandomContrast",
+    "RandomSaturation",
+    "RandomHue",
+    "RandomColorJitter",
+    "RandomLighting",
+]
+
+
+class Compose(Sequential):
+    """Sequentially compose transforms (reference transforms.py:33)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] → CHW float32 [0,1] (reference transforms.py:89)."""
+
+    def hybrid_forward(self, F, x):
+        if isinstance(x, NDArray):
+            arr = x.asnumpy()
+        else:
+            arr = np.asarray(x)
+        arr = arr.astype(np.float32) / 255.0
+        if arr.ndim == 3:
+            arr = arr.transpose(2, 0, 1)
+        elif arr.ndim == 4:
+            arr = arr.transpose(0, 3, 1, 2)
+        return nd_array(arr)
+
+
+class Normalize(HybridBlock):
+    """(x - mean) / std per channel, CHW input (reference transforms.py:133)."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = np.asarray(mean, dtype=np.float32).reshape(-1, 1, 1)
+        self._std = np.asarray(std, dtype=np.float32).reshape(-1, 1, 1)
+
+    def hybrid_forward(self, F, x):
+        arr = x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+        return nd_array((arr - self._mean) / self._std)
+
+
+def _to_np_hwc(x):
+    return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+
+
+def _resize_np(arr, size, interp=1):
+    """Bilinear resize on host via PIL (the reference uses OpenCV)."""
+    from PIL import Image
+
+    w, h = (size, size) if isinstance(size, int) else size
+    if arr.dtype != np.uint8:
+        img = Image.fromarray(arr.astype(np.uint8))
+    else:
+        img = Image.fromarray(arr)
+    resample = Image.BILINEAR if interp == 1 else Image.NEAREST
+    return np.asarray(img.resize((w, h), resample))
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        arr = _to_np_hwc(x)
+        if self._keep and isinstance(self._size, int):
+            h, w = arr.shape[:2]
+            scale = self._size / min(h, w)
+            size = (int(round(w * scale)), int(round(h * scale)))
+        else:
+            size = self._size
+        return nd_array(_resize_np(arr, size, self._interpolation))
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        arr = _to_np_hwc(x)
+        h, w = arr.shape[:2]
+        cw, ch = self._size
+        if h < ch or w < cw:
+            arr = _resize_np(arr, (max(w, cw), max(h, ch)), self._interpolation)
+            h, w = arr.shape[:2]
+        y0 = (h - ch) // 2
+        x0 = (w - cw) // 2
+        return nd_array(arr[y0 : y0 + ch, x0 : x0 + cw])
+
+
+class RandomResizedCrop(Block):
+    """Random area+aspect crop then resize (reference transforms.py:219)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3), interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._scale = scale
+        self._ratio = ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        arr = _to_np_hwc(x)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = np.random.uniform(*self._scale) * area
+            aspect = np.random.uniform(*self._ratio)
+            cw = int(round(np.sqrt(target_area * aspect)))
+            ch = int(round(np.sqrt(target_area / aspect)))
+            if np.random.random() < 0.5:
+                cw, ch = ch, cw
+            if cw <= w and ch <= h:
+                x0 = np.random.randint(0, w - cw + 1)
+                y0 = np.random.randint(0, h - ch + 1)
+                crop = arr[y0 : y0 + ch, x0 : x0 + cw]
+                return nd_array(_resize_np(crop, self._size, self._interpolation))
+        # fallback: center crop
+        return CenterCrop(self._size, self._interpolation).forward(nd_array(arr))
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        arr = _to_np_hwc(x)
+        if np.random.random() < 0.5:
+            arr = arr[:, ::-1]
+        return nd_array(np.ascontiguousarray(arr))
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        arr = _to_np_hwc(x)
+        if np.random.random() < 0.5:
+            arr = arr[::-1]
+        return nd_array(np.ascontiguousarray(arr))
+
+
+class _RandomJitter(Block):
+    def __init__(self, value):
+        super().__init__()
+        self._value = value
+
+    def _alpha(self):
+        return 1.0 + np.random.uniform(-self._value, self._value)
+
+
+class RandomBrightness(_RandomJitter):
+    def forward(self, x):
+        arr = _to_np_hwc(x).astype(np.float32)
+        return nd_array(np.clip(arr * self._alpha(), 0, 255))
+
+
+class RandomContrast(_RandomJitter):
+    def forward(self, x):
+        arr = _to_np_hwc(x).astype(np.float32)
+        gray = arr.mean()
+        return nd_array(np.clip(gray + self._alpha() * (arr - gray), 0, 255))
+
+
+class RandomSaturation(_RandomJitter):
+    def forward(self, x):
+        arr = _to_np_hwc(x).astype(np.float32)
+        gray = arr.mean(axis=-1, keepdims=True)
+        return nd_array(np.clip(gray + self._alpha() * (arr - gray), 0, 255))
+
+
+class RandomHue(_RandomJitter):
+    def forward(self, x):
+        arr = _to_np_hwc(x).astype(np.float32)
+        alpha = np.random.uniform(-self._value, self._value)
+        u, w_ = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0], [0.0, u, -w_], [0.0, w_, u]], dtype=np.float32)
+        t_yiq = np.array(
+            [[0.299, 0.587, 0.114], [0.596, -0.274, -0.321], [0.211, -0.523, 0.311]], dtype=np.float32
+        )
+        t_rgb = np.linalg.inv(t_yiq)
+        m = t_rgb @ bt @ t_yiq
+        return nd_array(np.clip(arr @ m.T, 0, 255))
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
+
+    def forward(self, x):
+        order = np.random.permutation(len(self._ts))
+        for i in order:
+            x = self._ts[i](x)
+        return x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA noise (reference transforms.py:357)."""
+
+    _eigval = np.array([55.46, 4.794, 1.148], dtype=np.float32)
+    _eigvec = np.array(
+        [[-0.5675, 0.7192, 0.4009], [-0.5808, -0.0045, -0.8140], [-0.5836, -0.6948, 0.4203]],
+        dtype=np.float32,
+    )
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        arr = _to_np_hwc(x).astype(np.float32)
+        alpha = np.random.normal(0, self._alpha, size=(3,)).astype(np.float32)
+        rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        return nd_array(np.clip(arr + rgb, 0, 255))

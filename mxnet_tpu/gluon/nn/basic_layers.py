@@ -1,0 +1,432 @@
+"""Basic layers — reference ``python/mxnet/gluon/nn/basic_layers.py``."""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import autograd
+from ..block import Block, HybridBlock
+from ..parameter import Parameter
+
+__all__ = [
+    "Sequential",
+    "HybridSequential",
+    "Dense",
+    "Dropout",
+    "BatchNorm",
+    "InstanceNorm",
+    "LayerNorm",
+    "Embedding",
+    "Flatten",
+    "Lambda",
+    "HybridLambda",
+]
+
+
+class Sequential(Block):
+    """Stack of Blocks run sequentially (reference basic_layers.py:29)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def forward(self, x, *args):
+        for block in self._children.values():
+            x = block(x, *args)
+            args = ()
+            if isinstance(x, (tuple, list)):
+                args = tuple(x[1:])
+                x = x[0]
+        if args:
+            return (x,) + args
+        return x
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())[key]
+        if isinstance(layers, list):
+            net = type(self)(prefix=self._prefix)
+            with net.name_scope():
+                net.add(*layers)
+            return net
+        return layers
+
+    def __len__(self):
+        return len(self._children)
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+    def hybridize(self, active=True, **kwargs):
+        if self._children and all(isinstance(c, HybridBlock) for c in self._children.values()):
+            import warnings
+
+            warnings.warn(
+                "All children of this Sequential layer '%s' are HybridBlocks. Consider "
+                "using HybridSequential for the best performance." % self.prefix,
+                stacklevel=2,
+            )
+        super().hybridize(active, **kwargs)
+
+
+class HybridSequential(HybridBlock):
+    """Stack of HybridBlocks, hybridizable as one CachedOp (reference :80)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def hybrid_forward(self, F, x, *args):
+        for block in self._children.values():
+            x = block(x, *args)
+            args = ()
+            if isinstance(x, (tuple, list)):
+                args = tuple(x[1:])
+                x = x[0]
+        if args:
+            return (x,) + args
+        return x
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())[key]
+        if isinstance(layers, list):
+            net = type(self)(prefix=self._prefix)
+            with net.name_scope():
+                net.add(*layers)
+            return net
+        return layers
+
+    def __len__(self):
+        return len(self._children)
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class Dense(HybridBlock):
+    """Fully-connected layer (reference basic_layers.py:123).
+
+    ``y = act(x W^T + b)`` — one MXU matmul; keep batch large and let XLA
+    fuse the bias+activation epilogue.
+    """
+
+    def __init__(
+        self,
+        units,
+        activation=None,
+        use_bias=True,
+        flatten=True,
+        dtype="float32",
+        weight_initializer=None,
+        bias_initializer="zeros",
+        in_units=0,
+        prefix=None,
+        params=None,
+    ):
+        super().__init__(prefix=prefix, params=params)
+        self._units = units
+        self._flatten = flatten
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight",
+                shape=(units, in_units),
+                dtype=dtype,
+                init=weight_initializer,
+                allow_deferred_init=True,
+            )
+            if use_bias:
+                self.bias = self.params.get(
+                    "bias", shape=(units,), dtype=dtype, init=bias_initializer, allow_deferred_init=True
+                )
+            else:
+                self.bias = None
+            if activation is not None:
+                self.act = Activation(activation, prefix=activation + "_")
+            else:
+                self.act = None
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        out = F.FullyConnected(
+            x, weight, bias, no_bias=bias is None, num_hidden=self._units, flatten=self._flatten
+        )
+        if self.act is not None:
+            out = self.act(out)
+        return out
+
+    def __repr__(self):
+        shape = self.weight.shape
+        return "Dense(%s -> %s, %s)" % (
+            shape[1] if shape[1] else None,
+            shape[0],
+            "linear" if self.act is None else self.act,
+        )
+
+
+class Dropout(HybridBlock):
+    """Dropout (reference basic_layers.py:196)."""
+
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._rate = rate
+        self._axes = axes
+
+    def hybrid_forward(self, F, x):
+        return F.Dropout(x, p=self._rate, axes=self._axes)
+
+    def __repr__(self):
+        return "Dropout(p = %s, axes=%s)" % (self._rate, self._axes)
+
+
+class BatchNorm(HybridBlock):
+    """Batch normalization (reference basic_layers.py:232).
+
+    Running stats are auxiliary Parameters (grad_req='null'); their update is
+    functional — inside a CachedOp trace the new values come back as extra
+    outputs and are folded into the buffers by the cached-op wrapper
+    (replacing the reference's in-place aux mutation in the kernel).
+    """
+
+    def __init__(
+        self,
+        axis=1,
+        momentum=0.9,
+        epsilon=1e-5,
+        center=True,
+        scale=True,
+        use_global_stats=False,
+        beta_initializer="zeros",
+        gamma_initializer="ones",
+        running_mean_initializer="zeros",
+        running_variance_initializer="ones",
+        in_channels=0,
+        prefix=None,
+        params=None,
+    ):
+        super().__init__(prefix=prefix, params=params)
+        self._kwargs = {
+            "axis": axis,
+            "eps": epsilon,
+            "momentum": momentum,
+            "fix_gamma": not scale,
+            "use_global_stats": use_global_stats,
+        }
+        self._axis = axis
+        self._momentum = momentum
+        self._use_global_stats = use_global_stats
+        with self.name_scope():
+            self.gamma = self.params.get(
+                "gamma",
+                grad_req="write" if scale else "null",
+                shape=(in_channels,),
+                init=gamma_initializer,
+                allow_deferred_init=True,
+                differentiable=scale,
+            )
+            self.beta = self.params.get(
+                "beta",
+                grad_req="write" if center else "null",
+                shape=(in_channels,),
+                init=beta_initializer,
+                allow_deferred_init=True,
+                differentiable=center,
+            )
+            self.running_mean = self.params.get(
+                "running_mean",
+                grad_req="null",
+                shape=(in_channels,),
+                init=running_mean_initializer,
+                allow_deferred_init=True,
+                differentiable=False,
+            )
+            self.running_var = self.params.get(
+                "running_var",
+                grad_req="null",
+                shape=(in_channels,),
+                init=running_variance_initializer,
+                allow_deferred_init=True,
+                differentiable=False,
+            )
+
+    def cast(self, dtype):
+        if np.dtype(dtype).name == "float16":
+            dtype = "float32"  # stats kept in f32, like the reference cuDNN path
+        super().cast(dtype)
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        from ...symbol.symbol import Symbol
+
+        if isinstance(x, Symbol):
+            return F.BatchNorm(x, gamma, beta, running_mean, running_var, name="fwd", **self._kwargs)
+        out, mean, var = F.BatchNorm(
+            x, gamma, beta, running_mean, running_var, output_mean_var=True, **self._kwargs
+        )
+        if autograd.is_training() and not self._use_global_stats:
+            with autograd.pause():
+                m = self._momentum
+                self.running_mean.data()._rebind(
+                    (m * running_mean + (1 - m) * mean.astype(running_mean.dtype))._data
+                )
+                self.running_var.data()._rebind(
+                    (m * running_var + (1 - m) * var.astype(running_var.dtype))._data
+                )
+        return out
+
+    def __repr__(self):
+        return "BatchNorm(axis=%s, in_channels=%s)" % (self._axis, self.gamma.shape[0])
+
+
+class InstanceNorm(HybridBlock):
+    """Instance normalization (reference basic_layers.py:315)."""
+
+    def __init__(
+        self,
+        axis=1,
+        epsilon=1e-5,
+        center=True,
+        scale=False,
+        beta_initializer="zeros",
+        gamma_initializer="ones",
+        in_channels=0,
+        prefix=None,
+        params=None,
+    ):
+        super().__init__(prefix=prefix, params=params)
+        self._epsilon = epsilon
+        self._axis = axis
+        with self.name_scope():
+            self.gamma = self.params.get(
+                "gamma",
+                grad_req="write" if scale else "null",
+                shape=(in_channels,),
+                init=gamma_initializer,
+                allow_deferred_init=True,
+            )
+            self.beta = self.params.get(
+                "beta",
+                grad_req="write" if center else "null",
+                shape=(in_channels,),
+                init=beta_initializer,
+                allow_deferred_init=True,
+            )
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        if self._axis == 1:
+            return F.InstanceNorm(x, gamma, beta, eps=self._epsilon)
+        x = x.swapaxes(1, self._axis)
+        return F.InstanceNorm(x, gamma, beta, eps=self._epsilon).swapaxes(1, self._axis)
+
+
+class LayerNorm(HybridBlock):
+    """Layer normalization (reference basic_layers.py:397)."""
+
+    def __init__(
+        self,
+        axis=-1,
+        epsilon=1e-5,
+        center=True,
+        scale=True,
+        beta_initializer="zeros",
+        gamma_initializer="ones",
+        in_channels=0,
+        prefix=None,
+        params=None,
+    ):
+        super().__init__(prefix=prefix, params=params)
+        self._axis = axis
+        self._epsilon = epsilon
+        with self.name_scope():
+            self.gamma = self.params.get(
+                "gamma",
+                grad_req="write" if scale else "null",
+                shape=(in_channels,),
+                init=gamma_initializer,
+                allow_deferred_init=True,
+            )
+            self.beta = self.params.get(
+                "beta",
+                grad_req="write" if center else "null",
+                shape=(in_channels,),
+                init=beta_initializer,
+                allow_deferred_init=True,
+            )
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.LayerNorm(x, gamma, beta, axis=self._axis, eps=self._epsilon)
+
+
+class Embedding(HybridBlock):
+    """Index → dense vector lookup (reference basic_layers.py:460).
+
+    A gather from the embedding matrix; XLA lowers it to a dynamic-gather
+    that stays on-device.
+    """
+
+    def __init__(
+        self,
+        input_dim,
+        output_dim,
+        dtype="float32",
+        weight_initializer=None,
+        sparse_grad=False,
+        prefix=None,
+        params=None,
+    ):
+        super().__init__(prefix=prefix, params=params)
+        self._kwargs = {"input_dim": input_dim, "output_dim": output_dim, "dtype": dtype}
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight",
+                shape=(input_dim, output_dim),
+                init=weight_initializer,
+                dtype=dtype,
+                allow_deferred_init=True,
+            )
+
+    def hybrid_forward(self, F, x, weight):
+        return F.Embedding(x, weight, **self._kwargs)
+
+
+class Flatten(HybridBlock):
+    """Flatten to (batch, -1) (reference basic_layers.py:520)."""
+
+    def hybrid_forward(self, F, x):
+        return F.Flatten(x)
+
+    def __repr__(self):
+        return "Flatten"
+
+
+class Lambda(Block):
+    """Wrap an arbitrary nd function as a Block (reference basic_layers.py:539)."""
+
+    def __init__(self, function, prefix=None):
+        super().__init__(prefix=prefix)
+        if isinstance(function, str):
+            from ... import ndarray as nd_mod
+
+            function = getattr(nd_mod, function)
+        self._func = function
+
+    def forward(self, *args):
+        return self._func(*args)
+
+
+class HybridLambda(HybridBlock):
+    """Wrap an arbitrary F-generic function (reference basic_layers.py:576)."""
+
+    def __init__(self, function, prefix=None):
+        super().__init__(prefix=prefix)
+        self._func_name = function if isinstance(function, str) else function.__name__
+        self._func = function
+
+    def hybrid_forward(self, F, x, *args):
+        if isinstance(self._func, str):
+            return getattr(F, self._func)(x, *args)
+        return self._func(F, x, *args)
+
+
+from .activations import Activation  # noqa: E402  (cycle: Dense uses Activation)

@@ -1,0 +1,351 @@
+"""Parameter / ParameterDict — reference ``python/mxnet/gluon/parameter.py:43,630``.
+
+A Parameter owns one NDArray (JAX arrays live wherever XLA puts them; the
+reference's per-context replica lists collapse to sharding annotations on the
+single array).  Deferred initialization (shape unknown until first forward,
+reference parameter.py:39) is kept: ``shape`` entries of 0 are inferred at
+first use.
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from .. import initializer as init_mod
+from ..base import MXNetError, dtype_np
+from ..context import cpu, current_context
+from ..ndarray import array as nd_array, zeros as nd_zeros
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["Parameter", "ParameterDict", "Constant", "DeferredInitializationError", "tensor_types"]
+
+tensor_types = (NDArray, np.ndarray)
+
+
+class DeferredInitializationError(MXNetError):
+    """Parameter accessed before its shape is known (reference parameter.py:35)."""
+
+
+class Parameter:
+    """A trainable (or auxiliary) tensor with initializer, grad_req, and
+    lr/wd multipliers (reference gluon/parameter.py:43)."""
+
+    def __init__(
+        self,
+        name,
+        grad_req="write",
+        shape=None,
+        dtype=np.float32,
+        lr_mult=1.0,
+        wd_mult=1.0,
+        init=None,
+        allow_deferred_init=False,
+        differentiable=True,
+        stype="default",
+        grad_stype="default",
+    ):
+        self.name = name
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self._grad_req = grad_req if differentiable else "null"
+        self.allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        self._data = None
+        self._deferred_init = None  # (init, ctx, default_init)
+        self._trainer = None
+
+    def __repr__(self):
+        return "Parameter %s (shape=%s, dtype=%s)" % (self.name, self.shape, self.dtype)
+
+    # -- grad_req -----------------------------------------------------------
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        assert req in ("write", "add", "null")
+        self._grad_req = req
+        if self._data is not None:
+            if req == "null":
+                self._data.grad = None
+                self._data._grad_req = "null"
+            else:
+                self._init_grad()
+
+    # -- initialization -----------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None, force_reinit=False):
+        """Materialize the array (reference parameter.py initialize)."""
+        default_init = default_init or init_mod.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if self.shape is None or any(s == 0 for s in self.shape):
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise DeferredInitializationError(
+                "Cannot initialize Parameter '%s' because it has invalid shape %s."
+                % (self.name, self.shape)
+            )
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_init(self, init, ctx, default_init):
+        initializer = init or self.init or default_init
+        if isinstance(initializer, str):
+            initializer = init_mod.create(initializer)
+        data = nd_zeros(self.shape, dtype=self.dtype)
+        initializer(init_mod.InitDesc(self.name), data)
+        self._data = data
+        self._deferred_init = None
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def _finish_deferred_init(self, shape):
+        """Called by Block once the input shape is seen."""
+        if self._deferred_init is None:
+            raise DeferredInitializationError(self.name)
+        self.shape = tuple(int(s) for s in shape)
+        init, ctx, default_init = self._deferred_init
+        self._finish_init(init, ctx, default_init)
+
+    def _init_grad(self):
+        from .. import autograd
+
+        autograd.mark_variables([self._data], [nd_zeros(self._data.shape, dtype=self._data.dtype)], self._grad_req)
+
+    # -- access -------------------------------------------------------------
+    def _check_initialized(self):
+        if self._data is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    "Parameter '%s' has not been initialized yet because initialization was deferred. "
+                    "Actual initialization happens during the first forward pass." % self.name
+                )
+            raise RuntimeError(
+                "Parameter '%s' has not been initialized. You should initialize parameters "
+                "with Block.initialize() before use." % self.name
+            )
+
+    def data(self, ctx=None):
+        self._check_initialized()
+        return self._data
+
+    def list_data(self):
+        return [self.data()]
+
+    def grad(self, ctx=None):
+        self._check_initialized()
+        if self._grad_req == "null":
+            raise RuntimeError("Cannot get gradient array for Parameter '%s' because grad_req='null'" % self.name)
+        return self._data.grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        self._check_initialized()
+        return [self._data.context]
+
+    def zero_grad(self):
+        if self._data is not None and self._data.grad is not None:
+            self._data.grad._rebind(nd_zeros(self._data.shape, dtype=self._data.dtype)._data)
+
+    def set_data(self, data):
+        if self._data is None:
+            # setting data also resolves deferred init (load_params path)
+            self.shape = tuple(data.shape)
+            if self._deferred_init is not None:
+                init, ctx, default_init = self._deferred_init
+                self._deferred_init = None
+            self._data = data if isinstance(data, NDArray) else nd_array(data)
+            if self._grad_req != "null":
+                self._init_grad()
+            return
+        if self.shape and tuple(data.shape) != tuple(self.shape):
+            raise ValueError(
+                "Shape mismatch for Parameter '%s': expected %s, got %s" % (self.name, self.shape, data.shape)
+            )
+        self._data._rebind(data._data if isinstance(data, NDArray) else nd_array(data)._data)
+
+    def reset_ctx(self, ctx):
+        pass  # single logical device space under XLA
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is not None:
+            was = self._data
+            self._data = was.astype(dtype)
+            if self._grad_req != "null":
+                self._init_grad()
+
+    def var(self):
+        from ..symbol import var as sym_var
+
+        return sym_var(self.name, shape=self.shape, dtype=self.dtype, lr_mult=self.lr_mult, wd_mult=self.wd_mult)
+
+
+class Constant(Parameter):
+    """Non-differentiable constant parameter (reference gluon/parameter.py Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = nd_array(np.asarray(value))
+        self.value = value
+
+        class _CInit(init_mod.Initializer):
+            def _init_weight(self, _, arr):
+                arr[:] = value.asnumpy()
+
+        super().__init__(
+            name,
+            grad_req="null",
+            shape=value.shape,
+            dtype=value.dtype,
+            init=_CInit(),
+            differentiable=False,
+        )
+
+
+class ParameterDict:
+    """Ordered name→Parameter mapping with prefix sharing (reference :630)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = {}
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def __repr__(self):
+        s = "\n".join("  %s" % p for p in self._params.values())
+        return "ParameterDict '%s' (\n%s\n)" % (self._prefix, s)
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def __contains__(self, k):
+        return k in self._params
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def get(self, name, **kwargs):
+        """Get-or-create (reference parameter.py:743): name is appended to prefix."""
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if v is None:
+                    continue
+                if k == "shape" and param.shape is not None:
+                    v = tuple(v)
+                    if len(v) != len(param.shape) or any(
+                        a and b and a != b for a, b in zip(param.shape, v)
+                    ):
+                        raise AssertionError(
+                            "Parameter '%s' already has shape %s; cannot re-get with shape %s"
+                            % (name, param.shape, v)
+                        )
+                    # merge partial shapes (0 = unknown, reference parameter.py)
+                    param.shape = tuple(a if a else b for a, b in zip(param.shape, v))
+                elif k == "dtype" and param.dtype is not None:
+                    import numpy as _np
+
+                    if _np.dtype(v) != _np.dtype(param.dtype):
+                        raise AssertionError(
+                            "Parameter '%s' already has dtype %s; cannot re-get with dtype %s"
+                            % (name, param.dtype, v)
+                        )
+                elif hasattr(param, k):
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise KeyError("No constant named '%s'" % name)
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise ValueError("Cannot update self with other because they have different Parameters with the same name '%s'" % k)
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        for p in self.values():
+            p.initialize(None, ctx, init or init_mod.Uniform(), force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        pass
+
+    def setattr(self, name, value):
+        for p in self.values():
+            setattr(p, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        from ..ndarray import save as nd_save
+
+        arg = {}
+        for p in self.values():
+            if p._data is None:
+                continue
+            name = p.name
+            if strip_prefix and name.startswith(strip_prefix):
+                name = name[len(strip_prefix):]
+            arg[name] = p.data()
+        nd_save(filename, arg)
+
+    def load(self, filename, ctx=None, allow_missing=False, ignore_extra=False, restore_prefix=""):
+        from ..ndarray import load as nd_load
+
+        loaded = nd_load(filename)
+        if restore_prefix:
+            loaded = {restore_prefix + k: v for k, v in loaded.items()}
+        if not allow_missing:
+            for name in self.keys():
+                if name not in loaded:
+                    raise IOError("Parameter '%s' is missing in file '%s'" % (name, filename))
+        for name, arr in loaded.items():
+            if name not in self._params:
+                if not ignore_extra:
+                    raise IOError("Parameter '%s' loaded from file '%s' is not present in this ParameterDict" % (name, filename))
+                continue
+            self._params[name].set_data(arr)

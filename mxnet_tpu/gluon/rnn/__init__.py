@@ -1,0 +1,14 @@
+"""Recurrent layers & cells (reference ``python/mxnet/gluon/rnn/``)."""
+from .rnn_cell import (
+    RecurrentCell,
+    HybridRecurrentCell,
+    RNNCell,
+    LSTMCell,
+    GRUCell,
+    SequentialRNNCell,
+    DropoutCell,
+    ZoneoutCell,
+    ResidualCell,
+    BidirectionalCell,
+)
+from .rnn_layer import RNN, LSTM, GRU

@@ -1,0 +1,479 @@
+"""RNN cells — reference ``python/mxnet/gluon/rnn/rnn_cell.py``.
+
+Cells are HybridBlocks computing one step; ``unroll`` is an explicit Python
+loop over a fixed length (trace-friendly: under a CachedOp the loop unrolls
+into the XLA graph; for long sequences use the fused layers in rnn_layer.py
+which use ``lax.scan``).
+"""
+from __future__ import annotations
+
+from ..block import Block, HybridBlock
+
+__all__ = [
+    "RecurrentCell",
+    "HybridRecurrentCell",
+    "RNNCell",
+    "LSTMCell",
+    "GRUCell",
+    "SequentialRNNCell",
+    "DropoutCell",
+    "ZoneoutCell",
+    "ResidualCell",
+    "BidirectionalCell",
+]
+
+
+def _cells_state_info(cells, batch_size):
+    return sum([c.state_info(batch_size) for c in cells], [])
+
+
+def _cells_begin_state(cells, **kwargs):
+    return sum([c.begin_state(**kwargs) for c in cells], [])
+
+
+def _format_sequence(length, inputs, layout, merge, in_layout=None):
+    """Normalize input sequence to list-of-steps or merged tensor
+    (reference rnn_cell.py:40)."""
+    from ...ndarray.ndarray import NDArray
+    from ... import ndarray as nd_mod
+
+    axis = layout.find("T")
+    batch_axis = layout.find("N")
+    if isinstance(inputs, NDArray):
+        batch_size = inputs.shape[batch_axis]
+        if merge is False:
+            assert length is None or length == inputs.shape[axis]
+            inputs = [
+                nd_mod.squeeze(s, axis=axis)
+                for s in nd_mod.split_v2(inputs, inputs.shape[axis], axis=axis)
+            ]
+    else:
+        assert length is None or len(inputs) == length
+        batch_size = inputs[0].shape[batch_axis]
+        if merge is True:
+            inputs = [nd_mod.expand_dims(i, axis=axis) for i in inputs]
+            inputs = nd_mod.concat(*inputs, dim=axis)
+    return inputs, axis, batch_size
+
+
+def _mask_sequence_variable_length(F, data, length, valid_length, time_axis, merge):
+    assert valid_length is not None
+    if not isinstance(data, list):
+        return F.SequenceMask(data, sequence_length=valid_length, use_sequence_length=True, axis=time_axis)
+    outputs = F.SequenceMask(
+        F.stack(*data, axis=time_axis), sequence_length=valid_length, use_sequence_length=True, axis=time_axis
+    )
+    if not merge:
+        outputs = [
+            F.squeeze(s, axis=time_axis)
+            for s in F.split_v2(outputs, len(data), axis=time_axis)
+        ]
+    return outputs
+
+
+class RecurrentCell(Block):
+    """Base recurrent cell (reference rnn_cell.py:111)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    @property
+    def _gate_names(self):
+        return ()
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Initial states (reference rnn_cell.py:167)."""
+        assert not self._modified
+        from ... import ndarray as nd_mod
+
+        states = []
+        func = func or nd_mod.zeros
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            info = dict(info)
+            shape = info.pop("shape")
+            info.pop("__layout__", None)
+            states.append(func(shape, **{**info, **kwargs}))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC", merge_outputs=None, valid_length=None):
+        """Unroll for `length` steps (reference rnn_cell.py:205)."""
+        from ... import ndarray as F
+
+        self.reset()
+        inputs, axis, batch_size = _format_sequence(length, inputs, layout, False)
+        begin_state = begin_state if begin_state is not None else self.begin_state(batch_size)
+        states = begin_state
+        outputs = []
+        all_states = []
+        for i in range(length):
+            output, states = self(inputs[i], states)
+            outputs.append(output)
+            if valid_length is not None:
+                all_states.append(states)
+        if valid_length is not None:
+            states = [
+                F.SequenceLast(
+                    F.stack(*ele_list, axis=0),
+                    sequence_length=valid_length,
+                    use_sequence_length=True,
+                    axis=0,
+                )
+                for ele_list in zip(*all_states)
+            ]
+            outputs = _mask_sequence_variable_length(F, outputs, length, valid_length, axis, True)
+        if merge_outputs is not False:
+            outputs = F.stack(*outputs, axis=axis) if isinstance(outputs, list) else outputs
+        return outputs, states
+
+    def forward(self, inputs, states):
+        self._counter += 1
+        return super().forward(inputs, states)
+
+
+class HybridRecurrentCell(RecurrentCell, HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def forward(self, inputs, states):
+        self._counter += 1
+        return HybridBlock.forward(self, inputs, states)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+
+class RNNCell(HybridRecurrentCell):
+    """Elman RNN cell: h' = act(W_i x + b_i + W_h h + b_h) (reference :344)."""
+
+    def __init__(self, hidden_size, activation="tanh", i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(hidden_size, input_size), init=i2h_weight_initializer, allow_deferred_init=True
+            )
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(hidden_size, hidden_size), init=h2h_weight_initializer, allow_deferred_init=True
+            )
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(hidden_size,), init=i2h_bias_initializer, allow_deferred_init=True
+            )
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(hidden_size,), init=h2h_bias_initializer, allow_deferred_init=True
+            )
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "rnn"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight, i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias, num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias, num_hidden=self._hidden_size)
+        output = F.Activation(i2h + h2h, act_type=self._activation)
+        return output, [output]
+
+
+class LSTMCell(HybridRecurrentCell):
+    """LSTM cell (reference rnn_cell.py:443); 4 gates in one MXU matmul."""
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros", input_size=0,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_size, input_size), init=i2h_weight_initializer, allow_deferred_init=True
+            )
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(4 * hidden_size, hidden_size), init=h2h_weight_initializer, allow_deferred_init=True
+            )
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_size,), init=i2h_bias_initializer, allow_deferred_init=True
+            )
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_size,), init=h2h_bias_initializer, allow_deferred_init=True
+            )
+
+    def state_info(self, batch_size=0):
+        return [
+            {"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+            {"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+        ]
+
+    @property
+    def _gate_names(self):
+        return ["_i", "_f", "_c", "_o"]
+
+    def _alias(self):
+        return "lstm"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight, i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias, num_hidden=4 * self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias, num_hidden=4 * self._hidden_size)
+        gates = i2h + h2h
+        slice_gates = F.SliceChannel(gates, num_outputs=4, axis=1)
+        in_gate = F.sigmoid(slice_gates[0])
+        forget_gate = F.sigmoid(slice_gates[1])
+        in_transform = F.tanh(slice_gates[2])
+        out_gate = F.sigmoid(slice_gates[3])
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * F.tanh(next_c)
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(HybridRecurrentCell):
+    """GRU cell (reference rnn_cell.py:565)."""
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros", input_size=0,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(3 * hidden_size, input_size), init=i2h_weight_initializer, allow_deferred_init=True
+            )
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(3 * hidden_size, hidden_size), init=h2h_weight_initializer, allow_deferred_init=True
+            )
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(3 * hidden_size,), init=i2h_bias_initializer, allow_deferred_init=True
+            )
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(3 * hidden_size,), init=h2h_bias_initializer, allow_deferred_init=True
+            )
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ["_r", "_z", "_o"]
+
+    def _alias(self):
+        return "gru"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight, i2h_bias, h2h_bias):
+        prev_h = states[0]
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias, num_hidden=3 * self._hidden_size)
+        h2h = F.FullyConnected(prev_h, h2h_weight, h2h_bias, num_hidden=3 * self._hidden_size)
+        i2h_r, i2h_z, i2h = F.SliceChannel(i2h, num_outputs=3, axis=1)
+        h2h_r, h2h_z, h2h = F.SliceChannel(h2h, num_outputs=3, axis=1)
+        reset_gate = F.sigmoid(i2h_r + h2h_r)
+        update_gate = F.sigmoid(i2h_z + h2h_z)
+        next_h_tmp = F.tanh(i2h + reset_gate * h2h)
+        next_h = (1.0 - update_gate) * next_h_tmp + update_gate * prev_h
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack cells (reference rnn_cell.py:667)."""
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._children.values(), **kwargs)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            assert not isinstance(cell, BidirectionalCell)
+            n = len(cell.state_info())
+            state = states[p : p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.append(state)
+        return inputs, sum(next_states, [])
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+
+class ModifierCell(HybridRecurrentCell):
+    """Wrap a cell modifying behavior (reference rnn_cell.py:743)."""
+
+    def __init__(self, base_cell):
+        assert not base_cell._modified
+        base_cell._modified = True
+        super().__init__(prefix=base_cell.prefix + self._alias() + "_", params=None)
+        self.base_cell = base_cell
+
+    @property
+    def params(self):
+        return self.base_cell.params
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, func=None, **kwargs):
+        assert not self._modified
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(func=func, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+
+class DropoutCell(HybridRecurrentCell):
+    """Dropout on step inputs (reference rnn_cell.py:692)."""
+
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def _alias(self):
+        return "dropout"
+
+    def hybrid_forward(self, F, inputs, states):
+        if self._rate > 0:
+            inputs = F.Dropout(inputs, p=self._rate, axes=self._axes)
+        return inputs, states
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularization (reference rnn_cell.py:797)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        assert not isinstance(base_cell, BidirectionalCell)
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def _alias(self):
+        return "zoneout"
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def hybrid_forward(self, F, inputs, states):
+        cell = self.base_cell
+        next_output, next_states = cell(inputs, states)
+
+        def mask(p, like):
+            return F.Dropout(F.ones_like(like), p=p)
+
+        prev_output = self._prev_output if self._prev_output is not None else F.zeros_like(next_output)
+        output = (
+            F.where(mask(self.zoneout_outputs, next_output), next_output, prev_output)
+            if self.zoneout_outputs > 0.0
+            else next_output
+        )
+        states = (
+            [F.where(mask(self.zoneout_states, new_s), new_s, old_s) for new_s, old_s in zip(next_states, states)]
+            if self.zoneout_states > 0.0
+            else next_states
+        )
+        self._prev_output = output
+        return output, states
+
+
+class ResidualCell(ModifierCell):
+    """Residual connection around a cell (reference rnn_cell.py:854)."""
+
+    def hybrid_forward(self, F, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        output = output + inputs
+        return output, states
+
+    def _alias(self):
+        return "residual"
+
+
+class BidirectionalCell(HybridRecurrentCell):
+    """Run two cells over the sequence in both directions (reference :899)."""
+
+    def __init__(self, l_cell, r_cell, output_prefix="bi_"):
+        super().__init__(prefix="", params=None)
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+        self._output_prefix = output_prefix
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError("Bidirectional cannot be stepped. Please use unroll")
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._children.values(), **kwargs)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC", merge_outputs=None, valid_length=None):
+        from ... import ndarray as F
+
+        self.reset()
+        inputs, axis, batch_size = _format_sequence(length, inputs, layout, False)
+        if valid_length is None:
+            reversed_inputs = list(reversed(inputs))
+        else:
+            # reverse within each sequence's valid span so the r_cell sees
+            # real data first, not padding (reference rnn_cell.py:946)
+            from ... import ndarray as F
+
+            rev = F.SequenceReverse(
+                F.stack(*inputs, axis=0), sequence_length=valid_length, use_sequence_length=True, axis=0
+            )
+            reversed_inputs = [F.squeeze(s, axis=0) for s in F.split_v2(rev, length, axis=0)]
+        begin_state = begin_state if begin_state is not None else self.begin_state(batch_size)
+        states = begin_state
+        l_cell, r_cell = self._children.values()
+        l_outputs, l_states = l_cell.unroll(
+            length, inputs=inputs, begin_state=states[: len(l_cell.state_info())],
+            layout=layout, merge_outputs=False, valid_length=valid_length,
+        )
+        r_outputs, r_states = r_cell.unroll(
+            length, inputs=reversed_inputs, begin_state=states[len(l_cell.state_info()):],
+            layout=layout, merge_outputs=False, valid_length=valid_length,
+        )
+        if valid_length is None:
+            r_outputs = list(reversed(r_outputs))
+        else:
+            r_outputs = F.SequenceReverse(
+                F.stack(*r_outputs, axis=0), sequence_length=valid_length, use_sequence_length=True, axis=0
+            )
+            r_outputs = [F.squeeze(s, axis=0) for s in F.split_v2(r_outputs, length, axis=0)]
+        outputs = [F.concat(l_o, r_o, dim=1) for l_o, r_o in zip(l_outputs, r_outputs)]
+        if merge_outputs is not False:
+            outputs = F.stack(*outputs, axis=axis)
+        states = l_states + r_states
+        return outputs, states

@@ -1,0 +1,188 @@
+"""Fused recurrent layers — reference ``python/mxnet/gluon/rnn/rnn_layer.py``.
+
+Backed by the fused ``RNN`` op (ops/rnn.py): one lax.scan per layer/direction,
+input projections hoisted into a single MXU matmul over the whole sequence.
+"""
+from __future__ import annotations
+
+from ... import ndarray as nd_mod
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(
+        self,
+        hidden_size,
+        num_layers,
+        layout,
+        dropout,
+        bidirectional,
+        input_size,
+        i2h_weight_initializer,
+        h2h_weight_initializer,
+        i2h_bias_initializer,
+        h2h_bias_initializer,
+        mode,
+        prefix=None,
+        params=None,
+    ):
+        super().__init__(prefix=prefix, params=params)
+        assert layout in ("TNC", "NTC"), "Invalid layout %s; must be one of ['TNC' or 'NTC']" % layout
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._i2h_weight_initializer = i2h_weight_initializer
+        self._h2h_weight_initializer = h2h_weight_initializer
+        self._i2h_bias_initializer = i2h_bias_initializer
+        self._h2h_bias_initializer = h2h_bias_initializer
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+        ng, ni, nh = self._gates, input_size, hidden_size
+        with self.name_scope():
+            for i in range(num_layers):
+                for j in ["l", "r"][: self._dir]:
+                    self._register_param("%s%d_i2h_weight" % (j, i), (ng * nh, ni), i2h_weight_initializer)
+                    self._register_param("%s%d_h2h_weight" % (j, i), (ng * nh, nh), h2h_weight_initializer)
+                    self._register_param("%s%d_i2h_bias" % (j, i), (ng * nh,), i2h_bias_initializer)
+                    self._register_param("%s%d_h2h_bias" % (j, i), (ng * nh,), h2h_bias_initializer)
+                ni = nh * self._dir
+
+    def _register_param(self, name, shape, init):
+        p = self.params.get(name, shape=shape, init=init, allow_deferred_init=True)
+        setattr(self, name, p)
+        return p
+
+    def __repr__(self):
+        s = "{name}({mapping}, {_layout}"
+        if self._num_layers != 1:
+            s += ", num_layers={_num_layers}"
+        if self._dropout != 0:
+            s += ", dropout={_dropout}"
+        if self._dir == 2:
+            s += ", bidirectional"
+        s += ")"
+        mapping = "%s -> %s" % (self._input_size if self._input_size else None, self._hidden_size)
+        return s.format(name=self.__class__.__name__, mapping=mapping, **self.__dict__)
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def infer_shape(self, *args):
+        """Resolve deferred param shapes straight from the input shape — the
+        fused layer knows its own formulas, so no symbolic trace is needed
+        (the generic HybridBlock.infer_shape path can't build the nd-array
+        initial states symbolically)."""
+        inputs = args[0]
+        ni = inputs.shape[self._layout.find("C")]
+        ng, nh = self._gates, self._hidden_size
+        for i in range(self._num_layers):
+            for j in ["l", "r"][: self._dir]:
+                getattr(self, "%s%d_i2h_weight" % (j, i)).shape = (ng * nh, ni)
+            ni = nh * self._dir
+        for p in self.collect_params().values():
+            if p._deferred_init is not None:
+                p._finish_deferred_init(p.shape)
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import ndarray as F
+
+        func = func or nd_mod.zeros
+        states = []
+        for i, info in enumerate(self.state_info(batch_size)):
+            info = dict(info)
+            info.pop("__layout__", None)
+            shape = info.pop("shape")
+            states.append(func(shape, **{**info, **kwargs}))
+        return states
+
+    def _flat_params(self, F, kwargs):
+        """Pack per-layer params into the fused op's parameter vector
+        (matches reference rnn_layer.py _collect_params_with_prefix order)."""
+        ws, bs = [], []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][: self._dir]:
+                ws.append(F.reshape(kwargs["%s%d_i2h_weight" % (j, i)], (-1,)))
+                ws.append(F.reshape(kwargs["%s%d_h2h_weight" % (j, i)], (-1,)))
+        for i in range(self._num_layers):
+            for j in ["l", "r"][: self._dir]:
+                bs.append(kwargs["%s%d_i2h_bias" % (j, i)])
+                bs.append(kwargs["%s%d_h2h_bias" % (j, i)])
+        return F.concat(*(ws + bs), dim=0)
+
+    def hybrid_forward(self, F, inputs, states=None, **kwargs):
+        batch_axis = self._layout.find("N")
+        batch_size = inputs.shape[batch_axis]
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(batch_size)
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+        if self._layout == "NTC":
+            inputs = F.swapaxes(inputs, 0, 1)
+        params = self._flat_params(F, kwargs)
+        rnn_args = [inputs, params] + list(states)
+        outputs = F.RNN(
+            *rnn_args,
+            state_size=self._hidden_size,
+            num_layers=self._num_layers,
+            bidirectional=self._dir == 2,
+            p=self._dropout,
+            state_outputs=True,
+            mode=self._mode,
+        )
+        out, states = outputs[0], list(outputs[1:])
+        if self._layout == "NTC":
+            out = F.swapaxes(out, 0, 1)
+        if skip_states:
+            return out
+        return out, states
+
+
+class RNN(_RNNLayer):
+    """Multi-layer Elman RNN (reference rnn_layer.py:348)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu", layout="TNC", dropout=0,
+                 bidirectional=False, i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros", input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer, "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size, self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    """Multi-layer LSTM (reference rnn_layer.py:439)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0, bidirectional=False,
+                 input_size=0, i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros", **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer, "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [
+            {"shape": (self._num_layers * self._dir, batch_size, self._hidden_size), "__layout__": "LNC"},
+            {"shape": (self._num_layers * self._dir, batch_size, self._hidden_size), "__layout__": "LNC"},
+        ]
+
+
+class GRU(_RNNLayer):
+    """Multi-layer GRU (reference rnn_layer.py:552)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0, bidirectional=False,
+                 input_size=0, i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros", **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size, self._hidden_size), "__layout__": "LNC"}]

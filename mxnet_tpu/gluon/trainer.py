@@ -1,0 +1,182 @@
+"""Trainer — reference ``python/mxnet/gluon/trainer.py:27``.
+
+Applies an Optimizer to a set of Parameters.  On one chip the update runs
+locally; on a device mesh the gradient averaging that the reference routed
+through KVStore push/pull becomes an XLA ``psum`` inside the jitted step
+(``mxnet_tpu.kvstore`` provides the same API over collectives).
+"""
+from __future__ import annotations
+
+from .. import optimizer as opt_mod
+from ..ndarray.ndarray import NDArray
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(
+        self,
+        params,
+        optimizer,
+        optimizer_params=None,
+        kvstore="device",
+        compression_params=None,
+        update_on_kvstore=None,
+    ):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError("First argument must be a list or dict of Parameters")
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise ValueError("First argument must be a list or dict of Parameters, got %s" % type(param))
+            self._params.append(param)
+            self._param2idx[param.name] = i
+            param._trainer = self
+        self._compression_params = compression_params
+        self._scale = 1.0
+        optimizer_params = optimizer_params or {}
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._update_on_kvstore = update_on_kvstore
+        self._states = [None] * len(self._params)
+        self._states_init = [False] * len(self._params)
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        if isinstance(optimizer, opt_mod.Optimizer):
+            assert not optimizer_params, (
+                "optimizer_params must be None if optimizer is an Optimizer instance"
+            )
+            self._optimizer = optimizer
+        else:
+            self._optimizer = opt_mod.create(optimizer, **optimizer_params)
+        lr_mult, wd_mult = {}, {}
+        for i, p in enumerate(self._params):
+            lr_mult[i] = p.lr_mult
+            wd_mult[i] = p.wd_mult
+        self._optimizer.set_lr_mult(lr_mult)
+        self._optimizer.set_wd_mult(wd_mult)
+
+    def _init_kvstore(self):
+        if self._kvstore_type and not isinstance(self._kvstore_type, str):
+            self._kvstore = self._kvstore_type  # a KVStore instance
+        elif self._kvstore_type and self._kvstore_type not in ("device", "local"):
+            from .. import kvstore as kv_mod
+
+            self._kvstore = kv_mod.create(self._kvstore_type)
+        self._kv_initialized = True
+        if self._kvstore is not None:
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null":
+                    self._kvstore.init(i, p.data())
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def allreduce_grads(self):
+        """Average gradients across workers (reference trainer.py:245).
+
+        Single-process: no-op.  With a dist kvstore attached, pushes+pulls
+        each grad (≡ psum over the mesh).
+        """
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._kvstore is None:
+            return
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null":
+                self._kvstore.push(i, p.grad())
+                self._kvstore.pull(i, out=p.grad())
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce + optimizer update (reference trainer.py:217)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._scale = 1.0 / batch_size
+        self.allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        """Optimizer update only — caller did its own allreduce (reference
+        trainer.py update)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._scale = 1.0 / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        rescale = self._scale
+        self._optimizer.rescale_grad = rescale
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            if p._data is None:
+                if not ignore_stale_grad:
+                    raise UserWarning("Parameter %s is not initialized" % p.name)
+                continue
+            if not self._states_init[i]:
+                self._states[i] = self._optimizer.create_state_multi_precision(i, p.data())
+                self._states_init[i] = True
+            self._optimizer.update_multi_precision(i, p.data(), p.grad(), self._states[i])
+
+    def save_states(self, fname):
+        """Serialize optimizer states (reference trainer.py:339)."""
+        import pickle
+
+        import numpy as np
+
+        state_np = []
+        for s in self._states:
+            state_np.append(_states_to_numpy(s))
+        with open(fname, "wb") as f:
+            pickle.dump({"optimizer": self._optimizer.serialize(), "states": state_np}, f)
+
+    def load_states(self, fname):
+        """Restore optimizer states (reference trainer.py:362)."""
+        import pickle
+
+        with open(fname, "rb") as f:
+            blob = pickle.load(f)
+        self._optimizer = opt_mod.Optimizer.deserialize(blob["optimizer"])
+        self._states = [_states_from_numpy(s) for s in blob["states"]]
+        self._states_init = [s is not None for s in self._states]
+        for i, init in enumerate(self._states_init):
+            if not init:
+                self._states[i] = None
+
+
+def _states_to_numpy(s):
+    if s is None:
+        return None
+    if isinstance(s, NDArray):
+        return s.asnumpy()
+    if isinstance(s, (list, tuple)):
+        return type(s)(_states_to_numpy(x) for x in s)
+    return s
+
+
+def _states_from_numpy(s):
+    import numpy as np
+
+    from ..ndarray import array as nd_array
+
+    if s is None:
+        return None
+    if isinstance(s, np.ndarray):
+        return nd_array(s)
+    if isinstance(s, (list, tuple)):
+        return type(s)(_states_from_numpy(x) for x in s)
+    return s

@@ -1,0 +1,113 @@
+"""Gluon utilities — reference ``python/mxnet/gluon/utils.py``."""
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+
+from ..ndarray.ndarray import NDArray
+from ..ndarray import array as nd_array
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1", "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split along batch axis into num_slice chunks (reference utils.py:31)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices along axis %d. "
+            "Use a batch size that's a multiple of %d or set even_split=False."
+            % (str(data.shape), num_slice, batch_axis, num_slice)
+        )
+    step = size // num_slice
+    if not even_split and size < num_slice:
+        step = 1
+        num_slice = size
+    slices = []
+    for i in range(num_slice):
+        lo = i * step
+        hi = (i + 1) * step if i < num_slice - 1 else size
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(lo, hi)
+        slices.append(data[tuple(idx)])
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split and place each slice on a context (reference utils.py:81).
+
+    On TPU the placement is a sharding hint; with one device it's a no-op
+    split for API parity.
+    """
+    if not isinstance(data, NDArray):
+        data = nd_array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so total L2 norm <= max_norm (reference utils.py:117)."""
+    assert len(arrays) > 0
+    total = 0.0
+    for a in arrays:
+        n = a.norm().asscalar()
+        total += float(n) ** 2
+    total = float(np.sqrt(total))
+    if check_isfinite and not np.isfinite(total):
+        import warnings
+
+        warnings.warn("nan or inf is detected. Clipping results will be undefined.", stacklevel=2)
+    scale = max_norm / (total + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a *= scale
+    return total
+
+
+def check_sha1(filename, sha1_hash):
+    """Check a file against expected sha1 (reference utils.py:153)."""
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5, verify_ssl=True):
+    """Download a file (reference utils.py:182).  This image has no egress;
+    local file:// URLs and cached files still work."""
+    if path is None:
+        fname = url.split("/")[-1]
+    elif os.path.isdir(path):
+        fname = os.path.join(path, url.split("/")[-1])
+    else:
+        fname = path
+    if os.path.exists(fname) and not overwrite and (not sha1_hash or check_sha1(fname, sha1_hash)):
+        return fname
+    if url.startswith("file://"):
+        import shutil
+
+        shutil.copyfile(url[7:], fname)
+        return fname
+    import urllib.request
+
+    dirname = os.path.dirname(os.path.abspath(os.path.expanduser(fname)))
+    if dirname and not os.path.exists(dirname):
+        os.makedirs(dirname, exist_ok=True)
+    last = None
+    for _ in range(retries):
+        try:
+            urllib.request.urlretrieve(url, fname)
+            if sha1_hash and not check_sha1(fname, sha1_hash):
+                raise UserWarning("File %s is downloaded but the content hash does not match." % fname)
+            return fname
+        except Exception as e:  # noqa: BLE001
+            last = e
+    raise last

@@ -42,10 +42,15 @@ def register(klass):
     return klass
 
 
+_ALIASES = {"zeros": "zero", "ones": "one"}  # gluon-style names (reference accepts both)
+
+
 def create(name, *args, **kwargs):
     if isinstance(name, Initializer):
         return name
-    return _INIT_REGISTRY[name.lower()](*args, **kwargs)
+    key = name.lower()
+    key = _ALIASES.get(key, key)
+    return _INIT_REGISTRY[key](*args, **kwargs)
 
 
 class InitDesc(str):

@@ -44,6 +44,9 @@ _VISIBLE_RULES = {
     "BatchNorm": lambda attrs: 3 if attrs.get("output_mean_var") else 1,
     "LayerNorm": lambda attrs: 3 if attrs.get("output_mean_var") else 1,
     "_sample_multinomial": lambda attrs: 2 if attrs.get("get_prob") else 1,
+    "RNN": lambda attrs: (
+        (3 if attrs.get("mode", "lstm") == "lstm" else 2) if attrs.get("state_outputs") else 1
+    ),
 }
 
 
@@ -90,10 +93,19 @@ def _invoke(opdef, args, kwargs):
     # map named tensor args to positions
     if not opdef.variadic and opdef.arg_names:
         if len(args) > len(opdef.arg_names):
-            raise TypeError(
-                "%s takes at most %d tensor arguments (%d given)"
-                % (opdef.name, len(opdef.arg_names), len(args))
-            )
+            # extra positional args are attrs passed positionally, MXNet-style
+            # (e.g. nd.clip(x, a_min, a_max)); an extra NDArray is a real
+            # arity error, not an attr
+            extras = args[len(opdef.arg_names) :]
+            args = args[: len(opdef.arg_names)]
+            free_attrs = [a for a in opdef.attr_names if a not in kwargs]
+            if len(extras) > len(free_attrs) or any(isinstance(e, NDArray) for e in extras):
+                raise TypeError(
+                    "%s takes at most %d tensor arguments (%d given)"
+                    % (opdef.name, len(opdef.arg_names), len(args) + len(extras))
+                )
+            for a, v in zip(free_attrs, extras):
+                kwargs[a] = v
         named = {}
         for i, a in enumerate(args):
             named[opdef.arg_names[i]] = a
@@ -212,16 +224,18 @@ def save(fname, data):
     Format: numpy .npz with a manifest key encoding list vs dict (portable,
     replacing the reference's dmlc binary format).
     """
-    if isinstance(data, NDArray):
-        np.savez(fname, __mx_format__="single", a0=data.asnumpy())
-    elif isinstance(data, (list, tuple)):
-        arrs = {"a%d" % i: a.asnumpy() for i, a in enumerate(data)}
-        np.savez(fname, __mx_format__="list", **arrs)
-    elif isinstance(data, dict):
-        arrs = {"k_" + k: v.asnumpy() for k, v in data.items()}
-        np.savez(fname, __mx_format__="dict", **arrs)
-    else:
-        raise TypeError(type(data))
+    # pass an open handle so numpy can't append ".npz" to the user's filename
+    with open(fname, "wb") as f:
+        if isinstance(data, NDArray):
+            np.savez(f, __mx_format__="single", a0=data.asnumpy())
+        elif isinstance(data, (list, tuple)):
+            arrs = {"a%d" % i: a.asnumpy() for i, a in enumerate(data)}
+            np.savez(f, __mx_format__="list", **arrs)
+        elif isinstance(data, dict):
+            arrs = {"k_" + k: v.asnumpy() for k, v in data.items()}
+            np.savez(f, __mx_format__="dict", **arrs)
+        else:
+            raise TypeError(type(data))
 
 
 def load(fname):

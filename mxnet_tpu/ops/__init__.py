@@ -13,5 +13,6 @@ from . import init_ops  # noqa: F401
 from . import indexing  # noqa: F401
 from . import nn  # noqa: F401
 from . import random_ops  # noqa: F401
+from . import rnn  # noqa: F401
 
 _load_all = True

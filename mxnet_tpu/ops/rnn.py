@@ -1,0 +1,202 @@
+"""Fused recurrent ops — TPU-native equivalent of reference
+``src/operator/rnn-inl.h`` / ``rnn_impl.h`` (cuDNN fused RNN).
+
+The whole sequence loop is a single ``lax.scan`` per layer/direction: XLA
+compiles it to one while-loop kernel with the gate matmuls on the MXU.
+Parameters use the reference's packed-vector convention (all i2h/h2h weights
+for every layer+direction concatenated, then all biases) so gluon's fused
+layers and checkpoint format match the reference (rnn_layer.py flattening).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _layer_param_size(mode, input_size, hidden, directions):
+    g = _GATES[mode]
+    return directions * g * hidden * (input_size + hidden + 2)
+
+
+def rnn_param_size(mode, input_size, hidden, num_layers, bidirectional):
+    """Total packed parameter count (reference rnn-inl.h GetParamSize)."""
+    d = 2 if bidirectional else 1
+    size = _layer_param_size(mode, input_size, hidden, d)
+    for _ in range(num_layers - 1):
+        size += _layer_param_size(mode, d * hidden, hidden, d)
+    return size
+
+
+def _unpack_params(params, mode, input_size, hidden, num_layers, d):
+    """Split the packed vector into per-(layer,direction) weight/bias arrays."""
+    g = _GATES[mode]
+    shapes_w = []
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else d * hidden
+        for _ in range(d):
+            shapes_w.append(((g * hidden, isz), (g * hidden, hidden)))
+    ws, pos = [], 0
+    for (wi_shape, wh_shape) in shapes_w:
+        ni = wi_shape[0] * wi_shape[1]
+        wi = params[pos : pos + ni].reshape(wi_shape)
+        pos += ni
+        nh = wh_shape[0] * wh_shape[1]
+        wh = params[pos : pos + nh].reshape(wh_shape)
+        pos += nh
+        ws.append((wi, wh))
+    bs = []
+    for _ in range(num_layers * d):
+        bi = params[pos : pos + g * hidden]
+        pos += g * hidden
+        bh = params[pos : pos + g * hidden]
+        pos += g * hidden
+        bs.append((bi, bh))
+    return [w + b for w, b in zip(ws, bs)]
+
+
+def _step_fn(mode, hidden):
+    if mode == "lstm":
+
+        def step(carry, x_gates, wh, bh):
+            h, c = carry
+            gates = x_gates + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c = f * c + i * g
+            h = o * jnp.tanh(c)
+            return (h, c), h
+
+        return step
+    if mode == "gru":
+
+        def step(carry, x_gates, wh, bh):
+            (h,) = carry
+            hg = h @ wh.T + bh
+            xr, xz, xn = jnp.split(x_gates, 3, axis=-1)
+            hr, hz, hn = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            h = (1 - z) * n + z * h
+            return (h,), h
+
+        return step
+
+    act = jnp.tanh if mode == "rnn_tanh" else (lambda v: jnp.maximum(v, 0))
+
+    def step(carry, x_gates, wh, bh):
+        (h,) = carry
+        h = act(x_gates + h @ wh.T + bh)
+        return (h,), h
+
+    return step
+
+
+def _run_layer(x, h0, c0, wi, wh, bi, bh, mode, hidden, reverse):
+    """One direction of one layer over the full sequence.  x: (T, N, I)."""
+    # hoist the input projection out of the scan: one big MXU matmul (T*N, I)
+    t, n, isz = x.shape
+    x_gates = (x.reshape(t * n, isz) @ wi.T + bi).reshape(t, n, -1)
+    step = _step_fn(mode, hidden)
+    carry = (h0, c0) if mode == "lstm" else (h0,)
+
+    def body(carry, xg):
+        return step(carry, xg, wh, bh)
+
+    carry, ys = jax.lax.scan(body, carry, x_gates, reverse=reverse)
+    return ys, carry
+
+
+@register(
+    "RNN",
+    aux=(),
+    inputs_fn=lambda attrs: ["data", "parameters", "state", "state_cell"]
+    if attrs.get("mode", "lstm") == "lstm"
+    else ["data", "parameters", "state"],
+    infer_params=lambda attrs, shapes: _rnn_infer(attrs, shapes),
+)
+def rnn(
+    data,
+    parameters,
+    state,
+    state_cell=None,
+    *,
+    state_size,
+    num_layers,
+    mode="lstm",
+    bidirectional=False,
+    p=0.0,
+    state_outputs=False,
+    lstm_state_clip_min=None,
+    lstm_state_clip_max=None,
+    lstm_state_clip_nan=False,
+    training=False,
+    key=None,
+):
+    """Fused multi-layer RNN (reference src/operator/rnn-inl.h).
+
+    data: (T, N, I) — sequence-major like the reference's fused op.
+    state: (L*D, N, H); state_cell likewise for LSTM.
+    Returns out (T, N, D*H) [+ final h [+ final c for lstm]].
+    """
+    d = 2 if bidirectional else 1
+    hidden = state_size
+    layers = _unpack_params(parameters, mode, data.shape[2], hidden, num_layers, d)
+    x = data
+    h_finals, c_finals = [], []
+    for layer in range(num_layers):
+        outs = []
+        for direction in range(d):
+            li = layer * d + direction
+            wi, wh, bi, bh = layers[li]
+            h0 = state[li]
+            c0 = state_cell[li] if mode == "lstm" else None
+            ys, carry = _run_layer(x, h0, c0, wi, wh, bi, bh, mode, hidden, reverse=direction == 1)
+            outs.append(ys)
+            h_finals.append(carry[0])
+            if mode == "lstm":
+                c = carry[1]
+                if lstm_state_clip_min is not None and lstm_state_clip_max is not None:
+                    c = jnp.clip(c, lstm_state_clip_min, lstm_state_clip_max)
+                c_finals.append(c)
+        x = outs[0] if d == 1 else jnp.concatenate(outs, axis=-1)
+        if p > 0 and training and layer < num_layers - 1 and key is not None:
+            keep = jax.random.bernoulli(jax.random.fold_in(key, layer), 1 - p, x.shape)
+            x = jnp.where(keep, x / (1 - p), 0)
+    out_h = jnp.stack(h_finals)
+    if mode == "lstm":
+        return x, out_h, jnp.stack(c_finals)
+    return x, out_h
+
+
+def _rnn_infer(attrs, shapes):
+    dshape = shapes["data"]
+    hidden = attrs["state_size"]
+    nl = attrs["num_layers"]
+    bi = attrs.get("bidirectional", False)
+    d = 2 if bi else 1
+    mode = attrs.get("mode", "lstm")
+    out = {
+        "parameters": (rnn_param_size(mode, dshape[2], hidden, nl, bi),),
+        "state": (nl * d, dshape[1], hidden),
+    }
+    if mode == "lstm":
+        out["state_cell"] = (nl * d, dshape[1], hidden)
+    return out
+
+
+@register("split_v2")
+def split_v2(data, *, indices_or_sections, axis=0, squeeze_axis=False):
+    """numpy-style split (reference matrix_op split_v2)."""
+    if isinstance(indices_or_sections, int):
+        parts = jnp.split(data, indices_or_sections, axis=axis)
+    else:
+        parts = jnp.split(data, list(indices_or_sections), axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(s, axis=axis) for s in parts]
+    return tuple(parts)

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import threading
 
-__all__ = ["seed", "next_key", "current_seed"]
+__all__ = ["seed", "next_key", "current_seed", "key_provider"]
 
 _state = threading.local()
 
@@ -20,6 +20,7 @@ def _ensure():
 
         _state.key = jax.random.PRNGKey(0)
         _state.seed_val = 0
+        _state.provider = None
         _state.init = True
 
 
@@ -38,9 +39,43 @@ def current_seed():
 
 
 def next_key():
-    """Split off a fresh key (called by the nd frontend per random op)."""
+    """Split off a fresh key (called by the nd frontend per random op).
+
+    Inside a :class:`key_provider` scope (hybridized/jitted graph capture),
+    keys instead come from the provider so randomness is a *traced input* of
+    the compiled graph rather than a constant baked at trace time.
+    """
     import jax
 
     _ensure()
+    if _state.provider is not None:
+        return _state.provider()
     _state.key, sub = jax.random.split(_state.key)
     return sub
+
+
+class key_provider:
+    """Scope that makes :func:`next_key` derive keys from a base key by
+    fold-in counter — used when tracing a CachedOp-style graph so the same
+    trace re-executes with fresh randomness each call."""
+
+    def __init__(self, base_key):
+        self._base = base_key
+        self._n = 0
+        self._prev = None
+
+    def __call__(self):
+        import jax
+
+        k = jax.random.fold_in(self._base, self._n)
+        self._n += 1
+        return k
+
+    def __enter__(self):
+        _ensure()
+        self._prev = _state.provider
+        _state.provider = self
+        return self
+
+    def __exit__(self, *a):
+        _state.provider = self._prev

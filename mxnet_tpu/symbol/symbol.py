@@ -612,7 +612,11 @@ def _infer_graph(sym, known_shapes, known_dtypes):
     for node in sym._walk():
         if node.is_var:
             if node.name not in shapes and node._shape_hint is not None:
-                shapes[node.name] = node._shape_hint
+                hint = tuple(node._shape_hint)
+                # partial hints (0 = unknown dim, reference-style) are left for
+                # the consuming op's infer_params rule to complete
+                if all(s for s in hint):
+                    shapes[node.name] = hint
             if node.name in shapes:
                 out_shapes[node.name] = shapes[node.name]
                 out_dtypes[node.name] = dtypes.get(node.name, np.float32)

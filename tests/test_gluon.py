@@ -1,0 +1,315 @@
+"""Gluon tests — mirrors reference tests/python/unittest/test_gluon.py patterns."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier")
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+    assert p.list_ctx() is not None
+
+
+def test_parameter_invalid_access():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    with pytest.raises(RuntimeError):
+        p.data()
+
+
+def test_paramdict():
+    params = gluon.ParameterDict("net_")
+    params.get("weight", shape=(10, 10))
+    assert list(params.keys()) == ["net_weight"]
+    params.initialize(ctx=mx.cpu())
+    params.save("/tmp/test_paramdict.npz")
+    params.load("/tmp/test_paramdict.npz", mx.cpu())
+
+
+def test_dense():
+    model = nn.Dense(128, activation="tanh", in_units=10, flatten=False, prefix="test_")
+    inputs = nd.array(np.random.rand(2, 3, 10).astype(np.float32))
+    model.initialize()
+    assert set(model.collect_params().keys()) == {"test_weight", "test_bias"}
+    out = model(inputs)
+    assert out.shape == (2, 3, 128)
+
+    model2 = nn.Dense(128, activation="relu", in_units=30, prefix="test2_")
+    inputs2 = nd.array(np.random.rand(17, 2, 5, 3).astype(np.float32))
+    model2.initialize()
+    out2 = model2(inputs2)
+    assert out2.shape == (17, 128)
+
+
+def test_sequential_and_getitem():
+    net = nn.Sequential()
+    net.add(nn.Dense(10), nn.Dense(10), nn.Dense(10))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+    assert len(net[0:2]) == 2
+
+
+def test_hybrid_eager_consistency():
+    """Hybridized (CachedOp/jit) output must match eager output exactly."""
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(4, 3, padding=1), nn.BatchNorm(), nn.Activation("relu"),
+                nn.MaxPool2D(2), nn.Dense(8))
+    net.initialize()
+    x = nd.array(np.random.rand(2, 3, 8, 8).astype(np.float32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    assert_almost_equal(eager, hybrid, rtol=1e-5, atol=1e-5)
+
+
+def test_hybrid_grad_consistency():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    x = nd.array(np.random.rand(3, 8).astype(np.float32))
+
+    def grads():
+        with autograd.record():
+            y = net(x).sum()
+        y.backward()
+        return [p.grad().asnumpy().copy() for p in net.collect_params().values()]
+
+    g_eager = grads()
+    net.hybridize()
+    g_hybrid = grads()
+    for a, b in zip(g_eager, g_hybrid):
+        assert_almost_equal(a, b, rtol=1e-5, atol=1e-5)
+
+
+def test_batchnorm_running_stats():
+    bn = nn.BatchNorm(in_channels=4)
+    bn.initialize()
+    x = nd.array(np.random.rand(8, 4, 5, 5).astype(np.float32) * 3 + 1)
+    with autograd.record():
+        bn(x)
+    rm = bn.running_mean.data().asnumpy()
+    rv = bn.running_var.data().asnumpy()
+    mean = x.asnumpy().mean(axis=(0, 2, 3))
+    var = x.asnumpy().var(axis=(0, 2, 3))
+    assert_almost_equal(rm, 0.1 * mean, rtol=1e-3, atol=1e-3)
+    assert_almost_equal(rv, 0.9 + 0.1 * var, rtol=1e-3, atol=1e-3)
+    # eval mode uses running stats
+    out = bn(x).asnumpy()
+    expect = (x.asnumpy() - rm.reshape(1, -1, 1, 1)) / np.sqrt(rv.reshape(1, -1, 1, 1) + 1e-5)
+    assert_almost_equal(out, expect, rtol=1e-3, atol=1e-3)
+
+
+def test_dropout_modes():
+    d = nn.Dropout(0.5)
+    x = nd.array(np.random.rand(10, 10).astype(np.float32) + 1)
+    # eval: identity
+    assert_almost_equal(d(x).asnumpy(), x.asnumpy())
+    # train: some zeros
+    with autograd.record():
+        out = d(x).asnumpy()
+    assert (out == 0).sum() > 0
+
+
+def test_hybrid_dropout_fresh_randomness():
+    """Jitted dropout must not bake the mask as a constant."""
+    d = nn.Dropout(0.5)
+    d.hybridize()
+    x = nd.array(np.ones((100,), np.float32))
+    with autograd.record():
+        m1 = d(x).asnumpy()
+        m2 = d(x).asnumpy()
+    assert (m1 == 0).sum() > 10
+    assert not np.array_equal(m1, m2)
+
+
+def test_losses_numpy():
+    pred = np.random.rand(5, 4).astype(np.float32)
+    label_idx = np.array([0, 1, 2, 3, 0], dtype=np.float32)
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    out = loss(nd.array(pred), nd.array(label_idx)).asnumpy()
+    logp = pred - pred.max(1, keepdims=True)
+    logp = logp - np.log(np.exp(logp).sum(1, keepdims=True))
+    expect = -logp[np.arange(5), label_idx.astype(int)]
+    assert_almost_equal(out, expect, rtol=1e-4, atol=1e-5)
+
+    l2 = gluon.loss.L2Loss()
+    a, b = np.random.rand(4, 3).astype(np.float32), np.random.rand(4, 3).astype(np.float32)
+    assert_almost_equal(l2(nd.array(a), nd.array(b)).asnumpy(), (0.5 * (a - b) ** 2).mean(1), rtol=1e-5)
+
+    l1 = gluon.loss.L1Loss()
+    assert_almost_equal(l1(nd.array(a), nd.array(b)).asnumpy(), np.abs(a - b).mean(1), rtol=1e-5)
+
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    lbl = (np.random.rand(4, 3) > 0.5).astype(np.float32)
+    out = bce(nd.array(a), nd.array(lbl)).asnumpy()
+    p = 1 / (1 + np.exp(-a))
+    expect = -(lbl * np.log(p) + (1 - lbl) * np.log(1 - p)).mean(1)
+    assert_almost_equal(out, expect, rtol=1e-4, atol=1e-5)
+
+    hinge = gluon.loss.HingeLoss()
+    sl = np.sign(np.random.rand(4, 3).astype(np.float32) - 0.5)
+    out = hinge(nd.array(a), nd.array(sl)).asnumpy()
+    assert_almost_equal(out, np.maximum(0, 1 - a * sl).mean(1), rtol=1e-5)
+
+
+def test_trainer_convergence():
+    """Linear regression converges (reference test pattern: small real train)."""
+    w_true = np.array([[2.0, -3.4]], dtype=np.float32)
+    b_true = 4.2
+    xs = np.random.normal(size=(200, 2)).astype(np.float32)
+    ys = xs @ w_true.T + b_true
+
+    net = nn.Dense(1)
+    net.initialize(init=mx.init.Normal(0.1))
+    trainer = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    loss_fn = gluon.loss.L2Loss()
+    for _ in range(60):
+        for i in range(0, 200, 50):
+            x = nd.array(xs[i : i + 50])
+            y = nd.array(ys[i : i + 50])
+            with autograd.record():
+                l = loss_fn(net(x), y)
+            l.backward()
+            trainer.step(50)
+    w = net.weight.data().asnumpy()
+    b = net.bias.data().asnumpy()
+    assert_almost_equal(w, w_true, rtol=1e-2, atol=1e-2)
+    assert_almost_equal(b, np.array([b_true]), rtol=1e-2, atol=1e-2)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, in_units=4), nn.Dense(4, in_units=8))
+    net.initialize()
+    x = nd.array(np.random.rand(2, 4).astype(np.float32))
+    out1 = net(x).asnumpy()
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(8, in_units=4), nn.Dense(4, in_units=8))
+    net2.load_parameters(f)
+    out2 = net2(x).asnumpy()
+    assert_almost_equal(out1, out2)
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = nn.Dense(4, in_units=4)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.01})
+    x = nd.array(np.random.rand(2, 4).astype(np.float32))
+    with autograd.record():
+        l = net(x).sum()
+    l.backward()
+    tr.step(2)
+    f = str(tmp_path / "tr.states")
+    tr.save_states(f)
+    tr.load_states(f)
+    with autograd.record():
+        l = net(x).sum()
+    l.backward()
+    tr.step(2)
+
+
+def test_rnn_cell_vs_fused_lstm():
+    """Unrolled LSTMCell must match the fused lax.scan LSTM layer."""
+    T, N, I, H = 4, 2, 3, 5
+    x = np.random.rand(T, N, I).astype(np.float32)
+
+    layer = gluon.rnn.LSTM(H, input_size=I)
+    layer.initialize()
+    out_fused = layer(nd.array(x)).asnumpy()
+
+    cell = gluon.rnn.LSTMCell(H, input_size=I)
+    cell.initialize()
+    # copy fused layer params into the cell
+    lp = {k[len(layer.prefix):]: v for k, v in layer.collect_params().items()}
+    for name, p in cell.collect_params().items():
+        short = name[len(cell.prefix):]
+        p.set_data(lp["l0_" + short].data())
+    out_cell, _ = cell.unroll(T, nd.array(x), layout="TNC", merge_outputs=True)
+    assert_almost_equal(out_fused, out_cell.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_bidirectional_gru_shapes():
+    layer = gluon.rnn.GRU(7, num_layers=2, bidirectional=True, input_size=3)
+    layer.initialize()
+    x = nd.array(np.random.rand(6, 2, 3).astype(np.float32))
+    out, states = layer(x, layer.begin_state(2))
+    assert out.shape == (6, 2, 14)
+    assert states[0].shape == (4, 2, 7)
+
+
+def test_model_zoo_runs():
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.get_model("resnet18_v1", classes=10)
+    net.initialize()
+    x = nd.array(np.random.rand(1, 3, 32, 32).astype(np.float32))
+    assert net(x).shape == (1, 10)
+
+
+def test_dataloader_and_dataset():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    data = np.random.rand(20, 3).astype(np.float32)
+    label = np.arange(20, dtype=np.int32)
+    ds = ArrayDataset(data, label)
+    assert len(ds) == 20
+    dl = DataLoader(ds, batch_size=6, last_batch="keep")
+    batches = list(dl)
+    assert len(batches) == 4
+    assert batches[0][0].shape == (6, 3)
+    assert batches[-1][0].shape == (2, 3)
+
+    dl2 = DataLoader(ds, batch_size=6, last_batch="discard", num_workers=2)
+    assert len(list(dl2)) == 3
+
+    seen = np.concatenate([b[1].asnumpy() for b in dl])
+    assert np.array_equal(np.sort(seen), label)
+
+
+def test_dataset_transform():
+    from mxnet_tpu.gluon.data import ArrayDataset
+
+    ds = ArrayDataset(np.ones((4, 2), np.float32), np.zeros(4, np.int32))
+    ds2 = ds.transform_first(lambda x: x * 2)
+    x, y = ds2[0]
+    assert float(np.asarray(x).sum()) == 4.0
+
+
+def test_block_repr_and_collect():
+    net = nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3))
+    params = net.collect_params()
+    assert all(k.startswith("model_") for k in params.keys())
+    sel = net.collect_params(".*weight")
+    assert all("weight" in k for k in sel.keys())
+    repr(net)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    idx = nd.array(np.array([0, 3, 9], dtype=np.float32))
+    out = emb(idx)
+    assert out.shape == (3, 4)
+    w = emb.weight.data().asnumpy()
+    assert_almost_equal(out.asnumpy(), w[[0, 3, 9]])
+
+
+def test_conv_transpose_shape():
+    net = nn.Conv2DTranspose(4, kernel_size=4, strides=2, padding=1, in_channels=3)
+    net.initialize()
+    x = nd.array(np.random.rand(1, 3, 8, 8).astype(np.float32))
+    assert net(x).shape == (1, 4, 16, 16)
