@@ -498,7 +498,12 @@ class SymbolBlock(HybridBlock):
     exported models back into gluon."""
 
     def __init__(self, outputs, inputs, params=None):
-        super().__init__(prefix=None, params=None)
+        # empty prefix: parameters must keep the wrapped symbol's argument
+        # names so imports()+load() can match them (reference resets the
+        # prefix for SymbolBlock for the same reason).  A caller-supplied
+        # `params` dict is shared, so existing initialized Parameters are
+        # reused rather than shadowed by fresh deferred ones.
+        super().__init__(prefix="", params=params)
         from ..symbol.symbol import Symbol
         from .. import symbol as sym_mod
 

@@ -80,7 +80,8 @@ class DataLoader:
             futures = []
             it = iter(self._batch_sampler)
             try:
-                for _ in range(self._prefetch or self._num_workers):
+                # at least one batch must be in flight for the drain loop to run
+                for _ in range(max(1, self._prefetch)):
                     futures.append(pool.submit(self._load_batch, next(it)))
             except StopIteration:
                 pass

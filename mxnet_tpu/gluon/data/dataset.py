@@ -85,7 +85,8 @@ class ArrayDataset(Dataset):
             from ...ndarray.ndarray import NDArray
             import numpy as np
 
-            if isinstance(data, NDArray) and data.ndim == 1:
+            if isinstance(data, NDArray):
+                # one host copy up-front beats per-sample device slices in the loader
                 data = data.asnumpy()
             self._data.append(data)
 

@@ -66,6 +66,10 @@ class _Conv(HybridBlock):
         self._channels = channels
         self._in_channels = in_channels
         n = len(kernel_size)
+        spatial = "DHW"[-n:]
+        if layout is not None and layout not in ("NC" + spatial, "N" + spatial + "C"):
+            raise ValueError(f"invalid layout {layout!r} for {n}-d convolution")
+        channel_last = layout is not None and layout[1] != "C"
         self._kwargs = {
             "kernel": kernel_size,
             "stride": _tup(strides, n),
@@ -75,12 +79,18 @@ class _Conv(HybridBlock):
             "num_group": groups,
             "no_bias": not use_bias,
         }
+        if layout is not None:
+            self._kwargs["layout"] = layout
         if adj is not None:
             self._kwargs["adj"] = adj
         self._op_name = op_name
         with self.name_scope():
+            ic = in_channels // groups if in_channels else 0
             if op_name == "Convolution":
-                wshape = (channels, in_channels // groups if in_channels else 0) + tuple(kernel_size)
+                if channel_last:  # NHWC-family: weight (O, *k, I/g)
+                    wshape = (channels,) + tuple(kernel_size) + (ic,)
+                else:
+                    wshape = (channels, ic) + tuple(kernel_size)
             else:  # Deconvolution: (in, out/g, *k)
                 wshape = (in_channels if in_channels else 0, channels // groups) + tuple(kernel_size)
             self.weight = self.params.get(
@@ -183,10 +193,14 @@ class _Pooling(HybridBlock):
     """Shared pooling implementation (reference conv_layers.py:669)."""
 
     def __init__(self, pool_size, strides, padding, ceil_mode=False, global_pool=False,
-                 pool_type="max", count_include_pad=None, prefix=None, params=None):
+                 pool_type="max", count_include_pad=None, layout=None, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         if strides is None:
             strides = pool_size
+        n = len(pool_size)
+        spatial = "DHW"[-n:]
+        if layout is not None and layout not in ("NC" + spatial, "N" + spatial + "C"):
+            raise ValueError(f"invalid layout {layout!r} for {n}-d pooling")
         self._kwargs = {
             "kernel": pool_size,
             "stride": _tup(strides, len(pool_size)),
@@ -195,6 +209,8 @@ class _Pooling(HybridBlock):
             "pool_type": pool_type,
             "pooling_convention": "full" if ceil_mode else "valid",
         }
+        if layout is not None:
+            self._kwargs["layout"] = layout
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad
 
@@ -216,65 +232,65 @@ class _Pooling(HybridBlock):
 
 class MaxPool1D(_Pooling):
     def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW", ceil_mode=False, **kwargs):
-        super().__init__(_tup(pool_size, 1), strides, padding, ceil_mode, False, "max", **kwargs)
+        super().__init__(_tup(pool_size, 1), strides, padding, ceil_mode, False, "max", layout=layout, **kwargs)
 
 
 class MaxPool2D(_Pooling):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout="NCHW", ceil_mode=False, **kwargs):
-        super().__init__(_tup(pool_size, 2), strides, padding, ceil_mode, False, "max", **kwargs)
+        super().__init__(_tup(pool_size, 2), strides, padding, ceil_mode, False, "max", layout=layout, **kwargs)
 
 
 class MaxPool3D(_Pooling):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0, layout="NCDHW", ceil_mode=False, **kwargs):
-        super().__init__(_tup(pool_size, 3), strides, padding, ceil_mode, False, "max", **kwargs)
+        super().__init__(_tup(pool_size, 3), strides, padding, ceil_mode, False, "max", layout=layout, **kwargs)
 
 
 class AvgPool1D(_Pooling):
     def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW", ceil_mode=False,
                  count_include_pad=True, **kwargs):
-        super().__init__(_tup(pool_size, 1), strides, padding, ceil_mode, False, "avg", count_include_pad, **kwargs)
+        super().__init__(_tup(pool_size, 1), strides, padding, ceil_mode, False, "avg", count_include_pad, layout=layout, **kwargs)
 
 
 class AvgPool2D(_Pooling):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout="NCHW", ceil_mode=False,
                  count_include_pad=True, **kwargs):
-        super().__init__(_tup(pool_size, 2), strides, padding, ceil_mode, False, "avg", count_include_pad, **kwargs)
+        super().__init__(_tup(pool_size, 2), strides, padding, ceil_mode, False, "avg", count_include_pad, layout=layout, **kwargs)
 
 
 class AvgPool3D(_Pooling):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0, layout="NCDHW", ceil_mode=False,
                  count_include_pad=True, **kwargs):
-        super().__init__(_tup(pool_size, 3), strides, padding, ceil_mode, False, "avg", count_include_pad, **kwargs)
+        super().__init__(_tup(pool_size, 3), strides, padding, ceil_mode, False, "avg", count_include_pad, layout=layout, **kwargs)
 
 
 class GlobalMaxPool1D(_Pooling):
     def __init__(self, layout="NCW", **kwargs):
-        super().__init__((1,), None, 0, True, True, "max", **kwargs)
+        super().__init__((1,), None, 0, True, True, "max", layout=layout, **kwargs)
 
 
 class GlobalMaxPool2D(_Pooling):
     def __init__(self, layout="NCHW", **kwargs):
-        super().__init__((1, 1), None, 0, True, True, "max", **kwargs)
+        super().__init__((1, 1), None, 0, True, True, "max", layout=layout, **kwargs)
 
 
 class GlobalMaxPool3D(_Pooling):
     def __init__(self, layout="NCDHW", **kwargs):
-        super().__init__((1, 1, 1), None, 0, True, True, "max", **kwargs)
+        super().__init__((1, 1, 1), None, 0, True, True, "max", layout=layout, **kwargs)
 
 
 class GlobalAvgPool1D(_Pooling):
     def __init__(self, layout="NCW", **kwargs):
-        super().__init__((1,), None, 0, True, True, "avg", **kwargs)
+        super().__init__((1,), None, 0, True, True, "avg", layout=layout, **kwargs)
 
 
 class GlobalAvgPool2D(_Pooling):
     def __init__(self, layout="NCHW", **kwargs):
-        super().__init__((1, 1), None, 0, True, True, "avg", **kwargs)
+        super().__init__((1, 1), None, 0, True, True, "avg", layout=layout, **kwargs)
 
 
 class GlobalAvgPool3D(_Pooling):
     def __init__(self, layout="NCDHW", **kwargs):
-        super().__init__((1, 1, 1), None, 0, True, True, "avg", **kwargs)
+        super().__init__((1, 1, 1), None, 0, True, True, "avg", layout=layout, **kwargs)
 
 
 class ReflectionPad2D(HybridBlock):

@@ -134,7 +134,12 @@ class RecurrentCell(Block):
                 for ele_list in zip(*all_states)
             ]
             outputs = _mask_sequence_variable_length(F, outputs, length, valid_length, axis, True)
-        if merge_outputs is not False:
+        if merge_outputs is False:
+            # keep the documented list-of-steps contract even after masking
+            # merged the sequence into one tensor
+            if not isinstance(outputs, list):
+                outputs = list(outputs.split(length, axis=axis, squeeze_axis=True))
+        else:
             outputs = F.stack(*outputs, axis=axis) if isinstance(outputs, list) else outputs
         return outputs, states
 

@@ -41,7 +41,9 @@ class _RNNLayer(HybridBlock):
         self._h2h_weight_initializer = h2h_weight_initializer
         self._i2h_bias_initializer = i2h_bias_initializer
         self._h2h_bias_initializer = h2h_bias_initializer
-        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+        from ...ops.rnn import _GATES
+
+        self._gates = _GATES[mode]
         ng, ni, nh = self._gates, input_size, hidden_size
         with self.name_scope():
             for i in range(num_layers):

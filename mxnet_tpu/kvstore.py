@@ -1,0 +1,281 @@
+"""KVStore — API-compatible parameter store over XLA collectives.
+
+Reference: ``include/mxnet/kvstore.h:47`` (Push/Pull/Updater/Barrier),
+``python/mxnet/kvstore.py``, factory ``src/kvstore/kvstore.cc:40-72``.
+
+Design (SURVEY §5.8 north star): the KVStore *API* survives — init / push /
+pull / row_sparse_pull / set_updater / set_optimizer / rank / num_workers /
+barrier — but the *implementation* is collective, not RPC:
+
+- ``local`` / ``device`` / ``nccl`` — single-process aggregation.  The
+  reference reduced across explicit GPU buffers (``src/kvstore/comm.h:103,451``);
+  here multi-device reduction happens inside the jitted train step via
+  ``lax.psum`` (see ``mxnet_tpu.parallel``), so the store itself only has to
+  merge the per-call value lists.
+- ``dist_sync`` / ``dist_device_sync`` — every host pushes, values are summed
+  across processes over DCN (≡ ps-lite worker→server push + server merge,
+  ``src/kvstore/kvstore_dist_server.h:262-283``), every host pulls the sum.
+- ``dist_async`` — parameter-server-only semantics with no collective analog
+  (SURVEY §2.2); accepted as an alias of ``dist_sync`` with a warning.
+
+2-bit gradient compression with error feedback is implemented faithfully
+(reference ``src/kvstore/gradient_compression.h:52-131``): pushed values are
+quantized to {-threshold, 0, +threshold} with the quantization error carried
+into the next push.
+"""
+from __future__ import annotations
+
+import logging
+import pickle
+
+from .ndarray.ndarray import NDArray
+
+__all__ = ["KVStore", "create"]
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+class KVStore:
+    """In-process key→array store with collective aggregation semantics."""
+
+    def __init__(self, type_str="local"):
+        self._type = type_str
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression = None
+        self._residual = {}
+        self._barrier_count = 0
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        """Reference ``kvstore_dist.h:106`` — this worker's index."""
+        from .parallel import dist
+
+        return dist.rank() if self._is_dist else 0
+
+    @property
+    def num_workers(self):
+        from .parallel import dist
+
+        return dist.size() if self._is_dist else 1
+
+    @property
+    def _is_dist(self):
+        return "dist" in self._type
+
+    # -- core API ----------------------------------------------------------
+    def init(self, key, value):
+        """Register initial values.  Worker 0's value wins in dist mode
+        (reference ``KVStoreDist::InitImpl``, ``kvstore_dist.h:181``)."""
+        from . import ndarray as nd
+
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                raise ValueError(f"key {k} already initialized")
+            v = v.copy() if isinstance(v, NDArray) else nd.array(v)
+            if self._is_dist:
+                v = self._broadcast_from_zero(v)
+            self._store[k] = v
+
+    def push(self, key, value, priority=0):
+        """Aggregate ``value`` (or a per-device list) into the store.
+
+        Engine priorities (reference pushes with priority = −key to overlap
+        comm with backward) are unnecessary: XLA's latency-hiding scheduler
+        owns overlap; the argument is accepted for API parity.
+        """
+        keys, values = self._normalize_push(key, value)
+        for k, vlist in zip(keys, values):
+            self._check_init(k)
+            merged = self._merge(vlist)
+            if self._compression is not None:
+                merged = self._compress(k, merged)
+            if self._is_dist:
+                merged = self._cross_process_sum(merged)
+            if self._updater is not None:
+                self._updater(k, merged, self._store[k])
+            else:
+                self._store[k] = merged
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """Copy the stored value into every array of ``out``."""
+        assert out is not None, "pull requires out="
+        keys, outs = self._normalize_push(key, out)
+        for k, olist in zip(keys, outs):
+            self._check_init(k)
+            src = self._store[k]
+            for o in olist:
+                o._rebind(src._data)
+        return out
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        self.pull(key, out if out is not None else value, priority)
+
+    def broadcast(self, key, value, out=None, priority=0):
+        self.init(key, value)
+        if out is not None:
+            self.pull(key, out=out, priority=priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Dense-backed row_sparse pull: gathers the requested rows.
+
+        The reference pulls only the rows named by ``row_ids``
+        (``python/mxnet/kvstore.py:307``); storage here is dense (BCOO is a
+        non-goal for the detection workloads, SURVEY §7.3) so this selects
+        rows from the dense table with the same call signature.
+        """
+        assert out is not None and row_ids is not None
+        keys, outs = self._normalize_push(key, out)
+        rids = _as_list(row_ids)
+        if len(rids) not in (1, len(keys)):
+            raise ValueError("row_ids must be one id set or one per key")
+        from . import ndarray as nd
+
+        for i, (k, olist) in enumerate(zip(keys, outs)):
+            self._check_init(k)
+            src = self._store[k]
+            rid = rids[0] if len(rids) == 1 else rids[i]
+            for o in olist:
+                rows = nd.take(src, rid, axis=0)
+                o._rebind(rows._data)
+        return out
+
+    # -- updater / optimizer ----------------------------------------------
+    def set_updater(self, updater):
+        """Install fn(key, recv, stored) applied at push (reference
+        ``KVStore::set_updater``, ``kvstore.h``)."""
+        self._updater = updater
+
+    def set_optimizer(self, optimizer):
+        """Run ``optimizer`` inside the store (reference pickles it to the
+        servers, ``python/mxnet/kvstore.py:443,609``; here the 'server' is
+        this process).  Round-trips through pickle to keep the same contract."""
+        from . import optimizer as opt_mod
+
+        optimizer = pickle.loads(pickle.dumps(optimizer))
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        params = dict(compression_params)
+        ctype = params.get("type", "2bit")
+        if ctype != "2bit":
+            raise ValueError("only 2bit compression is supported (as in reference)")
+        self._compression = {"type": ctype, "threshold": float(params.get("threshold", 0.5))}
+
+    # -- synchronization ---------------------------------------------------
+    def barrier(self):
+        from .parallel import dist
+
+        if self._is_dist:
+            self._barrier_count += 1
+            dist.barrier(f"kv_barrier_{self._barrier_count}")
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "no updater/optimizer attached"
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer=dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "no updater/optimizer attached"
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    # -- internals ---------------------------------------------------------
+    def _check_init(self, k):
+        if k not in self._store:
+            raise KeyError(f"key {k} has not been initialized")
+
+    @staticmethod
+    def _normalize(key, value):
+        keys = _as_list(key)
+        values = _as_list(value) if isinstance(key, (list, tuple)) else [value]
+        assert len(keys) == len(values), "mismatched keys/values"
+        return [str(k) for k in keys], values
+
+    @staticmethod
+    def _normalize_push(key, value):
+        """Returns (keys, list-of-value-lists).  A flat list of values for one
+        key means per-device replicas to be merged (reference key-grouping,
+        ``kvstore_local.h:250-268``)."""
+        if isinstance(key, (list, tuple)):
+            keys = [str(k) for k in key]
+            vals = list(value)
+            if len(vals) != len(keys):
+                # one flat list covering all keys, len multiple of #keys
+                assert len(vals) % len(keys) == 0
+                per = len(vals) // len(keys)
+                vals = [vals[i * per : (i + 1) * per] for i in range(len(keys))]
+            else:
+                vals = [_as_list(v) for v in vals]
+            return keys, vals
+        return [str(key)], [_as_list(value)]
+
+    @staticmethod
+    def _merge(vlist):
+        merged = vlist[0]
+        for v in vlist[1:]:
+            merged = merged + v
+        return merged if merged is not vlist[0] else merged.copy()
+
+    def _compress(self, k, merged):
+        """2-bit quantization with error feedback
+        (reference ``gradient_compression.h:79-131``)."""
+        import jax.numpy as jnp
+
+        thr = self._compression["threshold"]
+        resid = self._residual.get(k)
+        x = merged._data + (resid if resid is not None else 0.0)
+        q = jnp.where(x >= thr, thr, jnp.where(x <= -thr, -thr, 0.0)).astype(x.dtype)
+        self._residual[k] = x - q
+        return NDArray(q)
+
+    @staticmethod
+    def _broadcast_from_zero(v):
+        """Worker 0's value wins at init (reference KVStoreDist::InitImpl,
+        ``kvstore_dist.h:181``) — keeps replicas bit-identical from step 0."""
+        import jax
+
+        if jax.process_count() == 1:
+            return v
+        from jax.experimental import multihost_utils
+
+        return NDArray(multihost_utils.broadcast_one_to_all(v._data))
+
+    @staticmethod
+    def _cross_process_sum(merged):
+        import jax
+
+        if jax.process_count() == 1:
+            return merged
+        from jax.experimental import multihost_utils
+
+        total = multihost_utils.process_allgather(merged._data).sum(axis=0)
+        return NDArray(total)
+
+
+def create(name="local"):
+    """Factory (reference ``src/kvstore/kvstore.cc:40-72``)."""
+    known = ("local", "device", "nccl", "dist_sync", "dist_device_sync", "dist_async")
+    if name not in known:
+        raise ValueError(f"unknown KVStore type {name!r}; expected one of {known}")
+    if name == "dist_async":
+        logging.warning(
+            "dist_async has parameter-server-only semantics with no collective "
+            "analog (SURVEY §2.2); using synchronous aggregation."
+        )
+    if name.startswith("dist"):
+        from .parallel import dist
+
+        dist.init()
+    return KVStore(name)

@@ -99,7 +99,9 @@ def _invoke(opdef, args, kwargs):
             extras = args[len(opdef.arg_names) :]
             args = args[: len(opdef.arg_names)]
             free_attrs = [a for a in opdef.attr_names if a not in kwargs]
-            if len(extras) > len(free_attrs) or any(isinstance(e, NDArray) for e in extras):
+            if len(extras) > len(free_attrs) or any(
+                isinstance(e, NDArray) or getattr(e, "ndim", 0) > 0 for e in extras
+            ):
                 raise TypeError(
                     "%s takes at most %d tensor arguments (%d given)"
                     % (opdef.name, len(opdef.arg_names), len(args) + len(extras))

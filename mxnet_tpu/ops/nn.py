@@ -63,9 +63,17 @@ def fully_connected(data, weight, bias=None, *, num_hidden, no_bias=False, flatt
     return out
 
 
-def _conv_dims(kernel_ndim):
+def _conv_dims(kernel_ndim, layout=None):
     spatial = "DHW"[-kernel_ndim:]
-    return ("NC" + spatial, "OI" + spatial, "NC" + spatial)
+    if layout is None:
+        layout = "NC" + spatial
+    if layout not in ("NC" + spatial, "N" + spatial + "C"):
+        raise ValueError(f"bad conv layout {layout!r} for {kernel_ndim}-d kernel")
+    if layout[1] == "C":  # channel-first: weight (O, I/g, *k)
+        rhs = "OI" + layout[2:]
+    else:  # channel-last (NHWC et al): weight (O, *k, I/g) — TPU-friendly
+        rhs = "O" + layout[1:-1] + "I"
+    return (layout, rhs, layout)
 
 
 def _conv_params(attrs, shapes):
@@ -74,6 +82,9 @@ def _conv_params(attrs, shapes):
     k = (k,) if isinstance(k, int) else tuple(k)
     g = attrs.get("num_group", 1)
     nf = attrs["num_filter"]
+    layout = attrs.get("layout")
+    if layout and layout[1] != "C":  # channel-last
+        return {"weight": (nf,) + k + (d[-1] // g,), "bias": (nf,)}
     return {"weight": (nf, d[1] // g) + k, "bias": (nf,)}
 
 
@@ -106,7 +117,7 @@ def convolution(
     stride = _tup(stride, n)
     dilate = _tup(dilate, n)
     pad = _tup(pad, n) if pad is not None else (0,) * n
-    dn = jax.lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dims(n))
+    dn = jax.lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dims(n, layout))
     out = jax.lax.conv_general_dilated(
         data,
         weight,
@@ -120,7 +131,10 @@ def convolution(
     if out.dtype != data.dtype:
         out = out.astype(data.dtype)
     if not no_bias and bias is not None:
-        out = out + bias.reshape((1, -1) + (1,) * n)
+        c_axis = (layout or "NC").index("C")
+        bshape = [1] * out.ndim
+        bshape[c_axis] = -1
+        out = out + bias.reshape(bshape)
     return out
 
 
@@ -164,6 +178,8 @@ def deconvolution(
     """
     kernel = tuple(kernel)
     n = len(kernel)
+    if layout is not None and layout[1] != "C":
+        raise NotImplementedError("Deconvolution supports channel-first layouts only")
     stride = _tup(stride, n)
     dilate = _tup(dilate, n)
     pad = _tup(pad, n) if pad is not None else (0,) * n
@@ -222,12 +238,17 @@ def pooling(
     (ceil division, reference pool.h) is realized with extra right-padding.
     """
     n = data.ndim - 2
+    channel_last = layout is not None and len(layout) > 1 and layout[1] != "C"
     if global_pool:
-        ax = tuple(range(2, data.ndim))
+        ax = tuple(range(1, 1 + n)) if channel_last else tuple(range(2, data.ndim))
         if pool_type == "max":
             return jnp.max(data, axis=ax, keepdims=True)
-        if pool_type in ("avg", "lp"):
+        if pool_type == "avg":
             return jnp.mean(data, axis=ax, keepdims=True)
+        if pool_type == "lp":
+            p_ = float(p_value)
+            s = jnp.sum(jnp.abs(data.astype(jnp.float32)) ** p_, axis=ax, keepdims=True)
+            return (s ** (1.0 / p_)).astype(data.dtype)
         return jnp.sum(data, axis=ax, keepdims=True)
     kernel = _tup(kernel, n)
     stride = _tup(stride, n)
@@ -237,14 +258,19 @@ def pooling(
         lo = p
         hi = p
         if pooling_convention == "full":
-            x = data.shape[2 + i]
+            x = data.shape[(1 if channel_last else 2) + i]
             out_sz = int(np.ceil((x + 2 * p - k) / s)) + 1
             needed = (out_sz - 1) * s + k - (x + 2 * p)
             hi = p + max(needed, 0)
         pads.append((lo, hi))
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
-    padding = [(0, 0), (0, 0)] + pads
+    if channel_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        padding = [(0, 0)] + pads + [(0, 0)]
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        padding = [(0, 0), (0, 0)] + pads
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
         return jax.lax.reduce_window(data, init, jax.lax.max, window, strides, padding)

@@ -58,7 +58,7 @@ def _unpack_params(params, mode, input_size, hidden, num_layers, d):
     return [w + b for w, b in zip(ws, bs)]
 
 
-def _step_fn(mode, hidden):
+def _step_fn(mode, hidden, clip_min=None, clip_max=None, clip_nan=False):
     if mode == "lstm":
 
         def step(carry, x_gates, wh, bh):
@@ -68,6 +68,12 @@ def _step_fn(mode, hidden):
             i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
             g = jnp.tanh(g)
             c = f * c + i * g
+            # per-step cell clipping (reference cudnn_rnn-inl.h state clip):
+            # must happen inside the scan or long sequences still diverge
+            if clip_min is not None and clip_max is not None:
+                if clip_nan:
+                    c = jnp.nan_to_num(c, nan=0.0)
+                c = jnp.clip(c, clip_min, clip_max)
             h = o * jnp.tanh(c)
             return (h, c), h
 
@@ -97,12 +103,13 @@ def _step_fn(mode, hidden):
     return step
 
 
-def _run_layer(x, h0, c0, wi, wh, bi, bh, mode, hidden, reverse):
+def _run_layer(x, h0, c0, wi, wh, bi, bh, mode, hidden, reverse,
+               clip_min=None, clip_max=None, clip_nan=False):
     """One direction of one layer over the full sequence.  x: (T, N, I)."""
     # hoist the input projection out of the scan: one big MXU matmul (T*N, I)
     t, n, isz = x.shape
     x_gates = (x.reshape(t * n, isz) @ wi.T + bi).reshape(t, n, -1)
-    step = _step_fn(mode, hidden)
+    step = _step_fn(mode, hidden, clip_min, clip_max, clip_nan)
     carry = (h0, c0) if mode == "lstm" else (h0,)
 
     def body(carry, xg):
@@ -156,14 +163,15 @@ def rnn(
             wi, wh, bi, bh = layers[li]
             h0 = state[li]
             c0 = state_cell[li] if mode == "lstm" else None
-            ys, carry = _run_layer(x, h0, c0, wi, wh, bi, bh, mode, hidden, reverse=direction == 1)
+            ys, carry = _run_layer(
+                x, h0, c0, wi, wh, bi, bh, mode, hidden, reverse=direction == 1,
+                clip_min=lstm_state_clip_min, clip_max=lstm_state_clip_max,
+                clip_nan=lstm_state_clip_nan,
+            )
             outs.append(ys)
             h_finals.append(carry[0])
             if mode == "lstm":
-                c = carry[1]
-                if lstm_state_clip_min is not None and lstm_state_clip_max is not None:
-                    c = jnp.clip(c, lstm_state_clip_min, lstm_state_clip_max)
-                c_finals.append(c)
+                c_finals.append(carry[1])
         x = outs[0] if d == 1 else jnp.concatenate(outs, axis=-1)
         if p > 0 and training and layer < num_layers - 1 and key is not None:
             keep = jax.random.bernoulli(jax.random.fold_in(key, layer), 1 - p, x.shape)

@@ -1,0 +1,51 @@
+"""Parallelism primitives — the TPU-native replacement for the reference's
+device-placement + KVStore machinery (SURVEY §2.2, §5.8).
+
+The reference scales by slicing batches across explicit device contexts
+(``python/mxnet/module/executor_group.py:143``) and reducing gradients through
+a KVStore backed by ps-lite / NCCL (``src/kvstore/``).  Here scaling is
+declarative: pick a :class:`jax.sharding.Mesh`, annotate array shardings, and
+XLA inserts the collectives over ICI/DCN.
+
+Public surface:
+- :func:`make_mesh` / :func:`current_mesh` — named device meshes (dp/tp/pp/sp/ep axes)
+- :func:`shard` / :func:`replicate` — NamedSharding helpers
+- :func:`allreduce` / :func:`allgather` — pytree collectives usable inside shard_map
+- :mod:`mxnet_tpu.parallel.dist` — multi-host bootstrap (jax.distributed), the
+  replacement for ``tools/launch.py`` + dmlc tracker roles
+- :mod:`mxnet_tpu.parallel.ring` — ring attention (sequence/context parallelism)
+"""
+from .mesh import (
+    make_mesh,
+    current_mesh,
+    default_mesh,
+    set_default_mesh,
+    shard,
+    replicate,
+    named_sharding,
+    shard_params,
+    local_mesh_devices,
+)
+from .collectives import allreduce, allgather, reduce_scatter, pmean, psum_scatter
+from . import dist
+from .ring import ring_attention, ring_self_attention
+
+__all__ = [
+    "make_mesh",
+    "current_mesh",
+    "default_mesh",
+    "set_default_mesh",
+    "shard",
+    "replicate",
+    "named_sharding",
+    "shard_params",
+    "local_mesh_devices",
+    "allreduce",
+    "allgather",
+    "reduce_scatter",
+    "pmean",
+    "psum_scatter",
+    "dist",
+    "ring_attention",
+    "ring_self_attention",
+]

@@ -1,0 +1,55 @@
+"""Pytree collectives — the XLA replacement for the reference's Comm tree
+(``src/kvstore/comm.h:43``: CommCPU host reduce, CommDevice GPU P2P reduce)
+and the NCCL kvstore (``src/kvstore/kvstore_nccl.h``).
+
+Inside ``shard_map``/``pjit`` these lower to ICI collectives; outside a mapped
+context they fall back to identity (single-replica), mirroring how the
+reference's ``local`` kvstore degenerates on one device.
+"""
+from __future__ import annotations
+
+__all__ = ["allreduce", "pmean", "allgather", "reduce_scatter", "psum_scatter"]
+
+
+def _tree_map(fn, tree):
+    import jax
+
+    return jax.tree_util.tree_map(fn, tree)
+
+
+def allreduce(tree, axis_name="dp"):
+    """Sum each leaf over ``axis_name``.  ≡ KVStore push+pull of every key
+    (reference ``kvstore_dist.h:202,208``) collapsed into one fused collective."""
+    import jax
+
+    return _tree_map(lambda x: jax.lax.psum(x, axis_name), tree)
+
+
+def pmean(tree, axis_name="dp"):
+    """Mean over ``axis_name`` — the gradient-averaging step of dist_sync."""
+    import jax
+
+    return _tree_map(lambda x: jax.lax.pmean(x, axis_name), tree)
+
+
+def allgather(tree, axis_name="dp", axis=0, tiled=True):
+    """Gather shards along ``axis`` from every member of ``axis_name``."""
+    import jax
+
+    return _tree_map(
+        lambda x: jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled), tree
+    )
+
+
+def reduce_scatter(tree, axis_name="dp", axis=0):
+    """Sum then scatter along ``axis`` — the bandwidth-optimal half of an
+    allreduce; use with ZeRO-style sharded optimizer states."""
+    import jax
+
+    return _tree_map(
+        lambda x: jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True),
+        tree,
+    )
+
+
+psum_scatter = reduce_scatter

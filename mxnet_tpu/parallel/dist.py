@@ -1,0 +1,94 @@
+"""Multi-host bootstrap — replaces ``tools/launch.py`` + dmlc tracker.
+
+The reference spawns scheduler/server/worker roles over ssh/mpi/yarn and wires
+them through ps-lite (``src/kvstore/kvstore_dist.h:50-55``, SURVEY §3.5).  The
+TPU-native design has no parameter servers: every host is a worker, and
+``jax.distributed.initialize`` + DCN collectives replace the tracker and RPC.
+
+Environment contract (mirrors the reference's DMLC_* env protocol):
+  MXNET_COORDINATOR  — "host:port" of process 0 (≡ scheduler address)
+  MXNET_NUM_WORKERS  — total process count (≡ DMLC_NUM_WORKER)
+  MXNET_WORKER_RANK  — this process's rank   (≡ DMLC_RANK)
+Standard TPU-pod env (Cloud TPU metadata) is auto-detected by JAX when these
+are absent, so on real pods ``init()`` with no args is enough.
+"""
+from __future__ import annotations
+
+import os
+
+_initialized = False
+
+
+def init(coordinator_address=None, num_processes=None, process_id=None, **kw):
+    """Initialize multi-host JAX.  Idempotent; no-op in single-process runs
+    unless coordinator env/args are present."""
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get("MXNET_COORDINATOR")
+    if num_processes is None and "MXNET_NUM_WORKERS" in os.environ:
+        num_processes = int(os.environ["MXNET_NUM_WORKERS"])
+    if process_id is None and "MXNET_WORKER_RANK" in os.environ:
+        process_id = int(os.environ["MXNET_WORKER_RANK"])
+    if coordinator_address is None and num_processes is None:
+        # single-host; jax.distributed not needed
+        _initialized = True
+        return
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kw,
+    )
+    _initialized = True
+
+
+def rank():
+    """This host's index (reference ``KVStore.rank``, ``kvstore_dist.h:106``)."""
+    import jax
+
+    return jax.process_index()
+
+
+def size():
+    """Number of hosts (reference ``KVStore.num_workers``)."""
+    import jax
+
+    return jax.process_count()
+
+
+def is_coordinator():
+    return rank() == 0
+
+
+def barrier(name="mxnet_barrier", timeout_ms=120_000):
+    """Block until every process arrives (reference ``KVStore::Barrier``,
+    ``kvstore_dist.h:96``).  Uses the distributed KV client when multi-host;
+    trivially returns single-host."""
+    import jax
+
+    if jax.process_count() == 1:
+        return
+    try:
+        client = jax._src.distributed.global_state.client
+        client.wait_at_barrier(name, timeout_ms)
+    except Exception:
+        # fall back to a device-level sync: a tiny psum across all hosts
+        import jax.numpy as jnp
+
+        jax.block_until_ready(
+            jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(
+                jnp.ones((jax.local_device_count(),))
+            )
+        )
+
+
+def shutdown():
+    global _initialized
+    import jax
+
+    if _initialized and jax.process_count() > 1:
+        jax.distributed.shutdown()
+    _initialized = False

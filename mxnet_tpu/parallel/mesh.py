@@ -1,0 +1,154 @@
+"""Named device meshes and sharding helpers.
+
+Replaces the reference's manual device placement (``group2ctx`` →
+``nnvm::pass::PlaceDevice``, ``src/executor/graph_executor.cc:407``) and the
+executor-group batch slicing (``python/mxnet/module/executor_group.py:143``)
+with declarative ``jax.sharding`` over a named mesh.  Axis names follow the
+scaling-book convention: ``dp`` (data), ``tp`` (tensor/model), ``pp``
+(pipeline), ``sp`` (sequence/context), ``ep`` (expert).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+AXIS_ORDER = ("pp", "dp", "sp", "ep", "tp")
+
+_state = threading.local()
+
+
+def local_mesh_devices(platform=None):
+    """All addressable devices, in process-stable order."""
+    import jax
+
+    if platform:
+        return jax.devices(platform)
+    return jax.devices()
+
+
+def make_mesh(axes=None, devices=None, **axis_sizes):
+    """Create a named :class:`jax.sharding.Mesh`.
+
+    ``make_mesh()`` → 1-D data-parallel mesh over every device.
+    ``make_mesh(dp=2, tp=4)`` → 2×4 mesh with named axes.
+    ``make_mesh({"dp": 2, "tp": 4})`` → same.
+    Axis sizes of ``-1`` are inferred from the device count.
+    Axes are laid out in :data:`AXIS_ORDER` so that the innermost (fastest
+    varying, most bandwidth-hungry) axis ``tp`` lands on adjacent devices —
+    collectives ride ICI, not DCN (SURVEY §5.8 north star).
+    """
+    from jax.sharding import Mesh
+
+    if isinstance(axes, dict):
+        axis_sizes = dict(axes, **axis_sizes)
+        axes = None
+    if axes is not None and not axis_sizes:
+        # sequence of (name, size) pairs
+        axis_sizes = dict(axes)
+
+    devices = list(devices if devices is not None else local_mesh_devices())
+    n = len(devices)
+    if not axis_sizes:
+        axis_sizes = {"dp": n}
+
+    # order axes canonically, keep user-given axes not in AXIS_ORDER at the end
+    names = [a for a in AXIS_ORDER if a in axis_sizes]
+    names += [a for a in axis_sizes if a not in AXIS_ORDER]
+
+    sizes = [axis_sizes[a] for a in names]
+    n_infer = sizes.count(-1)
+    if n_infer > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    if n_infer:
+        known = int(np.prod([s for s in sizes if s != -1])) if len(sizes) > 1 else 1
+        if n % known:
+            raise ValueError(f"cannot infer axis size: {n} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes))
+    if total > n:
+        raise ValueError(f"mesh of size {total} exceeds {n} available devices")
+    dev_array = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(dev_array, tuple(names))
+
+
+def set_default_mesh(mesh):
+    """Install ``mesh`` as the process default (returned by current_mesh())."""
+    _state.default = mesh
+    return mesh
+
+
+def default_mesh():
+    """The process-default mesh, creating a 1-D dp mesh on first use."""
+    mesh = getattr(_state, "default", None)
+    if mesh is None:
+        mesh = set_default_mesh(make_mesh())
+    return mesh
+
+
+def current_mesh():
+    """The innermost active ``with mesh:`` scope, else the process default."""
+    import jax
+
+    try:
+        env_mesh = jax._src.mesh.thread_resources.env.physical_mesh  # active `with Mesh` scope
+        if env_mesh is not None and not env_mesh.empty:
+            return env_mesh
+    except Exception:
+        pass
+    return default_mesh()
+
+
+def named_sharding(mesh, *spec):
+    """``NamedSharding(mesh, PartitionSpec(*spec))`` with None passthrough."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def shard(x, spec, mesh=None):
+    """Place ``x`` on ``mesh`` with partition ``spec`` (tuple of axis names/None).
+
+    Works on NDArray, jax.Array, or numpy; returns the same kind it got.
+    """
+    import jax
+
+    mesh = mesh or current_mesh()
+    sh = named_sharding(mesh, *(spec if isinstance(spec, (list, tuple)) else (spec,)))
+    from ..ndarray.ndarray import NDArray
+
+    if isinstance(x, NDArray):
+        return NDArray(jax.device_put(x._data, sh), ctx=x._ctx)
+    return jax.device_put(x, sh)
+
+
+def replicate(x, mesh=None):
+    """Fully replicate ``x`` over the mesh."""
+    return shard(x, (), mesh=mesh)
+
+
+def shard_params(params, mesh=None, rules=None):
+    """Shard a dict/pytree of parameters by name-matching rules.
+
+    ``rules`` is a list of ``(substring, spec)`` pairs checked in order; the
+    first match wins, default is full replication (pure data parallelism —
+    the reference's only mode, SURVEY §2.2).  This is the declarative
+    equivalent of KVStore key-sharding (``EncodeDefaultKey``,
+    ``src/kvstore/kvstore_dist.h:522``).
+    """
+    import jax
+
+    mesh = mesh or current_mesh()
+    rules = rules or []
+
+    def place(path, v):
+        for substr, spec in rules:
+            if substr in path:
+                return shard(v, spec, mesh)
+        return replicate(v, mesh)
+
+    if isinstance(params, dict):
+        return {k: place(k, v) for k, v in params.items()}
+    flat, tree = jax.tree_util.tree_flatten_with_path(params)
+    out = [place(jax.tree_util.keystr(path), v) for path, v in flat]
+    return jax.tree_util.tree_unflatten(tree, out)

@@ -1,0 +1,9 @@
+"""shard_map import shim — jax.shard_map (≥0.8) vs jax.experimental.shard_map."""
+from __future__ import annotations
+
+try:
+    from jax import shard_map  # jax ≥ 0.8
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+__all__ = ["shard_map"]
