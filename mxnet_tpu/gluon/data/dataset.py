@@ -78,25 +78,37 @@ class ArrayDataset(Dataset):
         assert len(args) > 0
         self._length = len(args[0])
         self._data = []
+        self._was_ndarray = []
         for i, data in enumerate(args):
             assert len(data) == self._length, (
                 "All arrays must have the same length; got %d vs %d at %d" % (len(data), self._length, i)
             )
             from ...ndarray.ndarray import NDArray
-            import numpy as np
 
-            if isinstance(data, NDArray):
-                # one host copy up-front beats per-sample device slices in the loader
+            was_nd = isinstance(data, NDArray)
+            if was_nd:
+                # one host copy up-front beats per-sample device slices in the
+                # loader; samples are re-wrapped as CPU NDArrays in __getitem__
+                # to keep the reference's NDArray-sample API
                 data = data.asnumpy()
+            self._was_ndarray.append(was_nd and data.ndim > 1)
             self._data.append(data)
 
     def __len__(self):
         return self._length
 
+    def _fetch(self, col, idx):
+        sample = self._data[col][idx]
+        if self._was_ndarray[col]:
+            from ... import context, ndarray as nd
+
+            return nd.array(sample, ctx=context.cpu())
+        return sample
+
     def __getitem__(self, idx):
         if len(self._data) == 1:
-            return self._data[0][idx]
-        return tuple(d[idx] for d in self._data)
+            return self._fetch(0, idx)
+        return tuple(self._fetch(c, idx) for c in range(len(self._data)))
 
 
 class RecordFileDataset(Dataset):

@@ -157,6 +157,8 @@ class _ConvTranspose(_Conv):
     def __init__(self, channels, kernel_size, strides, padding, output_padding, dilation,
                  groups, layout, in_channels=0, activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros", **kwargs):
+        if layout is not None and len(layout) > 1 and layout[1] != "C":
+            raise ValueError("Conv*DTranspose supports channel-first layouts only, got %r" % layout)
         super().__init__(channels, kernel_size, strides, padding, dilation, groups, layout,
                          in_channels, activation, use_bias, weight_initializer, bias_initializer,
                          op_name="Deconvolution", adj=output_padding, **kwargs)

@@ -1,0 +1,27 @@
+"""Module package — the symbolic training API.
+
+Reference ``python/mxnet/module/``: BaseModule.fit drives the whole reference
+training loop (``base_module.py:399``); Module binds a symbol into executors
+(``module.py:364``); BucketingModule handles variable-length sequences.
+
+TPU-native redesign: the reference's ``DataParallelExecutorGroup`` (one
+executor per GPU, host-side batch slicing, ``executor_group.py:143``) is
+replaced by ONE jit executor whose arrays can be sharded over a
+``jax.sharding`` mesh — data parallelism is a sharding annotation, not an
+executor list.  Shape changes re-jit under a shape-signature cache, which is
+exactly the reference's bucketing/MutableModule re-bind semantics.
+"""
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule
+from .sequential_module import SequentialModule
+from .python_module import PythonModule, PythonLossModule
+
+__all__ = [
+    "BaseModule",
+    "Module",
+    "BucketingModule",
+    "SequentialModule",
+    "PythonModule",
+    "PythonLossModule",
+]

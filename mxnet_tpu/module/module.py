@@ -1,0 +1,405 @@
+"""Module — the concrete symbolic training module.
+
+Reference ``python/mxnet/module/module.py`` (bind ``:364``, init_optimizer
+``:473``, forward ``:572``, update ``:643``, save_checkpoint ``:165``).
+
+One jit Executor replaces the reference's per-device executor group; shape
+changes re-bind (re-jit) exactly like the reference's MutableModule.  Data
+parallelism: pass ``mesh=`` (a ``jax.sharding.Mesh`` with a ``dp`` axis) and
+every batch is sharded over it while params stay replicated — the XLA
+equivalent of DataParallelExecutorGroup + kvstore 'device'
+(``executor_group.py:143``, ``comm.h:451``).
+"""
+from __future__ import annotations
+
+import logging
+
+from .. import ndarray as nd
+from .. import optimizer as opt_mod
+from ..io import DataDesc
+from ..model import (
+    _create_kvstore,
+    _initialize_kvstore,
+    _update_params,
+    _update_params_on_kvstore,
+    load_checkpoint,
+    save_checkpoint,
+)
+from .base_module import BaseModule, _check_input_names
+
+__all__ = ["Module"]
+
+
+def _as_descs(shapes):
+    if shapes is None:
+        return None
+    out = []
+    for s in shapes:
+        if isinstance(s, DataDesc):
+            out.append(s)
+        else:
+            name, shape = s[0], s[1]
+            out.append(DataDesc(name, shape))
+    return out
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None, mesh=None):
+        super().__init__(logger=logger)
+        self._symbol = symbol
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._state_names = list(state_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
+        self._context = context
+        self._mesh = mesh
+        _check_input_names(symbol, self._data_names, "data", True)
+        _check_input_names(symbol, self._label_names, "label", False)
+        _check_input_names(symbol, self._state_names, "state", True)
+        _check_input_names(symbol, self._fixed_param_names, "fixed_param", True)
+
+        arg_names = symbol.list_arguments()
+        input_names = set(self._data_names + self._label_names + self._state_names)
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+
+        self._arg_params = None
+        self._aux_params = None
+        self._exec = None
+        self._data_shapes = None
+        self._label_shapes = None
+        self._optimizer = None
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._updater = None
+        self._preload_opt_states = None
+        self._grad_req = "write"
+
+    # -- properties ----------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return [(n, o.shape) for n, o in zip(self._output_names, self._exec.outputs)] if self._exec.outputs else None
+
+    # -- params ---------------------------------------------------------------
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        self._sync_params_from_exec()
+        return dict(self._arg_params), dict(self._aux_params)
+
+    def _sync_params_from_exec(self):
+        if self._exec is None:
+            return
+        for n in self._param_names:
+            self._arg_params[n] = self._exec.arg_dict[n]
+        for n in self._aux_names:
+            self._aux_params[n] = self._exec.aux_dict[n]
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        """Reference module.py init_params — initializer fills anything not
+        supplied by arg_params/aux_params."""
+        assert self.binded, "call bind before initializing the parameters"
+        if self.params_initialized and not force_init:
+            return
+        from ..initializer import Uniform, InitDesc
+
+        initializer = initializer if initializer is not None else Uniform(0.01)
+
+        def _impl(name, arr, cache):
+            if cache is not None and name in cache:
+                cached = cache[name]
+                if cached is not arr:
+                    if cached.shape != arr.shape:
+                        raise ValueError(
+                            "shape mismatch for %s: loaded %s vs expected %s"
+                            % (name, cached.shape, arr.shape)
+                        )
+                    arr._rebind(cached._data)
+            else:
+                if cache is not None and not allow_missing:
+                    raise RuntimeError("%s is not presented" % name)
+                if initializer is not None:
+                    initializer(InitDesc(name), arr)
+
+        # Module.load pre-populates _arg_params; use them as the cache
+        if arg_params is None and self._arg_params:
+            arg_params = self._arg_params
+            allow_missing = True
+        if aux_params is None and self._aux_params:
+            aux_params = self._aux_params
+            allow_missing = True
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            _impl(name, arr, arg_params)
+        for name in self._aux_names:
+            arr = self._exec.aux_dict[name]
+            _impl(name, arr, aux_params)
+
+        if arg_params is not None and not allow_extra:
+            for name in arg_params:
+                if name not in self._param_names and name not in self._data_names + self._label_names:
+                    raise ValueError("provided arg_params %s not found in symbol" % name)
+
+        self._arg_params = {n: self._exec.arg_dict[n] for n in self._param_names}
+        self._aux_params = {n: self._exec.aux_dict[n] for n in self._aux_names}
+        self.params_initialized = True
+
+    # -- bind -----------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None, grad_req="write"):
+        if force_rebind:
+            self._exec = None
+            self.binded = False
+        if self.binded:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self._grad_req = grad_req
+        assert not (not for_training and inputs_need_grad)
+
+        self._data_shapes = _as_descs(data_shapes)
+        self._label_shapes = _as_descs(label_shapes)
+
+        shape_dict = {d.name: d.shape for d in self._data_shapes}
+        if self._label_shapes:
+            shape_dict.update({d.name: d.shape for d in self._label_shapes})
+
+        arg_names = self._symbol.list_arguments()
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**shape_dict)
+        shape_of = dict(zip(arg_names, arg_shapes))
+
+        args = {}
+        for n in arg_names:
+            if shared_module is not None and n in getattr(shared_module, "_param_names", []):
+                args[n] = shared_module._exec.arg_dict[n]
+            elif self._arg_params is not None and n in self._arg_params and self._arg_params[n].shape == shape_of[n]:
+                args[n] = self._arg_params[n]  # survive re-bind (MutableModule)
+            else:
+                args[n] = nd.zeros(shape_of[n], ctx=self._context if not isinstance(self._context, list) else None)
+        aux = {}
+        aux_of = dict(zip(self._aux_names, aux_shapes))
+        for n in self._aux_names:
+            if shared_module is not None and n in getattr(shared_module, "_aux_names", []):
+                aux[n] = shared_module._exec.aux_dict[n]
+            elif self._aux_params is not None and n in self._aux_params and self._aux_params[n].shape == aux_of[n]:
+                aux[n] = self._aux_params[n]
+            else:
+                aux[n] = nd.zeros(aux_of[n])
+
+        grads = None
+        req = {}
+        if for_training and grad_req != "null":
+            grads = {}
+            for n in self._param_names:
+                if n in self._fixed_param_names:
+                    req[n] = "null"
+                    continue
+                req[n] = grad_req if isinstance(grad_req, str) else grad_req.get(n, "write")
+                grads[n] = nd.zeros(shape_of[n])
+            for n in self._data_names:
+                if inputs_need_grad:
+                    req[n] = "write"
+                    grads[n] = nd.zeros(shape_of[n])
+                else:
+                    req[n] = "null"
+            for n in self._label_names + self._state_names:
+                req[n] = "null"
+        else:
+            req = "null"
+
+        self._exec = self._symbol.bind(
+            ctx=self._context if not isinstance(self._context, list) else None,
+            args=args, args_grad=grads, grad_req=req, aux_states=aux,
+        )
+        self.binded = True
+
+        if shared_module is not None and shared_module.params_initialized:
+            self._arg_params = {n: self._exec.arg_dict[n] for n in self._param_names}
+            self._aux_params = {n: self._exec.aux_dict[n] for n in self._aux_names}
+            self.params_initialized = True
+
+    def reshape(self, data_shapes, label_shapes=None):
+        """Re-bind for new shapes, keeping params (reference module.py:452)."""
+        assert self.binded
+        params_were_init = self.params_initialized
+        self._sync_params_from_exec() if params_were_init else None
+        self.bind(data_shapes, label_shapes, self.for_training, self.inputs_need_grad,
+                  force_rebind=True, grad_req=self._grad_req)
+        self.params_initialized = params_were_init
+
+    # -- optimizer -------------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd", optimizer_params=None, force_init=False):
+        """Reference module.py:473 — chooses kvstore-vs-local updater."""
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            self.logger.warning("optimizer already initialized, ignoring...")
+            return
+
+        kv, update_on_kvstore = _create_kvstore(
+            kvstore, 1, {n: self._exec.arg_dict[n] for n in self._param_names}
+        )
+        if isinstance(optimizer, str):
+            optimizer_params = dict(optimizer_params or {})
+            optimizer = opt_mod.create(optimizer, **optimizer_params)
+        optimizer.idx2name = {i: n for i, n in enumerate(self._param_names)}
+
+        self._optimizer = optimizer
+        self._kvstore = kv
+        self._update_on_kvstore = update_on_kvstore
+        self._updater = None
+        if kv:
+            if update_on_kvstore:
+                kv.set_optimizer(self._optimizer)
+            for i, n in enumerate(self._param_names):
+                kv.init(n, self._exec.arg_dict[n])
+        if not update_on_kvstore:
+            self._updater = opt_mod.get_updater(optimizer)
+        self.optimizer_initialized = True
+        if self._preload_opt_states is not None:
+            self.load_optimizer_states(self._preload_opt_states)
+            self._preload_opt_states = None
+
+    # -- compute ---------------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+
+        # MutableModule semantics: reshape on a new batch shape
+        new_descs = _as_descs(data_batch.provide_data) if data_batch.provide_data else [
+            DataDesc(n, a.shape) for n, a in zip(self._data_names, data_batch.data)
+        ]
+        if [d.shape for d in new_descs] != [d.shape for d in self._data_shapes]:
+            if data_batch.provide_label:
+                new_labels = _as_descs(data_batch.provide_label)
+            elif data_batch.label is not None and self._label_shapes:
+                new_labels = [DataDesc(n, a.shape) for n, a in zip(self._label_names, data_batch.label)]
+            else:
+                new_labels = self._label_shapes
+            self.reshape(new_descs, new_labels)
+
+        feed = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feed[name] = arr
+        if self._label_shapes and data_batch.label is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feed[name] = arr
+        elif self._label_shapes:
+            # predict-mode batch without labels: keep stale label buffers
+            pass
+        if self._mesh is not None:
+            from ..parallel import shard
+
+            feed = {
+                k: shard(v if isinstance(v, nd.NDArray) else nd.array(v),
+                         ("dp",) + (None,) * (len(v.shape) - 1), mesh=self._mesh)
+                for k, v in feed.items()
+            }
+        self._exec.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        """Apply one optimizer step (reference module.py:643)."""
+        assert self.binded and self.params_initialized and self.optimizer_initialized
+        param_arrays = [self._exec.arg_dict[n] for n in self._param_names]
+        grad_arrays = [self._exec.grad_dict.get(n) for n in self._param_names]
+        if self._kvstore and self._update_on_kvstore:
+            _update_params_on_kvstore(param_arrays, grad_arrays, self._kvstore, self._param_names)
+        else:
+            _update_params(param_arrays, grad_arrays, self._updater, 1,
+                           kvstore=self._kvstore, param_names=self._param_names)
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded
+        return list(self._exec.outputs)
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.inputs_need_grad
+        return [self._exec.grad_dict[n] for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    def get_states(self, merge_multi_context=True):
+        assert self.binded
+        return [self._exec.arg_dict[n] for n in self._state_names]
+
+    def set_states(self, states=None, value=None):
+        assert self.binded
+        if states is not None:
+            for n, v in zip(self._state_names, states):
+                self._exec.arg_dict[n] = v if isinstance(v, nd.NDArray) else nd.array(v)
+        else:
+            for n in self._state_names:
+                self._exec.arg_dict[n][:] = value
+
+    def install_monitor(self, mon):
+        assert self.binded
+        mon.install(self._exec)
+
+    # -- checkpointing ----------------------------------------------------------
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        """symbol json + params + optional optimizer states (reference
+        module.py:165)."""
+        arg_params, aux_params = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg_params, aux_params)
+        if save_optimizer_states:
+            self.save_optimizer_states("%s-%04d.states" % (prefix, epoch))
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = False
+        mod._preloaded_params = (args, auxs)
+        if load_optimizer_states:
+            mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
+        return mod
+
+    def save_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname)
+        else:
+            with open(fname, "wb") as f:
+                f.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as f:
+                self._updater.set_states(f.read())

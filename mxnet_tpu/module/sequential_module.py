@@ -1,0 +1,188 @@
+"""SequentialModule — chain modules, feeding outputs to the next's inputs.
+
+Reference ``python/mxnet/module/sequential_module.py``.
+"""
+from __future__ import annotations
+
+import logging
+
+from .. import ndarray as nd
+from ..io import DataDesc, DataBatch
+from .base_module import BaseModule
+
+__all__ = ["SequentialModule"]
+
+
+class SequentialModule(BaseModule):
+    META_TAKE_LABELS = "take_labels"
+    META_AUTO_WIRING = "auto_wiring"
+
+    def __init__(self, logger=logging):
+        super().__init__(logger=logger)
+        self._modules = []
+        self._metas = []
+        self._label_shapes = None
+        self._data_shapes = None
+        self._meta_keys = {self.META_TAKE_LABELS, self.META_AUTO_WIRING}
+
+    def add(self, module, **kwargs):
+        self._modules.append(module)
+        for key in kwargs:
+            assert key in self._meta_keys, "Unknown meta %s (known: %s)" % (key, self._meta_keys)
+        self._metas.append(kwargs)
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        return self
+
+    @property
+    def data_names(self):
+        assert self._modules
+        return self._modules[0].data_names
+
+    @property
+    def output_names(self):
+        assert self._modules
+        return self._modules[-1].output_names
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._modules[0].data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return self._modules[-1].output_shapes
+
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        arg_params, aux_params = {}, {}
+        for module in self._modules:
+            arg, aux = module.get_params()
+            arg_params.update(arg)
+            aux_params.update(aux)
+        return arg_params, aux_params
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded
+        for module in self._modules:
+            module.init_params(initializer=initializer, arg_params=arg_params,
+                               aux_params=aux_params, allow_missing=True,
+                               force_init=force_init, allow_extra=True)
+
+        # make sure we do not have duplicated parameter names
+        def _check_name(known, new_names, modules, i):
+            for name in new_names:
+                assert name not in known, "Duplicated parameter names: %s in module %d" % (name, i)
+                known[name] = i
+
+        arg_names, aux_names = {}, {}
+        for i_layer, module in enumerate(self._modules):
+            arg, aux = module.get_params()
+            _check_name(arg_names, arg.keys(), self._modules, i_layer)
+            _check_name(aux_names, aux.keys(), self._modules, i_layer)
+        self.params_initialized = True
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None, grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        assert shared_module is None, "shared_module is not supported"
+        assert len(self._modules) > 0
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+
+        my_data_shapes = data_shapes
+        my_label_shapes = label_shapes
+        anybody_ever_needs_label = False
+        for i_layer, module in enumerate(self._modules):
+            meta = self._metas[i_layer]
+            if meta.get(self.META_TAKE_LABELS):
+                module.bind(my_data_shapes, label_shapes, for_training,
+                            inputs_need_grad or i_layer > 0, force_rebind, None, grad_req)
+                anybody_ever_needs_label = True
+            else:
+                module.bind(my_data_shapes, None, for_training,
+                            inputs_need_grad or i_layer > 0, force_rebind, None, grad_req)
+            # wire outputs → next inputs
+            if i_layer < len(self._modules) - 1:
+                nxt = self._modules[i_layer + 1]
+                if self._metas[i_layer + 1].get(self.META_AUTO_WIRING, True):
+                    data_names = nxt.data_names
+                    shape_dict = {
+                        (d.name if isinstance(d, DataDesc) else d[0]):
+                        (d.shape if isinstance(d, DataDesc) else d[1])
+                        for d in my_data_shapes
+                    }
+                    _, out_shapes, _ = module.symbol.infer_shape_partial(**shape_dict)
+                    assert len(data_names) == len(out_shapes)
+                    my_data_shapes = [DataDesc(n, s) for n, s in zip(data_names, out_shapes)]
+        if not anybody_ever_needs_label:
+            self._label_shapes = None
+        else:
+            self._label_shapes = label_shapes
+        self._data_shapes = data_shapes
+        self.binded = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd", optimizer_params=None, force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        for module in self._modules:
+            module.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                                  optimizer_params=optimizer_params, force_init=force_init)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        batch = DataBatch(data=data_batch.data, label=data_batch.label,
+                          pad=data_batch.pad, provide_data=data_batch.provide_data,
+                          provide_label=data_batch.provide_label)
+        for i_layer, module in enumerate(self._modules):
+            module.forward(batch, is_train=is_train)
+            if i_layer == len(self._modules) - 1:
+                break
+            batch = DataBatch(data=module.get_outputs(), label=data_batch.label, pad=data_batch.pad)
+        return
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        for i_layer in range(len(self._modules) - 1, -1, -1):
+            module = self._modules[i_layer]
+            module.backward(out_grads=out_grads)
+            if i_layer == 0:
+                break
+            out_grads = module.get_input_grads()
+
+    def update(self):
+        assert self.binded and self.params_initialized and self.optimizer_initialized
+        for module in self._modules:
+            module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded
+        return self._modules[-1].get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.inputs_need_grad
+        return self._modules[0].get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels):
+        for module, meta in zip(self._modules, self._metas):
+            if meta.get(self.META_TAKE_LABELS):
+                module.update_metric(eval_metric, labels)
+
+    def install_monitor(self, mon):
+        assert self.binded
+        for module in self._modules:
+            module.install_monitor(mon)

@@ -65,24 +65,15 @@ def is_coordinator():
 
 def barrier(name="mxnet_barrier", timeout_ms=120_000):
     """Block until every process arrives (reference ``KVStore::Barrier``,
-    ``kvstore_dist.h:96``).  Uses the distributed KV client when multi-host;
-    trivially returns single-host."""
+    ``kvstore_dist.h:96``).  Desync/timeout errors propagate — a missing host
+    is a real failure, not something to paper over."""
     import jax
 
     if jax.process_count() == 1:
         return
-    try:
-        client = jax._src.distributed.global_state.client
-        client.wait_at_barrier(name, timeout_ms)
-    except Exception:
-        # fall back to a device-level sync: a tiny psum across all hosts
-        import jax.numpy as jnp
+    from jax.experimental import multihost_utils
 
-        jax.block_until_ready(
-            jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(
-                jnp.ones((jax.local_device_count(),))
-            )
-        )
+    multihost_utils.sync_global_devices(name)
 
 
 def shutdown():

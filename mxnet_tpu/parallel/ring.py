@@ -40,8 +40,7 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None):
 
     neg_inf = jnp.finfo(jnp.float32).min
 
-    def step(carry, t):
-        o, m, l, k_blk, v_blk = carry
+    def accumulate(o, m, l, k_blk, v_blk, t):
         # block currently held arrived from device (my_idx - t) mod n
         src = (my_idx - t) % n
         s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_blk.astype(jnp.float32))
@@ -60,10 +59,15 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None):
         corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         o_new = o * corr + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
-        # rotate K/V to the next device; overlaps with next step's einsum
+        return o_new, m_new, l_new
+
+    def step(carry, t):
+        o, m, l, k_blk, v_blk = carry
+        o, m, l = accumulate(o, m, l, k_blk, v_blk, t)
+        # rotate K/V to the next device; overlaps with the next step's einsum
         k_next = jax.lax.ppermute(k_blk, axis_name, perm)
         v_next = jax.lax.ppermute(v_blk, axis_name, perm)
-        return (o_new, m_new, l_new, k_next, v_next), None
+        return (o, m, l, k_next, v_next), None
 
     o0 = jnp.zeros((B, H, Sq, D), jnp.float32)
     m0 = jnp.full((B, H, Sq, 1), neg_inf, jnp.float32)
@@ -74,7 +78,15 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None):
         o0, m0, l0 = (jax.lax.pvary(x, (axis_name,)) for x in (o0, m0, l0))
     except AttributeError:
         pass
-    (o, m, l, _, _), _ = jax.lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
+    # scan n-1 rotate-steps, then consume the final block without rotating —
+    # otherwise the last ppermute ships a full K+V block nobody reads
+    if n > 1:
+        (o, m, l, k_last, v_last), _ = jax.lax.scan(
+            step, (o0, m0, l0, k, v), jnp.arange(n - 1)
+        )
+    else:
+        o, m, l, k_last, v_last = o0, m0, l0, k, v
+    o, m, l = accumulate(o, m, l, k_last, v_last, n - 1)
     out = o / jnp.maximum(l, 1e-30)
     return out.astype(q.dtype)
 

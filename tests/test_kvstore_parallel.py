@@ -37,11 +37,11 @@ class TestKVStore:
 
     def test_list_kv_pair(self):
         kv = init_kv()
-        kv.push(KEYS, [mx.nd.ones(SHAPE) * 4] * len(KEYS))
-        out = [mx.nd.empty(SHAPE)] * len(KEYS)
+        kv.push(KEYS, [mx.nd.ones(SHAPE) * (k + 1) for k in range(len(KEYS))])
+        out = [mx.nd.empty(SHAPE) for _ in KEYS]
         kv.pull(KEYS, out=out)
-        for o in out:
-            check_diff_to_scalar(o, 4)
+        for k, o in enumerate(out):
+            check_diff_to_scalar(o, k + 1)
 
     def test_aggregator(self):
         """Per-device value lists are summed (reference test_kvstore.py

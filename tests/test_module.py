@@ -1,0 +1,238 @@
+"""Module API tests — mirrors reference ``tests/python/unittest/test_module.py``
+and ``tests/python/train/test_mlp.py`` (small real training to an accuracy
+threshold).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import module as mod_mod
+from mxnet_tpu.io import DataBatch, DataDesc, NDArrayIter
+
+
+def _mlp_sym(num_hidden=32, num_classes=4):
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=num_hidden)
+    act = mx.sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, name="fc2", num_hidden=num_classes)
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _toy_classification(n=400, num_classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 8).astype(np.float32)
+    W = rng.randn(8, num_classes).astype(np.float32)
+    y = np.argmax(X @ W + 0.1 * rng.randn(n, num_classes), axis=1).astype(np.float32)
+    return X, y
+
+
+class TestModuleBasics:
+    def test_bind_and_shapes(self):
+        sym = _mlp_sym()
+        mod = mod_mod.Module(sym, data_names=["data"], label_names=["softmax_label"])
+        mod.bind(data_shapes=[("data", (10, 8))], label_shapes=[("softmax_label", (10,))])
+        assert mod.binded
+        assert mod.data_shapes[0].shape == (10, 8)
+        mod.init_params()
+        assert mod.params_initialized
+        arg_params, aux_params = mod.get_params()
+        assert set(arg_params) == {"fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"}
+
+    def test_forward_output_shape(self):
+        sym = _mlp_sym()
+        mod = mod_mod.Module(sym)
+        mod.bind(data_shapes=[("data", (10, 8))], label_shapes=[("softmax_label", (10,))])
+        mod.init_params()
+        batch = DataBatch(data=[mx.nd.ones((10, 8))], label=[mx.nd.zeros((10,))])
+        mod.forward(batch, is_train=False)
+        out = mod.get_outputs()[0]
+        assert out.shape == (10, 4)
+        np.testing.assert_allclose(out.asnumpy().sum(axis=1), np.ones(10), rtol=1e-5)
+
+    def test_forward_reshapes_on_new_batch_shape(self):
+        """MutableModule semantics (reference rcnn/core/module.py:30)."""
+        sym = _mlp_sym()
+        mod = mod_mod.Module(sym)
+        mod.bind(data_shapes=[("data", (10, 8))], label_shapes=[("softmax_label", (10,))])
+        mod.init_params()
+        p0 = mod.get_params()[0]["fc1_weight"].asnumpy()
+        batch = DataBatch(data=[mx.nd.ones((6, 8))], label=[mx.nd.zeros((6,))])
+        mod.forward(batch, is_train=False)
+        assert mod.get_outputs()[0].shape == (6, 4)
+        # params survived the reshape
+        np.testing.assert_allclose(mod.get_params()[0]["fc1_weight"].asnumpy(), p0)
+
+    def test_input_grads(self):
+        sym = _mlp_sym()
+        mod = mod_mod.Module(sym)
+        mod.bind(data_shapes=[("data", (4, 8))], label_shapes=[("softmax_label", (4,))],
+                 inputs_need_grad=True)
+        mod.init_params()
+        batch = DataBatch(data=[mx.nd.ones((4, 8))], label=[mx.nd.array([0, 1, 2, 3])])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        g = mod.get_input_grads()[0]
+        assert g.shape == (4, 8)
+        assert np.abs(g.asnumpy()).sum() > 0
+
+    def test_save_load_checkpoint(self, tmp_path):
+        sym = _mlp_sym()
+        mod = mod_mod.Module(sym)
+        mod.bind(data_shapes=[("data", (4, 8))], label_shapes=[("softmax_label", (4,))])
+        mod.init_params()
+        prefix = str(tmp_path / "mlp")
+        mod.save_checkpoint(prefix, 3)
+        mod2 = mod_mod.Module.load(prefix, 3)
+        mod2.bind(data_shapes=[("data", (4, 8))], label_shapes=[("softmax_label", (4,))])
+        mod2.init_params()
+        p1 = mod.get_params()[0]
+        p2 = mod2.get_params()[0]
+        for k in p1:
+            np.testing.assert_allclose(p1[k].asnumpy(), p2[k].asnumpy())
+
+    def test_set_params(self):
+        sym = _mlp_sym()
+        mod = mod_mod.Module(sym)
+        mod.bind(data_shapes=[("data", (4, 8))], label_shapes=[("softmax_label", (4,))])
+        mod.init_params()
+        arg, aux = mod.get_params()
+        arg2 = {k: mx.nd.ones(v.shape) for k, v in arg.items()}
+        mod.set_params(arg2, aux)
+        for v in mod.get_params()[0].values():
+            np.testing.assert_allclose(v.asnumpy(), np.ones(v.shape))
+
+    def test_fixed_params_not_updated(self):
+        sym = _mlp_sym()
+        mod = mod_mod.Module(sym, fixed_param_names=["fc1_weight"])
+        mod.bind(data_shapes=[("data", (4, 8))], label_shapes=[("softmax_label", (4,))])
+        mod.init_params()
+        mod.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 1.0})
+        before = mod.get_params()[0]["fc1_weight"].asnumpy()
+        batch = DataBatch(data=[mx.nd.ones((4, 8))], label=[mx.nd.array([0, 1, 2, 3])])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+        after = mod.get_params()[0]["fc1_weight"].asnumpy()
+        np.testing.assert_allclose(before, after)
+        # fc1 grad was never allocated
+        assert mod._exec.grad_dict.get("fc1_weight") is None
+
+
+class TestModuleFit:
+    def test_fit_mlp_accuracy(self):
+        """Small real training to threshold (reference tests/python/train/test_mlp.py)."""
+        X, y = _toy_classification()
+        train = NDArrayIter(X, y, batch_size=50, shuffle=True, label_name="softmax_label")
+        val = NDArrayIter(X, y, batch_size=50, label_name="softmax_label")
+        mod = mod_mod.Module(_mlp_sym())
+        mod.fit(train, eval_data=val, optimizer="adam",
+                optimizer_params={"learning_rate": 0.01},
+                num_epoch=15, eval_metric="acc")
+        score = mod.score(val, "acc")[0][1]
+        assert score > 0.85, score
+
+    def test_score_and_predict(self):
+        X, y = _toy_classification()
+        train = NDArrayIter(X, y, batch_size=50, shuffle=True)
+        mod = mod_mod.Module(_mlp_sym())
+        mod.fit(train, optimizer="adam", optimizer_params={"learning_rate": 0.01}, num_epoch=5)
+        pred = mod.predict(NDArrayIter(X, y, batch_size=50))
+        assert pred.shape == (400, 4)
+
+    def test_fit_with_kvstore_instance(self):
+        X, y = _toy_classification(n=100)
+        train = NDArrayIter(X, y, batch_size=50)
+        kv = mx.kv.create("local")
+        mod = mod_mod.Module(_mlp_sym())
+        mod.fit(train, kvstore=kv, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1}, num_epoch=2)
+        assert mod.score(train, "acc")[0][1] > 0.2
+
+
+class TestBucketingModule:
+    def test_buckets_share_params(self):
+        def sym_gen(seq_len):
+            data = mx.sym.var("data")
+            fc = mx.sym.FullyConnected(data, name="fc", num_hidden=4)
+            out = mx.sym.SoftmaxOutput(fc, name="softmax")
+            return out, ["data"], ["softmax_label"]
+
+        bm = mod_mod.BucketingModule(sym_gen, default_bucket_key=8)
+        bm.bind([("data", (2, 8))], [("softmax_label", (2,))])
+        bm.init_params()
+        bm.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 0.1})
+
+        b1 = DataBatch(data=[mx.nd.ones((2, 8))], label=[mx.nd.array([0, 1])],
+                       bucket_key=8, provide_data=[DataDesc("data", (2, 8))],
+                       provide_label=[DataDesc("softmax_label", (2,))])
+        bm.forward(b1, is_train=True)
+        bm.backward()
+        bm.update()
+        w_after = bm.get_params()[0]["fc_weight"].asnumpy()
+
+        # same param object visible from another bucket
+        b2 = DataBatch(data=[mx.nd.ones((4, 8))], label=[mx.nd.array([0, 1, 2, 3])],
+                       bucket_key=4, provide_data=[DataDesc("data", (4, 8))],
+                       provide_label=[DataDesc("softmax_label", (4,))])
+        bm.forward(b2, is_train=False)
+        np.testing.assert_allclose(bm.get_params()[0]["fc_weight"].asnumpy(), w_after)
+        assert bm.get_outputs()[0].shape == (4, 4)
+
+
+class TestSequentialModule:
+    def test_two_stage_chain(self):
+        data = mx.sym.var("data")
+        net1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+        net1 = mx.sym.Activation(net1, name="a1", act_type="relu")
+
+        data2 = mx.sym.var("data")
+        net2 = mx.sym.FullyConnected(data2, name="fc2", num_hidden=4)
+        net2 = mx.sym.SoftmaxOutput(net2, name="softmax")
+
+        seq = mod_mod.SequentialModule()
+        seq.add(mod_mod.Module(net1, label_names=None))
+        seq.add(mod_mod.Module(net2), take_labels=True, auto_wiring=True)
+        seq.bind([("data", (4, 8))], [("softmax_label", (4,))])
+        seq.init_params()
+        seq.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 0.1})
+        batch = DataBatch(data=[mx.nd.ones((4, 8))], label=[mx.nd.array([0, 1, 2, 3])])
+        seq.forward(batch, is_train=True)
+        out = seq.get_outputs()[0]
+        assert out.shape == (4, 4)
+        seq.backward()
+        seq.update()
+
+
+class TestFeedForward:
+    def test_feedforward_fit_predict(self):
+        X, y = _toy_classification(n=200)
+        ff = mx.model.FeedForward(_mlp_sym(), num_epoch=5, optimizer="adam", learning_rate=0.01)
+        ff.fit(X, y, kvstore=None)
+        pred = ff.predict(NDArrayIter(X, y, batch_size=50))
+        assert pred.shape == (200, 4)
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        sym = _mlp_sym()
+        arg = {"fc1_weight": mx.nd.ones((32, 8)), "fc1_bias": mx.nd.zeros((32,)),
+               "fc2_weight": mx.nd.ones((4, 32)), "fc2_bias": mx.nd.zeros((4,))}
+        prefix = str(tmp_path / "ck")
+        mx.model.save_checkpoint(prefix, 7, sym, arg, {})
+        sym2, arg2, aux2 = mx.model.load_checkpoint(prefix, 7)
+        assert sym2 is not None
+        for k in arg:
+            np.testing.assert_allclose(arg[k].asnumpy(), arg2[k].asnumpy())
+
+
+class TestModuleMeshDP:
+    def test_fit_with_mesh_sharded_batches(self):
+        """Data-parallel Module over a dp mesh — the XLA replacement for
+        DataParallelExecutorGroup (reference executor_group.py:143)."""
+        from mxnet_tpu import parallel
+
+        mesh = parallel.make_mesh(dp=8)
+        X, y = _toy_classification(n=400)
+        train = NDArrayIter(X, y, batch_size=80, shuffle=True)
+        mod = mod_mod.Module(_mlp_sym(), mesh=mesh)
+        mod.fit(train, optimizer="adam", optimizer_params={"learning_rate": 0.01}, num_epoch=8)
+        score = mod.score(NDArrayIter(X, y, batch_size=80), "acc")[0][1]
+        assert score > 0.8, score
