@@ -1,0 +1,64 @@
+"""Benchmark: ResNet-50 training throughput (img/s), single chip.
+
+Baseline: the reference's own headline number — ResNet-50 training at batch 32
+on 1x K80: 109 img/s (`example/image-classification/README.md:145-156`,
+BASELINE.md).  Prints ONE JSON line.
+
+The measured step is the full training step — forward, backward, BatchNorm
+stat update, SGD-momentum — compiled into one XLA module (see
+mxnet_tpu/gluon/functional.py make_train_step).
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    platform = jax.devices()[0].platform
+    # TPU: big batch keeps the MXU fed; CPU smoke runs stay tiny
+    batch = int(os.environ.get("MXNET_BENCH_BATCH", 128 if platform == "tpu" else 4))
+    iters = int(os.environ.get("MXNET_BENCH_ITERS", 20 if platform == "tpu" else 2))
+    image = 224
+
+    import mxnet_tpu as mx  # noqa: F401
+    from mxnet_tpu.gluon import loss as loss_mod
+    from mxnet_tpu.gluon.functional import make_train_step
+    from __graft_entry__ import _build_resnet
+
+    net = _build_resnet(classes=1000, version=50, image_size=image)
+    step, state, _meta = make_train_step(
+        net, loss_mod.SoftmaxCrossEntropyLoss(), learning_rate=0.05, momentum=0.9
+    )
+    jstep = jax.jit(step, donate_argnums=(0,))
+
+    rng = np.random.RandomState(0)
+    x = jax.device_put(rng.randn(batch, 3, image, image).astype(np.float32))
+    y = jax.device_put(rng.randint(0, 1000, (batch,)).astype(np.float32))
+    key = jax.random.PRNGKey(0)
+
+    # warmup/compile
+    state, loss = jstep(state, x, y, key)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(iters):
+        state, loss = jstep(state, x, y, jax.random.fold_in(key, i))
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = batch * iters / dt
+    baseline = 109.0  # 1x K80, batch 32
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec",
+        "value": round(imgs_per_sec, 2),
+        "unit": "img/s",
+        "vs_baseline": round(imgs_per_sec / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

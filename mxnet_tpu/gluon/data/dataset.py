@@ -91,7 +91,7 @@ class ArrayDataset(Dataset):
                 # loader; samples are re-wrapped as CPU NDArrays in __getitem__
                 # to keep the reference's NDArray-sample API
                 data = data.asnumpy()
-            self._was_ndarray.append(was_nd and data.ndim > 1)
+            self._was_ndarray.append(was_nd)
             self._data.append(data)
 
     def __len__(self):

@@ -1,0 +1,106 @@
+"""Functionalize a gluon Block: explicit-parameter pure functions.
+
+The reference trains gluon nets through the autograd tape + Trainer
+(``python/mxnet/gluon/trainer.py:27``); the TPU-performance path is a single
+jitted train step where parameters are explicit pytree inputs so jax.grad /
+pjit / donation all apply.  This module converts any initialized Block into
+that form — the same param-swap trace technique HybridBlock's CachedOp uses
+(``mxnet_tpu/gluon/block.py:_build_cached_op``), exposed as a public utility.
+"""
+from __future__ import annotations
+
+from .. import autograd
+from .. import random as _rnd
+from ..ndarray.ndarray import NDArray
+from .block import Block, _TRACING
+
+__all__ = ["functionalize", "make_train_step"]
+
+
+def functionalize(net, train=False):
+    """→ (apply, param_names, param_vals, aux_names)
+
+    ``apply(param_vals, x, key) -> (outputs, new_aux_vals)`` is pure and
+    jittable: ``param_vals`` is a list of jax arrays ordered like
+    ``param_names``; ``new_aux_vals`` carries mutated auxiliary state
+    (BatchNorm running stats) for names in ``aux_names`` (a subset of
+    ``param_names`` with grad_req='null').
+    """
+    params = sorted(net.collect_params().items())
+    for _, p in params:
+        p.data()  # raise early (with a clear message) if uninitialized
+    param_names = [n for n, _ in params]
+    param_vals = [p._data._data for _, p in params]
+    aux_names = [n for n, p in params if p.grad_req == "null"]
+    aux_set = set(aux_names)
+
+    def apply(vals, x, key=None):
+        if key is None:
+            key = _rnd.next_key()
+        swapped = []
+        for (name, p), v in zip(params, vals):
+            swapped.append((p, p._data))
+            p._data = NDArray(v)
+        prev = _TRACING.active
+        _TRACING.active = True
+        try:
+            xs = x if isinstance(x, (list, tuple)) else (x,)
+            nd_in = [v if isinstance(v, NDArray) else NDArray(v) for v in xs]
+            with autograd.pause(train_mode=train), _rnd.key_provider(key):
+                out = Block.__call__(net, *nd_in)
+            outs = out if isinstance(out, (list, tuple)) else (out,)
+            out_vals = tuple(o._data for o in outs)
+            new_aux = [p._data._data for n, p in params if n in aux_set]
+            return out_vals if len(out_vals) > 1 else out_vals[0], new_aux
+        finally:
+            _TRACING.active = prev
+            for p, old in swapped:
+                p._data = old
+
+    return apply, param_names, param_vals, aux_names
+
+
+def make_train_step(net, loss_fn, learning_rate=0.01, momentum=0.0):
+    """Build a fully-jittable SGD train step for an initialized Block.
+
+    → (step, state) where ``state = (param_vals, momentum_vals, aux_vals)``
+    pytrees and ``step(state, x, y, key) -> (state, loss)``.  All compute —
+    forward, backward, BN-stat update, optimizer — lands in ONE XLA module,
+    which is what lets the compiler fuse and overlap (the reference needed
+    engine bulking + fused optimizer kernels for the same effect,
+    ``src/executor/graph_executor.cc:1454``, ``src/operator/optimizer_op.cc``).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    apply, names, vals, aux_names = functionalize(net, train=True)
+    aux_idx = [i for i, n in enumerate(names) if n in set(aux_names)]
+    learn_idx = [i for i, n in enumerate(names) if n not in set(aux_names)]
+
+    def compute_loss(learn_vals, aux_vals, x, y, key):
+        merged = [None] * len(names)
+        for i, v in zip(learn_idx, learn_vals):
+            merged[i] = v
+        for i, v in zip(aux_idx, aux_vals):
+            merged[i] = v
+        out, new_aux = apply(merged, x, key)
+        loss = loss_fn(NDArray(out), NDArray(y))
+        return jnp.mean(loss._data), new_aux
+
+    grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
+
+    def step(state, x, y, key):
+        learn_vals, mom_vals, aux_vals = state
+        (loss, new_aux), grads = grad_fn(learn_vals, aux_vals, x, y, key)
+        if momentum:
+            mom_vals = [momentum * m + g for m, g in zip(mom_vals, grads)]
+            upd = mom_vals
+        else:
+            upd = grads
+        learn_vals = [p - learning_rate * g for p, g in zip(learn_vals, upd)]
+        return (learn_vals, mom_vals, new_aux), loss
+
+    learn_vals = [vals[i] for i in learn_idx]
+    aux_vals = [vals[i] for i in aux_idx]
+    mom_vals = [jnp.zeros_like(v) for v in learn_vals] if momentum else []
+    return step, (learn_vals, mom_vals, aux_vals), (names, learn_idx, aux_idx)

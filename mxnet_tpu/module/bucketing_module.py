@@ -32,6 +32,7 @@ class BucketingModule(BaseModule):
         self._params_dirty = False
         self._grad_req = "write"
         self._monitor = None
+        self._opt_module = None
 
     @property
     def default_bucket_key(self):
@@ -127,6 +128,8 @@ class BucketingModule(BaseModule):
                         grad_req=self._grad_req)
             if self._monitor is not None:
                 module.install_monitor(self._monitor)
+            if self.optimizer_initialized:
+                self._borrow_optimizer(module)
             self._buckets[bucket_key] = module
         self._curr_module = self._buckets[bucket_key]
         self._curr_bucket_key = bucket_key
@@ -145,14 +148,21 @@ class BucketingModule(BaseModule):
         if self.optimizer_initialized and not force_init:
             return
         self._curr_module.init_optimizer(kvstore, optimizer, optimizer_params, force_init=force_init)
+        self._opt_module = self._curr_module
+        self.optimizer_initialized = True
         for mod in self._buckets.values():
             if mod is not self._curr_module:
-                mod._optimizer = self._curr_module._optimizer
-                mod._kvstore = self._curr_module._kvstore
-                mod._update_on_kvstore = self._curr_module._update_on_kvstore
-                mod._updater = self._curr_module._updater
-                mod.optimizer_initialized = True
-        self.optimizer_initialized = True
+                self._borrow_optimizer(mod)
+
+    def _borrow_optimizer(self, module):
+        """Share the default bucket's optimizer state (reference
+        bucketing_module.py borrow_optimizer)."""
+        src = self._opt_module
+        module._optimizer = src._optimizer
+        module._kvstore = src._kvstore
+        module._update_on_kvstore = src._update_on_kvstore
+        module._updater = src._updater
+        module.optimizer_initialized = True
 
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized

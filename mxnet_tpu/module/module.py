@@ -269,6 +269,12 @@ class Module(BaseModule):
             optimizer_params = dict(optimizer_params or {})
             optimizer = opt_mod.create(optimizer, **optimizer_params)
         optimizer.idx2name = {i: n for i, n in enumerate(self._param_names)}
+        if hasattr(self._symbol, "attr_dict"):
+            optimizer.sym_info = (self._symbol.attr_dict(), self._symbol.list_arguments())
+        # repopulate name-keyed multipliers now that idx2name is known
+        # (wd exemption for bias/gamma, __lr_mult__/__wd_mult__ attrs)
+        optimizer.set_lr_mult(getattr(optimizer, "lr_mult", {}) or {})
+        optimizer.set_wd_mult(getattr(optimizer, "wd_mult", {}) or {})
 
         self._optimizer = optimizer
         self._kvstore = kv
@@ -277,8 +283,9 @@ class Module(BaseModule):
         if kv:
             if update_on_kvstore:
                 kv.set_optimizer(self._optimizer)
-            for i, n in enumerate(self._param_names):
-                kv.init(n, self._exec.arg_dict[n])
+            _initialize_kvstore(kv, [self._exec.arg_dict[n] for n in self._param_names],
+                                {n: self._exec.arg_dict[n] for n in self._param_names},
+                                self._param_names, update_on_kvstore)
         if not update_on_kvstore:
             self._updater = opt_mod.get_updater(optimizer)
         self.optimizer_initialized = True
@@ -301,8 +308,13 @@ class Module(BaseModule):
                 new_labels = _as_descs(data_batch.provide_label)
             elif data_batch.label is not None and self._label_shapes:
                 new_labels = [DataDesc(n, a.shape) for n, a in zip(self._label_names, data_batch.label)]
+            elif self._label_shapes:
+                # label-less batch (predict): rescale label batch dims to match
+                new_batch = new_descs[0].shape[0]
+                new_labels = [DataDesc(d.name, (new_batch,) + tuple(d.shape[1:]))
+                              for d in self._label_shapes]
             else:
-                new_labels = self._label_shapes
+                new_labels = None
             self.reshape(new_descs, new_labels)
 
         feed = {}
@@ -382,8 +394,6 @@ class Module(BaseModule):
         mod = Module(symbol=sym, **kwargs)
         mod._arg_params = args
         mod._aux_params = auxs
-        mod.params_initialized = False
-        mod._preloaded_params = (args, auxs)
         if load_optimizer_states:
             mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
         return mod

@@ -119,12 +119,15 @@ class SequentialModule(BaseModule):
                 nxt = self._modules[i_layer + 1]
                 if self._metas[i_layer + 1].get(self.META_AUTO_WIRING, True):
                     data_names = nxt.data_names
-                    shape_dict = {
-                        (d.name if isinstance(d, DataDesc) else d[0]):
-                        (d.shape if isinstance(d, DataDesc) else d[1])
-                        for d in my_data_shapes
-                    }
-                    _, out_shapes, _ = module.symbol.infer_shape_partial(**shape_dict)
+                    if module.symbol is not None:
+                        shape_dict = {
+                            (d.name if isinstance(d, DataDesc) else d[0]):
+                            (d.shape if isinstance(d, DataDesc) else d[1])
+                            for d in my_data_shapes
+                        }
+                        _, out_shapes, _ = module.symbol.infer_shape_partial(**shape_dict)
+                    else:  # PythonModule et al: already bound, shapes known
+                        out_shapes = [s for _, s in module.output_shapes]
                     assert len(data_names) == len(out_shapes)
                     my_data_shapes = [DataDesc(n, s) for n, s in zip(data_names, out_shapes)]
         if not anybody_ever_needs_label:

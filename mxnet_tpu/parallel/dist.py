@@ -65,15 +65,21 @@ def is_coordinator():
 
 def barrier(name="mxnet_barrier", timeout_ms=120_000):
     """Block until every process arrives (reference ``KVStore::Barrier``,
-    ``kvstore_dist.h:96``).  Desync/timeout errors propagate — a missing host
-    is a real failure, not something to paper over."""
+    ``kvstore_dist.h:96``).  Uses the coordination-service barrier (bounded by
+    ``timeout_ms``) when available; desync/timeout errors propagate — a
+    missing host is a real failure, not something to paper over."""
     import jax
 
     if jax.process_count() == 1:
         return
-    from jax.experimental import multihost_utils
+    try:
+        client = jax._src.distributed.global_state.client
+    except AttributeError:  # jax moved the internals: unbounded device sync
+        from jax.experimental import multihost_utils
 
-    multihost_utils.sync_global_devices(name)
+        multihost_utils.sync_global_devices(name)
+        return
+    client.wait_at_barrier(name, timeout_ms)
 
 
 def shutdown():

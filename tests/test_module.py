@@ -236,3 +236,27 @@ class TestModuleMeshDP:
         mod.fit(train, optimizer="adam", optimizer_params={"learning_rate": 0.01}, num_epoch=8)
         score = mod.score(NDArrayIter(X, y, batch_size=80), "acc")[0][1]
         assert score > 0.8, score
+
+
+class TestBucketingOptimizerBorrow:
+    def test_update_on_late_bucket(self):
+        """New bucket created after init_optimizer must be able to update
+        (reference borrow_optimizer)."""
+
+        def sym_gen(seq_len):
+            data = mx.sym.var("data")
+            fc = mx.sym.FullyConnected(data, name="fc", num_hidden=4)
+            return mx.sym.SoftmaxOutput(fc, name="softmax"), ["data"], ["softmax_label"]
+
+        bm = mod_mod.BucketingModule(sym_gen, default_bucket_key=8)
+        bm.bind([("data", (2, 8))], [("softmax_label", (2,))])
+        bm.init_params()
+        bm.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 0.1})
+        b = DataBatch(data=[mx.nd.ones((4, 8))], label=[mx.nd.array([0, 1, 2, 3])],
+                      bucket_key=4, provide_data=[DataDesc("data", (4, 8))],
+                      provide_label=[DataDesc("softmax_label", (4,))])
+        w0 = bm.get_params()[0]["fc_weight"].asnumpy()
+        bm.forward(b, is_train=True)
+        bm.backward()
+        bm.update()  # must not assert
+        assert not np.allclose(bm.get_params()[0]["fc_weight"].asnumpy(), w0)
