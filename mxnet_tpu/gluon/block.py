@@ -445,30 +445,23 @@ class HybridBlock(Block):
         n_params = len(params)
         self_ref = self
 
+        mutable_idx = [i for i, (_, p) in enumerate(params) if p.grad_req == "null"]
+
         def pure(key, *vals):
             param_vals = vals[:n_params]
             input_vals = vals[n_params:]
-            swapped = []
-            for (name, p), v in zip(params, param_vals):
-                swapped.append((p, p._data))
-                p._data = NDArray(v)
-            prev_tracing = _TRACING.active
-            _TRACING.active = True
-            try:
+
+            def call():
                 nd_inputs = [NDArray(v) for v in input_vals]
                 grouped, _ = _regroup(nd_inputs, in_fmt)
                 if not isinstance(grouped, tuple):
                     grouped = (grouped,)
-                with autograd.pause(train_mode=train), _rnd.key_provider(key):
-                    out = Block.__call__(self_ref, *grouped)
-                flat_out, out_fmt = _flatten(out)
-                out_fmt_box[0] = out_fmt
-                aux_vals = [p._data._data for _, p in mutable]
-                return tuple(o._data for o in flat_out) + tuple(aux_vals)
-            finally:
-                _TRACING.active = prev_tracing
-                for p, old in swapped:
-                    p._data = old
+                return Block.__call__(self_ref, *grouped)
+
+            out, post = _swap_trace_call(params, param_vals, call, key, train)
+            flat_out, out_fmt = _flatten(out)
+            out_fmt_box[0] = out_fmt
+            return tuple(o._data for o in flat_out) + tuple(post[i] for i in mutable_idx)
 
         return jax.jit(pure), out_fmt_box, mutable
 
@@ -478,6 +471,29 @@ class _TracingFlag(threading.local):
 
 
 _TRACING = _TracingFlag()
+
+
+def _swap_trace_call(params, param_vals, call, key, train):
+    """Core of the CachedOp/functionalize trace (reference CachedOp captures a
+    graph by running the block once, src/imperative/cached_op.cc:268): swap the
+    given jax arrays into the Parameters, run ``call()`` under the tracing flag
+    with a fixed RNG key, collect post-call param arrays (mutated aux state,
+    e.g. BatchNorm running stats), then restore.  Returns (out, post_vals)."""
+    swapped = []
+    for (_, p), v in zip(params, param_vals):
+        swapped.append((p, p._data))
+        p._data = NDArray(v)
+    prev_tracing = _TRACING.active
+    _TRACING.active = True
+    try:
+        with autograd.pause(train_mode=train), _rnd.key_provider(key):
+            out = call()
+        post = [p._data._data for _, p in params]
+        return out, post
+    finally:
+        _TRACING.active = prev_tracing
+        for p, old in swapped:
+            p._data = old
 
 
 class _name_prefix_scope:

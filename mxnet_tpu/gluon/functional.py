@@ -9,10 +9,9 @@ that form — the same param-swap trace technique HybridBlock's CachedOp uses
 """
 from __future__ import annotations
 
-from .. import autograd
 from .. import random as _rnd
 from ..ndarray.ndarray import NDArray
-from .block import Block, _TRACING
+from .block import Block, _swap_trace_call
 
 __all__ = ["functionalize", "make_train_step"]
 
@@ -32,30 +31,22 @@ def functionalize(net, train=False):
     param_names = [n for n, _ in params]
     param_vals = [p._data._data for _, p in params]
     aux_names = [n for n, p in params if p.grad_req == "null"]
-    aux_set = set(aux_names)
+    aux_idx = [i for i, (n, _) in enumerate(params) if n in set(aux_names)]
 
     def apply(vals, x, key=None):
         if key is None:
             key = _rnd.next_key()
-        swapped = []
-        for (name, p), v in zip(params, vals):
-            swapped.append((p, p._data))
-            p._data = NDArray(v)
-        prev = _TRACING.active
-        _TRACING.active = True
-        try:
+
+        def call():
             xs = x if isinstance(x, (list, tuple)) else (x,)
             nd_in = [v if isinstance(v, NDArray) else NDArray(v) for v in xs]
-            with autograd.pause(train_mode=train), _rnd.key_provider(key):
-                out = Block.__call__(net, *nd_in)
-            outs = out if isinstance(out, (list, tuple)) else (out,)
-            out_vals = tuple(o._data for o in outs)
-            new_aux = [p._data._data for n, p in params if n in aux_set]
-            return out_vals if len(out_vals) > 1 else out_vals[0], new_aux
-        finally:
-            _TRACING.active = prev
-            for p, old in swapped:
-                p._data = old
+            return Block.__call__(net, *nd_in)
+
+        out, post = _swap_trace_call(params, vals, call, key, train)
+        outs = out if isinstance(out, (list, tuple)) else (out,)
+        out_vals = tuple(o._data for o in outs)
+        new_aux = [post[i] for i in aux_idx]
+        return out_vals if len(out_vals) > 1 else out_vals[0], new_aux
 
     return apply, param_names, param_vals, aux_names
 

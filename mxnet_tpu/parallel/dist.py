@@ -72,9 +72,10 @@ def barrier(name="mxnet_barrier", timeout_ms=120_000):
 
     if jax.process_count() == 1:
         return
-    try:
-        client = jax._src.distributed.global_state.client
-    except AttributeError:  # jax moved the internals: unbounded device sync
+    client = getattr(jax._src.distributed.global_state, "client", None)
+    if client is None:
+        # jax moved the internals, or no coordination-service client (e.g.
+        # proxy backends): fall back to an unbounded device sync
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices(name)
