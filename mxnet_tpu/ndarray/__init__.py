@@ -168,6 +168,15 @@ for _name in _registry.list_ops(include_aliases=True):
         setattr(sys.modules[__name__], _name, _f)
 sys.modules[op.__name__] = op
 
+# contrib namespace: `_contrib_Foo` → `nd.contrib.Foo` (reference
+# python/mxnet/ndarray/contrib.py generated the same way)
+contrib = types.ModuleType(__name__ + ".contrib")
+contrib.__doc__ = "Contrib (experimental) operators (reference mx.nd.contrib)."
+for _name in _registry.list_ops(include_aliases=True):
+    if _name.startswith("_contrib_"):
+        setattr(contrib, _name[len("_contrib_"):], _make_op_func(_registry.get(_name), _name))
+sys.modules[contrib.__name__] = contrib
+
 
 # ---------------------------------------------------------------------------
 # creation functions with ctx handling (reference ndarray.py zeros/ones/...)

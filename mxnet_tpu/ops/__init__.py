@@ -14,5 +14,6 @@ from . import indexing  # noqa: F401
 from . import nn  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import rnn  # noqa: F401
+from . import detection  # noqa: F401
 
 _load_all = True

@@ -25,3 +25,11 @@ for _name in _registry.list_ops(include_aliases=True):
     if not hasattr(sys.modules[__name__], _name):
         setattr(sys.modules[__name__], _name, _f)
 sys.modules[op.__name__] = op
+
+# contrib namespace: `_contrib_Foo` → `sym.contrib.Foo`
+contrib = types.ModuleType(__name__ + ".contrib")
+contrib.__doc__ = "Contrib (experimental) operators as Symbol builders."
+for _name in _registry.list_ops(include_aliases=True):
+    if _name.startswith("_contrib_"):
+        setattr(contrib, _name[len("_contrib_"):], _make_sym_op_func(_registry.get(_name), _name))
+sys.modules[contrib.__name__] = contrib

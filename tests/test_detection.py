@@ -1,0 +1,431 @@
+"""Detection op tests — numpy oracles implementing the reference kernels'
+documented semantics (reference tests live in
+tests/python/unittest/test_operator.py::test_roipooling / test_proposal etc.;
+oracles here are written from the algorithm, independent of both codebases).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+# ---------------------------------------------------------------------------
+# numpy oracles
+# ---------------------------------------------------------------------------
+
+
+def np_roi_pooling(data, rois, pooled, scale):
+    B, C, H, W = data.shape
+    PH, PW = pooled
+    R = rois.shape[0]
+    out = np.zeros((R, C, PH, PW), data.dtype)
+    for r in range(R):
+        b = int(rois[r, 0])
+        xs = int(round(rois[r, 1] * scale))
+        ys = int(round(rois[r, 2] * scale))
+        xe = int(round(rois[r, 3] * scale))
+        ye = int(round(rois[r, 4] * scale))
+        rh, rw = max(ye - ys + 1, 1), max(xe - xs + 1, 1)
+        for ph in range(PH):
+            for pw in range(PW):
+                hs = min(max(int(np.floor(ph * rh / PH)) + ys, 0), H)
+                he = min(max(int(np.ceil((ph + 1) * rh / PH)) + ys, 0), H)
+                ws = min(max(int(np.floor(pw * rw / PW)) + xs, 0), W)
+                we = min(max(int(np.ceil((pw + 1) * rw / PW)) + xs, 0), W)
+                if he <= hs or we <= ws:
+                    continue
+                out[r, :, ph, pw] = data[b, :, hs:he, ws:we].max(axis=(1, 2))
+    return out
+
+
+def np_bilinear(plane, y, x):
+    H, W = plane.shape
+    y, x = min(max(y, 0.0), H - 1.0), min(max(x, 0.0), W - 1.0)
+    y0, x0 = int(np.floor(y)), int(np.floor(x))
+    y1, x1 = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+    ly, lx = y - y0, x - x0
+    return (
+        plane[y0, x0] * (1 - ly) * (1 - lx)
+        + plane[y0, x1] * (1 - ly) * lx
+        + plane[y1, x0] * ly * (1 - lx)
+        + plane[y1, x1] * ly * lx
+    )
+
+
+def np_roi_align(data, rois, pooled, scale, ratio):
+    B, C, H, W = data.shape
+    PH, PW = pooled
+    R = rois.shape[0]
+    out = np.zeros((R, C, PH, PW), np.float64)
+    for r in range(R):
+        b = int(rois[r, 0])
+        x1, y1, x2, y2 = rois[r, 1:] * scale
+        rw, rh = max(x2 - x1, 1.0), max(y2 - y1, 1.0)
+        bh, bw = rh / PH, rw / PW
+        gh = ratio if ratio > 0 else int(np.ceil(rh / PH))
+        gw = ratio if ratio > 0 else int(np.ceil(rw / PW))
+        for ph in range(PH):
+            for pw in range(PW):
+                acc = np.zeros(C)
+                for iy in range(gh):
+                    yy = y1 + ph * bh + (iy + 0.5) * bh / gh
+                    for ix in range(gw):
+                        xx = x1 + pw * bw + (ix + 0.5) * bw / gw
+                        if yy < -1.0 or yy > H or xx < -1.0 or xx > W:
+                            continue
+                        acc += np.array([np_bilinear(data[b, c], yy, xx) for c in range(C)])
+                out[r, :, ph, pw] = acc / (gh * gw)
+    return out
+
+
+def np_psroi_pooling(data, rois, scale, output_dim, pooled, group):
+    B, C, H, W = data.shape
+    R = rois.shape[0]
+    out = np.zeros((R, output_dim, pooled, pooled), np.float64)
+    for r in range(R):
+        b = int(rois[r, 0])
+        xs = round(rois[r, 1]) * scale
+        ys = round(rois[r, 2]) * scale
+        xe = (round(rois[r, 3]) + 1.0) * scale
+        ye = (round(rois[r, 4]) + 1.0) * scale
+        rw, rh = max(xe - xs, 0.1), max(ye - ys, 0.1)
+        bh, bw = rh / pooled, rw / pooled
+        for ct in range(output_dim):
+            for ph in range(pooled):
+                for pw in range(pooled):
+                    hs = min(max(int(np.floor(ph * bh + ys)), 0), H)
+                    he = min(max(int(np.ceil((ph + 1) * bh + ys)), 0), H)
+                    ws = min(max(int(np.floor(pw * bw + xs)), 0), W)
+                    we = min(max(int(np.ceil((pw + 1) * bw + xs)), 0), W)
+                    gh = min(max(ph * group // pooled, 0), group - 1)
+                    gw = min(max(pw * group // pooled, 0), group - 1)
+                    c = (ct * group + gh) * group + gw
+                    if he <= hs or we <= ws:
+                        continue
+                    out[r, ct, ph, pw] = data[b, c, hs:he, ws:we].mean()
+    return out
+
+
+def np_deformable_psroi(data, rois, trans, scale, output_dim, group, pooled, part, spp, trans_std, no_trans):
+    B, C, H, W = data.shape
+    R = rois.shape[0]
+    out = np.zeros((R, output_dim, pooled, pooled), np.float64)
+    num_classes = 1 if no_trans else trans.shape[1] // 2
+    cpc = output_dim // num_classes
+    for r in range(R):
+        b = int(rois[r, 0])
+        xs = round(rois[r, 1]) * scale - 0.5
+        ys = round(rois[r, 2]) * scale - 0.5
+        xe = (round(rois[r, 3]) + 1.0) * scale - 0.5
+        ye = (round(rois[r, 4]) + 1.0) * scale - 0.5
+        rw, rh = max(xe - xs, 0.1), max(ye - ys, 0.1)
+        bh, bw = rh / pooled, rw / pooled
+        sub_h, sub_w = bh / spp, bw / spp
+        for ct in range(output_dim):
+            cls = ct // cpc
+            for ph in range(pooled):
+                for pw in range(pooled):
+                    p_h = int(np.floor(float(ph) / pooled * part))
+                    p_w = int(np.floor(float(pw) / pooled * part))
+                    tx = 0.0 if no_trans else trans[r, cls * 2, p_h, p_w] * trans_std
+                    ty = 0.0 if no_trans else trans[r, cls * 2 + 1, p_h, p_w] * trans_std
+                    wst = pw * bw + xs + tx * rw
+                    hst = ph * bh + ys + ty * rh
+                    gh = min(max(ph * group // pooled, 0), group - 1)
+                    gw = min(max(pw * group // pooled, 0), group - 1)
+                    c = (ct * group + gh) * group + gw
+                    acc, cnt = 0.0, 0
+                    for ih in range(spp):
+                        for iw in range(spp):
+                            w_ = wst + iw * sub_w
+                            h_ = hst + ih * sub_h
+                            if w_ < -0.5 or w_ > W - 0.5 or h_ < -0.5 or h_ > H - 0.5:
+                                continue
+                            acc += np_bilinear(data[b, c], h_, w_)
+                            cnt += 1
+                    out[r, ct, ph, pw] = 0.0 if cnt == 0 else acc / cnt
+    return out
+
+
+def np_deformable_conv(data, offset, weight, bias, kernel, stride, dilate, pad, groups, dg):
+    B, C, H, W = data.shape
+    F = weight.shape[0]
+    kh, kw = kernel
+    sh, sw = stride
+    dh, dw = dilate
+    ph, pw = pad
+    Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    col = np.zeros((B, C, kh * kw, Ho, Wo))
+    for b in range(B):
+        for c in range(C):
+            g = c // (C // dg)
+            for i in range(kh):
+                for j in range(kw):
+                    t = i * kw + j
+                    for ho in range(Ho):
+                        for wo in range(Wo):
+                            oy = offset[b, g * 2 * kh * kw + 2 * t, ho, wo]
+                            ox = offset[b, g * 2 * kh * kw + 2 * t + 1, ho, wo]
+                            y = ho * sh - ph + i * dh + oy
+                            x = wo * sw - pw + j * dw + ox
+                            if y < 0 or y >= H or x < 0 or x >= W:
+                                continue
+                            col[b, c, t, ho, wo] = np_bilinear(data[b, c], y, x)
+    cpg = C // groups
+    fpg = F // groups
+    out = np.zeros((B, F, Ho, Wo))
+    for b in range(B):
+        for g in range(groups):
+            w_ = weight[g * fpg:(g + 1) * fpg].reshape(fpg, -1)
+            c_ = col[b, g * cpg:(g + 1) * cpg].reshape(cpg * kh * kw, -1)
+            out[b, g * fpg:(g + 1) * fpg] = (w_ @ c_).reshape(fpg, Ho, Wo)
+    if bias is not None:
+        out += bias[None, :, None, None]
+    return out
+
+
+def np_multi_proposal(cls_prob, bbox_pred, im_info, stride, scales, ratios, pre_nms, post_nms, thresh, min_size):
+    # anchors
+    base = np.array([0, 0, stride - 1, stride - 1], np.float32)
+    w0 = base[2] - base[0] + 1
+    h0 = base[3] - base[1] + 1
+    cx, cy = base[0] + 0.5 * (w0 - 1), base[1] + 0.5 * (h0 - 1)
+    size = w0 * h0
+    anchors = []
+    for r in ratios:
+        sr = np.floor(size / r)
+        nw = np.floor(np.sqrt(sr) + 0.5)
+        nh = np.floor(nw * r + 0.5)
+        for s in scales:
+            ws, hs = nw * s, nh * s
+            anchors.append([cx - 0.5 * (ws - 1), cy - 0.5 * (hs - 1), cx + 0.5 * (ws - 1), cy + 0.5 * (hs - 1)])
+    anchors = np.array(anchors, np.float32)
+    A = anchors.shape[0]
+    B, _, Hf, Wf = cls_prob.shape
+    rois_all, scores_all = [], []
+    for b in range(B):
+        im_h, im_w, im_scale = im_info[b]
+        props = []
+        for h in range(Hf):
+            for w in range(Wf):
+                for a in range(A):
+                    box = anchors[a] + np.array([w * stride, h * stride, w * stride, h * stride])
+                    bw = box[2] - box[0] + 1
+                    bh = box[3] - box[1] + 1
+                    bcx = box[0] + 0.5 * (bw - 1)
+                    bcy = box[1] + 0.5 * (bh - 1)
+                    dx, dy, dw_, dh_ = bbox_pred[b, 4 * a:4 * a + 4, h, w]
+                    pcx, pcy = dx * bw + bcx, dy * bh + bcy
+                    pw_, ph_ = np.exp(dw_) * bw, np.exp(dh_) * bh
+                    x1 = np.clip(pcx - 0.5 * (pw_ - 1), 0, im_w - 1)
+                    y1 = np.clip(pcy - 0.5 * (ph_ - 1), 0, im_h - 1)
+                    x2 = np.clip(pcx + 0.5 * (pw_ - 1), 0, im_w - 1)
+                    y2 = np.clip(pcy + 0.5 * (ph_ - 1), 0, im_h - 1)
+                    score = cls_prob[b, A + a, h, w]
+                    if h >= int(im_h / stride) or w >= int(im_w / stride):
+                        score = -1.0
+                    ms = min_size * im_scale
+                    if (x2 - x1 + 1) < ms or (y2 - y1 + 1) < ms:
+                        x1, y1, x2, y2 = x1 - ms / 2, y1 - ms / 2, x2 + ms / 2, y2 + ms / 2
+                        score = -1.0
+                    props.append([x1, y1, x2, y2, score])
+        props = np.array(props, np.float32)
+        order = np.argsort(-props[:, 4], kind="stable")[: min(pre_nms, len(props))]
+        ordered = props[order]
+        # greedy NMS, +1 areas
+        area = (ordered[:, 2] - ordered[:, 0] + 1) * (ordered[:, 3] - ordered[:, 1] + 1)
+        suppressed = np.zeros(len(ordered), bool)
+        keep = []
+        for i in range(len(ordered)):
+            if len(keep) >= post_nms:
+                break
+            if suppressed[i]:
+                continue
+            keep.append(i)
+            xx1 = np.maximum(ordered[i, 0], ordered[i + 1:, 0])
+            yy1 = np.maximum(ordered[i, 1], ordered[i + 1:, 1])
+            xx2 = np.minimum(ordered[i, 2], ordered[i + 1:, 2])
+            yy2 = np.minimum(ordered[i, 3], ordered[i + 1:, 3])
+            inter = np.maximum(0, xx2 - xx1 + 1) * np.maximum(0, yy2 - yy1 + 1)
+            iou = inter / (area[i] + area[i + 1:] - inter)
+            suppressed[i + 1:] |= iou > thresh
+        out = np.zeros((post_nms, 5), np.float32)
+        osc = np.zeros((post_nms, 1), np.float32)
+        for i in range(post_nms):
+            idx = keep[i] if i < len(keep) else keep[i % len(keep)]
+            out[i, 0] = b
+            out[i, 1:] = ordered[idx, :4]
+            osc[i, 0] = ordered[idx, 4]
+        rois_all.append(out)
+        scores_all.append(osc)
+    return np.concatenate(rois_all), np.concatenate(scores_all)
+
+
+# ---------------------------------------------------------------------------
+# tests
+# ---------------------------------------------------------------------------
+
+
+def test_roi_pooling():
+    data = np.random.randn(2, 3, 12, 9).astype(np.float32)
+    rois = np.array(
+        [
+            [0, 0, 0, 16, 16],
+            [1, 2, 3, 15, 13],
+            [0, 7, 3, 24, 22],  # exceeds the map after scaling
+            [1, 5, 5, 5, 5],  # degenerate single-pixel roi
+        ],
+        np.float32,
+    )
+    out = nd.ROIPooling(nd.array(data), nd.array(rois), pooled_size=(3, 3), spatial_scale=0.5).asnumpy()
+    exp = np_roi_pooling(data, rois, (3, 3), 0.5)
+    assert_almost_equal(out, exp, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("ratio", [2, -1])
+def test_roi_align(ratio):
+    data = np.random.randn(2, 4, 10, 10).astype(np.float32)
+    rois = np.array([[0, 1, 1, 8, 8], [1, 0, 0, 18, 12], [0, 3.3, 2.2, 6.1, 7.9]], np.float32)
+    out = nd.contrib.ROIAlign(
+        nd.array(data), nd.array(rois), pooled_size=(2, 2), spatial_scale=0.5, sample_ratio=ratio
+    ).asnumpy()
+    exp = np_roi_align(data, rois, (2, 2), 0.5, ratio)
+    assert_almost_equal(out, exp, rtol=1e-4, atol=1e-5)
+
+
+def test_psroi_pooling():
+    group, od = 3, 4
+    data = np.random.randn(2, group * group * od, 9, 9).astype(np.float32)
+    rois = np.array([[0, 0, 0, 14, 14], [1, 2, 4, 17, 15]], np.float32)
+    out = nd.contrib.PSROIPooling(
+        nd.array(data), nd.array(rois), spatial_scale=0.5, output_dim=od, pooled_size=group, group_size=group
+    ).asnumpy()
+    exp = np_psroi_pooling(data, rois, 0.5, od, group, group)
+    assert_almost_equal(out, exp, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("no_trans", [True, False])
+def test_deformable_psroi_pooling(no_trans):
+    group = pooled = part = 3
+    od = 2
+    data = np.random.randn(2, group * group * od, 9, 9).astype(np.float32)
+    rois = np.array([[0, 0, 0, 14, 14], [1, 2, 4, 17, 15]], np.float32)
+    trans = (np.random.rand(2, 2, part, part).astype(np.float32) - 0.5)
+    out = nd.contrib.DeformablePSROIPooling(
+        nd.array(data),
+        nd.array(rois),
+        nd.array(trans),
+        spatial_scale=0.5,
+        output_dim=od,
+        group_size=group,
+        pooled_size=pooled,
+        part_size=part,
+        sample_per_part=2,
+        trans_std=0.1,
+        no_trans=no_trans,
+    ).asnumpy()
+    exp = np_deformable_psroi(data, rois, trans, 0.5, od, group, pooled, part, 2, 0.1, no_trans)
+    assert_almost_equal(out, exp, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_convolution_matches_conv_at_zero_offset():
+    data = np.random.randn(1, 4, 7, 7).astype(np.float32)
+    weight = np.random.randn(6, 4, 3, 3).astype(np.float32)
+    bias = np.random.randn(6).astype(np.float32)
+    offset = np.zeros((1, 2 * 9, 5, 5), np.float32)
+    out = nd.contrib.DeformableConvolution(
+        nd.array(data), nd.array(offset), nd.array(weight), nd.array(bias), kernel=(3, 3), num_filter=6
+    ).asnumpy()
+    ref = nd.Convolution(
+        nd.array(data), nd.array(weight), nd.array(bias), kernel=(3, 3), num_filter=6
+    ).asnumpy()
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_convolution():
+    B, C, H, W = 2, 4, 6, 5
+    kernel, stride, dilate, pad = (3, 3), (2, 2), (1, 1), (1, 1)
+    dg = 2
+    Ho = (H + 2 - 3) // 2 + 1
+    Wo = (W + 2 - 3) // 2 + 1
+    data = np.random.randn(B, C, H, W).astype(np.float32)
+    weight = np.random.randn(4, C, 3, 3).astype(np.float32)
+    offset = np.random.randn(B, 2 * dg * 9, Ho, Wo).astype(np.float32)
+    out = nd.contrib.DeformableConvolution(
+        nd.array(data), nd.array(offset), nd.array(weight),
+        kernel=kernel, num_filter=4, stride=stride, dilate=dilate, pad=pad,
+        num_deformable_group=dg, no_bias=True,
+    ).asnumpy()
+    exp = np_deformable_conv(data, offset, weight, None, kernel, stride, dilate, pad, 1, dg)
+    assert_almost_equal(out, exp, rtol=1e-3, atol=1e-4)
+
+
+def test_deformable_convolution_grad():
+    # jax AD of the gather formulation vs finite differences (replaces the
+    # reference's hand-written deformable_col2im backward)
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.registry import get as get_op
+
+    op = get_op("_contrib_DeformableConvolution")
+    data = np.random.randn(1, 2, 5, 5).astype(np.float32)
+    weight = np.random.randn(3, 2, 3, 3).astype(np.float32)
+    offset = 0.3 * np.random.randn(1, 18, 5, 5).astype(np.float32)
+
+    def f(d, o, w):
+        return op.fn(d, o, w, None, kernel=(3, 3), num_filter=3, pad=(1, 1), no_bias=True).sum()
+
+    g_data, g_off, g_w = jax.grad(f, argnums=(0, 1, 2))(data, offset, weight)
+    eps = np.float32(1e-2)  # float32 finite differences
+    for arr, g, name in [(data, g_data, "data"), (offset, g_off, "offset"), (weight, g_w, "weight")]:
+        idx = tuple(np.unravel_index(np.argmax(np.abs(np.asarray(g))), arr.shape))
+        p = arr.copy()
+        p[idx] += eps
+        m = arr.copy()
+        m[idx] -= eps
+        args_p = [p if name == "data" else data, p if name == "offset" else offset, p if name == "weight" else weight]
+        num = (f(*args_p) - f(*[m if name == "data" else data, m if name == "offset" else offset, m if name == "weight" else weight])) / (2 * eps)
+        assert_almost_equal(np.asarray(g)[idx], np.asarray(num), rtol=2e-2, atol=1e-2, names=(name, "fd"))
+
+
+def test_multi_proposal():
+    np.random.seed(3)
+    B, A, Hf, Wf = 2, 9, 4, 4
+    stride = 16
+    scales, ratios = (8, 16, 32), (0.5, 1, 2)
+    cls_prob = np.random.rand(B, 2 * A, Hf, Wf).astype(np.float32)
+    bbox_pred = (0.2 * np.random.randn(B, 4 * A, Hf, Wf)).astype(np.float32)
+    im_info = np.array([[64, 64, 1.5], [48, 64, 2.0]], np.float32)
+    post = 8
+    rois, scores = nd.contrib.MultiProposal(
+        nd.array(cls_prob), nd.array(bbox_pred), nd.array(im_info),
+        feature_stride=stride, scales=scales, ratios=ratios,
+        rpn_pre_nms_top_n=60, rpn_post_nms_top_n=post, threshold=0.7,
+        rpn_min_size=8, output_score=True,
+    )
+    exp_rois, exp_scores = np_multi_proposal(
+        cls_prob, bbox_pred, im_info, stride, scales, ratios, 60, post, 0.7, 8
+    )
+    assert_almost_equal(rois.asnumpy(), exp_rois, rtol=1e-4, atol=1e-4)
+    assert_almost_equal(scores.asnumpy(), exp_scores, rtol=1e-4, atol=1e-4)
+
+
+def test_proposal_symbol():
+    # symbolic-path smoke: Proposal inside a Symbol graph
+    from mxnet_tpu import sym
+
+    cls = sym.Variable("cls")
+    bbox = sym.Variable("bbox")
+    info = sym.Variable("info")
+    p = sym.contrib.MultiProposal(cls, bbox, info, rpn_post_nms_top_n=4, rpn_pre_nms_top_n=12,
+                                  scales=(8,), ratios=(1.0,), feature_stride=16)
+    exe = p.simple_bind(mx.cpu(), cls=(1, 2, 3, 3), bbox=(1, 4, 3, 3), info=(1, 3))
+    exe.arg_dict["cls"][:] = nd.array(np.random.rand(1, 2, 3, 3).astype(np.float32))
+    exe.arg_dict["bbox"][:] = nd.array(0.1 * np.random.randn(1, 4, 3, 3).astype(np.float32))
+    exe.arg_dict["info"][:] = nd.array(np.array([[48, 48, 1.0]], np.float32))
+    out = exe.forward()[0]
+    assert out.shape == (4, 5)
+    assert np.isfinite(out.asnumpy()).all()

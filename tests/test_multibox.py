@@ -1,0 +1,228 @@
+"""MultiBox (SSD) + generic box op tests vs numpy oracles (reference
+tests/python/unittest/test_operator.py multibox cases + test_bounding_box)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def np_multibox_prior(H, W, sizes, ratios, clip, steps, offsets):
+    step_y = 1.0 / H if steps[0] <= 0 else steps[0]
+    step_x = 1.0 / W if steps[1] <= 0 else steps[1]
+    out = []
+    for r in range(H):
+        cy = (r + offsets[0]) * step_y
+        for c in range(W):
+            cx = (c + offsets[1]) * step_x
+            for s in sizes:
+                w, h = s * H / W / 2, s / 2
+                out.append([cx - w, cy - h, cx + w, cy + h])
+            for rt in ratios[1:]:
+                sq = np.sqrt(rt)
+                w, h = sizes[0] * H / W * sq / 2, sizes[0] / sq / 2
+                out.append([cx - w, cy - h, cx + w, cy + h])
+    out = np.array(out, np.float32)[None]
+    return np.clip(out, 0, 1) if clip else out
+
+
+def np_iou(a, b):
+    tl = np.maximum(a[:2], b[:2])
+    br = np.minimum(a[2:], b[2:])
+    wh = np.maximum(br - tl, 0)
+    inter = wh[0] * wh[1]
+    u = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return 0.0 if u <= 0 else inter / u
+
+
+def np_multibox_target(anchors, labels, cls_preds, overlap=0.5, ignore=-1.0,
+                       neg_ratio=-1.0, neg_thresh=0.5, variances=(0.1, 0.1, 0.2, 0.2)):
+    B, L, _ = labels.shape
+    A = anchors.shape[0]
+    loc_t = np.zeros((B, A * 4), np.float32)
+    loc_m = np.zeros((B, A * 4), np.float32)
+    cls_t = np.full((B, A), ignore, np.float32)
+    for b in range(B):
+        lab = labels[b]
+        nvalid = 0
+        for i in range(L):
+            if lab[i, 0] == -1:
+                break
+            nvalid += 1
+        if nvalid == 0:
+            continue
+        ious = np.array([[np_iou(anchors[j], lab[k, 1:5]) for k in range(nvalid)] for j in range(A)])
+        gt_flags = np.zeros(nvalid, bool)
+        flags = np.full(A, -1, np.int8)
+        match = np.full(A, -1, np.int32)
+        match_iou = np.full(A, -1.0, np.float32)
+        num_pos = 0
+        while not gt_flags.all():
+            best = (-1, -1, 1e-6)
+            for j in range(A):
+                if flags[j] == 1:
+                    continue
+                for k in range(nvalid):
+                    if gt_flags[k]:
+                        continue
+                    if ious[j, k] > best[2]:
+                        best = (j, k, ious[j, k])
+            if best[0] == -1:
+                break
+            j, k, v = best
+            match[j], match_iou[j] = k, v
+            gt_flags[k] = True
+            flags[j] = 1
+            num_pos += 1
+        if overlap > 0:
+            for j in range(A):
+                if flags[j] == 1:
+                    continue
+                k = int(np.argmax(ious[j])) if nvalid else -1
+                if k >= 0:
+                    match[j], match_iou[j] = k, ious[j, k]
+                    if ious[j, k] > overlap:
+                        flags[j] = 1
+                        num_pos += 1
+        if neg_ratio > 0:
+            num_neg = min(int(num_pos * neg_ratio), A - num_pos)
+            if num_neg > 0:
+                cand = []
+                for j in range(A):
+                    if flags[j] != 1 and match_iou[j] < neg_thresh:
+                        z = cls_preds[b, :, j]
+                        p = np.exp(z - z.max())
+                        cand.append((-(p[0] / p.sum()), j))
+                cand.sort(key=lambda t: t[0], reverse=True)
+                for i in range(num_neg):
+                    flags[cand[i][1]] = 0
+        else:
+            flags[flags != 1] = 0
+        vx, vy, vw, vh = variances
+        for j in range(A):
+            if flags[j] == 1:
+                g = lab[match[j]]
+                cls_t[b, j] = g[0] + 1
+                al, at, ar, ab_ = anchors[j]
+                aw, ah = ar - al, ab_ - at
+                ax, ay = (al + ar) / 2, (at + ab_) / 2
+                gw, gh = g[3] - g[1], g[4] - g[2]
+                gx, gy = (g[1] + g[3]) / 2, (g[2] + g[4]) / 2
+                loc_t[b, j * 4:j * 4 + 4] = [(gx - ax) / aw / vx, (gy - ay) / ah / vy,
+                                             np.log(gw / aw) / vw, np.log(gh / ah) / vh]
+                loc_m[b, j * 4:j * 4 + 4] = 1
+            elif flags[j] == 0:
+                cls_t[b, j] = 0
+    return loc_t, loc_m, cls_t
+
+
+def test_multibox_prior():
+    data = np.zeros((1, 3, 5, 6), np.float32)
+    for sizes, ratios, clip in [((0.5,), (1.0,), False), ((0.3, 0.6), (1.0, 2.0, 0.5), True)]:
+        out = nd.contrib.MultiBoxPrior(nd.array(data), sizes=sizes, ratios=ratios, clip=clip).asnumpy()
+        exp = np_multibox_prior(5, 6, sizes, ratios, clip, (-1, -1), (0.5, 0.5))
+        assert_almost_equal(out, exp, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("neg_ratio", [-1.0, 3.0])
+def test_multibox_target(neg_ratio):
+    np.random.seed(5)
+    A, B, L, C = 20, 2, 4, 3
+    # anchors in [0,1] corner format
+    ctr = np.random.rand(A, 2)
+    wh = 0.1 + 0.2 * np.random.rand(A, 2)
+    anchors = np.concatenate([ctr - wh / 2, ctr + wh / 2], axis=1).astype(np.float32)
+    labels = -np.ones((B, L, 5), np.float32)
+    labels[0, 0] = [1, 0.1, 0.1, 0.4, 0.4]
+    labels[0, 1] = [0, 0.5, 0.5, 0.9, 0.8]
+    labels[1, 0] = [2, 0.2, 0.3, 0.5, 0.6]
+    cls_preds = np.random.randn(B, C, A).astype(np.float32)
+    lt, lm, ct = (
+        x.asnumpy()
+        for x in nd.contrib.MultiBoxTarget(
+            nd.array(anchors[None]), nd.array(labels), nd.array(cls_preds),
+            overlap_threshold=0.5, negative_mining_ratio=neg_ratio, negative_mining_thresh=0.5,
+        )
+    )
+    elt, elm, ect = np_multibox_target(anchors, labels, cls_preds, 0.5, -1.0, neg_ratio, 0.5)
+    assert_almost_equal(lm, elm, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(ct, ect, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(lt, elt, rtol=1e-4, atol=1e-5)
+
+
+def test_multibox_detection():
+    np.random.seed(11)
+    B, C, A = 2, 3, 12
+    cls_prob = np.random.rand(B, C, A).astype(np.float32)
+    cls_prob /= cls_prob.sum(axis=1, keepdims=True)
+    loc_pred = 0.1 * np.random.randn(B, A * 4).astype(np.float32)
+    ctr = np.random.rand(A, 2)
+    wh = 0.1 + 0.2 * np.random.rand(A, 2)
+    anchors = np.concatenate([ctr - wh / 2, ctr + wh / 2], axis=1).astype(np.float32)[None]
+    out = nd.contrib.MultiBoxDetection(
+        nd.array(cls_prob), nd.array(loc_pred), nd.array(anchors),
+        threshold=0.2, nms_threshold=0.4, nms_topk=8,
+    ).asnumpy()
+    assert out.shape == (B, A, 6)
+    for b in range(B):
+        rows = out[b]
+        valid = rows[rows[:, 0] >= 0]
+        # sorted by score desc among surviving detections
+        assert (np.diff(valid[:, 1]) <= 1e-6).all()
+        # every surviving pair of same class has IoU <= nms_threshold
+        for i in range(len(valid)):
+            for j in range(i + 1, len(valid)):
+                if valid[i, 0] == valid[j, 0]:
+                    assert np_iou(valid[i, 2:], valid[j, 2:]) <= 0.4 + 1e-6
+        # scores >= threshold for valid
+        assert (valid[:, 1] >= 0.2 - 1e-6).all()
+
+
+def test_box_iou():
+    np.random.seed(2)
+    a = np.random.rand(5, 4).astype(np.float32)
+    a[:, 2:] += a[:, :2]
+    b = np.random.rand(7, 4).astype(np.float32)
+    b[:, 2:] += b[:, :2]
+    out = nd.contrib.box_iou(nd.array(a), nd.array(b)).asnumpy()
+    exp = np.array([[np_iou(x, y) for y in b] for x in a])
+    assert_almost_equal(out, exp, rtol=1e-5, atol=1e-6)
+
+
+def test_box_nms():
+    data = np.array(
+        [
+            [0, 0.9, 0.1, 0.1, 0.5, 0.5],
+            [1, 0.8, 0.1, 0.1, 0.5, 0.5],  # overlaps first, different class
+            [0, 0.7, 0.12, 0.12, 0.52, 0.52],  # overlaps first, same class → suppressed
+            [0, 0.6, 0.6, 0.6, 0.9, 0.9],
+            [0, 0.01, 0.0, 0.0, 0.1, 0.1],  # below valid_thresh
+        ],
+        np.float32,
+    )
+    out = nd.contrib.box_nms(
+        nd.array(data[None]), overlap_thresh=0.5, valid_thresh=0.05, id_index=0,
+        coord_start=2, score_index=1,
+    ).asnumpy()[0]
+    # rows sorted by score: row0 kept, row1 kept (other class), row2 -1, row3 kept, row4 -1
+    assert out[0, 1] == pytest.approx(0.9)
+    assert out[1, 1] == pytest.approx(0.8)
+    assert (out[2] == -1).all()
+    assert out[3, 1] == pytest.approx(0.6)
+    assert (out[4] == -1).all()
+    # force_suppress kills cross-class overlap too
+    out2 = nd.contrib.box_nms(
+        nd.array(data[None]), overlap_thresh=0.5, valid_thresh=0.05, id_index=0,
+        coord_start=2, score_index=1, force_suppress=True,
+    ).asnumpy()[0]
+    assert (out2[1] == -1).all()
+
+
+def test_bipartite_matching():
+    score = np.array([[0.9, 0.2], [0.8, 0.7], [0.1, 0.05]], np.float32)
+    rows, cols = nd.contrib.bipartite_matching(nd.array(score[None]), threshold=0.1)
+    rows, cols = rows.asnumpy()[0], cols.asnumpy()[0]
+    # greedy: (0,0)=0.9 first, then (1,1)=0.7; row2 below threshold
+    assert rows.tolist() == [0.0, 1.0, -1.0]
+    assert cols.tolist() == [0.0, 1.0]
