@@ -42,6 +42,12 @@ def __getattr__(name):
         "callback": ".callback",
         "lr_scheduler": ".lr_scheduler",
         "model": ".model",
+        "name": ".name",
+        "attribute": ".attribute",
+        "operator": ".operator",
+        "rnn": ".rnn",
+        "executor_manager": ".executor_manager",
+        "viz": ".visualization",
         "profiler": ".profiler",
         "recordio": ".recordio",
         "image": ".image",
@@ -54,7 +60,13 @@ def __getattr__(name):
         "engine": ".engine",
     }
     if name in lazy:
-        mod = importlib.import_module(lazy[name], __name__)
+        try:
+            mod = importlib.import_module(lazy[name], __name__)
+        except ModuleNotFoundError as e:
+            # keep hasattr()-style feature detection working
+            raise AttributeError(
+                "module %r has no attribute %r (%s)" % (__name__, name, e)
+            ) from e
         globals()[name] = mod
         return mod
     raise AttributeError("module %r has no attribute %r" % (__name__, name))

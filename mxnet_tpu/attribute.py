@@ -1,0 +1,8 @@
+"""Attribute scoping support (reference ``python/mxnet/attribute.py``).
+
+``AttrScope`` lives in :mod:`mxnet_tpu.base`; this module keeps the
+reference's import path (``mx.attribute.AttrScope``).
+"""
+from .base import AttrScope
+
+__all__ = ["AttrScope"]

@@ -1,0 +1,97 @@
+"""Engine facade — execution-mode control over XLA async dispatch.
+
+TPU-native stand-in for the reference dependency engine's user-visible knobs
+(reference ``src/engine/engine.cc:32-44`` factory selected by
+``MXNET_ENGINE_TYPE``; bulk mode ``src/engine/threaded_engine.h:410``).
+
+There is no threaded scheduler to configure here: JAX's async dispatch + the
+XLA latency-hiding scheduler play that role (SURVEY §7.1).  What remains
+meaningful:
+
+- ``NaiveEngine`` ≡ synchronous, un-jitted execution for debugging — mapped
+  to ``jax.disable_jit()`` so every op runs eagerly with usable tracebacks
+  (reference ``docs/faq/env_var.md:52-56``).
+- ``wait_all`` / ``wait_to_read`` block on outstanding device work
+  (reference ``Engine::WaitForAll`` / ``WaitForVar``,
+  ``src/engine/threaded_engine.cc:367``).
+- bulk mode (op fusion across engine pushes) is what ``jax.jit`` does by
+  construction; ``set_bulk_size`` is accepted and recorded for API parity.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+__all__ = [
+    "engine_type",
+    "set_bulk_size",
+    "bulk",
+    "wait_all",
+    "naive_engine",
+    "is_naive",
+]
+
+_BULK_SIZE = int(os.environ.get("MXNET_EXECUTOR_BULK_EXEC_MAX_NODE_TRAIN", 15))
+_NAIVE = os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine"
+_naive_cm = None
+
+
+def engine_type():
+    """Current engine flavour: 'ThreadedEnginePerDevice' (async XLA dispatch)
+    or 'NaiveEngine' (sync, jit disabled)."""
+    return "NaiveEngine" if _NAIVE else "ThreadedEnginePerDevice"
+
+
+def is_naive():
+    return _NAIVE
+
+
+def naive_engine(enable=True):
+    """Switch synchronous debug mode on/off at runtime.
+
+    Enabling enters ``jax.disable_jit()`` globally so compiled callables run
+    op-by-op; the reference gets the same effect by exporting
+    ``MXNET_ENGINE_TYPE=NaiveEngine`` before startup.
+    """
+    global _NAIVE, _naive_cm
+    import jax
+
+    if enable and not _NAIVE:
+        _naive_cm = jax.disable_jit()
+        _naive_cm.__enter__()
+        _NAIVE = True
+    elif not enable and _NAIVE:
+        if _naive_cm is not None:
+            _naive_cm.__exit__(None, None, None)
+            _naive_cm = None
+        _NAIVE = False
+
+
+def set_bulk_size(size):
+    """Set max ops per bulk segment; returns the previous value.
+
+    XLA fuses whole jitted programs regardless, so this is a recorded
+    preference, not a scheduler knob (reference
+    ``MXEngineSetBulkSize`` / ``BulkStatus`` threaded_engine.h:410).
+    """
+    global _BULK_SIZE
+    old, _BULK_SIZE = _BULK_SIZE, int(size)
+    return old
+
+
+@contextlib.contextmanager
+def bulk(size):
+    """Scoped bulk-size override (reference ``mx.engine.bulk``)."""
+    old = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(old)
+
+
+def wait_all():
+    """Block until all outstanding device computation finishes
+    (reference ``Engine::WaitForAll``)."""
+    from .ndarray.ndarray import waitall
+
+    waitall()

@@ -1,0 +1,149 @@
+"""Legacy multi-device executor helpers (reference ``python/mxnet/executor_manager.py``).
+
+The Module package is the maintained multi-device path; this keeps the legacy
+``FeedForward``-era API importable: batch-slicing across contexts and a thin
+``DataParallelExecutorManager`` driving one executor per context.  On TPU the
+real data parallelism is a sharded jit (SURVEY §2.2) — these slices map to
+per-device shards of the global batch.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["_split_input_slice", "_check_arguments", "DataParallelExecutorManager"]
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Split a batch into per-device slices proportional to work loads
+    (reference executor_manager.py:33).  Returns a list of ``slice``s."""
+    total = sum(work_load_list)
+    if total <= 0:
+        raise MXNetError("Invalid workload")
+    batch_num_list = [round(batch_size * v / total) for v in work_load_list]
+    slices = []
+    end = 0
+    for n in batch_num_list:
+        begin = int(min(end, batch_size))
+        end = int(min(begin + n, batch_size))
+        if begin >= end:
+            raise MXNetError("Too many slices. Some splits are empty.")
+        slices.append(slice(begin, end))
+    return slices
+
+
+def _check_arguments(symbol):
+    """Assert argument/aux names are unique (reference executor_manager.py:57)."""
+    arg_names = symbol.list_arguments()
+    if len(set(arg_names)) != len(arg_names):
+        raise MXNetError(
+            "Find duplicated argument name,"
+            " please make the weight name non-duplicated, arg_names=%s" % str(arg_names)
+        )
+    aux_names = symbol.list_auxiliary_states()
+    if len(set(aux_names)) != len(aux_names):
+        raise MXNetError(
+            "Find duplicated auxiliary param name, aux_names=%s" % str(aux_names)
+        )
+
+
+class DataParallelExecutorManager:
+    """Legacy helper running one executor per context (reference :205).
+
+    Delegates to per-context ``simple_bind`` executors; gradients are summed
+    on host.  New code should use ``mxnet_tpu.module.Module`` (which compiles
+    a single sharded step instead).
+    """
+
+    def __init__(
+        self,
+        symbol,
+        ctx,
+        train_data,
+        arg_names=None,
+        param_names=None,
+        aux_names=None,
+        work_load_list=None,
+        logger=None,
+        sym_gen=None,
+    ):
+        self.logger = logger or logging
+        num_device = len(ctx)
+        self.logger.info("Start training with %s", str(ctx))
+        if work_load_list is None:
+            work_load_list = [1] * num_device
+        if len(work_load_list) != num_device:
+            raise MXNetError("Invalid settings for work load.")
+        self.symbol = symbol
+        self.ctx = ctx
+        self.slices = _split_input_slice(train_data.batch_size, work_load_list)
+        self.arg_names = arg_names or symbol.list_arguments()
+        self.aux_names = aux_names or symbol.list_auxiliary_states()
+        data_names = [d.name for d in train_data.provide_data] + [
+            l.name for l in train_data.provide_label
+        ]
+        self.param_names = param_names or [
+            n for n in self.arg_names if n not in data_names
+        ]
+        _check_arguments(symbol)
+
+        shapes = {d.name: d.shape for d in train_data.provide_data}
+        shapes.update({l.name: l.shape for l in train_data.provide_label})
+        self.execs = []
+        for i, _ in enumerate(ctx):
+            sl = self.slices[i]
+            dev_shapes = {
+                k: (sl.stop - sl.start,) + tuple(s[1:]) for k, s in shapes.items()
+            }
+            self.execs.append(symbol.simple_bind(ctx=ctx[i], grad_req="write", **dev_shapes))
+
+    @property
+    def param_arrays(self):
+        return [
+            [e.arg_dict[n] for e in self.execs] for n in self.param_names
+        ]
+
+    @property
+    def grad_arrays(self):
+        return [
+            [e.grad_dict.get(n) for e in self.execs] for n in self.param_names
+        ]
+
+    @property
+    def aux_arrays(self):
+        return [[e.aux_dict[n] for e in self.execs] for n in self.aux_names]
+
+    def install_monitor(self, monitor):
+        for e in self.execs:
+            monitor.install(e)
+
+    def set_params(self, arg_params, aux_params):
+        for e in self.execs:
+            e.copy_params_from(arg_params, aux_params)
+
+    def load_data_batch(self, data_batch):
+        self._batch = data_batch
+
+    def forward(self, is_train=False):
+        from .ndarray import array
+
+        for i, e in enumerate(self.execs):
+            sl = self.slices[i]
+            for desc, d in zip(self._batch.provide_data, self._batch.data):
+                e.arg_dict[desc.name]._rebind(array(d.asnumpy()[sl])._data)
+            for desc, l in zip(self._batch.provide_label, self._batch.label or []):
+                e.arg_dict[desc.name]._rebind(array(l.asnumpy()[sl])._data)
+            e.forward(is_train=is_train)
+
+    def backward(self):
+        for e in self.execs:
+            e.backward()
+
+    def update_metric(self, metric, labels):
+        for i, e in enumerate(self.execs):
+            sl = self.slices[i]
+            labels_slice = [type(l)(l.asnumpy()[sl]) if hasattr(l, "asnumpy") else l[sl] for l in labels]
+            metric.update(labels_slice, e.outputs)

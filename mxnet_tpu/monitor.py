@@ -1,0 +1,84 @@
+"""Monitor — per-tensor training statistics (reference ``python/mxnet/monitor.py:33``).
+
+Hooks the executor's monitor callback (reference
+``include/mxnet/executor.h:172``, ``GraphExecutor::ExecuteMonCallback``
+graph_executor.cc:1562; here ``Executor.set_monitor_callback``, which runs
+forward un-jitted so every node output is observable) and collects a chosen
+statistic over outputs whose names match a regex.
+
+Typical use::
+
+    mon = mx.monitor.Monitor(100, norm_stat)
+    mod.install_monitor(mon)   # or mon.install(executor)
+    ...
+    mon.tic(); mod.forward(batch); print(mon.toc_print())
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    """Collect ``stat_func`` of matching tensors every ``interval`` batches.
+
+    Parameters mirror the reference: ``interval`` (batches between actives),
+    ``stat_func`` (ndarray → scalar/ndarray stat; default mean(|x|)),
+    ``pattern`` (regex on tensor names), ``sort`` (sort results by name).
+    """
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+
+            def stat_func(x):
+                return np.abs(x).mean()
+
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+    # executor callback — receives (name, value) per node output
+    def _stat_helper(self, name, value):
+        if not self.activated or not self.re_prog.match(name):
+            return
+        arr = np.asarray(value)
+        self.queue.append((self.step, name, self.stat_func(arr)))
+
+    def install(self, exe, monitor_all=False):
+        """Attach to an executor (reference Monitor.install)."""
+        exe.set_monitor_callback(self._stat_helper, monitor_all)
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting for this batch if the interval has elapsed."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Stop collecting; returns list of (step, tensor_name, stat)."""
+        if not self.activated:
+            return []
+        self.activated = False
+        res = []
+        queue = sorted(self.queue, key=lambda q: q[1]) if self.sort else self.queue
+        for n, k, v in queue:
+            res.append((n, k, str(v)))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """toc() + log each stat line (reference toc_print)."""
+        res = self.toc()
+        for n, k, v in res:
+            print("Batch: %7d %30s %s" % (n, k, v))
+        return res

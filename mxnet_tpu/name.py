@@ -1,0 +1,9 @@
+"""Automatic naming support (reference ``python/mxnet/name.py``).
+
+The implementations live in :mod:`mxnet_tpu.base` because Symbol building
+needs them at import time; this module keeps the reference's import path
+(``mx.name.NameManager`` / ``mx.name.Prefix``).
+"""
+from .base import NameManager, Prefix
+
+__all__ = ["NameManager", "Prefix"]

@@ -240,11 +240,17 @@ def psroi_pooling(data, rois, *, spatial_scale, output_dim, pooled_size, group_s
     mask_h = (hh[None, None, :] >= hstart[:, :, None]) & (hh[None, None, :] < hend[:, :, None])
     mask_w = (ww[None, None, :] >= wstart[:, :, None]) & (ww[None, None, :] < wend[:, :, None])
 
-    # masked bin sums as two einsum contractions (MXU-friendly), then ÷ area
+    # masked bin sums as two einsum contractions (MXU-friendly), then ÷ area.
+    # Contract H/W away on the full channel dim FIRST (O(C·PH·PW) result),
+    # then gather the position-sensitive channel per bin — avoids
+    # materializing a (OD,PH,PW,H,W) gather per ROI that XLA can't fuse
+    # into the contraction.
+    p_idx = jnp.arange(PH)[None, :, None]
+    q_idx = jnp.arange(PW)[None, None, :]
+
     def one(b, mh, mw):
-        feat = data[b][cin]  # (OD, PH, PW, H, W)
-        s = jnp.einsum("opqhw,ph,qw->opq", feat, mh.astype(f32), mw.astype(f32))
-        return s
+        s_all = jnp.einsum("chw,ph,qw->cpq", data[b], mh.astype(f32), mw.astype(f32))
+        return s_all[cin, p_idx, q_idx]  # (OD, PH, PW)
 
     out = jax.vmap(one)(batch_idx, mask_h, mask_w)  # (R, OD, PH, PW)
     cnt_h = (hend - hstart)[:, None, :, None].astype(f32)
@@ -338,11 +344,22 @@ def deformable_psroi_pooling(
         live = (sx >= -0.5) & (sx <= W - 0.5) & (sy >= -0.5) & (sy <= H - 0.5)
         syc = jnp.clip(sy, 0.0, H - 1.0)
         sxc = jnp.clip(sx, 0.0, W - 1.0)
-        planes = feat[cin]  # (OD, PH, PW, H, W)
-        v = jax.vmap(
-            lambda p, yy, xx: _bilinear(p, yy, xx)
-        )(planes.reshape(OD * PH * PW, H, W), syc.reshape(OD * PH * PW, spp, spp), sxc.reshape(OD * PH * PW, spp, spp))
-        v = v.reshape(OD, PH, PW, spp, spp)
+        # bilinear with a per-bin channel index: gather only the 4 corner
+        # values per sample instead of materializing feat[cin] as a
+        # (OD,PH,PW,H,W) copy of the feature map (snap rule as _bilinear)
+        y0 = jnp.floor(syc).astype(jnp.int32)
+        x0 = jnp.floor(sxc).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, H - 1)
+        x1 = jnp.minimum(x0 + 1, W - 1)
+        ly = syc - y0.astype(f32)
+        lx = sxc - x0.astype(f32)
+        c_idx = cin[..., None, None]  # (OD,PH,PW,1,1) broadcasts over samples
+        v = (
+            feat[c_idx, y0, x0] * (1 - ly) * (1 - lx)
+            + feat[c_idx, y0, x1] * (1 - ly) * lx
+            + feat[c_idx, y1, x0] * ly * (1 - lx)
+            + feat[c_idx, y1, x1] * ly * lx
+        )
         lf = live.astype(f32)
         cnt = lf.sum(axis=(3, 4))
         s = (v * lf).sum(axis=(3, 4))
@@ -479,6 +496,18 @@ def _generate_base_anchors(stride, scales, ratios):
     return np.array(out, np.float32)  # (A, 4)
 
 
+def _iou_row(boxes, area, i, plus_one=0.0):
+    """IoU of score-ordered corner ``boxes[i]`` vs all boxes — the one greedy
+    NMS step shared by every NMS op here.  ``plus_one=1.0`` selects the
+    reference's +1 pixel-area convention (multi_proposal.cc:221-273)."""
+    tl = jnp.maximum(boxes[i, :2], boxes[:, :2])
+    br = jnp.minimum(boxes[i, 2:], boxes[:, 2:])
+    wh = jnp.maximum(br - tl + plus_one, 0.0)
+    inter = wh[:, 0] * wh[:, 1]
+    union = area[i] + area - inter
+    return jnp.where(union <= 0, 0.0, inter / jnp.maximum(union, 1e-12))
+
+
 def _nms_fixed(boxes, thresh, max_keep):
     """Greedy NMS over score-ordered (N, 4) boxes, +1 area convention
     (multi_proposal.cc:221-273).  Returns (keep_idx (max_keep,), out_size).
@@ -491,12 +520,7 @@ def _nms_fixed(boxes, thresh, max_keep):
         suppressed, keep, cnt = state
         take = (~suppressed[i]) & (cnt < max_keep)
         keep = keep.at[jnp.where(take, cnt, max_keep)].set(i, mode="drop")
-        xx1 = jnp.maximum(boxes[i, 0], boxes[:, 0])
-        yy1 = jnp.maximum(boxes[i, 1], boxes[:, 1])
-        xx2 = jnp.minimum(boxes[i, 2], boxes[:, 2])
-        yy2 = jnp.minimum(boxes[i, 3], boxes[:, 3])
-        inter = jnp.maximum(0.0, xx2 - xx1 + 1.0) * jnp.maximum(0.0, yy2 - yy1 + 1.0)
-        iou = inter / (area[i] + area - inter)
+        iou = _iou_row(boxes, area, i, plus_one=1.0)
         suppressed = suppressed | (take & (iou > thresh) & (arange > i))
         return suppressed, keep, cnt + take.astype(jnp.int32)
 
@@ -544,8 +568,8 @@ def _proposal_one_image(scores_fg, deltas, im_info, anchors, stride, pre_nms, po
 
     score = scores_fg.transpose(1, 2, 0)  # (Hf, Wf, A)
     # mask padded rows/cols beyond the real (unpadded) feature extent
-    real_h = (im_h / stride).astype(jnp.int32)
-    real_w = (im_w / stride).astype(jnp.int32)
+    real_h = jnp.ceil(im_h / stride).astype(jnp.int32)
+    real_w = jnp.ceil(im_w / stride).astype(jnp.int32)
     pad_mask = (jnp.arange(Hf)[:, None, None] >= real_h) | (jnp.arange(Wf)[None, :, None] >= real_w)
     score = jnp.where(pad_mask, -1.0, score)
 
@@ -857,12 +881,7 @@ def multibox_detection(
 
         if 0 < nms_threshold <= 1:
             def body(i, cid_):
-                tl = jnp.maximum(boxes[i, :2], boxes[:, :2])
-                br = jnp.minimum(boxes[i, 2:], boxes[:, 2:])
-                wh = jnp.maximum(br - tl, 0.0)
-                inter = wh[:, 0] * wh[:, 1]
-                union = area[i] + area - inter
-                iou = jnp.where(union <= 0, 0.0, inter / jnp.maximum(union, 1e-12))
+                iou = _iou_row(boxes, area, i)
                 sup = (
                     (jnp.arange(A) > i)
                     & (cid_ >= 0)
@@ -946,12 +965,7 @@ def box_nms(
         ids = d[:, id_index] if id_index >= 0 else jnp.zeros((N,))
 
         def body(i, alive):
-            tl = jnp.maximum(boxes[i, :2], boxes[:, :2])
-            br = jnp.minimum(boxes[i, 2:], boxes[:, 2:])
-            wh = jnp.maximum(br - tl, 0.0)
-            inter = wh[:, 0] * wh[:, 1]
-            union = area[i] + area - inter
-            iou = jnp.where(union <= 0, 0.0, inter / jnp.maximum(union, 1e-12))
+            iou = _iou_row(boxes, area, i)
             sup = (
                 alive[i]
                 & (jnp.arange(N) > i)

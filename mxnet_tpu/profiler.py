@@ -1,0 +1,317 @@
+"""Profiler — chrome-trace profiling facade (reference ``python/mxnet/profiler.py``).
+
+TPU-native design (SURVEY §5.1): the reference's lock-free per-device stat
+queues (``src/profiler/profiler.h:256``, ``DeviceStats :223``) instrumented
+every engine push; here the device-side story is XLA's own profiler
+(``jax.profiler`` → TensorBoard XPlane traces), and this module provides
+
+1. the reference's *user-annotation* object model — ``Domain``, ``Task``,
+   ``Frame``, ``Event``, ``Counter``, ``Marker`` (reference
+   ``profiler.py:151-240``, C++ ``ProfileDomain :528`` / ``ProfileCounter
+   :556``) — recording into an in-process buffer, and
+2. ``dump()`` emitting **chrome://tracing JSON** exactly like the reference's
+   ``Profiler::DumpProfile`` (``src/profiler/profiler.h:304``), and
+3. ``set_state('run')`` optionally starting a ``jax.profiler`` trace so the
+   XLA/TPU timeline lands next to the user annotations.
+
+Use ``mx.profiler.set_config(filename='profile.json'); set_state('run')``,
+then open the dumped file in chrome://tracing or Perfetto.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = [
+    "set_config",
+    "profiler_set_config",
+    "set_state",
+    "profiler_set_state",
+    "state",
+    "pause",
+    "resume",
+    "dump",
+    "dumps",
+    "dump_profile",
+    "Domain",
+    "Task",
+    "Frame",
+    "Event",
+    "Counter",
+    "Marker",
+]
+
+_lock = threading.Lock()
+_events = []  # chrome trace event dicts
+_config = {
+    "filename": "profile.json",
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": False,
+    "profile_api": False,
+    "aggregate_stats": False,
+    "continuous_dump": False,
+    "use_xla_trace": False,  # also capture a jax.profiler trace dir
+}
+_state = "stop"
+_paused = False
+_xla_trace_dir = None
+_t0 = time.perf_counter()
+
+
+def _now_us():
+    return (time.perf_counter() - _t0) * 1e6
+
+
+def _emit(ev):
+    if _state != "run" or _paused:
+        return
+    with _lock:
+        _events.append(ev)
+
+
+def set_config(**kwargs):
+    """Configure the profiler (reference ``profiler.py:28`` set_config).
+
+    Accepts the reference kwargs (``filename``, ``profile_all``,
+    ``profile_symbolic``, ``profile_imperative``, ``profile_memory``,
+    ``profile_api``, ``aggregate_stats``, ``continuous_dump``) plus
+    ``use_xla_trace=True`` to also record a ``jax.profiler`` trace directory
+    alongside the chrome-trace file.
+    """
+    unknown = set(kwargs) - set(_config)
+    if unknown:
+        raise ValueError("unknown profiler config keys: %s" % sorted(unknown))
+    _config.update(kwargs)
+
+
+profiler_set_config = set_config
+
+
+def state():
+    return _state
+
+
+def set_state(state="stop", profile_process="worker"):
+    """'run' starts recording; 'stop' stops (and dumps if continuous_dump)."""
+    global _state, _xla_trace_dir
+    if state not in ("run", "stop"):
+        raise ValueError("state must be 'run' or 'stop'")
+    if state == "run" and _state != "run":
+        _state = "run"
+        if _config["use_xla_trace"]:
+            import jax
+
+            _xla_trace_dir = os.path.splitext(_config["filename"])[0] + "_xla"
+            jax.profiler.start_trace(_xla_trace_dir)
+    elif state == "stop" and _state == "run":
+        if _config["use_xla_trace"] and _xla_trace_dir is not None:
+            import jax
+
+            jax.profiler.stop_trace()
+            _xla_trace_dir = None
+        _state = "stop"
+        if _config["continuous_dump"]:
+            dump()
+
+
+profiler_set_state = set_state
+
+
+def pause(profile_process="worker"):
+    """Suspend recording without ending the run (reference MXProfilePause)."""
+    global _paused
+    _paused = True
+
+
+def resume(profile_process="worker"):
+    global _paused
+    _paused = False
+
+
+def dumps(reset=False):
+    """Return the chrome-trace JSON string (reference aggregate dumps)."""
+    with _lock:
+        evs = list(_events)
+        if reset:
+            _events.clear()
+    return json.dumps({"traceEvents": evs, "displayTimeUnit": "ms"}, indent=1)
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write chrome://tracing JSON to the configured filename."""
+    data = dumps(reset=finished)
+    with open(_config["filename"], "w") as f:
+        f.write(data)
+    return _config["filename"]
+
+
+dump_profile = dump  # deprecated reference alias
+
+
+class Domain:
+    """Named grouping of profiler objects (reference ProfileDomain :528);
+    becomes the chrome-trace process name."""
+
+    _next_pid = [1]
+
+    def __init__(self, name):
+        self.name = name
+        self.pid = Domain._next_pid[0]
+        Domain._next_pid[0] += 1
+        _emit(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": self.pid,
+                "args": {"name": name},
+            }
+        )
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_frame(self, name):
+        return Frame(self, name)
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+    def __repr__(self):
+        return "Domain('%s')" % self.name
+
+
+_default_domain = None
+
+
+def _domain_of(domain):
+    global _default_domain
+    if domain is not None:
+        return domain
+    if _default_domain is None:
+        _default_domain = Domain("mxnet_tpu")
+    return _default_domain
+
+
+class _DurationObject:
+    _phase = "X"
+    _cat = "task"
+
+    def __init__(self, domain, name):
+        self.domain = _domain_of(domain)
+        self.name = name
+        self._start_us = None
+
+    def start(self):
+        self._start_us = _now_us()
+        return self
+
+    def stop(self):
+        if self._start_us is None:
+            return self
+        _emit(
+            {
+                "name": self.name,
+                "cat": self._cat,
+                "ph": "X",
+                "ts": self._start_us,
+                "dur": _now_us() - self._start_us,
+                "pid": self.domain.pid,
+                "tid": threading.get_ident() % 1_000_000,
+            }
+        )
+        self._start_us = None
+        return self
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def __repr__(self):
+        return "%s('%s')" % (type(self).__name__, self.name)
+
+
+class Task(_DurationObject):
+    """Generic start/stop work item bound to a domain (reference Task)."""
+
+    _cat = "task"
+
+
+class Frame(_DurationObject):
+    """Per-iteration frame (reference Frame) — e.g. one training batch."""
+
+    _cat = "frame"
+
+
+class Event(_DurationObject):
+    """Thread-bound duration event (reference Event); domain-less."""
+
+    _cat = "event"
+
+    def __init__(self, name):
+        super().__init__(None, name)
+
+
+class Counter:
+    """Numeric time-series counter (reference ProfileCounter :556)."""
+
+    def __init__(self, domain, name, value=None):
+        self.domain = _domain_of(domain)
+        self.name = name
+        self._value = 0
+        if value is not None:
+            self.set_value(value)
+
+    def set_value(self, value):
+        self._value = value
+        _emit(
+            {
+                "name": self.name,
+                "ph": "C",
+                "ts": _now_us(),
+                "pid": self.domain.pid,
+                "args": {self.name: value},
+            }
+        )
+
+    def increment(self, delta=1):
+        self.set_value(self._value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self._value - delta)
+
+    def __iadd__(self, v):
+        self.increment(v)
+        return self
+
+    def __isub__(self, v):
+        self.decrement(v)
+        return self
+
+
+class Marker:
+    """Instant annotation (reference Marker); scope: 'process' or 'thread'."""
+
+    def __init__(self, domain, name):
+        self.domain = _domain_of(domain)
+        self.name = name
+
+    def mark(self, scope="process"):
+        _emit(
+            {
+                "name": self.name,
+                "ph": "i",
+                "ts": _now_us(),
+                "pid": self.domain.pid,
+                "tid": threading.get_ident() % 1_000_000,
+                "s": {"process": "p", "thread": "t", "global": "g"}.get(scope, "p"),
+            }
+        )

@@ -1,0 +1,159 @@
+"""Network visualization (reference ``python/mxnet/visualization.py``).
+
+``print_summary`` renders a per-layer table with output shapes and parameter
+counts; ``plot_network`` emits a graphviz Digraph when the ``graphviz``
+package is available (it is optional, exactly as in the reference).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def _node_shapes(symbol, shape):
+    """Map node name → output shape via the internals graph."""
+    if not shape:
+        return {}
+    ints = symbol.get_internals()
+    names = ints.list_outputs()
+    _, out_shapes, _ = ints.infer_shape_partial(**shape)
+    m = {}
+    for n, s in zip(names, out_shapes):
+        key = n
+        for suf in ("_output",):
+            if key.endswith(suf):
+                key = key[: -len(suf)]
+        # strip _output%d
+        if "_output" in key:
+            key = key.split("_output")[0]
+        m.setdefault(key, s)
+        m[n] = s
+    return m
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.74, 1.0)):
+    """Print a Keras-style layer summary (reference visualization.py print_summary).
+
+    ``shape`` is a dict of input name → shape used for shape inference.
+    Returns the total parameter count.
+    """
+    shape_by_node = _node_shapes(symbol, shape)
+    positions = [int(line_length * p) for p in positions]
+    fields = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(f, pos):
+        line = ""
+        for i, x in enumerate(f):
+            line += str(x)
+            line = line[: pos[i]]
+            line += " " * (pos[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(fields, positions)
+    print("=" * line_length)
+
+    total_params = 0
+    arg_shapes = {}
+    if shape:
+        try:
+            arg_names = symbol.list_arguments()
+            s_args, _, _ = symbol.infer_shape_partial(**shape)
+            arg_shapes = dict(zip(arg_names, s_args))
+        except Exception:
+            pass
+    inputs = set(shape or ())
+
+    for node in symbol._walk():
+        if node.is_var:
+            continue
+        op_name = node.op.name
+        out_shape = shape_by_node.get(node.name, None)
+        # params = sum of var-input sizes that aren't data inputs
+        n_params = 0
+        for inp in node.inputs:
+            b = inp._base() if inp.out_index is not None else inp
+            # label heads are graph inputs, not parameters (reference
+            # visualization.py counts only weight/bias-style inputs)
+            is_label = b.name == "label" or b.name.endswith("_label")
+            if b.is_var and b.name not in inputs and not is_label:
+                s = arg_shapes.get(b.name)
+                if s:
+                    n_params += int(np.prod(s))
+        total_params += n_params
+        prev = ",".join(
+            (i._base() if i.out_index is not None else i).name
+            for i in node.inputs
+            if not (i._base() if i.out_index is not None else i).is_var
+        )
+        print_row(
+            ["%s (%s)" % (node.name, op_name), str(out_shape or ""), str(n_params), prev],
+            positions,
+        )
+        print("_" * line_length)
+
+    print("Total params: %d" % total_params)
+    print("_" * line_length)
+    return total_params
+
+
+def plot_network(
+    symbol,
+    title="plot",
+    save_format="pdf",
+    shape=None,
+    node_attrs=None,
+    hide_weights=True,
+):
+    """Build a graphviz Digraph of the symbol (reference plot_network).
+
+    Requires the optional ``graphviz`` package; raises ImportError otherwise
+    (same behavior as the reference).
+    """
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise ImportError("plot_network requires the 'graphviz' package") from e
+
+    shape_by_node = _node_shapes(symbol, shape)
+    node_attr = {
+        "shape": "box",
+        "fixedsize": "false",
+        "fontsize": "10",
+        "style": "filled",
+    }
+    node_attr.update(node_attrs or {})
+    dot = Digraph(name=title, format=save_format)
+
+    palette = {
+        "FullyConnected": "#fb8072",
+        "Convolution": "#fb8072",
+        "Activation": "#ffffb3",
+        "LeakyReLU": "#ffffb3",
+        "BatchNorm": "#bebada",
+        "Pooling": "#80b1d3",
+        "Concat": "#fdb462",
+        "Flatten": "#fdb462",
+        "Reshape": "#fdb462",
+        "Softmax": "#b3de69",
+        "SoftmaxOutput": "#b3de69",
+    }
+
+    for node in symbol._walk():
+        if node.is_var:
+            if hide_weights and node.name not in (shape or {}):
+                continue
+            dot.node(node.name, node.name, fillcolor="#8dd3c7", **node_attr)
+            continue
+        label = "%s\n%s" % (node.name, node.op.name)
+        s = shape_by_node.get(node.name)
+        if s:
+            label += "\n" + "x".join(map(str, s))
+        dot.node(node.name, label, fillcolor=palette.get(node.op.name, "#d9d9d9"), **node_attr)
+        for inp in node.inputs:
+            b = inp._base() if inp.out_index is not None else inp
+            if b.is_var and hide_weights and b.name not in (shape or {}):
+                continue
+            dot.edge(b.name, node.name)
+    return dot

@@ -1,0 +1,111 @@
+"""Facade modules: engine, profiler, monitor, visualization, name/attribute,
+executor_manager (reference test models: tests/python/unittest/test_profiler.py,
+test_engine.py-style checks)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_engine_bulk_and_wait():
+    from mxnet_tpu import engine
+
+    assert engine.engine_type() in ("ThreadedEnginePerDevice", "NaiveEngine")
+    old = engine.set_bulk_size(4)
+    with engine.bulk(8):
+        pass
+    engine.set_bulk_size(old)
+    a = mx.nd.ones((4, 4))
+    b = a * 2
+    engine.wait_all()
+    assert b.asnumpy().sum() == 32
+
+
+def test_naive_engine_toggle():
+    from mxnet_tpu import engine
+
+    engine.naive_engine(True)
+    try:
+        assert engine.is_naive()
+        x = mx.nd.ones((2, 2)) + 1
+        assert x.asnumpy().sum() == 8
+    finally:
+        engine.naive_engine(False)
+    assert not engine.is_naive()
+
+
+def test_profiler_chrome_trace(tmp_path):
+    from mxnet_tpu import profiler
+
+    fname = str(tmp_path / "profile.json")
+    profiler.set_config(filename=fname)
+    profiler.set_state("run")
+    dom = profiler.Domain("testdomain")
+    task = dom.new_task("mytask")
+    with task:
+        mx.nd.ones((8, 8)).asnumpy()
+    ctr = dom.new_counter("loss", 10)
+    ctr.increment(5)
+    dom.new_marker("epoch_end").mark()
+    profiler.pause()
+    with dom.new_task("hidden"):
+        pass
+    profiler.resume()
+    profiler.set_state("stop")
+    profiler.dump()
+    data = json.load(open(fname))
+    names = [e.get("name") for e in data["traceEvents"]]
+    assert "mytask" in names
+    assert "loss" in names
+    assert "epoch_end" in names
+    assert "hidden" not in names
+
+
+def test_monitor_collects_stats():
+    from mxnet_tpu.monitor import Monitor
+
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    exe = fc.simple_bind(data=(2, 4))
+    mon = Monitor(1, sort=True)
+    mon.install(exe)
+    mon.tic()
+    exe.forward(is_train=False, data=mx.nd.ones((2, 4)))
+    res = mon.toc()
+    assert any("fc_output" in k for _, k, _ in res)
+
+
+def test_print_summary_param_count(capsys):
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=10, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=2, name="fc2")
+    total = mx.viz.print_summary(fc2, shape={"data": (1, 5)})
+    # fc1: 5*10+10 = 60, fc2: 10*2+2 = 22
+    assert total == 82
+    out = capsys.readouterr().out
+    assert "fc1" in out and "fc2" in out
+
+
+def test_name_and_attribute_paths():
+    from mxnet_tpu.name import NameManager, Prefix
+    from mxnet_tpu.attribute import AttrScope
+
+    with Prefix("pre_"):
+        s = mx.sym.Variable("x")
+        fc = mx.sym.FullyConnected(s, num_hidden=2)
+        assert fc.name.startswith("pre_")
+    with AttrScope(ctx_group="dev1"):
+        v = mx.sym.Variable("y")
+
+
+def test_split_input_slice():
+    from mxnet_tpu.executor_manager import _split_input_slice
+
+    slices = _split_input_slice(10, [1, 1])
+    assert slices == [slice(0, 5), slice(5, 10)]
+    slices = _split_input_slice(9, [2, 1])
+    assert slices[0] == slice(0, 6)
