@@ -1,0 +1,109 @@
+"""ctypes loader for the native data-plane library (``src/`` in this repo).
+
+The reference framework's data plane is C++ (``src/io/`` +
+``3rdparty/dmlc-core`` recordio) reached through the C API
+(``src/c_api/c_api.cc`` MXRecordIO*/MXDataIter*).  Here the native library is
+``libmxtpu.so``, built lazily from ``src/`` with ``make`` on first use and
+loaded over ctypes.  All callers must degrade gracefully to pure-Python
+paths when the toolchain is unavailable (``lib() is None``).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_LIB = None
+_TRIED = False
+_LOCK = threading.Lock()
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_HERE, "libmxtpu.so")
+_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "src")
+
+
+def _declare(lib):
+    u64, i32, fp = ctypes.c_uint64, ctypes.c_int, ctypes.POINTER(ctypes.c_float)
+    voidp, charp = ctypes.c_void_p, ctypes.c_char_p
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i32p = ctypes.POINTER(ctypes.c_int)
+    sigs = {
+        "MXTRecordIOWriterCreate": (voidp, [charp]),
+        "MXTRecordIOWriterWrite": (u64, [voidp, charp, u64]),
+        "MXTRecordIOWriterTell": (u64, [voidp]),
+        "MXTRecordIOWriterFree": (None, [voidp]),
+        "MXTRecordIOReaderCreate": (voidp, [charp]),
+        "MXTRecordIOReaderNext": (
+            i32,
+            [voidp, ctypes.POINTER(charp), ctypes.POINTER(u64)],
+        ),
+        "MXTRecordIOReaderSeek": (None, [voidp, u64]),
+        "MXTRecordIOReaderTell": (u64, [voidp]),
+        "MXTRecordIOReaderFree": (None, [voidp]),
+        "MXTDecodeJPEG": (i32, [u8p, u64, u8p, u64, i32p, i32p, i32p]),
+        "MXTResizeBilinear": (i32, [u8p, i32, i32, i32, u8p, i32, i32]),
+        "MXTImageRecordLoaderCreate": (
+            voidp,
+            [charp, i32, i32, i32, i32, i32, i32, i32, i32, i32, u64, fp, fp],
+        ),
+        "MXTImageRecordLoaderSize": (u64, [voidp]),
+        "MXTImageRecordLoaderNext": (i32, [voidp, fp, fp]),
+        "MXTImageRecordLoaderReset": (None, [voidp]),
+        "MXTImageRecordLoaderFree": (None, [voidp]),
+    }
+    for name, (restype, argtypes) in sigs.items():
+        fn = getattr(lib, name)
+        fn.restype = restype
+        fn.argtypes = argtypes
+    return lib
+
+
+def _build():
+    if not os.path.isdir(_SRC_DIR):
+        return False
+    try:
+        subprocess.run(
+            ["make", "-s", "OUT=" + _SO_PATH],
+            cwd=_SRC_DIR,
+            check=True,
+            capture_output=True,
+            timeout=300,
+        )
+        return os.path.exists(_SO_PATH)
+    except Exception:
+        return False
+
+
+def lib():
+    """Returns the loaded native library, or None if unavailable."""
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        if os.environ.get("MXNET_TPU_DISABLE_NATIVE", "0") == "1":
+            return None
+        if not os.path.exists(_SO_PATH):
+            src_newer = False
+        else:
+            try:
+                so_mtime = os.path.getmtime(_SO_PATH)
+                src_newer = any(
+                    os.path.getmtime(os.path.join(root, f)) > so_mtime
+                    for root, _, files in os.walk(_SRC_DIR)
+                    for f in files
+                    if f.endswith((".cc", ".h"))
+                )
+            except OSError:
+                src_newer = True
+        if (not os.path.exists(_SO_PATH)) or src_newer:
+            if not _build():
+                return None
+        try:
+            _LIB = _declare(ctypes.CDLL(_SO_PATH))
+        except OSError:
+            _LIB = None
+        return _LIB

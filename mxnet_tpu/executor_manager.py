@@ -24,6 +24,8 @@ def _split_input_slice(batch_size, work_load_list):
     if total <= 0:
         raise MXNetError("Invalid workload")
     batch_num_list = [round(batch_size * v / total) for v in work_load_list]
+    # rounding remainder goes to the last slice so every sample is assigned
+    batch_num_list[-1] += batch_size - sum(batch_num_list)
     slices = []
     end = 0
     for n in batch_num_list:

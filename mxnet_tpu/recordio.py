@@ -1,0 +1,353 @@
+"""RecordIO format — reference ``python/mxnet/recordio.py`` (MXRecordIO,
+MXIndexedRecordIO, IRHeader/pack/unpack :291-367, pack_img/unpack_img) and the
+dmlc-core recordio framing used by ``src/io/``.
+
+On-disk framing: ``[magic:u32le][lrec:u32le][payload, 4B-padded]`` with
+``lrec = (cflag<<29)|len``; payloads containing the magic word are split into
+continuation chunks (cflag 1/2/3) — identical to the reference so .rec files
+round-trip.  The hot path goes through the native C++ library
+(``src/io/recordio.cc`` here); a pure-Python implementation is the fallback.
+"""
+from __future__ import annotations
+
+import ctypes
+import numbers
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+from . import _native
+
+__all__ = [
+    "MXRecordIO",
+    "MXIndexedRecordIO",
+    "IRHeader",
+    "pack",
+    "unpack",
+    "pack_img",
+    "unpack_img",
+]
+
+_KMAGIC = 0xCED7230A
+_MAGIC_BYTES = struct.pack("<I", _KMAGIC)
+
+
+def _encode_lrec(cflag, length):
+    return (cflag << 29) | length
+
+
+class _PyWriter:
+    def __init__(self, path):
+        self._f = open(path, "wb")
+
+    def tell(self):
+        return self._f.tell()
+
+    def write(self, data):
+        start = self._f.tell()
+        # Split payload at embedded magic words (dmlc recordio scheme).
+        chunks = data.split(_MAGIC_BYTES)
+        n = len(chunks)
+        for i, chunk in enumerate(chunks):
+            if n == 1:
+                cflag = 0
+            elif i == 0:
+                cflag = 1
+            elif i == n - 1:
+                cflag = 3
+            else:
+                cflag = 2
+            self._f.write(_MAGIC_BYTES)
+            self._f.write(struct.pack("<I", _encode_lrec(cflag, len(chunk))))
+            self._f.write(chunk)
+            pad = (4 - (len(chunk) & 3)) & 3
+            if pad:
+                self._f.write(b"\x00" * pad)
+        return start
+
+    def close(self):
+        self._f.close()
+
+
+class _PyReader:
+    def __init__(self, path):
+        self._f = open(path, "rb")
+
+    def tell(self):
+        return self._f.tell()
+
+    def seek(self, pos):
+        self._f.seek(pos)
+
+    def read(self):
+        out = []
+        cont = False
+        while True:
+            head = self._f.read(8)
+            if len(head) < 8:
+                return None
+            magic, lrec = struct.unpack("<II", head)
+            if magic != _KMAGIC:
+                return None
+            cflag, length = lrec >> 29, lrec & ((1 << 29) - 1)
+            if cont:
+                out.append(_MAGIC_BYTES)
+            chunk = self._f.read(length)
+            if len(chunk) < length:
+                return None
+            out.append(chunk)
+            pad = (4 - (length & 3)) & 3
+            if pad:
+                self._f.seek(pad, os.SEEK_CUR)
+            if cflag in (0, 3):
+                return b"".join(out)
+            cont = True
+
+    def close(self):
+        self._f.close()
+
+
+class _NativeWriter:
+    def __init__(self, path):
+        self._lib = _native.lib()
+        self._h = self._lib.MXTRecordIOWriterCreate(path.encode())
+        if not self._h:
+            raise IOError("cannot open %s for writing" % path)
+
+    def tell(self):
+        return self._lib.MXTRecordIOWriterTell(self._h)
+
+    def write(self, data):
+        return self._lib.MXTRecordIOWriterWrite(self._h, data, len(data))
+
+    def close(self):
+        if self._h:
+            self._lib.MXTRecordIOWriterFree(self._h)
+            self._h = None
+
+
+class _NativeReader:
+    def __init__(self, path):
+        self._lib = _native.lib()
+        self._h = self._lib.MXTRecordIOReaderCreate(path.encode())
+        if not self._h:
+            raise IOError("cannot open %s for reading" % path)
+
+    def tell(self):
+        return self._lib.MXTRecordIOReaderTell(self._h)
+
+    def seek(self, pos):
+        self._lib.MXTRecordIOReaderSeek(self._h, pos)
+
+    def read(self):
+        n = ctypes.c_uint64()
+        ptr = ctypes.c_char_p()
+        ok = self._lib.MXTRecordIOReaderNext(self._h, ctypes.byref(ptr), ctypes.byref(n))
+        if not ok:
+            return None
+        return ctypes.string_at(ptr, n.value)
+
+    def close(self):
+        if self._h:
+            self._lib.MXTRecordIOReaderFree(self._h)
+            self._h = None
+
+
+class MXRecordIO:
+    """Sequential RecordIO reader/writer (reference recordio.py:36)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        native = _native.lib() is not None
+        if self.flag == "w":
+            self._impl = _NativeWriter(self.uri) if native else _PyWriter(self.uri)
+            self.writable = True
+        elif self.flag == "r":
+            self._impl = _NativeReader(self.uri) if native else _PyReader(self.uri)
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.is_open = True
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        is_open = self.is_open
+        self.close()
+        d = dict(self.__dict__)
+        d["is_open"] = is_open
+        del d["_impl"]
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        is_open = d["is_open"]
+        self.is_open = False
+        if is_open:
+            self.open()
+
+    def close(self):
+        if not self.is_open:
+            return
+        self._impl.close()
+        self.is_open = False
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf):
+        assert self.writable
+        if isinstance(buf, str):
+            buf = buf.encode()
+        self._impl.write(buf)
+
+    def read(self):
+        assert not self.writable
+        out = self._impl.read()
+        return out
+
+    def tell(self):
+        return self._impl.tell()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """RecordIO with a key→offset index sidecar (reference recordio.py:180)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 2:
+                        continue
+                    key = self.key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if not self.is_open:
+            return
+        if self.writable:
+            with open(self.idx_path, "w") as fout:
+                for key in self.keys:
+                    fout.write("%s\t%d\n" % (str(key), self.idx[key]))
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        self._impl.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        assert self.writable
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Packs header+payload into an image-record string (reference :309)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        header = header._replace(flag=0)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0)
+        s = label.tobytes() + s
+    s = struct.pack(_IR_FORMAT, *header) + s
+    return s
+
+
+def unpack(s):
+    """Inverse of pack (reference :344)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        header = header._replace(label=np.frombuffer(s, np.float32, header.flag))
+        s = s[header.flag * 4 :]
+    return header, s
+
+
+def _encode_jpeg(img, quality=95):
+    from io import BytesIO
+
+    from PIL import Image
+
+    buf = BytesIO()
+    Image.fromarray(img).save(buf, format="JPEG", quality=quality)
+    return buf.getvalue()
+
+
+def _decode_image(s):
+    lib = _native.lib()
+    if lib is not None and s[:2] == b"\xff\xd8":  # JPEG magic
+        cap = len(s) * 64 + (1 << 16)
+        out = np.empty(cap, dtype=np.uint8)
+        w = ctypes.c_int()
+        h = ctypes.c_int()
+        c = ctypes.c_int()
+        src = np.frombuffer(s, dtype=np.uint8)
+        rc = lib.MXTDecodeJPEG(
+            src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            len(s),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            cap,
+            ctypes.byref(w),
+            ctypes.byref(h),
+            ctypes.byref(c),
+        )
+        if rc == 0:
+            return out[: w.value * h.value * c.value].reshape(h.value, w.value, c.value).copy()
+    from io import BytesIO
+
+    from PIL import Image
+
+    return np.asarray(Image.open(BytesIO(s)).convert("RGB"))
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Packs an image array into an image-record string (reference :386)."""
+    if img_fmt.lower() not in (".jpg", ".jpeg"):
+        raise ValueError("only JPEG packing is supported (got %s)" % img_fmt)
+    img = np.asarray(img, dtype=np.uint8)
+    return pack(header, _encode_jpeg(img, quality=quality))
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpacks an image-record string to (header, HWC uint8 array)."""
+    header, s = unpack(s)
+    img = _decode_image(s)
+    if iscolor == 0 and img.ndim == 3:
+        img = np.asarray(
+            0.299 * img[..., 0] + 0.587 * img[..., 1] + 0.114 * img[..., 2], dtype=np.uint8
+        )
+    return header, img

@@ -1,0 +1,106 @@
+// JPEG decode + bilinear resize + augmentation primitives for the native
+// data plane.  Reference behavior: src/io/iter_image_recordio_2.cc (OpenCV
+// imdecode + augmenters) rebuilt on libjpeg with no OpenCV dependency.
+#include <cstdio>
+
+#include <jpeglib.h>
+
+#include <algorithm>
+#include <cmath>
+#include <csetjmp>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace mxtpu {
+
+struct JpegErrorMgr {
+  jpeg_error_mgr pub;
+  jmp_buf jump;
+};
+
+static void jpeg_error_exit(j_common_ptr cinfo) {
+  JpegErrorMgr* err = reinterpret_cast<JpegErrorMgr*>(cinfo->err);
+  longjmp(err->jump, 1);
+}
+
+// Decodes a JPEG buffer to interleaved RGB u8.  Returns false on failure.
+bool DecodeJPEG(const uint8_t* buf, size_t len, std::vector<uint8_t>* out,
+                int* width, int* height, int* channels) {
+  jpeg_decompress_struct cinfo;
+  JpegErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = jpeg_error_exit;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(buf), (unsigned long)len);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  const int w = cinfo.output_width, h = cinfo.output_height;
+  const int c = cinfo.output_components;
+  out->resize((size_t)w * h * c);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = out->data() + (size_t)cinfo.output_scanline * w * c;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  *width = w;
+  *height = h;
+  *channels = c;
+  return true;
+}
+
+// Bilinear resize, interleaved u8 HWC.
+void ResizeBilinear(const uint8_t* src, int sh, int sw, int c, uint8_t* dst,
+                    int dh, int dw) {
+  const float sy = dh > 1 ? (float)(sh - 1) / (dh - 1) : 0.f;
+  const float sx = dw > 1 ? (float)(sw - 1) / (dw - 1) : 0.f;
+  for (int y = 0; y < dh; ++y) {
+    const float fy = y * sy;
+    const int y0 = (int)fy;
+    const int y1 = std::min(y0 + 1, sh - 1);
+    const float wy = fy - y0;
+    for (int x = 0; x < dw; ++x) {
+      const float fx = x * sx;
+      const int x0 = (int)fx;
+      const int x1 = std::min(x0 + 1, sw - 1);
+      const float wx = fx - x0;
+      for (int k = 0; k < c; ++k) {
+        const float v00 = src[((size_t)y0 * sw + x0) * c + k];
+        const float v01 = src[((size_t)y0 * sw + x1) * c + k];
+        const float v10 = src[((size_t)y1 * sw + x0) * c + k];
+        const float v11 = src[((size_t)y1 * sw + x1) * c + k];
+        const float v = v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                        v10 * wy * (1 - wx) + v11 * wy * wx;
+        dst[((size_t)y * dw + x) * c + k] = (uint8_t)(v + 0.5f);
+      }
+    }
+  }
+}
+
+// HWC u8 -> CHW float with mean/std and optional horizontal mirror.
+void NormalizeToCHW(const uint8_t* src, int h, int w, int c, float* dst,
+                    const float* mean, const float* stdv, int mirror) {
+  for (int k = 0; k < c; ++k) {
+    const float m = mean ? mean[k] : 0.f;
+    const float s = stdv ? stdv[k] : 1.f;
+    float* plane = dst + (size_t)k * h * w;
+    for (int y = 0; y < h; ++y) {
+      for (int x = 0; x < w; ++x) {
+        const int sx = mirror ? (w - 1 - x) : x;
+        plane[(size_t)y * w + x] =
+            ((float)src[((size_t)y * w + sx) * c + k] - m) / s;
+      }
+    }
+  }
+}
+
+}  // namespace mxtpu

@@ -1,0 +1,122 @@
+#include "recordio.h"
+
+#include <cstring>
+
+namespace mxtpu {
+
+namespace {
+inline uint32_t EncodeLRec(uint32_t cflag, uint32_t length) {
+  return (cflag << 29u) | length;
+}
+inline uint32_t DecodeFlag(uint32_t rec) { return (rec >> 29u) & 7u; }
+inline uint32_t DecodeLength(uint32_t rec) { return rec & ((1u << 29u) - 1u); }
+}  // namespace
+
+RecordIOWriter::RecordIOWriter(const std::string& path) {
+  fp_ = std::fopen(path.c_str(), "wb");
+}
+
+RecordIOWriter::~RecordIOWriter() { Close(); }
+
+void RecordIOWriter::Close() {
+  if (fp_) {
+    std::fclose(fp_);
+    fp_ = nullptr;
+  }
+}
+
+uint64_t RecordIOWriter::Tell() { return fp_ ? (uint64_t)std::ftell(fp_) : 0; }
+
+uint64_t RecordIOWriter::WriteRecord(const void* buf, size_t size) {
+  const uint64_t start = Tell();
+  const char* data = static_cast<const char*>(buf);
+  const uint32_t magic = kMagic;
+  // Split payload at occurrences of the magic word so readers can resync.
+  size_t begin = 0;
+  bool first = true;
+  std::vector<std::pair<size_t, size_t>> chunks;  // (offset, len)
+  size_t i = 0;
+  while (i + 4 <= size) {
+    if (std::memcmp(data + i, &magic, 4) == 0) {
+      chunks.emplace_back(begin, i - begin);
+      begin = i + 4;
+      i += 4;
+    } else {
+      ++i;
+    }
+  }
+  chunks.emplace_back(begin, size - begin);
+  (void)first;
+  const size_t n = chunks.size();
+  for (size_t c = 0; c < n; ++c) {
+    uint32_t cflag;
+    if (n == 1) {
+      cflag = 0;
+    } else if (c == 0) {
+      cflag = 1;
+    } else if (c + 1 == n) {
+      cflag = 3;
+    } else {
+      cflag = 2;
+    }
+    uint32_t len = (uint32_t)chunks[c].second;
+    uint32_t lrec = EncodeLRec(cflag, len);
+    std::fwrite(&magic, 4, 1, fp_);
+    std::fwrite(&lrec, 4, 1, fp_);
+    if (len) std::fwrite(data + chunks[c].first, 1, len, fp_);
+    const uint32_t pad = (4 - (len & 3u)) & 3u;
+    static const char zeros[4] = {0, 0, 0, 0};
+    if (pad) std::fwrite(zeros, 1, pad, fp_);
+  }
+  return start;
+}
+
+RecordIOReader::RecordIOReader(const std::string& path) {
+  fp_ = std::fopen(path.c_str(), "rb");
+}
+
+RecordIOReader::~RecordIOReader() { Close(); }
+
+void RecordIOReader::Close() {
+  if (fp_) {
+    std::fclose(fp_);
+    fp_ = nullptr;
+  }
+}
+
+uint64_t RecordIOReader::Tell() { return fp_ ? (uint64_t)std::ftell(fp_) : 0; }
+
+void RecordIOReader::Seek(uint64_t pos) {
+  if (fp_) std::fseek(fp_, (long)pos, SEEK_SET);
+}
+
+bool RecordIOReader::NextRecord(std::vector<char>* out) {
+  out->clear();
+  if (!fp_) return false;
+  bool in_continuation = false;
+  while (true) {
+    uint32_t magic = 0, lrec = 0;
+    if (std::fread(&magic, 4, 1, fp_) != 1) return false;
+    if (magic != RecordIOWriter::kMagic) return false;  // corrupt / EOF pad
+    if (std::fread(&lrec, 4, 1, fp_) != 1) return false;
+    const uint32_t cflag = DecodeFlag(lrec);
+    const uint32_t len = DecodeLength(lrec);
+    const size_t cur = out->size();
+    // Continuation chunks were split at a magic word in the payload:
+    // reinsert it between chunks.
+    if (in_continuation) {
+      const uint32_t m = RecordIOWriter::kMagic;
+      out->resize(cur + 4);
+      std::memcpy(out->data() + cur, &m, 4);
+    }
+    const size_t base = out->size();
+    out->resize(base + len);
+    if (len && std::fread(out->data() + base, 1, len, fp_) != len) return false;
+    const uint32_t pad = (4 - (len & 3u)) & 3u;
+    if (pad) std::fseek(fp_, pad, SEEK_CUR);
+    if (cflag == 0 || cflag == 3) return true;
+    in_continuation = true;
+  }
+}
+
+}  // namespace mxtpu

@@ -1,0 +1,53 @@
+// RecordIO on-disk format (dmlc-compatible framing).
+//
+// Reference behavior: 3rdparty/dmlc-core recordio (used by the reference's
+// src/io/ iterators and python/mxnet/recordio.py via the C API
+// MXRecordIOWriterCreate/MXRecordIOReaderCreate).  The framing is:
+//   [kMagic:u32le][lrec:u32le][payload ... pad to 4B]
+// where lrec encodes cflag (upper 3 bits) and length (lower 29 bits).
+// Payloads containing the magic word are split into continuation chunks
+// (cflag 1=begin, 2=middle, 3=end; 0=whole record) so a reader can always
+// resynchronize on the magic word.
+#ifndef MXTPU_IO_RECORDIO_H_
+#define MXTPU_IO_RECORDIO_H_
+
+#include <cstdint>
+#include <cstdio>
+#include <string>
+#include <vector>
+
+namespace mxtpu {
+
+class RecordIOWriter {
+ public:
+  static const uint32_t kMagic = 0xced7230a;
+  explicit RecordIOWriter(const std::string& path);
+  ~RecordIOWriter();
+  bool ok() const { return fp_ != nullptr; }
+  // Writes one logical record; returns byte offset of the record start.
+  uint64_t WriteRecord(const void* buf, size_t size);
+  uint64_t Tell();
+  void Close();
+
+ private:
+  std::FILE* fp_ = nullptr;
+};
+
+class RecordIOReader {
+ public:
+  explicit RecordIOReader(const std::string& path);
+  ~RecordIOReader();
+  bool ok() const { return fp_ != nullptr; }
+  // Reads the next logical record into out; false at EOF.
+  bool NextRecord(std::vector<char>* out);
+  void Seek(uint64_t pos);
+  uint64_t Tell();
+  void Close();
+
+ private:
+  std::FILE* fp_ = nullptr;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_IO_RECORDIO_H_

@@ -1,0 +1,215 @@
+"""RecordIO + native data plane tests — mirrors reference
+tests/python/unittest/test_recordio.py and the ImageRecordIter coverage in
+tests/python/unittest/test_io.py."""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu import _native
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        w.write(("record_%d" % i).encode())
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for i in range(5):
+        assert r.read() == ("record_%d" % i).encode()
+    assert r.read() is None
+    r.close()
+
+
+def test_recordio_embedded_magic(tmp_path):
+    """Payloads containing the magic word must round-trip (continuation chunks)."""
+    magic = struct.pack("<I", 0xCED7230A)
+    payloads = [
+        magic,
+        b"ab" + magic + b"cd",
+        magic + magic + magic,
+        b"x" * 37,
+        b"",
+        b"tail" + magic,
+    ]
+    path = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for p in payloads:
+        assert r.read() == p
+    r.close()
+
+
+def test_native_python_interop(tmp_path):
+    """Files written by the native writer parse with the pure-Python reader."""
+    if _native.lib() is None:
+        pytest.skip("native lib unavailable")
+    path = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(path, "w")  # native
+    data = [os.urandom(n) for n in (1, 4, 100, 1024)]
+    for d in data:
+        w.write(d)
+    w.close()
+    r = recordio._PyReader(path)
+    for d in data:
+        assert r.read() == d
+    r.close()
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "t")
+    w = recordio.MXIndexedRecordIO(path + ".idx", path + ".rec", "w")
+    for i in range(10):
+        w.write_idx(i, ("rec_%d" % i).encode())
+    w.close()
+    assert os.path.isfile(path + ".idx")
+    r = recordio.MXIndexedRecordIO(path + ".idx", path + ".rec", "r")
+    assert r.keys == list(range(10))
+    assert r.read_idx(7) == b"rec_7"
+    assert r.read_idx(2) == b"rec_2"
+    r.close()
+
+
+def test_pack_unpack_label_array():
+    label = np.array([1.0, 2.0, 3.0], dtype=np.float32)
+    header = recordio.IRHeader(0, label, 42, 0)
+    s = recordio.pack(header, b"payload")
+    h2, s2 = recordio.unpack(s)
+    assert h2.flag == 3
+    np.testing.assert_array_equal(h2.label, label)
+    assert s2 == b"payload"
+    assert h2.id == 42
+
+
+def _smooth_img(h, w, phase=0.0):
+    """Gradient image — JPEG-friendly so decode error stays small."""
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    r = (xx / w) * 255
+    g = (yy / h) * 255
+    b = ((xx + yy + phase) / (h + w)) % 1.0 * 255
+    return np.stack([r, g, b], axis=-1).astype(np.uint8)
+
+
+def test_pack_img_unpack_img():
+    img = _smooth_img(32, 24)
+    header = recordio.IRHeader(0, 7.0, 1, 0)
+    s = recordio.pack_img(header, img, quality=95)
+    h2, img2 = recordio.unpack_img(s)
+    assert h2.label == 7.0
+    assert img2.shape == (32, 24, 3)
+    # JPEG is lossy; high quality should stay close
+    assert np.mean(np.abs(img2.astype(np.float32) - img.astype(np.float32))) < 12.0
+
+
+def _make_rec(tmp_path, n=20, h=18, w=14):
+    """Packs n random images with label=i into a .rec file."""
+    path = str(tmp_path / "imgs")
+    rec = recordio.MXIndexedRecordIO(path + ".idx", path + ".rec", "w")
+    imgs = []
+    for i in range(n):
+        img = _smooth_img(h, w, phase=float(i))
+        imgs.append(img)
+        rec.write_idx(i, recordio.pack_img(recordio.IRHeader(0, float(i), i, 0), img))
+    rec.close()
+    return path + ".rec", imgs
+
+
+def test_image_record_iter(tmp_path):
+    rec_path, _ = _make_rec(tmp_path, n=10)
+    it = mx.io.ImageRecordIter(
+        path_imgrec=rec_path, data_shape=(3, 18, 14), batch_size=4, shuffle=False
+    )
+    assert len(it) == 10
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 3, 18, 14)
+    assert batches[-1].pad == 2
+    labels = np.concatenate([b.label[0].asnumpy() for b in batches])
+    assert labels[:10].tolist() == [float(i) for i in range(10)]
+    # reset and re-iterate
+    it.reset()
+    again = list(it)
+    assert len(again) == 3
+    np.testing.assert_allclose(
+        again[0].data[0].asnumpy(), batches[0].data[0].asnumpy(), rtol=1e-6
+    )
+
+
+def test_image_record_iter_decode_values(tmp_path):
+    """Pixel values from the pipeline match the packed image (up to JPEG loss)."""
+    rec_path, imgs = _make_rec(tmp_path, n=4)
+    it = mx.io.ImageRecordIter(
+        path_imgrec=rec_path, data_shape=(3, 18, 14), batch_size=4, shuffle=False
+    )
+    batch = next(iter(it))
+    got = batch.data[0].asnumpy()
+    for i in range(4):
+        want = imgs[i].astype(np.float32).transpose(2, 0, 1)
+        assert np.mean(np.abs(got[i] - want)) < 12.0
+
+
+def test_image_record_iter_resize_and_normalize(tmp_path):
+    rec_path, _ = _make_rec(tmp_path, n=4, h=32, w=32)
+    it = mx.io.ImageRecordIter(
+        path_imgrec=rec_path,
+        data_shape=(3, 16, 16),
+        batch_size=2,
+        mean_r=127.0,
+        mean_g=127.0,
+        mean_b=127.0,
+        std_r=58.0,
+        std_g=58.0,
+        std_b=58.0,
+    )
+    batch = next(iter(it))
+    arr = batch.data[0].asnumpy()
+    assert arr.shape == (2, 3, 16, 16)
+    assert np.abs(arr).max() < 4.0  # normalized range
+
+
+def test_image_record_iter_shuffle_epochs_differ(tmp_path):
+    rec_path, _ = _make_rec(tmp_path, n=16)
+    it = mx.io.ImageRecordIter(
+        path_imgrec=rec_path, data_shape=(3, 18, 14), batch_size=16, shuffle=True, seed=3
+    )
+    b1 = next(iter(it)).label[0].asnumpy().copy()
+    it.reset()
+    b2 = next(iter(it)).label[0].asnumpy().copy()
+    assert sorted(b1.tolist()) == sorted(b2.tolist()) == [float(i) for i in range(16)]
+    assert not np.array_equal(b1, b2)  # reshuffled across epochs
+
+
+def test_im2rec_tool(tmp_path):
+    from PIL import Image
+    import importlib.util
+
+    root = tmp_path / "data"
+    for cls in ("cat", "dog"):
+        os.makedirs(root / cls)
+        for i in range(3):
+            arr = (np.random.RandomState(i).rand(20, 20, 3) * 255).astype(np.uint8)
+            Image.fromarray(arr).save(root / cls / ("%d.jpg" % i))
+    spec = importlib.util.spec_from_file_location(
+        "im2rec", os.path.join(os.path.dirname(__file__), "..", "tools", "im2rec.py")
+    )
+    im2rec = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(im2rec)
+    prefix = str(tmp_path / "out")
+    images = list(im2rec.list_image(str(root)))
+    assert len(images) == 6
+    assert {lbl for _, _, lbl in images} == {0, 1}
+    im2rec.write_list(prefix + ".lst", images)
+    n = im2rec.pack_list(prefix, str(root))
+    assert n == 6
+    it = mx.io.ImageRecordIter(
+        path_imgrec=prefix + ".rec", data_shape=(3, 20, 20), batch_size=6
+    )
+    labels = next(iter(it)).label[0].asnumpy()
+    assert sorted(labels.tolist()) == [0.0, 0.0, 0.0, 1.0, 1.0, 1.0]

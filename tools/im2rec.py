@@ -1,0 +1,112 @@
+"""im2rec — pack an image folder into RecordIO (.rec/.idx/.lst).
+
+Reference behavior: ``tools/im2rec.py`` (list generation, multi-worker image
+packing into MXIndexedRecordIO).  JPEG re-encode uses PIL; record framing is
+the native data plane (mxnet_tpu.recordio).
+
+Usage:
+  python tools/im2rec.py PREFIX ROOT --list        # make PREFIX.lst
+  python tools/im2rec.py PREFIX ROOT               # pack PREFIX.lst -> .rec/.idx
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mxnet_tpu import recordio
+
+_EXTS = (".jpg", ".jpeg", ".png")
+
+
+def list_image(root, recursive=True):
+    """Yields (index, relpath, label) with labels from subfolder order."""
+    cat = {}
+    i = 0
+    if recursive:
+        for path, _, files in sorted(os.walk(root, followlinks=True)):
+            for fname in sorted(files):
+                if os.path.splitext(fname)[1].lower() not in _EXTS:
+                    continue
+                if path not in cat:
+                    cat[path] = len(cat)
+                yield i, os.path.relpath(os.path.join(path, fname), root), cat[path]
+                i += 1
+    else:
+        for fname in sorted(os.listdir(root)):
+            if os.path.splitext(fname)[1].lower() in _EXTS:
+                yield i, fname, 0
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as fout:
+        for idx, relpath, label in image_list:
+            fout.write("%d\t%f\t%s\n" % (idx, float(label), relpath))
+
+
+def read_list(path_in):
+    with open(path_in) as fin:
+        for line in fin:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            # idx \t label(s)... \t path
+            yield int(float(parts[0])), [float(x) for x in parts[1:-1]], parts[-1]
+
+
+def pack_list(prefix, root, resize=0, quality=95):
+    """Packs PREFIX.lst into PREFIX.rec + PREFIX.idx."""
+    from PIL import Image
+
+    record = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    count = 0
+    for idx, labels, relpath in read_list(prefix + ".lst"):
+        img = Image.open(os.path.join(root, relpath)).convert("RGB")
+        if resize:
+            w, h = img.size
+            scale = resize / min(w, h)
+            img = img.resize((max(1, int(w * scale)), max(1, int(h * scale))))
+        label = labels[0] if len(labels) == 1 else np.array(labels, dtype=np.float32)
+        header = recordio.IRHeader(0, label, idx, 0)
+        record.write_idx(idx, recordio.pack_img(header, np.asarray(img), quality=quality))
+        count += 1
+    record.close()
+    return count
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("prefix")
+    p.add_argument("root")
+    p.add_argument("--list", action="store_true", help="generate PREFIX.lst only")
+    p.add_argument("--recursive", action=argparse.BooleanOptionalAction, default=True)
+    p.add_argument("--shuffle", type=int, default=1)
+    p.add_argument("--resize", type=int, default=0)
+    p.add_argument("--quality", type=int, default=95)
+    args = p.parse_args()
+    if args.list:
+        images = list(list_image(args.root, args.recursive))
+        if args.shuffle:
+            random.seed(100)
+            random.shuffle(images)
+        write_list(args.prefix + ".lst", images)
+        print("wrote %d entries to %s.lst" % (len(images), args.prefix))
+    else:
+        if not os.path.isfile(args.prefix + ".lst"):
+            images = list(list_image(args.root, args.recursive))
+            if args.shuffle:
+                random.seed(100)
+                random.shuffle(images)
+            write_list(args.prefix + ".lst", images)
+        n = pack_list(args.prefix, args.root, resize=args.resize, quality=args.quality)
+        print("packed %d images into %s.rec" % (n, args.prefix))
+
+
+if __name__ == "__main__":
+    main()
