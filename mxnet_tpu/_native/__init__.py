@@ -104,6 +104,8 @@ def lib():
                 return None
         try:
             _LIB = _declare(ctypes.CDLL(_SO_PATH))
-        except OSError:
+        except Exception:
+            # stale .so missing a symbol, load failure, ... -> degrade to the
+            # pure-Python paths rather than erroring the caller
             _LIB = None
         return _LIB

@@ -1,0 +1,7 @@
+"""Image processing + iterators — reference ``python/mxnet/image/``."""
+from .image import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403
+from . import image
+from . import detection
+
+__all__ = image.__all__ + detection.__all__

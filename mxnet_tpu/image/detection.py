@@ -1,0 +1,469 @@
+"""Detection image pipeline — reference ``python/mxnet/image/detection.py``
+(DetAugmenter :39, DetHorizontalFlipAug :126, DetRandomCropAug :152,
+DetRandomPadAug :324, CreateDetAugmenter :483, ImageDetIter :625).
+
+Label convention (same as the reference's packed det records): the flat label
+is ``[header_width, object_width, <extra header...>, obj0, obj1, ...]`` where
+each object is ``object_width`` floats ``[class, xmin, ymin, xmax, ymax, ...]``
+with coordinates normalized to [0, 1].  Batch labels are padded with -1 rows.
+"""
+from __future__ import annotations
+
+import json
+import random as pyrandom
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import array
+from .. import io
+from . import image as img_mod
+
+__all__ = [
+    "DetAugmenter",
+    "DetBorrowAug",
+    "DetRandomSelectAug",
+    "DetHorizontalFlipAug",
+    "DetRandomCropAug",
+    "DetRandomPadAug",
+    "CreateMultiRandCropAugmenter",
+    "CreateDetAugmenter",
+    "ImageDetIter",
+]
+
+
+class DetAugmenter:
+    """Detection augmenter base: __call__(src, label) (reference :39)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        for k, v in self._kwargs.items():
+            if isinstance(v, np.ndarray):
+                self._kwargs[k] = v.tolist()
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Lifts an image-only Augmenter into a det augmenter (reference :65)."""
+
+    def __init__(self, augmenter):
+        if not isinstance(augmenter, img_mod.Augmenter):
+            raise RuntimeError("Validation: invalid augmenter to borrow from")
+        super().__init__(augmenter=augmenter.dumps())
+        self.augmenter = augmenter
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(), self.augmenter.dumps()]
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly applies one of aug_list, or skips (reference :90)."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        super().__init__(skip_prob=skip_prob)
+        if not isinstance(aug_list, (list, tuple)):
+            aug_list = [aug_list]
+        for aug in aug_list:
+            if not isinstance(aug, DetAugmenter):
+                raise RuntimeError("Validation: invalid augmenter in aug_list")
+        self.aug_list = aug_list
+        self.skip_prob = skip_prob
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(), [x.dumps() for x in self.aug_list]]
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.skip_prob or not self.aug_list:
+            return src, label
+        return pyrandom.choice(self.aug_list)(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Flips image and x-coordinates with probability p (reference :126)."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.p:
+            src = np.asarray(src)[:, ::-1]
+            label = label.copy()
+            tmp = 1.0 - label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = tmp
+        return src, label
+
+
+def _box_iob(crop, boxes):
+    """Intersection-over-box-area of crop (x1,y1,x2,y2) vs boxes (N,4)."""
+    ix1 = np.maximum(crop[0], boxes[:, 0])
+    iy1 = np.maximum(crop[1], boxes[:, 1])
+    ix2 = np.minimum(crop[2], boxes[:, 2])
+    iy2 = np.minimum(crop[3], boxes[:, 3])
+    iw = np.maximum(0.0, ix2 - ix1)
+    ih = np.maximum(0.0, iy2 - iy1)
+    area = np.maximum(1e-12, (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1]))
+    return iw * ih / area
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop with object-coverage constraints (reference :152).
+
+    Samples crops until one covers at least ``min_object_covered`` of some
+    object; objects whose centers fall outside the crop are dropped, the rest
+    are clipped and renormalized.
+    """
+
+    def __init__(
+        self,
+        min_object_covered=0.1,
+        aspect_ratio_range=(0.75, 1.33),
+        area_range=(0.05, 1.0),
+        min_eject_coverage=0.3,
+        max_attempts=50,
+    ):
+        if not isinstance(aspect_ratio_range, (tuple, list)):
+            aspect_ratio_range = (aspect_ratio_range, aspect_ratio_range)
+        if not isinstance(area_range, (tuple, list)):
+            area_range = (area_range, 1.0)
+        super().__init__(
+            min_object_covered=min_object_covered,
+            aspect_ratio_range=aspect_ratio_range,
+            area_range=area_range,
+            min_eject_coverage=min_eject_coverage,
+            max_attempts=max_attempts,
+        )
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+        self.enabled = area_range[1] > area_range[0] or area_range[1] < 1.0
+
+    def _update_labels(self, label, crop):
+        """Returns updated labels for crop (x1,y1,x2,y2 normalized) or None."""
+        x1, y1, x2, y2 = crop
+        cw, ch = max(1e-12, x2 - x1), max(1e-12, y2 - y1)
+        boxes = label[:, 1:5]
+        coverage = _box_iob(np.asarray(crop), boxes)
+        centers_x = (boxes[:, 0] + boxes[:, 2]) / 2
+        centers_y = (boxes[:, 1] + boxes[:, 3]) / 2
+        keep = (
+            (centers_x > x1)
+            & (centers_x < x2)
+            & (centers_y > y1)
+            & (centers_y < y2)
+            & (coverage >= self.min_eject_coverage)
+        )
+        if not keep.any():
+            return None
+        out = label[keep].copy()
+        out[:, 1] = np.clip((out[:, 1] - x1) / cw, 0, 1)
+        out[:, 2] = np.clip((out[:, 2] - y1) / ch, 0, 1)
+        out[:, 3] = np.clip((out[:, 3] - x1) / cw, 0, 1)
+        out[:, 4] = np.clip((out[:, 4] - y1) / ch, 0, 1)
+        return out
+
+    def _sample_crop(self, label):
+        for _ in range(self.max_attempts):
+            area = pyrandom.uniform(*self.area_range)
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            w = min(1.0, np.sqrt(area * ratio))
+            h = min(1.0, np.sqrt(area / ratio))
+            x1 = pyrandom.uniform(0.0, 1.0 - w)
+            y1 = pyrandom.uniform(0.0, 1.0 - h)
+            crop = (x1, y1, x1 + w, y1 + h)
+            coverage = _box_iob(np.asarray(crop), label[:, 1:5])
+            if coverage.max() >= self.min_object_covered:
+                new_label = self._update_labels(label, crop)
+                if new_label is not None:
+                    return crop, new_label
+        return None, None
+
+    def __call__(self, src, label):
+        if not self.enabled or label.shape[0] == 0:
+            return src, label
+        crop, new_label = self._sample_crop(label)
+        if crop is None:
+            return src, label
+        src = np.asarray(src)
+        h, w = src.shape[:2]
+        x1 = int(crop[0] * w)
+        y1 = int(crop[1] * h)
+        x2 = max(x1 + 1, int(crop[2] * w))
+        y2 = max(y1 + 1, int(crop[3] * h))
+        return src[y1:y2, x1:x2], new_label
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Random expansion padding with fill value (reference :324)."""
+
+    def __init__(
+        self,
+        aspect_ratio_range=(0.75, 1.33),
+        area_range=(1.0, 3.0),
+        max_attempts=50,
+        pad_val=(127, 127, 127),
+    ):
+        if not isinstance(pad_val, (tuple, list)):
+            pad_val = (pad_val,)
+        super().__init__(
+            aspect_ratio_range=aspect_ratio_range,
+            area_range=area_range,
+            max_attempts=max_attempts,
+            pad_val=pad_val,
+        )
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.pad_val = pad_val
+        self.enabled = area_range[1] > 1.0
+
+    def __call__(self, src, label):
+        if not self.enabled:
+            return src, label
+        src = np.asarray(src)
+        h, w = src.shape[:2]
+        for _ in range(self.max_attempts):
+            area = pyrandom.uniform(*self.area_range)
+            ratio = pyrandom.uniform(*self.aspect_ratio_range) * (w / h)
+            nw = int(w * np.sqrt(area * ratio))
+            nh = int(h * np.sqrt(area / ratio))
+            if nw < w or nh < h:
+                continue
+            x0 = pyrandom.randint(0, nw - w)
+            y0 = pyrandom.randint(0, nh - h)
+            c = src.shape[2] if src.ndim == 3 else 1
+            canvas = np.empty((nh, nw, c), dtype=src.dtype)
+            canvas[:] = np.asarray(self.pad_val[:c], dtype=src.dtype)
+            canvas[y0 : y0 + h, x0 : x0 + w] = src.reshape(h, w, c)
+            label = label.copy()
+            label[:, 1] = (label[:, 1] * w + x0) / nw
+            label[:, 2] = (label[:, 2] * h + y0) / nh
+            label[:, 3] = (label[:, 3] * w + x0) / nw
+            label[:, 4] = (label[:, 4] * h + y0) / nh
+            return canvas, label
+        return src, label
+
+
+def CreateMultiRandCropAugmenter(
+    min_object_covered=0.1,
+    aspect_ratio_range=(0.75, 1.33),
+    area_range=(0.05, 1.0),
+    min_eject_coverage=0.3,
+    max_attempts=50,
+    skip_prob=0,
+):
+    """One DetRandomSelectAug over per-threshold crop augmenters (reference :418)."""
+
+    def _as_list(x):
+        return list(x) if isinstance(x, (list, tuple)) and isinstance(x[0], (list, tuple)) else [x]
+
+    covered = min_object_covered if isinstance(min_object_covered, (list, tuple)) else [min_object_covered]
+    ratios = _as_list(aspect_ratio_range)
+    areas = _as_list(area_range)
+    ejects = min_eject_coverage if isinstance(min_eject_coverage, (list, tuple)) else [min_eject_coverage]
+    n = max(len(covered), len(ratios), len(areas), len(ejects))
+
+    def _pick(lst, i):
+        return lst[i] if i < len(lst) else lst[-1]
+
+    augs = [
+        DetRandomCropAug(
+            min_object_covered=_pick(covered, i),
+            aspect_ratio_range=_pick(ratios, i),
+            area_range=_pick(areas, i),
+            min_eject_coverage=_pick(ejects, i),
+            max_attempts=max_attempts,
+        )
+        for i in range(n)
+    ]
+    return DetRandomSelectAug(augs, skip_prob=skip_prob)
+
+
+def CreateDetAugmenter(
+    data_shape,
+    resize=0,
+    rand_crop=0,
+    rand_pad=0,
+    rand_gray=0,
+    rand_mirror=False,
+    mean=None,
+    std=None,
+    brightness=0,
+    contrast=0,
+    saturation=0,
+    pca_noise=0,
+    hue=0,
+    inter_method=2,
+    min_object_covered=0.1,
+    aspect_ratio_range=(0.75, 1.33),
+    area_range=(0.05, 3.0),
+    min_eject_coverage=0.3,
+    max_attempts=50,
+    pad_val=(127, 127, 127),
+):
+    """Standard detection augmentation list (reference :483)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(img_mod.ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        crop_augs = CreateMultiRandCropAugmenter(
+            min_object_covered=min_object_covered,
+            aspect_ratio_range=aspect_ratio_range,
+            area_range=(area_range[0], min(1.0, area_range[1])),
+            min_eject_coverage=min_eject_coverage,
+            max_attempts=max_attempts,
+            skip_prob=1 - rand_crop,
+        )
+        auglist.append(crop_augs)
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    if rand_pad > 0:
+        auglist.append(
+            DetRandomSelectAug(
+                [
+                    DetRandomPadAug(
+                        aspect_ratio_range, (1.0, max(1.0, area_range[1])), max_attempts, pad_val
+                    )
+                ],
+                1 - rand_pad,
+            )
+        )
+    auglist.append(DetBorrowAug(img_mod.ForceResizeAug((data_shape[2], data_shape[1]), inter_method)))
+    auglist.append(DetBorrowAug(img_mod.CastAug()))
+    if brightness or contrast or saturation:
+        auglist.append(DetBorrowAug(img_mod.ColorJitterAug(brightness, contrast, saturation)))
+    if hue:
+        auglist.append(DetBorrowAug(img_mod.HueJitterAug(hue)))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array(
+            [
+                [-0.5675, 0.7192, 0.4009],
+                [-0.5808, -0.0045, -0.8140],
+                [-0.5836, -0.6948, 0.4203],
+            ]
+        )
+        auglist.append(DetBorrowAug(img_mod.LightingAug(pca_noise, eigval, eigvec)))
+    if rand_gray > 0:
+        auglist.append(DetBorrowAug(img_mod.RandomGrayAug(rand_gray)))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(DetBorrowAug(img_mod.ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(img_mod.ImageIter):
+    """Detection iterator yielding (data, padded object labels) (reference :625)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None, path_imglist=None,
+                 path_root=None, shuffle=False, aug_list=None, imglist=None,
+                 object_width=5, max_objects=None, data_name="data",
+                 label_name="label", last_batch_handle="pad", **kwargs):
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(data_shape, **kwargs)
+            kwargs = {}
+        super().__init__(
+            batch_size=batch_size,
+            data_shape=data_shape,
+            path_imgrec=path_imgrec,
+            path_imglist=path_imglist,
+            path_root=path_root,
+            shuffle=shuffle,
+            aug_list=[],
+            imglist=imglist,
+            data_name=data_name,
+            label_name=label_name,
+            last_batch_handle=last_batch_handle,
+        )
+        self.det_auglist = aug_list
+        self.object_width = object_width
+        if max_objects is None:
+            max_objects = self._scan_max_objects()
+        self.max_objects = max_objects
+
+    def _parse_label(self, label):
+        """Flat packed label -> (N, object_width) array (reference _parse_label)."""
+        raw = np.asarray(label, dtype=np.float32).ravel()
+        if raw.size < 2:
+            raise MXNetError("label must start with [header_width, object_width]")
+        header_width = int(raw[0])
+        obj_width = int(raw[1])
+        if obj_width < 5:
+            raise MXNetError("object width must be >= 5 (class + 4 coords)")
+        body = raw[header_width:]
+        n = body.size // obj_width
+        return body[: n * obj_width].reshape(n, obj_width)
+
+    def _scan_max_objects(self):
+        mx_obj = 1
+        cur, seq = self.cur, list(self.seq)
+        self.cur = 0
+        try:
+            while True:
+                label, _ = self.next_sample()
+                mx_obj = max(mx_obj, self._parse_label(label).shape[0])
+        except StopIteration:
+            pass
+        self.cur = cur
+        self.seq = seq
+        return mx_obj
+
+    @property
+    def provide_label(self):
+        return [
+            io.DataDesc(
+                self.label_name,
+                (self.batch_size, self.max_objects, self.object_width),
+                np.float32,
+            )
+        ]
+
+    def next(self):
+        c, h, w = self.data_shape
+        data = np.zeros((self.batch_size, c, h, w), dtype=np.float32)
+        labels = np.full(
+            (self.batch_size, self.max_objects, self.object_width), -1.0, dtype=np.float32
+        )
+        i = 0
+        try:
+            while i < self.batch_size:
+                raw_label, img = self.next_sample()
+                obj = self._parse_label(raw_label)
+                for aug in self.det_auglist:
+                    img, obj = aug(img, obj)
+                if img.ndim == 2:
+                    img = img[..., None]
+                if img.shape[:2] != (h, w):
+                    raise MXNetError(
+                        "augmented image shape %s != data_shape %s" % (img.shape, self.data_shape)
+                    )
+                data[i] = np.asarray(img, dtype=np.float32).transpose(2, 0, 1)[:c]
+                n = min(obj.shape[0], self.max_objects)
+                labels[i, :n, : obj.shape[1]] = obj[:n, : self.object_width]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+            if self.last_batch_handle == "discard":
+                raise
+        return io.DataBatch(
+            data=[array(data)],
+            label=[array(labels)],
+            pad=self.batch_size - i,
+            provide_data=self.provide_data,
+            provide_label=self.provide_label,
+        )

@@ -1,0 +1,689 @@
+"""Image utilities and ImageIter — reference ``python/mxnet/image/image.py``
+(imread :45, imdecode :86, resize_short :230, crops :292-509, Augmenter
+classes :493-901, CreateAugmenter :903, ImageIter :1017).
+
+TPU-first design note: the reference runs augmenters on NDArrays through the
+dependency engine; here the whole augmentation pipeline is host-side numpy
+(uint8/float32 HWC) and only the final batch is materialized as an NDArray —
+host work stays off the device, the device sees one NCHW batch per step.
+Functions accept NDArray or numpy and return numpy.
+"""
+from __future__ import annotations
+
+import os
+import random as pyrandom
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, array
+from .. import io
+from .. import recordio
+
+__all__ = [
+    "imread",
+    "imdecode",
+    "scale_down",
+    "resize_short",
+    "imresize",
+    "fixed_crop",
+    "random_crop",
+    "center_crop",
+    "color_normalize",
+    "random_size_crop",
+    "Augmenter",
+    "SequentialAug",
+    "ResizeAug",
+    "ForceResizeAug",
+    "RandomCropAug",
+    "RandomSizedCropAug",
+    "CenterCropAug",
+    "RandomOrderAug",
+    "BrightnessJitterAug",
+    "ContrastJitterAug",
+    "SaturationJitterAug",
+    "HueJitterAug",
+    "ColorJitterAug",
+    "LightingAug",
+    "ColorNormalizeAug",
+    "RandomGrayAug",
+    "HorizontalFlipAug",
+    "CastAug",
+    "CreateAugmenter",
+    "ImageIter",
+]
+
+_GRAY_COEF = np.array([0.299, 0.587, 0.114], dtype=np.float32)
+
+
+def _to_np(img):
+    if isinstance(img, NDArray):
+        return img.asnumpy()
+    return np.asarray(img)
+
+
+def imdecode(buf, to_rgb=1, flag=1, **kwargs):
+    """Decodes an image byte buffer to an HWC array (reference :86).
+
+    Uses the native JPEG decoder (src/io/image_decode.cc) when available,
+    PIL otherwise.  ``flag=0`` decodes to grayscale (H, W, 1).
+    """
+    if isinstance(buf, np.ndarray) and buf.dtype == np.uint8:
+        buf = buf.tobytes()
+    img = recordio._decode_image(bytes(buf))
+    if not to_rgb:
+        img = img[..., ::-1]  # BGR like OpenCV default
+    if flag == 0:
+        img = (img.astype(np.float32) @ _GRAY_COEF).astype(np.uint8)[..., None]
+    return img
+
+
+def imread(filename, *args, **kwargs):
+    """Reads and decodes an image file (reference :45)."""
+    if not os.path.isfile(filename):
+        raise MXNetError("image file %s not found" % filename)
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), *args, **kwargs)
+
+
+def scale_down(src_size, size):
+    """Scales requested crop size down to fit the source (reference :140)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+_PIL_INTERP = {0: 0, 1: 2, 2: 3, 3: 0, 4: 1}  # cv2 code -> PIL filter
+
+
+def _get_interp_method(interp, sizes=()):
+    """Maps cv2-style interp codes incl. 9/10 auto modes (reference :175)."""
+    if interp == 9:
+        if sizes:
+            oh, ow, _, nh, nw = sizes[0], sizes[1], None, sizes[2], sizes[3]
+            return 2 if nh > oh and nw > ow else 3
+        return 2
+    if interp == 10:
+        return pyrandom.randint(0, 4)
+    if interp not in (0, 1, 2, 3, 4):
+        raise ValueError("Unknown interp method %d" % interp)
+    return interp
+
+
+def imresize(src, w, h, interp=2):
+    """Resizes HWC image to (h, w) (reference mx.image.imresize)."""
+    from PIL import Image
+
+    src = _to_np(src)
+    squeeze = False
+    if src.ndim == 3 and src.shape[2] == 1:
+        src = src[..., 0]
+        squeeze = True
+    dtype = src.dtype
+    pil = Image.fromarray(src.astype(np.uint8) if dtype != np.uint8 else src)
+    interp = _get_interp_method(interp, (src.shape[0], src.shape[1], h, w))
+    out = np.asarray(pil.resize((w, h), resample=_PIL_INTERP[interp]))
+    if squeeze:
+        out = out[..., None]
+    return out.astype(dtype) if dtype != np.uint8 else out
+
+
+def resize_short(src, size, interp=2):
+    """Resizes so the shorter edge equals size (reference :230)."""
+    src = _to_np(src)
+    h, w = src.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp=interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    """Crops a fixed region, optionally resizing to size (reference :292)."""
+    src = _to_np(src)
+    out = src[y0 : y0 + h, x0 : x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp=interp)
+    return out
+
+
+def random_crop(src, size, interp=2):
+    """Randomly crops to size, scaling down if needed (reference :324).
+
+    Returns (cropped, (x0, y0, w, h)).
+    """
+    src = _to_np(src)
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = pyrandom.randint(0, w - new_w)
+    y0 = pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    """Center-crops to size (reference :363).  Returns (cropped, region)."""
+    src = _to_np(src)
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    """(src - mean) / std in float32 (reference :412)."""
+    src = _to_np(src).astype(np.float32)
+    src = src - np.asarray(mean, dtype=np.float32)
+    if std is not None:
+        src = src / np.asarray(std, dtype=np.float32)
+    return src
+
+
+def random_size_crop(src, size, area, ratio, interp=2, **kwargs):
+    """Random crop with area/aspect-ratio constraints (reference :436)."""
+    src = _to_np(src)
+    h, w = src.shape[:2]
+    src_area = h * w
+    if "min_area" in kwargs:
+        area = kwargs.pop("min_area")
+    assert not kwargs, "unexpected keyword arguments: %s" % str(kwargs.keys())
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = pyrandom.uniform(area[0], area[1]) * src_area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        new_ratio = np.exp(pyrandom.uniform(*log_ratio))
+        new_w = int(round(np.sqrt(target_area * new_ratio)))
+        new_h = int(round(np.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = pyrandom.randint(0, w - new_w)
+            y0 = pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+class Augmenter:
+    """Image augmenter base (reference :493)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        for k, v in self._kwargs.items():
+            if isinstance(v, np.ndarray):
+                self._kwargs[k] = v.tolist()
+
+    def dumps(self):
+        import json
+
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    """Applies a list of augmenters in order (reference :519)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(), [t.dumps() for t in self.ts]]
+
+    def __call__(self, src):
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    """Resize shorter edge (reference :542)."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    """Force resize to (w, h) (reference :562)."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        src = _to_np(src)
+        sizes = (src.shape[0], src.shape[1], self.size[1], self.size[0])
+        return imresize(src, *self.size, interp=_get_interp_method(self.interp, sizes))
+
+
+class RandomCropAug(Augmenter):
+    """Random crop to size (reference :583)."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    """Random area/ratio crop (reference :603)."""
+
+    def __init__(self, size, area, ratio, interp=2, **kwargs):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size = size
+        if "min_area" in kwargs:
+            area = kwargs.pop("min_area")
+        assert not kwargs, "unexpected keyword arguments: %s" % str(kwargs.keys())
+        self.area = area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    """Center crop (reference :637)."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomOrderAug(Augmenter):
+    """Applies augmenters in random order (reference :657)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(), [t.dumps() for t in self.ts]]
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        pyrandom.shuffle(ts)
+        for t in ts:
+            src = t(src)
+        return src
+
+
+class BrightnessJitterAug(Augmenter):
+    """src *= 1 + U(-b, b) (reference :681)."""
+
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
+        return _to_np(src).astype(np.float32) * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    """Blend with mean gray level (reference :700)."""
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        src = _to_np(src).astype(np.float32)
+        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
+        gray = src @ _GRAY_COEF
+        gray_mean = (3.0 * (1.0 - alpha) / gray.size) * gray.sum()
+        return src * alpha + gray_mean
+
+
+class SaturationJitterAug(Augmenter):
+    """Blend with per-pixel gray (reference :723)."""
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        src = _to_np(src).astype(np.float32)
+        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
+        gray = (src @ _GRAY_COEF)[..., None] * (1.0 - alpha)
+        return src * alpha + gray
+
+
+class HueJitterAug(Augmenter):
+    """Rotates hue in YIQ space (reference :747)."""
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = np.array(
+            [[0.299, 0.587, 0.114], [0.596, -0.274, -0.321], [0.211, -0.523, 0.311]],
+            dtype=np.float32,
+        )
+        self.ityiq = np.array(
+            [[1.0, 0.956, 0.621], [1.0, -0.272, -0.647], [1.0, -1.107, 1.705]],
+            dtype=np.float32,
+        )
+
+    def __call__(self, src):
+        src = _to_np(src).astype(np.float32)
+        alpha = pyrandom.uniform(-self.hue, self.hue)
+        u = np.cos(alpha * np.pi)
+        w = np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]], dtype=np.float32)
+        t = self.ityiq @ bt @ self.tyiq
+        return src @ t.T
+
+
+class ColorJitterAug(RandomOrderAug):
+    """Random-order brightness/contrast/saturation (reference :781)."""
+
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """PCA-based lighting noise (reference :804)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd, eigval=eigval, eigvec=eigvec)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, dtype=np.float32)
+        self.eigvec = np.asarray(eigvec, dtype=np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,)).astype(np.float32)
+        rgb = self.eigvec @ (alpha * self.eigval)
+        return _to_np(src).astype(np.float32) + rgb
+
+
+class ColorNormalizeAug(Augmenter):
+    """Mean/std normalization (reference :830)."""
+
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean = None if mean is None else np.asarray(mean, dtype=np.float32)
+        self.std = None if std is None else np.asarray(std, dtype=np.float32)
+
+    def __call__(self, src):
+        src = _to_np(src).astype(np.float32)
+        if self.mean is not None:
+            src = src - self.mean
+        if self.std is not None:
+            src = src / self.std
+        return src
+
+
+class RandomGrayAug(Augmenter):
+    """Randomly converts to gray (reference :850)."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        src = _to_np(src)
+        if pyrandom.random() < self.p:
+            gray = src.astype(np.float32) @ _GRAY_COEF
+            src = np.repeat(gray[..., None], 3, axis=-1)
+        return src
+
+
+class HorizontalFlipAug(Augmenter):
+    """Random horizontal flip (reference :872)."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            return _to_np(src)[:, ::-1]
+        return _to_np(src)
+
+
+class CastAug(Augmenter):
+    """Cast to dtype (reference :891)."""
+
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return _to_np(src).astype(self.typ)
+
+
+def CreateAugmenter(
+    data_shape,
+    resize=0,
+    rand_crop=False,
+    rand_resize=False,
+    rand_mirror=False,
+    mean=None,
+    std=None,
+    brightness=0,
+    contrast=0,
+    saturation=0,
+    hue=0,
+    pca_noise=0,
+    rand_gray=0,
+    inter_method=2,
+):
+    """Builds the standard augmentation list (reference :903)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, 0.08, (3.0 / 4.0, 4.0 / 3.0), inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array(
+            [
+                [-0.5675, 0.7192, 0.4009],
+                [-0.5808, -0.0045, -0.8140],
+                [-0.5836, -0.6948, 0.4203],
+            ]
+        )
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    elif mean is not None:
+        mean = np.asarray(mean)
+        assert mean.shape[0] in (1, 3)
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    elif std is not None:
+        std = np.asarray(std)
+        assert std.shape[0] in (1, 3)
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(io.DataIter):
+    """Flexible Python-side image iterator (reference :1017).
+
+    Sources: ``path_imgrec`` (.rec file) or ``imglist`` + ``path_root``
+    (list of [label, relpath]).  Applies ``aug_list`` augmenters per image and
+    yields NCHW float32 batches.
+    """
+
+    def __init__(
+        self,
+        batch_size,
+        data_shape,
+        label_width=1,
+        path_imgrec=None,
+        path_imglist=None,
+        path_root=None,
+        shuffle=False,
+        aug_list=None,
+        imglist=None,
+        data_name="data",
+        label_name="softmax_label",
+        last_batch_handle="pad",
+        **kwargs,
+    ):
+        super().__init__(batch_size)
+        assert len(data_shape) == 3 and data_shape[0] in (1, 3)
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.data_name = data_name
+        self.label_name = label_name
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.imgrec = None
+        self.imglist = {}
+        self.seq = []
+        if path_imgrec is not None:
+            idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
+            if os.path.isfile(idx_path):
+                self.imgrec = recordio.MXIndexedRecordIO(idx_path, path_imgrec, "r")
+                self.seq = list(self.imgrec.keys)
+            else:
+                # no index: load records into memory for shuffling support
+                rec = recordio.MXRecordIO(path_imgrec, "r")
+                self._records = []
+                while True:
+                    item = rec.read()
+                    if item is None:
+                        break
+                    self._records.append(item)
+                rec.close()
+                self.seq = list(range(len(self._records)))
+        elif path_imglist is not None or imglist is not None:
+            if path_imglist is not None:
+                imglist = []
+                with open(path_imglist) as fin:
+                    for line in fin:
+                        parts = line.strip().split("\t")
+                        if len(parts) < 3:
+                            continue
+                        imglist.append([float(x) for x in parts[1:-1]] + [parts[-1]])
+            for i, entry in enumerate(imglist):
+                label = np.asarray(entry[:-1], dtype=np.float32)
+                self.imglist[i] = (label, entry[-1])
+                self.seq.append(i)
+            self.path_root = path_root or "."
+        else:
+            raise MXNetError("either path_imgrec, path_imglist, or imglist is required")
+        if not self.seq:
+            raise MXNetError("empty image source")
+        if aug_list is None:
+            aug_list = CreateAugmenter(data_shape, **kwargs)
+        self.auglist = aug_list
+        self.cur = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [io.DataDesc(self.data_name, (self.batch_size,) + self.data_shape, np.float32)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 else (self.batch_size, self.label_width)
+        return [io.DataDesc(self.label_name, shape, np.float32)]
+
+    def reset(self):
+        if self.shuffle:
+            pyrandom.shuffle(self.seq)
+        self.cur = 0
+
+    def next_sample(self):
+        """Returns (label, raw image array HWC uint8)."""
+        if self.cur >= len(self.seq):
+            raise StopIteration
+        idx = self.seq[self.cur]
+        self.cur += 1
+        if self.imgrec is not None:
+            s = self.imgrec.read_idx(idx)
+            header, img = recordio.unpack_img(s)
+            label = header.label
+            return label, img
+        if hasattr(self, "_records"):
+            header, img = recordio.unpack_img(self._records[idx])
+            return header.label, img
+        label, fname = self.imglist[idx]
+        return label, imread(os.path.join(self.path_root, fname))
+
+    def _aug(self, img):
+        for aug in self.auglist:
+            img = aug(img)
+        return img
+
+    def next(self):
+        c, h, w = self.data_shape
+        data = np.zeros((self.batch_size, c, h, w), dtype=np.float32)
+        label = np.zeros((self.batch_size, self.label_width), dtype=np.float32)
+        i = 0
+        try:
+            while i < self.batch_size:
+                lab, img = self.next_sample()
+                img = self._aug(img)
+                if img.shape[:2] != (h, w):
+                    raise MXNetError(
+                        "augmented image shape %s does not match data_shape %s; "
+                        "add a crop/resize augmenter" % (img.shape, self.data_shape)
+                    )
+                if img.ndim == 2:
+                    img = img[..., None]
+                data[i] = img.astype(np.float32).transpose(2, 0, 1)[:c]
+                lab = np.atleast_1d(np.asarray(lab, dtype=np.float32))
+                label[i, : min(self.label_width, lab.size)] = lab[: self.label_width]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+            if self.last_batch_handle == "discard":
+                raise
+        pad = self.batch_size - i
+        lab_out = label[:, 0] if self.label_width == 1 else label
+        return io.DataBatch(
+            data=[array(data)],
+            label=[array(lab_out)],
+            pad=pad,
+            provide_data=self.provide_data,
+            provide_label=self.provide_label,
+        )

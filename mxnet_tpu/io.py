@@ -468,6 +468,8 @@ class ImageRecordIter(DataIter):
             self._rand_mirror = rand_mirror
             self._rand_crop = rand_crop
             self._rng = np.random.RandomState(seed)
+            if self._shuffle:  # shuffle epoch 1 too (native Reset() does)
+                self._rng.shuffle(self._order)
             self._cursor = 0
         if self._num == 0:
             raise MXNetError("record file %s is empty" % path_imgrec)

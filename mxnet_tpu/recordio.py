@@ -46,6 +46,8 @@ class _PyWriter:
         return self._f.tell()
 
     def write(self, data):
+        if len(data) >= (1 << 29):
+            raise ValueError("record too large: %d bytes (max 2^29-1)" % len(data))
         start = self._f.tell()
         # Split payload at embedded magic words (dmlc recordio scheme).
         chunks = data.split(_MAGIC_BYTES)
@@ -120,6 +122,8 @@ class _NativeWriter:
         return self._lib.MXTRecordIOWriterTell(self._h)
 
     def write(self, data):
+        if len(data) >= (1 << 29):
+            raise ValueError("record too large: %d bytes (max 2^29-1)" % len(data))
         return self._lib.MXTRecordIOWriterWrite(self._h, data, len(data))
 
     def close(self):

@@ -22,8 +22,9 @@ bool DecodeJPEG(const uint8_t* buf, size_t len, std::vector<uint8_t>* out,
                 int* width, int* height, int* channels);
 void ResizeBilinear(const uint8_t* src, int sh, int sw, int c, uint8_t* dst,
                     int dh, int dw);
-void NormalizeToCHW(const uint8_t* src, int h, int w, int c, float* dst,
-                    const float* mean, const float* stdv, int mirror);
+void NormalizeToCHW(const uint8_t* src, int h, int w, int src_c, float* dst,
+                    int out_c, const float* mean, const float* stdv,
+                    int mirror);
 
 // Image-record payload header: struct {u32 flag; f32 label; u64 id; u64 id2}
 // (+ flag extra f32 labels), mirroring python/mxnet/recordio.py _IR_FORMAT.
@@ -60,16 +61,21 @@ class ImageRecordLoader {
  public:
   ImageRecordLoader(const std::string& rec_path, const LoaderConfig& cfg)
       : path_(rec_path), cfg_(cfg), rng_(cfg.seed) {
-    // Scan the file once to collect record offsets (the .idx file in the
-    // reference is an optimization over exactly this scan).
-    RecordIOReader scan(rec_path);
-    ok_ = scan.ok();
+    {
+      RecordIOReader probe(rec_path);
+      ok_ = probe.ok();
+    }
     if (!ok_) return;
-    std::vector<char> tmp;
-    uint64_t pos = scan.Tell();
-    while (scan.NextRecord(&tmp)) {
-      offsets_.push_back(pos);
-      pos = scan.Tell();
+    // Prefer the .idx sidecar (written by im2rec / MXIndexedRecordIO) over a
+    // full sequential scan — on large .rec files the scan is minutes of IO.
+    if (!LoadIndex(rec_path)) {
+      RecordIOReader scan(rec_path);
+      std::vector<char> tmp;
+      uint64_t pos = scan.Tell();
+      while (scan.NextRecord(&tmp)) {
+        offsets_.push_back(pos);
+        pos = scan.Tell();
+      }
     }
     order_.resize(offsets_.size());
     Reset();
@@ -141,6 +147,24 @@ class ImageRecordLoader {
   }
 
  private:
+  // Parses PREFIX.idx ("key\toffset\n" per record) next to PREFIX.rec.
+  bool LoadIndex(const std::string& rec_path) {
+    std::string idx_path = rec_path;
+    const size_t dot = idx_path.rfind('.');
+    if (dot == std::string::npos) return false;
+    idx_path = idx_path.substr(0, dot) + ".idx";
+    std::FILE* f = std::fopen(idx_path.c_str(), "r");
+    if (!f) return false;
+    char line[256];
+    while (std::fgets(line, sizeof(line), f)) {
+      long long key, off;
+      if (std::sscanf(line, "%lld\t%lld", &key, &off) == 2)
+        offsets_.push_back((uint64_t)off);
+    }
+    std::fclose(f);
+    return !offsets_.empty();
+  }
+
   void WorkerLoop() {
     RecordIOReader reader(path_);
     std::vector<char> rec;
@@ -212,8 +236,8 @@ class ImageRecordLoader {
       ResizeBilinear(src, h, w, c, resized->data(), th, tw);
       src = resized->data();
     }
-    NormalizeToCHW(src, th, tw, std::min(c, cfg_.channels), data, cfg_.mean,
-                   cfg_.stdv, plan.mirror);
+    NormalizeToCHW(src, th, tw, c, data, cfg_.channels, cfg_.mean, cfg_.stdv,
+                   plan.mirror);
   }
 
   std::string path_;

@@ -86,18 +86,27 @@ void ResizeBilinear(const uint8_t* src, int sh, int sw, int c, uint8_t* dst,
   }
 }
 
-// HWC u8 -> CHW float with mean/std and optional horizontal mirror.
-void NormalizeToCHW(const uint8_t* src, int h, int w, int c, float* dst,
-                    const float* mean, const float* stdv, int mirror) {
-  for (int k = 0; k < c; ++k) {
+// HWC u8 (src_c interleaved channels) -> CHW float (out_c planes) with
+// mean/std and optional horizontal mirror.  out_c == 1 with an RGB source
+// converts to luminance (matching the reference's grayscale decode path);
+// otherwise extra output planes replicate the last source channel.
+void NormalizeToCHW(const uint8_t* src, int h, int w, int src_c, float* dst,
+                    int out_c, const float* mean, const float* stdv,
+                    int mirror) {
+  const bool to_gray = (out_c == 1 && src_c >= 3);
+  for (int k = 0; k < out_c; ++k) {
     const float m = mean ? mean[k] : 0.f;
     const float s = stdv ? stdv[k] : 1.f;
+    const int sk = k < src_c ? k : src_c - 1;
     float* plane = dst + (size_t)k * h * w;
     for (int y = 0; y < h; ++y) {
       for (int x = 0; x < w; ++x) {
         const int sx = mirror ? (w - 1 - x) : x;
-        plane[(size_t)y * w + x] =
-            ((float)src[((size_t)y * w + sx) * c + k] - m) / s;
+        const uint8_t* px = src + ((size_t)y * w + sx) * src_c;
+        const float v = to_gray
+                            ? 0.299f * px[0] + 0.587f * px[1] + 0.114f * px[2]
+                            : (float)px[sk];
+        plane[(size_t)y * w + x] = (v - m) / s;
       }
     }
   }

@@ -28,6 +28,8 @@ void RecordIOWriter::Close() {
 uint64_t RecordIOWriter::Tell() { return fp_ ? (uint64_t)std::ftell(fp_) : 0; }
 
 uint64_t RecordIOWriter::WriteRecord(const void* buf, size_t size) {
+  // lrec stores chunk length in 29 bits; larger payloads cannot be framed.
+  if (size >= (1u << 29)) return UINT64_MAX;
   const uint64_t start = Tell();
   const char* data = static_cast<const char*>(buf);
   const uint32_t magic = kMagic;

@@ -1,0 +1,222 @@
+"""Image pipeline tests — mirrors reference tests/python/unittest/test_image.py."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as mimg
+from mxnet_tpu import recordio
+
+
+def _gradient(h, w, phase=0.0):
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    r = (xx / max(1, w - 1)) * 255
+    g = (yy / max(1, h - 1)) * 255
+    b = ((xx + yy + phase) % 255)
+    return np.stack([r, g, b], axis=-1).astype(np.uint8)
+
+
+@pytest.fixture(scope="module")
+def jpeg_bytes():
+    from io import BytesIO
+
+    from PIL import Image
+
+    buf = BytesIO()
+    Image.fromarray(_gradient(40, 30)).save(buf, format="JPEG", quality=95)
+    return buf.getvalue()
+
+
+def test_imdecode_imread(tmp_path, jpeg_bytes):
+    img = mimg.imdecode(jpeg_bytes)
+    assert img.shape == (40, 30, 3)
+    gray = mimg.imdecode(jpeg_bytes, flag=0)
+    assert gray.shape == (40, 30, 1)
+    p = tmp_path / "x.jpg"
+    p.write_bytes(jpeg_bytes)
+    img2 = mimg.imread(str(p))
+    np.testing.assert_array_equal(img, img2)
+    with pytest.raises(mx.MXNetError):
+        mimg.imread(str(tmp_path / "missing.jpg"))
+
+
+def test_resize_and_crops():
+    img = _gradient(48, 64)
+    assert mimg.resize_short(img, 24).shape == (24, 32, 3)
+    assert mimg.resize_short(img.transpose(1, 0, 2), 24).shape == (32, 24, 3)
+    assert mimg.imresize(img, 10, 20).shape == (20, 10, 3)
+    out = mimg.fixed_crop(img, 4, 6, 16, 12)
+    np.testing.assert_array_equal(out, img[6:18, 4:20])
+    out, (x0, y0, w, h) = mimg.random_crop(img, (20, 10))
+    assert out.shape == (10, 20, 3) and (w, h) == (20, 10)
+    np.testing.assert_array_equal(out, img[y0 : y0 + 10, x0 : x0 + 20])
+    out, (x0, y0, w, h) = mimg.center_crop(img, (32, 24))
+    assert out.shape == (24, 32, 3) and x0 == 16 and y0 == 12
+    # requested crop bigger than source: scaled down then resized up
+    out, _ = mimg.random_crop(img, (128, 100))
+    assert out.shape == (100, 128, 3)
+
+
+def test_scale_down():
+    assert mimg.scale_down((640, 480), (720, 120)) == (640, 106)
+    assert mimg.scale_down((360, 1000), (480, 500)) == (360, 375)
+
+
+def test_color_normalize():
+    img = _gradient(8, 8)
+    out = mimg.color_normalize(img, mean=np.array([1.0, 2.0, 3.0]), std=np.array([2.0, 2.0, 2.0]))
+    np.testing.assert_allclose(out[..., 0], (img[..., 0] - 1.0) / 2.0, rtol=1e-6)
+
+
+def test_augmenters_shapes_and_types():
+    img = _gradient(32, 32)
+    for aug in [
+        mimg.BrightnessJitterAug(0.3),
+        mimg.ContrastJitterAug(0.3),
+        mimg.SaturationJitterAug(0.3),
+        mimg.HueJitterAug(0.1),
+        mimg.LightingAug(0.1, np.array([55.46, 4.794, 1.148]), np.random.rand(3, 3)),
+        mimg.ColorNormalizeAug(np.array([1.0, 1.0, 1.0]), np.array([2.0, 2.0, 2.0])),
+        mimg.RandomGrayAug(1.0),
+        mimg.HorizontalFlipAug(1.0),
+        mimg.CastAug(),
+    ]:
+        out = aug(img)
+        assert out.shape == img.shape, type(aug).__name__
+    flipped = mimg.HorizontalFlipAug(1.0)(img)
+    np.testing.assert_array_equal(flipped, img[:, ::-1])
+    gray = mimg.RandomGrayAug(1.0)(img)
+    assert np.allclose(gray[..., 0], gray[..., 1])
+
+
+def test_create_augmenter_pipeline():
+    augs = mimg.CreateAugmenter(
+        (3, 24, 24), resize=30, rand_crop=True, rand_mirror=True, mean=True, std=True,
+        brightness=0.1, contrast=0.1, saturation=0.1, hue=0.1, pca_noise=0.1, rand_gray=0.05,
+    )
+    img = _gradient(50, 40)
+    for aug in augs:
+        img = aug(img)
+    assert img.shape == (24, 24, 3)
+    assert img.dtype == np.float32
+
+
+def _write_rec(tmp_path, n=8, h=30, w=26, det=False):
+    prefix = str(tmp_path / "imgs")
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    for i in range(n):
+        img = _gradient(h, w, phase=i * 10)
+        if det:
+            # [header_width=2, object_width=5, cls,x1,y1,x2,y2] * objects
+            nobj = 1 + i % 3
+            objs = []
+            for j in range(nobj):
+                objs += [float(j), 0.1 + 0.05 * j, 0.2, 0.6 + 0.05 * j, 0.8]
+            label = np.array([2, 5] + objs, dtype=np.float32)
+        else:
+            label = float(i)
+        rec.write_idx(i, recordio.pack_img(recordio.IRHeader(0, label, i, 0), img))
+    rec.close()
+    return prefix + ".rec"
+
+
+def test_image_iter_rec(tmp_path):
+    rec = _write_rec(tmp_path, n=8)
+    it = mimg.ImageIter(
+        batch_size=4, data_shape=(3, 24, 24), path_imgrec=rec, shuffle=False
+    )
+    b = it.next()
+    assert b.data[0].shape == (4, 3, 24, 24)
+    assert b.label[0].asnumpy().tolist() == [0.0, 1.0, 2.0, 3.0]
+    b2 = it.next()
+    assert b2.label[0].asnumpy().tolist() == [4.0, 5.0, 6.0, 7.0]
+    with pytest.raises(StopIteration):
+        it.next()
+
+
+def test_image_iter_imglist(tmp_path):
+    from PIL import Image
+
+    root = tmp_path / "imgs"
+    os.makedirs(root)
+    imglist = []
+    for i in range(4):
+        Image.fromarray(_gradient(20, 20, i * 5)).save(root / ("%d.jpg" % i))
+        imglist.append([float(i), "%d.jpg" % i])
+    it = mimg.ImageIter(
+        batch_size=2, data_shape=(3, 20, 20), imglist=imglist, path_root=str(root)
+    )
+    b = it.next()
+    assert b.data[0].shape == (2, 3, 20, 20)
+    assert b.label[0].asnumpy().tolist() == [0.0, 1.0]
+
+
+def test_image_iter_pad_last_batch(tmp_path):
+    rec = _write_rec(tmp_path, n=5)
+    it = mimg.ImageIter(batch_size=4, data_shape=(3, 24, 24), path_imgrec=rec)
+    it.next()
+    b = it.next()
+    assert b.pad == 3
+
+
+def test_det_iter(tmp_path):
+    rec = _write_rec(tmp_path, n=6, det=True)
+    it = mimg.ImageDetIter(
+        batch_size=3, data_shape=(3, 24, 24), path_imgrec=rec, shuffle=False
+    )
+    assert it.max_objects == 3
+    b = it.next()
+    assert b.data[0].shape == (3, 3, 24, 24)
+    lab = b.label[0].asnumpy()
+    assert lab.shape == (3, 3, 5)
+    # image 0 has 1 object, rest padded with -1
+    assert lab[0, 0, 0] == 0.0
+    assert (lab[0, 1:] == -1).all()
+    # image 2 has 3 objects
+    assert (lab[2, :, 0] == [0.0, 1.0, 2.0]).all()
+    np.testing.assert_allclose(lab[2, 1, 1:], [0.15, 0.2, 0.65, 0.8], rtol=1e-5)
+
+
+def test_det_flip_updates_boxes():
+    img = _gradient(20, 20)
+    label = np.array([[0, 0.1, 0.2, 0.4, 0.8]], dtype=np.float32)
+    out, lab = mimg.DetHorizontalFlipAug(1.0)(img, label)
+    np.testing.assert_allclose(lab[0], [0, 0.6, 0.2, 0.9, 0.8], rtol=1e-5)
+    np.testing.assert_array_equal(out, img[:, ::-1])
+
+
+def test_det_random_crop_keeps_objects():
+    np.random.seed(0)
+    img = _gradient(64, 64)
+    label = np.array([[1, 0.3, 0.3, 0.7, 0.7]], dtype=np.float32)
+    aug = mimg.DetRandomCropAug(min_object_covered=0.5, area_range=(0.3, 0.9))
+    for _ in range(5):
+        out, lab = aug(img, label)
+        assert lab.shape[1] == 5
+        assert (lab[:, 1:] >= 0).all() and (lab[:, 1:] <= 1).all()
+
+
+def test_det_random_pad_updates_boxes():
+    img = _gradient(20, 20)
+    label = np.array([[0, 0.0, 0.0, 1.0, 1.0]], dtype=np.float32)
+    aug = mimg.DetRandomPadAug(area_range=(2.0, 3.0))
+    out, lab = aug(img, label)
+    assert out.shape[0] >= 20 and out.shape[1] >= 20
+    # original image box must still bound a smaller normalized region
+    assert lab[0, 3] - lab[0, 1] < 1.0 or lab[0, 4] - lab[0, 2] < 1.0
+
+
+def test_create_det_augmenter_runs():
+    augs = mimg.CreateDetAugmenter(
+        (3, 24, 24), rand_crop=0.5, rand_pad=0.5, rand_mirror=True, mean=True, std=True,
+        brightness=0.1, contrast=0.1, saturation=0.1,
+    )
+    img = _gradient(40, 40)
+    label = np.array([[0, 0.2, 0.2, 0.8, 0.8]], dtype=np.float32)
+    for _ in range(3):
+        im, lab = img, label
+        for aug in augs:
+            im, lab = aug(im, lab)
+        assert im.shape == (24, 24, 3)
+        assert lab.shape[1] == 5
