@@ -408,18 +408,24 @@ class ImageDetIter(img_mod.ImageIter):
         n = body.size // obj_width
         return body[: n * obj_width].reshape(n, obj_width)
 
+    def _iter_labels(self):
+        """Yields raw labels without decoding any image bytes."""
+        from .. import recordio as rio
+
+        if self.imgrec is not None:
+            for k in self.imgrec.keys:
+                yield rio.unpack(self.imgrec.read_idx(k))[0].label
+        elif hasattr(self, "_records"):
+            for r in self._records:
+                yield rio.unpack(r)[0].label
+        else:
+            for idx in self.imglist:
+                yield self.imglist[idx][0]
+
     def _scan_max_objects(self):
         mx_obj = 1
-        cur, seq = self.cur, list(self.seq)
-        self.cur = 0
-        try:
-            while True:
-                label, _ = self.next_sample()
-                mx_obj = max(mx_obj, self._parse_label(label).shape[0])
-        except StopIteration:
-            pass
-        self.cur = cur
-        self.seq = seq
+        for label in self._iter_labels():
+            mx_obj = max(mx_obj, self._parse_label(label).shape[0])
         return mx_obj
 
     @property

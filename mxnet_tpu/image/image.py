@@ -62,8 +62,9 @@ def _to_np(img):
     return np.asarray(img)
 
 
-def imdecode(buf, to_rgb=1, flag=1, **kwargs):
-    """Decodes an image byte buffer to an HWC array (reference :86).
+def imdecode(buf, flag=1, to_rgb=True, **kwargs):
+    """Decodes an image byte buffer to an HWC array (reference :86; same
+    positional order: ``imdecode(buf, flag, to_rgb)``).
 
     Uses the native JPEG decoder (src/io/image_decode.cc) when available,
     PIL otherwise.  ``flag=0`` decodes to grayscale (H, W, 1).
@@ -352,7 +353,7 @@ class ContrastJitterAug(Augmenter):
         src = _to_np(src).astype(np.float32)
         alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
         gray = src @ _GRAY_COEF
-        gray_mean = (3.0 * (1.0 - alpha) / gray.size) * gray.sum()
+        gray_mean = (1.0 - alpha) * gray.mean()
         return src * alpha + gray_mean
 
 

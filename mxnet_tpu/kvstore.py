@@ -77,10 +77,14 @@ class KVStore:
         (reference ``KVStoreDist::InitImpl``, ``kvstore_dist.h:181``)."""
         from . import ndarray as nd
 
+        from .ndarray.sparse import BaseSparseNDArray
+
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
             if k in self._store:
                 raise ValueError(f"key {k} already initialized")
+            if isinstance(v, BaseSparseNDArray):
+                v = v.todense()  # dense-backed store
             v = v.copy() if isinstance(v, NDArray) else nd.array(v)
             if self._is_dist:
                 v = self._broadcast_from_zero(v)
@@ -141,13 +145,36 @@ class KVStore:
             raise ValueError("row_ids must be one id set or one per key")
         from . import ndarray as nd
 
+        from .ndarray.sparse import RowSparseNDArray
+
         for i, (k, olist) in enumerate(zip(keys, outs)):
             self._check_init(k)
             src = self._store[k]
             rid = rids[0] if len(rids) == 1 else rids[i]
             for o in olist:
                 rows = nd.take(src, rid, axis=0)
-                o._rebind(rows._data)
+                if isinstance(o, RowSparseNDArray):
+                    import jax.numpy as jnp
+
+                    o._aux["data"] = rows._data
+                    o._aux["indices"] = jnp.asarray(
+                        rid._data if hasattr(rid, "_data") else rid
+                    ).astype("int32")
+                    o._data = None  # invalidate dense cache
+                elif o.shape == src.shape:
+                    # full-shape dense out: scatter pulled rows in place
+                    # (takes precedence over the gather path so permuted
+                    # full-length row_ids keep scatter semantics)
+                    idx = (rid._data if hasattr(rid, "_data") else rid).astype("int32")
+                    o._rebind(o._data.at[idx].set(rows._data))
+                elif o.shape == rows.shape:
+                    o._rebind(rows._data)
+                else:
+                    raise ValueError(
+                        "row_sparse_pull out shape %s matches neither the "
+                        "store shape %s nor the pulled rows shape %s"
+                        % (o.shape, src.shape, rows.shape)
+                    )
         return out
 
     # -- updater / optimizer ----------------------------------------------
@@ -223,9 +250,14 @@ class KVStore:
 
     @staticmethod
     def _merge(vlist):
+        from .ndarray.sparse import BaseSparseNDArray
+
         merged = vlist[0]
         for v in vlist[1:]:
             merged = merged + v
+        if isinstance(merged, BaseSparseNDArray):
+            # the store is dense-backed; materialize sparse aggregates
+            return merged.todense()
         return merged if merged is not vlist[0] else merged.copy()
 
     def _compress(self, k, merged):

@@ -183,9 +183,13 @@ sys.modules[contrib.__name__] = contrib
 # ---------------------------------------------------------------------------
 
 
-def zeros(shape, ctx=None, dtype="float32", **kwargs):
+def zeros(shape, ctx=None, dtype="float32", stype=None, **kwargs):
     import jax.numpy as jnp
 
+    if stype is not None and stype != "default":
+        from . import sparse as _sparse
+
+        return _sparse.zeros(stype, shape, ctx=ctx, dtype=dtype or "float32")
     shape = (shape,) if isinstance(shape, int) else tuple(shape)
     out = jnp.zeros(shape, dtype=dtype_np(dtype or "float32"))
     return _wrap(_to_device(out, ctx) if ctx else out, ctx)
@@ -294,3 +298,16 @@ for _fname, _opname in [
 ]:
     setattr(random, _fname, _make_random(_fname, _opname))
 sys.modules[random.__name__] = random
+
+# ---------------------------------------------------------------------------
+# nd.sparse namespace (reference mxnet/ndarray/sparse.py)
+# ---------------------------------------------------------------------------
+from . import sparse  # noqa: E402
+from .sparse import (  # noqa: E402,F401
+    BaseSparseNDArray,
+    CSRNDArray,
+    RowSparseNDArray,
+    cast_storage,
+)
+
+__all__ += ["sparse", "BaseSparseNDArray", "CSRNDArray", "RowSparseNDArray", "cast_storage"]

@@ -1,0 +1,411 @@
+"""Sparse NDArrays — reference ``python/mxnet/ndarray/sparse.py``
+(CSRNDArray, RowSparseNDArray, BaseSparseNDArray) and the C++ storage types
+``include/mxnet/ndarray.h:61-66`` (kDefaultStorage/kRowSparseStorage/
+kCSRStorage).
+
+TPU-first design: XLA has no native sparse tensors, so sparse here is a
+*storage format* for host/optimizer/kvstore paths (embedding-style gradients,
+parameter-server row pulls), not a device compute path.  RowSparse holds
+``(indices, data)``; CSR holds ``(data, indices, indptr)``.  Compute that
+benefits on TPU (csr dot dense) lowers to gather/segment ops under jit;
+everything else densifies explicitly via ``tostype('default')``.  The
+reference's fine-grained sparse kernel zoo (src/operator/tensor/ *-inl.h
+sparse branches) is deliberately collapsed into these few primitives.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError, dtype_np
+from .ndarray import NDArray, array as _dense_array, _wrap
+
+__all__ = [
+    "BaseSparseNDArray",
+    "CSRNDArray",
+    "RowSparseNDArray",
+    "csr_matrix",
+    "row_sparse_array",
+    "cast_storage",
+    "retain",
+    "dot",
+    "zeros",
+    "empty",
+    "array",
+]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class BaseSparseNDArray(NDArray):
+    """Base for sparse storage types (reference sparse.py BaseSparseNDArray).
+
+    ``_data`` holds the *dense* materialization lazily (None until needed);
+    component arrays live in subclass slots.
+    """
+
+    __slots__ = ("_shape", "_dtype", "_aux")
+
+    def __init__(self, shape, dtype):
+        super().__init__(None)
+        self._shape = tuple(int(s) for s in shape)
+        self._dtype = np.dtype(dtype)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    def _densify(self):
+        raise NotImplementedError
+
+    def _dense(self):
+        if self._data is None:
+            self._data = self._densify()
+        return self._data
+
+    def asnumpy(self):
+        """Returns a dense numpy array (reference behavior)."""
+        return np.asarray(self._dense())
+
+    def todense(self):
+        return _wrap(self._dense())
+
+    def tostype(self, stype):
+        if stype == self.stype:
+            return self
+        if stype == "default":
+            return self.todense()
+        return cast_storage(self.todense(), stype)
+
+    def astype(self, dtype, copy=True):
+        raise MXNetError("astype is not supported for %s; tostype('default') first" % self.stype)
+
+    def __getitem__(self, key):
+        raise MXNetError("indexing is not supported for %s storage" % self.stype)
+
+    def __setitem__(self, key, value):
+        raise MXNetError("assignment is not supported for %s storage" % self.stype)
+
+    def _binary(self, other, op_name):
+        """Sparse arithmetic: same-stype stays sparse, else densifies."""
+        import operator
+
+        fn = getattr(operator, op_name)
+        if isinstance(other, BaseSparseNDArray) and other.stype == self.stype:
+            out = fn(self.todense(), other.todense())
+            return cast_storage(out, self.stype)
+        if isinstance(other, NDArray):
+            return fn(self.todense(), other)
+        return fn(self.todense(), other)
+
+    def __add__(self, other):
+        return self._binary(other, "add")
+
+    def __sub__(self, other):
+        return self._binary(other, "sub")
+
+    def __mul__(self, other):
+        return self._binary(other, "mul")
+
+    def __truediv__(self, other):
+        return self._binary(other, "truediv")
+
+    def __repr__(self):
+        return "<%s %s @%s>" % (type(self).__name__, "x".join(map(str, self._shape)), self.stype)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse: ``data[i] == dense[indices[i]]`` (reference sparse.py:778).
+
+    Typical producer: embedding-gradient rows.  ``indices`` is sorted unique
+    int64; ``data`` has shape ``(len(indices),) + shape[1:]``.
+    """
+
+    __slots__ = ()
+
+    def __init__(self, data, indices, shape, dtype=None):
+        jnp = _jnp()
+        data = jnp.asarray(data)
+        dtype = dtype or data.dtype
+        super().__init__(shape, dtype)
+        self._aux = {
+            "data": data.astype(dtype_np(dtype)) if data.dtype != np.dtype(dtype) else data,
+            "indices": jnp.asarray(np.asarray(indices), dtype="int32"),
+        }
+        if self._aux["data"].shape[0] != self._aux["indices"].shape[0]:
+            raise MXNetError(
+                "row_sparse data rows (%d) != indices (%d)"
+                % (self._aux["data"].shape[0], self._aux["indices"].shape[0])
+            )
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def data(self):
+        return _wrap(self._aux["data"])
+
+    @property
+    def indices(self):
+        return _wrap(self._aux["indices"])
+
+    def _densify(self):
+        jnp = _jnp()
+        out = jnp.zeros(self._shape, dtype=self._dtype)
+        if self._aux["indices"].shape[0] == 0:
+            return out
+        return out.at[self._aux["indices"]].set(self._aux["data"])
+
+    def retain(self, indices):
+        return retain(self, indices)
+
+    def copy(self):
+        return RowSparseNDArray(self._aux["data"], self._aux["indices"], self._shape, self._dtype)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (reference sparse.py:322)."""
+
+    __slots__ = ()
+
+    def __init__(self, data, indices, indptr, shape, dtype=None):
+        jnp = _jnp()
+        data = jnp.asarray(data)
+        dtype = dtype or data.dtype
+        if len(shape) != 2:
+            raise MXNetError("csr storage requires a 2D shape, got %s" % (shape,))
+        super().__init__(shape, dtype)
+        self._aux = {
+            "data": data.astype(dtype_np(dtype)) if data.dtype != np.dtype(dtype) else data,
+            "indices": jnp.asarray(np.asarray(indices), dtype="int32"),
+            "indptr": jnp.asarray(np.asarray(indptr), dtype="int32"),
+        }
+        if self._aux["indptr"].shape[0] != shape[0] + 1:
+            raise MXNetError("indptr length must be shape[0]+1")
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def data(self):
+        return _wrap(self._aux["data"])
+
+    @property
+    def indices(self):
+        return _wrap(self._aux["indices"])
+
+    @property
+    def indptr(self):
+        return _wrap(self._aux["indptr"])
+
+    def _row_ids(self):
+        """nnz-length row index vector expanded from indptr (host-side)."""
+        indptr = np.asarray(self._aux["indptr"])
+        counts = np.diff(indptr)
+        return np.repeat(np.arange(self._shape[0], dtype=np.int64), counts)
+
+    def _densify(self):
+        jnp = _jnp()
+        out = jnp.zeros(self._shape, dtype=self._dtype)
+        if self._aux["data"].shape[0] == 0:
+            return out
+        rows = jnp.asarray(self._row_ids())
+        return out.at[rows, self._aux["indices"]].set(self._aux["data"])
+
+    def __getitem__(self, key):
+        # row slicing mirrors reference CSRNDArray.__getitem__
+        if isinstance(key, int):
+            key = slice(key, key + 1)
+        if not isinstance(key, slice) or key.step not in (None, 1):
+            raise MXNetError("csr only supports contiguous row slicing")
+        start, stop, _ = key.indices(self._shape[0])
+        indptr = np.asarray(self._aux["indptr"])
+        lo, hi = int(indptr[start]), int(indptr[stop])
+        return CSRNDArray(
+            self._aux["data"][lo:hi],
+            self._aux["indices"][lo:hi],
+            indptr[start : stop + 1] - lo,
+            (stop - start, self._shape[1]),
+            self._dtype,
+        )
+
+    def asscipy(self):
+        import scipy.sparse as sps
+
+        return sps.csr_matrix(
+            (
+                np.asarray(self._aux["data"]),
+                np.asarray(self._aux["indices"]),
+                np.asarray(self._aux["indptr"]),
+            ),
+            shape=self._shape,
+        )
+
+    def copy(self):
+        return CSRNDArray(
+            self._aux["data"],
+            self._aux["indices"],
+            self._aux["indptr"],
+            self._shape,
+            self._dtype,
+        )
+
+
+# -- creation ----------------------------------------------------------------
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Creates a RowSparseNDArray (reference sparse.py row_sparse_array).
+
+    ``arg1`` is ``(data, indices)``, a dense array/NDArray, or another
+    RowSparseNDArray.
+    """
+    if isinstance(arg1, RowSparseNDArray):
+        return arg1.copy() if shape is None else RowSparseNDArray(
+            arg1._aux["data"], arg1._aux["indices"], shape, dtype or arg1.dtype
+        )
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = data._data if isinstance(data, NDArray) else np.asarray(data)
+        if shape is None:
+            raise MXNetError("shape is required when creating from (data, indices)")
+        return RowSparseNDArray(data, np.asarray(indices), shape, dtype)
+    dense = arg1 if isinstance(arg1, NDArray) else _dense_array(np.asarray(arg1), dtype=dtype)
+    return cast_storage(dense, "row_sparse")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Creates a CSRNDArray from (data, indices, indptr), dense, or scipy."""
+    if isinstance(arg1, CSRNDArray):
+        return arg1.copy()
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = data._data if isinstance(data, NDArray) else np.asarray(data)
+        if shape is None:
+            raise MXNetError("shape is required when creating from (data, indices, indptr)")
+        return CSRNDArray(data, np.asarray(indices), np.asarray(indptr), shape, dtype)
+    if hasattr(arg1, "tocsr"):  # scipy sparse
+        sp = arg1.tocsr()
+        return CSRNDArray(sp.data, sp.indices, sp.indptr, sp.shape, dtype or sp.dtype)
+    dense = arg1 if isinstance(arg1, NDArray) else _dense_array(np.asarray(arg1), dtype=dtype)
+    return cast_storage(dense, "csr")
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Sparse-aware array(): passes sparse through, densifies else."""
+    if isinstance(source_array, BaseSparseNDArray):
+        return source_array.copy()
+    if hasattr(source_array, "tocsr"):
+        return csr_matrix(source_array, dtype=dtype)
+    return _dense_array(source_array, ctx=ctx, dtype=dtype)
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    """All-zero array of the given storage type (reference sparse.py zeros)."""
+    if isinstance(shape, int):
+        shape = (shape,)
+    if stype == "row_sparse":
+        return RowSparseNDArray(
+            np.zeros((0,) + tuple(shape[1:]), dtype=dtype_np(dtype)), np.zeros(0, np.int64), shape
+        )
+    if stype == "csr":
+        return CSRNDArray(
+            np.zeros(0, dtype=dtype_np(dtype)),
+            np.zeros(0, np.int64),
+            np.zeros(shape[0] + 1, np.int64),
+            shape,
+        )
+    if stype == "default":
+        from . import zeros as dzeros
+
+        return dzeros(shape, ctx=ctx, dtype=dtype)
+    raise MXNetError("unknown storage type %s" % stype)
+
+
+def empty(stype, shape, ctx=None, dtype="float32"):
+    return zeros(stype, shape, ctx=ctx, dtype=dtype)
+
+
+# -- conversion / compute ----------------------------------------------------
+
+
+def cast_storage(arr, stype):
+    """dense <-> sparse conversion (reference cast_storage-inl.h)."""
+    if isinstance(arr, BaseSparseNDArray):
+        return arr.tostype(stype)
+    if not isinstance(arr, NDArray):
+        arr = _dense_array(np.asarray(arr))
+    if stype == "default":
+        return arr
+    dense = np.asarray(arr.asnumpy())
+    if stype == "row_sparse":
+        nz_rows = np.where(np.any(dense.reshape(dense.shape[0], -1) != 0, axis=1))[0]
+        return RowSparseNDArray(dense[nz_rows], nz_rows.astype(np.int64), dense.shape, dense.dtype)
+    if stype == "csr":
+        if dense.ndim != 2:
+            raise MXNetError("csr storage requires 2D input")
+        rows, cols = np.nonzero(dense)
+        indptr = np.zeros(dense.shape[0] + 1, dtype=np.int64)
+        np.add.at(indptr[1:], rows, 1)
+        indptr = np.cumsum(indptr)
+        return CSRNDArray(dense[rows, cols], cols.astype(np.int64), indptr, dense.shape, dense.dtype)
+    raise MXNetError("unknown storage type %s" % stype)
+
+
+def retain(rsp, indices):
+    """Keeps only the requested rows of a RowSparseNDArray (reference
+    _retain; used by kvstore row_sparse pulls)."""
+    if not isinstance(rsp, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    want = np.asarray(
+        indices._data if isinstance(indices, NDArray) else indices, dtype=np.int64
+    )
+    have = np.asarray(rsp._aux["indices"])
+    # keep rows of rsp whose index is in `want`, in sorted order
+    mask = np.isin(have, want)
+    keep = np.where(mask)[0]
+    return RowSparseNDArray(rsp._aux["data"][keep], have[keep], rsp.shape, rsp.dtype)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot.  csr × dense lowers to gather + segment-sum, the
+    TPU-friendly formulation of the reference's sparse dot kernels
+    (src/operator/tensor/dot-inl.h)."""
+    import jax
+
+    jnp = _jnp()
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray) and not isinstance(rhs, BaseSparseNDArray):
+        if transpose_b:
+            raise MXNetError("transpose_b unsupported for csr dot")
+        rows = jnp.asarray(lhs._row_ids())
+        cols = lhs._aux["indices"]
+        vals = lhs._aux["data"]
+        if transpose_a:
+            # csr^T dot dense: scatter-add into output rows keyed by column
+            out = jnp.zeros((lhs.shape[1], rhs.shape[1]), vals.dtype).at[cols].add(
+                rhs._data[rows] * vals[:, None]
+            )
+            return _wrap(out)
+        gathered = rhs._data[cols] * vals[:, None]  # (nnz, N)
+        out = jax.ops.segment_sum(gathered, rows, num_segments=lhs.shape[0])
+        return _wrap(out)
+    if isinstance(lhs, BaseSparseNDArray):
+        lhs = lhs.todense()
+    if isinstance(rhs, BaseSparseNDArray):
+        rhs = rhs.todense()
+    from . import op
+
+    return op.dot(lhs, rhs, transpose_a=transpose_a, transpose_b=transpose_b)

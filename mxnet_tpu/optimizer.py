@@ -163,8 +163,17 @@ class Optimizer:
     def update_multi_precision(self, index, weight, grad, state):
         import jax.numpy as jnp
 
+        from .ndarray.sparse import BaseSparseNDArray, RowSparseNDArray
+
+        if isinstance(grad, BaseSparseNDArray) and not (
+            isinstance(grad, RowSparseNDArray) and getattr(self, "_handles_sparse", False)
+        ):
+            # only row_sparse has dedicated rules; everything else densifies
+            grad = grad.todense()
         if self.multi_precision and isinstance(state, tuple) and len(state) == 2 and isinstance(state[0], NDArray):
             master, base_state = state
+            if isinstance(grad, BaseSparseNDArray):
+                grad = grad.todense()
             self.update(index, master, grad.astype("float32"), base_state)
             weight._rebind(master._data.astype(weight._data.dtype))
         else:
@@ -345,7 +354,15 @@ def _zeros_like_nd(w):
 
 @register
 class SGD(Optimizer):
-    """SGD with momentum & multi-precision (reference optimizer.py SGD)."""
+    """SGD with momentum & multi-precision (reference optimizer.py SGD).
+
+    Row-sparse gradients take the lazy path: only rows present in the
+    gradient are updated (reference sgd_update/sgd_mom_update sparse kernels,
+    src/operator/optimizer_op.cc) — on TPU this is a gather/scatter over the
+    touched rows, the embedding-training fast path.
+    """
+
+    _handles_sparse = True
 
     def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
         super().__init__(**kwargs)
@@ -357,8 +374,32 @@ class SGD(Optimizer):
             return None
         return _zeros_like_nd(weight)
 
+    def _sparse_update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        rows = grad._aux["indices"]
+        g = grad._aux["data"] * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        w = weight._data
+        w_rows = w[rows]
+        g = g + wd * w_rows
+        if state is None:
+            weight._rebind(w.at[rows].add(-lr * g))
+        else:
+            mom_rows = self.momentum * state._data[rows] - lr * g
+            state._rebind(state._data.at[rows].set(mom_rows))
+            weight._rebind(w.at[rows].add(mom_rows))
+
     def update(self, index, weight, grad, state):
+        from .ndarray.sparse import RowSparseNDArray
+
         self._update_count(index)
+        if isinstance(grad, RowSparseNDArray):
+            if self.lazy_update:
+                return self._sparse_update(index, weight, grad, state)
+            grad = grad.todense()
         lr, wd = self._get_lr(index), self._get_wd(index)
         g = self._preprocess(grad)
         mom = state._data if state is not None else None
@@ -467,16 +508,45 @@ class DCASGD(Optimizer):
 
 @register
 class Adam(Optimizer):
+    _handles_sparse = True
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         return (_zeros_like_nd(weight), _zeros_like_nd(weight))
 
+    def _sparse_update(self, index, weight, grad, state, t):
+        """Lazy row-sparse adam (reference adam_update row_sparse kernel)."""
+        import jax.numpy as jnp
+
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        rows = grad._aux["indices"]
+        g = grad._aux["data"] * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        m, v = state
+        w_rows = weight._data[rows]
+        g = g + wd * w_rows
+        m_rows = self.beta1 * m._data[rows] + (1 - self.beta1) * g
+        v_rows = self.beta2 * v._data[rows] + (1 - self.beta2) * g * g
+        lr_t = lr * np.sqrt(1 - self.beta2**t) / (1 - self.beta1**t)
+        upd = -lr_t * m_rows / (jnp.sqrt(v_rows) + self.epsilon)
+        m._rebind(m._data.at[rows].set(m_rows))
+        v._rebind(v._data.at[rows].set(v_rows))
+        weight._rebind(weight._data.at[rows].add(upd))
+
     def update(self, index, weight, grad, state):
+        from .ndarray.sparse import RowSparseNDArray
+
         self._update_count(index)
         t = self._index_update_count[index]
+        if isinstance(grad, RowSparseNDArray):
+            if self.lazy_update:
+                return self._sparse_update(index, weight, grad, state, t)
+            grad = grad.todense()
         g = self._preprocess(grad)
         m, v = state
         new_w, new_m, new_v = adam_rule(

@@ -220,3 +220,19 @@ def test_create_det_augmenter_runs():
             im, lab = aug(im, lab)
         assert im.shape == (24, 24, 3)
         assert lab.shape[1] == 5
+
+
+def test_contrast_jitter_identity_mean():
+    """Contrast blend must preserve a uniform image's level (review regression:
+    the gray-mean term was 3x too large)."""
+    img = np.full((4, 4, 3), 100.0, dtype=np.float32)
+    aug = mimg.ContrastJitterAug(0.5)
+    for _ in range(5):
+        out = aug(img)
+        np.testing.assert_allclose(out, 100.0, atol=0.5)
+
+
+def test_imdecode_positional_flag(jpeg_bytes):
+    """Reference argument order: imdecode(buf, flag) — flag=0 is grayscale."""
+    gray = mimg.imdecode(jpeg_bytes, 0)
+    assert gray.shape == (40, 30, 1)

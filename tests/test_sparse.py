@@ -1,0 +1,270 @@
+"""Sparse NDArray tests — mirrors reference
+tests/python/unittest/test_sparse_ndarray.py (creation, cast_storage, retain,
+slicing, dot) and the sparse optimizer coverage of test_optimizer.py."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse
+
+
+def _rand_rs(shape, density=0.3, seed=0):
+    rng = np.random.RandomState(seed)
+    dense = rng.rand(*shape).astype(np.float32)
+    mask = rng.rand(shape[0]) < density
+    dense[~mask] = 0
+    return dense
+
+
+def _rand_csr(shape, density=0.3, seed=0):
+    rng = np.random.RandomState(seed)
+    dense = (rng.rand(*shape) < density) * rng.rand(*shape)
+    return dense.astype(np.float32)
+
+
+class TestCreation:
+    def test_row_sparse_from_dense(self):
+        dense = _rand_rs((8, 3))
+        rs = sparse.row_sparse_array(dense)
+        assert rs.stype == "row_sparse"
+        assert rs.shape == (8, 3)
+        np.testing.assert_allclose(rs.asnumpy(), dense, rtol=1e-6)
+        nz = np.where(np.any(dense != 0, axis=1))[0]
+        np.testing.assert_array_equal(rs.indices.asnumpy(), nz)
+
+    def test_row_sparse_from_components(self):
+        data = np.arange(6, dtype=np.float32).reshape(2, 3)
+        rs = sparse.row_sparse_array((data, [1, 3]), shape=(5, 3))
+        dense = rs.asnumpy()
+        assert dense.shape == (5, 3)
+        np.testing.assert_array_equal(dense[1], data[0])
+        np.testing.assert_array_equal(dense[3], data[1])
+        np.testing.assert_array_equal(dense[0], 0)
+
+    def test_csr_from_dense_and_components(self):
+        dense = _rand_csr((6, 5))
+        cs = sparse.csr_matrix(dense)
+        assert cs.stype == "csr"
+        np.testing.assert_allclose(cs.asnumpy(), dense, rtol=1e-6)
+        cs2 = sparse.csr_matrix(
+            (cs.data.asnumpy(), cs.indices.asnumpy(), cs.indptr.asnumpy()), shape=(6, 5)
+        )
+        np.testing.assert_allclose(cs2.asnumpy(), dense, rtol=1e-6)
+
+    def test_zeros(self):
+        rs = sparse.zeros("row_sparse", (4, 2))
+        assert rs.stype == "row_sparse" and rs.asnumpy().sum() == 0
+        cs = sparse.zeros("csr", (4, 2))
+        assert cs.stype == "csr" and cs.asnumpy().sum() == 0
+        assert nd.zeros((4, 2), stype="row_sparse").stype == "row_sparse"
+        assert nd.zeros((4, 2)).stype == "default"
+
+    def test_csr_requires_2d(self):
+        with pytest.raises(mx.MXNetError):
+            sparse.zeros("csr", (4, 2, 2))
+
+    def test_component_mismatch_raises(self):
+        with pytest.raises(mx.MXNetError):
+            sparse.row_sparse_array((np.zeros((2, 3), np.float32), [1]), shape=(5, 3))
+
+
+class TestConversion:
+    def test_tostype_roundtrip(self):
+        dense = _rand_rs((8, 3))
+        arr = nd.array(dense)
+        rs = arr.tostype("row_sparse")
+        assert rs.stype == "row_sparse"
+        back = rs.tostype("default")
+        assert back.stype == "default"
+        np.testing.assert_allclose(back.asnumpy(), dense, rtol=1e-6)
+
+    def test_cast_storage_csr(self):
+        dense = _rand_csr((5, 7))
+        cs = sparse.cast_storage(nd.array(dense), "csr")
+        np.testing.assert_allclose(cs.asnumpy(), dense, rtol=1e-6)
+        rs = cs.tostype("row_sparse")
+        assert rs.stype == "row_sparse"
+        np.testing.assert_allclose(rs.asnumpy(), dense, rtol=1e-6)
+
+
+class TestOps:
+    def test_retain(self):
+        dense = np.arange(15, dtype=np.float32).reshape(5, 3)
+        rs = sparse.row_sparse_array(dense)
+        out = sparse.retain(rs, [1, 3])
+        np.testing.assert_array_equal(out.indices.asnumpy(), [1, 3])
+        got = out.asnumpy()
+        np.testing.assert_array_equal(got[1], dense[1])
+        np.testing.assert_array_equal(got[0], 0)
+        np.testing.assert_array_equal(got[2], 0)
+
+    def test_csr_slice(self):
+        dense = _rand_csr((8, 4))
+        cs = sparse.csr_matrix(dense)
+        sl = cs[2:5]
+        assert sl.shape == (3, 4)
+        np.testing.assert_allclose(sl.asnumpy(), dense[2:5], rtol=1e-6)
+        row = cs[3]
+        np.testing.assert_allclose(row.asnumpy(), dense[3:4], rtol=1e-6)
+
+    def test_csr_dot_dense(self):
+        dense_l = _rand_csr((6, 5), density=0.4)
+        rhs = np.random.RandomState(1).rand(5, 3).astype(np.float32)
+        cs = sparse.csr_matrix(dense_l)
+        out = sparse.dot(cs, nd.array(rhs))
+        np.testing.assert_allclose(out.asnumpy(), dense_l @ rhs, rtol=1e-5)
+
+    def test_csr_dot_dense_transpose_a(self):
+        dense_l = _rand_csr((6, 5), density=0.4)
+        rhs = np.random.RandomState(1).rand(6, 3).astype(np.float32)
+        cs = sparse.csr_matrix(dense_l)
+        out = sparse.dot(cs, nd.array(rhs), transpose_a=True)
+        np.testing.assert_allclose(out.asnumpy(), dense_l.T @ rhs, rtol=1e-5)
+
+    def test_sparse_add(self):
+        a = _rand_rs((6, 3), seed=0)
+        b = _rand_rs((6, 3), seed=1)
+        out = sparse.row_sparse_array(a) + sparse.row_sparse_array(b)
+        assert out.stype == "row_sparse"
+        np.testing.assert_allclose(out.asnumpy(), a + b, rtol=1e-6)
+        out2 = sparse.row_sparse_array(a) + nd.array(b)
+        assert out2.stype == "default"
+        np.testing.assert_allclose(out2.asnumpy(), a + b, rtol=1e-6)
+
+    def test_scipy_interop(self):
+        scipy = pytest.importorskip("scipy.sparse")
+        dense = _rand_csr((5, 4))
+        cs = sparse.csr_matrix(dense)
+        sp = cs.asscipy()
+        np.testing.assert_allclose(sp.toarray(), dense, rtol=1e-6)
+        back = sparse.csr_matrix(sp)
+        np.testing.assert_allclose(back.asnumpy(), dense, rtol=1e-6)
+
+    def test_blocked_methods_raise(self):
+        rs = sparse.zeros("row_sparse", (4, 2))
+        with pytest.raises(mx.MXNetError):
+            rs[0]
+        with pytest.raises(mx.MXNetError):
+            rs.astype("float16")
+
+
+class TestSparseOptimizer:
+    def _check_lazy(self, opt_name, **kwargs):
+        from mxnet_tpu import optimizer as optmod
+
+        shape = (6, 4)
+        rng = np.random.RandomState(0)
+        w0 = rng.rand(*shape).astype(np.float32)
+        g_rows = np.array([1, 4])
+        g_data = rng.rand(2, 4).astype(np.float32)
+
+        opt = optmod.create(opt_name, learning_rate=0.1, **kwargs)
+        w = nd.array(w0.copy())
+        state = opt.create_state(0, w)
+        grad = sparse.row_sparse_array((g_data, g_rows), shape=shape)
+        opt.update(0, w, grad, state)
+        got = w.asnumpy()
+
+        # dense twin: same update with zero-filled grad, but only touched
+        # rows should move under the lazy path
+        untouched = [i for i in range(shape[0]) if i not in g_rows]
+        np.testing.assert_allclose(got[untouched], w0[untouched], rtol=1e-6)
+        assert not np.allclose(got[list(g_rows)], w0[list(g_rows)])
+        return got
+
+    def test_sgd_lazy_rows(self):
+        self._check_lazy("sgd")
+        self._check_lazy("sgd", momentum=0.9)
+
+    def test_adam_lazy_rows(self):
+        self._check_lazy("adam")
+
+    def test_sgd_sparse_matches_dense_on_touched_rows(self):
+        from mxnet_tpu import optimizer as optmod
+
+        shape = (6, 4)
+        rng = np.random.RandomState(0)
+        w0 = rng.rand(*shape).astype(np.float32)
+        g_rows = np.array([1, 4])
+        g_data = rng.rand(2, 4).astype(np.float32)
+        dense_grad = np.zeros(shape, np.float32)
+        dense_grad[g_rows] = g_data
+
+        opt1 = optmod.create("sgd", learning_rate=0.1, wd=0.0)
+        w1 = nd.array(w0.copy())
+        opt1.update(0, w1, sparse.row_sparse_array((g_data, g_rows), shape=shape), None)
+
+        opt2 = optmod.create("sgd", learning_rate=0.1, wd=0.0)
+        w2 = nd.array(w0.copy())
+        opt2.update(0, w2, nd.array(dense_grad), None)
+
+        np.testing.assert_allclose(w1.asnumpy(), w2.asnumpy(), rtol=1e-6)
+
+    def test_unsupported_optimizer_densifies(self):
+        from mxnet_tpu import optimizer as optmod
+
+        shape = (4, 3)
+        w = nd.array(np.ones(shape, np.float32))
+        opt = optmod.create("rmsprop", learning_rate=0.1)
+        state = opt.create_state_multi_precision(0, w)
+        grad = sparse.row_sparse_array(
+            (np.ones((1, 3), np.float32), [2]), shape=shape
+        )
+        opt.update_multi_precision(0, w, grad, state)
+        assert not np.allclose(w.asnumpy(), 1.0)
+
+
+class TestKVStoreSparse:
+    def test_row_sparse_pull(self):
+        kv = mx.kv.create("local")
+        shape = (5, 3)
+        init = np.random.RandomState(0).rand(*shape).astype(np.float32)
+        kv.init("w", nd.array(init))
+        out = nd.zeros(shape)
+        kv.row_sparse_pull("w", out=out, row_ids=nd.array(np.array([0, 2], np.float32)))
+        got = out.asnumpy()
+        np.testing.assert_allclose(got[0], init[0], rtol=1e-6)
+        np.testing.assert_allclose(got[2], init[2], rtol=1e-6)
+
+
+class TestReviewRegressions:
+    def test_csr_grad_densifies_in_updater(self):
+        from mxnet_tpu import optimizer as optmod
+
+        w = nd.array(np.ones((4, 3), np.float32))
+        opt = optmod.create("sgd", learning_rate=0.1)
+        upd = optmod.get_updater(opt)
+        g = sparse.csr_matrix(np.eye(4, 3, dtype=np.float32))
+        upd(0, g, w)  # must not crash on the lazy dense cache
+        assert not np.allclose(w.asnumpy(), 1.0)
+
+    def test_kvstore_sparse_push_and_init(self):
+        kv = mx.kv.create("local")
+        g = sparse.row_sparse_array(
+            (np.ones((1, 3), np.float32), [1]), shape=(4, 3)
+        )
+        kv.init("k", sparse.zeros("row_sparse", (4, 3)))
+        kv.push("k", g)
+        out = nd.zeros((4, 3))
+        kv.pull("k", out=out)
+        got = out.asnumpy()
+        assert got.shape == (4, 3)
+
+    def test_row_sparse_pull_permuted_full_ids_scatter(self):
+        kv = mx.kv.create("local")
+        init = np.arange(12, dtype=np.float32).reshape(4, 3)
+        kv.init("w", nd.array(init))
+        out = nd.zeros((4, 3))
+        kv.row_sparse_pull(
+            "w", out=out, row_ids=nd.array(np.array([3, 2, 1, 0], np.float32))
+        )
+        np.testing.assert_allclose(out.asnumpy(), init, rtol=1e-6)
+
+    def test_row_sparse_pull_bad_out_shape_raises(self):
+        kv = mx.kv.create("local")
+        kv.init("w", nd.zeros((4, 3)))
+        with pytest.raises(ValueError):
+            kv.row_sparse_pull(
+                "w", out=nd.zeros((5, 3)), row_ids=nd.array(np.array([0.0]))
+            )
