@@ -156,6 +156,11 @@ class KVStore:
                 if isinstance(o, RowSparseNDArray):
                     import jax.numpy as jnp
 
+                    if o.shape != src.shape:
+                        raise ValueError(
+                            "row_sparse_pull out shape %s != store shape %s"
+                            % (o.shape, src.shape)
+                        )
                     o._aux["data"] = rows._data
                     o._aux["indices"] = jnp.asarray(
                         rid._data if hasattr(rid, "_data") else rid

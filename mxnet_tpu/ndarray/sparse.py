@@ -97,29 +97,53 @@ class BaseSparseNDArray(NDArray):
     def __setitem__(self, key, value):
         raise MXNetError("assignment is not supported for %s storage" % self.stype)
 
-    def _binary(self, other, op_name):
-        """Sparse arithmetic: same-stype stays sparse, else densifies."""
+    def _binary(self, other, op_name, reflected=False):
+        """Sparse arithmetic: same-stype stays sparse; scalar mul/div keeps
+        sparsity (zeros stay zero); everything else densifies."""
+        import numbers
         import operator
 
         fn = getattr(operator, op_name)
+        if reflected:
+            fwd = fn
+            fn = lambda a, b: fwd(b, a)  # noqa: E731
+        # rs/scalar and rs*scalar keep zeros zero; scalar/rs does not
+        if isinstance(other, numbers.Number) and (
+            op_name == "mul" or (op_name == "truediv" and not reflected)
+        ):
+            out = self.copy()
+            out._aux = dict(out._aux)
+            out._aux["data"] = fn(out._aux["data"], other)
+            out._data = None
+            return out
         if isinstance(other, BaseSparseNDArray) and other.stype == self.stype:
             out = fn(self.todense(), other.todense())
             return cast_storage(out, self.stype)
-        if isinstance(other, NDArray):
-            return fn(self.todense(), other)
         return fn(self.todense(), other)
 
     def __add__(self, other):
         return self._binary(other, "add")
 
+    def __radd__(self, other):
+        return self._binary(other, "add", reflected=True)
+
     def __sub__(self, other):
         return self._binary(other, "sub")
+
+    def __rsub__(self, other):
+        return self._binary(other, "sub", reflected=True)
 
     def __mul__(self, other):
         return self._binary(other, "mul")
 
+    def __rmul__(self, other):
+        return self._binary(other, "mul")
+
     def __truediv__(self, other):
         return self._binary(other, "truediv")
+
+    def __rtruediv__(self, other):
+        return self._binary(other, "truediv", reflected=True)
 
     def __repr__(self):
         return "<%s %s @%s>" % (type(self).__name__, "x".join(map(str, self._shape)), self.stype)
@@ -228,6 +252,10 @@ class CSRNDArray(BaseSparseNDArray):
     def __getitem__(self, key):
         # row slicing mirrors reference CSRNDArray.__getitem__
         if isinstance(key, int):
+            if key < 0:
+                key += self._shape[0]
+            if not 0 <= key < self._shape[0]:
+                raise MXNetError("row index out of range")
             key = slice(key, key + 1)
         if not isinstance(key, slice) or key.step not in (None, 1):
             raise MXNetError("csr only supports contiguous row slicing")

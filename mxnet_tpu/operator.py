@@ -1,0 +1,116 @@
+"""Python custom operators — reference ``python/mxnet/operator.py``
+(CustomOp :426, CustomOpProp :472, register :692; older NDArrayOp/NumpyOp
+interfaces are intentionally dropped — CustomOp superseded them in the
+reference too).
+
+Usage (identical to the reference)::
+
+    class Softmax(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            ...
+            self.assign(out_data[0], req[0], mx.nd.array(y))
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            ...
+
+    @mx.operator.register("softmax")
+    class SoftmaxProp(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=False)
+        def list_arguments(self): return ['data', 'label']
+        def list_outputs(self): return ['output']
+        def infer_shape(self, in_shape): ...
+
+    out = mx.nd.Custom(x, label, op_type='softmax')
+
+Execution happens through ``jax.pure_callback`` (ops/custom.py), so the op
+body may use arbitrary host code (numpy/cython) and still run inside jitted
+graphs — the TPU-native answer to the reference's engine-async custom op
+(src/operator/custom/custom.cc ExecType::kAsync).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .ops import custom as _custom
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered_operators"]
+
+
+class CustomOp:
+    """Base class for custom operators (reference operator.py:426)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Assign src to dst honoring the write/add/null request
+        (reference operator.py CustomOp.assign)."""
+        if req == "null":
+            return
+        from .ndarray.ndarray import NDArray
+
+        src_nd = src if isinstance(src, NDArray) else None
+        if req in ("write", "inplace"):
+            dst._rebind(src_nd._data if src_nd is not None else np.asarray(src))
+        elif req == "add":
+            dst._rebind(dst._data + (src_nd._data if src_nd is not None else np.asarray(src)))
+        else:
+            raise ValueError("unknown req %r" % req)
+
+
+class CustomOpProp:
+    """Operator properties: arity, shapes, types (reference operator.py:472)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        """Default: all outputs shaped like in_shape[0] (reference default)."""
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (
+            in_type,
+            [in_type[0]] * len(self.list_outputs()),
+            [in_type[0]] * len(self.list_auxiliary_states()),
+        )
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def register(reg_name):
+    """Class decorator registering a CustomOpProp under ``op_type=reg_name``
+    (reference operator.py:692)."""
+
+    def do_register(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise TypeError("register expects a CustomOpProp subclass")
+        _custom.register_prop(reg_name, prop_cls)
+        return prop_cls
+
+    return do_register
+
+
+def get_all_registered_operators():
+    return list(_custom.PROP_REGISTRY)

@@ -15,5 +15,6 @@ from . import nn  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import rnn  # noqa: F401
 from . import detection  # noqa: F401
+from . import custom  # noqa: F401
 
 _load_all = True

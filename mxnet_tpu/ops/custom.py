@@ -1,0 +1,162 @@
+"""Custom-op bridge — reference ``src/operator/custom/custom.cc`` (engine-side
+async custom op) + ``python/mxnet/operator.py:426,472,692`` (CustomOp,
+CustomOpProp, register).
+
+TPU-native design: a frontend-defined op runs arbitrary host Python (numpy,
+cython, ...) inside a traced/jitted graph via ``jax.pure_callback`` — the
+escape hatch SURVEY §7.3 calls for (rcnn's proposal_target).  Gradients route
+through ``jax.custom_vjp`` whose backward is a second host callback into
+``CustomOp.backward``.  Shapes/dtypes come from the prop's ``infer_shape`` /
+``infer_type``, exactly the contract the reference's C++ bridge enforces
+through MXCustomOpInfo callbacks.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import register
+
+# op_type -> CustomOpProp subclass (reference mx.operator.register registry)
+PROP_REGISTRY = {}
+
+
+def register_prop(op_type, prop_cls):
+    PROP_REGISTRY[op_type] = prop_cls
+
+
+def _make_prop(attrs):
+    attrs = dict(attrs)
+    op_type = attrs.pop("op_type", None)
+    if op_type is None:
+        raise ValueError("Custom op requires op_type=")
+    if op_type not in PROP_REGISTRY:
+        raise ValueError(
+            "custom op type %r is not registered (use mx.operator.register)" % op_type
+        )
+    # reference semantics: every kwarg reaches the prop as a string
+    str_attrs = {k: str(v) for k, v in attrs.items()}
+    prop = PROP_REGISTRY[op_type](**str_attrs)
+    return prop
+
+
+def num_outputs_for(attrs):
+    return len(_make_prop(attrs).list_outputs())
+
+
+def _req_list(n, req="write"):
+    return [req] * n
+
+
+@register("Custom")
+def custom(*data, **attrs):
+    """Runs a registered CustomOp (reference ``mx.nd.Custom``).
+
+    ``op_type`` selects the registered ``CustomOpProp``; remaining attrs are
+    forwarded to the prop constructor as strings.
+    """
+    import jax
+
+    prop = _make_prop(attrs)
+    in_shapes = [tuple(d.shape) for d in data]
+    shape_res = prop.infer_shape(in_shapes)
+    if len(shape_res) == 3:
+        in_shapes, out_shapes, aux_shapes = shape_res
+    else:
+        in_shapes, out_shapes = shape_res
+        aux_shapes = []
+    in_types = [np.dtype(d.dtype) for d in data]
+    type_res = prop.infer_type(in_types)
+    if len(type_res) == 3:
+        _, out_types, _ = type_res
+    else:
+        _, out_types = type_res
+    n_out = len(prop.list_outputs())
+    out_specs = tuple(
+        jax.ShapeDtypeStruct(tuple(s), np.dtype(t))
+        for s, t in zip(out_shapes, out_types)
+    )
+    if aux_shapes:
+        raise NotImplementedError(
+            "auxiliary states in custom ops are not supported; keep state on "
+            "the prop/op instance instead"
+        )
+    op_holder = {}
+
+    def _get_op():
+        if "op" not in op_holder:
+            op_holder["op"] = prop.create_operator(None, in_shapes, in_types)
+        return op_holder["op"]
+
+    from .. import autograd as _ag
+
+    is_train = _ag.is_training()
+
+    def _host_forward(*arrays):
+        from ..ndarray.ndarray import array as nd_array
+
+        in_nd = [nd_array(np.asarray(a)) for a in arrays]
+        out_nd = [
+            nd_array(np.zeros(s, dtype=np.dtype(t)))
+            for s, t in zip(out_shapes, out_types)
+        ]
+        _get_op().forward(
+            is_train=is_train,
+            req=_req_list(n_out),
+            in_data=in_nd,
+            out_data=out_nd,
+            aux=[],
+        )
+        return tuple(np.asarray(o.asnumpy(), dtype=np.dtype(t)) for o, t in zip(out_nd, out_types))
+
+    @jax.custom_vjp
+    def _fn(*jargs):
+        out = jax.pure_callback(_host_forward, out_specs, *jargs, vmap_method="sequential")
+        return tuple(out)
+
+    def _fwd(*jargs):
+        out = jax.pure_callback(_host_forward, out_specs, *jargs, vmap_method="sequential")
+        return tuple(out), (jargs, tuple(out))
+
+    def _bwd(res, cts):
+        jargs, outs = res
+        in_specs = tuple(
+            jax.ShapeDtypeStruct(tuple(s), np.dtype(t))
+            for s, t in zip(in_shapes, in_types)
+        )
+
+        def _host_backward(*flat):
+            from ..ndarray.ndarray import array as nd_array
+
+            n_in = len(in_shapes)
+            ins = flat[:n_in]
+            o_data = flat[n_in : n_in + n_out]
+            o_grad = flat[n_in + n_out :]
+            in_nd = [nd_array(np.asarray(a)) for a in ins]
+            out_nd = [nd_array(np.asarray(a)) for a in o_data]
+            ograd_nd = [nd_array(np.asarray(a)) for a in o_grad]
+            igrad_nd = [
+                nd_array(np.zeros(s, dtype=np.dtype(t)))
+                for s, t in zip(in_shapes, in_types)
+            ]
+            _get_op().backward(
+                req=_req_list(len(in_shapes)),
+                out_grad=ograd_nd,
+                in_data=in_nd,
+                out_data=out_nd,
+                in_grad=igrad_nd,
+                aux=[],
+            )
+            return tuple(
+                np.asarray(g.asnumpy(), dtype=np.dtype(t))
+                for g, t in zip(igrad_nd, in_types)
+            )
+
+        igrads = jax.pure_callback(
+            _host_backward, in_specs, *(tuple(jargs) + tuple(outs) + tuple(cts)),
+            vmap_method="sequential",
+        )
+        return tuple(igrads)
+
+    _fn.defvjp(_fwd, _bwd)
+    out = _fn(*data)
+    return out if n_out > 1 else out[0]

@@ -459,6 +459,10 @@ def _num_outputs_of(opdef, attrs):
     ops are special-cased, everything else is 1 until traced."""
     if opdef.name == "SliceChannel":
         return attrs.get("num_outputs", 1)
+    if opdef.name == "Custom":
+        from ..ops import custom as _custom
+
+        return _custom.num_outputs_for(attrs)
     if opdef.name in ("BatchNorm",):
         return 3 if attrs.get("output_mean_var") else 1
     if opdef.name == "LayerNorm":

@@ -1,0 +1,214 @@
+"""CustomOp bridge tests — mirrors reference
+tests/python/unittest/test_operator.py test_custom_op and the docs softmax
+example (docs/faq/new_op.md)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+@mx.operator.register("sqr")
+class SqrProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def create_operator(self, ctx, shapes, dtypes):
+        outer = self
+
+        class Sqr(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                x = in_data[0].asnumpy()
+                self.assign(out_data[0], req[0], nd.array(x * x))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+                x = in_data[0].asnumpy()
+                g = out_grad[0].asnumpy()
+                self.assign(in_grad[0], req[0], nd.array(2 * x * g))
+
+        return Sqr()
+
+
+@mx.operator.register("np_softmax")
+class NpSoftmaxProp(mx.operator.CustomOpProp):
+    """The canonical reference example: softmax+CE loss as a custom op."""
+
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = (in_shape[0][0],)
+        return [data_shape, label_shape], [data_shape], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        class NpSoftmax(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                x = in_data[0].asnumpy()
+                y = np.exp(x - x.max(axis=1, keepdims=True))
+                y /= y.sum(axis=1, keepdims=True)
+                self.assign(out_data[0], req[0], nd.array(y))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+                lab = in_data[1].asnumpy().astype(np.int32)
+                y = out_data[0].asnumpy().copy()
+                y[np.arange(lab.shape[0]), lab] -= 1.0
+                self.assign(in_grad[0], req[0], nd.array(y))
+                self.assign(in_grad[1], req[1], nd.array(np.zeros_like(lab, np.float32)))
+
+        return NpSoftmax()
+
+
+@mx.operator.register("split2")
+class Split2Prop(mx.operator.CustomOpProp):
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["lo", "hi"]
+
+    def infer_shape(self, in_shape):
+        n = in_shape[0][0] // 2
+        half = (n,) + tuple(in_shape[0][1:])
+        return in_shape, [half, half], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        class Split2(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                x = in_data[0].asnumpy()
+                n = x.shape[0] // 2
+                self.assign(out_data[0], req[0], nd.array(x[:n]))
+                self.assign(out_data[1], req[1], nd.array(x[n:]))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+                g = np.concatenate([out_grad[0].asnumpy(), out_grad[1].asnumpy()])
+                self.assign(in_grad[0], req[0], nd.array(g))
+
+        return Split2()
+
+
+def test_custom_forward():
+    x = nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    y = nd.Custom(x, op_type="sqr")
+    np.testing.assert_allclose(y.asnumpy(), [[1, 4], [9, 16]], rtol=1e-6)
+
+
+def test_custom_backward():
+    from mxnet_tpu import autograd
+
+    x = nd.array(np.array([[1.0, -2.0], [0.5, 3.0]], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="sqr")
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy(), rtol=1e-5)
+
+
+def test_custom_softmax_matches_numpy():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 5).astype(np.float32)
+    lab = np.array([0, 2, 1, 4], np.float32)
+    out = nd.Custom(nd.array(x), nd.array(lab), op_type="np_softmax")
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    want = e / e.sum(axis=1, keepdims=True)
+    np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-5)
+
+
+def test_custom_softmax_grad():
+    from mxnet_tpu import autograd
+
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(4, 5).astype(np.float32))
+    lab = nd.array(np.array([0, 2, 1, 4], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, lab, op_type="np_softmax")
+        # pseudo-loss: the custom op defines its own backward (need_top_grad
+        # False in reference; here the ct on y is ones, ignored by backward)
+        s = y.sum()
+    s.backward()
+    e = np.exp(x.asnumpy() - x.asnumpy().max(axis=1, keepdims=True))
+    sm = e / e.sum(axis=1, keepdims=True)
+    want = sm.copy()
+    want[np.arange(4), [0, 2, 1, 4]] -= 1.0
+    np.testing.assert_allclose(x.grad.asnumpy(), want, rtol=1e-5)
+
+
+def test_custom_multi_output():
+    x = nd.array(np.arange(8, dtype=np.float32).reshape(4, 2))
+    lo, hi = nd.Custom(x, op_type="split2")
+    np.testing.assert_allclose(lo.asnumpy(), x.asnumpy()[:2])
+    np.testing.assert_allclose(hi.asnumpy(), x.asnumpy()[2:])
+
+
+def test_custom_in_jit():
+    """The callback must survive jit tracing (the CachedOp/hybridize path)."""
+    import jax
+
+    from mxnet_tpu.ops import registry
+
+    fn = registry.get("Custom").fn
+    x = np.array([[1.0, 2.0]], np.float32)
+
+    @jax.jit
+    def f(a):
+        return fn(a, op_type="sqr")
+
+    np.testing.assert_allclose(np.asarray(f(x)), [[1.0, 4.0]], rtol=1e-6)
+
+
+def test_custom_symbol_graph():
+    from mxnet_tpu import sym
+
+    data = sym.Variable("data")
+    out = sym.Custom(data, op_type="sqr", name="sq")
+    exe = out.simple_bind(data=(2, 3))
+    x = np.random.RandomState(0).rand(2, 3).astype(np.float32)
+    (y,) = exe.forward(is_train=True, data=nd.array(x))
+    np.testing.assert_allclose(y.asnumpy(), x * x, rtol=1e-5)
+    exe.backward(nd.array(np.ones_like(x)))
+    np.testing.assert_allclose(exe.grad_arrays[0].asnumpy(), 2 * x, rtol=1e-5)
+
+
+def test_unregistered_op_type_raises():
+    with pytest.raises(Exception):
+        nd.Custom(nd.array(np.zeros((2, 2), np.float32)), op_type="nope_missing")
+
+
+def test_attrs_reach_prop_as_strings():
+    seen = {}
+
+    @mx.operator.register("attr_check")
+    class AttrProp(mx.operator.CustomOpProp):
+        def __init__(self, alpha="1", beta="x"):
+            super().__init__()
+            seen["alpha"] = alpha
+            seen["beta"] = beta
+
+        def create_operator(self, ctx, shapes, dtypes):
+            class Id(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0])
+
+                def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+                    self.assign(in_grad[0], req[0], out_grad[0])
+
+            return Id()
+
+    x = nd.array(np.ones((2, 2), np.float32))
+    nd.Custom(x, op_type="attr_check", alpha=3, beta="hello")
+    assert seen["alpha"] == "3"
+    assert seen["beta"] == "hello"

@@ -268,3 +268,52 @@ class TestReviewRegressions:
             kv.row_sparse_pull(
                 "w", out=nd.zeros((5, 3)), row_ids=nd.array(np.array([0.0]))
             )
+
+    def test_reflected_and_scalar_arithmetic(self):
+        """Review regression: dense+sparse, scalar*sparse, sparse/scalar."""
+        dense = np.ones((4, 3), np.float32)
+        rs = sparse.row_sparse_array(
+            (np.full((1, 3), 2.0, np.float32), [1]), shape=(4, 3)
+        )
+        out = nd.array(dense) + rs
+        got = out.asnumpy()
+        np.testing.assert_allclose(got[1], 3.0)
+        np.testing.assert_allclose(got[0], 1.0)
+        out2 = 2 * rs
+        assert out2.stype == "row_sparse"
+        np.testing.assert_allclose(out2.asnumpy()[1], 4.0)
+        out3 = rs / 2
+        assert out3.stype == "row_sparse"
+        np.testing.assert_allclose(out3.asnumpy()[1], 1.0)
+        out4 = 6.0 / (rs + nd.array(np.ones((4, 3), np.float32)))
+        np.testing.assert_allclose(out4.asnumpy()[1], 2.0)
+
+    def test_csr_negative_index(self):
+        dense = np.arange(12, dtype=np.float32).reshape(4, 3)
+        cs = sparse.csr_matrix(dense)
+        np.testing.assert_allclose(cs[-1].asnumpy(), dense[3:4], rtol=1e-6)
+        with pytest.raises(mx.MXNetError):
+            cs[4]
+
+    def test_row_sparse_pull_sparse_out_shape_check(self):
+        kv = mx.kv.create("local")
+        kv.init("w", nd.zeros((5, 3)))
+        with pytest.raises(ValueError):
+            kv.row_sparse_pull(
+                "w",
+                out=sparse.zeros("row_sparse", (3, 3)),
+                row_ids=nd.array(np.array([4.0])),
+            )
+
+    def test_mixed_push_dense_and_sparse(self):
+        kv = mx.kv.create("local")
+        kv.init("k", nd.zeros((4, 3)))
+        g_sparse = sparse.row_sparse_array(
+            (np.ones((1, 3), np.float32), [2]), shape=(4, 3)
+        )
+        kv.push("k", [nd.ones((4, 3)), g_sparse])
+        out = nd.zeros((4, 3))
+        kv.pull("k", out=out)
+        got = out.asnumpy()
+        np.testing.assert_allclose(got[2], 2.0)
+        np.testing.assert_allclose(got[0], 1.0)
