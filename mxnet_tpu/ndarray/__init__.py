@@ -117,10 +117,12 @@ def _invoke(opdef, args, kwargs):
         args = [named.get(an, opdef.defaults.get(an)) for an in opdef.arg_names]
         while args and args[-1] is None and opdef.arg_names[len(args) - 1] not in named:
             args.pop()
-    # attrs
+    # attrs (Custom keeps raw strings: the prop contract passes kwargs
+    # verbatim, reference operator.py register)
+    keep_raw = opdef.name == "Custom"
     attrs = {}
     for k, v in kwargs.items():
-        attrs[k] = parse_attr(v) if isinstance(v, str) else v
+        attrs[k] = parse_attr(v) if isinstance(v, str) and not keep_raw else v
     if "key" in opdef.attr_names and "key" not in attrs:
         from .. import random as _rnd
 

@@ -24,8 +24,12 @@ def register_prop(op_type, prop_cls):
     PROP_REGISTRY[op_type] = prop_cls
 
 
+_PROP_CACHE = {}
+
+
 def _make_prop(attrs):
     attrs = dict(attrs)
+    attrs.pop("training", None)  # frontend-injected, not a prop kwarg
     op_type = attrs.pop("op_type", None)
     if op_type is None:
         raise ValueError("Custom op requires op_type=")
@@ -35,7 +39,14 @@ def _make_prop(attrs):
         )
     # reference semantics: every kwarg reaches the prop as a string
     str_attrs = {k: str(v) for k, v in attrs.items()}
-    prop = PROP_REGISTRY[op_type](**str_attrs)
+    # one prop per (op_type, attrs): Symbol building, shape inference, and
+    # trace-time execution reuse the same instance (the reference creates the
+    # prop once per operator, not per query)
+    key = (op_type, tuple(sorted(str_attrs.items())))
+    prop = _PROP_CACHE.get(key)
+    if prop is None or type(prop) is not PROP_REGISTRY[op_type]:
+        prop = PROP_REGISTRY[op_type](**str_attrs)
+        _PROP_CACHE[key] = prop
     return prop
 
 
@@ -48,11 +59,13 @@ def _req_list(n, req="write"):
 
 
 @register("Custom")
-def custom(*data, **attrs):
+def custom(*data, training=False, **attrs):
     """Runs a registered CustomOp (reference ``mx.nd.Custom``).
 
     ``op_type`` selects the registered ``CustomOpProp``; remaining attrs are
-    forwarded to the prop constructor as strings.
+    forwarded to the prop constructor as strings.  ``training`` is injected
+    by the frontends (autograd recording state / executor is_train), becoming
+    the ``is_train`` flag of ``CustomOp.forward``.
     """
     import jax
 
@@ -87,9 +100,7 @@ def custom(*data, **attrs):
             op_holder["op"] = prop.create_operator(None, in_shapes, in_types)
         return op_holder["op"]
 
-    from .. import autograd as _ag
-
-    is_train = _ag.is_training()
+    is_train = bool(training)
 
     def _host_forward(*arrays):
         from ..ndarray.ndarray import array as nd_array

@@ -495,11 +495,12 @@ def _make_sym_op_func(opdef, public_name):
                 if not isinstance(a, Symbol):
                     raise TypeError("variadic op %s expects Symbols" % opdef.name)
                 inputs.append(a)
+            keep_raw = opdef.name == "Custom"  # prop kwargs stay verbatim
             for k, v in kwargs.items():
                 if isinstance(v, Symbol):
                     inputs.append(v)
                 else:
-                    attrs[k] = parse_attr(v) if isinstance(v, str) else v
+                    attrs[k] = parse_attr(v) if isinstance(v, str) and not keep_raw else v
             return _create(opdef, inputs, attrs, name, user_attrs)
         named = {}
         for i, a in enumerate(tensor_args):

@@ -212,3 +212,65 @@ def test_attrs_reach_prop_as_strings():
     nd.Custom(x, op_type="attr_check", alpha=3, beta="hello")
     assert seen["alpha"] == "3"
     assert seen["beta"] == "hello"
+
+
+def test_is_train_flag_follows_context():
+    """Review regression: is_train must follow autograd/executor state, not
+    be baked at trace time."""
+    seen = []
+
+    @mx.operator.register("train_probe")
+    class TrainProbeProp(mx.operator.CustomOpProp):
+        def create_operator(self, ctx, shapes, dtypes):
+            class P(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    seen.append(bool(is_train))
+                    self.assign(out_data[0], req[0], in_data[0])
+
+                def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+                    self.assign(in_grad[0], req[0], out_grad[0])
+
+            return P()
+
+    from mxnet_tpu import autograd
+
+    x = nd.array(np.ones((2, 2), np.float32))
+    nd.Custom(x, op_type="train_probe")
+    with autograd.record():
+        nd.Custom(x, op_type="train_probe")
+    assert seen[-2:] == [False, True]
+
+    from mxnet_tpu import sym
+
+    out = sym.Custom(sym.Variable("data"), op_type="train_probe")
+    exe = out.simple_bind(data=(2, 2))
+    seen.clear()
+    exe.forward(is_train=True, data=x)
+    assert seen and seen[-1] is True
+    seen.clear()
+    exe.forward(is_train=False, data=x)
+    assert seen and seen[-1] is False
+
+
+def test_string_attrs_verbatim():
+    """Review regression: '1e3' must not be re-parsed into '1000.0'."""
+    got = {}
+
+    @mx.operator.register("verbatim")
+    class VerbatimProp(mx.operator.CustomOpProp):
+        def __init__(self, thresh="1e3"):
+            super().__init__()
+            got["thresh"] = thresh
+
+        def create_operator(self, ctx, shapes, dtypes):
+            class Id(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0])
+
+                def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+                    self.assign(in_grad[0], req[0], out_grad[0])
+
+            return Id()
+
+    nd.Custom(nd.array(np.ones((1,), np.float32)), op_type="verbatim", thresh="1e3")
+    assert got["thresh"] == "1e3"
