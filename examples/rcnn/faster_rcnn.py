@@ -1,0 +1,418 @@
+"""Faster R-CNN — reference ``example/rcnn/`` (train_end2end.py,
+rcnn/symbol/symbol_vgg.py get_vgg_train, rcnn/core/loader.py AnchorLoader,
+rcnn/symbol/proposal_target.py CustomOp), rebuilt TPU-first.
+
+End-to-end architecture (same as the reference end2end config):
+backbone conv features → RPN (cls + bbox) → MultiProposal op →
+proposal_target CustomOp (ROI sampling, host-side like the reference) →
+ROIPooling → FC head → per-class cls_score + bbox_pred.
+
+TPU notes: the Proposal/NMS path is the fixed-capacity masked formulation in
+ops/detection.py (SURVEY §7.3's "dynamic shapes" hard part); proposal_target
+keeps the reference's host-numpy sampling via the pure_callback CustomOp
+bridge, returning fixed-size padded ROI batches so everything downstream jits.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import nn, HybridBlock, Block
+
+
+# ---------------------------------------------------------------------------
+# host-side target assignment (reference rcnn/processing/{generate_anchor,
+# assign_anchor}; runs in the data path like AnchorLoader did)
+# ---------------------------------------------------------------------------
+
+
+def generate_anchors(stride, scales, ratios):
+    """Base anchors — MUST be byte-identical to MultiProposal's device-side
+    enumeration, so reuse the op's own helper (ops/detection.py:471)."""
+    from mxnet_tpu.ops.detection import _generate_base_anchors
+
+    return np.asarray(_generate_base_anchors(stride, scales, ratios), np.float32)
+
+
+def _shift_anchors(base, stride, hf, wf):
+    sx = np.arange(wf) * stride
+    sy = np.arange(hf) * stride
+    sx, sy = np.meshgrid(sx, sy)
+    shifts = np.stack([sx.ravel(), sy.ravel(), sx.ravel(), sy.ravel()], axis=1)
+    all_anchors = base[None, :, :] + shifts[:, None, :].astype(np.float32)
+    return all_anchors.reshape(-1, 4)  # (Hf*Wf*A, 4)
+
+
+def _np_iou(a, b):
+    tl = np.maximum(a[:, None, :2], b[None, :, :2])
+    br = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.maximum(br - tl + 1, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[:, 2] - a[:, 0] + 1) * (a[:, 3] - a[:, 1] + 1)
+    area_b = (b[:, 2] - b[:, 0] + 1) * (b[:, 3] - b[:, 1] + 1)
+    return inter / np.maximum(area_a[:, None] + area_b[None, :] - inter, 1e-12)
+
+
+def _bbox_transform(ex, gt):
+    """Box regression targets (reference rcnn/processing/bbox_transform.py)."""
+    ew = ex[:, 2] - ex[:, 0] + 1.0
+    eh = ex[:, 3] - ex[:, 1] + 1.0
+    ecx = ex[:, 0] + 0.5 * (ew - 1)
+    ecy = ex[:, 1] + 0.5 * (eh - 1)
+    gw = gt[:, 2] - gt[:, 0] + 1.0
+    gh = gt[:, 3] - gt[:, 1] + 1.0
+    gcx = gt[:, 0] + 0.5 * (gw - 1)
+    gcy = gt[:, 1] + 0.5 * (gh - 1)
+    return np.stack(
+        [
+            (gcx - ecx) / (ew + 1e-14),
+            (gcy - ecy) / (eh + 1e-14),
+            np.log(gw / ew),
+            np.log(gh / eh),
+        ],
+        axis=1,
+    ).astype(np.float32)
+
+
+def assign_anchor(feat_shape, gt_boxes, im_info, stride=8, scales=(2, 4, 8),
+                  ratios=(0.5, 1, 2), allowed_border=0, batch_rois=256, fg_fraction=0.5,
+                  pos_thresh=0.7, neg_thresh=0.3, rng=None):
+    """RPN target assignment (reference rcnn/core/loader.py AnchorLoader →
+    assign_anchor).  Returns (label (A',), bbox_target (A',4), bbox_weight)."""
+    rng = rng or np.random
+    hf, wf = feat_shape
+    base = generate_anchors(stride, scales, ratios)
+    anchors = _shift_anchors(base, stride, hf, wf)
+    total = anchors.shape[0]
+    im_h, im_w = im_info[0], im_info[1]
+    inds_inside = np.where(
+        (anchors[:, 0] >= -allowed_border)
+        & (anchors[:, 1] >= -allowed_border)
+        & (anchors[:, 2] < im_w + allowed_border)
+        & (anchors[:, 3] < im_h + allowed_border)
+    )[0]
+    label = np.full(total, -1, np.float32)
+    bbox_target = np.zeros((total, 4), np.float32)
+    bbox_weight = np.zeros((total, 4), np.float32)
+    inside = anchors[inds_inside]
+    gt = gt_boxes[gt_boxes[:, 0] >= 0][:, 1:5] if gt_boxes.size else np.zeros((0, 4), np.float32)
+    if gt.shape[0]:
+        iou = _np_iou(inside, gt)
+        argmax = iou.argmax(axis=1)
+        max_iou = iou[np.arange(inside.shape[0]), argmax]
+        lab_in = np.full(inside.shape[0], -1, np.float32)
+        lab_in[max_iou < neg_thresh] = 0
+        # each gt's best anchor is fg (reference assign_anchor rule)
+        gt_best = iou.argmax(axis=0)
+        lab_in[gt_best] = 1
+        lab_in[max_iou >= pos_thresh] = 1
+        # subsample to batch_rois
+        fg = np.where(lab_in == 1)[0]
+        max_fg = int(batch_rois * fg_fraction)
+        if len(fg) > max_fg:
+            lab_in[rng.choice(fg, len(fg) - max_fg, replace=False)] = -1
+        bg = np.where(lab_in == 0)[0]
+        max_bg = batch_rois - min(len(np.where(lab_in == 1)[0]), max_fg)
+        if len(bg) > max_bg:
+            lab_in[rng.choice(bg, len(bg) - max_bg, replace=False)] = -1
+        fg = np.where(lab_in == 1)[0]
+        bbox_target[inds_inside[fg]] = _bbox_transform(inside[fg], gt[argmax[fg]])
+        bbox_weight[inds_inside[fg]] = 1.0
+        label[inds_inside] = lab_in
+    else:
+        lab_in = np.full(inside.shape[0], -1, np.float32)
+        bg = rng.choice(inside.shape[0], min(batch_rois, inside.shape[0]), replace=False)
+        lab_in[bg] = 0
+        label[inds_inside] = lab_in
+    return label, bbox_target, bbox_weight
+
+
+# ---------------------------------------------------------------------------
+# proposal_target CustomOp (reference rcnn/symbol/proposal_target.py:31,82)
+# ---------------------------------------------------------------------------
+
+
+@mx.operator.register("proposal_target")
+class ProposalTargetProp(mx.operator.CustomOpProp):
+    """``num_classes`` INCLUDES background (reference rcnn config convention:
+    VOC num_classes=21)."""
+
+    def __init__(self, num_classes="2", batch_images="1", batch_rois="64",
+                 fg_fraction="0.25"):
+        super().__init__(need_top_grad=False)
+        self._num_classes = int(num_classes)
+        self._batch_images = int(batch_images)
+        self._batch_rois = int(batch_rois)
+        self._fg_fraction = float(fg_fraction)
+        if self._batch_rois % self._batch_images != 0:
+            raise ValueError(
+                "batch_rois (%d) must be divisible by batch_images (%d)"
+                % (self._batch_rois, self._batch_images)
+            )
+
+    def list_arguments(self):
+        return ["rois", "gt_boxes"]
+
+    def list_outputs(self):
+        return ["rois_output", "label", "bbox_target", "bbox_weight"]
+
+    def infer_shape(self, in_shape):
+        rpn_rois_shape = in_shape[0]
+        gt_boxes_shape = in_shape[1]
+        rois = self._batch_rois
+        C = self._num_classes
+        return (
+            [rpn_rois_shape, gt_boxes_shape],
+            [(rois, 5), (rois,), (rois, 4 * C), (rois, 4 * C)],
+            [],
+        )
+
+    def create_operator(self, ctx, shapes, dtypes):
+        prop = self
+
+        class ProposalTarget(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                all_rois = in_data[0].asnumpy()  # (R, 5)
+                gt_flat = in_data[1].asnumpy()  # (B, N, 5) [cls,x1,y1,x2,y2]
+                B = prop._batch_images
+                per_im = prop._batch_rois // B
+                fg_per_im = int(round(prop._fg_fraction * per_im))
+                C = prop._num_classes
+                rng = np.random
+                rois_out, labels, bt, bw = [], [], [], []
+                for b in range(B):
+                    rois_b = all_rois[all_rois[:, 0] == b]
+                    gt_b = gt_flat[b]
+                    gt_b = gt_b[gt_b[:, 0] >= 0]
+                    # include gt boxes as rois (reference behavior)
+                    if gt_b.shape[0]:
+                        gt_rois = np.concatenate(
+                            [np.full((gt_b.shape[0], 1), b, np.float32), gt_b[:, 1:5]], axis=1
+                        )
+                        rois_b = np.concatenate([rois_b, gt_rois], axis=0)
+                    if gt_b.shape[0]:
+                        iou = _np_iou(rois_b[:, 1:5], gt_b[:, 1:5])
+                        argmax = iou.argmax(axis=1)
+                        max_iou = iou[np.arange(rois_b.shape[0]), argmax]
+                    else:
+                        argmax = np.zeros(rois_b.shape[0], np.int64)
+                        max_iou = np.zeros(rois_b.shape[0], np.float32)
+                    fg = np.where(max_iou >= 0.5)[0]
+                    bg = np.where(max_iou < 0.5)[0]
+                    n_fg = min(fg_per_im, fg.size)
+                    if fg.size > n_fg:
+                        fg = rng.choice(fg, n_fg, replace=False)
+                    n_bg = per_im - n_fg
+                    if bg.size > n_bg:
+                        bg = rng.choice(bg, n_bg, replace=False)
+                    elif bg.size < n_bg and bg.size > 0:
+                        bg = np.concatenate([bg, rng.choice(bg, n_bg - bg.size)])
+                    keep = np.concatenate([fg, bg]).astype(np.int64)
+                    if keep.size == 0:  # no rois for this image at all
+                        keep = np.zeros(per_im, np.int64)
+                    while keep.size < per_im:  # degenerate: pad by repeating
+                        keep = np.concatenate([keep, keep])[:per_im]
+                    keep = keep[:per_im]
+                    sel = rois_b[keep]
+                    lab = np.zeros(per_im, np.float32)
+                    t = np.zeros((per_im, 4 * C), np.float32)
+                    w = np.zeros((per_im, 4 * C), np.float32)
+                    if gt_b.shape[0]:
+                        lab[: n_fg] = gt_b[argmax[keep[:n_fg]], 0] + 1  # 0 is bg
+                        tgt = _bbox_transform(sel[:n_fg, 1:5], gt_b[argmax[keep[:n_fg]], 1:5])
+                        for j in range(n_fg):
+                            c = int(lab[j])
+                            t[j, 4 * c : 4 * c + 4] = tgt[j]
+                            w[j, 4 * c : 4 * c + 4] = 1.0
+                    rois_out.append(sel)
+                    labels.append(lab)
+                    bt.append(t)
+                    bw.append(w)
+                self.assign(out_data[0], req[0], nd.array(np.concatenate(rois_out)))
+                self.assign(out_data[1], req[1], nd.array(np.concatenate(labels)))
+                self.assign(out_data[2], req[2], nd.array(np.concatenate(bt)))
+                self.assign(out_data[3], req[3], nd.array(np.concatenate(bw)))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+                self.assign(in_grad[0], req[0], nd.array(np.zeros(in_data[0].shape, np.float32)))
+                self.assign(in_grad[1], req[1], nd.array(np.zeros(in_data[1].shape, np.float32)))
+
+        return ProposalTarget()
+
+
+# ---------------------------------------------------------------------------
+# network
+# ---------------------------------------------------------------------------
+
+
+class _Backbone(HybridBlock):
+    """Small conv backbone, output stride 8 (stands in for VGG16 conv4/5;
+    reference rcnn/symbol/symbol_vgg.py get_vgg_conv)."""
+
+    def __init__(self, channels=(16, 32, 64), **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.body = nn.HybridSequential()
+            for ch in channels:
+                self.body.add(
+                    nn.Conv2D(ch, kernel_size=3, padding=1),
+                    nn.BatchNorm(),
+                    nn.Activation("relu"),
+                    nn.MaxPool2D(pool_size=2, strides=2),
+                )
+
+    def hybrid_forward(self, F, x):
+        return self.body(x)
+
+
+class RPN(HybridBlock):
+    def __init__(self, num_anchors, channels=64, **kw):
+        super().__init__(**kw)
+        self.num_anchors = num_anchors
+        with self.name_scope():
+            self.conv = nn.Conv2D(channels, kernel_size=3, padding=1, activation="relu")
+            self.cls = nn.Conv2D(2 * num_anchors, kernel_size=1)
+            self.bbox = nn.Conv2D(4 * num_anchors, kernel_size=1)
+
+    def hybrid_forward(self, F, x):
+        t = self.conv(x)
+        return self.cls(t), self.bbox(t)
+
+
+class FasterRCNN(Block):
+    """End-to-end Faster R-CNN (reference get_vgg_train / get_vgg_test)."""
+
+    def __init__(self, num_classes, stride=8, scales=(2, 4, 8), ratios=(0.5, 1, 2),
+                 batch_rois=64, roi_size=(7, 7), **kw):
+        super().__init__(**kw)
+        self.num_classes = num_classes  # excludes background
+        self.stride = stride
+        self.scales = scales
+        self.ratios = ratios
+        self.batch_rois = batch_rois
+        self.roi_size = roi_size
+        A = len(scales) * len(ratios)
+        self.num_anchors = A
+        with self.name_scope():
+            self.backbone = _Backbone()
+            self.rpn = RPN(A)
+            self.head = nn.HybridSequential()
+            self.head.add(nn.Dense(128, activation="relu"), nn.Dense(128, activation="relu"))
+            self.cls_score = nn.Dense(num_classes + 1)
+            self.bbox_pred = nn.Dense(4 * (num_classes + 1))
+
+    def rpn_forward(self, x):
+        feat = self.backbone(x)
+        rpn_cls, rpn_bbox = self.rpn(feat)
+        return feat, rpn_cls, rpn_bbox
+
+    def proposals(self, rpn_cls, rpn_bbox, im_info, train=True):
+        B, _, hf, wf = rpn_cls.shape
+        A = self.num_anchors
+        # 2-class softmax over anchors: reshape (B, 2A, H, W) -> (B, 2, A*H, W)
+        score = nd.reshape(rpn_cls, shape=(B, 2, A * hf, wf))
+        prob = nd.softmax(score, axis=1)
+        prob = nd.reshape(prob, shape=(B, 2 * A, hf, wf))
+        return nd.contrib.MultiProposal(
+            prob, rpn_bbox, im_info,
+            rpn_pre_nms_top_n=600 if train else 300,
+            rpn_post_nms_top_n=self.batch_rois * 2 if train else 100,
+            threshold=0.7,
+            rpn_min_size=self.stride,
+            scales=self.scales,
+            ratios=self.ratios,
+            feature_stride=self.stride,
+        )
+
+    def roi_head(self, feat, rois):
+        pooled = nd.ROIPooling(
+            feat, rois, pooled_size=self.roi_size, spatial_scale=1.0 / self.stride
+        )
+        h = self.head(nd.flatten(pooled))
+        return self.cls_score(h), self.bbox_pred(h)
+
+    def forward(self, x, im_info, gt_boxes=None):
+        """Training forward: returns everything the loss needs."""
+        feat, rpn_cls, rpn_bbox = self.rpn_forward(x)
+        rois = self.proposals(rpn_cls, rpn_bbox, im_info, train=gt_boxes is not None)
+        if gt_boxes is not None:
+            rois, label, bbox_target, bbox_weight = nd.Custom(
+                rois, gt_boxes, op_type="proposal_target",
+                num_classes=str(self.num_classes + 1),  # incl. background
+                batch_images=str(x.shape[0]),
+                batch_rois=str(self.batch_rois), fg_fraction="0.25",
+            )
+            cls_score, bbox_pred = self.roi_head(feat, rois)
+            return rpn_cls, rpn_bbox, rois, label, bbox_target, bbox_weight, cls_score, bbox_pred
+        cls_score, bbox_pred = self.roi_head(feat, rois)
+        return rois, cls_score, bbox_pred
+
+
+def smooth_l1(pred, target, weight, sigma=1.0):
+    d = (pred - target) * weight
+    s2 = sigma * sigma
+    absd = nd.abs(d)
+    out = nd.where(absd < 1.0 / s2, 0.5 * s2 * d * d, absd - 0.5 / s2)
+    return out.sum() / max(pred.shape[0], 1)
+
+
+def rcnn_losses(net, x, im_info, gt_boxes, anchor_rng=None):
+    """Full end-to-end loss (reference train_end2end.py loss heads)."""
+    from mxnet_tpu.gluon import loss as gloss
+
+    (rpn_cls, rpn_bbox, rois, label, bbox_target, bbox_weight, cls_score,
+     bbox_pred) = net(x, im_info, gt_boxes)
+    B, _, hf, wf = rpn_cls.shape
+    A = net.num_anchors
+    # host RPN targets per image (reference AnchorLoader)
+    labs, bts, bws = [], [], []
+    gt_np = gt_boxes.asnumpy()
+    info_np = im_info.asnumpy()
+    for b in range(B):
+        lab, bt, bw = assign_anchor(
+            (hf, wf), gt_np[b], info_np[b], stride=net.stride, scales=net.scales,
+            ratios=net.ratios, rng=anchor_rng,
+        )
+        labs.append(lab)
+        bts.append(bt)
+        bws.append(bw)
+    rpn_label = nd.array(np.stack(labs))  # (B, Hf*Wf*A)
+    rpn_bt = nd.array(np.stack(bts))  # (B, Hf*Wf*A, 4)
+    rpn_bw = nd.array(np.stack(bws))
+
+    # rpn cls loss: logits (B, 2A, Hf, Wf), channel layout [A bg | A fg]
+    # to MATCH what proposals()/MultiProposal read (detection.py:629
+    # cls_prob[:, A:] = fg) -> (B, Hf*Wf*A, 2) with last dim (bg, fg)
+    logits = nd.transpose(
+        nd.reshape(rpn_cls, shape=(B, 2, A, hf, wf)), axes=(0, 3, 4, 2, 1)
+    )
+    logits = nd.reshape(logits, shape=(B, hf * wf * A, 2))
+    ce = gloss.SoftmaxCrossEntropyLoss()
+    valid = rpn_label >= 0
+    rpn_cls_loss = (
+        nd.reshape(ce(nd.reshape(logits, shape=(-1, 2)),
+                      nd.reshape(nd.maximum(rpn_label, 0.0), shape=(-1,))),
+                   shape=rpn_label.shape) * valid
+    ).sum() / nd.maximum(valid.sum(), 1.0)
+
+    # rpn bbox loss: preds (B, 4A, Hf, Wf) -> (B, Hf*Wf*A, 4)
+    bp = nd.transpose(nd.reshape(rpn_bbox, shape=(B, A, 4, hf, wf)), axes=(0, 3, 4, 1, 2))
+    bp = nd.reshape(bp, shape=(B, hf * wf * A, 4))
+    rpn_bbox_loss = smooth_l1(bp, rpn_bt, rpn_bw, sigma=3.0)
+
+    # rcnn head losses
+    rcnn_cls_loss = ce(cls_score, label).mean()
+    rcnn_bbox_loss = smooth_l1(bbox_pred, bbox_target, bbox_weight)
+    total = rpn_cls_loss + rpn_bbox_loss + rcnn_cls_loss + rcnn_bbox_loss
+    return total, {
+        "rpn_cls": float(rpn_cls_loss.asnumpy()),
+        "rpn_bbox": float(rpn_bbox_loss.asnumpy()),
+        "rcnn_cls": float(rcnn_cls_loss.asnumpy()),
+        "rcnn_bbox": float(rcnn_bbox_loss.asnumpy()),
+    }

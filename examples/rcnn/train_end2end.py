@@ -1,0 +1,81 @@
+"""Faster R-CNN end-to-end training — reference ``example/rcnn/train_end2end.py``.
+
+--synthetic generates a shapes dataset (pixel-coordinate gt boxes) so the
+whole pipeline runs anywhere; pass a detection .rec for real data.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+
+from faster_rcnn import FasterRCNN, rcnn_losses
+
+
+def synthetic_batches(batch_size, data_shape, num_batches, num_classes=2, seed=0):
+    """Rectangles dataset with PIXEL-coordinate labels [cls, x1, y1, x2, y2]."""
+    rng = np.random.RandomState(seed)
+    c, h, w = data_shape
+    for _ in range(num_batches):
+        data = rng.rand(batch_size, c, h, w).astype(np.float32) * 0.2
+        labels = np.full((batch_size, 2, 5), -1.0, dtype=np.float32)
+        for b in range(batch_size):
+            for j in range(rng.randint(1, 3)):
+                cls = rng.randint(0, num_classes)
+                bw = rng.uniform(0.3, 0.6) * w
+                bh = rng.uniform(0.3, 0.6) * h
+                x1 = rng.uniform(0, w - bw)
+                y1 = rng.uniform(0, h - bh)
+                labels[b, j] = [cls, x1, y1, x1 + bw, y1 + bh]
+                data[b, cls % c, int(y1) : int(y1 + bh), int(x1) : int(x1 + bw)] += 0.8
+        im_info = np.tile(np.array([h, w, 1.0], np.float32), (batch_size, 1))
+        yield nd.array(data), nd.array(im_info), nd.array(labels)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch-size", type=int, default=2)
+    p.add_argument("--data-shape", type=int, nargs=3, default=[3, 64, 64])
+    p.add_argument("--num-classes", type=int, default=2)
+    p.add_argument("--epochs", type=int, default=4)
+    p.add_argument("--batches-per-epoch", type=int, default=8)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--synthetic", action="store_true", default=True)
+    args = p.parse_args()
+
+    net = FasterRCNN(num_classes=args.num_classes)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(
+        net.collect_params(), "sgd", {"learning_rate": args.lr, "momentum": 0.9, "wd": 5e-4}
+    )
+    anchor_rng = np.random.RandomState(0)
+    for epoch in range(args.epochs):
+        tic = time.time()
+        agg = {}
+        nb = 0
+        for data, im_info, labels in synthetic_batches(
+            args.batch_size, tuple(args.data_shape), args.batches_per_epoch,
+            args.num_classes, seed=epoch,
+        ):
+            with autograd.record():
+                loss, parts = rcnn_losses(net, data, im_info, labels, anchor_rng=anchor_rng)
+            loss.backward()
+            trainer.step(args.batch_size)
+            for k, v in parts.items():
+                agg[k] = agg.get(k, 0.0) + v
+            nb += 1
+        msg = " ".join("%s=%.4f" % (k, v / nb) for k, v in sorted(agg.items()))
+        print("epoch %d: %s (%.1fs)" % (epoch, msg, time.time() - tic))
+    return net
+
+
+if __name__ == "__main__":
+    main()

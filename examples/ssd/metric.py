@@ -1,0 +1,103 @@
+"""VOC mAP metric — reference ``example/ssd/evaluate/eval_metric.py``
+(MApMetric/VOC07MApMetric)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class VOCMApMetric:
+    """Mean average precision for detection.
+
+    update() takes detections (B, A, 6) [cls, score, x1, y1, x2, y2] (cls -1
+    = invalid) and ground-truth labels (B, N, 5+) [cls, x1, y1, x2, y2]
+    (cls -1 = padding).
+    """
+
+    def __init__(self, iou_thresh=0.5, class_names=None, use_voc07=False):
+        self.iou_thresh = iou_thresh
+        self.class_names = class_names
+        self.use_voc07 = use_voc07
+        self.reset()
+
+    def reset(self):
+        self._records = {}  # cls -> list of (score, tp)
+        self._gt_counts = {}
+
+    @staticmethod
+    def _iou(box, boxes):
+        ix1 = np.maximum(box[0], boxes[:, 0])
+        iy1 = np.maximum(box[1], boxes[:, 1])
+        ix2 = np.minimum(box[2], boxes[:, 2])
+        iy2 = np.minimum(box[3], boxes[:, 3])
+        iw = np.maximum(ix2 - ix1, 0)
+        ih = np.maximum(iy2 - iy1, 0)
+        inter = iw * ih
+        union = (
+            (box[2] - box[0]) * (box[3] - box[1])
+            + (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+            - inter
+        )
+        return inter / np.maximum(union, 1e-12)
+
+    def update(self, dets, labels):
+        dets = np.asarray(dets)
+        labels = np.asarray(labels)
+        for b in range(dets.shape[0]):
+            gt = labels[b]
+            gt = gt[gt[:, 0] >= 0]
+            for c in np.unique(gt[:, 0]).astype(int):
+                self._gt_counts[c] = self._gt_counts.get(c, 0) + int((gt[:, 0] == c).sum())
+            det = dets[b]
+            det = det[det[:, 0] >= 0]
+            order = np.argsort(-det[:, 1])
+            det = det[order]
+            matched = np.zeros(gt.shape[0], dtype=bool)
+            for row in det:
+                c = int(row[0])
+                cls_gt_idx = np.where(gt[:, 0] == c)[0]
+                tp = 0
+                if cls_gt_idx.size:
+                    ious = self._iou(row[2:6], gt[cls_gt_idx, 1:5])
+                    best = np.argmax(ious)
+                    if ious[best] >= self.iou_thresh and not matched[cls_gt_idx[best]]:
+                        matched[cls_gt_idx[best]] = True
+                        tp = 1
+                self._records.setdefault(c, []).append((float(row[1]), tp))
+
+    def _average_precision(self, rec, prec):
+        if self.use_voc07:
+            ap = 0.0
+            for t in np.arange(0.0, 1.1, 0.1):
+                p = prec[rec >= t].max() if (rec >= t).any() else 0.0
+                ap += p / 11.0
+            return ap
+        mrec = np.concatenate([[0.0], rec, [1.0]])
+        mpre = np.concatenate([[0.0], prec, [0.0]])
+        for i in range(mpre.size - 1, 0, -1):
+            mpre[i - 1] = max(mpre[i - 1], mpre[i])
+        idx = np.where(mrec[1:] != mrec[:-1])[0]
+        return float(((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]).sum())
+
+    def get(self):
+        aps = []
+        names = []
+        # every class with ground truth counts: zero detections -> AP 0
+        for c in sorted(set(self._records) | set(self._gt_counts)):
+            npos = self._gt_counts.get(c, 0)
+            if npos == 0:
+                continue
+            recs = self._records.get(c, [])
+            if not recs:
+                aps.append(0.0)
+                names.append(self.class_names[c] if self.class_names else str(c))
+                continue
+            recs = sorted(recs, key=lambda x: -x[0])
+            tps = np.array([tp for _, tp in recs], dtype=np.float64)
+            tp_cum = np.cumsum(tps)
+            fp_cum = np.cumsum(1.0 - tps)
+            rec = tp_cum / npos
+            prec = tp_cum / np.maximum(tp_cum + fp_cum, 1e-12)
+            aps.append(self._average_precision(rec, prec))
+            names.append(self.class_names[c] if self.class_names else str(c))
+        mean_ap = float(np.mean(aps)) if aps else 0.0
+        return "mAP", mean_ap

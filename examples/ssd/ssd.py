@@ -1,0 +1,149 @@
+"""SSD detector — reference ``example/ssd/`` (symbol/symbol_builder.py,
+symbol/common.py multibox layers) rebuilt as a gluon HybridBlock.
+
+TPU-first notes: the whole forward — backbone, heads, anchor generation —
+is one jit-compiled graph of static shapes; MultiBoxTarget/Detection are the
+registry ops (mxnet_tpu/ops/detection.py) whose NMS/matching are masked
+fixed-capacity computations, not dynamic host loops.
+"""
+from __future__ import annotations
+
+import sys
+import os
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import nn, HybridBlock, loss as gloss
+
+
+def _conv_block(channels):
+    """conv-bn-relu x2 (reference symbol/common.py conv_act_layer)."""
+    blk = nn.HybridSequential()
+    for _ in range(2):
+        blk.add(
+            nn.Conv2D(channels, kernel_size=3, padding=1),
+            nn.BatchNorm(),
+            nn.Activation("relu"),
+        )
+    return blk
+
+
+def _down_sample(channels):
+    blk = _conv_block(channels)
+    blk.add(nn.MaxPool2D(pool_size=2, strides=2))
+    return blk
+
+
+def _cls_predictor(num_anchors, num_classes):
+    return nn.Conv2D(num_anchors * (num_classes + 1), kernel_size=3, padding=1)
+
+
+def _box_predictor(num_anchors):
+    return nn.Conv2D(num_anchors * 4, kernel_size=3, padding=1)
+
+
+class SSD(HybridBlock):
+    """Multi-scale single-shot detector.
+
+    Parameters mirror the reference SSD example: per-scale anchor ``sizes``
+    and ``ratios``; ``num_classes`` excludes background.
+    """
+
+    def __init__(
+        self,
+        num_classes,
+        base_channels=(16, 32, 64),
+        scale_channels=64,
+        num_scales=4,
+        sizes=None,
+        ratios=None,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.num_scales = num_scales
+        if sizes is None:
+            s = np.linspace(0.2, 0.9, num_scales + 1)
+            sizes = [[float(s[i]), float(np.sqrt(s[i] * s[i + 1]))] for i in range(num_scales)]
+        if ratios is None:
+            ratios = [[1.0, 2.0, 0.5]] * num_scales
+        self.sizes = sizes
+        self.ratios = ratios
+        self.num_anchors = len(sizes[0]) + len(ratios[0]) - 1
+        with self.name_scope():
+            self.base = nn.HybridSequential()
+            for ch in base_channels:
+                self.base.add(_down_sample(ch))
+            self.stages = []
+            self.cls_preds = []
+            self.box_preds = []
+            for i in range(num_scales):
+                stage = _down_sample(scale_channels) if i > 0 else _conv_block(scale_channels)
+                cls = _cls_predictor(self.num_anchors, num_classes)
+                box = _box_predictor(self.num_anchors)
+                setattr(self, "stage%d" % i, stage)
+                setattr(self, "cls%d" % i, cls)
+                setattr(self, "box%d" % i, box)
+                self.stages.append(stage)
+                self.cls_preds.append(cls)
+                self.box_preds.append(box)
+
+    def hybrid_forward(self, F, x):
+        x = self.base(x)
+        anchors, cls_outs, box_outs = [], [], []
+        for i in range(self.num_scales):
+            x = self.stages[i](x)
+            anchors.append(
+                F.contrib.MultiBoxPrior(x, sizes=self.sizes[i], ratios=self.ratios[i])
+            )
+            c = self.cls_preds[i](x)  # (B, A*(C+1), H, W)
+            b = self.box_preds[i](x)  # (B, A*4, H, W)
+            cls_outs.append(F.flatten(F.transpose(c, axes=(0, 2, 3, 1))))
+            box_outs.append(F.flatten(F.transpose(b, axes=(0, 2, 3, 1))))
+        anchors = F.concat(*anchors, dim=1)  # (1, A_total, 4)
+        cls_preds = F.reshape(
+            F.concat(*cls_outs, dim=1), shape=(0, -1, self.num_classes + 1)
+        )  # (B, A, C+1)
+        box_preds = F.concat(*box_outs, dim=1)  # (B, A*4)
+        return anchors, cls_preds, box_preds
+
+
+def training_targets(anchors, cls_preds, labels, negative_mining_ratio=3.0):
+    """MultiBoxTarget wrapper: anchors (1,A,4), cls_preds (B,A,C+1),
+    labels (B,N,5) -> (box_target, box_mask, cls_target)."""
+    cls_preds_t = nd.transpose(cls_preds, axes=(0, 2, 1))  # (B, C+1, A)
+    return nd.contrib.MultiBoxTarget(
+        anchors, labels, cls_preds_t, negative_mining_ratio=negative_mining_ratio
+    )
+
+
+class SSDLoss:
+    """cls CE (ignoring -1) + smooth-L1 on matched boxes (reference
+    example/ssd training loss: MultiBoxTarget + SoftmaxOutput/SmoothL1)."""
+
+    def __init__(self):
+        self._ce = gloss.SoftmaxCrossEntropyLoss()
+        self._l1 = gloss.HuberLoss()
+
+    def __call__(self, cls_preds, box_preds, cls_target, box_target, box_mask):
+        valid = cls_target >= 0  # ignore_label rows contribute nothing
+        ce = self._ce(
+            nd.reshape(cls_preds, shape=(-1, cls_preds.shape[-1])),
+            nd.reshape(nd.maximum(cls_target, 0.0), shape=(-1,)),
+        )
+        ce = nd.reshape(ce, shape=cls_target.shape) * valid
+        l1 = self._l1(box_preds * box_mask, box_target * box_mask)
+        return ce.mean() + l1.mean()
+
+
+def detect(net, x, threshold=0.01, nms_threshold=0.45):
+    """Inference: decode + NMS via MultiBoxDetection; returns (B, A, 6)."""
+    anchors, cls_preds, box_preds = net(x)
+    cls_prob = nd.transpose(nd.softmax(cls_preds, axis=-1), axes=(0, 2, 1))
+    return nd.contrib.MultiBoxDetection(
+        cls_prob, box_preds, anchors, threshold=threshold, nms_threshold=nms_threshold
+    )

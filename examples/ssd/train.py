@@ -1,0 +1,122 @@
+"""SSD training — reference ``example/ssd/train.py`` + ``train/train_net.py``.
+
+Runs on a .rec detection dataset (ImageDetIter) or, with --synthetic, on a
+generated shapes dataset so the full pipeline is runnable anywhere.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+
+from ssd import SSD, SSDLoss, training_targets, detect
+from metric import VOCMApMetric
+
+
+def synthetic_batches(batch_size, data_shape, num_batches, num_classes=2, seed=0):
+    """Random colored rectangles on noise; label = [cls, x1, y1, x2, y2]."""
+    rng = np.random.RandomState(seed)
+    c, h, w = data_shape
+    for _ in range(num_batches):
+        data = rng.rand(batch_size, c, h, w).astype(np.float32) * 0.2
+        labels = np.full((batch_size, 2, 5), -1.0, dtype=np.float32)
+        for b in range(batch_size):
+            n = rng.randint(1, 3)
+            for j in range(n):
+                cls = rng.randint(0, num_classes)
+                bw, bh = rng.uniform(0.25, 0.5, 2)
+                x1 = rng.uniform(0, 1 - bw)
+                y1 = rng.uniform(0, 1 - bh)
+                x2, y2 = x1 + bw, y1 + bh
+                labels[b, j] = [cls, x1, y1, x2, y2]
+                ix1, iy1 = int(x1 * w), int(y1 * h)
+                ix2, iy2 = max(ix1 + 1, int(x2 * w)), max(iy1 + 1, int(y2 * h))
+                # class-dependent intensity pattern makes the task learnable
+                data[b, cls % c, iy1:iy2, ix1:ix2] += 0.8
+        yield nd.array(data), nd.array(labels)
+
+
+def train(args):
+    net = SSD(num_classes=args.num_classes)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(
+        net.collect_params(), "sgd", {"learning_rate": args.lr, "momentum": 0.9, "wd": 5e-4}
+    )
+    loss_fn = SSDLoss()
+
+    for epoch in range(args.epochs):
+        tic = time.time()
+        tot_loss, nb = 0.0, 0
+        if args.synthetic:
+            batches = synthetic_batches(
+                args.batch_size, tuple(args.data_shape), args.batches_per_epoch, args.num_classes,
+                seed=epoch,
+            )
+        else:
+            it = mx.image.ImageDetIter(
+                batch_size=args.batch_size,
+                data_shape=tuple(args.data_shape),
+                path_imgrec=args.train_rec,
+                shuffle=True,
+                rand_mirror=True,
+                mean=True,
+                std=True,
+            )
+            batches = ((b.data[0], b.label[0]) for b in it)
+        for data, labels in batches:
+            with autograd.record():
+                anchors, cls_preds, box_preds = net(data)
+                box_target, box_mask, cls_target = training_targets(anchors, cls_preds, labels)
+                loss = loss_fn(cls_preds, box_preds, cls_target, box_target, box_mask)
+            loss.backward()
+            trainer.step(args.batch_size)
+            tot_loss += float(loss.asnumpy())
+            nb += 1
+        print(
+            "epoch %d: loss %.4f (%.1fs, %.1f samples/s)"
+            % (epoch, tot_loss / max(nb, 1), time.time() - tic,
+               nb * args.batch_size / max(time.time() - tic, 1e-9))
+        )
+    return net
+
+
+def evaluate(net, args):
+    metric = VOCMApMetric(iou_thresh=0.5)
+    batches = synthetic_batches(
+        args.batch_size, tuple(args.data_shape), 4, args.num_classes, seed=999
+    )
+    for data, labels in batches:
+        dets = detect(net, data, threshold=0.1)
+        metric.update(dets.asnumpy(), labels.asnumpy())
+    name, val = metric.get()
+    print("%s: %.4f" % (name, val))
+    return val
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--train-rec", default=None, help=".rec file (ImageDetIter)")
+    p.add_argument("--synthetic", action="store_true", default=False)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--data-shape", type=int, nargs=3, default=[3, 64, 64])
+    p.add_argument("--num-classes", type=int, default=2)
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--batches-per-epoch", type=int, default=16)
+    p.add_argument("--lr", type=float, default=0.1)
+    args = p.parse_args()
+    if args.train_rec is None:
+        args.synthetic = True
+    net = train(args)
+    evaluate(net, args)
+
+
+if __name__ == "__main__":
+    main()

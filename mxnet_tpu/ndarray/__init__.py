@@ -222,6 +222,24 @@ def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
     return _wrap(_to_device(out, ctx) if ctx else out, ctx)
 
 
+def maximum(lhs, rhs):
+    """Elementwise max, scalar-aware (reference python/mxnet/ndarray/ndarray.py maximum)."""
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        return _invoke(_registry.get("broadcast_maximum"), (lhs, rhs), {})
+    if isinstance(lhs, NDArray):
+        return _invoke(_registry.get("_maximum_scalar"), (lhs,), {"scalar": float(rhs)})
+    return _invoke(_registry.get("_maximum_scalar"), (rhs,), {"scalar": float(lhs)})
+
+
+def minimum(lhs, rhs):
+    """Elementwise min, scalar-aware (reference ndarray.py minimum)."""
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        return _invoke(_registry.get("broadcast_minimum"), (lhs, rhs), {})
+    if isinstance(lhs, NDArray):
+        return _invoke(_registry.get("_minimum_scalar"), (lhs,), {"scalar": float(rhs)})
+    return _invoke(_registry.get("_minimum_scalar"), (rhs,), {"scalar": float(lhs)})
+
+
 def zeros_like(arr, **kw):
     return _invoke(_registry.get("zeros_like"), (arr,), kw)
 

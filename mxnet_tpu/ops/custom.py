@@ -24,10 +24,17 @@ def register_prop(op_type, prop_cls):
     PROP_REGISTRY[op_type] = prop_cls
 
 
-_PROP_CACHE = {}
+_META_PROP_CACHE = {}
 
 
-def _make_prop(attrs):
+def _make_prop(attrs, metadata_only=False):
+    """Instantiates the registered CustomOpProp for these attrs.
+
+    ``metadata_only=True`` (Symbol building / output counting) may return a
+    cached instance — those queries are pure.  Execution paths always get a
+    fresh prop, matching the reference's prop-per-operator-node lifetime so
+    stateful props never cross-contaminate between layers/models.
+    """
     attrs = dict(attrs)
     attrs.pop("training", None)  # frontend-injected, not a prop kwarg
     op_type = attrs.pop("op_type", None)
@@ -39,19 +46,19 @@ def _make_prop(attrs):
         )
     # reference semantics: every kwarg reaches the prop as a string
     str_attrs = {k: str(v) for k, v in attrs.items()}
-    # one prop per (op_type, attrs): Symbol building, shape inference, and
-    # trace-time execution reuse the same instance (the reference creates the
-    # prop once per operator, not per query)
-    key = (op_type, tuple(sorted(str_attrs.items())))
-    prop = _PROP_CACHE.get(key)
-    if prop is None or type(prop) is not PROP_REGISTRY[op_type]:
+    if metadata_only:
+        key = (op_type, tuple(sorted(str_attrs.items())))
+        prop = _META_PROP_CACHE.get(key)
+        if prop is not None and type(prop) is PROP_REGISTRY[op_type]:
+            return prop
         prop = PROP_REGISTRY[op_type](**str_attrs)
-        _PROP_CACHE[key] = prop
-    return prop
+        _META_PROP_CACHE[key] = prop
+        return prop
+    return PROP_REGISTRY[op_type](**str_attrs)
 
 
 def num_outputs_for(attrs):
-    return len(_make_prop(attrs).list_outputs())
+    return len(_make_prop(attrs, metadata_only=True).list_outputs())
 
 
 def _req_list(n, req="write"):

@@ -667,6 +667,11 @@ def multibox_prior(data, *, sizes=(1.0,), ratios=(1.0,), clip=False, steps=(-1.0
     ``ratios[1:]`` at sizes[0]; corner format, normalized coords; optional
     clip to [0, 1].  Output (1, H·W·A, 4)."""
     H, W = data.shape[2], data.shape[3]
+    if H <= 0 or W <= 0:
+        raise ValueError(
+            "MultiBoxPrior: feature map is %dx%d — input too small for this "
+            "many downsampling stages" % (H, W)
+        )
     sizes = tuple(float(s) for s in (sizes if isinstance(sizes, (tuple, list)) else (sizes,)))
     ratios = tuple(float(r) for r in (ratios if isinstance(ratios, (tuple, list)) else (ratios,)))
     step_y = 1.0 / H if steps[0] <= 0 else float(steps[0])

@@ -1,0 +1,163 @@
+"""Smoke tests for the detection example models (reference example/ssd,
+example/rcnn — SURVEY §2.4 required end-to-end capability)."""
+import importlib.util
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+
+_EX = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def _load(name, path):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def ssd_mod():
+    return _load("ssd_example", os.path.join(_EX, "ssd", "ssd.py"))
+
+
+@pytest.fixture(scope="module")
+def ssd_train_mod(ssd_mod):
+    sys.path.insert(0, os.path.join(_EX, "ssd"))
+    return _load("ssd_train_example", os.path.join(_EX, "ssd", "train.py"))
+
+
+@pytest.fixture(scope="module")
+def rcnn_mod():
+    sys.path.insert(0, os.path.join(_EX, "rcnn"))
+    return _load("rcnn_example", os.path.join(_EX, "rcnn", "faster_rcnn.py"))
+
+
+def test_ssd_forward_shapes(ssd_mod):
+    net = ssd_mod.SSD(num_classes=3, num_scales=3)
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.rand(2, 3, 64, 64).astype(np.float32))
+    anchors, cls_preds, box_preds = net(x)
+    A = anchors.shape[1]
+    assert anchors.shape == (1, A, 4)
+    assert cls_preds.shape == (2, A, 4)
+    assert box_preds.shape == (2, A * 4)
+
+
+def test_ssd_train_step_decreases_loss(ssd_mod, ssd_train_mod):
+    net = ssd_mod.SSD(num_classes=2, num_scales=3)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.2, "momentum": 0.9})
+    loss_fn = ssd_mod.SSDLoss()
+    losses = []
+    for i in range(4):
+        batches = ssd_train_mod.synthetic_batches(4, (3, 64, 64), 2, 2, seed=i)
+        tot = n = 0
+        for data, labels in batches:
+            with autograd.record():
+                anchors, cls_preds, box_preds = net(data)
+                bt, bm, ct = ssd_mod.training_targets(anchors, cls_preds, labels)
+                loss = loss_fn(cls_preds, box_preds, ct, bt, bm)
+            loss.backward()
+            trainer.step(4)
+            tot += float(loss.asnumpy())
+            n += 1
+        losses.append(tot / n)
+    assert losses[-1] < losses[0]
+
+
+def test_ssd_detect_output(ssd_mod):
+    net = ssd_mod.SSD(num_classes=2, num_scales=3)
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.rand(1, 3, 64, 64).astype(np.float32))
+    dets = ssd_mod.detect(net, x, threshold=0.0)
+    assert dets.shape[0] == 1 and dets.shape[2] == 6
+    d = dets.asnumpy()[0]
+    valid = d[d[:, 0] >= 0]
+    assert (valid[:, 1] >= 0).all() and (valid[:, 1] <= 1).all()
+
+
+def test_ssd_map_metric():
+    metric = _load("ssd_metric_example", os.path.join(_EX, "ssd", "metric.py"))
+    m = metric.VOCMApMetric()
+    dets = np.array([[[0, 0.9, 0.1, 0.1, 0.5, 0.5], [1, 0.8, 0.6, 0.6, 0.9, 0.9]]])
+    labels = np.array([[[0, 0.1, 0.1, 0.5, 0.5], [1, 0.6, 0.6, 0.9, 0.9]]])
+    m.update(dets, labels)
+    name, val = m.get()
+    assert name == "mAP" and val == 1.0
+    m.reset()
+    # detection matching the wrong class -> AP 0
+    dets_bad = np.array([[[1, 0.9, 0.1, 0.1, 0.5, 0.5]]])
+    labels2 = np.array([[[0, 0.1, 0.1, 0.5, 0.5]]])
+    m.update(dets_bad, labels2)
+    assert m.get()[1] == 0.0
+
+
+def test_rcnn_anchor_target(rcnn_mod):
+    rng = np.random.RandomState(0)
+    gt = np.array([[0, 8.0, 8.0, 40.0, 40.0], [-1, -1, -1, -1, -1]], np.float32)
+    lab, bt, bw = rcnn_mod.assign_anchor((8, 8), gt, (64, 64, 1.0), stride=8, rng=rng)
+    assert lab.shape == (8 * 8 * 9,)
+    assert set(np.unique(lab)).issubset({-1.0, 0.0, 1.0})
+    fg = lab == 1
+    assert fg.sum() >= 1
+    assert (bw[fg] == 1).all()
+    assert np.isfinite(bt).all()
+
+
+def test_rcnn_end_to_end_loss_decreases(rcnn_mod):
+    train = _load("rcnn_train_example", os.path.join(_EX, "rcnn", "train_end2end.py"))
+    net = rcnn_mod.FasterRCNN(num_classes=2)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.05, "momentum": 0.9})
+    rng = np.random.RandomState(0)
+    losses = []
+    for epoch in range(3):
+        tot = n = 0
+        for data, im_info, labels in train.synthetic_batches(2, (3, 64, 64), 2, 2, seed=epoch):
+            with autograd.record():
+                loss, parts = rcnn_mod.rcnn_losses(net, data, im_info, labels, anchor_rng=rng)
+            loss.backward()
+            trainer.step(2)
+            tot += float(loss.asnumpy())
+            n += 1
+        losses.append(tot / n)
+    assert losses[-1] < losses[0]
+    assert all(np.isfinite(l) for l in losses)
+
+
+def test_rcnn_inference_path(rcnn_mod):
+    net = rcnn_mod.FasterRCNN(num_classes=2)
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.rand(1, 3, 64, 64).astype(np.float32))
+    im_info = nd.array(np.array([[64, 64, 1.0]], np.float32))
+    rois, cls_score, bbox_pred = net(x, im_info)
+    assert rois.shape[1] == 5
+    assert cls_score.shape == (rois.shape[0], 3)
+    assert bbox_pred.shape == (rois.shape[0], 12)
+
+
+def test_proposal_target_custom_op(rcnn_mod):
+    rois = np.zeros((8, 5), np.float32)
+    rois[:, 1:] = np.array([4, 4, 28, 28], np.float32) + np.arange(8)[:, None]
+    gt = np.array([[[1, 6.0, 6.0, 30.0, 30.0], [-1, -1, -1, -1, -1]]], np.float32)
+    out = nd.Custom(
+        nd.array(rois), nd.array(gt), op_type="proposal_target",
+        num_classes="3", batch_images="1", batch_rois="8", fg_fraction="0.5",
+    )
+    rois_out, label, bt, bw = out
+    assert rois_out.shape == (8, 5)
+    assert label.shape == (8,)
+    assert bt.shape == (8, 12) and bw.shape == (8, 12)
+    lab = label.asnumpy()
+    assert (lab >= 0).all() and (lab <= 2).all()
+    # fg rois carry class 2 (gt cls 1 + 1) and nonzero weights in that slot
+    fg = np.where(lab == 2)[0]
+    assert fg.size > 0
+    w = bw.asnumpy()
+    assert (w[fg][:, 8:12] == 1).all()
