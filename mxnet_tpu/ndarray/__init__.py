@@ -259,15 +259,18 @@ def save(fname, data):
     Format: numpy .npz with a manifest key encoding list vs dict (portable,
     replacing the reference's dmlc binary format).
     """
+    def _np(v):
+        return v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+
     # pass an open handle so numpy can't append ".npz" to the user's filename
     with open(fname, "wb") as f:
-        if isinstance(data, NDArray):
-            np.savez(f, __mx_format__="single", a0=data.asnumpy())
+        if isinstance(data, (NDArray, np.ndarray)):
+            np.savez(f, __mx_format__="single", a0=_np(data))
         elif isinstance(data, (list, tuple)):
-            arrs = {"a%d" % i: a.asnumpy() for i, a in enumerate(data)}
+            arrs = {"a%d" % i: _np(a) for i, a in enumerate(data)}
             np.savez(f, __mx_format__="list", **arrs)
         elif isinstance(data, dict):
-            arrs = {"k_" + k: v.asnumpy() for k, v in data.items()}
+            arrs = {"k_" + k: _np(v) for k, v in data.items()}
             np.savez(f, __mx_format__="dict", **arrs)
         else:
             raise TypeError(type(data))

@@ -15,6 +15,15 @@ def zeros(*, shape, dtype="float32"):
     return jnp.zeros(shape, dtype=dtype_np(dtype or "float32"))
 
 
+@register("_zeros_rows")
+def zeros_rows(data, *, tail, dtype="float32"):
+    """Zeros of shape (data.shape[0],) + tail — batch-dynamic zero states
+    (replaces the reference's shape-0 partial-shape trick for RNN
+    begin_state, rnn_cell.py:108 begin_state)."""
+    tail = (tail,) if isinstance(tail, int) else tuple(tail)
+    return jnp.zeros((data.shape[0],) + tail, dtype=dtype_np(dtype or "float32"))
+
+
 @register("_ones", alias=["ones"])
 def ones(*, shape, dtype="float32"):
     return jnp.ones(shape, dtype=dtype_np(dtype or "float32"))

@@ -1,0 +1,10 @@
+"""Legacy symbol-based RNN API — reference ``python/mxnet/rnn/``."""
+from .rnn_cell import *  # noqa: F401,F403
+from .rnn import *  # noqa: F401,F403
+from .io import *  # noqa: F401,F403
+
+from . import rnn_cell
+from . import rnn
+from . import io
+
+__all__ = rnn_cell.__all__ + rnn.__all__ + io.__all__

@@ -1,0 +1,136 @@
+"""Bucketed sequence IO — reference ``python/mxnet/rnn/io.py``
+(encode_sentences :30, BucketSentenceIter :78)."""
+from __future__ import annotations
+
+import bisect
+import random as pyrandom
+
+import numpy as np
+
+from ..io import DataIter, DataBatch, DataDesc
+from ..ndarray.ndarray import array
+
+__all__ = ["encode_sentences", "BucketSentenceIter"]
+
+
+def encode_sentences(sentences, vocab=None, invalid_label=-1, invalid_key="\n",
+                     start_label=0):
+    """Token lists -> id lists, building/extending vocab (reference :30)."""
+    idx = start_label
+    if vocab is None:
+        vocab = {invalid_key: invalid_label}
+        new_vocab = True
+    else:
+        new_vocab = False
+    res = []
+    for sent in sentences:
+        coded = []
+        for word in sent:
+            if word not in vocab:
+                assert new_vocab, "Unknown token %s" % word
+                if idx == invalid_label:
+                    idx += 1
+                vocab[word] = idx
+                idx += 1
+            coded.append(vocab[word])
+        res.append(coded)
+    return res, vocab
+
+
+class BucketSentenceIter(DataIter):
+    """Bucketed iterator over encoded sentences (reference :78).
+
+    Pads each sentence up to its bucket length; yields batches whose
+    ``bucket_key`` is the bucket length (pairs with BucketingModule — on TPU
+    each bucket is one jit specialization, the reference's per-bucket
+    executor).
+    """
+
+    def __init__(self, sentences, batch_size, buckets=None, invalid_label=-1,
+                 data_name="data", label_name="softmax_label", dtype="float32",
+                 layout="NT"):
+        super().__init__(batch_size)
+        if not buckets:
+            buckets = [
+                i for i, j in enumerate(np.bincount([len(s) for s in sentences]))
+                if j >= batch_size
+            ]
+        buckets.sort()
+        ndiscard = 0
+        self.data = [[] for _ in buckets]
+        for sent in sentences:
+            buck = bisect.bisect_left(buckets, len(sent))
+            if buck == len(buckets):
+                ndiscard += 1
+                continue
+            buff = np.full((buckets[buck],), invalid_label, dtype=dtype)
+            buff[: len(sent)] = sent
+            self.data[buck].append(buff)
+        # empty buckets must stay 2-D (0, bucket_len) so reset()'s label
+        # shift and batching indexing stay valid
+        self.data = [
+            np.asarray(i, dtype=dtype).reshape(len(i), buckets[k])
+            for k, i in enumerate(self.data)
+        ]
+        if ndiscard:
+            print("WARNING: discarded %d sentences longer than the largest bucket." % ndiscard)
+
+        self.batch_size = batch_size
+        self.buckets = buckets
+        self.data_name = data_name
+        self.label_name = label_name
+        self.dtype = dtype
+        self.invalid_label = invalid_label
+        self.nddata = []
+        self.ndlabel = []
+        self.major_axis = layout.find("N")
+        self.layout = layout
+        self.default_bucket_key = max(buckets)
+
+        shape = (
+            (batch_size, self.default_bucket_key)
+            if self.major_axis == 0
+            else (self.default_bucket_key, batch_size)
+        )
+        self.provide_data = [DataDesc(data_name, shape, dtype, layout=layout)]
+        self.provide_label = [DataDesc(label_name, shape, dtype, layout=layout)]
+
+        self.idx = []
+        for i, buck in enumerate(self.data):
+            self.idx.extend([(i, j) for j in range(0, len(buck) - batch_size + 1, batch_size)])
+        self.curr_idx = 0
+        self.reset()
+
+    def reset(self):
+        self.curr_idx = 0
+        pyrandom.shuffle(self.idx)
+        for buck in self.data:
+            np.random.shuffle(buck)
+        self.nddata = []
+        self.ndlabel = []
+        for buck in self.data:
+            label = np.empty_like(buck)
+            label[:, :-1] = buck[:, 1:]
+            label[:, -1] = self.invalid_label
+            self.nddata.append(buck)
+            self.ndlabel.append(label)
+
+    def next(self):
+        if self.curr_idx == len(self.idx):
+            raise StopIteration
+        i, j = self.idx[self.curr_idx]
+        self.curr_idx += 1
+        if self.major_axis == 1:
+            data = self.nddata[i][j : j + self.batch_size].T
+            label = self.ndlabel[i][j : j + self.batch_size].T
+        else:
+            data = self.nddata[i][j : j + self.batch_size]
+            label = self.ndlabel[i][j : j + self.batch_size]
+        return DataBatch(
+            [array(data)],
+            [array(label)],
+            pad=0,
+            bucket_key=self.buckets[i],
+            provide_data=[DataDesc(self.data_name, data.shape, self.dtype, layout=self.layout)],
+            provide_label=[DataDesc(self.label_name, label.shape, self.dtype, layout=self.layout)],
+        )

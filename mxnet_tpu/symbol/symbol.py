@@ -469,6 +469,9 @@ def _num_outputs_of(opdef, attrs):
         return 3 if attrs.get("output_mean_var") else 1
     if opdef.name == "moments":
         return 2
+    if opdef.name == "RNN":
+        # op returns (out, h_final[, c_final]) unconditionally (ops/rnn.py:179-182)
+        return 3 if attrs.get("mode", "lstm") == "lstm" else 2
     if opdef.name == "topk":
         return 2 if attrs.get("ret_typ") == "both" else 1
     return 1

@@ -1,0 +1,220 @@
+"""Legacy rnn package tests — mirrors reference
+tests/python/unittest/test_rnn.py (cell unroll shapes, fused-vs-unfused
+consistency, bidirectional/residual/zoneout, BucketSentenceIter)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu import rnn as mrnn
+
+
+def _bind_run(outputs, length=3, batch=2, dim=4, seed=0, **var_shapes):
+    """simple_bind an unrolled graph and run forward with random inputs."""
+    out = sym.Group(outputs) if isinstance(outputs, list) else outputs
+    shapes = {"data": (batch, length, dim)}
+    shapes.update(var_shapes)
+    exe = out.simple_bind(**shapes)
+    rng = np.random.RandomState(seed)
+    feed = {"data": nd.array(rng.randn(batch, length, dim).astype(np.float32))}
+    outs = exe.forward(is_train=False, **feed)
+    return exe, [o.asnumpy() for o in outs]
+
+
+def test_rnn_cell_unroll_shapes():
+    cell = mrnn.RNNCell(8, prefix="rnn_")
+    outputs, states = cell.unroll(3, inputs=sym.Variable("data"), merge_outputs=False)
+    assert len(outputs) == 3
+    assert sorted(cell.params._params.keys()) == [
+        "rnn_h2h_bias", "rnn_h2h_weight", "rnn_i2h_bias", "rnn_i2h_weight",
+    ]
+    exe, outs = _bind_run(outputs)
+    assert all(o.shape == (2, 8) for o in outs)
+
+
+def test_lstm_gru_unroll_merged():
+    for cell, nstates in [(mrnn.LSTMCell(8, prefix="lstm_"), 2), (mrnn.GRUCell(8, prefix="gru_"), 1)]:
+        outputs, states = cell.unroll(3, inputs=sym.Variable("data"), merge_outputs=True)
+        assert len(states) == nstates
+        exe, outs = _bind_run(outputs)
+        assert outs[0].shape == (2, 3, 8)
+
+
+def test_sequential_stack():
+    stack = mrnn.SequentialRNNCell()
+    stack.add(mrnn.LSTMCell(8, prefix="l0_"))
+    stack.add(mrnn.LSTMCell(8, prefix="l1_"))
+    outputs, states = stack.unroll(3, inputs=sym.Variable("data"), merge_outputs=True)
+    assert len(states) == 4
+    exe, outs = _bind_run(outputs)
+    assert outs[0].shape == (2, 3, 8)
+
+
+def test_bidirectional():
+    cell = mrnn.BidirectionalCell(
+        mrnn.LSTMCell(8, prefix="l_"), mrnn.LSTMCell(8, prefix="r_"), output_prefix="bi_"
+    )
+    outputs, states = cell.unroll(3, inputs=sym.Variable("data"), merge_outputs=True)
+    exe, outs = _bind_run(outputs)
+    assert outs[0].shape == (2, 3, 16)
+
+
+def test_residual_cell():
+    cell = mrnn.ResidualCell(mrnn.RNNCell(4, prefix="res_"))
+    outputs, states = cell.unroll(2, inputs=sym.Variable("data"), merge_outputs=False)
+    exe, outs = _bind_run(outputs, length=2, dim=4)
+    assert outs[0].shape == (2, 4)
+
+
+def test_zoneout_cell_runs():
+    cell = mrnn.ZoneoutCell(mrnn.RNNCell(4, prefix="zo_"), zoneout_outputs=0.3, zoneout_states=0.3)
+    outputs, states = cell.unroll(3, inputs=sym.Variable("data"), merge_outputs=False)
+    exe, outs = _bind_run(outputs, dim=4)
+    assert outs[0].shape == (2, 4)
+
+
+def test_unpack_pack_roundtrip_lstm():
+    cell = mrnn.LSTMCell(4, prefix="lstm_")
+    rng = np.random.RandomState(0)
+    args = {
+        "lstm_i2h_weight": rng.randn(16, 5).astype(np.float32),
+        "lstm_i2h_bias": rng.randn(16).astype(np.float32),
+        "lstm_h2h_weight": rng.randn(16, 4).astype(np.float32),
+        "lstm_h2h_bias": rng.randn(16).astype(np.float32),
+    }
+    unpacked = cell.unpack_weights(dict(args))
+    assert "lstm_i2h_i_weight" in unpacked and "lstm_h2h_o_bias" in unpacked
+    packed = cell.pack_weights(unpacked)
+    for k, v in args.items():
+        np.testing.assert_allclose(packed[k], v, rtol=1e-6)
+
+
+@pytest.mark.parametrize("mode", ["rnn_tanh", "lstm", "gru"])
+def test_fused_matches_unfused(mode):
+    """The reference's canonical consistency check (test_rnn.py test_fused):
+    FusedRNNCell and its unfuse() stack must produce identical outputs when
+    weights are converted with unpack_weights."""
+    T, B, D, H, L = 3, 2, 5, 4, 2
+    fused = mrnn.FusedRNNCell(H, num_layers=L, mode=mode, prefix="f_", get_next_state=False)
+    f_out, _ = fused.unroll(T, inputs=sym.Variable("data"), merge_outputs=True)
+    f_exe = f_out.simple_bind(data=(B, T, D))
+
+    rng = np.random.RandomState(0)
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    psize = rnn_param_size(mode, D, H, L, False)
+    params = (rng.rand(psize).astype(np.float32) - 0.5) * 0.4
+    x = rng.randn(B, T, D).astype(np.float32)
+
+    (f_y,) = f_exe.forward(is_train=False, data=nd.array(x), f_parameters=nd.array(params))
+
+    unfused = fused.unfuse()
+    u_out, _ = unfused.unroll(T, inputs=sym.Variable("data"), merge_outputs=True)
+    u_exe = u_out.simple_bind(data=(B, T, D))
+    args = fused.unpack_weights({"f_parameters": nd.array(params)})
+    feed = {k: nd.array(np.asarray(v)) for k, v in args.items() if k != "f_parameters"}
+    (u_y,) = u_exe.forward(is_train=False, data=nd.array(x), **feed)
+    np.testing.assert_allclose(f_y.asnumpy(), u_y.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("mode", ["rnn_tanh", "lstm", "gru"])
+def test_fused_get_next_state(mode):
+    """RNN op is multi-output; get_next_state must expose final states."""
+    cell = mrnn.FusedRNNCell(4, mode=mode, get_next_state=True, prefix=mode + "_")
+    out, states = cell.unroll(3, inputs=sym.Variable("data"), merge_outputs=True)
+    assert len(states) == (2 if mode == "lstm" else 1)
+    _, out_sh, _ = out.infer_shape(data=(2, 3, 5))
+    assert out_sh[0] == (2, 3, 4)
+    _, st_sh, _ = states[0].infer_shape(data=(2, 3, 5))
+    assert st_sh[0] == (1, 2, 4)
+
+
+def test_begin_state_func_zeros_binds():
+    """begin_state(func=sym.zeros) with the reference's shape-0 batch dim
+    must yield a bindable graph (deferred _zeros_rows), for both unfused and
+    fused cells; non-zeros funcs are rejected with a clear error."""
+    cell = mrnn.LSTMCell(4, prefix="l_")
+    states = cell.begin_state(func=sym.zeros)
+    o, _ = cell.unroll(2, inputs=sym.Variable("data"), begin_state=states, merge_outputs=True)
+    exe = o.simple_bind(data=(3, 2, 5))
+    (y,) = exe.forward(is_train=False, data=nd.ones((3, 2, 5)))
+    assert y.shape == (3, 2, 4)
+
+    fused = mrnn.FusedRNNCell(4, mode="lstm", prefix="f_")
+    st = fused.begin_state(func=sym.zeros)
+    o2, _ = fused.unroll(3, inputs=sym.Variable("data"), begin_state=st, merge_outputs=True)
+    e2 = o2.simple_bind(data=(2, 3, 5))
+    (y2,) = e2.forward(is_train=False, data=nd.ones((2, 3, 5)))
+    assert y2.shape == (2, 3, 4)
+
+    with pytest.raises(mx.base.MXNetError):
+        mrnn.GRUCell(4, prefix="g_").begin_state(func=sym.uniform)
+
+
+def test_begin_state_func_zeros_manual_step():
+    """Reference pattern: begin_state(func=sym.zeros) then step the cell
+    directly — deferred states resolve against the step input."""
+    cell = mrnn.LSTMCell(4, prefix="l_")
+    states = cell.begin_state(func=sym.zeros)
+    x = sym.Variable("x")
+    out, states = cell(x, states)
+    out2, _ = cell(x, states)
+    exe = out2.simple_bind(x=(3, 5))
+    (y,) = exe.forward(is_train=False, x=nd.ones((3, 5)))
+    assert y.shape == (3, 4)
+
+
+def test_rnn_unroll_auto_inputs():
+    """rnn_unroll(inputs=None) auto-creates per-step input Variables
+    (reference rnn.py:26)."""
+    cell = mrnn.RNNCell(4, prefix="r_")
+    outputs, states = mrnn.rnn_unroll(cell, 3, input_prefix="t_")
+    out = sym.Group(outputs) if isinstance(outputs, list) else outputs
+    args = out.list_arguments()
+    assert "t_t0_data" in args and "t_t2_data" in args
+
+
+def test_bucket_iter_empty_bucket():
+    """A user bucket longer than every sentence must not crash construction."""
+    it = mrnn.BucketSentenceIter([[1, 2], [2, 1]], batch_size=2, buckets=[3, 10],
+                                 invalid_label=0)
+    batches = list(it)
+    assert len(batches) == 1
+    assert batches[0].bucket_key == 3
+
+
+def test_encode_sentences_and_bucket_iter():
+    sentences = [["a", "b", "c"], ["a", "c"], ["b", "c", "a", "b"], ["a", "b"], ["c", "b"]]
+    enc, vocab = mrnn.encode_sentences(sentences, invalid_label=0, start_label=1)
+    assert len(vocab) >= 3
+    it = mrnn.BucketSentenceIter(enc, batch_size=2, buckets=[3, 5], invalid_label=0)
+    batches = list(it)
+    assert batches
+    for b in batches:
+        assert b.bucket_key in (3, 5)
+        assert b.data[0].shape == (2, b.bucket_key)
+        d = b.data[0].asnumpy()
+        lab = b.label[0].asnumpy()
+        # label is data shifted left by one
+        np.testing.assert_array_equal(lab[:, :-1], d[:, 1:])
+    it.reset()
+    assert len(list(it)) == len(batches)
+
+
+def test_rnn_checkpoint_roundtrip(tmp_path):
+    cell = mrnn.LSTMCell(4, prefix="lstm_")
+    outputs, _ = cell.unroll(2, inputs=sym.Variable("data"), merge_outputs=True)
+    rng = np.random.RandomState(0)
+    arg_params = {
+        "lstm_i2h_weight": nd.array(rng.randn(16, 5).astype(np.float32)),
+        "lstm_i2h_bias": nd.array(rng.randn(16).astype(np.float32)),
+        "lstm_h2h_weight": nd.array(rng.randn(16, 4).astype(np.float32)),
+        "lstm_h2h_bias": nd.array(rng.randn(16).astype(np.float32)),
+    }
+    prefix = str(tmp_path / "model")
+    mrnn.save_rnn_checkpoint(cell, prefix, 1, outputs, dict(arg_params), {})
+    sym2, arg2, aux2 = mrnn.load_rnn_checkpoint(cell, prefix, 1)
+    for k in arg_params:
+        np.testing.assert_allclose(
+            arg2[k].asnumpy(), arg_params[k].asnumpy(), rtol=1e-6
+        )
