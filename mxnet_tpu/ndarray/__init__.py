@@ -134,6 +134,15 @@ def _invoke(opdef, args, kwargs):
     visible_rule = _VISIBLE_RULES.get(opdef.name)
     visible = visible_rule(attrs) if visible_rule else None
     result = _invoke_raw(opdef.fn, args, attrs, visible=visible, ctx=ctx)
+    if opdef.mutates:
+        # reference mutable-input ops (optimizer updates): extra outputs are
+        # the new values of the named inputs, written back in place
+        outs = result if isinstance(result, list) else [result]
+        for i, mname in enumerate(opdef.mutates):
+            idx = opdef.arg_names.index(mname)
+            if idx < len(args) and isinstance(args[idx], NDArray):
+                args[idx]._rebind(outs[1 + i]._data)
+        result = outs[0]
     if out_arr is not None:
         target = result[0] if isinstance(result, list) else result
         out_arr._rebind(target._data)

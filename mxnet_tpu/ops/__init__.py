@@ -14,6 +14,9 @@ from . import indexing  # noqa: F401
 from . import nn  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import rnn  # noqa: F401
+from . import linalg  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import contrib_ops  # noqa: F401
 from . import detection  # noqa: F401
 from . import custom  # noqa: F401
 

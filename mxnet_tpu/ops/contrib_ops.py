@@ -1,0 +1,207 @@
+"""Contrib + legacy standalone operators — reference ``src/operator/contrib/``
+(ctc_loss.cc, fft-inl.h, ifft-inl.h, count_sketch-inl.h, krprod.cc,
+quadratic_op-inl.h, bilinear_resize-inl.h, transformer.cc:34) and
+``src/operator/{correlation,crop}-inl.h``.
+
+TPU notes: CTC rides optax's scan-based forward algorithm (differentiable,
+jit-friendly); FFT lowers to XLA's fft HLO; Correlation is expressed as a
+shift-and-reduce over static displacement offsets so XLA can fuse it — no
+dynamic indexing.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+
+@register("_contrib_CTCLoss", alias=["_contrib_ctc_loss", "CTCLoss", "ctc_loss"])
+def ctc_loss(data, label, data_lengths=None, label_lengths=None, *,
+             use_data_lengths=False, use_label_lengths=False, blank_label="first"):
+    """Connectionist Temporal Classification loss (reference
+    src/operator/contrib/ctc_loss.cc:71; softmax applied internally).
+
+    data: (T, N, C) unnormalized activations; label: (N, L) padded class ids.
+    With blank_label='first', blank is id 0 and padding value is 0 (labels are
+    1-based); with 'last', blank is C-1 and padding is -1. Returns (N,) loss.
+    """
+    import optax
+
+    t, n, c = data.shape
+    logits = jnp.transpose(data, (1, 0, 2)).astype(jnp.float32)  # (N, T, C)
+    label = label.astype(jnp.int32)
+
+    if use_data_lengths and data_lengths is not None:
+        steps = jnp.arange(t)[None, :]
+        logit_pad = (steps >= data_lengths.astype(jnp.int32)[:, None]).astype(jnp.float32)
+    else:
+        logit_pad = jnp.zeros((n, t), jnp.float32)
+
+    pad_value = 0 if blank_label == "first" else -1
+    if use_label_lengths and label_lengths is not None:
+        pos = jnp.arange(label.shape[1])[None, :]
+        label_pad = (pos >= label_lengths.astype(jnp.int32)[:, None]).astype(jnp.float32)
+    else:
+        label_pad = (label == pad_value).astype(jnp.float32)
+
+    if blank_label == "first":
+        blank_id = 0
+        labels = label  # ids already 1-based with blank 0
+    else:
+        blank_id = c - 1
+        labels = jnp.where(label < 0, 0, label)  # padding slots masked anyway
+
+    return optax.ctc_loss(logits, logit_pad, labels, label_pad, blank_id=blank_id)
+
+
+@register("_contrib_fft", alias=["fft"])
+def fft(data, *, compute_size=128):
+    """1D FFT over the last axis; complex output interleaved as
+    (..., 2*d) [re, im, re, im, ...] (reference contrib/fft-inl.h)."""
+    y = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    out = jnp.stack([y.real, y.imag], axis=-1)
+    return out.reshape(*data.shape[:-1], data.shape[-1] * 2).astype(data.dtype)
+
+
+@register("_contrib_ifft", alias=["ifft"])
+def ifft(data, *, compute_size=128):
+    """Unnormalized inverse FFT of interleaved complex input (..., 2*d) ->
+    real (..., d); like cuFFT, NOT scaled by 1/d (reference contrib/ifft-inl.h:136
+    keeps the division commented out)."""
+    d = data.shape[-1] // 2
+    pairs = data.reshape(*data.shape[:-1], d, 2).astype(jnp.float32)
+    z = jax.lax.complex(pairs[..., 0], pairs[..., 1])
+    out = jnp.fft.ifft(z, axis=-1).real * d
+    return out.astype(data.dtype)
+
+
+@register("_contrib_count_sketch", alias=["count_sketch"])
+def count_sketch(data, h, s, *, out_dim, processing_batch_size=32):
+    """Count-sketch projection (reference contrib/count_sketch-inl.h):
+    out[n, h[i]] += s[i] * data[n, i]."""
+    in_dim = data.shape[-1]
+    flat = data.reshape(-1, in_dim)
+    hh = h.reshape(-1).astype(jnp.int32)
+    ss = s.reshape(-1).astype(data.dtype)
+    signed = flat * ss[None, :]
+    out = jnp.zeros((flat.shape[0], out_dim), data.dtype)
+    out = out.at[:, hh].add(signed)
+    return out.reshape(*data.shape[:-1], out_dim)
+
+
+@register("khatri_rao")
+def khatri_rao(*matrices):
+    """Column-wise Khatri-Rao product (reference contrib/krprod.cc:75)."""
+    assert matrices, "khatri_rao needs at least one matrix"
+    out = matrices[0]
+    for m in matrices[1:]:
+        k = out.shape[-1]
+        assert m.shape[-1] == k, "khatri_rao: column counts must match"
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, k)
+    return out
+
+
+@register("_contrib_quadratic", alias=["quadratic"])
+def quadratic(data, *, a=0.0, b=0.0, c=0.0):
+    """f(x) = a*x^2 + b*x + c (reference contrib/quadratic_op-inl.h:40)."""
+    return a * data * data + b * data + c
+
+
+@register("_contrib_BilinearResize2D", alias=["BilinearResize2D"])
+def bilinear_resize_2d(data, *, height, width):
+    """Bilinear upsampling of NCHW to (height, width) with align_corners
+    (reference contrib/bilinear_resize-inl.h, matching PyTorch-style
+    align_corners=True used by the reference kernels)."""
+    n, ch, ih, iw = data.shape
+    if ih == height and iw == width:
+        return data
+    ys = jnp.linspace(0.0, ih - 1.0, height)
+    xs = jnp.linspace(0.0, iw - 1.0, width)
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, ih - 1)
+    x1 = jnp.minimum(x0 + 1, iw - 1)
+    wy = (ys - y0).astype(data.dtype)
+    wx = (xs - x0).astype(data.dtype)
+    top_rows = data[:, :, y0, :]
+    bot_rows = data[:, :, y1, :]
+    top = top_rows[:, :, :, x0] * (1 - wx) + top_rows[:, :, :, x1] * wx
+    bot = bot_rows[:, :, :, x0] * (1 - wx) + bot_rows[:, :, :, x1] * wx
+    return top * (1 - wy[:, None]) + bot * wy[:, None]
+
+
+@register("_contrib_div_sqrt_dim", alias=["div_sqrt_dim"])
+def div_sqrt_dim(data):
+    """data / sqrt(last_dim) — the attention scaling helper
+    (reference contrib/transformer.cc:34)."""
+    return data / jnp.sqrt(jnp.asarray(data.shape[-1], data.dtype))
+
+
+@register("Correlation")
+def correlation(data1, data2, *, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    """FlowNet correlation layer (reference src/operator/correlation-inl.h:53).
+
+    Computes, for every spatial position and displacement (dy, dx) on a
+    stride2-quantized grid, the mean over a kernel window and channels of
+    data1 * shifted(data2) (or |data1 - shifted(data2)|). Expressed as a
+    static loop over displacements -> XLA fuses each shift-multiply-reduce.
+    """
+    n, c, h, w = data1.shape
+    pad = pad_size
+    d1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    d2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    kr = (kernel_size - 1) // 2
+    border = max_displacement + kr
+    ph, pw = h + 2 * pad, w + 2 * pad
+    top_h = int(np.ceil((ph - border * 2) / stride1))
+    top_w = int(np.ceil((pw - border * 2) / stride1))
+    grid_r = max_displacement // stride2
+    ks2 = kernel_size * kernel_size
+
+    # base (y, x) centers in padded coords
+    ys = border + stride1 * jnp.arange(top_h)
+    xs = border + stride1 * jnp.arange(top_w)
+
+    def window_sumpool(x):
+        # mean over kernel window around each center, all channels: (N,C,topH,topW)
+        if kernel_size == 1:
+            return x[:, :, ys, :][:, :, :, xs]
+        acc = 0.0
+        for ky in range(-kr, kr + 1):
+            for kx in range(-kr, kr + 1):
+                acc = acc + x[:, :, ys + ky, :][:, :, :, xs + kx]
+        return acc / ks2
+
+    out_maps = []
+    for dy in range(-grid_r, grid_r + 1):
+        for dx in range(-grid_r, grid_r + 1):
+            oy, ox = dy * stride2, dx * stride2
+            shifted = jnp.roll(d2, shift=(-oy, -ox), axis=(2, 3))
+            prod = d1 * shifted if is_multiply else jnp.abs(d1 - shifted)
+            pooled = window_sumpool(prod)  # (N, C, topH, topW)
+            out_maps.append(pooled.mean(axis=1))
+    return jnp.stack(out_maps, axis=1)  # (N, grid^2, topH, topW)
+
+
+def _crop_inputs(attrs):
+    return ["data", "crop_like"] if attrs.get("num_args", 1) == 2 else ["data"]
+
+
+@register("Crop", inputs_fn=_crop_inputs)
+def crop(data, crop_like=None, *, num_args=1, offset=(0, 0), h_w=(0, 0),
+         center_crop=False):
+    """Crop NCHW spatially to h_w (or to crop_like's H, W)
+    (reference src/operator/crop-inl.h:52)."""
+    n, c, h, w = data.shape
+    if crop_like is not None:
+        th, tw = crop_like.shape[2], crop_like.shape[3]
+    else:
+        th, tw = h_w
+    if center_crop:
+        oy, ox = (h - th) // 2, (w - tw) // 2
+    else:
+        oy, ox = offset
+    return data[:, :, oy : oy + th, ox : ox + tw]

@@ -1,0 +1,112 @@
+"""Linear-algebra operators — reference ``src/operator/tensor/la_op.{h,cc}``
+(LAPACK via c_lapack_api.h in the reference; here jnp/jax.scipy.linalg, which
+XLA lowers to MXU matmuls and on-device factorization routines).
+
+All ops operate on the last two axes, batching over leading axes, matching
+the reference's la_op batch semantics.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.linalg as jsl
+
+from .registry import register
+
+
+def _t(x, do):
+    return jnp.swapaxes(x, -1, -2) if do else x
+
+
+@register("_linalg_gemm", alias=["linalg_gemm"])
+def linalg_gemm(A, B, C, *, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0, axis=-2):
+    """out = alpha * op(A) @ op(B) + beta * C (reference la_op.cc:36)."""
+    if axis != -2:
+        A = jnp.moveaxis(A, axis, -2)
+        B = jnp.moveaxis(B, axis, -2)
+        C = jnp.moveaxis(C, axis, -2)
+    out = alpha * jnp.matmul(_t(A, transpose_a), _t(B, transpose_b)) + beta * C
+    if axis != -2:
+        out = jnp.moveaxis(out, -2, axis)
+    return out
+
+
+@register("_linalg_gemm2", alias=["linalg_gemm2"])
+def linalg_gemm2(A, B, *, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
+    """out = alpha * op(A) @ op(B) (reference la_op.cc:109)."""
+    if axis != -2:
+        A = jnp.moveaxis(A, axis, -2)
+        B = jnp.moveaxis(B, axis, -2)
+    out = alpha * jnp.matmul(_t(A, transpose_a), _t(B, transpose_b))
+    if axis != -2:
+        out = jnp.moveaxis(out, -2, axis)
+    return out
+
+
+@register("_linalg_potrf", alias=["linalg_potrf"])
+def linalg_potrf(A):
+    """Lower Cholesky factor of a symmetric positive-definite matrix
+    (reference la_op.cc:176)."""
+    return jnp.linalg.cholesky(A)
+
+
+@register("_linalg_potri", alias=["linalg_potri"])
+def linalg_potri(A):
+    """Inverse of B = A @ A^T from its lower Cholesky factor A
+    (reference la_op.cc:225)."""
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    inv_a = jsl.solve_triangular(A, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(inv_a, -1, -2), inv_a)
+
+
+@register("_linalg_trmm", alias=["linalg_trmm"])
+def linalg_trmm(A, B, *, transpose=False, rightside=False, alpha=1.0, lower=True):
+    """Triangular matrix multiply: out = alpha*op(A)@B (or B@op(A))
+    (reference la_op.cc:280). A is triangular."""
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    tri = _t(tri, transpose)
+    return alpha * (jnp.matmul(B, tri) if rightside else jnp.matmul(tri, B))
+
+
+@register("_linalg_trsm", alias=["linalg_trsm"])
+def linalg_trsm(A, B, *, transpose=False, rightside=False, alpha=1.0, lower=True):
+    """Solve op(A) @ X = alpha*B (or X @ op(A) = alpha*B) with triangular A
+    (reference la_op.cc:343)."""
+    if rightside:
+        # X @ op(A) = alpha*B  <=>  op(A)^T @ X^T = alpha*B^T
+        xt = jsl.solve_triangular(
+            A, jnp.swapaxes(alpha * B, -1, -2), lower=lower,
+            trans=0 if transpose else 1,
+        )
+        return jnp.swapaxes(xt, -1, -2)
+    return jsl.solve_triangular(A, alpha * B, lower=lower, trans=1 if transpose else 0)
+
+
+@register("_linalg_sumlogdiag", alias=["linalg_sumlogdiag"])
+def linalg_sumlogdiag(A):
+    """Sum of log of diagonal entries (reference la_op.cc:406)."""
+    d = jnp.diagonal(A, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(d), axis=-1)
+
+
+@register("_linalg_syrk", alias=["linalg_syrk"])
+def linalg_syrk(A, *, transpose=False, alpha=1.0):
+    """out = alpha * A @ A^T (or A^T @ A if transpose) (reference la_op.cc:449)."""
+    At = jnp.swapaxes(A, -1, -2)
+    return alpha * (jnp.matmul(At, A) if transpose else jnp.matmul(A, At))
+
+
+@register("_linalg_gelqf", alias=["linalg_gelqf"])
+def linalg_gelqf(A):
+    """LQ factorization A = L @ Q with Q orthonormal rows (reference
+    la_op.cc:506). Via QR of A^T: A^T = Q' R  =>  A = R^T Q'^T."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2), mode="reduced")
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("_linalg_syevd", alias=["linalg_syevd"])
+def linalg_syevd(A):
+    """Symmetric eigendecomposition A = U^T diag(L) U; rows of U are the
+    eigenvectors (reference la_op.cc:577)."""
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w

@@ -1,0 +1,158 @@
+"""Optimizer update operators — reference ``src/operator/optimizer_op.cc``
+(sgd_update :317, sgd_mom_update :344, mp_* :398-431, ftml_update :433,
+adam_update :465, rmsprop_update :519, rmspropalex_update :569,
+ftrl_update :610, signsgd_update :43, signum_update :69).
+
+The reference's kernels mutate weight/state tensors in place; here each op is
+a pure function returning (new_weight, *new_states) and the eager frontend
+writes the extra outputs back into the passed-in state NDArrays (OpDef
+``mutates``), so ``nd.adam_update(w, g, m, v, out=w, lr=...)`` behaves like
+the reference. On TPU these fuse into a handful of HBM-bound elementwise
+kernels under jit.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _prep_grad(grad, rescale_grad, clip_gradient, wd, weight):
+    """sgd-family semantics: clip(rescale*grad) + wd*weight
+    (reference SGDKernel optimizer_op-inl.h:92-96)."""
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * weight
+
+
+def _prep_grad_clip_after(grad, rescale_grad, clip_gradient, wd, weight):
+    """adam/rmsprop-family semantics: clip(rescale*grad + wd*weight)
+    (reference AdamUpdate optimizer_op-inl.h:841+ adds wd before clipping)."""
+    g = grad * rescale_grad + wd * weight
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+@register("sgd_update")
+def sgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+               lazy_update=True):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    return weight - lr * g
+
+
+@register("sgd_mom_update", mutates=("mom",))
+def sgd_mom_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, lazy_update=True):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mom = momentum * mom - lr * g
+    return weight + new_mom, new_mom
+
+
+@register("mp_sgd_update", mutates=("weight32",))
+def mp_sgd_update(weight, grad, weight32, *, lr, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, lazy_update=True):
+    """Multi-precision SGD: fp32 master weights, low-precision model weights
+    (reference optimizer_op.cc:398)."""
+    g = _prep_grad(grad.astype(jnp.float32), rescale_grad, clip_gradient, wd, weight32)
+    new_w32 = weight32 - lr * g
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@register("mp_sgd_mom_update", mutates=("mom", "weight32"))
+def mp_sgd_mom_update(weight, grad, mom, weight32, *, lr, momentum=0.0, wd=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _prep_grad(grad.astype(jnp.float32), rescale_grad, clip_gradient, wd, weight32)
+    new_mom = momentum * mom - lr * g
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@register("adam_update", mutates=("mean", "var"))
+def adam_update(weight, grad, mean, var, *, lr, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _prep_grad_clip_after(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mean = beta1 * mean + (1.0 - beta1) * g
+    new_var = beta2 * var + (1.0 - beta2) * g * g
+    new_w = weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return new_w, new_mean, new_var
+
+
+@register("ftml_update", mutates=("d", "v", "z"))
+def ftml_update(weight, grad, d, v, z, *, lr, t, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0):
+    """FTML (reference optimizer_op.cc:433; Zheng & Kwok 2017)."""
+    g = grad * rescale_grad + wd * weight
+    if clip_grad is not None and clip_grad >= 0:
+        g = jnp.clip(g, -clip_grad, clip_grad)
+    new_v = beta2 * v + (1.0 - beta2) * g * g
+    d_t = (1.0 - beta1 ** t) / lr * (jnp.sqrt(new_v / (1.0 - beta2 ** t)) + epsilon)
+    sigma_t = d_t - beta1 * d
+    new_z = beta1 * z + (1.0 - beta1) * g - sigma_t * weight
+    new_w = -new_z / d_t
+    return new_w, d_t, new_v, new_z
+
+
+@register("rmsprop_update", mutates=("n",))
+def rmsprop_update(weight, grad, n, *, lr, gamma1=0.95, epsilon=1e-8, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
+    g = _prep_grad_clip_after(grad, rescale_grad, clip_gradient, wd, weight)
+    new_n = (1.0 - gamma1) * g * g + gamma1 * n
+    new_w = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n
+
+
+@register("rmspropalex_update", mutates=("n", "g", "delta"))
+def rmspropalex_update(weight, grad, n, g, delta, *, lr, gamma1=0.95, gamma2=0.9,
+                       epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                       clip_weights=-1.0):
+    """RMSProp with momentum (Graves 2013; reference optimizer_op.cc:569)."""
+    gr = _prep_grad_clip_after(grad, rescale_grad, clip_gradient, wd, weight)
+    new_n = (1.0 - gamma1) * gr * gr + gamma1 * n
+    new_g = (1.0 - gamma1) * gr + gamma1 * g
+    new_delta = gamma2 * delta - lr * gr / jnp.sqrt(new_n - new_g * new_g + epsilon)
+    new_w = weight + new_delta
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n, new_g, new_delta
+
+
+@register("ftrl_update", mutates=("z", "n"))
+def ftrl_update(weight, grad, z, n, *, lr, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_n = n + g * g
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    new_w = jnp.where(
+        jnp.abs(new_z) <= lamda1,
+        jnp.zeros_like(weight),
+        -(new_z - jnp.sign(new_z) * lamda1)
+        / ((beta + jnp.sqrt(new_n)) / lr + wd),
+    )
+    return new_w, new_z, new_n
+
+
+@register("signsgd_update")
+def signsgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+@register("signum_update", mutates=("mom",))
+def signum_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, wd_lh=0.0):
+    """Signum: sign of momentum (reference optimizer_op.cc:69; Bernstein 2018)."""
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mom = momentum * mom - (1.0 - momentum) * (g + wd * weight)
+    new_w = (1.0 - lr * wd_lh) * weight + lr * jnp.sign(new_mom)
+    return new_w, new_mom

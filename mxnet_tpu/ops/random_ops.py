@@ -87,46 +87,91 @@ def sample_multinomial(data, *, shape=(), get_prob=False, dtype="int32", key=Non
     return out
 
 
-def _sample_like(name, base):
-    """Per-element-distribution samplers: params are arrays, one draw each
-    (reference multisample_op.cc _sample_uniform etc.)."""
-
-    if name == "_sample_uniform":
-
-        @register(name)
-        def _s(low, high, *, shape=(), dtype="float32", key=None):
-            ext = tuple(shape) if shape else ()
-            tgt = low.shape + ext
-            u = jax.random.uniform(key, tgt, dtype=_dt(dtype))
-            lo = low.reshape(low.shape + (1,) * len(ext))
-            hi = high.reshape(high.shape + (1,) * len(ext))
-            return lo + u * (hi - lo)
-
-    elif name == "_sample_normal":
-
-        @register(name)
-        def _s(mu, sigma, *, shape=(), dtype="float32", key=None):
-            ext = tuple(shape) if shape else ()
-            tgt = mu.shape + ext
-            z = jax.random.normal(key, tgt, dtype=_dt(dtype))
-            return mu.reshape(mu.shape + (1,) * len(ext)) + z * sigma.reshape(sigma.shape + (1,) * len(ext))
-
-    elif name == "_sample_gamma":
-
-        @register(name)
-        def _s(alpha, beta, *, shape=(), dtype="float32", key=None):
-            ext = tuple(shape) if shape else ()
-            a = alpha.reshape(alpha.shape + (1,) * len(ext))
-            g = jax.random.gamma(key, jnp.broadcast_to(a, alpha.shape + ext), dtype=_dt(dtype))
-            return g * beta.reshape(beta.shape + (1,) * len(ext))
-
-
-for _n in ("_sample_uniform", "_sample_normal", "_sample_gamma"):
-    _sample_like(_n, None)
-
-
 @register("_shuffle", alias=["shuffle"])
 def shuffle(data, *, key=None):
     """Shuffle along first axis (reference src/operator/random/shuffle_op.cc)."""
     perm = jax.random.permutation(key, data.shape[0])
     return jnp.take(data, perm, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# multisample ops: per-row distribution parameters (reference
+# src/operator/random/multisample_op.cc) — each row of the parameter tensors
+# parameterizes an independent draw of ``shape`` samples.
+# ---------------------------------------------------------------------------
+
+
+def _msample_shape(param, shape):
+    shape = tuple(shape) if not isinstance(shape, int) else (shape,)
+    return param.shape + shape
+
+
+@register("_sample_uniform", alias=["sample_uniform"])
+def sample_uniform(low, high, *, shape=(), dtype="float32", key=None):
+    full = _msample_shape(low, shape)
+    u = jax.random.uniform(key, full, dtype=_dt(dtype))
+    nd_extra = len(full) - low.ndim
+    lo = low.reshape(low.shape + (1,) * nd_extra)
+    hi = high.reshape(high.shape + (1,) * nd_extra)
+    return (lo + u * (hi - lo)).astype(_dt(dtype))
+
+
+@register("_sample_normal", alias=["sample_normal"])
+def sample_normal(mu, sigma, *, shape=(), dtype="float32", key=None):
+    full = _msample_shape(mu, shape)
+    z = jax.random.normal(key, full, dtype=_dt(dtype))
+    nd_extra = len(full) - mu.ndim
+    m = mu.reshape(mu.shape + (1,) * nd_extra)
+    s = sigma.reshape(sigma.shape + (1,) * nd_extra)
+    return (m + z * s).astype(_dt(dtype))
+
+
+@register("_sample_gamma", alias=["sample_gamma"])
+def sample_gamma(alpha, beta, *, shape=(), dtype="float32", key=None):
+    full = _msample_shape(alpha, shape)
+    nd_extra = len(full) - alpha.ndim
+    a = alpha.reshape(alpha.shape + (1,) * nd_extra)
+    b = beta.reshape(beta.shape + (1,) * nd_extra)
+    g = jax.random.gamma(key, jnp.broadcast_to(a, full), dtype=_dt(dtype))
+    return (g * b).astype(_dt(dtype))
+
+
+@register("_sample_exponential", alias=["sample_exponential"])
+def sample_exponential(lam, *, shape=(), dtype="float32", key=None):
+    full = _msample_shape(lam, shape)
+    nd_extra = len(full) - lam.ndim
+    l = lam.reshape(lam.shape + (1,) * nd_extra)
+    e = jax.random.exponential(key, full, dtype=_dt(dtype))
+    return (e / l).astype(_dt(dtype))
+
+
+@register("_sample_poisson", alias=["sample_poisson"])
+def sample_poisson(lam, *, shape=(), dtype="float32", key=None):
+    full = _msample_shape(lam, shape)
+    nd_extra = len(full) - lam.ndim
+    l = lam.reshape(lam.shape + (1,) * nd_extra)
+    return jax.random.poisson(key, jnp.broadcast_to(l, full)).astype(_dt(dtype))
+
+
+@register("_sample_negative_binomial", alias=["sample_negative_binomial"])
+def sample_negative_binomial(k, p, *, shape=(), dtype="float32", key=None):
+    full = _msample_shape(k, shape)
+    k1, k2 = jax.random.split(key)
+    nd_extra = len(full) - k.ndim
+    kk = k.reshape(k.shape + (1,) * nd_extra)
+    pp = p.reshape(p.shape + (1,) * nd_extra)
+    lam = jax.random.gamma(k1, jnp.broadcast_to(kk * 1.0, full)) * ((1.0 - pp) / pp)
+    return jax.random.poisson(k2, lam).astype(_dt(dtype))
+
+
+@register("_sample_generalized_negative_binomial", alias=["sample_generalized_negative_binomial"])
+def sample_generalized_negative_binomial(mu, alpha, *, shape=(), dtype="float32", key=None):
+    full = _msample_shape(mu, shape)
+    k1, k2 = jax.random.split(key)
+    nd_extra = len(full) - mu.ndim
+    m = mu.reshape(mu.shape + (1,) * nd_extra)
+    a = jnp.maximum(alpha.reshape(alpha.shape + (1,) * nd_extra), 1e-6)
+    r = 1.0 / a
+    p = r / (r + m)
+    lam = jax.random.gamma(k1, jnp.broadcast_to(r, full)) * ((1.0 - p) / p)
+    return jax.random.poisson(k2, lam).astype(_dt(dtype))

@@ -43,7 +43,7 @@ class OpDef:
     wrap_outputs : if int n > 1, op returns an n-tuple.
     """
 
-    def __init__(self, name, fn, aliases=(), hint=None, aux=(), inputs_fn=None, infer_params=None, aux_update=None):
+    def __init__(self, name, fn, aliases=(), hint=None, aux=(), inputs_fn=None, infer_params=None, aux_update=None, mutates=()):
         self.name = name
         self.fn = fn
         self.aliases = tuple(aliases)
@@ -61,6 +61,10 @@ class OpDef:
         # (the partial shape inference jax.eval_shape can't do; reference
         # infer_graph_attr_pass.cc solves the same problem graph-wide)
         self.infer_params = infer_params
+        # mutates: input arg names updated in place by the eager frontend from
+        # the op's extra outputs (reference optimizer_op.cc mutable inputs:
+        # fn returns (out, *new_values_for_mutates) but presents one output)
+        self.mutates = tuple(mutates)
         sig = inspect.signature(fn)
         self.arg_names = []
         self.attr_names = []
@@ -89,7 +93,7 @@ class OpDef:
         return "OpDef(%s)" % self.name
 
 
-def register(name, alias=(), hint=None, aux=(), inputs_fn=None, infer_params=None, aux_update=None):
+def register(name, alias=(), hint=None, aux=(), inputs_fn=None, infer_params=None, aux_update=None, mutates=()):
     """Decorator registering a pure jax function as a framework operator."""
 
     def _reg(fn):
@@ -102,6 +106,7 @@ def register(name, alias=(), hint=None, aux=(), inputs_fn=None, infer_params=Non
             inputs_fn=inputs_fn,
             infer_params=infer_params,
             aux_update=aux_update,
+            mutates=mutates,
         )
         if name in _REGISTRY:
             raise ValueError("duplicate op registration: %s" % name)

@@ -472,6 +472,8 @@ def _num_outputs_of(opdef, attrs):
     if opdef.name == "RNN":
         # op returns (out, h_final[, c_final]) unconditionally (ops/rnn.py:179-182)
         return 3 if attrs.get("mode", "lstm") == "lstm" else 2
+    if opdef.name in ("_linalg_gelqf", "_linalg_syevd"):
+        return 2
     if opdef.name == "topk":
         return 2 if attrs.get("ret_typ") == "both" else 1
     return 1

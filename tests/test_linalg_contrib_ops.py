@@ -1,0 +1,306 @@
+"""Linalg / contrib / optimizer-update / multisample op tests — mirrors
+reference tests/python/unittest/test_operator.py (test_laop*, test_ctc_loss,
+test_quadratic_function, test_correlation, ...)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)
+
+
+class TestLinalg:
+    def test_potrf_potri(self, rng):
+        A = rng.randn(2, 3, 3).astype(np.float32)
+        spd = np.matmul(A, A.transpose(0, 2, 1)) + 3 * np.eye(3, dtype=np.float32)
+        L = nd.linalg_potrf(nd.array(spd))
+        np.testing.assert_allclose(
+            np.matmul(L.asnumpy(), L.asnumpy().transpose(0, 2, 1)), spd, rtol=1e-4, atol=1e-4
+        )
+        inv = nd.linalg_potri(L)
+        np.testing.assert_allclose(
+            np.matmul(inv.asnumpy(), spd),
+            np.broadcast_to(np.eye(3, dtype=np.float32), (2, 3, 3)), atol=1e-3,
+        )
+
+    def test_gemm_gemm2(self, rng):
+        A = rng.randn(2, 3, 3).astype(np.float32)
+        B = rng.randn(2, 3, 4).astype(np.float32)
+        C = rng.randn(2, 3, 4).astype(np.float32)
+        g = nd.linalg_gemm(nd.array(A), nd.array(B), nd.array(C), alpha=2.0, beta=0.5)
+        np.testing.assert_allclose(g.asnumpy(), 2 * np.matmul(A, B) + 0.5 * C, rtol=1e-4)
+        g2 = nd.linalg_gemm2(nd.array(A), nd.array(B), transpose_a=True)
+        np.testing.assert_allclose(g2.asnumpy(), np.matmul(A.transpose(0, 2, 1), B), rtol=1e-4)
+
+    def test_trsm_trmm(self, rng):
+        A = rng.randn(2, 3, 3).astype(np.float32)
+        spd = np.matmul(A, A.transpose(0, 2, 1)) + 3 * np.eye(3, dtype=np.float32)
+        L = nd.linalg_potrf(nd.array(spd))
+        B = rng.randn(2, 3, 4).astype(np.float32)
+        X = nd.linalg_trsm(L, nd.array(B), alpha=1.5)
+        np.testing.assert_allclose(np.matmul(L.asnumpy(), X.asnumpy()), 1.5 * B, rtol=1e-3, atol=1e-3)
+        B2 = rng.randn(2, 4, 3).astype(np.float32)
+        X2 = nd.linalg_trsm(L, nd.array(B2), rightside=True, alpha=2.0)
+        np.testing.assert_allclose(np.matmul(X2.asnumpy(), L.asnumpy()), 2.0 * B2, rtol=1e-3, atol=1e-3)
+        X3 = nd.linalg_trsm(L, nd.array(B), transpose=True)
+        np.testing.assert_allclose(
+            np.matmul(L.asnumpy().transpose(0, 2, 1), X3.asnumpy()), B, rtol=1e-3, atol=1e-3
+        )
+        tm = nd.linalg_trmm(L, nd.array(B))
+        np.testing.assert_allclose(tm.asnumpy(), np.matmul(np.tril(L.asnumpy()), B), rtol=1e-4)
+
+    def test_sumlogdiag_syrk(self, rng):
+        A = rng.randn(2, 3, 3).astype(np.float32)
+        spd = np.matmul(A, A.transpose(0, 2, 1)) + 3 * np.eye(3, dtype=np.float32)
+        sld = nd.linalg_sumlogdiag(nd.array(spd))
+        np.testing.assert_allclose(
+            sld.asnumpy(), np.log(np.diagonal(spd, axis1=-2, axis2=-1)).sum(-1), rtol=1e-4
+        )
+        sy = nd.linalg_syrk(nd.array(A), alpha=1.0)
+        np.testing.assert_allclose(sy.asnumpy(), np.matmul(A, A.transpose(0, 2, 1)), rtol=1e-4)
+        syt = nd.linalg_syrk(nd.array(A), transpose=True, alpha=0.5)
+        np.testing.assert_allclose(syt.asnumpy(), 0.5 * np.matmul(A.transpose(0, 2, 1), A), rtol=1e-4)
+
+    def test_gelqf_syevd(self, rng):
+        M = rng.randn(3, 5).astype(np.float32)
+        Lq, Q = nd.linalg_gelqf(nd.array(M))
+        np.testing.assert_allclose(np.matmul(Lq.asnumpy(), Q.asnumpy()), M, rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(np.matmul(Q.asnumpy(), Q.asnumpy().T), np.eye(3), atol=1e-4)
+        A = rng.randn(4, 4).astype(np.float32)
+        spd = A @ A.T + 4 * np.eye(4, dtype=np.float32)
+        U, lam = nd.linalg_syevd(nd.array(spd))
+        recon = U.asnumpy().T @ np.diag(lam.asnumpy()) @ U.asnumpy()
+        np.testing.assert_allclose(recon, spd, rtol=1e-3, atol=1e-3)
+
+    def test_gelqf_syevd_symbolic_two_outputs(self, rng):
+        from mxnet_tpu import sym
+
+        s = sym.linalg_gelqf(sym.Variable("A"))
+        assert len(s.list_outputs()) == 2
+        exe = s.simple_bind(A=(3, 5))
+        M = rng.randn(3, 5).astype(np.float32)
+        L, Q = exe.forward(is_train=False, A=nd.array(M))
+        np.testing.assert_allclose(np.matmul(L.asnumpy(), Q.asnumpy()), M, rtol=1e-3, atol=1e-3)
+        s2 = sym.linalg_syevd(sym.Variable("A"))
+        assert len(s2.list_outputs()) == 2
+
+    def test_gemm_grad_flows(self, rng):
+        from mxnet_tpu import autograd
+
+        a = nd.array(rng.randn(3, 3).astype(np.float32))
+        a.attach_grad()
+        with autograd.record():
+            y = nd.linalg_gemm2(a, a)
+            loss = y.sum()
+        loss.backward()
+        assert np.abs(a.grad.asnumpy()).sum() > 0
+
+
+class TestOptimizerUpdateOps:
+    def test_sgd_matches_formula(self, rng):
+        w0 = rng.randn(5).astype(np.float32)
+        g0 = rng.randn(5).astype(np.float32)
+        w = nd.array(w0); g = nd.array(g0)
+        nd.sgd_update(w, g, out=w, lr=0.1, wd=0.01, rescale_grad=0.5)
+        expect = w0 - 0.1 * (0.5 * g0 + 0.01 * w0)
+        np.testing.assert_allclose(w.asnumpy(), expect, rtol=1e-5)
+
+    def test_mutation_semantics(self, rng):
+        w = nd.array(rng.randn(4).astype(np.float32))
+        g = nd.array(rng.randn(4).astype(np.float32))
+        m = nd.zeros((4,)); v = nd.zeros((4,))
+        w0 = w.asnumpy().copy()
+        nd.adam_update(w, g, m, v, out=w, lr=0.1)
+        assert not np.allclose(w.asnumpy(), w0)
+        assert not np.allclose(m.asnumpy(), 0)
+        assert not np.allclose(v.asnumpy(), 0)
+
+    def test_adam_matches_formula(self, rng):
+        w0 = rng.randn(5).astype(np.float32); g0 = rng.randn(5).astype(np.float32)
+        m0 = rng.randn(5).astype(np.float32); v0 = rng.rand(5).astype(np.float32)
+        w = nd.array(w0); g = nd.array(g0); m = nd.array(m0); v = nd.array(v0)
+        nd.adam_update(w, g, m, v, out=w, lr=0.01, beta1=0.9, beta2=0.999, epsilon=1e-8)
+        me = 0.9 * m0 + 0.1 * g0
+        ve = 0.999 * v0 + 0.001 * g0 * g0
+        we = w0 - 0.01 * me / (np.sqrt(ve) + 1e-8)
+        np.testing.assert_allclose(m.asnumpy(), me, rtol=1e-5)
+        np.testing.assert_allclose(v.asnumpy(), ve, rtol=1e-5)
+        np.testing.assert_allclose(w.asnumpy(), we, rtol=1e-5)
+
+    def test_adam_clips_after_wd(self, rng):
+        """Reference AdamUpdate clips (rescale*grad + wd*weight), not just
+        the gradient (optimizer_op-inl.h AdamUpdate)."""
+        w0 = np.full(3, 100.0, np.float32)
+        g0 = np.zeros(3, np.float32)
+        w = nd.array(w0); g = nd.array(g0)
+        m = nd.zeros((3,)); v = nd.zeros((3,))
+        nd.adam_update(w, g, m, v, out=w, lr=0.1, wd=1.0, clip_gradient=1.0)
+        # effective grad = clip(0 + 1.0*100) = 1.0 -> mean = 0.1
+        np.testing.assert_allclose(m.asnumpy(), np.full(3, 0.1), rtol=1e-5)
+
+    def test_all_updates_run(self, rng):
+        w = nd.array(rng.randn(4).astype(np.float32))
+        g = nd.array(rng.randn(4).astype(np.float32))
+        nd.sgd_mom_update(w, g, nd.zeros((4,)), out=w, lr=0.1, momentum=0.9)
+        nd.ftrl_update(w, g, nd.zeros((4,)), nd.zeros((4,)), out=w, lr=0.1)
+        nd.rmsprop_update(w, g, nd.zeros((4,)), out=w, lr=0.01)
+        nd.rmspropalex_update(w, g, nd.zeros((4,)), nd.zeros((4,)), nd.zeros((4,)), out=w, lr=0.01)
+        nd.signsgd_update(w, g, out=w, lr=0.01)
+        nd.signum_update(w, g, nd.zeros((4,)), out=w, lr=0.01, momentum=0.9)
+        nd.ftml_update(w, g, nd.zeros((4,)), nd.zeros((4,)), nd.zeros((4,)), out=w, lr=0.01, t=1)
+        w16 = nd.cast(w, dtype="float16"); w32 = nd.array(w.asnumpy())
+        nd.mp_sgd_update(w16, nd.cast(g, dtype="float16"), w32, out=w16, lr=0.1)
+        assert np.isfinite(w.asnumpy()).all() and np.isfinite(w16.asnumpy()).all()
+
+
+class TestContribOps:
+    def test_fft_ifft(self, rng):
+        x = rng.randn(2, 8).astype(np.float32)
+        f = nd.fft(nd.array(x))
+        fr = np.fft.fft(x, axis=-1)
+        got = f.asnumpy().reshape(2, 8, 2)
+        np.testing.assert_allclose(got[..., 0], fr.real, rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(got[..., 1], fr.imag, rtol=1e-3, atol=1e-3)
+        # cuFFT-style unnormalized inverse: ifft(fft(x)) == x * d
+        back = nd.ifft(f)
+        np.testing.assert_allclose(back.asnumpy(), x * 8, rtol=1e-3, atol=1e-3)
+
+    def test_quadratic_khatri_rao(self, rng):
+        x = rng.randn(2, 8).astype(np.float32)
+        q = nd.quadratic(nd.array(x), a=1.0, b=2.0, c=3.0)
+        np.testing.assert_allclose(q.asnumpy(), x * x + 2 * x + 3, rtol=1e-5)
+        A = np.arange(6).reshape(2, 3).astype(np.float32)
+        B = rng.randn(4, 3).astype(np.float32)
+        kr = nd.khatri_rao(nd.array(A), nd.array(B))
+        expect = np.stack([np.kron(A[:, k], B[:, k]) for k in range(3)], axis=1)
+        np.testing.assert_allclose(kr.asnumpy(), expect, rtol=1e-5)
+
+    def test_count_sketch(self, rng):
+        x = rng.randn(2, 8).astype(np.float32)
+        h = rng.randint(0, 5, (8,)).astype(np.float32)
+        s = rng.choice([-1.0, 1.0], 8).astype(np.float32)
+        cs = nd.count_sketch(nd.array(x), nd.array(h), nd.array(s), out_dim=5)
+        ref = np.zeros((2, 5), np.float32)
+        for i in range(8):
+            ref[:, int(h[i])] += s[i] * x[:, i]
+        np.testing.assert_allclose(cs.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_bilinear_resize(self, rng):
+        img = rng.randn(1, 2, 4, 4).astype(np.float32)
+        bz = nd.BilinearResize2D(nd.array(img), height=8, width=8)
+        assert bz.shape == (1, 2, 8, 8)
+        # align_corners: endpoints preserved
+        np.testing.assert_allclose(bz.asnumpy()[:, :, 0, 0], img[:, :, 0, 0], rtol=1e-5)
+        np.testing.assert_allclose(bz.asnumpy()[:, :, 7, 7], img[:, :, 3, 3], rtol=1e-5)
+
+    def test_div_sqrt_dim_crop(self, rng):
+        x = rng.randn(2, 8).astype(np.float32)
+        np.testing.assert_allclose(nd.div_sqrt_dim(nd.array(x)).asnumpy(), x / np.sqrt(8), rtol=1e-5)
+        img = rng.randn(1, 2, 4, 4).astype(np.float32)
+        cr = nd.Crop(nd.array(img), offset=(1, 1), h_w=(2, 2))
+        np.testing.assert_allclose(cr.asnumpy(), img[:, :, 1:3, 1:3])
+        cc = nd.Crop(nd.array(img), h_w=(2, 2), center_crop=True)
+        np.testing.assert_allclose(cc.asnumpy(), img[:, :, 1:3, 1:3])
+
+    def test_correlation_naive(self, rng):
+        def naive(d1, d2, ks, md, pad):
+            n, c, h, w = d1.shape
+            d1p = np.pad(d1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+            d2p = np.pad(d2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+            kr = (ks - 1) // 2
+            border = md + kr
+            ph, pw = h + 2 * pad, w + 2 * pad
+            th = int(np.ceil((ph - 2 * border) / 1))
+            tw = int(np.ceil((pw - 2 * border) / 1))
+            gr = md
+            gw = 2 * gr + 1
+            out = np.zeros((n, gw * gw, th, tw), np.float32)
+            for b in range(n):
+                for oi, dy in enumerate(range(-gr, gr + 1)):
+                    for oj, dx in enumerate(range(-gr, gr + 1)):
+                        for yi, y in enumerate(range(border, ph - border)):
+                            for xi, x in enumerate(range(border, pw - border)):
+                                acc = 0.0
+                                for ky in range(-kr, kr + 1):
+                                    for kx in range(-kr, kr + 1):
+                                        a = d1p[b, :, y + ky, x + kx]
+                                        bb = d2p[b, :, y + ky + dy, x + kx + dx]
+                                        acc += (a * bb).sum()
+                                out[b, oi * gw + oj, yi, xi] = acc / (ks * ks * c)
+            return out
+
+        d1 = rng.randn(1, 2, 6, 6).astype(np.float32)
+        d2 = rng.randn(1, 2, 6, 6).astype(np.float32)
+        ref = naive(d1, d2, 1, 1, 1)
+        got = nd.Correlation(
+            nd.array(d1), nd.array(d2), kernel_size=1, max_displacement=1, pad_size=1
+        ).asnumpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+
+class TestCTCLoss:
+    def test_matches_torch(self, rng):
+        torch = pytest.importorskip("torch")
+        import torch.nn.functional as F
+
+        T, N, C = 12, 3, 6
+        acts = rng.randn(T, N, C).astype(np.float32)
+        labels = np.array([[1, 2, 3, 0], [2, 2, 0, 0], [5, 4, 3, 2]], np.float32)
+        lab_lens = np.array([3, 2, 4])
+        loss = nd.ctc_loss(nd.array(acts), nd.array(labels)).asnumpy()
+        lp = torch.log_softmax(torch.tensor(acts), dim=-1)
+        tl = F.ctc_loss(
+            lp, torch.tensor(labels.astype(np.int64)),
+            input_lengths=torch.full((N,), T, dtype=torch.long),
+            target_lengths=torch.tensor(lab_lens), blank=0, reduction="none",
+        )
+        np.testing.assert_allclose(loss, tl.numpy(), rtol=1e-3, atol=1e-3)
+
+    def test_lengths_and_symbol(self, rng):
+        from mxnet_tpu import sym
+
+        T, N, C = 10, 2, 5
+        acts = rng.randn(T, N, C).astype(np.float32)
+        labels = np.array([[1, 2, 3], [2, 1, 1]], np.float32)
+        l1 = nd.ctc_loss(
+            nd.array(acts), nd.array(labels),
+            nd.array(np.array([10, 8], np.float32)), nd.array(np.array([2, 3], np.float32)),
+            use_data_lengths=True, use_label_lengths=True,
+        )
+        assert l1.shape == (2,) and np.isfinite(l1.asnumpy()).all()
+        out = sym.ctc_loss(sym.Variable("data"), sym.Variable("label"))
+        exe = out.simple_bind(data=(T, N, C), label=(2, 3))
+        (y,) = exe.forward(is_train=False, data=nd.array(acts), label=nd.array(labels))
+        assert y.shape == (2,)
+
+
+class TestMultisample:
+    def test_sample_uniform_normal(self, rng):
+        low = nd.array(np.array([0.0, 10.0], np.float32))
+        high = nd.array(np.array([1.0, 20.0], np.float32))
+        a = nd.sample_uniform(low, high, shape=(100,)).asnumpy()
+        assert a.shape == (2, 100) and a[0].max() <= 1.0 and a[1].min() >= 10.0
+        sn = nd.sample_normal(
+            nd.array(np.array([0.0, 100.0], np.float32)),
+            nd.array(np.array([1.0, 1.0], np.float32)), shape=(50,),
+        ).asnumpy()
+        assert abs(sn[1].mean() - 100) < 1
+
+    def test_sample_counts(self, rng):
+        sp = nd.sample_poisson(nd.array(np.array([1.0, 50.0], np.float32)), shape=(200,)).asnumpy()
+        assert abs(sp[1].mean() - 50) < 5
+        sg = nd.sample_gamma(
+            nd.array(np.array([2.0, 9.0], np.float32)),
+            nd.array(np.array([1.0, 0.5], np.float32)), shape=(500,),
+        ).asnumpy()
+        assert abs(sg[0].mean() - 2.0) < 0.5 and abs(sg[1].mean() - 4.5) < 0.8
+        se = nd.sample_exponential(nd.array(np.array([1.0, 10.0], np.float32)), shape=(500,)).asnumpy()
+        assert abs(se[0].mean() - 1.0) < 0.3 and abs(se[1].mean() - 0.1) < 0.05
+        snb = nd.sample_negative_binomial(
+            nd.array(np.array([4.0], np.float32)), nd.array(np.array([0.5], np.float32)), shape=(800,)
+        ).asnumpy()
+        assert abs(snb.mean() - 4.0) < 1.0
