@@ -1,0 +1,3 @@
+"""Contrib package — reference ``python/mxnet/contrib/`` (quantization,
+autograd compat, text, onnx, tensorboard)."""
+from . import quantization  # noqa: F401

@@ -244,7 +244,7 @@ def pooling(
         if pool_type == "max":
             return jnp.max(data, axis=ax, keepdims=True)
         if pool_type == "avg":
-            return jnp.mean(data, axis=ax, keepdims=True)
+            return jnp.mean(data, axis=ax, keepdims=True).astype(data.dtype)
         if pool_type == "lp":
             p_ = float(p_value)
             s = jnp.sum(jnp.abs(data.astype(jnp.float32)) ** p_, axis=ax, keepdims=True)
@@ -272,10 +272,15 @@ def pooling(
         strides = (1, 1) + stride
         padding = [(0, 0), (0, 0)] + pads
     if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        # init must be a concrete scalar of the operand dtype: a traced jnp
+        # constant breaks reduce_window's autodiff rule
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            init = np.asarray(-np.inf, data.dtype)[()]
+        else:
+            init = np.asarray(np.iinfo(np.dtype(data.dtype)).min, data.dtype)[()]
         return jax.lax.reduce_window(data, init, jax.lax.max, window, strides, padding)
     if pool_type == "sum":
-        return jax.lax.reduce_window(data, 0.0, jax.lax.add, window, strides, padding)
+        return jax.lax.reduce_window(data, np.asarray(0, data.dtype)[()], jax.lax.add, window, strides, padding)
     if pool_type == "avg":
         summed = jax.lax.reduce_window(
             data.astype(jnp.float32), 0.0, jax.lax.add, window, strides, padding

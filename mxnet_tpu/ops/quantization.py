@@ -1,0 +1,245 @@
+"""INT8 quantization operators — reference ``src/operator/quantization/``
+(quantize-inl.h:53-80, dequantize-inl.h, requantize-inl.h,
+quantized_conv.cc, quantized_fully_connected.cc, quantized_pooling.cc,
+quantized_flatten-inl.h, quantization_utils.h).
+
+TPU-native: int8 operands feed the MXU with int32 accumulation
+(``preferred_element_type=int32``); ranges are tracked as scalar (1,)
+tensors exactly like the reference's min/max companion outputs, so the
+same graph-rewrite pass (contrib/quantization.py) applies.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+from .nn import _tup
+
+INT32_MAX = float(2**31 - 1)
+INT32_MIN = float(-(2**31 - 1))
+
+
+def _qrange(out_type):
+    """(min_limit, max_limit, quantized_range) per reference
+    quantization_utils.h FloatForOneQuantizedLevel."""
+    if out_type == "uint8":
+        return 0.0, 255.0, 255.0
+    if out_type == "int8":
+        return -127.0, 127.0, 127.0
+    raise ValueError("unsupported quantized type %r" % (out_type,))
+
+
+def _maxabs(a, b):
+    return jnp.maximum(jnp.abs(a), jnp.abs(b))
+
+
+@register("_contrib_quantize", alias=["quantize"])
+def quantize(data, min_range, max_range, *, out_type="uint8"):
+    """float32 -> quantized (reference quantize-inl.h:53-80).
+
+    uint8: affine over [min_range, max_range]; int8: symmetric over
+    [-maxabs, maxabs]. Returns (q, min_out, max_out)."""
+    mn = jnp.asarray(min_range, jnp.float32).reshape(()) if np.ndim(min_range) == 0 else min_range.reshape(()).astype(jnp.float32)
+    mx_ = jnp.asarray(max_range, jnp.float32).reshape(()) if np.ndim(max_range) == 0 else max_range.reshape(()).astype(jnp.float32)
+    if out_type == "uint8":
+        lo, hi, qrange = _qrange("uint8")
+        scale = qrange / (mx_ - mn)
+        q = jnp.clip((data - mn) * scale + 0.5, lo, hi).astype(jnp.uint8)
+        return q, mn.reshape(1), mx_.reshape(1)
+    real_range = _maxabs(mn, mx_)
+    scale = 127.0 / real_range
+    q = (jnp.sign(data) * jnp.minimum(jnp.abs(data) * scale + 0.5, 127.0)).astype(jnp.int8)
+    return q, (-real_range).reshape(1), real_range.reshape(1)
+
+
+@register("_contrib_dequantize", alias=["dequantize"])
+def dequantize(data, min_range, max_range, *, out_type="float32"):
+    """quantized -> float32 (reference dequantize-inl.h)."""
+    mn = min_range.reshape(()).astype(jnp.float32)
+    mx_ = max_range.reshape(()).astype(jnp.float32)
+    if data.dtype == jnp.uint8:
+        scale = (mx_ - mn) / 255.0
+        return data.astype(jnp.float32) * scale + mn
+    if data.dtype == jnp.int32:
+        real = _maxabs(mn, mx_)
+        return data.astype(jnp.float32) * (real / INT32_MAX)
+    real = _maxabs(mn, mx_)
+    return data.astype(jnp.float32) * (real / 127.0)
+
+
+@register("_contrib_requantize", alias=["requantize"])
+def requantize(data, min_range, max_range, *, min_calib_range=None, max_calib_range=None):
+    """int32 -> int8 re-quantization (reference requantize-inl.h). Without
+    calibrated ranges the actual min/max of the tensor is used (the
+    reference's runtime path)."""
+    real_in = _maxabs(min_range.reshape(()), max_range.reshape(())).astype(jnp.float32)
+    fval = data.astype(jnp.float32) * (real_in / INT32_MAX)
+    if min_calib_range is not None and max_calib_range is not None:
+        real_out = jnp.maximum(abs(float(min_calib_range)), abs(float(max_calib_range)))
+        real_out = jnp.asarray(real_out, jnp.float32)
+    else:
+        real_out = jnp.max(jnp.abs(fval))
+    scale = 127.0 / real_out
+    q = (jnp.sign(fval) * jnp.minimum(jnp.abs(fval) * scale + 0.5, 127.0)).astype(jnp.int8)
+    return q, (-real_out).reshape(1), real_out.reshape(1)
+
+
+def _float_for_one(min_r, max_r, dtype):
+    """Float value of one quantized level. int8 is symmetric (maxabs/127);
+    uint8 is affine ((max-min)/255) with zero-point min (reference
+    quantization_utils.h + the MKLDNN affine path)."""
+    mn = min_r.reshape(())
+    mx_ = max_r.reshape(())
+    if dtype == jnp.uint8:
+        return (mx_ - mn) / 255.0
+    return _maxabs(mn, mx_) / 127.0
+
+
+def _range_for_mul(a_one, b_one):
+    """int32-accumulator output range (reference quantization_utils.h
+    QuantizationRangeForMultiplication)."""
+    one = (a_one * b_one).astype(jnp.float32)
+    return (one * INT32_MIN).reshape(1), (one * INT32_MAX).reshape(1)
+
+
+def _qconv_inputs(attrs):
+    # bias triple trails so that no_bias only drops TRAILING positionals
+    # (the executor and shape inference pass inputs positionally)
+    base = ["data", "weight", "min_data", "max_data", "min_weight", "max_weight"]
+    if not attrs.get("no_bias"):
+        base += ["bias", "min_bias", "max_bias"]
+    return base
+
+
+def _q_minmax_shapes(attrs):
+    names = ["min_data", "max_data", "min_weight", "max_weight"]
+    if not attrs.get("no_bias"):
+        names += ["min_bias", "max_bias"]
+    return {n: (1,) for n in names}
+
+
+def _qconv_params(attrs, shapes):
+    from .nn import _conv_params
+
+    out = _conv_params(attrs, shapes)
+    out.update(_q_minmax_shapes(attrs))
+    return out
+
+
+def _qfc_params(attrs, shapes):
+    from .nn import _fc_params
+
+    out = _fc_params(attrs, shapes)
+    out.update(_q_minmax_shapes(attrs))
+    return out
+
+
+@register("_contrib_quantized_conv", alias=["quantized_conv"], inputs_fn=_qconv_inputs,
+          infer_params=_qconv_params)
+def quantized_conv(data, weight, min_data=None, max_data=None,
+                   min_weight=None, max_weight=None, bias=None, min_bias=None,
+                   max_bias=None, *, kernel, num_filter, stride=None, pad=None,
+                   dilate=None, no_bias=False, num_group=1, layout="NCHW",
+                   cudnn_off=False, cudnn_tune=None, workspace=1024):
+    """int8 convolution with int32 accumulation (reference quantized_conv.cc).
+    Returns (int32 out, min_out, max_out)."""
+    k = _tup(kernel, 2)
+    assert len(k) == 2, "quantized conv is 2D (reference quantized_conv.cc)"
+    s = _tup(stride, 2)
+    p = _tup(pad if pad is not None else 0, 2)
+    d = _tup(dilate, 2)
+    lhs = data.astype(jnp.int32)
+    rhs = weight.astype(jnp.int32)
+    out = jax.lax.conv_general_dilated(
+        lhs, rhs, window_strides=s, padding=[(pi, pi) for pi in p],
+        rhs_dilation=d, feature_group_count=num_group,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    a_one = _float_for_one(min_data, max_data, data.dtype)
+    w_one = _float_for_one(min_weight, max_weight, weight.dtype)
+    if data.dtype == jnp.uint8:
+        # affine zero-point: x = q*a_one + min_d inside the image but exactly 0
+        # in padding, so the min_d*sum(w) correction is per-position — a mask
+        # convolution over the valid window (XLA folds it; it's weight-only)
+        z = jnp.round(min_data.reshape(()) / a_one).astype(jnp.int32)
+        mask = jnp.ones_like(lhs)
+        win_w = jax.lax.conv_general_dilated(
+            mask, rhs, window_strides=s, padding=[(pi, pi) for pi in p],
+            rhs_dilation=d, feature_group_count=num_group,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+        out = out + z * win_w
+    acc_one = (a_one * w_one).astype(jnp.float32)
+    mn, mx_ = _range_for_mul(a_one, w_one)
+    if bias is not None and not no_bias:
+        # rescale int8 bias into the int32 accumulator's quantization level
+        bias_one = _maxabs(min_bias.reshape(()), max_bias.reshape(())) / 127.0
+        bias32 = jnp.round(bias.astype(jnp.float32) * (bias_one / acc_one)).astype(jnp.int32)
+        out = out + bias32.reshape((1, -1) + (1,) * len(k))
+    return out, mn, mx_
+
+
+@register("_contrib_quantized_fully_connected", alias=["quantized_fully_connected"], inputs_fn=_qconv_inputs,
+          infer_params=_qfc_params)
+def quantized_fully_connected(data, weight, min_data=None, max_data=None,
+                              min_weight=None, max_weight=None, bias=None,
+                              min_bias=None, max_bias=None, *, num_hidden,
+                              no_bias=False, flatten=True):
+    """int8 dense with int32 accumulation (reference
+    quantized_fully_connected.cc). Returns (int32 out, min_out, max_out)."""
+    x = data.reshape(data.shape[0], -1) if flatten else data
+    xq = x.astype(jnp.int32)
+    wq = weight.astype(jnp.int32)
+    out = jax.lax.dot_general(xq, wq, (((x.ndim - 1,), (1,)), ((), ())))
+    a_one = _float_for_one(min_data, max_data, data.dtype)
+    w_one = _float_for_one(min_weight, max_weight, weight.dtype)
+    if data.dtype == jnp.uint8:
+        z = jnp.round(min_data.reshape(()) / a_one).astype(jnp.int32)
+        out = out + z * jnp.sum(wq, axis=1)
+    acc_one = (a_one * w_one).astype(jnp.float32)
+    mn, mx_ = _range_for_mul(a_one, w_one)
+    if bias is not None and not no_bias:
+        bias_one = _maxabs(min_bias.reshape(()), max_bias.reshape(())) / 127.0
+        bias32 = jnp.round(bias.astype(jnp.float32) * (bias_one / acc_one)).astype(jnp.int32)
+        out = out + bias32
+    return out, mn, mx_
+
+
+@register("_contrib_quantized_pooling", alias=["quantized_pooling"])
+def quantized_pooling(data, min_data, max_data, *, kernel=(1, 1), pool_type="max",
+                      stride=None, pad=None, global_pool=False,
+                      pooling_convention="valid", count_include_pad=True,
+                      cudnn_off=False, p_value=2, layout=None):
+    """Pooling on quantized data; range passes through (reference
+    quantized_pooling.cc). max/avg are linear in the quantized encoding
+    (affine for uint8, symmetric for int8), so the float kernel applies
+    verbatim. Returns (q out, min, max)."""
+    from .nn import pooling
+
+    if pool_type not in ("max", "avg"):
+        raise ValueError("unsupported quantized pool_type %r" % pool_type)
+    out = pooling(
+        data, kernel=kernel, pool_type=pool_type, global_pool=global_pool,
+        stride=stride, pad=pad, pooling_convention=pooling_convention,
+        count_include_pad=count_include_pad, p_value=p_value, layout=layout,
+    )
+    return out, min_data, max_data
+
+
+@register("_contrib_quantized_flatten", alias=["quantized_flatten"])
+def quantized_flatten(data, min_data, max_data):
+    """Flatten on quantized data (reference quantized_flatten-inl.h)."""
+    return data.reshape(data.shape[0], -1), min_data, max_data
+
+
+@register("_contrib_quantized_act", alias=["quantized_act"])
+def quantized_act(data, min_data, max_data, *, act_type="relu"):
+    """ReLU on symmetric int8 keeps the range representation (zero stays
+    zero); other activations must be computed in float."""
+    if act_type != "relu":
+        raise ValueError("only relu supported in the quantized domain")
+    if data.dtype == jnp.uint8:
+        raise ValueError("relu on affine uint8 needs the zero point; compute in float")
+    return jnp.maximum(data, 0), min_data, max_data

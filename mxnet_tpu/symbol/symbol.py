@@ -72,6 +72,10 @@ class Symbol:
 
         def visit(s):
             base = s._base()
+            if base.is_group:
+                for inp in base.inputs:
+                    visit(inp)
+                return
             key = base.name
             if key in names:
                 return
@@ -381,9 +385,12 @@ class Symbol:
         arg_names = self.list_arguments()
         aux_names = self.list_auxiliary_states()
         arg_shapes, _, aux_shapes = self.infer_shape(**shapes)
+        dtype_hints = {
+            n.name: n._dtype_hint for n in self._walk() if n.is_var and n._dtype_hint
+        }
         args = {}
         for n, s in zip(arg_names, arg_shapes):
-            dt = (type_dict or {}).get(n, "float32")
+            dt = (type_dict or {}).get(n) or dtype_hints.get(n) or "float32"
             args[n] = nd_zeros(s, ctx=ctx, dtype=dt)
         aux = {}
         for n, s in zip(aux_names, aux_shapes):
@@ -474,6 +481,10 @@ def _num_outputs_of(opdef, attrs):
         return 3 if attrs.get("mode", "lstm") == "lstm" else 2
     if opdef.name in ("_linalg_gelqf", "_linalg_syevd"):
         return 2
+    if opdef.name in ("_contrib_quantize", "_contrib_requantize") or \
+            opdef.name.startswith("_contrib_quantized_"):
+        # (values, min_range, max_range) triples (ops/quantization.py)
+        return 3
     if opdef.name == "topk":
         return 2 if attrs.get("ret_typ") == "both" else 1
     return 1

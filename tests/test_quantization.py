@@ -1,0 +1,195 @@
+"""Quantization tests — mirrors reference tests/python/quantization/
+test_quantization.py (quantize/dequantize/requantize ops, quantized conv/fc,
+quantize_model graph pass with none/naive/entropy calibration)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.contrib.quantization import (
+    quantize_model, _get_optimal_threshold, _quantize_symbol,
+)
+from mxnet_tpu.io import NDArrayIter
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)
+
+
+class TestQuantizeOps:
+    def test_int8_roundtrip(self, rng):
+        x = rng.randn(4, 6).astype(np.float32) * 3
+        q, mn, mx_ = nd.contrib.quantize(
+            nd.array(x), nd.array([x.min()]), nd.array([x.max()]), out_type="int8"
+        )
+        assert q.asnumpy().dtype == np.int8
+        back = nd.contrib.dequantize(q, mn, mx_)
+        assert np.abs(back.asnumpy() - x).max() < np.abs(x).max() / 127 * 1.5
+
+    def test_uint8_roundtrip(self, rng):
+        x = rng.rand(4, 6).astype(np.float32) * 5 + 1
+        q, mn, mx_ = nd.contrib.quantize(
+            nd.array(x), nd.array([x.min()]), nd.array([x.max()]), out_type="uint8"
+        )
+        assert q.asnumpy().dtype == np.uint8
+        back = nd.contrib.dequantize(q, mn, mx_)
+        assert np.abs(back.asnumpy() - x).max() < (x.max() - x.min()) / 255 * 1.5
+
+    def test_requantize_calibrated(self, rng):
+        # int32 values representing floats in [-10, 10]
+        f = rng.randn(8).astype(np.float32) * 3
+        int32_max = float(2**31 - 1)
+        data = (f / 10.0 * int32_max).astype(np.int64).astype(np.int32)
+        q, mn, mx_ = nd.contrib.requantize(
+            nd.array(data.astype(np.float32)).astype("int32"),
+            nd.array([-10.0]), nd.array([10.0]),
+            min_calib_range=-9.0, max_calib_range=9.0,
+        )
+        back = q.asnumpy().astype(np.float32) * 9.0 / 127
+        np.testing.assert_allclose(back, np.clip(f, -9, 9), atol=9.0 / 127 + 1e-3)
+
+    def test_quantized_fc_matches_float(self, rng):
+        x = rng.randn(4, 16).astype(np.float32)
+        w = rng.randn(8, 16).astype(np.float32) * 0.5
+        qd, mnd, mxd = nd.contrib.quantize(nd.array(x), nd.array([x.min()]), nd.array([x.max()]), out_type="int8")
+        qw, mnw, mxw = nd.contrib.quantize(nd.array(w), nd.array([w.min()]), nd.array([w.max()]), out_type="int8")
+        out, omn, omx = nd.contrib.quantized_fully_connected(
+            qd, qw, mnd, mxd, mnw, mxw, num_hidden=8, no_bias=True
+        )
+        assert out.asnumpy().dtype == np.int32
+        fout = nd.contrib.dequantize(out, omn, omx).asnumpy()
+        ref = x @ w.T
+        assert np.abs(fout - ref).max() / np.abs(ref).max() < 0.03
+
+
+class TestKLCalibration:
+    def test_threshold_on_gaussian(self, rng):
+        arr = rng.randn(20000).astype(np.float32)
+        amin, amax, div, th = _get_optimal_threshold(arr)
+        assert 0 < th <= max(abs(amin), abs(amax))
+        assert np.isfinite(div)
+
+    def test_threshold_zero_array(self):
+        assert _get_optimal_threshold(np.zeros(100, np.float32))[3] == 0.0
+
+
+def _small_net():
+    data = sym.Variable("data")
+    c1 = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1), name="conv1")
+    r1 = sym.Activation(c1, act_type="relu", name="relu1")
+    p1 = sym.Pooling(r1, kernel=(2, 2), stride=(2, 2), pool_type="max", name="pool1")
+    fl = sym.Flatten(p1, name="flatten1")
+    return sym.FullyConnected(fl, num_hidden=10, name="fc1")
+
+
+def _params_for(net, rng, shape=(2, 3, 8, 8)):
+    arg_shapes, _, _ = net.infer_shape(data=shape)
+    return {
+        n: nd.array((rng.randn(*s) * 0.2).astype(np.float32))
+        for n, s in zip(net.list_arguments(), arg_shapes) if n != "data"
+    }
+
+
+def _fwd(net, params, X):
+    exe = net.simple_bind(data=X.shape)
+    for k, v in params.items():
+        if k in exe.arg_dict:
+            exe.arg_dict[k][:] = v
+    (out,) = exe.forward(is_train=False, data=nd.array(X))
+    return out.asnumpy(), exe
+
+
+class TestQuantizeModel:
+    @pytest.mark.parametrize("calib_mode", ["none", "naive", "entropy"])
+    def test_quantized_net_close_to_fp32(self, rng, calib_mode):
+        net = _small_net()
+        params = _params_for(net, rng)
+        X = rng.randn(2, 3, 8, 8).astype(np.float32)
+        ref, _ = _fwd(net, params, X)
+        kwargs = {}
+        if calib_mode != "none":
+            kwargs["calib_data"] = NDArrayIter(
+                rng.randn(32, 3, 8, 8).astype(np.float32), batch_size=8
+            )
+        qsym, qargs, _ = quantize_model(net, params, {}, calib_mode=calib_mode, **kwargs)
+        got, qexe = _fwd(qsym, qargs, X)
+        rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert rel < 0.12, (calib_mode, rel)
+        # weights are genuinely int8 in the bound executor
+        assert qexe.arg_dict["conv1_weight_quantize"].dtype in (np.int8, "int8")
+
+    def test_no_bias_conv_and_fc(self, rng):
+        data = sym.Variable("data")
+        c1 = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                             no_bias=True, name="conv1")
+        fl = sym.Flatten(c1, name="fl")
+        net = sym.FullyConnected(fl, num_hidden=6, no_bias=True, name="fc1")
+        params = _params_for(net, rng)
+        X = rng.randn(2, 3, 8, 8).astype(np.float32)
+        ref, _ = _fwd(net, params, X)
+        qsym, qargs, _ = quantize_model(net, params, {}, calib_mode="none")
+        got, _ = _fwd(qsym, qargs, X)
+        rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert rel < 0.1, rel
+
+    def test_uint8_data_zero_point(self, rng):
+        net = _small_net()
+        params = _params_for(net, rng)
+        X = rng.randn(2, 3, 8, 8).astype(np.float32)  # has negative values
+        ref, _ = _fwd(net, params, X)
+        qsym, qargs, _ = quantize_model(
+            net, params, {}, calib_mode="none", quantized_dtype="uint8"
+        )
+        got, _ = _fwd(qsym, qargs, X)
+        rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert rel < 0.12, rel
+
+    def test_multi_output_group_with_calibration(self, rng):
+        data = sym.Variable("data")
+        c1 = sym.Convolution(data, kernel=(3, 3), num_filter=4, pad=(1, 1), name="conv1")
+        c2 = sym.Convolution(c1, kernel=(1, 1), num_filter=4, name="conv2")
+        net = sym.Group([c1, c2])
+        params = _params_for(net, rng)
+        calib = NDArrayIter(rng.randn(16, 3, 8, 8).astype(np.float32), batch_size=8)
+        qsym, qargs, _ = quantize_model(net, params, {}, calib_mode="naive", calib_data=calib)
+        X = rng.randn(2, 3, 8, 8).astype(np.float32)
+        exe = qsym.simple_bind(data=X.shape)
+        for k, v in qargs.items():
+            if k in exe.arg_dict:
+                exe.arg_dict[k][:] = v
+        outs = exe.forward(is_train=False, data=nd.array(X))
+        assert len(outs) == 2
+
+    def test_avg_pool_count_include_pad(self, rng):
+        data = sym.Variable("data")
+        c1 = sym.Convolution(data, kernel=(3, 3), num_filter=4, pad=(1, 1), name="conv1")
+        p1 = sym.Pooling(c1, kernel=(2, 2), stride=(2, 2), pool_type="avg",
+                         count_include_pad=False, pad=(1, 1), name="pool1")
+        net = sym.FullyConnected(sym.Flatten(p1, name="fl"), num_hidden=4, name="fc1")
+        params = _params_for(net, rng)
+        X = rng.randn(2, 3, 8, 8).astype(np.float32)
+        ref, _ = _fwd(net, params, X)
+        qsym, qargs, _ = quantize_model(net, params, {}, calib_mode="none")
+        got, _ = _fwd(qsym, qargs, X)
+        rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert rel < 0.15, rel
+
+    def test_excluded_layer_stays_float(self, rng):
+        net = _small_net()
+        params = _params_for(net, rng)
+        qsym, qargs, _ = quantize_model(
+            net, params, {}, calib_mode="none", excluded_sym_names=["fc1"]
+        )
+        opnames = [n.op.name for n in qsym._walk() if n.op is not None]
+        assert "FullyConnected" in opnames
+        assert "_contrib_quantized_fully_connected" not in opnames
+        assert "_contrib_quantized_conv" in opnames
+
+    def test_calibration_sets_requantize_attrs(self, rng):
+        net = _small_net()
+        params = _params_for(net, rng)
+        calib = NDArrayIter(rng.randn(16, 3, 8, 8).astype(np.float32), batch_size=8)
+        qsym, _, _ = quantize_model(net, params, {}, calib_mode="naive", calib_data=calib)
+        req = [n for n in qsym._walk() if n.op is not None and n.op.name == "_contrib_requantize"]
+        assert req and all("min_calib_range" in n.attrs for n in req)
