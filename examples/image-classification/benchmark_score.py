@@ -1,0 +1,47 @@
+"""Inference throughput over the symbol zoo on synthetic data — parity with
+reference example/image-classification/benchmark_score.py."""
+import argparse
+import logging
+import time
+from importlib import import_module
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def get_symbol(network, num_layers, image_shape):
+    net = import_module("symbols." + network)
+    return net.get_symbol(num_classes=1000, num_layers=num_layers,
+                          image_shape=image_shape)
+
+
+def score(network, num_layers, batch_size, image_shape="3,224,224", repeats=5):
+    sym = get_symbol(network, num_layers, image_shape)
+    shape = (batch_size,) + tuple(int(x) for x in image_shape.split(","))
+    mod = mx.mod.Module(symbol=sym, context=mx.current_context())
+    mod.bind(for_training=False, data_shapes=[("data", shape)])
+    mod.init_params(initializer=mx.init.Xavier())
+    rng = np.random.RandomState(0)
+    batch = mx.io.DataBatch([mx.nd.array(rng.rand(*shape).astype(np.float32))], [])
+    mod.forward(batch, is_train=False)  # compile
+    mod.get_outputs()[0].wait_to_read()
+    tic = time.time()
+    for _ in range(repeats):
+        mod.forward(batch, is_train=False)
+    mod.get_outputs()[0].wait_to_read()
+    return repeats * batch_size / (time.time() - tic)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--network", type=str, default="resnet")
+    parser.add_argument("--num-layers", type=int, default=50)
+    parser.add_argument("--image-shape", type=str, default="3,224,224")
+    parser.add_argument("--batch-sizes", type=str, default="1,32")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    for b in [int(x) for x in args.batch_sizes.split(",")]:
+        speed = score(args.network, args.num_layers, b, args.image_shape)
+        logging.info("network=%s-%d batch=%d %f img/s",
+                     args.network, args.num_layers, b, speed)

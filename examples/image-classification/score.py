@@ -1,0 +1,56 @@
+"""Score a saved checkpoint on a validation .rec — parity with reference
+example/image-classification/score.py."""
+import argparse
+import logging
+import time
+
+import mxnet_tpu as mx
+
+
+def score(model_prefix, epoch, data_val, image_shape, batch_size, rgb_mean,
+          metrics=None, max_num_examples=None, data_nthreads=4):
+    mean = [float(x) for x in rgb_mean.split(",")]
+    shape = tuple(int(x) for x in image_shape.split(","))
+    data = mx.io.ImageRecordIter(
+        path_imgrec=data_val, data_shape=shape, batch_size=batch_size,
+        mean_r=mean[0], mean_g=mean[1], mean_b=mean[2],
+        preprocess_threads=data_nthreads,
+    )
+    sym, arg_params, aux_params = mx.model.load_checkpoint(model_prefix, epoch)
+    mod = mx.mod.Module(symbol=sym, context=mx.current_context())
+    mod.bind(for_training=False, data_shapes=data.provide_data,
+             label_shapes=data.provide_label)
+    mod.set_params(arg_params, aux_params)
+    if metrics is None:
+        metrics = [mx.metric.create("acc"), mx.metric.create("top_k_accuracy", top_k=5)]
+    num = 0
+    tic = time.time()
+    for batch in data:
+        mod.forward(batch, is_train=False)
+        # last batch may be zero-padded: score only the valid rows
+        valid = batch_size - (batch.pad or 0)
+        outs = [o[:valid] for o in mod.get_outputs()]
+        labels = [l[:valid] for l in batch.label]
+        for m in metrics:
+            m.update(labels, outs)
+        num += valid
+        if max_num_examples is not None and num >= max_num_examples:
+            break
+    speed = num / (time.time() - tic)
+    logging.info("Finished with %f images per second", speed)
+    return [m.get() for m in metrics]
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="score a model on a dataset")
+    parser.add_argument("--model-prefix", type=str, required=True)
+    parser.add_argument("--epoch", type=int, required=True)
+    parser.add_argument("--data-val", type=str, required=True)
+    parser.add_argument("--image-shape", type=str, default="3,224,224")
+    parser.add_argument("--rgb-mean", type=str, default="123.68,116.779,103.939")
+    parser.add_argument("--batch-size", type=int, default=32)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    for name, value in score(args.model_prefix, args.epoch, args.data_val,
+                             args.image_shape, args.batch_size, args.rgb_mean):
+        logging.info("%s = %f", name, value)

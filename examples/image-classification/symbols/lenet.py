@@ -1,0 +1,23 @@
+"""LeNet-5 style convnet — parity with reference symbols/lenet.py."""
+from mxnet_tpu import sym
+
+
+def get_symbol(num_classes=10, add_stn=False, **kwargs):
+    data = sym.Variable("data")
+    if add_stn:
+        data = sym.SpatialTransformer(
+            data, sym.GridGenerator(
+                sym.FullyConnected(sym.Flatten(data), num_hidden=6, name="stn_loc"),
+                transform_type="affine", target_shape=(28, 28)),
+            transform_type="bilinear", name="stn")
+    conv1 = sym.Convolution(data, kernel=(5, 5), num_filter=20, name="conv1")
+    tanh1 = sym.Activation(conv1, act_type="tanh")
+    pool1 = sym.Pooling(tanh1, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    conv2 = sym.Convolution(pool1, kernel=(5, 5), num_filter=50, name="conv2")
+    tanh2 = sym.Activation(conv2, act_type="tanh")
+    pool2 = sym.Pooling(tanh2, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    flatten = sym.Flatten(pool2)
+    fc1 = sym.FullyConnected(flatten, num_hidden=500, name="fc1")
+    tanh3 = sym.Activation(fc1, act_type="tanh")
+    fc2 = sym.FullyConnected(tanh3, num_hidden=num_classes, name="fc2")
+    return sym.SoftmaxOutput(fc2, name="softmax")

@@ -1,0 +1,46 @@
+"""Train on CIFAR-10 — parity with reference
+example/image-classification/train_cifar10.py (ResNet-110 recipe, Module API).
+
+No network egress in this environment: point --data-train/--data-val at local
+cifar10_{train,val}.rec files (build with tools/im2rec.py), or pass
+--benchmark 1 for synthetic batches.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+from common import data, fit  # noqa: E402
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train cifar10",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    data.add_data_aug_args(parser)
+    data.set_data_aug_level(parser, 2)
+    parser.set_defaults(
+        network="resnet",
+        num_layers=110,
+        data_train=os.path.join("data", "cifar10_train.rec"),
+        data_val=os.path.join("data", "cifar10_val.rec"),
+        num_classes=10,
+        num_examples=50000,
+        image_shape="3,28,28",
+        pad_size=4,
+        batch_size=128,
+        num_epochs=300,
+        lr=0.05,
+        lr_step_epochs="200,250",
+    )
+    args = parser.parse_args()
+
+    from importlib import import_module
+
+    net = import_module("symbols." + args.network)
+    sym = net.get_symbol(**vars(args))
+
+    fit.fit(args, sym, data.get_rec_iter)

@@ -1,0 +1,43 @@
+"""Train on ImageNet-1K records — parity with reference
+example/image-classification/train_imagenet.py (ResNet-50 recipe).
+
+Point --data-train/--data-val at local .rec files, or --benchmark 1 for
+synthetic throughput runs (the BASELINE.md headline config).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+from common import data, fit  # noqa: E402
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train imagenet-1k",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    data.add_data_aug_args(parser)
+    data.set_data_aug_level(parser, 3)
+    parser.set_defaults(
+        network="resnet",
+        num_layers=50,
+        num_classes=1000,
+        num_examples=1281167,
+        image_shape="3,224,224",
+        min_random_scale=1,
+        batch_size=128,
+        num_epochs=80,
+        lr=0.1,
+        lr_step_epochs="30,60",
+    )
+    args = parser.parse_args()
+
+    from importlib import import_module
+
+    net = import_module("symbols." + args.network)
+    sym = net.get_symbol(**vars(args))
+
+    fit.fit(args, sym, data.get_rec_iter)

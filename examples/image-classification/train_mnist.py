@@ -1,0 +1,63 @@
+"""Train on MNIST — parity with reference
+example/image-classification/train_mnist.py (mlp/lenet over NDArrayIter).
+
+Reads a local `mnist.npz` (--data-path) or generates a deterministic
+synthetic stand-in when absent (zero-egress environment).
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(__file__))
+from common import fit  # noqa: E402
+
+import mxnet_tpu as mx
+
+
+def get_mnist_iter(args, kv):
+    if args.data_path and os.path.exists(args.data_path):
+        with np.load(args.data_path) as f:
+            x_train, y_train = f["x_train"], f["y_train"]
+            x_test, y_test = f["x_test"], f["y_test"]
+        x_train = x_train.reshape(-1, 1, 28, 28).astype(np.float32) / 255
+        x_test = x_test.reshape(-1, 1, 28, 28).astype(np.float32) / 255
+    else:  # synthetic fallback: class-conditioned gaussians, learnable
+        rng = np.random.RandomState(7)
+        n = args.num_examples
+        y_train = rng.randint(0, 10, n)
+        protos = rng.randn(10, 1, 28, 28).astype(np.float32)
+        x_train = protos[y_train] + 0.3 * rng.randn(n, 1, 28, 28).astype(np.float32)
+        y_test = rng.randint(0, 10, n // 5)
+        x_test = protos[y_test] + 0.3 * rng.randn(n // 5, 1, 28, 28).astype(np.float32)
+    train = mx.io.NDArrayIter(x_train, y_train.astype(np.float32),
+                              args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(x_test, y_test.astype(np.float32), args.batch_size)
+    return train, val
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train mnist",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    parser.add_argument("--num-classes", type=int, default=10)
+    parser.add_argument("--num-examples", type=int, default=60000)
+    parser.add_argument("--data-path", type=str, default="data/mnist.npz")
+    fit.add_fit_args(parser)
+    parser.set_defaults(
+        network="mlp",
+        batch_size=64,
+        num_epochs=20,
+        lr=0.05,
+        lr_step_epochs="10",
+    )
+    args = parser.parse_args()
+
+    from importlib import import_module
+
+    net = import_module("symbols." + args.network)
+    sym = net.get_symbol(**vars(args))
+
+    fit.fit(args, sym, get_mnist_iter)

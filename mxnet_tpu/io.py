@@ -12,6 +12,7 @@ from __future__ import annotations
 import collections
 import queue as _queue
 import threading
+import warnings
 
 import numpy as np
 
@@ -413,10 +414,31 @@ class ImageRecordIter(DataIter):
         round_batch=True,
         data_name="data",
         label_name="softmax_label",
+        num_parts=1,
+        part_index=0,
+        pad=0,
+        max_random_scale=1.0,
+        min_random_scale=1.0,
         **kwargs,
     ):
         super().__init__(batch_size)
         from . import _native
+
+        _IGNORED_DEFAULTS = {
+            "max_random_aspect_ratio": 0.0, "max_random_rotate_angle": 0,
+            "max_random_shear_ratio": 0.0, "max_img_size": 0.0, "min_img_size": 0.0,
+            "max_random_h": 0, "max_random_s": 0, "max_random_l": 0,
+            "max_random_contrast": 0.0, "max_random_illumination": 0.0,
+            "fill_value": 255, "inter_method": 1, "resize": -1,
+        }
+        for k, v in kwargs.items():
+            if k in _IGNORED_DEFAULTS:
+                if v != _IGNORED_DEFAULTS[k]:
+                    warnings.warn(
+                        "ImageRecordIter: augmentation %s=%r is not implemented "
+                        "in this data plane yet; it will be IGNORED" % (k, v))
+            else:
+                warnings.warn("ImageRecordIter: unknown argument %s=%r ignored" % (k, v))
 
         self.data_shape = tuple(data_shape)  # (C, H, W)
         assert len(self.data_shape) == 3, "data_shape must be (channels, height, width)"
@@ -427,6 +449,22 @@ class ImageRecordIter(DataIter):
         self._mean = np.array([mean_r, mean_g, mean_b], dtype=np.float32)
         self._std = np.array([std_r, std_g, std_b], dtype=np.float32)
         self._lib = _native.lib()
+        # multi-worker sharding (reference kNumPart/kPartIndex in
+        # iter_image_recordio_2.cc): worker i keeps every num_parts-th record,
+        # truncated so every part has the SAME length (unequal parts deadlock
+        # dist_sync collectives at the epoch tail). The native loader does not
+        # partition / pixel-pad / scale-augment yet; those modes use the
+        # python record path.
+        self._num_parts = int(num_parts)
+        self._part_index = int(part_index)
+        if not 0 <= self._part_index < self._num_parts:
+            raise ValueError("part_index %d out of range for num_parts %d"
+                             % (self._part_index, self._num_parts))
+        self._pad_px = int(pad)
+        self._max_scale = float(max_random_scale)
+        self._min_scale = float(min_random_scale)
+        if self._num_parts > 1 or self._pad_px > 0 or self._max_scale != 1.0 or self._min_scale != 1.0:
+            self._lib = None
         self._handle = None
         c, h, w = self.data_shape
         if self._lib is not None:
@@ -455,13 +493,20 @@ class ImageRecordIter(DataIter):
 
             self._records = []
             rec = MXRecordIO(path_imgrec, "r")
+            i = 0
             while True:
                 item = rec.read()
                 if item is None:
                     break
-                self._records.append(item)
+                # filter while reading: residency stays at ~1/num_parts
+                if i % self._num_parts == self._part_index:
+                    self._records.append(item)
+                i += 1
             rec.close()
             self._unpack_img = unpack_img
+            if self._num_parts > 1:
+                equal = i // self._num_parts  # same length on every worker
+                self._records = self._records[:equal]
             self._num = len(self._records)
             self._order = np.arange(self._num)
             self._shuffle = shuffle
@@ -517,6 +562,18 @@ class ImageRecordIter(DataIter):
             header, img = self._unpack_img(self._records[self._order[self._cursor + i]])
             if img.ndim == 2:
                 img = np.stack([img] * c, axis=-1)
+            if self._max_scale != 1.0 or self._min_scale != 1.0:
+                # random isotropic rescale before cropping (reference
+                # image_aug_default.cc max/min_random_scale)
+                from PIL import Image
+
+                sc = self._rng.uniform(self._min_scale, self._max_scale)
+                nh = max(h, int(round(img.shape[0] * sc)))
+                nw = max(w, int(round(img.shape[1] * sc)))
+                img = np.asarray(Image.fromarray(img).resize((nw, nh)))
+            if self._pad_px > 0:
+                pp = self._pad_px
+                img = np.pad(img, ((pp, pp), (pp, pp), (0, 0)), mode="constant")
             if self._rand_crop and img.shape[0] > h and img.shape[1] > w:
                 oy = self._rng.randint(0, img.shape[0] - h + 1)
                 ox = self._rng.randint(0, img.shape[1] - w + 1)

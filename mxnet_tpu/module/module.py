@@ -266,7 +266,13 @@ class Module(BaseModule):
             kvstore, 1, {n: self._exec.arg_dict[n] for n in self._param_names}
         )
         if isinstance(optimizer, str):
+            # loss-op backwards emit per-sample gradients; normalize by the
+            # global batch like the reference (module.py:497 rescale_grad)
+            batch_size = self._data_shapes[0].shape[0]
+            if kv and "dist" in kv.type:
+                batch_size *= kv.num_workers
             optimizer_params = dict(optimizer_params or {})
+            optimizer_params.setdefault("rescale_grad", 1.0 / batch_size)
             optimizer = opt_mod.create(optimizer, **optimizer_params)
         optimizer.idx2name = {i: n for i, n in enumerate(self._param_names)}
         if hasattr(self._symbol, "attr_dict"):

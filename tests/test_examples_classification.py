@@ -1,0 +1,97 @@
+"""Image-classification example tests — symbol zoo builds/infers, the shared
+fit harness trains (mirrors reference tests/python/train + example configs)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+EXDIR = os.path.join(os.path.dirname(__file__), "..", "examples", "image-classification")
+sys.path.insert(0, os.path.abspath(EXDIR))
+
+
+class TestSymbols:
+    @pytest.mark.parametrize("depth,img,expect_bottleneck", [
+        (20, 28, False), (110, 28, False), (164, 28, True),
+        (18, 224, False), (50, 224, True),
+    ])
+    def test_resnet_shapes(self, depth, img, expect_bottleneck):
+        from symbols import resnet
+
+        s = resnet.get_symbol(num_classes=10, num_layers=depth, image_shape="3,%d,%d" % (img, img))
+        _, out, _ = s.infer_shape(data=(2, 3, img, img), softmax_label=(2,))
+        assert out[0] == (2, 10)
+
+    def test_other_symbols(self):
+        from symbols import mlp, lenet, alexnet, vgg
+
+        s = mlp.get_symbol(num_classes=10)
+        _, out, _ = s.infer_shape(data=(2, 1, 28, 28), softmax_label=(2,))
+        assert out[0] == (2, 10)
+        s = lenet.get_symbol(num_classes=10)
+        _, out, _ = s.infer_shape(data=(2, 1, 28, 28), softmax_label=(2,))
+        assert out[0] == (2, 10)
+        s = alexnet.get_symbol(num_classes=1000)
+        _, out, _ = s.infer_shape(data=(1, 3, 224, 224), softmax_label=(1,))
+        assert out[0] == (1, 1000)
+        s = vgg.get_symbol(num_classes=1000, num_layers=11, batch_norm=True)
+        _, out, _ = s.infer_shape(data=(1, 3, 224, 224), softmax_label=(1,))
+        assert out[0] == (1, 1000)
+
+
+class TestFitHarness:
+    def test_mnist_mlp_sgd_learns(self, tmp_path):
+        """End-to-end: synthetic MNIST + mlp + sgd via the example CLI path."""
+        import argparse
+        import train_mnist
+        from common import fit
+
+        parser = argparse.ArgumentParser()
+        parser.add_argument("--num-classes", type=int, default=10)
+        parser.add_argument("--num-examples", type=int, default=1000)
+        parser.add_argument("--data-path", type=str, default=str(tmp_path / "none.npz"))
+        fit.add_fit_args(parser)
+        args = parser.parse_args([
+            "--network", "mlp", "--batch-size", "50", "--num-epochs", "2",
+            "--lr", "0.1", "--disp-batches", "100",
+            "--model-prefix", str(tmp_path / "mnist"),
+        ])
+        from symbols import mlp
+
+        sym = mlp.get_symbol(num_classes=10)
+        model = fit.fit(args, sym, train_mnist.get_mnist_iter)
+        train, val = train_mnist.get_mnist_iter(args, None)
+        metric = mx.metric.Accuracy()
+        model.score(val, metric)
+        assert metric.get()[1] > 0.9, metric.get()
+        # checkpoint written by epoch-end callback
+        assert os.path.exists(str(tmp_path / "mnist-0002.params"))
+
+    def test_resnet20_synthetic_step(self):
+        """ResNet-20 CIFAR shape runs a couple of fit batches (benchmark path)."""
+        import argparse
+        from common import data, fit
+        from symbols import resnet
+
+        parser = argparse.ArgumentParser()
+        fit.add_fit_args(parser)
+        data.add_data_args(parser)
+        data.add_data_aug_args(parser)
+        args = parser.parse_args([
+            "--benchmark", "1", "--num-classes", "10", "--num-layers", "20",
+            "--image-shape", "3,28,28", "--batch-size", "4", "--num-epochs", "1",
+            "--num-examples", "200", "--lr", "0.05", "--disp-batches", "1000",
+        ])
+        sym = resnet.get_symbol(num_classes=10, num_layers=20, image_shape="3,28,28")
+
+        def tiny_loader(a, kv):
+            train, _ = data.get_rec_iter(a, kv)
+            train.max_iter = 3  # keep the smoke run short
+            return train, None
+
+        model = fit.fit(args, sym, tiny_loader)
+        assert model is not None

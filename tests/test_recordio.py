@@ -213,3 +213,59 @@ def test_im2rec_tool(tmp_path):
     )
     labels = next(iter(it)).label[0].asnumpy()
     assert sorted(labels.tolist()) == [0.0, 0.0, 0.0, 1.0, 1.0, 1.0]
+
+
+class TestImageRecordIterSharding:
+    def _make_rec(self, tmp_path, n=11):
+        import numpy as np
+        from mxnet_tpu import recordio
+
+        fname = str(tmp_path / "shard.rec")
+        rec = recordio.MXRecordIO(fname, "w")
+        for i in range(n):
+            img = np.full((8, 8, 3), i * 20, dtype=np.uint8)
+            header = recordio.IRHeader(0, float(i % 3), i, 0)
+            rec.write(recordio.pack_img(header, img, quality=95))
+        rec.close()
+        return fname
+
+    def test_equal_parts_and_validation(self, tmp_path):
+        import numpy as np
+        import pytest as _pytest
+        import mxnet_tpu as mx
+
+        fname = self._make_rec(tmp_path, n=11)
+        # 11 records, 2 parts -> both workers truncated to 5
+        its = [
+            mx.io.ImageRecordIter(
+                path_imgrec=fname, data_shape=(3, 8, 8), batch_size=2,
+                num_parts=2, part_index=i)
+            for i in range(2)
+        ]
+        assert len(its[0]) == len(its[1]) == 5
+        with _pytest.raises(ValueError):
+            mx.io.ImageRecordIter(path_imgrec=fname, data_shape=(3, 8, 8),
+                                  batch_size=2, num_parts=2, part_index=2)
+
+    def test_pad_and_scale_python_path(self, tmp_path):
+        import numpy as np
+        import mxnet_tpu as mx
+
+        fname = self._make_rec(tmp_path, n=4)
+        it = mx.io.ImageRecordIter(
+            path_imgrec=fname, data_shape=(3, 8, 8), batch_size=2,
+            pad=2, rand_crop=True, max_random_scale=1.2, min_random_scale=0.9)
+        batch = next(iter(it))
+        assert batch.data[0].shape == (2, 3, 8, 8)
+
+    def test_unknown_aug_warns(self, tmp_path):
+        import warnings as _w
+        import mxnet_tpu as mx
+
+        fname = self._make_rec(tmp_path, n=4)
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            mx.io.ImageRecordIter(
+                path_imgrec=fname, data_shape=(3, 8, 8), batch_size=2,
+                max_random_rotate_angle=10)
+            assert any("IGNORED" in str(x.message) for x in rec)
