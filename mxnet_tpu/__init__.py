@@ -58,6 +58,7 @@ def __getattr__(name):
         "visualization": ".visualization",
         "contrib": ".contrib",
         "engine": ".engine",
+        "rtc": ".rtc",
     }
     if name in lazy:
         try:

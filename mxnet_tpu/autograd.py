@@ -182,6 +182,14 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     var_list = _collect_live_marked()
     if not var_list:
         raise ValueError("There are no variables attached with gradients (attach_grad).")
+    # only variables that PARTICIPATE in this graph get gradients written;
+    # stale marked vars from earlier graphs keep their buffers untouched
+    # (reference: only nodes in the backward graph receive kWriteTo)
+    used = {id(i) for e in tape for i in e.inputs if i is not None}
+    used.update(id(h) for h in heads)
+    var_list = [v for v in var_list if id(v) in used]
+    if not var_list:
+        raise ValueError("None of the attached variables participate in the recorded graph.")
     f = _replay(tape, heads, var_list)
     var_vals = [v._data for v in var_list]
     outs, vjp_fn = jax.vjp(f, var_vals)
@@ -196,6 +204,10 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     for v, g in zip(var_list, grads):
         if v._grad_req == "add" and v.grad is not None:
             v.grad._rebind(v.grad._data + g)
+        elif v.grad is not None:
+            # write INTO the marked buffer (reference kWriteTo): callers
+            # holding the gradient array (mark_variables) see the update
+            v.grad._rebind(g)
         else:
             v.grad = _wrap(g)
     if not retain_graph:

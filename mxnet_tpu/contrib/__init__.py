@@ -1,3 +1,7 @@
 """Contrib package — reference ``python/mxnet/contrib/`` (quantization,
 autograd compat, text, onnx, tensorboard)."""
 from . import quantization  # noqa: F401
+from . import autograd  # noqa: F401
+from . import text  # noqa: F401
+from . import tensorboard  # noqa: F401
+from . import onnx  # noqa: F401

@@ -1,0 +1,96 @@
+"""Legacy autograd API — reference ``python/mxnet/contrib/autograd.py``
+(train_section :74, test_section :88, mark_variables :102, backward :123,
+grad_and_loss :163, grad :195). Thin adapters over mxnet_tpu.autograd."""
+from __future__ import annotations
+
+import functools
+
+from .. import autograd as _ag
+from .. import ndarray as nd
+
+__all__ = ["set_is_training", "train_section", "test_section",
+           "mark_variables", "backward", "compute_gradient",
+           "grad_and_loss", "grad"]
+
+
+def set_is_training(is_train):
+    """Legacy global training-mode toggle (reference :32)."""
+    prev = _ag.is_training()
+    _ag.set_training(is_train)
+    _ag.set_recording(is_train)
+    return prev
+
+
+class TrainingStateScope:
+    """(reference :54)"""
+
+    def __init__(self, enter_state):
+        self._enter_state = enter_state
+        self._prev_record = None
+        self._prev_train = None
+
+    def __enter__(self):
+        self._prev_record = _ag.set_recording(True)
+        self._prev_train = _ag.set_training(self._enter_state)
+
+    def __exit__(self, ptype, value, trace):
+        _ag.set_recording(self._prev_record)
+        _ag.set_training(self._prev_train)
+
+
+def train_section():
+    """Scope: computation taped and in training mode (reference :74)."""
+    return TrainingStateScope(True)
+
+
+def test_section():
+    """Scope: taped but inference mode (reference :88)."""
+    return TrainingStateScope(False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """(reference :102)"""
+    _ag.mark_variables(variables, gradients, grad_reqs)
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    """(reference :123)"""
+    _ag.backward(outputs, head_grads=out_grads, retain_graph=retain_graph)
+
+
+def compute_gradient(outputs):
+    """(reference :158)"""
+    backward(outputs)
+
+
+def grad_and_loss(func, argnum=None):
+    """Returns fn computing both gradient of *func* and its loss
+    (reference :163)."""
+
+    @functools.wraps(func)
+    def wrapped(*args):
+        variables = list(args)
+        if argnum is not None:
+            argnums = [argnum] if isinstance(argnum, int) else list(argnum)
+            variables = [args[i] for i in argnums]
+        for x in variables:
+            assert isinstance(x, nd.NDArray), "type of autograd input should be NDArray."
+        grads = [nd.zeros_like(x) for x in variables]
+        mark_variables(variables, grads)
+        with train_section():
+            outputs = func(*args)
+        compute_gradient([outputs] if isinstance(outputs, nd.NDArray) else outputs)
+        return grads, outputs
+
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """Returns fn computing gradient of *func* (reference :195)."""
+    grad_with_loss_func = grad_and_loss(func, argnum)
+
+    @functools.wraps(grad_with_loss_func)
+    def wrapped(*args):
+        return grad_with_loss_func(*args)[0]
+
+    return wrapped

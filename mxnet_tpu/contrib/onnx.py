@@ -1,0 +1,20 @@
+"""ONNX import — reference ``python/mxnet/contrib/onnx/`` (import_model).
+
+The `onnx` package is not available in this environment; the API surface is
+kept so callers get an actionable error instead of an AttributeError."""
+from __future__ import annotations
+
+
+def import_model(model_file):
+    """Imports an ONNX model file as (sym, arg_params, aux_params)
+    (reference contrib/onnx/_import/import_model.py)."""
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "ONNX support requires the `onnx` package, which is not installed "
+            "in this environment. Convert the model offline or install onnx."
+        ) from e
+    raise NotImplementedError(
+        "ONNX graph translation to mxnet_tpu symbols is not implemented yet; "
+        "file an issue with the opset you need.")

@@ -1,0 +1,48 @@
+"""TensorBoard logging callback — reference
+``python/mxnet/contrib/tensorboard.py:25`` (LogMetricsCallback).
+
+The `tensorboard` package is optional; construction fails with a clear
+message when it (or an equivalent SummaryWriter provider) is absent.
+"""
+from __future__ import annotations
+
+
+class LogMetricsCallback:
+    """Log training speedometer metrics to TensorBoard (reference :25).
+
+    Usage mirrors the reference::
+
+        lm = LogMetricsCallback('logs/train')
+        mod.fit(..., batch_end_callback=[lm])
+    """
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        try:
+            from tensorboard import SummaryWriter  # 2018-era package layout
+
+            self.summary_writer = SummaryWriter(logging_dir)
+        except ImportError:
+            try:  # modern providers expose the same writer API
+                from torch.utils.tensorboard import SummaryWriter
+
+                self.summary_writer = SummaryWriter(logging_dir)
+            except ImportError:
+                raise ImportError(
+                    "LogMetricsCallback requires a SummaryWriter provider "
+                    "(`tensorboard` or `torch.utils.tensorboard`). "
+                    "Install one or use mx.callback.Speedometer for console logs.")
+
+    def __call__(self, param):
+        """Callback to log metrics at batch end."""
+        if param.eval_metric is None:
+            return
+        name_value = param.eval_metric.get_name_value()
+        self.step += 1
+        for name, value in name_value:
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            # explicit global_step: torch's writer defaults to step 0,
+            # which would overwrite every point
+            self.summary_writer.add_scalar(name, value, self.step)

@@ -1,0 +1,256 @@
+"""Token embeddings — reference ``python/mxnet/contrib/text/embedding.py``
+(registry :39, _TokenEmbedding :132, GloVe :468, FastText :558,
+CustomEmbedding :658, CompositeEmbedding :719).
+
+Zero-egress environment: the pretrained GloVe/FastText downloads are
+unavailable; those classes load from a LOCAL file path via
+``pretrained_file_path`` (same text format), and ``CustomEmbedding`` is the
+primary entry point.
+"""
+from __future__ import annotations
+
+import io
+import logging
+import os
+
+import numpy as np
+
+from ... import ndarray as nd
+from .vocab import Vocabulary
+
+_REGISTRY = {}
+
+
+def register(embedding_cls):
+    """Registers a new token embedding class (reference embedding.py:39)."""
+    _REGISTRY[embedding_cls.__name__.lower()] = embedding_cls
+    return embedding_cls
+
+
+def create(embedding_name, **kwargs):
+    """Creates a registered embedding by name (reference :62)."""
+    name = embedding_name.lower()
+    if name not in _REGISTRY:
+        raise KeyError(
+            "Cannot find `embedding_name` %s. Use get_pretrained_file_names()."
+            % embedding_name)
+    return _REGISTRY[name](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Names of registered embeddings / their known files (reference :89)."""
+    if embedding_name is not None:
+        cls = _REGISTRY.get(embedding_name.lower())
+        if cls is None:
+            raise KeyError("Cannot find `embedding_name` %s" % embedding_name)
+        return list(getattr(cls, "pretrained_file_names", []))
+    return {name: list(getattr(cls, "pretrained_file_names", []))
+            for name, cls in _REGISTRY.items()}
+
+
+class _TokenEmbedding(Vocabulary):
+    """Base embedding: token index + idx_to_vec matrix (reference :132)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    def _load_embedding(self, pretrained_file_path, elem_delim,
+                        init_unknown_vec, encoding="utf8"):
+        """Parses 'token v0 v1 ...' lines into the index + matrix
+        (reference :231)."""
+        pretrained_file_path = os.path.expanduser(pretrained_file_path)
+        if not os.path.isfile(pretrained_file_path):
+            raise ValueError(
+                "`pretrained_file_path` must be a valid path to the "
+                "pre-trained token embedding file (downloads are unavailable "
+                "in this environment): %s" % pretrained_file_path)
+        all_elems = []
+        tokens = set()
+        loaded_unknown_vec = None
+        with io.open(pretrained_file_path, "r", encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                elems = line.rstrip().split(elem_delim)
+                assert len(elems) > 1, (
+                    "line %d in %s: unexpected data format." % (line_num, pretrained_file_path))
+                token, elems = elems[0], [float(i) for i in elems[1:]]
+                if token == self.unknown_token and loaded_unknown_vec is None:
+                    loaded_unknown_vec = elems
+                elif token in tokens:
+                    logging.warning("line %d in %s: duplicate embedding found for token %s. "
+                                    "Skipped.", line_num, pretrained_file_path, token)
+                elif len(elems) == 1:
+                    logging.warning("line %d in %s: token %s with 1-dimensional vector %s; "
+                                    "likely a header and skipped.",
+                                    line_num, pretrained_file_path, token, elems)
+                else:
+                    if self._vec_len == 0:
+                        self._vec_len = len(elems)
+                    else:
+                        assert len(elems) == self._vec_len, (
+                            "line %d in %s: found vector of inconsistent dimension for token "
+                            "%s. expected: %d, found: %d"
+                            % (line_num, pretrained_file_path, token, self._vec_len, len(elems)))
+                    all_elems.extend(elems)
+                    self._idx_to_token.append(token)
+                    self._token_to_idx[token] = len(self._idx_to_token) - 1
+                    tokens.add(token)
+        mat = np.zeros((len(self._idx_to_token), self._vec_len), dtype=np.float32)
+        # rows before `base` are unknown + reserved tokens, not file rows
+        base = len(self._idx_to_token) - (len(all_elems) // self._vec_len if self._vec_len else 0)
+        if self._vec_len:
+            mat[base:] = np.asarray(all_elems, dtype=np.float32).reshape(-1, self._vec_len)
+        if loaded_unknown_vec is None:
+            v = init_unknown_vec(shape=self._vec_len)
+            unk = v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+        else:
+            unk = np.asarray(loaded_unknown_vec, dtype=np.float32)
+        mat[:base] = unk  # unknown + reserved rows share the unknown init
+        self._idx_to_vec = nd.array(mat)
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Vectors for token(s) (reference :365)."""
+        to_reduce = False
+        if not isinstance(tokens, list):
+            tokens = [tokens]
+            to_reduce = True
+        if not lower_case_backup:
+            indices = [self.token_to_idx.get(t, 0) for t in tokens]
+        else:
+            indices = [
+                self.token_to_idx[t] if t in self.token_to_idx
+                else self.token_to_idx.get(t.lower(), 0) for t in tokens
+            ]
+        vecs = nd.take(self.idx_to_vec, nd.array(np.asarray(indices, np.int32)))
+        return vecs[0] if to_reduce else vecs
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Overwrite vectors of known tokens (reference :404)."""
+        assert self.idx_to_vec is not None, "The property `idx_to_vec` has not been properly set."
+        if not isinstance(tokens, list) or len(tokens) == 1:
+            assert not isinstance(new_vectors, list), \
+                "`new_vectors` must be an NDArray for one token."
+            if not isinstance(tokens, list):
+                tokens = [tokens]
+            new_vectors = new_vectors.reshape((1, -1))
+        indices = []
+        for token in tokens:
+            if token in self.token_to_idx:
+                indices.append(self.token_to_idx[token])
+            else:
+                raise ValueError("Token %s is unknown; to update the unknown-token vector, "
+                                 "use `%s` explicitly." % (token, self.unknown_token))
+        mat = np.array(self.idx_to_vec.asnumpy())  # asnumpy view is read-only
+        mat[np.asarray(indices)] = new_vectors.asnumpy()
+        self._idx_to_vec = nd.array(mat)
+
+    def _index_tokens_from_vocabulary(self, vocabulary):
+        self._token_to_idx = vocabulary.token_to_idx.copy() \
+            if vocabulary.token_to_idx is not None else None
+        self._idx_to_token = vocabulary.idx_to_token[:] \
+            if vocabulary.idx_to_token is not None else None
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = vocabulary.reserved_tokens[:] \
+            if vocabulary.reserved_tokens is not None else None
+
+    def _set_idx_to_vec_by_embeddings(self, token_embeddings, vocab_len, vocab_idx_to_token):
+        """Lay out this vocabulary's matrix from source embeddings
+        (reference :313)."""
+        new_vec_len = sum(e.vec_len for e in token_embeddings)
+        rows = np.zeros((vocab_len, new_vec_len), dtype=np.float32)
+        col_start = 0
+        for emb in token_embeddings:
+            col_end = col_start + emb.vec_len
+            rows[:, col_start:col_end] = emb.get_vecs_by_tokens(vocab_idx_to_token).asnumpy()
+            col_start = col_end
+        self._vec_len = new_vec_len
+        self._idx_to_vec = nd.array(rows)
+
+    def _build_embedding_for_vocabulary(self, vocabulary):
+        """Re-key this embedding onto *vocabulary*: vectors are gathered with
+        the CURRENT (file-order) mapping FIRST, then the index is swapped
+        (reference :344 does exactly this order — reversing it reads wrong
+        rows)."""
+        if vocabulary is None:
+            return
+        assert isinstance(vocabulary, Vocabulary), \
+            "`vocabulary` must be an instance of Vocabulary."
+        new_vecs = self.get_vecs_by_tokens(vocabulary.idx_to_token).asnumpy()
+        self._index_tokens_from_vocabulary(vocabulary)
+        self._idx_to_vec = nd.array(new_vecs)
+
+
+@register
+class CustomEmbedding(_TokenEmbedding):
+    """Embedding from a user file of 'token<delim>v0<delim>v1...' lines
+    (reference embedding.py:658)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ", encoding="utf8",
+                 init_unknown_vec=nd.zeros, vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path, elem_delim, init_unknown_vec, encoding)
+        self._build_embedding_for_vocabulary(vocabulary)
+
+
+@register
+class GloVe(_TokenEmbedding):
+    """GloVe text format (reference :468). Provide the local file via
+    ``pretrained_file_path`` — downloads are unavailable here."""
+
+    pretrained_file_names = [
+        "glove.42B.300d.txt", "glove.6B.50d.txt", "glove.6B.100d.txt",
+        "glove.6B.200d.txt", "glove.6B.300d.txt", "glove.840B.300d.txt",
+        "glove.twitter.27B.25d.txt", "glove.twitter.27B.50d.txt",
+        "glove.twitter.27B.100d.txt", "glove.twitter.27B.200d.txt",
+    ]
+
+    def __init__(self, pretrained_file_name="glove.840B.300d.txt",
+                 embedding_root=None, init_unknown_vec=nd.zeros,
+                 vocabulary=None, pretrained_file_path=None, **kwargs):
+        super().__init__(**kwargs)
+        if pretrained_file_path is None:
+            root = embedding_root or os.path.join("~", ".mxnet", "embeddings", "glove")
+            pretrained_file_path = os.path.join(root, pretrained_file_name)
+        self._load_embedding(pretrained_file_path, " ", init_unknown_vec)
+        self._build_embedding_for_vocabulary(vocabulary)
+
+
+@register
+class FastText(_TokenEmbedding):
+    """fastText .vec text format (reference :558). Provide the local file via
+    ``pretrained_file_path`` — downloads are unavailable here."""
+
+    pretrained_file_names = ["wiki.en.vec", "wiki.simple.vec", "wiki.zh.vec"]
+
+    def __init__(self, pretrained_file_name="wiki.simple.vec",
+                 embedding_root=None, init_unknown_vec=nd.zeros,
+                 vocabulary=None, pretrained_file_path=None, **kwargs):
+        super().__init__(**kwargs)
+        if pretrained_file_path is None:
+            root = embedding_root or os.path.join("~", ".mxnet", "embeddings", "fasttext")
+            pretrained_file_path = os.path.join(root, pretrained_file_name)
+        self._load_embedding(pretrained_file_path, " ", init_unknown_vec)
+        self._build_embedding_for_vocabulary(vocabulary)
+
+
+class CompositeEmbedding(_TokenEmbedding):
+    """Concatenation of several embeddings over one vocabulary
+    (reference :719)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(token_embeddings, list):
+            token_embeddings = [token_embeddings]
+        self._index_tokens_from_vocabulary(vocabulary)
+        self._vec_len = 0
+        self._idx_to_vec = None
+        self._set_idx_to_vec_by_embeddings(
+            token_embeddings, len(self), self.idx_to_token)

@@ -1,0 +1,23 @@
+"""Text tokenization helpers — reference
+``python/mxnet/contrib/text/utils.py`` (count_tokens_from_str :~30)."""
+from __future__ import annotations
+
+import collections
+import re
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Counts tokens in a (possibly multi-line) string (reference utils.py).
+
+    Splits on token_delim/seq_delim, optionally lowercases, updates or
+    creates a collections.Counter.
+    """
+    source_str = filter(
+        None, re.split(re.escape(token_delim) + "|" + re.escape(seq_delim), source_str))
+    if to_lower:
+        source_str = [t.lower() for t in source_str]
+    if counter_to_update is None:
+        return collections.Counter(source_str)
+    counter_to_update.update(source_str)
+    return counter_to_update

@@ -1,0 +1,97 @@
+"""Token-index vocabulary — reference ``python/mxnet/contrib/text/vocab.py:30``
+(Vocabulary: counter-driven indexing, unknown/reserved tokens)."""
+from __future__ import annotations
+
+import collections
+
+C_UNKNOWN_IDX = 0
+
+
+class Vocabulary:
+    """Indexes text tokens by frequency (reference vocab.py:79).
+
+    Parameters mirror the reference: ``counter`` (collections.Counter or
+    None), ``most_freq_count``, ``min_freq``, ``unknown_token``,
+    ``reserved_tokens``.
+    """
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        assert min_freq > 0, "`min_freq` must be set to a positive value."
+        self._index_unknown_and_reserved_tokens(unknown_token, reserved_tokens)
+        if counter is not None:
+            self._index_counter_keys(counter, unknown_token, reserved_tokens,
+                                     most_freq_count, min_freq)
+
+    def _index_unknown_and_reserved_tokens(self, unknown_token, reserved_tokens):
+        self._unknown_token = unknown_token
+        if reserved_tokens is None:
+            self._reserved_tokens = None
+            self._idx_to_token = [unknown_token]
+        else:
+            reserved = list(reserved_tokens)
+            assert unknown_token not in reserved, \
+                "`reserved_tokens` cannot contain `unknown_token`."
+            assert len(set(reserved)) == len(reserved), \
+                "`reserved_tokens` cannot contain duplicate reserved tokens."
+            self._reserved_tokens = reserved
+            self._idx_to_token = [unknown_token] + reserved
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+
+    def _index_counter_keys(self, counter, unknown_token, reserved_tokens,
+                            most_freq_count, min_freq):
+        assert isinstance(counter, collections.Counter), \
+            "`counter` must be an instance of collections.Counter."
+        unknown_and_reserved = set(self._idx_to_token)
+        token_freqs = sorted(counter.items(), key=lambda x: x[0])
+        token_freqs.sort(key=lambda x: x[1], reverse=True)
+        token_cap = len(unknown_and_reserved) + (
+            len(counter) if most_freq_count is None else most_freq_count)
+        for token, freq in token_freqs:
+            if freq < min_freq or len(self._idx_to_token) == token_cap:
+                break
+            if token not in unknown_and_reserved:
+                self._idx_to_token.append(token)
+                self._token_to_idx[token] = len(self._idx_to_token) - 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token (or list of tokens) -> index/indices (reference :160)."""
+        to_reduce = False
+        if not isinstance(tokens, list):
+            tokens = [tokens]
+            to_reduce = True
+        indices = [self.token_to_idx.get(t, C_UNKNOWN_IDX) for t in tokens]
+        return indices[0] if to_reduce else indices
+
+    def to_tokens(self, indices):
+        """Index (or list) -> token(s) (reference :186)."""
+        to_reduce = False
+        if not isinstance(indices, list):
+            indices = [indices]
+            to_reduce = True
+        max_idx = len(self._idx_to_token) - 1
+        tokens = []
+        for idx in indices:
+            if not isinstance(idx, int) or not 0 <= idx <= max_idx:
+                raise ValueError("Token index %s in the provided `indices` is invalid." % idx)
+            tokens.append(self._idx_to_token[idx])
+        return tokens[0] if to_reduce else tokens

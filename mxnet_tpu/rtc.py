@@ -1,0 +1,33 @@
+"""Runtime kernel compilation facade — reference ``python/mxnet/rtc.py``
+(CudaModule :58, CudaKernel :167 over ``src/common/rtc.cc`` NVRTC).
+
+There is no CUDA on TPU; the TPU-native equivalent of runtime-compiled
+kernels is a **Pallas** kernel (jax.experimental.pallas), which jits through
+XLA:TPU. This module keeps the reference API importable and fails loudly
+with that guidance at use."""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["CudaModule", "CudaKernel"]
+
+_MSG = (
+    "mx.rtc compiles CUDA C at runtime, which does not exist on TPU. "
+    "Write the kernel with jax.experimental.pallas instead (see "
+    "/opt/skills/guides/pallas_guide.md for the TPU kernel playbook) and "
+    "register it as an operator with mxnet_tpu.ops.registry.register."
+)
+
+
+class CudaModule:
+    """(reference rtc.py:58) Unavailable on TPU — raises with guidance."""
+
+    def __init__(self, source, options=(), exports=()):
+        raise MXNetError(_MSG)
+
+
+class CudaKernel:
+    """(reference rtc.py:167) Unavailable on TPU — raises with guidance."""
+
+    def __init__(self, *args, **kwargs):
+        raise MXNetError(_MSG)

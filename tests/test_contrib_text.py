@@ -1,0 +1,142 @@
+"""Contrib text/autograd/rtc tests — mirrors reference
+tests/python/unittest/test_contrib_text.py + contrib autograd API."""
+import collections
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.contrib import text
+
+
+class TestVocabulary:
+    def test_counter_indexing(self):
+        counter = collections.Counter(["a", "b", "b", "c", "c", "c", "some_word$"])
+        v = text.vocab.Vocabulary(counter, most_freq_count=None, min_freq=1,
+                                  unknown_token="<unk>", reserved_tokens=["<pad>"])
+        assert len(v) == 6
+        assert v.token_to_idx["<unk>"] == 0
+        assert v.token_to_idx["<pad>"] == 1
+        assert v.idx_to_token[2] == "c"  # most frequent first
+        assert v.to_indices("c") == 2
+        assert v.to_indices(["c", "nope"]) == [2, 0]
+        assert v.to_tokens([0, 2]) == ["<unk>", "c"]
+        with pytest.raises(ValueError):
+            v.to_tokens(100)
+
+    def test_min_freq_and_cap(self):
+        counter = collections.Counter(["a"] * 5 + ["b"] * 3 + ["c"])
+        v = text.vocab.Vocabulary(counter, min_freq=2)
+        assert "c" not in v.token_to_idx
+        v2 = text.vocab.Vocabulary(counter, most_freq_count=1)
+        assert len(v2) == 2  # unk + a
+
+    def test_count_tokens(self):
+        c = text.utils.count_tokens_from_str("a b  b\nc C", to_lower=True)
+        assert c["b"] == 2 and c["c"] == 2 and c["a"] == 1
+
+
+class TestEmbedding:
+    def _write_emb(self, tmp_path):
+        p = tmp_path / "emb.txt"
+        p.write_text("hello 1 2 3\nworld 4 5 6\n")
+        return str(p)
+
+    def test_custom_embedding(self, tmp_path):
+        emb = text.embedding.CustomEmbedding(self._write_emb(tmp_path))
+        assert emb.vec_len == 3
+        np.testing.assert_allclose(
+            emb.get_vecs_by_tokens("world").asnumpy(), [4, 5, 6])
+        np.testing.assert_allclose(
+            emb.get_vecs_by_tokens(["nope"]).asnumpy(), [[0, 0, 0]])
+        emb.update_token_vectors("hello", nd.array(np.array([[9., 9, 9]], np.float32)))
+        np.testing.assert_allclose(emb.get_vecs_by_tokens("hello").asnumpy(), [9, 9, 9])
+
+    def test_with_vocabulary_and_composite(self, tmp_path):
+        path = self._write_emb(tmp_path)
+        counter = collections.Counter(["hello", "nope"])
+        vocab = text.vocab.Vocabulary(counter)
+        emb = text.embedding.CustomEmbedding(path, vocabulary=vocab)
+        assert len(emb) == len(vocab)
+        comp = text.embedding.CompositeEmbedding(
+            vocab, [text.embedding.CustomEmbedding(path)])
+        assert comp.idx_to_vec.shape == (len(vocab), 3)
+
+    def test_vocabulary_reorder_fetches_right_rows(self, tmp_path):
+        """Vocabulary whose token order differs from file order must still
+        map each token to its own vector (reference :344 layout-then-reindex)."""
+        path = self._write_emb(tmp_path)  # file order: hello, world
+        vocab = text.vocab.Vocabulary(collections.Counter(["world"]))  # world at idx 1
+        emb = text.embedding.CustomEmbedding(path, vocabulary=vocab)
+        np.testing.assert_allclose(emb.get_vecs_by_tokens("world").asnumpy(), [4, 5, 6])
+
+    def test_reserved_tokens_load(self, tmp_path):
+        emb = text.embedding.CustomEmbedding(
+            self._write_emb(tmp_path), reserved_tokens=["<pad>"])
+        assert len(emb) == 4  # unk, pad, hello, world
+        np.testing.assert_allclose(emb.get_vecs_by_tokens("hello").asnumpy(), [1, 2, 3])
+        np.testing.assert_allclose(emb.get_vecs_by_tokens("<pad>").asnumpy(), [0, 0, 0])
+
+    def test_negative_index_rejected(self):
+        v = text.vocab.Vocabulary(collections.Counter(["a"]))
+        with pytest.raises(ValueError):
+            v.to_tokens(-1)
+
+    def test_regex_delim_escaped(self):
+        c = text.utils.count_tokens_from_str("a.b.c", token_delim=".")
+        assert c == collections.Counter({"a": 1, "b": 1, "c": 1})
+
+    def test_registry(self, tmp_path):
+        names = text.embedding.get_pretrained_file_names()
+        assert "glove" in names and "fasttext" in names
+        emb = text.embedding.create("customembedding",
+                                    pretrained_file_path=self._write_emb(tmp_path))
+        assert emb.vec_len == 3
+        with pytest.raises(ValueError):
+            text.embedding.GloVe(pretrained_file_path=str(tmp_path / "missing.txt"))
+
+
+class TestLegacyAutograd:
+    def test_grad_and_loss(self):
+        from mxnet_tpu.contrib import autograd as cag
+
+        x = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+
+        @cag.grad_and_loss
+        def f(a):
+            return a * a
+
+        grads, loss = f(x)
+        np.testing.assert_allclose(grads[0].asnumpy(), [2, 4, 6], rtol=1e-5)
+
+    def test_stale_marked_vars_keep_grads(self):
+        """A later unrelated backward must not zero gradient buffers already
+        returned for earlier graphs."""
+        from mxnet_tpu.contrib import autograd as cag
+
+        x = nd.array(np.array([1.0, 2.0], np.float32))
+        grads1, _ = cag.grad_and_loss(lambda a: a * a)(x)
+        got = grads1[0].asnumpy().copy()
+        np.testing.assert_allclose(got, [2, 4], rtol=1e-5)
+        y = nd.array(np.array([5.0], np.float32))
+        cag.grad_and_loss(lambda b: b * 3)(y)  # x still alive, not involved
+        np.testing.assert_allclose(grads1[0].asnumpy(), got, rtol=1e-5)
+
+    def test_train_test_section(self):
+        from mxnet_tpu.contrib import autograd as cag
+        from mxnet_tpu import autograd as ag
+
+        assert not ag.is_recording()
+        with cag.train_section():
+            assert ag.is_recording() and ag.is_training()
+            with cag.test_section():
+                assert ag.is_recording() and not ag.is_training()
+            assert ag.is_training()
+        assert not ag.is_recording()
+
+
+class TestRtc:
+    def test_cuda_module_raises_with_guidance(self):
+        with pytest.raises(mx.base.MXNetError, match="[Pp]allas"):
+            mx.rtc.CudaModule("__global__ void k(){}")
