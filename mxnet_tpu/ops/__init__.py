@@ -18,6 +18,7 @@ from . import linalg  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import contrib_ops  # noqa: F401
 from . import quantization  # noqa: F401
+from . import misc_ops  # noqa: F401
 from . import detection  # noqa: F401
 from . import custom  # noqa: F401
 

@@ -103,17 +103,21 @@ def squeeze(data, *, axis=None):
     return jnp.squeeze(data, axis=axis)
 
 
+def _slice_index(ndim, begin, end, step=None):
+    """MXNet begin/end/step attrs -> python slice tuple, padded to ndim
+    (None / step 0 = full range; reference matrix_op.cc slice param rules)."""
+    begin = tuple(begin) + (None,) * (ndim - len(begin))
+    end = tuple(end) + (None,) * (ndim - len(end))
+    step = tuple(step) + (None,) * (ndim - len(tuple(step))) if step else (None,) * ndim
+    return tuple(
+        slice(b, e, s if s != 0 else None) for b, e, s in zip(begin, end, step)
+    )
+
+
 @register("slice", alias=["crop"])
 def slice_op(data, *, begin, end, step=None):
     """N-d slice (reference matrix_op.cc slice).  None entries = full range."""
-    nd = data.ndim
-    begin = tuple(begin) + (None,) * (nd - len(begin))
-    end = tuple(end) + (None,) * (nd - len(end))
-    step = tuple(step) + (None,) * (nd - len(step)) if step else (None,) * nd
-    idx = tuple(
-        slice(b, e, s if s != 0 else None) for b, e, s in zip(begin, end, step)
-    )
-    return data[idx]
+    return data[_slice_index(data.ndim, begin, end, step)]
 
 
 @register("slice_axis")

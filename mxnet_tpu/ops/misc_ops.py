@@ -1,0 +1,183 @@
+"""Long-tail operator parity — the remaining reference registrations found by
+diffing ``NNVM_REGISTER_OP``/``MXNET_REGISTER_OP_PROPERTY`` sites against this
+registry: v1 op aliases, internal helper ops the frontends emit, image
+tensor ops (``src/operator/image/image_random.cc``), sparse-flavored ops in
+their dense formulation, and IdentityAttachKLSparseReg
+(``src/operator/identity_attach_KL_sparse_reg-inl.h``).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register, alias
+
+
+# -- v1 / cudnn aliases: the reference kept pre-NNVM copies of conv/pool/BN
+# (src/operator/convolution_v1.cc etc.); semantics match the modern ops ------
+alias("Convolution", "Convolution_v1")
+alias("Pooling", "Pooling_v1")
+alias("BatchNorm", "BatchNorm_v1", "CuDNNBatchNorm")
+alias("MakeLoss", "make_loss")
+# gradient accumulation add (src/operator/tensor/elemwise_binary_op_basic.cc
+# _grad_add) and the sparse-capable embedding: dense formulations here
+alias("elemwise_add", "_grad_add")
+alias("Embedding", "_contrib_SparseEmbedding")
+
+
+@register("hard_sigmoid")
+def hard_sigmoid(data, *, alpha=0.2, beta=0.5):
+    """clip(alpha*x + beta, 0, 1) (reference elemwise_unary_op_basic.cc)."""
+    return jnp.clip(alpha * data + beta, 0.0, 1.0)
+
+
+@register("reshape_like")
+def reshape_like(lhs, rhs):
+    """Reshape lhs to rhs's shape (reference elemwise_unary_op_basic.cc)."""
+    return lhs.reshape(rhs.shape)
+
+
+@register("_copyto", alias=["copyto"])
+def _copyto(data):
+    """Identity/device copy (reference ndarray_function copy; device
+    placement is XLA's job here)."""
+    return jnp.asarray(data)
+
+
+@register("_identity_with_attr_like_rhs")
+def _identity_with_attr_like_rhs(lhs, rhs):
+    """Identity on lhs carrying rhs's storage attrs (reference
+    elemwise_unary_op_basic.cc; dense here, so plain identity)."""
+    return jnp.asarray(lhs)
+
+
+@register("_ravel_multi_index", alias=["ravel_multi_index"])
+def ravel_multi_index(data, *, shape):
+    """(reference src/operator/tensor/ravel.cc) data: (ndim, n) indices."""
+    shape = tuple(shape)
+    return jnp.ravel_multi_index(
+        tuple(data[i].astype(jnp.int32) for i in range(len(shape))), shape,
+        mode="clip").astype(data.dtype)
+
+
+@register("_unravel_index", alias=["unravel_index"])
+def unravel_index(data, *, shape):
+    """(reference src/operator/tensor/ravel.cc) -> (ndim, n) indices."""
+    shape = tuple(shape)
+    unr = jnp.unravel_index(data.astype(jnp.int32), shape)
+    return jnp.stack(unr).astype(data.dtype)
+
+
+@register("_square_sum", alias=["square_sum"])
+def square_sum(data, *, axis=None, keepdims=False, exclude=False):
+    """sum(x^2) fused reduce (reference square_sum-inl.h; the rowsparse
+    optimization is moot on dense XLA, which fuses this anyway)."""
+    from .reduce import _norm_axis
+
+    ax = _norm_axis(data.ndim, axis, exclude)
+    return jnp.sum(data * data, axis=ax, keepdims=keepdims)
+
+
+@register("_scatter_plus_scalar")
+def _scatter_plus_scalar(data, *, scalar=1.0):
+    """Sparse-storage-preserving scalar add (reference
+    elemwise_binary_scalar_op_basic.cc); dense: plain add."""
+    return data + scalar
+
+
+@register("_scatter_minus_scalar")
+def _scatter_minus_scalar(data, *, scalar=1.0):
+    return data - scalar
+
+
+@register("_slice_assign", alias=["slice_assign"])
+def _slice_assign(lhs, rhs, *, begin, end, step=()):
+    """lhs with lhs[begin:end:step] = rhs (reference matrix_op _slice_assign,
+    the engine op behind NDArray.__setitem__)."""
+    from .matrix import _slice_index
+
+    return jnp.asarray(lhs).at[_slice_index(lhs.ndim, begin, end, step)].set(rhs)
+
+
+@register("_slice_assign_scalar", alias=["slice_assign_scalar"])
+def _slice_assign_scalar(data, *, begin, end, scalar=0.0, step=()):
+    from .matrix import _slice_index
+
+    return jnp.asarray(data).at[_slice_index(data.ndim, begin, end, step)].set(scalar)
+
+
+@register("_image_to_tensor", alias=["image_to_tensor"])
+def image_to_tensor(data):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference
+    src/operator/image/image_random.cc:41)."""
+    if data.ndim == 3:
+        return jnp.transpose(data.astype(jnp.float32) / 255.0, (2, 0, 1))
+    return jnp.transpose(data.astype(jnp.float32) / 255.0, (0, 3, 1, 2))
+
+
+@register("_image_normalize", alias=["image_normalize"])
+def image_normalize(data, *, mean=(0.0,), std=(1.0,)):
+    """Channel-wise (x - mean) / std on CHW float tensors (reference
+    src/operator/image/image_random.cc:51)."""
+    mean = jnp.asarray(mean, data.dtype)
+    std = jnp.asarray(std, data.dtype)
+    if data.ndim == 3:
+        return (data - mean.reshape(-1, 1, 1)) / std.reshape(-1, 1, 1)
+    return (data - mean.reshape(1, -1, 1, 1)) / std.reshape(1, -1, 1, 1)
+
+
+@register("_sparse_adagrad_update", mutates=("history",))
+def sparse_adagrad_update(weight, grad, history, *, lr, epsilon=1e-7, wd=0.0,
+                          rescale_grad=1.0, clip_gradient=-1.0):
+    """AdaGrad update (reference optimizer_op.cc:651 _sparse_adagrad_update);
+    dense formulation — XLA only touches rows whose gradient is nonzero after
+    fusion, the moral equivalent of the rowsparse kernel."""
+    from .optimizer_ops import _prep_grad
+
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    new_hist = history + g * g
+    return weight - lr * g / (jnp.sqrt(new_hist) + epsilon), new_hist
+
+
+def _kl_sparse_aux_update(attrs, raw_outputs, aux):
+    """Update the moving average of activations (reference
+    identity_attach_KL_sparse_reg-inl.h:108). The executor passes the raw fn
+    result (a single array for this op) and a possibly-empty aux dict."""
+    if "moving_avg" not in aux:
+        return {}
+    momentum = attrs.get("momentum", 0.9)
+    out = raw_outputs[0] if isinstance(raw_outputs, tuple) else raw_outputs
+    avg = jnp.mean(out, axis=0)
+    return {"moving_avg": momentum * aux["moving_avg"] + (1 - momentum) * avg}
+
+
+def _kl_infer(attrs, shapes):
+    return {"moving_avg": (shapes["data"][1],)}
+
+
+@register("IdentityAttachKLSparseReg", aux=("moving_avg",),
+          inputs_fn=lambda attrs: ["data", "moving_avg"],
+          infer_params=_kl_infer, aux_update=_kl_sparse_aux_update)
+def identity_attach_kl_sparse_reg(data, moving_avg=None, *, sparseness_target=0.1,
+                                  penalty=0.001, momentum=0.9):
+    """Identity forward; backward adds the KL sparseness penalty gradient
+    penalty * (-rho/rho_hat + (1-rho)/(1-rho_hat)) using the moving average
+    of activations (reference identity_attach_KL_sparse_reg-inl.h:65-111)."""
+    rho = sparseness_target
+    rho_hat = moving_avg if moving_avg is not None else jnp.mean(data, axis=0)
+
+    @jax.custom_vjp
+    def _f(x, rh):
+        return x
+
+    def _fwd(x, rh):
+        return x, rh
+
+    def _bwd(rh, g):
+        rh = jnp.clip(rh, 1e-6, 1 - 1e-6)  # fresh zero-initialized aux
+        reg = penalty * (-rho / rh + (1 - rho) / (1 - rh))
+        return (g + jnp.broadcast_to(reg, g.shape), None)
+
+    _f.defvjp(_fwd, _bwd)
+    return _f(data, jax.lax.stop_gradient(rho_hat))

@@ -1,0 +1,98 @@
+"""Long-tail op parity tests (ops/misc_ops.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym, autograd
+from mxnet_tpu.ops.registry import get as _get
+from mxnet_tpu.ndarray import _invoke
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)
+
+
+def test_hard_sigmoid_reshape_like_square_sum(rng):
+    x = rng.randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        nd.hard_sigmoid(nd.array(x)).asnumpy(), np.clip(0.2 * x + 0.5, 0, 1), rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.reshape_like(nd.array(x), nd.array(np.zeros((4, 3)))).asnumpy(), x.reshape(4, 3))
+    np.testing.assert_allclose(
+        nd.square_sum(nd.array(x), axis=1).asnumpy(), (x * x).sum(1), rtol=1e-5)
+
+
+def test_ravel_unravel(rng):
+    idx = np.array([[0, 1, 2], [1, 0, 3]], np.float32)
+    rv = nd.ravel_multi_index(nd.array(idx), shape=(3, 4))
+    np.testing.assert_allclose(rv.asnumpy(), [1, 4, 11])
+    ur = nd.unravel_index(nd.array(np.array([1, 4, 11], np.float32)), shape=(3, 4))
+    np.testing.assert_allclose(ur.asnumpy(), idx)
+
+
+def test_slice_assign():
+    out = _get("_slice_assign")(
+        np.zeros((4, 4), np.float32), np.ones((2, 2), np.float32), begin=(1, 1), end=(3, 3))
+    assert out.sum() == 4 and out[1, 1] == 1 and out[0, 0] == 0
+    out2 = _get("_slice_assign_scalar")(
+        np.zeros((4, 4), np.float32), begin=(0, 0), end=(2, 4), scalar=7.0)
+    assert out2[0, 0] == 7 and out2[3, 3] == 0
+
+
+def test_image_ops(rng):
+    img = (rng.rand(5, 6, 3) * 255).astype(np.uint8)
+    tt = np.asarray(_get("_image_to_tensor")(img))
+    assert tt.shape == (3, 5, 6) and tt.max() <= 1.0
+    nrm = np.asarray(_get("_image_normalize")(tt, mean=(0.5, 0.5, 0.5), std=(0.2, 0.2, 0.2)))
+    np.testing.assert_allclose(nrm, (tt - 0.5) / 0.2, rtol=1e-5)
+
+
+def test_v1_aliases_and_make_loss():
+    s = sym.Convolution_v1(sym.Variable("d"), kernel=(3, 3), num_filter=2)
+    _, osh, _ = s.infer_shape(d=(1, 3, 8, 8))
+    assert osh[0] == (1, 2, 6, 6)
+    s2 = sym.Pooling_v1(sym.Variable("d"), kernel=(2, 2), stride=(2, 2), pool_type="max")
+    _, osh2, _ = s2.infer_shape(d=(1, 2, 8, 8))
+    assert osh2[0] == (1, 2, 4, 4)
+    assert sym.make_loss(sym.Variable("x")) is not None
+    assert _get("BatchNorm_v1").name == "BatchNorm"
+    assert _get("_grad_add").name == "elemwise_add"
+
+
+def test_sparse_adagrad_update(rng):
+    w0 = rng.randn(4).astype(np.float32)
+    g0 = rng.randn(4).astype(np.float32)
+    w = nd.array(w0); h = nd.zeros((4,))
+    _invoke(_get("_sparse_adagrad_update"), (w, nd.array(g0), h), {"lr": 0.1, "out": w})
+    np.testing.assert_allclose(h.asnumpy(), g0 * g0, rtol=1e-5)
+    expect = w0 - 0.1 * g0 / (np.sqrt(g0 * g0) + 1e-7)
+    np.testing.assert_allclose(w.asnumpy(), expect, rtol=1e-4)
+
+
+def test_kl_sparse_reg_graph_mode(rng):
+    """simple_bind path: aux moving_avg allocated, updated with COLUMN means."""
+    s = sym.IdentityAttachKLSparseReg(sym.Variable("d"), momentum=0.0, name="klreg")
+    exe = s.simple_bind(d=(8, 5))
+    dv = rng.rand(8, 5).astype(np.float32)
+    exe.forward(is_train=True, d=nd.array(dv))
+    aux_names = s.list_auxiliary_states()
+    assert aux_names, "moving_avg aux missing"
+    avg = exe.aux_dict[aux_names[0]].asnumpy()
+    np.testing.assert_allclose(avg, dv.mean(axis=0), rtol=1e-4)
+
+
+def test_kl_sparse_reg_grad(rng):
+    d = nd.array(rng.rand(8, 5).astype(np.float32) * 0.5 + 0.25)
+    d.attach_grad()
+    with autograd.record():
+        y = nd.IdentityAttachKLSparseReg(d, penalty=0.01, sparseness_target=0.1)
+        loss = y.sum()
+    loss.backward()
+    g = d.grad.asnumpy()
+    # identity forward
+    np.testing.assert_allclose(y.asnumpy(), d.asnumpy())
+    # penalty term: -rho/rho_hat + (1-rho)/(1-rho_hat), rho_hat = col means
+    rho_hat = d.asnumpy().mean(axis=0)
+    reg = 0.01 * (-0.1 / rho_hat + 0.9 / (1 - rho_hat))
+    np.testing.assert_allclose(g, 1.0 + np.broadcast_to(reg, g.shape), rtol=1e-4)
