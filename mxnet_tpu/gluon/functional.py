@@ -51,7 +51,8 @@ def functionalize(net, train=False):
     return apply, param_names, param_vals, aux_names
 
 
-def make_train_step(net, loss_fn, learning_rate=0.01, momentum=0.0):
+def make_train_step(net, loss_fn, learning_rate=0.01, momentum=0.0,
+                    compute_dtype=None):
     """Build a fully-jittable SGD train step for an initialized Block.
 
     → (step, state) where ``state = (param_vals, momentum_vals, aux_vals)``
@@ -60,6 +61,12 @@ def make_train_step(net, loss_fn, learning_rate=0.01, momentum=0.0):
     which is what lets the compiler fuse and overlap (the reference needed
     engine bulking + fused optimizer kernels for the same effect,
     ``src/executor/graph_executor.cc:1454``, ``src/operator/optimizer_op.cc``).
+
+    ``compute_dtype='bfloat16'`` enables mixed precision: fp32 master
+    parameters and optimizer state, forward/backward in bf16 (halved HBM
+    traffic, native MXU dtype; the reference's fp16 multi-precision mode,
+    ``optimizer_op.cc mp_sgd_mom_update``, with bf16's range so no loss
+    scaling is needed), loss and BN statistics in fp32.
     """
     import jax
     import jax.numpy as jnp
@@ -67,14 +74,27 @@ def make_train_step(net, loss_fn, learning_rate=0.01, momentum=0.0):
     apply, names, vals, aux_names = functionalize(net, train=True)
     aux_idx = [i for i, n in enumerate(names) if n in set(aux_names)]
     learn_idx = [i for i, n in enumerate(names) if n not in set(aux_names)]
+    cdtype = jnp.dtype(compute_dtype) if compute_dtype is not None else None
 
     def compute_loss(learn_vals, aux_vals, x, y, key):
         merged = [None] * len(names)
         for i, v in zip(learn_idx, learn_vals):
-            merged[i] = v
+            merged[i] = v.astype(cdtype) if cdtype is not None else v
         for i, v in zip(aux_idx, aux_vals):
-            merged[i] = v
-        out, new_aux = apply(merged, x, key)
+            merged[i] = v  # BN stats stay fp32
+        if cdtype is not None:
+            # only float leaves change dtype: token ids / masks stay integral
+            x_ = jax.tree_util.tree_map(
+                lambda a: a.astype(cdtype)
+                if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
+                x,
+            )
+        else:
+            x_ = x
+        out, new_aux = apply(merged, x_, key)
+        if cdtype is not None:
+            out = jax.tree_util.tree_map(lambda a: a.astype(jnp.float32), out)
+            new_aux = [a.astype(jnp.float32) for a in new_aux]
         loss = loss_fn(NDArray(out), NDArray(y))
         return jnp.mean(loss._data), new_aux
 

@@ -126,10 +126,11 @@ def convolution(
         rhs_dilation=dilate,
         dimension_numbers=dn,
         feature_group_count=num_group,
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
+        # no preferred_element_type: the MXU accumulates in f32 regardless and
+        # bf16 output storage is the mixed-precision contract; forcing an f32
+        # output also breaks the conv transpose rule under AD (cotangent dtype
+        # mismatch)
     )
-    if out.dtype != data.dtype:
-        out = out.astype(data.dtype)
     if not no_bias and bias is not None:
         c_axis = (layout or "NC").index("C")
         bshape = [1] * out.ndim
