@@ -1,0 +1,246 @@
+"""Deformable R-FCN — the north-star model family of the reference fork
+(README.md:1-7: "the CPU version of Deformable-RCNN code"; ops
+``src/operator/contrib/deformable_convolution-inl.h:99``,
+``deformable_psroi_pooling.cc:66``, ``multi_proposal.cc:38``; model code
+lives in the external Deformable-ConvNets repo which this fork serves).
+
+TPU-native composition: backbone convs → a deformable conv block (offsets
+learned by a plain conv) → RPN + MultiProposal (fixed-capacity top-k, jit
+friendly) → position-sensitive score/bbox maps → DeformablePSROIPooling with
+learned per-ROI ``trans`` offsets → per-ROI classification + bbox deltas.
+Everything jits into one XLA module per phase.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import HybridBlock, nn
+
+
+class Backbone(HybridBlock):
+    """Small strided conv trunk ending at stride 8, with one deformable
+    conv block at the end (the Deformable-ConvNets recipe applies deformable
+    convs in the last stage)."""
+
+    def __init__(self, channels=(16, 32, 64), defconv_filters=64, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.body = nn.HybridSequential(prefix="")
+            for i, ch in enumerate(channels):
+                self.body.add(nn.Conv2D(ch, 3, strides=2, padding=1, prefix="down%d_" % i))
+                self.body.add(nn.BatchNorm())
+                self.body.add(nn.Activation("relu"))
+            # offsets for a 3x3 deformable conv: 2*3*3=18 channels, zero-init
+            # (starts as a regular conv, learns sampling locations)
+            self.offset_conv = nn.Conv2D(
+                18, 3, padding=1, weight_initializer="zeros",
+                bias_initializer="zeros", prefix="offset_")
+            self.def_weight = self.params.get(
+                "defconv_weight", shape=(defconv_filters, channels[-1], 3, 3),
+                init=mx.init.Xavier())
+            self.def_bias = self.params.get(
+                "defconv_bias", shape=(defconv_filters,), init="zeros")
+
+    def hybrid_forward(self, F, x, def_weight, def_bias):
+        feat = self.body(x)
+        offsets = self.offset_conv(feat)
+        return F.contrib.DeformableConvolution(
+            feat, offsets, def_weight, def_bias,
+            kernel=(3, 3), num_filter=def_weight.shape[0], pad=(1, 1),
+            num_deformable_group=1,
+        )
+
+
+class RPN(HybridBlock):
+    """(reference rcnn symbol rpn_* layers)"""
+
+    def __init__(self, num_anchors, channels=64, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.conv = nn.Conv2D(channels, 3, padding=1, prefix="conv_")
+            self.cls = nn.Conv2D(2 * num_anchors, 1, prefix="cls_")
+            self.bbox = nn.Conv2D(4 * num_anchors, 1, prefix="bbox_")
+
+    def hybrid_forward(self, F, x):
+        t = F.relu(self.conv(x))
+        return self.cls(t), self.bbox(t)
+
+
+class DeformableRFCN(HybridBlock):
+    """R-FCN head: position-sensitive maps + deformable PSROI pooling.
+
+    cls branch:  conv -> (C+1)*p*p score maps -> def-psroi -> (R, C+1)
+    bbox branch: conv -> 4*p*p maps          -> def-psroi -> (R, 4)
+    trans branch: per-ROI offset maps pooled with no_trans, predicting the
+    deformation applied in the second (deformable) pooling pass — the
+    two-stage scheme of Deformable R-FCN.
+    """
+
+    def __init__(self, num_classes=2, num_anchors=9, pooled_size=3,
+                 stride=8, rpn_post_nms=32, **kw):
+        super().__init__(**kw)
+        self.num_classes = num_classes
+        self.p = pooled_size
+        self.stride = stride
+        self.rpn_post_nms = rpn_post_nms
+        self.num_anchors = num_anchors
+        self.scales = (2, 4, 8)
+        self.ratios = (0.5, 1, 2)
+        with self.name_scope():
+            self.backbone = Backbone(prefix="backbone_")
+            self.rpn = RPN(num_anchors, prefix="rpn_")
+            cpp = (num_classes + 1) * pooled_size * pooled_size
+            self.ps_cls = nn.Conv2D(cpp, 1, prefix="pscls_")
+            self.ps_bbox = nn.Conv2D(4 * pooled_size * pooled_size, 1, prefix="psbbox_")
+            # offset (trans) maps: 2 channels (dx, dy); per-bin variation
+            # comes from the stage-1 pooling reading each bin's own spatial
+            # region (group_size=1 pooling consumes exactly output_dim=2
+            # channels, detection.py:314)
+            self.ps_trans = nn.Conv2D(2, 1,
+                                      weight_initializer="zeros",
+                                      bias_initializer="zeros", prefix="pstrans_")
+
+    def hybrid_forward(self, F, data, im_info):
+        feat = self.backbone(data)
+        rpn_cls, rpn_bbox = self.rpn(feat)
+        # (B, 2A, H, W) -> softmax over {bg, fg} per anchor; shapes stay
+        # symbolic (MXNet reshape specials + reshape_like), so the block
+        # also hybridizes
+        rpn_prob = F.softmax(F.Reshape(rpn_cls, shape=(0, 2, -1)), axis=1)
+        rpn_prob = F.reshape_like(rpn_prob, rpn_cls)
+        rois = F.contrib.MultiProposal(
+            rpn_prob, rpn_bbox, im_info,
+            feature_stride=self.stride, scales=(2, 4, 8), ratios=(0.5, 1, 2),
+            rpn_pre_nms_top_n=128, rpn_post_nms_top_n=self.rpn_post_nms,
+            threshold=0.7, rpn_min_size=4,
+        )  # (B*post, 5)
+        cls_maps = self.ps_cls(feat)
+        bbox_maps = self.ps_bbox(feat)
+        trans_maps = self.ps_trans(feat)
+        ss = 1.0 / self.stride
+        # stage 1: pool the trans maps without deformation -> per-ROI offsets
+        trans = F.contrib.DeformablePSROIPooling(
+            trans_maps, rois, spatial_scale=ss, output_dim=2,
+            group_size=1, pooled_size=self.p, no_trans=True,
+        )  # (R, 2, p, p)
+        cls = F.contrib.DeformablePSROIPooling(
+            cls_maps, rois, trans, spatial_scale=ss,
+            output_dim=self.num_classes + 1, group_size=self.p,
+            pooled_size=self.p, trans_std=0.1,
+        )  # (R, C+1, p, p)
+        bbox = F.contrib.DeformablePSROIPooling(
+            bbox_maps, rois, trans, spatial_scale=ss, output_dim=4,
+            group_size=self.p, pooled_size=self.p, trans_std=0.1,
+        )  # (R, 4, p, p)
+        cls_score = F.Reshape(cls, shape=(0, 0, -1)).mean(axis=2)
+        bbox_pred = F.Reshape(bbox, shape=(0, 0, -1)).mean(axis=2)
+        return rois, cls_score, bbox_pred, rpn_cls, rpn_bbox
+
+
+def _rcnn_example():
+    """The sibling Faster R-CNN example's helpers (vectorized IoU, anchor
+    assignment, smooth-L1) — shared numerics across the detection examples."""
+    import importlib
+    import sys
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "rcnn")
+    if path not in sys.path:
+        sys.path.insert(0, path)
+    return importlib.import_module("faster_rcnn")
+
+
+def _roi_targets(rois_np, gt_np, iou_fg=0.5):
+    """Host-side per-ROI targets from IoU vs gt (the reference's
+    proposal_target CustomOp runs on host too; targets carry no gradient)."""
+    fr = _rcnn_example()
+    boxes = rois_np[:, 1:]
+    bidx = rois_np[:, 0].astype(np.int32)
+    R = boxes.shape[0]
+    labels = np.zeros((R,), np.float32)
+    tgt = np.zeros((R, 4), np.float32)
+    for b in np.unique(bidx):
+        sel = np.where(bidx == b)[0]
+        g = gt_np[b]
+        g = g[g[:, 0] >= 0]
+        if not len(g):
+            continue
+        iou = fr._np_iou(boxes[sel], g[:, 1:])  # (r, G)
+        j = iou.argmax(axis=1)
+        best = iou.max(axis=1)
+        fg = best >= iou_fg
+        labels[sel[fg]] = g[j[fg], 0] + 1  # background = 0
+        bx = boxes[sel]
+        gb = g[j, 1:]
+        bw = np.maximum(bx[:, 2] - bx[:, 0], 1.0)
+        bh = np.maximum(bx[:, 3] - bx[:, 1], 1.0)
+        t = np.stack([
+            ((gb[:, 0] + gb[:, 2]) / 2 - (bx[:, 0] + bx[:, 2]) / 2) / bw,
+            ((gb[:, 1] + gb[:, 3]) / 2 - (bx[:, 1] + bx[:, 3]) / 2) / bh,
+            np.log(np.maximum(gb[:, 2] - gb[:, 0], 1.0) / bw),
+            np.log(np.maximum(gb[:, 3] - gb[:, 1], 1.0) / bh),
+        ], axis=1)
+        tgt[sel[fg]] = t[fg]
+    return labels, tgt
+
+
+def rpn_losses(net, rpn_cls, rpn_bbox, gt_boxes, im_info, anchor_rng=None):
+    """RPN cls/bbox losses via the shared anchor assignment (the same loss
+    heads as examples/rcnn — without them the RPN receives zero gradient,
+    since ROI coordinates enter pooling through a round())."""
+    from mxnet_tpu.gluon import loss as gloss
+
+    fr = _rcnn_example()
+    B, _, hf, wf = rpn_cls.shape
+    A = net.num_anchors
+    labs, bts, bws = [], [], []
+    gt_np = gt_boxes.asnumpy()
+    info_np = im_info.asnumpy()
+    for b in range(B):
+        lab, bt, bw = fr.assign_anchor(
+            (hf, wf), gt_np[b], info_np[b], stride=net.stride,
+            scales=net.scales, ratios=net.ratios, rng=anchor_rng)
+        labs.append(lab)
+        bts.append(bt)
+        bws.append(bw)
+    rpn_label = nd.array(np.stack(labs))
+    rpn_bt = nd.array(np.stack(bts))
+    rpn_bw = nd.array(np.stack(bws))
+
+    logits = nd.transpose(
+        nd.reshape(rpn_cls, shape=(B, 2, A, hf, wf)), axes=(0, 3, 4, 2, 1))
+    logits = nd.reshape(logits, shape=(B, hf * wf * A, 2))
+    ce = gloss.SoftmaxCrossEntropyLoss()
+    valid = rpn_label >= 0
+    cls_loss = (
+        nd.reshape(ce(nd.reshape(logits, shape=(-1, 2)),
+                      nd.reshape(nd.maximum(rpn_label, 0.0), shape=(-1,))),
+                   shape=rpn_label.shape) * valid
+    ).sum() / nd.maximum(valid.sum(), 1.0)
+
+    bp = nd.transpose(nd.reshape(rpn_bbox, shape=(B, A, 4, hf, wf)), axes=(0, 3, 4, 1, 2))
+    bp = nd.reshape(bp, shape=(B, hf * wf * A, 4))
+    bbox_loss = fr.smooth_l1(bp, rpn_bt, rpn_bw, sigma=3.0)
+    return cls_loss, bbox_loss
+
+
+def rfcn_losses(rois, cls_score, bbox_pred, gt_boxes, num_classes, iou_fg=0.5):
+    """(cls_loss, bbox_loss) scalars; targets on host (no grad), losses as
+    taped nd ops so gradients flow into the score/bbox branches."""
+    from mxnet_tpu.gluon import loss as gloss
+
+    labels_np, tgt_np = _roi_targets(rois.asnumpy(), gt_boxes.asnumpy(), iou_fg)
+    labels = nd.array(labels_np)
+    tgt = nd.array(tgt_np)
+
+    ce = gloss.SoftmaxCrossEntropyLoss()
+    cls_loss = ce(cls_score, labels).mean()
+
+    fg = nd.reshape(labels > 0, shape=(-1, 1))
+    diff = nd.abs(bbox_pred - tgt)
+    smooth = nd.where(diff < 1.0, 0.5 * diff * diff, diff - 0.5)
+    bbox_loss = (smooth * fg).sum() / nd.maximum(fg.sum(), 1.0)
+    return cls_loss, bbox_loss

@@ -1,0 +1,88 @@
+"""Deformable R-FCN end-to-end training on a synthetic shapes dataset —
+the BASELINE config-3 north star run anywhere (reference: Deformable R-FCN
+over the deformable ops this fork exists for; model recipe from the external
+Deformable-ConvNets repo, rebuilt TPU-first)."""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+from deformable_rfcn import DeformableRFCN, rfcn_losses, rpn_losses
+
+
+def synthetic_batches(batch_size, data_shape, num_batches, num_classes=2, seed=0):
+    """Bright rectangles on dim noise; labels [cls, x1, y1, x2, y2] in pixels."""
+    rng = np.random.RandomState(seed)
+    c, h, w = data_shape
+    for _ in range(num_batches):
+        data = rng.rand(batch_size, c, h, w).astype(np.float32) * 0.2
+        labels = np.full((batch_size, 2, 5), -1.0, dtype=np.float32)
+        for b in range(batch_size):
+            for j in range(rng.randint(1, 3)):
+                cls = rng.randint(0, num_classes)
+                bw = rng.uniform(0.3, 0.6) * w
+                bh = rng.uniform(0.3, 0.6) * h
+                x1 = rng.uniform(0, w - bw)
+                y1 = rng.uniform(0, h - bh)
+                labels[b, j] = [cls, x1, y1, x1 + bw, y1 + bh]
+                data[b, cls % c, int(y1):int(y1 + bh), int(x1):int(x1 + bw)] += 0.8
+        im_info = np.tile(np.array([h, w, 1.0], np.float32), (batch_size, 1))
+        yield nd.array(data), nd.array(im_info), nd.array(labels)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch-size", type=int, default=2)
+    p.add_argument("--data-shape", type=int, nargs=3, default=[3, 64, 64])
+    p.add_argument("--num-classes", type=int, default=2)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batches-per-epoch", type=int, default=6)
+    p.add_argument("--lr", type=float, default=0.02)
+    args = p.parse_args()
+
+    net = DeformableRFCN(num_classes=args.num_classes)
+    net.initialize()
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": args.lr, "momentum": 0.9})
+
+    first_loss = last_loss = None
+    for epoch in range(args.epochs):
+        tic = time.time()
+        total = 0.0
+        n = 0
+        for data, im_info, labels in synthetic_batches(
+                args.batch_size, tuple(args.data_shape),
+                args.batches_per_epoch, args.num_classes, seed=epoch):
+            with autograd.record():
+                rois, cls_score, bbox_pred, rpn_cls, rpn_bbox = net(data, im_info)
+                cls_loss, bbox_loss = rfcn_losses(
+                    rois, cls_score, bbox_pred, labels, args.num_classes)
+                rpn_cls_loss, rpn_bbox_loss = rpn_losses(
+                    net, rpn_cls, rpn_bbox, labels, im_info)
+                loss = cls_loss + bbox_loss + rpn_cls_loss + rpn_bbox_loss
+            loss.backward()
+            trainer.step(args.batch_size)
+            total += float(loss.asnumpy())
+            n += 1
+        avg = total / n
+        if first_loss is None:
+            first_loss = avg
+        last_loss = avg
+        print("Epoch[%d] loss=%.4f time=%.1fs" % (epoch, avg, time.time() - tic))
+    print("first=%.4f last=%.4f" % (first_loss, last_loss))
+    assert last_loss < first_loss, "loss did not decrease"
+    print("DEFORMABLE-RFCN TRAIN OK")
+
+
+if __name__ == "__main__":
+    main()

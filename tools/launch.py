@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Distributed job launcher — capability parity with reference
+``tools/launch.py`` (dmlc_tracker ssh/mpi/sge/yarn/local, :29,48-115), shaped
+for the TPU runtime: instead of scheduler/server/worker roles over ps-lite,
+every process is an equal jax.distributed participant; process 0 hosts the
+coordination service (SURVEY §5.8 translation: the launcher becomes a thin
+multi-host bootstrapper).
+
+Usage (mirrors the reference CLI):
+
+    # N local processes, a fake cluster on one host (the reference's
+    # `--launcher local` nightly-test pattern, ci/runtime_functions.sh:673)
+    python tools/launch.py -n 4 --launcher local python train.py ...
+
+    # ssh to a host list; each host runs one process
+    python tools/launch.py -n 4 -H hostfile --launcher ssh python train.py ...
+
+Every spawned process receives the env contract consumed by
+``mxnet_tpu.parallel.dist.init()``:
+  MXNET_COORDINATOR, MXNET_NUM_WORKERS, MXNET_WORKER_RANK
+(DMLC_* aliases are exported too for scripts reading the reference names).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _env_for(rank, n, coordinator, base=None):
+    env = dict(base if base is not None else os.environ)
+    env.update({
+        "MXNET_COORDINATOR": coordinator,
+        "MXNET_NUM_WORKERS": str(n),
+        "MXNET_WORKER_RANK": str(rank),
+        # reference names, for scripts that read them
+        "DMLC_NUM_WORKER": str(n),
+        "DMLC_RANK": str(rank),
+        "DMLC_ROLE": "worker",
+    })
+    return env
+
+
+def launch_local(n, command, verbose=False):
+    """N processes on this host (the reference local tracker)."""
+    coordinator = "127.0.0.1:%d" % _free_port()
+    procs = []
+    try:
+        for rank in range(n):
+            p = subprocess.Popen(command, env=_env_for(rank, n, coordinator))
+            procs.append(p)
+        codes = [p.wait() for p in procs]
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        raise
+    bad = [(i, c) for i, c in enumerate(codes) if c != 0]
+    if bad:
+        raise SystemExit("workers failed: %s" % bad)
+    return 0
+
+
+def launch_ssh(n, hosts, command, verbose=False, port=None):
+    """One process per host over ssh (reference ssh launcher, launch.py:48).
+
+    The coordinator address is host0:port. The port must be free ON hosts[0]
+    — a locally-probed free port proves nothing about the remote — so a fixed
+    default is used and --port overrides it on conflict.
+    """
+    if len(hosts) < n:
+        raise SystemExit("need %d hosts, hostfile has %d" % (n, len(hosts)))
+    port = port or 29400
+    coordinator = "%s:%d" % (hosts[0], port)
+    cmd_str = " ".join("'%s'" % c for c in command)
+    procs = []
+    for rank in range(n):
+        envs = " ".join(
+            "%s=%s" % (k, v)
+            for k, v in _env_for(rank, n, coordinator, base={}).items()
+        )
+        full = ["ssh", "-o", "StrictHostKeyChecking=no", hosts[rank],
+                "cd %s && env %s %s" % (os.getcwd(), envs, cmd_str)]
+        if verbose:
+            print("launch:", " ".join(full))
+        procs.append(subprocess.Popen(full))
+    codes = [p.wait() for p in procs]
+    bad = [(hosts[i], c) for i, c in enumerate(codes) if c != 0]
+    if bad:
+        raise SystemExit("workers failed: %s" % bad)
+    return 0
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Launch a distributed mxnet_tpu job",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    parser.add_argument("-n", "--num-workers", required=True, type=int,
+                        help="number of worker processes")
+    parser.add_argument("-s", "--num-servers", type=int, default=0,
+                        help="accepted for reference CLI parity; the collective "
+                             "runtime has no server role, so this is ignored")
+    parser.add_argument("-H", "--hostfile", type=str,
+                        help="file with one hostname per line (ssh launcher)")
+    parser.add_argument("--launcher", type=str, default="local",
+                        choices=["local", "ssh"],
+                        help="mpi/sge/yarn launchers of the reference are "
+                             "cluster-manager specific; local and ssh cover "
+                             "the dev and bare-metal paths")
+    parser.add_argument("--port", type=int, default=None,
+                        help="coordinator port on host 0 (ssh launcher)")
+    parser.add_argument("--verbose", action="store_true")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="command to run on every worker")
+    args = parser.parse_args()
+    if not args.command:
+        parser.error("no command given")
+    if args.num_servers:
+        print("note: -s/--num-servers ignored — collectives replace the "
+              "parameter-server role (see SURVEY §5.8)", file=sys.stderr)
+    if args.launcher == "local":
+        return launch_local(args.num_workers, args.command, args.verbose)
+    if not args.hostfile:
+        parser.error("--hostfile is required with --launcher ssh")
+    hosts = [h.strip() for h in open(args.hostfile) if h.strip()]
+    return launch_ssh(args.num_workers, hosts, args.command, args.verbose,
+                      port=args.port)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
