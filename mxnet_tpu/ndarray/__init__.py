@@ -16,6 +16,7 @@ import numpy as np
 
 from ..base import parse_attr, dtype_np
 from ..context import current_context, Context
+from .. import profiler as _prof
 from ..ops import registry as _registry
 from ..ops import _load_all  # noqa: F401  (populates the registry)
 from .ndarray import NDArray, array, empty, concatenate, waitall, _wrap, _to_device
@@ -68,7 +69,12 @@ def _invoke_raw(fn, nd_args, attrs, visible=None, ctx=None):
         else:
             jargs.append(a)
             nd_inputs.append(None)
-    res = fn(*jargs, **attrs)
+    if _prof._op_profiling_active():
+        t0 = _prof._now_us()
+        res = fn(*jargs, **attrs)
+        _prof._emit_op(getattr(fn, "__name__", "op"), t0, _prof._now_us() - t0)
+    else:
+        res = fn(*jargs, **attrs)
     multi = isinstance(res, tuple)
     outs = res if multi else (res,)
     if ctx is not None:

@@ -1,0 +1,96 @@
+"""Pallas TPU kernels for the quantization hot path.
+
+The int8/uint8 (de)quantize ops (ops/quantization.py, reference
+``src/operator/quantization/quantize-inl.h``) are pure HBM-bandwidth ops:
+read fp32, write int8 + two scalars. The jnp formulation lowers to several
+XLA ops (abs, max-reduce, scale, clip, round, cast) that XLA usually fuses —
+these Pallas versions make the single-pass structure explicit (one VMEM tile
+in, one tile out, scalar range in SMEM) and serve as the template for
+further kernels (pallas_guide.md "Quantization Kernels" pattern).
+
+Used automatically by the quantize/dequantize ops on TPU for tile-aligned
+inputs; the jnp path remains the fallback (CPU tests run it via
+``interpret=True`` coverage here).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["quantize_int8_pallas", "dequantize_int8_pallas", "supported"]
+
+_LANE = 128
+# minimum sublane count per dtype (pallas_guide.md tiling constraints)
+_MIN_SUBLANES = {jnp.dtype(jnp.float32): 8, jnp.dtype(jnp.bfloat16): 16,
+                 jnp.dtype(jnp.int8): 32}
+
+
+def supported(shape, dtype):
+    """Tile-aligned 2D-reshapeable arrays of a pallas-kernel dtype on TPU."""
+    try:
+        import jax.experimental.pallas  # noqa: F401
+    except ImportError:  # pragma: no cover
+        return False
+    sub = _MIN_SUBLANES.get(jnp.dtype(dtype))
+    if sub is None:
+        return False
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n >= sub * _LANE and n % (sub * _LANE) == 0
+
+
+def _q_kernel(x_ref, scale_ref, out_ref):
+    """Symmetric int8: q = sign(x) * min(|x|*127/range + 0.5, 127)
+    (reference quantize-inl.h:70-80)."""
+    scale = scale_ref[0]
+    x = x_ref[:]
+    q = jnp.sign(x) * jnp.minimum(jnp.abs(x) * scale + 0.5, 127.0)
+    out_ref[:] = q.astype(jnp.int8)
+
+
+def _dq_kernel(q_ref, scale_ref, out_ref):
+    out_ref[:] = q_ref[:].astype(jnp.float32) * scale_ref[0]
+
+
+def _tiled_elementwise(kernel, x, scale, out_dtype, interpret):
+    """Shared scaffolding: flatten to (rows, 128) tiles, grid over row
+    blocks, scalar in SMEM — the template for further elementwise kernels."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    shape = x.shape
+    flat = x.reshape(-1, _LANE)
+    rows = flat.shape[0]
+    block = min(rows, 512)
+    while rows % block:
+        block //= 2
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(flat.shape, out_dtype),
+        grid=(rows // block,),
+        in_specs=[
+            pl.BlockSpec((block, _LANE), lambda i: (i, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((block, _LANE), lambda i: (i, 0)),
+        interpret=interpret,
+    )(flat, scale)
+    return out.reshape(shape)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def quantize_int8_pallas(x, real_range, interpret=False):
+    """x: fp32 (any tile-aligned shape); real_range: scalar max-abs.
+    Returns int8 of the same shape."""
+    scale = (127.0 / real_range).reshape(1).astype(jnp.float32)
+    return _tiled_elementwise(_q_kernel, x, scale, jnp.int8, interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def dequantize_int8_pallas(q, real_range, interpret=False):
+    """Inverse of quantize_int8_pallas."""
+    scale = (real_range / 127.0).reshape(1).astype(jnp.float32)
+    return _tiled_elementwise(_dq_kernel, q, scale, jnp.float32, interpret)

@@ -49,8 +49,13 @@ def quantize(data, min_range, max_range, *, out_type="uint8"):
         q = jnp.clip((data - mn) * scale + 0.5, lo, hi).astype(jnp.uint8)
         return q, mn.reshape(1), mx_.reshape(1)
     real_range = _maxabs(mn, mx_)
-    scale = 127.0 / real_range
-    q = (jnp.sign(data) * jnp.minimum(jnp.abs(data) * scale + 0.5, 127.0)).astype(jnp.int8)
+    from .pallas_kernels import quantize_int8_pallas, supported as _pallas_ok
+
+    if jax.default_backend() == "tpu" and _pallas_ok(data.shape, data.dtype):
+        q = quantize_int8_pallas(data, real_range)
+    else:
+        scale = 127.0 / real_range
+        q = (jnp.sign(data) * jnp.minimum(jnp.abs(data) * scale + 0.5, 127.0)).astype(jnp.int8)
     return q, (-real_range).reshape(1), real_range.reshape(1)
 
 
@@ -66,6 +71,11 @@ def dequantize(data, min_range, max_range, *, out_type="float32"):
         real = _maxabs(mn, mx_)
         return data.astype(jnp.float32) * (real / INT32_MAX)
     real = _maxabs(mn, mx_)
+    from .pallas_kernels import dequantize_int8_pallas, supported as _pallas_ok
+
+    if (data.dtype == jnp.int8 and jax.default_backend() == "tpu"
+            and _pallas_ok(data.shape, data.dtype)):
+        return dequantize_int8_pallas(data, real)
     return data.astype(jnp.float32) * (real / 127.0)
 
 

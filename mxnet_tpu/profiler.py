@@ -73,6 +73,31 @@ def _emit(ev):
         _events.append(ev)
 
 
+def _op_profiling_active():
+    """Fast check for the eager frontend's per-op hook."""
+    return (
+        _state == "run"
+        and not _paused
+        and (_config["profile_imperative"] or _config["profile_all"])
+    )
+
+
+def _emit_op(name, t0_us, dur_us):
+    """One operator execution (reference ThreadedEngine::ExecuteOprBlock
+    bracketing, threaded_engine.h:335). Eager jax dispatch is async, so the
+    duration covers trace+enqueue (and compile on first call) — the XLA
+    device timeline comes from use_xla_trace."""
+    _emit({
+        "name": name,
+        "cat": "operator",
+        "ph": "X",
+        "ts": t0_us,
+        "dur": dur_us,
+        "pid": 0,
+        "tid": threading.get_ident() % 1_000_000,
+    })
+
+
 def set_config(**kwargs):
     """Configure the profiler (reference ``profiler.py:28`` set_config).
 

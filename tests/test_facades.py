@@ -109,3 +109,43 @@ def test_split_input_slice():
     assert slices == [slice(0, 5), slice(5, 10)]
     slices = _split_input_slice(9, [2, 1])
     assert slices[0] == slice(0, 6)
+
+
+class TestProfilerOpEvents:
+    def test_chrome_trace_records_operators(self, tmp_path):
+        import json
+        import mxnet_tpu as mx
+        from mxnet_tpu import nd
+
+        fn = str(tmp_path / "profile.json")
+        mx.profiler.set_config(profile_all=True, filename=fn)
+        mx.profiler.set_state("run")
+        x = nd.ones((32, 32))
+        x = nd.dot(x, x)
+        x = nd.relu(x)
+        x.wait_to_read()
+        mx.profiler.set_state("stop")
+        mx.profiler.dump()
+        j = json.load(open(fn))
+        names = [e["name"] for e in j["traceEvents"]]
+        assert "dot" in names and "relu" in names
+        # duration events carry the chrome-trace complete-event fields
+        ev = next(e for e in j["traceEvents"] if e["name"] == "dot")
+        assert ev["ph"] == "X" and ev["dur"] >= 0 and ev["cat"] == "operator"
+
+    def test_pause_resume(self, tmp_path):
+        import json
+        import mxnet_tpu as mx
+        from mxnet_tpu import nd
+
+        fn = str(tmp_path / "p2.json")
+        mx.profiler.set_config(profile_all=True, filename=fn)
+        mx.profiler.set_state("run")
+        mx.profiler.pause()
+        nd.tanh(nd.ones((4, 4))).wait_to_read()
+        mx.profiler.resume()
+        nd.sigmoid(nd.ones((4, 4))).wait_to_read()
+        mx.profiler.set_state("stop")
+        mx.profiler.dump()
+        names = [e["name"] for e in json.load(open(fn))["traceEvents"]]
+        assert "sigmoid" in names and "tanh" not in names

@@ -1,0 +1,129 @@
+"""Finite-difference gradient sweep over core operators — the reference's
+central test discipline (tests/python/unittest/test_operator.py drives
+check_numeric_gradient on nearly every op, test_utils.py:792). Small shapes
+keep the O(n) central differences cheap."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+
+def _rand(*shape, scale=1.0, shift=0.0):
+    rng = np.random.RandomState(hash(shape) % (2**31))
+    return (rng.randn(*shape) * scale + shift).astype(np.float32)
+
+
+UNARY_CASES = [
+    ("sigmoid", lambda x: nd.sigmoid(x), _rand(3, 4)),
+    ("tanh", lambda x: nd.tanh(x), _rand(3, 4)),
+    ("relu_offset", lambda x: nd.relu(x), _rand(3, 4, shift=3.0)),  # away from kink
+    ("exp", lambda x: nd.exp(x), _rand(3, 4, scale=0.5)),
+    ("log", lambda x: nd.log(x), np.abs(_rand(3, 4)) + 1.0),
+    ("sqrt", lambda x: nd.sqrt(x), np.abs(_rand(3, 4)) + 1.0),
+    ("square", lambda x: nd.square(x), _rand(3, 4)),
+    ("softmax", lambda x: nd.softmax(x, axis=-1), _rand(3, 5)),
+    ("log_softmax", lambda x: nd.log_softmax(x, axis=-1), _rand(3, 5)),
+    ("hard_sigmoid_interior", lambda x: nd.hard_sigmoid(x), _rand(3, 4, scale=0.3)),
+    ("smooth_l1", lambda x: nd.smooth_l1(x, scalar=1.0), _rand(3, 4, scale=0.3)),
+    ("LayerNorm-ish_mean", lambda x: nd.mean(x, axis=1), _rand(4, 5)),
+    ("norm", lambda x: nd.norm(x), np.abs(_rand(3, 4)) + 0.5),
+    ("transpose_sum", lambda x: nd.transpose(x) * nd.transpose(x), _rand(3, 4)),
+    ("quadratic", lambda x: nd.quadratic(x, a=0.5, b=-1.0, c=2.0), _rand(3, 4)),
+    ("erf", lambda x: nd.erf(x), _rand(3, 4, scale=0.5)),
+    ("div_sqrt_dim", lambda x: nd.div_sqrt_dim(x), _rand(3, 8)),
+    ("linalg_sumlogdiag", lambda x: nd.linalg_sumlogdiag(x),
+     np.eye(4, dtype=np.float32) * 2 + np.abs(_rand(4, 4, scale=0.05))),
+]
+
+
+# eps ~ cbrt(fp32 machine epsilon): central differences on fp32 evaluations
+# need a much larger step than the harness's fp64-era default
+EPS = 1e-2
+RTOL = 5e-2
+
+
+@pytest.mark.parametrize("name,fn,x", UNARY_CASES, ids=[c[0] for c in UNARY_CASES])
+def test_unary_grads(name, fn, x):
+    check_numeric_gradient(fn, [x.copy()], eps=EPS, rtol=RTOL)
+
+
+BINARY_CASES = [
+    ("add", lambda a, b: a + b),
+    ("mul", lambda a, b: a * b),
+    ("div", lambda a, b: a / (b * b + 1.0)),
+    ("dot", lambda a, b: nd.dot(a, b)),
+    ("broadcast_mul", lambda a, b: a * b.reshape((1, -1))[:, :4]),
+    ("maximum_apart", lambda a, b: nd.maximum(a, b + 10.0)),
+]
+
+
+@pytest.mark.parametrize("name,fn", BINARY_CASES, ids=[c[0] for c in BINARY_CASES])
+def test_binary_grads(name, fn):
+    a = _rand(4, 4)
+    b = _rand(4, 4, shift=0.5)
+    check_numeric_gradient(fn, [a, b], eps=EPS, rtol=RTOL)
+
+
+def test_fc_grads():
+    x = _rand(3, 6)
+    w = _rand(4, 6, scale=0.5)
+    b = _rand(4, scale=0.1)
+    check_numeric_gradient(
+        lambda x_, w_, b_: nd.FullyConnected(x_, w_, b_, num_hidden=4),
+        [x, w, b], eps=EPS, rtol=RTOL)
+
+
+def test_conv_grads():
+    x = _rand(1, 2, 5, 5, scale=0.5)
+    w = _rand(3, 2, 3, 3, scale=0.3)
+    check_numeric_gradient(
+        lambda x_, w_: nd.Convolution(x_, w_, kernel=(3, 3), num_filter=3,
+                                      pad=(1, 1), no_bias=True),
+        [x, w], eps=EPS, rtol=RTOL)
+
+
+def test_pooling_grads():
+    x = _rand(1, 2, 6, 6)
+    check_numeric_gradient(
+        lambda x_: nd.Pooling(x_, kernel=(2, 2), stride=(2, 2), pool_type="avg"),
+        [x], eps=EPS, rtol=RTOL)
+
+
+def test_batchnorm_inference_grads():
+    x = _rand(3, 4, scale=0.5)
+    g = np.abs(_rand(4, scale=0.2)) + 1.0
+    b = _rand(4, scale=0.2)
+    mm = _rand(4, scale=0.1)
+    mv = np.abs(_rand(4, scale=0.1)) + 1.0
+
+    def f(x_, g_, b_):
+        return nd.BatchNorm(x_.reshape((3, 4, 1, 1)), g_, b_, nd.array(mm), nd.array(mv),
+                            fix_gamma=False, use_global_stats=True)
+
+    check_numeric_gradient(f, [x, g, b], eps=EPS, rtol=RTOL)
+
+
+def test_embedding_take_grads():
+    w = _rand(5, 4, scale=0.5)
+    idx = np.array([0, 2, 4], np.float32)
+    check_numeric_gradient(
+        lambda w_: nd.Embedding(nd.array(idx), w_, input_dim=5, output_dim=4),
+        [w], eps=EPS, rtol=RTOL)
+
+
+def test_deformable_conv_grads():
+    """The north-star op: gradients through data, offsets, and weights.
+
+    Bilinear sampling is only piecewise smooth: kinks sit on integer sample
+    coordinates and at the live-region border. pad=0 keeps all taps interior
+    and the +0.3 offset keeps samples a safe margin from integer crossings,
+    so central differences see the smooth region autograd differentiates."""
+    x = _rand(1, 2, 7, 7, scale=0.5)
+    off = np.full((1, 18, 5, 5), 0.3, np.float32) + _rand(1, 18, 5, 5, scale=0.05)
+    w = _rand(2, 2, 3, 3, scale=0.3)
+    check_numeric_gradient(
+        lambda x_, o_, w_: nd.contrib.DeformableConvolution(
+            x_, o_, w_, kernel=(3, 3), num_filter=2, no_bias=True),
+        [x, off, w], eps=5e-3, rtol=8e-2, atol=1e-2)
