@@ -1,0 +1,125 @@
+"""LSTM language model with bucketing — parity with reference
+``example/rnn/bucketing/lstm_bucketing.py`` (BucketingModule over
+BucketSentenceIter; each bucket length is one jit specialization, the
+reference's per-bucket executor).
+
+Zero-egress environment: point --data-train at a local PTB-format text file,
+or omit it to train on a generated synthetic corpus with Zipfian unigrams and
+bigram structure (learnable by the LM).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+parser = argparse.ArgumentParser(
+    description="Train an LSTM LM with bucketing",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--data-train", type=str, default=None,
+                    help="PTB-style text file; synthetic corpus when absent")
+parser.add_argument("--num-layers", type=int, default=2)
+parser.add_argument("--num-hidden", type=int, default=128)
+parser.add_argument("--num-embed", type=int, default=64)
+parser.add_argument("--kv-store", type=str, default="device")
+parser.add_argument("--num-epochs", type=int, default=5)
+parser.add_argument("--lr", type=float, default=0.01)
+parser.add_argument("--optimizer", type=str, default="adam")
+parser.add_argument("--mom", type=float, default=0.0)
+parser.add_argument("--wd", type=float, default=0.00001)
+parser.add_argument("--batch-size", type=int, default=32)
+parser.add_argument("--disp-batches", type=int, default=50)
+parser.add_argument("--num-sentences", type=int, default=2000,
+                    help="synthetic corpus size")
+
+
+def tokenize_text(fname, vocab=None, invalid_label=-1, start_label=0):
+    if not os.path.isfile(fname):
+        raise IOError("file %s not found (downloads unavailable; pass a local "
+                      "PTB-format file or omit --data-train)" % fname)
+    lines = [list(filter(None, line.split(" "))) for line in open(fname)]
+    return mx.rnn.encode_sentences(lines, vocab=vocab,
+                                   invalid_label=invalid_label,
+                                   start_label=start_label)
+
+
+def synthetic_corpus(n, vocab_size=60, seed=0):
+    """Zipfian unigrams + deterministic bigram successor structure."""
+    rng = np.random.RandomState(seed)
+    succ = rng.randint(1, vocab_size, size=vocab_size)
+    sents = []
+    for _ in range(n):
+        length = rng.randint(4, 24)
+        w = rng.zipf(1.5) % vocab_size or 1
+        sent = [int(w)]
+        for _ in range(length - 1):
+            w = succ[w] if rng.rand() < 0.8 else (rng.zipf(1.5) % vocab_size or 1)
+            sent.append(int(w))
+        sents.append(sent)
+    return sents, vocab_size
+
+
+def main():
+    logging.basicConfig(level=logging.INFO, format="%(asctime)-15s %(message)s")
+    args = parser.parse_args()
+    buckets = [10, 20, 30]
+    start_label = 1
+    invalid_label = 0
+
+    if args.data_train:
+        train_sent, vocab = tokenize_text(
+            args.data_train, start_label=start_label, invalid_label=invalid_label)
+        vocab_size = len(vocab) + start_label
+    else:
+        train_sent, vocab_size = synthetic_corpus(args.num_sentences)
+
+    data_train = mx.rnn.BucketSentenceIter(
+        train_sent, args.batch_size, buckets=buckets, invalid_label=invalid_label)
+
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(args.num_layers):
+        stack.add(mx.rnn.LSTMCell(num_hidden=args.num_hidden, prefix="lstm_l%d_" % i))
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data=data, input_dim=vocab_size,
+                                 output_dim=args.num_embed, name="embed")
+        stack.reset()
+        outputs, states = stack.unroll(seq_len, inputs=embed, merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, args.num_hidden))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab_size, name="pred")
+        label = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(pred, label=label, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    model = mx.mod.BucketingModule(
+        sym_gen=sym_gen,
+        default_bucket_key=data_train.default_bucket_key,
+        context=mx.current_context())
+
+    model.fit(
+        train_data=data_train,
+        eval_metric=mx.metric.Perplexity(invalid_label),
+        kvstore=args.kv_store,
+        optimizer=args.optimizer,
+        optimizer_params=(
+            {"learning_rate": args.lr, "wd": args.wd, "momentum": args.mom}
+            if args.optimizer in ("sgd", "nag", "signum")
+            else {"learning_rate": args.lr, "wd": args.wd}),
+        initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
+        num_epoch=args.num_epochs,
+        batch_end_callback=mx.callback.Speedometer(args.batch_size, args.disp_batches),
+    )
+    return model
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""Bucketing-LM and sparse linear-classification example tests (reference
+example/rnn/bucketing + example/sparse/linear_classification families)."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _run(script, args, cwd, timeout=600):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=1")
+    return subprocess.run(
+        [sys.executable, script] + args, cwd=cwd, env=env,
+        capture_output=True, text=True, timeout=timeout)
+
+
+def test_lstm_bucketing_perplexity_drops():
+    cwd = os.path.join(REPO, "examples", "rnn")
+    res = _run("lstm_bucketing.py",
+               ["--num-epochs", "3", "--num-sentences", "400",
+                "--batch-size", "16", "--num-hidden", "48",
+                "--num-embed", "24", "--disp-batches", "1000"], cwd)
+    assert res.returncode == 0, res.stdout + res.stderr
+    import re
+
+    ppl = [float(m) for m in re.findall(r"Train-perplexity=([0-9.]+)",
+                                        res.stdout + res.stderr)]
+    assert len(ppl) == 3 and ppl[-1] < ppl[0] * 0.7, ppl
+
+
+def test_sparse_linear_classification():
+    cwd = os.path.join(REPO, "examples", "sparse")
+    res = _run("linear_classification.py",
+               ["--epochs", "6", "--num-samples", "256"], cwd)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "SPARSE LINEAR OK" in res.stdout
