@@ -1,0 +1,107 @@
+"""Post-training INT8 quantization — parity with reference
+``example/quantization/imagenet_gen_qsym.py`` (train fp32, quantize with
+calibration, compare accuracies).
+
+Runs anywhere: trains a small convnet on a synthetic 3-class image task,
+then quantizes with each calib mode and reports accuracy deltas.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.contrib.quantization import quantize_model
+from mxnet_tpu.io import NDArrayIter
+
+
+def make_data(n, seed=0, num_classes=8):
+    """Class = which spatial quadrant+channel carries a WEAK brightness bump;
+    weak enough that fp32 lands below saturation, so int8 deltas are
+    informative."""
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, num_classes, n)
+    x = rng.rand(n, 3, 16, 16).astype(np.float32) * 0.5
+    for i in range(n):
+        ch = y[i] % 3
+        qy, qx = (y[i] // 3) % 2, (y[i] // 6) % 2
+        x[i, ch, qy * 8:qy * 8 + 8, qx * 8:qx * 8 + 8] += 0.15
+    return x, y.astype(np.float32)
+
+
+def build_net():
+    data = sym.Variable("data")
+    c1 = sym.Convolution(data, kernel=(3, 3), num_filter=16, pad=(1, 1), name="conv1")
+    r1 = sym.Activation(c1, act_type="relu", name="relu1")
+    p1 = sym.Pooling(r1, kernel=(2, 2), stride=(2, 2), pool_type="max", name="pool1")
+    c2 = sym.Convolution(p1, kernel=(3, 3), num_filter=32, pad=(1, 1), name="conv2")
+    r2 = sym.Activation(c2, act_type="relu", name="relu2")
+    p2 = sym.Pooling(r2, kernel=(2, 2), stride=(2, 2), pool_type="max", name="pool2")
+    fl = sym.Flatten(p2, name="flat")
+    fc = sym.FullyConnected(fl, num_hidden=8, name="fc")
+    return sym.SoftmaxOutput(fc, name="softmax")
+
+
+def accuracy(net_sym, params, X, y, batch_size=64):
+    exe = None
+    correct = 0
+    for i in range(0, len(X) - batch_size + 1, batch_size):
+        xb = X[i:i + batch_size]
+        if exe is None:
+            exe = net_sym.simple_bind(grad_req="null", data=xb.shape)
+            for k, v in params.items():
+                if k in exe.arg_dict:
+                    exe.arg_dict[k][:] = v
+        outs = exe.forward(is_train=False, data=nd.array(xb))
+        pred = outs[0].asnumpy().argmax(axis=1)
+        correct += (pred == y[i:i + batch_size]).sum()
+    return correct / (len(X) // batch_size * batch_size)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--num-train", type=int, default=1024)
+    p.add_argument("--num-val", type=int, default=512)
+    p.add_argument("--epochs", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--num-calib-batches", type=int, default=4)
+    args = p.parse_args()
+
+    Xtr, ytr = make_data(args.num_train, seed=0)
+    Xval, yval = make_data(args.num_val, seed=1)
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = build_net()
+    mod = mx.mod.Module(net)
+    mod.fit(NDArrayIter(Xtr, ytr, args.batch_size, shuffle=True),
+            num_epoch=args.epochs, optimizer="adam",
+            optimizer_params={"learning_rate": 1e-3},
+            initializer=mx.init.Xavier())
+    arg_params, aux_params = mod.get_params()
+
+    fp32_acc = accuracy(net, arg_params, Xval, yval, args.batch_size)
+    print("fp32 accuracy: %.4f" % fp32_acc)
+
+    for calib_mode in ("none", "naive", "entropy"):
+        kwargs = {}
+        if calib_mode != "none":
+            kwargs["calib_data"] = NDArrayIter(Xtr, ytr, args.batch_size)
+            kwargs["num_calib_examples"] = args.batch_size * args.num_calib_batches
+        qsym, qargs, _ = quantize_model(
+            net, arg_params, aux_params, calib_mode=calib_mode, **kwargs)
+        q_acc = accuracy(qsym, qargs, Xval, yval, args.batch_size)
+        print("int8 (%s calib) accuracy: %.4f  (delta %.4f)"
+              % (calib_mode, q_acc, q_acc - fp32_acc))
+        assert q_acc > fp32_acc - 0.02, (calib_mode, q_acc, fp32_acc)
+    print("QUANTIZATION EXAMPLE OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""Quantization and distributed-DP example tests."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _run(subdir, script, args, timeout=900, devices=8):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=%d" % devices)
+    return subprocess.run(
+        [sys.executable, script] + args,
+        cwd=os.path.join(REPO, "examples", subdir), env=env,
+        capture_output=True, text=True, timeout=timeout)
+
+
+def test_quantization_example():
+    res = _run("quantization", "quantize_model.py",
+               ["--num-train", "512", "--num-val", "256", "--epochs", "2"],
+               devices=1)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "QUANTIZATION EXAMPLE OK" in res.stdout
+
+
+def test_dp_training_example():
+    res = _run("distributed_training", "train_dp.py",
+               ["--steps", "20", "--batch-per-device", "4"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "DP TRAINING OK" in res.stdout
+    assert "devices=8" in res.stdout
