@@ -29,3 +29,11 @@ def test_dp_training_example():
     assert res.returncode == 0, res.stdout + res.stderr
     assert "DP TRAINING OK" in res.stdout
     assert "devices=8" in res.stdout
+
+
+def test_ring_attention_lm_example():
+    res = _run("long_context", "train_ring_attention.py",
+               ["--seq-len", "256", "--steps", "60"], timeout=1200)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "RING ATTENTION LM OK" in res.stdout
+    assert "8-way sequence parallelism" in res.stdout
