@@ -37,3 +37,10 @@ def test_ring_attention_lm_example():
     assert res.returncode == 0, res.stdout + res.stderr
     assert "RING ATTENTION LM OK" in res.stdout
     assert "8-way sequence parallelism" in res.stdout
+
+
+def test_dcgan_example():
+    res = _run("gluon", "dcgan.py",
+               ["--epochs", "2", "--batches-per-epoch", "6"], devices=1)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "DCGAN OK" in res.stdout

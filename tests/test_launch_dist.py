@@ -61,11 +61,16 @@ def test_dist_sync_kvstore_two_processes(tmp_path):
     worker = tmp_path / "worker.py"
     worker.write_text(WORKER)
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
-    res = subprocess.run(
-        [sys.executable, LAUNCH, "-n", "2", "--launcher", "local",
-         sys.executable, str(worker)],
-        env=env, capture_output=True, text=True, timeout=240,
-    )
+    # Gloo inter-process connects can time out when the host is saturated
+    # (full-suite runs on one core); one retry keeps the signal without flakes
+    for attempt in range(2):
+        res = subprocess.run(
+            [sys.executable, LAUNCH, "-n", "2", "--launcher", "local",
+             sys.executable, str(worker)],
+            env=env, capture_output=True, text=True, timeout=420,
+        )
+        if res.returncode == 0:
+            break
     assert res.returncode == 0, res.stdout + res.stderr
     lines = [l for l in res.stdout.splitlines() if "_RESULT" in l]
     assert len(lines) == 2, res.stdout + res.stderr
