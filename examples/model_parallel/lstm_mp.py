@@ -1,0 +1,173 @@
+"""Model-parallel LSTM language model over a device mesh.
+
+Reference counterpart: `example/model-parallel/` + `docs/faq/model_parallel_lstm.md`
+(each LSTM layer pinned to a different GPU via `group2ctx`, activations
+copied between devices by `_CrossDeviceCopy`;
+`tests/python/unittest/test_model_parallel.py`).
+
+The TPU-native version does not place layers on devices by hand.  The model's
+weights are *sharded* over an ``mp`` mesh axis (each chip owns a slice of
+every gate matrix), the hidden state is kept ``mp``-sharded with
+``with_sharding_constraint``, and XLA inserts the all-gather/psum collectives
+over ICI where the reference inserted explicit device-to-device copies.  This
+is strictly more parallel than the reference's scheme: every chip computes on
+every timestep instead of idling while other layers run.
+
+Run: ``./dev.sh python examples/model_parallel/lstm_mp.py`` (8-dev CPU mesh)
+or on real chips.  ``--check-replicated`` re-runs the first loss on a
+single-device replica and asserts the sharded program computes the same
+numbers — the correctness bar the reference's test_model_parallel.py sets.
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+
+def init_params(rng, vocab, embed, hidden, layers):
+    p = {"embed": rng.normal(0, 0.08, (vocab, embed)).astype(np.float32)}
+    for l in range(layers):
+        din = embed if l == 0 else hidden
+        p["wx%d" % l] = rng.normal(0, 0.08, (din, 4 * hidden)).astype(np.float32)
+        p["wh%d" % l] = rng.normal(0, 0.08, (hidden, 4 * hidden)).astype(np.float32)
+        p["b%d" % l] = np.zeros((4 * hidden,), np.float32)
+    p["wout"] = rng.normal(0, 0.08, (hidden, vocab)).astype(np.float32)
+    p["bout"] = np.zeros((vocab,), np.float32)
+    return p
+
+
+def shard_specs(layers):
+    """Tensor-parallel layout: gate/output dims split over mp (Megatron-style
+    column-parallel wx/wh, row-parallel wout ⇒ one psum per step)."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = {"embed": P(None, None), "wout": P("mp", None), "bout": P(None)}
+    for l in range(layers):
+        spec["wx%d" % l] = P(None, "mp")
+        spec["wh%d" % l] = P(None, "mp")
+        spec["b%d" % l] = P("mp")
+    return spec
+
+
+def make_loss_fn(layers, hidden, mesh):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def constrain(x, *spec):
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
+    def lstm_cell(p, l, x_t, h, c):
+        # wx/wh are column-sharded ⇒ gates land mp-sharded; h is gathered by
+        # XLA for the wh matmul (the ICI hop that replaces _CrossDeviceCopy)
+        gates = x_t @ p["wx%d" % l] + h @ p["wh%d" % l] + p["b%d" % l]
+        gates = constrain(gates, None, "mp")
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return constrain(h, None, "mp"), constrain(c, None, "mp")
+
+    def loss_fn(params, tokens):
+        # tokens: (batch, T+1) int32
+        x = params["embed"][tokens[:, :-1]]          # (B, T, E)
+        y = tokens[:, 1:]
+        B, T = y.shape
+        hc = [(jnp.zeros((B, hidden)), jnp.zeros((B, hidden)))] * layers
+
+        def step(carry, x_t):
+            hc = list(carry)
+            inp = x_t
+            for l in range(layers):
+                h, c = lstm_cell(params, l, inp, *hc[l])
+                hc[l] = (h, c)
+                inp = h
+            logits = inp @ params["wout"] + params["bout"]  # row-parallel psum
+            return tuple(hc), logits
+
+        _, logits = jax.lax.scan(step, tuple(hc), jnp.swapaxes(x, 0, 1))
+        logits = jnp.swapaxes(logits, 0, 1)          # (B, T, V)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, y[..., None], axis=-1)
+        return nll.mean()
+
+    return loss_fn
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--embed", type=int, default=64)
+    ap.add_argument("--vocab", type=int, default=32)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--seq-len", type=int, default=48)
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--lr", type=float, default=1.0)
+    ap.add_argument("--check-replicated", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mxnet_tpu import parallel
+
+    n = len(jax.devices())
+    mesh = parallel.make_mesh({"mp": n})
+    assert args.hidden % n == 0, "hidden must divide over the mp axis"
+
+    rng = np.random.RandomState(0)
+    params = init_params(rng, args.vocab, args.embed, args.hidden, args.layers)
+    specs = shard_specs(args.layers)
+    params = {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+              for k, v in params.items()}
+
+    loss_fn = make_loss_fn(args.layers, args.hidden, mesh)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def train_step(params, tokens, lr):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+        return params, loss
+
+    def sample_batch(i):
+        # learnable structure: tokens follow t_{k+1} = (t_k + stride) % V with
+        # a per-sequence stride in {1,2,3}; the LM must use its state to learn it
+        r = np.random.RandomState(1000 + i)
+        stride = r.randint(1, 4, (args.batch, 1))
+        start = r.randint(0, args.vocab, (args.batch, 1))
+        ar = np.arange(args.seq_len + 1)[None, :]
+        return ((start + stride * ar) % args.vocab).astype(np.int32)
+
+    if args.check_replicated:
+        # oracle: same math fully replicated (= single-device semantics)
+        repl = {k: jax.device_put(np.asarray(v), NamedSharding(mesh, P()))
+                for k, v in params.items()}
+        t = sample_batch(0)
+        a = float(jax.jit(loss_fn)(params, t))
+        b = float(jax.jit(loss_fn)(repl, t))
+        assert abs(a - b) < 1e-4, (a, b)
+        print("sharded-vs-replicated loss match: %.6f vs %.6f" % (a, b))
+
+    losses, t0 = [], None
+    for i in range(args.steps):
+        params, loss = train_step(params, sample_batch(i), args.lr)
+        losses.append(float(loss))
+        if i == 0:
+            t0 = time.perf_counter()
+    dt = time.perf_counter() - t0
+    toks = args.batch * args.seq_len * (args.steps - 1) / dt
+    print("mp=%d  loss %.4f -> %.4f  (%.0f tok/s)" % (n, losses[0], losses[-1], toks))
+    assert losses[-1] < losses[0] * 0.6, "model-parallel LM failed to learn"
+    print("MODEL PARALLEL LSTM OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -59,6 +59,7 @@ def __getattr__(name):
         "contrib": ".contrib",
         "engine": ".engine",
         "rtc": ".rtc",
+        "predictor": ".predictor",
     }
     if name in lazy:
         try:

@@ -1,0 +1,177 @@
+"""Deployment inference API — the reference's C predict API, TPU-native.
+
+The reference ships a deployment-only ABI (`include/mxnet/c_predict_api.h`,
+`src/c_api/c_predict_api.cc`): create a predictor from a symbol JSON + a
+param blob, feed inputs, run forward, read outputs — no training machinery
+linked in.  Here the same surface is a small class over the Symbol frontend:
+creation infers shapes once and compiles ONE XLA inference module
+(jit-cached per shape signature), `reshape` re-specializes, and
+`set_input/forward/get_output` mirror `MXPredSetInput/MXPredForward/
+MXPredGetOutput`.  Partial-output predictors (`MXPredCreatePartialOut`)
+select internal symbol outputs via ``get_internals()``.
+
+Reference map:
+- `MXPredCreate` / `MXPredCreatePartialOut` → ``Predictor(...)`` /
+  ``Predictor(..., output_names=[...])`` (c_predict_api.cc)
+- `MXPredReshape`       → ``Predictor.reshape``
+- `MXPredSetInput`      → ``Predictor.set_input``
+- `MXPredForward`       → ``Predictor.forward``
+- `MXPredGetOutputShape`→ ``Predictor.get_output_shape``
+- `MXPredGetOutput`     → ``Predictor.get_output``
+- `MXNDListCreate`      → ``load_ndarray_file``
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import ndarray as nd
+from . import symbol as sym_mod
+from .executor import Executor
+
+
+def load_ndarray_file(fname):
+    """Load a name→array file saved by ``nd.save`` (reference
+    ``MXNDListCreate``, c_predict_api.cc; e.g. the mean-image file used by
+    image-classification deployments)."""
+    return nd.load(fname)
+
+
+class Predictor:
+    """Inference-only executor over a saved (symbol, params) pair.
+
+    Parameters
+    ----------
+    symbol : Symbol or str
+        A Symbol, a path to ``*-symbol.json``, or a JSON string.
+    params : dict or str
+        ``{name: NDArray}`` (``arg:``/``aux:`` prefixes optional, matching
+        the checkpoint format) or a path to a ``*.params`` file.
+    input_shapes : dict
+        name → shape for every data input (reference ``input_keys`` +
+        ``input_shape_data`` of MXPredCreate).
+    output_names : list of str, optional
+        Select internal outputs by name (``MXPredCreatePartialOut``); names
+        may be given with or without the ``_output`` suffix.
+    dtype : str
+        Input/param compute dtype (deployments may pass "bfloat16" for
+        TPU-native inference; params are cast on copy).
+    """
+
+    def __init__(self, symbol, params, input_shapes, ctx=None,
+                 output_names=None, dtype="float32"):
+        if isinstance(symbol, str):
+            s = symbol.lstrip()
+            symbol = (sym_mod.load_json(symbol) if s.startswith("{")
+                      else sym_mod.load(symbol))
+        if output_names:
+            internals = symbol.get_internals()
+            avail = internals.list_outputs()
+            picked = []
+            for name in output_names:
+                cand = name if name in avail else name + "_output"
+                if cand not in avail:
+                    raise ValueError(
+                        "output %r not in graph (have e.g. %s)"
+                        % (name, avail[:8]))
+                picked.append(internals[avail.index(cand)])
+            symbol = sym_mod.Group(picked) if len(picked) > 1 else picked[0]
+        self._symbol = symbol
+        self._dtype = dtype
+        self._ctx = ctx
+        self._arg_params, self._aux_params = self._load_params(params)
+        self._input_names = list(input_shapes.keys())
+        self._build(dict(input_shapes))
+
+    @staticmethod
+    def _load_params(params):
+        if isinstance(params, str):
+            params = nd.load(params)
+        args, aux = {}, {}
+        for k, v in params.items():
+            if k.startswith("arg:"):
+                args[k[4:]] = v
+            elif k.startswith("aux:"):
+                aux[k[4:]] = v
+            else:
+                args[k] = v
+        return args, aux
+
+    def _build(self, input_shapes):
+        self._input_shapes = input_shapes
+        # every arg (inputs AND weights) is allocated in the deploy dtype, so
+        # dtype="bfloat16" really computes in bf16 on the MXU; aux states
+        # (BN running stats) stay float32, the mixed-precision norm
+        exe = self._symbol.simple_bind(
+            ctx=self._ctx, grad_req="null",
+            type_dict={n: self._dtype for n in self._symbol.list_arguments()},
+            **input_shapes)
+        # inputs (data/label) are fed per-call, never from the param file
+        # (reference c_predict_api.cc keeps arg_params and input keys disjoint)
+        weights = {k: v for k, v in self._arg_params.items()
+                   if k not in input_shapes}
+        exe.copy_params_from(weights, self._aux_params,
+                             allow_extra_params=True)
+        self._exec: Executor = exe
+        self._outputs = None
+
+    # -- c_predict_api surface ---------------------------------------------
+    def set_input(self, name, value):
+        """Stage one input array (``MXPredSetInput``)."""
+        if name not in self._input_shapes:
+            raise KeyError("unknown input %r (declared: %s)"
+                           % (name, self._input_names))
+        arr = np.asarray(
+            value.asnumpy() if hasattr(value, "asnumpy") else value)
+        if tuple(arr.shape) != tuple(self._input_shapes[name]):
+            raise ValueError(
+                "input %r shape %s != declared %s (use reshape())"
+                % (name, arr.shape, self._input_shapes[name]))
+        # NDArray assignment casts to the bound dtype (incl. bfloat16)
+        self._exec.arg_dict[name][:] = arr
+
+    def forward(self, **kwargs):
+        """Run inference (``MXPredForward``); inputs may also be passed as
+        kwargs, matching ``Executor.forward``."""
+        for k, v in kwargs.items():
+            self.set_input(k, v)
+        self._outputs = self._exec.forward(is_train=False)
+        return self._outputs
+
+    def get_output_shape(self, index=0):
+        """Output shape without running (``MXPredGetOutputShape``)."""
+        _, out_shapes, _ = self._symbol.infer_shape(**self._input_shapes)
+        return tuple(out_shapes[index])
+
+    def get_output(self, index=0):
+        """Fetch an output as numpy (``MXPredGetOutput`` copies to host)."""
+        if self._outputs is None:
+            self.forward()
+        return self._outputs[index].asnumpy()
+
+    def reshape(self, input_shapes):
+        """Re-specialize to new input shapes (``MXPredReshape``) — a new jit
+        signature; weight buffers are reused in place (``Executor.reshape``
+        keeps same-shaped arrays; shape-changing weights is an error, same
+        as the reference's shape check)."""
+        shapes = dict(self._input_shapes)
+        shapes.update(input_shapes)
+        self._input_shapes = shapes
+        self._exec = self._exec.reshape(**shapes)
+        want = (self._dtype if self._dtype == "bfloat16"
+                else str(np.dtype(self._dtype)))
+        for n in self._input_names:
+            arr = self._exec.arg_dict[n]
+            if str(arr.dtype) != want:
+                self._exec.arg_dict[n] = nd.zeros(arr.shape, dtype=self._dtype)
+        self._outputs = None
+
+    @property
+    def outputs(self):
+        return self._outputs
+
+
+def create(symbol_file, param_file, input_shapes, ctx=None, output_names=None,
+           dtype="float32"):
+    """Functional spelling of ``MXPredCreate(PartialOut)``."""
+    return Predictor(symbol_file, param_file, input_shapes, ctx=ctx,
+                     output_names=output_names, dtype=dtype)

@@ -1,0 +1,127 @@
+"""Deployment Predictor (reference c_predict_api) + bandwidth tool tests.
+
+Reference: `src/c_api/c_predict_api.cc` (MXPredCreate/SetInput/Forward/
+GetOutput/Reshape/PartialOut), `tools/bandwidth/measure.py`.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.predictor import Predictor, create, load_ndarray_file
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+
+def _mlp_symbol():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _trained_checkpoint(tmp_path):
+    sym = _mlp_symbol()
+    exe = sym.simple_bind(grad_req="null", data=(2, 8))
+    rng = np.random.RandomState(0)
+    args = {n: nd.array(rng.randn(*a.shape).astype(np.float32))
+            for n, a in exe.arg_dict.items()
+            if n not in ("data", "softmax_label")}
+    prefix = str(tmp_path / "mlp")
+    mx.model.save_checkpoint(prefix, 3, sym, args, {})
+    return prefix, args
+
+
+def test_predictor_matches_executor(tmp_path):
+    prefix, args = _trained_checkpoint(tmp_path)
+    pred = create(prefix + "-symbol.json", prefix + "-0003.params",
+                  {"data": (2, 8)})
+    x = np.random.RandomState(1).rand(2, 8).astype(np.float32)
+    pred.set_input("data", x)
+    pred.forward()
+    out = pred.get_output(0)
+    assert out.shape == (2, 4)
+    assert pred.get_output_shape(0) == (2, 4)
+    np.testing.assert_allclose(out.sum(axis=1), np.ones(2), rtol=1e-5)
+
+    # oracle: the training-side executor on the same params
+    sym, arg_params, aux_params = mx.model.load_checkpoint(prefix, 3)
+    exe = sym.simple_bind(grad_req="null", data=(2, 8))
+    exe.copy_params_from(arg_params, aux_params, allow_extra_params=True)
+    ref = exe.forward(is_train=False, data=nd.array(x))[0].asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_forward_kwargs_and_errors(tmp_path):
+    prefix, _ = _trained_checkpoint(tmp_path)
+    pred = Predictor(prefix + "-symbol.json", prefix + "-0003.params",
+                     {"data": (3, 8)})
+    x = np.zeros((3, 8), np.float32)
+    pred.forward(data=x)
+    assert pred.get_output(0).shape == (3, 4)
+    with pytest.raises(KeyError):
+        pred.set_input("bogus", x)
+    with pytest.raises(ValueError):
+        pred.set_input("data", np.zeros((1, 8), np.float32))
+
+
+def test_predictor_reshape(tmp_path):
+    prefix, _ = _trained_checkpoint(tmp_path)
+    pred = Predictor(prefix + "-symbol.json", prefix + "-0003.params",
+                     {"data": (2, 8)})
+    a = pred.forward(data=np.ones((2, 8), np.float32))[0].asnumpy()
+    pred.reshape({"data": (5, 8)})
+    b = pred.forward(data=np.ones((5, 8), np.float32))[0].asnumpy()
+    assert b.shape == (5, 4)
+    np.testing.assert_allclose(a[0], b[0], rtol=1e-5)
+
+
+def test_predictor_partial_out(tmp_path):
+    """MXPredCreatePartialOut: read an internal layer's activations."""
+    prefix, _ = _trained_checkpoint(tmp_path)
+    pred = Predictor(prefix + "-symbol.json", prefix + "-0003.params",
+                     {"data": (2, 8)}, output_names=["relu1"])
+    out = pred.forward(data=np.random.rand(2, 8).astype(np.float32))
+    relu = out[0].asnumpy()
+    assert relu.shape == (2, 16)
+    assert (relu >= 0).all()
+
+
+def test_load_ndarray_file(tmp_path):
+    f = str(tmp_path / "mean.nd")
+    nd.save(f, {"mean_img": nd.ones((3, 4))})
+    d = load_ndarray_file(f)
+    np.testing.assert_allclose(d["mean_img"].asnumpy(), np.ones((3, 4)))
+
+
+def test_bandwidth_tool_runs():
+    """tools/bandwidth.py measures psum busbw over the 8-dev CPU mesh."""
+    import bandwidth
+
+    res = bandwidth.measure([1 << 16], iters=2, warmup=1)
+    (r,) = res
+    assert r["n_devices"] >= 1
+    assert r["busbw_GBps"] > 0
+    if r["n_devices"] > 1:
+        assert r["collective"] == "psum"
+    assert bandwidth._parse_size("16M") == 16 << 20
+
+
+def test_predictor_bfloat16(tmp_path):
+    """dtype='bfloat16' really computes in bf16 (weights cast on copy)."""
+    prefix, _ = _trained_checkpoint(tmp_path)
+    pred = Predictor(prefix + "-symbol.json", prefix + "-0003.params",
+                     {"data": (2, 8)}, dtype="bfloat16")
+    assert str(pred._exec.arg_dict["fc1_weight"].dtype) == "bfloat16"
+    x = np.random.RandomState(2).rand(2, 8).astype(np.float32)
+    out = pred.forward(data=x)[0].asnumpy()
+    ref = Predictor(prefix + "-symbol.json", prefix + "-0003.params",
+                    {"data": (2, 8)}).forward(data=x)[0].asnumpy()
+    np.testing.assert_allclose(out.astype(np.float32), ref, rtol=0.1, atol=0.05)
+    pred.reshape({"data": (4, 8)})
+    assert str(pred._exec.arg_dict["data"].dtype) == "bfloat16"
+    assert pred.forward(data=np.zeros((4, 8), np.float32))[0].shape == (4, 4)
