@@ -60,6 +60,8 @@ def __getattr__(name):
         "engine": ".engine",
         "rtc": ".rtc",
         "predictor": ".predictor",
+        "th": ".torch_bridge",
+        "torch_bridge": ".torch_bridge",
     }
     if name in lazy:
         try:

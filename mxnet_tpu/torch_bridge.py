@@ -1,0 +1,270 @@
+"""Torch interop — the reference's torch plugin, TPU-native.
+
+Reference counterparts:
+- `python/mxnet/torch.py` (`mx.th.*`: torch tensor/math functions applied to
+  NDArrays via the TorchModule plugin ABI),
+- `plugin/torch/torch_module-inl.h` (`TorchModule` op: run a torch `nn`
+  module inside the framework's graph, weights owned by the framework),
+- `plugin/torch/torch_criterion-inl.h` (`TorchCriterion`: torch loss inside
+  the graph).
+
+Design: torch here is host-side (CPU build).  Pointwise/tensor functions are
+wrapped NDArray→torch→NDArray (`function`, plus a generated `mx.th.*`
+namespace, mirroring the reference's generated bindings).  `TorchModule` /
+`TorchCriterion` embed a live ``torch.nn.Module`` as a gluon Block whose
+parameters are framework-owned (updated by `Trainer`/KVStore like any other
+Parameter) and whose forward/backward run through the CustomOp bridge
+(`operator.py` → ``jax.pure_callback`` + ``custom_vjp``), with gradients
+computed by torch autograd on the host.  This mirrors the reference exactly:
+the plugin ran torch kernels on the framework's tensors inside the engine;
+here the host callback is the "device" boundary instead of TH/THC.
+
+Requires the baked-in CPU torch; import fails with a clear error otherwise.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import torch as _torch
+except ImportError as _e:  # pragma: no cover
+    raise ImportError(
+        "mxnet_tpu.th requires the 'torch' package (the reference's torch "
+        "plugin is optional too: MXNET_USE_TORCH)") from _e
+
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import operator as _op_mod
+
+__all__ = ["to_torch", "from_torch", "function", "TorchModule",
+           "TorchCriterion"]
+
+
+def to_torch(x):
+    """NDArray/numpy → host torch tensor (reference plugin's TBlob→THTensor)."""
+    if isinstance(x, NDArray):
+        x = x.asnumpy()
+    # jax-exported numpy buffers are read-only; torch wants writable memory
+    return _torch.from_numpy(np.array(x, order="C"))
+
+
+def from_torch(t, ctx=None):
+    """torch tensor → NDArray (device transfer happens lazily via jax)."""
+    return nd.array(t.detach().cpu().numpy(), ctx=ctx)
+
+
+def function(fn, name=None):
+    """Wrap a torch callable to take/return NDArrays (reference torch.py's
+    generated function wrappers).  Non-array args pass through."""
+
+    def wrapped(*args, **kwargs):
+        targs = [to_torch(a) if isinstance(a, (NDArray, np.ndarray)) else a
+                 for a in args]
+        tkw = {k: to_torch(v) if isinstance(v, (NDArray, np.ndarray)) else v
+               for k, v in kwargs.items()}
+        out = fn(*targs, **tkw)
+        if isinstance(out, _torch.Tensor):
+            return from_torch(out)
+        if isinstance(out, (tuple, list)):
+            return type(out)(from_torch(o) if isinstance(o, _torch.Tensor)
+                             else o for o in out)
+        return out
+
+    wrapped.__name__ = name or getattr(fn, "__name__", "torch_fn")
+    wrapped.__doc__ = "NDArray wrapper over torch.%s" % wrapped.__name__
+    return wrapped
+
+
+# generated namespace, mirroring the reference's auto-registered th.* ops
+_TH_FUNCS = [
+    "abs", "acos", "asin", "atan", "ceil", "cos", "cosh", "exp", "floor",
+    "log", "log1p", "neg", "round", "rsqrt", "sigmoid", "sign", "sin",
+    "sinh", "sqrt", "tan", "tanh", "trunc", "add", "sub", "mul", "div",
+    "pow", "fmod", "remainder", "clamp", "maximum", "minimum", "mm",
+    "matmul", "bmm", "dot", "cat", "stack", "squeeze", "unsqueeze", "sum",
+    "mean", "std", "var", "norm", "cumsum", "cumprod", "sort", "topk",
+]
+for _f in _TH_FUNCS:
+    if hasattr(_torch, _f):
+        globals()[_f] = function(getattr(_torch, _f), _f)
+
+
+def _flat_params(mod):
+    out, seen = [], {}
+    for n, p in mod.named_parameters():
+        flat = n.replace(".", "_")
+        if flat in seen:  # dot-mangling can collide ('a.b_w' vs 'a_b.w')
+            seen[flat] += 1
+            flat = "%s__%d" % (flat, seen[flat])
+        else:
+            seen[flat] = 0
+        out.append((flat, p))
+    return out
+
+
+class _TorchOpProp(_op_mod.CustomOpProp):
+    """CustomOpProp driving a torch module: args = [data..., params...]."""
+
+    def __init__(self, tmod, n_data, criterion=False, input_dtypes=None):
+        super().__init__(need_top_grad=not criterion)
+        self._tmod = tmod
+        self._n_data = n_data
+        self._criterion = criterion
+        self._input_dtypes = input_dtypes
+        self._shape_cache = {}
+
+    def list_arguments(self):
+        data = ["data%d" % i for i in range(self._n_data)]
+        return data + [n for n, _ in _flat_params(self._tmod)]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        # torch's own shape propagation, run ONCE per input signature on a
+        # throwaway copy (never mutates the live module's buffers — e.g.
+        # BatchNorm running stats — and never pays per-call host compute)
+        key = tuple(tuple(s) for s in in_shape[:self._n_data])
+        if key not in self._shape_cache:
+            import copy
+            probe = copy.deepcopy(self._tmod).eval()
+            dts = self._input_dtypes or [None] * self._n_data
+            with _torch.no_grad():
+                try:
+                    outs = probe(*[_torch.zeros(s, dtype=dt)
+                                   for s, dt in zip(key, dts)])
+                except (RuntimeError, TypeError):
+                    # integer-input modules (Embedding etc.)
+                    outs = probe(*[_torch.zeros(s, dtype=_torch.long)
+                                   for s in key])
+            out = outs[0] if isinstance(outs, (tuple, list)) else outs
+            self._shape_cache[key] = tuple(out.shape)
+        return in_shape, [self._shape_cache[key]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        prop = self
+
+        class _TorchOp(_op_mod.CustomOp):
+            def _run(self, in_data, want_grad, is_train):
+                n = prop._n_data
+                dts = prop._input_dtypes or [None] * n
+                xs = [to_torch(a).to(dt) if dt is not None
+                      else to_torch(a).float()
+                      for a, dt in zip(in_data[:n], dts)]
+                plist = _flat_params(prop._tmod)
+                with _torch.no_grad():
+                    for (pname, p), arr in zip(plist, in_data[n:]):
+                        p.copy_(to_torch(arr).float())
+                # grad flags must be set BEFORE the forward builds the graph
+                # (user-frozen torch params would otherwise silently drop out)
+                for x in xs:
+                    if x.is_floating_point():
+                        x.requires_grad_(want_grad)
+                for _, p in plist:
+                    p.requires_grad_(want_grad)
+                prop._tmod.train(bool(is_train))
+                out = prop._tmod(*xs)
+                if isinstance(out, (tuple, list)):
+                    out = out[0]
+                return xs, [p for _, p in plist], out
+
+            def forward(self, is_train, req, in_data, out_data, aux):
+                # stash the RNG state so backward's recompute replays the
+                # SAME stochastic pass (dropout masks etc.)
+                self._rng_state = _torch.get_rng_state()
+                self._was_train = bool(is_train)
+                # the vjp machinery may replay this forward several times;
+                # keep it buffer-pure and let backward apply the one real
+                # stateful update (BN running stats etc.)
+                bufs = [(b, b.detach().clone())
+                        for b in prop._tmod.buffers()] if is_train else []
+                with _torch.no_grad():
+                    _, _, out = self._run(in_data, want_grad=False,
+                                          is_train=is_train)
+                    for b, saved in bufs:
+                        b.copy_(saved)
+                self.assign(out_data[0], req[0], from_torch(out))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+                # recompute forward under torch autograd (reference plugin
+                # called the module's own backward; CustomOp re-presents
+                # in_data here, same contract), replaying forward's RNG
+                if getattr(self, "_rng_state", None) is not None:
+                    _torch.set_rng_state(self._rng_state)
+                # this recompute applies the step's ONE stateful buffer
+                # update (forward keeps buffers pure — it may be replayed)
+                xs, ps, out = self._run(
+                    in_data, want_grad=True,
+                    is_train=getattr(self, "_was_train", True))
+                head = (to_torch(out_grad[0]).float() if not prop._criterion
+                        else _torch.ones_like(out))
+                grads = _torch.autograd.grad(
+                    out, [t for t in xs + ps if t.requires_grad],
+                    grad_outputs=head, allow_unused=True)
+                it = iter(grads)
+                grads = [next(it) if t.requires_grad else None
+                         for t in xs + ps]
+                for i, g in enumerate(grads):
+                    if g is None:
+                        g = _torch.zeros_like((xs + ps)[i])
+                    self.assign(in_grad[i], req[i], from_torch(g))
+
+        return _TorchOp()
+
+
+_INSTANCE_COUNT = [0]
+
+
+def _register_prop(tmod, n_data, criterion, input_dtypes=None):
+    # unique per wrapper instance: wrapping the same torch module twice (or
+    # with different num_data) must not alias registrations
+    _INSTANCE_COUNT[0] += 1
+    key = "_torch_module_%d" % _INSTANCE_COUNT[0]
+
+    @_op_mod.register(key)
+    class _Prop(_TorchOpProp):
+        def __init__(self):
+            super().__init__(tmod, n_data, criterion, input_dtypes)
+
+    return key
+
+
+class TorchModule:
+    """Embed a ``torch.nn.Module`` in the framework (reference
+    `plugin/torch/torch_module-inl.h`): parameters are framework NDArrays
+    (initialized from the torch module's state, updatable by any Trainer /
+    optimizer / KVStore path), execution is torch on host via the CustomOp
+    bridge, gradients flow through `autograd.record()` like any op.
+    """
+
+    def __init__(self, torch_module, num_data=1, input_dtypes=None,
+                 _criterion=False):
+        self._tmod = torch_module.float()
+        self._n_data = num_data
+        self._criterion = _criterion
+        if input_dtypes is not None:
+            input_dtypes = [getattr(_torch, d) if isinstance(d, str) else d
+                            for d in input_dtypes]
+        self._key = _register_prop(self._tmod, num_data, _criterion,
+                                   input_dtypes)
+        self._params = {n: from_torch(p) for n, p in _flat_params(self._tmod)}
+        for p in self._params.values():
+            p.attach_grad()
+
+    @property
+    def params(self):
+        """name → NDArray (attach_grad'ed; pass to your optimizer)."""
+        return self._params
+
+    def __call__(self, *data):
+        args = list(data) + [self._params[n]
+                             for n, _ in _flat_params(self._tmod)]
+        return nd.Custom(*args, op_type=self._key)
+
+
+class TorchCriterion(TorchModule):
+    """Torch loss inside the graph (reference torch_criterion-inl.h);
+    ``need_top_grad=False`` — it is a terminal loss node."""
+
+    def __init__(self, torch_loss, num_data=2):
+        super().__init__(torch_loss, num_data, _criterion=True)

@@ -1,0 +1,143 @@
+"""Torch plugin bridge tests (reference plugin/torch + python/mxnet/torch.py;
+reference gpu tests exercised TorchModule/TorchCriterion inside graphs)."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu import th
+
+
+def test_th_functions_match_torch():
+    x = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+    out = th.exp(nd.array(x))
+    np.testing.assert_allclose(out.asnumpy(), np.exp(x), rtol=1e-6)
+    a, b = np.random.rand(2, 3, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        th.mm(nd.array(a), nd.array(b.T)).asnumpy(), a @ b.T, rtol=1e-5)
+    # kwargs + non-array args pass through
+    np.testing.assert_allclose(
+        th.clamp(nd.array(x), 0.2, 0.8).asnumpy(), np.clip(x, 0.2, 0.8))
+    tk = th.topk(nd.array(x), 2)
+    assert tk[0].shape == (3, 2)
+
+
+def test_to_from_torch_roundtrip():
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    t = th.to_torch(nd.array(x))
+    assert isinstance(t, torch.Tensor)
+    np.testing.assert_allclose(th.from_torch(t).asnumpy(), x)
+
+
+def test_torch_module_forward_matches_torch():
+    tnet = torch.nn.Sequential(
+        torch.nn.Linear(8, 16), torch.nn.Tanh(), torch.nn.Linear(16, 4))
+    bridged = th.TorchModule(tnet)
+    x = np.random.RandomState(1).rand(5, 8).astype(np.float32)
+    out = bridged(nd.array(x)).asnumpy()
+    with torch.no_grad():
+        ref = tnet(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_torch_module_trains_under_autograd():
+    """Gradients flow through autograd.record into framework-owned params,
+    and a plain SGD step reduces a torch-computed loss (the reference plugin's
+    whole point: torch layers as first-class graph citizens)."""
+    torch.manual_seed(0)
+    tnet = torch.nn.Sequential(torch.nn.Linear(4, 8), torch.nn.Tanh(),
+                               torch.nn.Linear(8, 1))
+    bridged = th.TorchModule(tnet)
+    rng = np.random.RandomState(0)
+    X = rng.rand(32, 4).astype(np.float32)
+    Y = (X.sum(axis=1, keepdims=True) * 0.5).astype(np.float32)
+
+    losses = []
+    for _ in range(40):
+        x, y = nd.array(X), nd.array(Y)
+        with autograd.record():
+            pred = bridged(x)
+            loss = ((pred - y) ** 2).mean()
+        loss.backward()
+        for p in bridged.params.values():
+            p[:] = p - 0.1 * p.grad
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0] * 0.5, losses[::10]
+
+
+def test_torch_criterion():
+    crit = th.TorchCriterion(torch.nn.MSELoss())
+    x = np.random.RandomState(2).rand(6, 3).astype(np.float32)
+    y = np.zeros((6, 3), np.float32)
+    xin = nd.array(x)
+    xin.attach_grad()
+    with autograd.record():
+        loss = crit(xin, nd.array(y))
+    loss.backward()
+    np.testing.assert_allclose(float(loss.asnumpy()), (x ** 2).mean(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(xin.grad.asnumpy(), 2 * x / x.size, rtol=1e-4)
+
+
+def test_torch_module_dropout_eval_deterministic():
+    """is_train=False must disable dropout (review finding: is_train was
+    ignored, making inference stochastic)."""
+    tnet = torch.nn.Sequential(torch.nn.Linear(4, 4), torch.nn.Dropout(0.5))
+    bridged = th.TorchModule(tnet)
+    x = nd.array(np.random.RandomState(0).rand(8, 4).astype(np.float32))
+    a = bridged(x).asnumpy()
+    b = bridged(x).asnumpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_torch_module_bn_stats_not_mutated_at_inference():
+    """Inference (and shape inference) must not touch BatchNorm running
+    stats (review finding: infer_shape ran the live module on zeros)."""
+    bn = torch.nn.BatchNorm1d(4)
+    bridged = th.TorchModule(bn)
+    x = nd.array(np.random.RandomState(0).rand(8, 4).astype(np.float32) + 3)
+    bridged(x)  # inference call, no autograd.record
+    np.testing.assert_array_equal(bn.running_mean.numpy(), np.zeros(4))
+    # training DOES update stats (once, not twice)
+    with autograd.record():
+        out = bridged(x)
+    out.backward()
+    expected = 0.1 * th.to_torch(x).float().mean(0).numpy()
+    np.testing.assert_allclose(bn.running_mean.numpy(), expected, rtol=1e-4)
+
+
+def test_torch_module_frozen_params_still_get_grads():
+    """Framework-owned params train even if the torch module had
+    requires_grad=False (review finding: grad flag set after forward)."""
+    lin = torch.nn.Linear(3, 2)
+    for p in lin.parameters():
+        p.requires_grad_(False)
+    bridged = th.TorchModule(lin)
+    x = nd.array(np.random.RandomState(0).rand(4, 3).astype(np.float32))
+    with autograd.record():
+        loss = (bridged(x) ** 2).sum()
+    loss.backward()
+    assert any(np.abs(p.grad.asnumpy()).sum() > 0
+               for p in bridged.params.values())
+
+
+def test_torch_module_wrap_twice_no_alias():
+    """Wrapping the same torch module twice must not alias registrations
+    (review finding: registry keyed by id(module))."""
+    lin = torch.nn.Linear(3, 3)
+    b1 = th.TorchModule(lin, num_data=1)
+    b2 = th.TorchModule(lin, num_data=1)
+    assert b1._key != b2._key
+    x = nd.array(np.eye(3, dtype=np.float32))
+    np.testing.assert_allclose(b1(x).asnumpy(), b2(x).asnumpy(), rtol=1e-6)
+
+
+def test_torch_embedding_module():
+    """Integer-input modules work (shape probe falls back to long zeros)."""
+    emb = torch.nn.Embedding(10, 6)
+    bridged = th.TorchModule(emb, input_dtypes=["int64"])
+    idx = nd.array(np.array([[1, 2], [3, 4]], np.float32))
+    out = bridged(idx)
+    assert out.shape == (2, 2, 6)
