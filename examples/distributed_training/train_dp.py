@@ -28,6 +28,11 @@ def main():
     p.add_argument("--classes", type=int, default=10)
     p.add_argument("--image-size", type=int, default=32)
     p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--ckpt-dir", default=None,
+                   help="rotating sharded checkpoints + resume-from-latest "
+                        "(the reference's recovery story: epoch checkpoints "
+                        "+ relaunch, SURVEY §5.3/§5.4)")
+    p.add_argument("--ckpt-every", type=int, default=8)
     args = p.parse_args()
 
     import jax
@@ -57,13 +62,29 @@ def main():
     batch_sh = NamedSharding(mesh, P("dp"))
     state = jax.tree_util.tree_map(lambda v: jax.device_put(v, repl), state)
 
+    mgr, start_step = None, 0
+    if args.ckpt_dir:
+        from mxnet_tpu.parallel import checkpoint as ckpt
+
+        mgr = ckpt.CheckpointManager(args.ckpt_dir, max_to_keep=2)
+        if mgr.latest_step() is not None:
+            start_step = mgr.latest_step()
+            state = mgr.restore(like=state)
+            print("resumed from step %d" % start_step)
+        if start_step >= args.steps:
+            print("checkpoint already at step %d >= --steps %d; nothing to do"
+                  % (start_step, args.steps))
+            mgr.close()
+            print("DP TRAINING OK")
+            return
+
     batch = n * args.batch_per_device
     rng = np.random.RandomState(0)
     jstep = jax.jit(step, donate_argnums=(0,))
 
     losses = []
     t0 = None
-    for i in range(args.steps):
+    for i in range(start_step, args.steps):
         y_np = rng.randint(0, args.classes, (batch,))
         x_np = rng.rand(batch, 3, args.image_size, args.image_size).astype(np.float32) * 0.2
         for b in range(batch):  # learnable signal: class-indexed bright band
@@ -72,13 +93,20 @@ def main():
         y = jax.device_put(y_np.astype(np.float32), batch_sh)
         state, loss = jstep(state, x, y, jax.random.PRNGKey(i))
         losses.append(float(jax.block_until_ready(loss)))
-        if i == 0:
+        if mgr is not None and (i + 1) % args.ckpt_every == 0:
+            mgr.save(i + 1, state, force=True)  # async; overlaps next step
+        if i == start_step:
             t0 = time.perf_counter()  # exclude compile
+    if mgr is not None:
+        mgr.wait_until_finished()
+        mgr.close()
     dt = time.perf_counter() - t0
-    imgs = batch * (args.steps - 1) / dt if args.steps > 1 else 0
+    n_timed = args.steps - start_step - 1
+    imgs = batch * n_timed / dt if n_timed > 0 else 0
     print("devices=%d global-batch=%d  loss %.4f -> %.4f  %.1f img/s"
           % (n, batch, losses[0], losses[-1], imgs))
-    assert np.mean(losses[-3:]) < losses[0], "loss did not decrease"
+    if start_step == 0:
+        assert np.mean(losses[-3:]) < losses[0], "loss did not decrease"
     print("DP TRAINING OK")
 
 

@@ -66,7 +66,7 @@ def __getattr__(name):
     if name in lazy:
         try:
             mod = importlib.import_module(lazy[name], __name__)
-        except ModuleNotFoundError as e:
+        except ImportError as e:
             # keep hasattr()-style feature detection working
             raise AttributeError(
                 "module %r has no attribute %r (%s)" % (__name__, name, e)

@@ -112,5 +112,10 @@ def register(reg_name):
     return do_register
 
 
+def unregister(reg_name):
+    """Remove a registered CustomOpProp (frees per-instance registrations)."""
+    _custom.unregister_prop(reg_name)
+
+
 def get_all_registered_operators():
     return list(_custom.PROP_REGISTRY)

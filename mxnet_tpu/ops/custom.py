@@ -24,6 +24,14 @@ def register_prop(op_type, prop_cls):
     PROP_REGISTRY[op_type] = prop_cls
 
 
+def unregister_prop(op_type):
+    """Remove a registration (used by wrappers that register per-instance,
+    e.g. torch_bridge.TorchModule, so wrapped modules can be released)."""
+    PROP_REGISTRY.pop(op_type, None)
+    for key in [k for k in _META_PROP_CACHE if k[0] == op_type]:
+        _META_PROP_CACHE.pop(key, None)
+
+
 _META_PROP_CACHE = {}
 
 

@@ -28,6 +28,7 @@ from .mesh import (
 )
 from .collectives import allreduce, allgather, reduce_scatter, pmean, psum_scatter
 from . import dist
+from . import checkpoint
 from .ring import ring_attention, ring_self_attention
 
 __all__ = [
@@ -46,6 +47,7 @@ __all__ = [
     "pmean",
     "psum_scatter",
     "dist",
+    "checkpoint",
     "ring_attention",
     "ring_self_attention",
 ]

@@ -105,13 +105,22 @@ def _flat_params(mod):
 class _TorchOpProp(_op_mod.CustomOpProp):
     """CustomOpProp driving a torch module: args = [data..., params...]."""
 
-    def __init__(self, tmod, n_data, criterion=False, input_dtypes=None):
+    def __init__(self, tmod, n_data, criterion=False, input_dtypes=None,
+                 shape_cache=None):
         super().__init__(need_top_grad=not criterion)
         self._tmod = tmod
         self._n_data = n_data
         self._criterion = criterion
         self._input_dtypes = input_dtypes
-        self._shape_cache = {}
+        # shared across prop instances (a fresh prop is built per execution):
+        # the probe forward must run once per signature, not once per call
+        self._shape_cache = {} if shape_cache is None else shape_cache
+
+    def infer_type(self, in_type):
+        # the bridge computes in torch float32 regardless of index-typed
+        # inputs; without this, integer inputs would imply integer outputs
+        # and truncate the module's float results
+        return list(in_type), [np.dtype(np.float32)], []
 
     def list_arguments(self):
         data = ["data%d" % i for i in range(self._n_data)]
@@ -220,11 +229,13 @@ def _register_prop(tmod, n_data, criterion, input_dtypes=None):
     # with different num_data) must not alias registrations
     _INSTANCE_COUNT[0] += 1
     key = "_torch_module_%d" % _INSTANCE_COUNT[0]
+    shape_cache = {}  # class-level: survives per-execution prop instances
 
     @_op_mod.register(key)
     class _Prop(_TorchOpProp):
         def __init__(self):
-            super().__init__(tmod, n_data, criterion, input_dtypes)
+            super().__init__(tmod, n_data, criterion, input_dtypes,
+                             shape_cache=shape_cache)
 
     return key
 
@@ -247,9 +258,18 @@ class TorchModule:
                             for d in input_dtypes]
         self._key = _register_prop(self._tmod, num_data, _criterion,
                                    input_dtypes)
+        # release the registry entry (and the captured torch module) when
+        # this wrapper is garbage-collected
+        import weakref
+        self._finalizer = weakref.finalize(
+            self, _op_mod.unregister, self._key)
         self._params = {n: from_torch(p) for n, p in _flat_params(self._tmod)}
         for p in self._params.values():
             p.attach_grad()
+
+    def close(self):
+        """Explicitly unregister (also runs automatically on GC)."""
+        self._finalizer()
 
     @property
     def params(self):

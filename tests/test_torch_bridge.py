@@ -135,9 +135,25 @@ def test_torch_module_wrap_twice_no_alias():
 
 
 def test_torch_embedding_module():
-    """Integer-input modules work (shape probe falls back to long zeros)."""
+    """Integer-input modules work (shape probe falls back to long zeros) and
+    integer inputs do NOT truncate the float output (review finding:
+    default infer_type propagated in_type[0] to the output)."""
     emb = torch.nn.Embedding(10, 6)
     bridged = th.TorchModule(emb, input_dtypes=["int64"])
-    idx = nd.array(np.array([[1, 2], [3, 4]], np.float32))
-    out = bridged(idx)
+    idx_np = np.array([[1, 2], [3, 4]])
+    out = bridged(nd.array(idx_np.astype(np.int32), dtype="int32"))
     assert out.shape == (2, 2, 6)
+    assert np.dtype(out.dtype) == np.float32
+    with torch.no_grad():
+        ref = emb(torch.from_numpy(idx_np)).numpy()
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-6)
+
+
+def test_torch_module_close_unregisters():
+    """Per-instance registrations are released (review finding: leak)."""
+    lin = torch.nn.Linear(2, 2)
+    b = th.TorchModule(lin)
+    key = b._key
+    assert key in mx.operator.get_all_registered_operators()
+    b.close()
+    assert key not in mx.operator.get_all_registered_operators()
