@@ -79,12 +79,14 @@ def main():
             return
 
     batch = n * args.batch_per_device
-    rng = np.random.RandomState(0)
     jstep = jax.jit(step, donate_argnums=(0,))
 
     losses = []
     t0 = None
     for i in range(start_step, args.steps):
+        # per-step seed: a resumed run draws the SAME stream positions an
+        # uninterrupted run would (exact-resume continuity)
+        rng = np.random.RandomState(1234 + i)
         y_np = rng.randint(0, args.classes, (batch,))
         x_np = rng.rand(batch, 3, args.image_size, args.image_size).astype(np.float32) * 0.2
         for b in range(batch):  # learnable signal: class-indexed bright band

@@ -75,14 +75,21 @@ def async_save(path, tree, force=True):
     shards serialize (the device→host copy happens before return, so the
     next step may freely donate/overwrite the arrays).
 
+    One long-lived AsyncCheckpointer is shared by all calls (repeated saves
+    reuse its worker instead of leaking one thread pool per call; a second
+    save first waits for the previous commit, orbax's usual pipelining).
     Returns an object with ``wait_until_finished()``; :func:`wait_all`
     drains every pending save (call before exit — mirrors the reference's
     ``Engine::WaitForAll`` before shutdown).
     """
-    ckptr = _checkpointer(use_async=True)
-    ckptr.save(os.path.abspath(path), _to_jax_tree(tree), force=force)
     with _LOCK:
-        _PENDING.append(ckptr)
+        if not _PENDING:
+            import atexit
+
+            _PENDING.append(_checkpointer(use_async=True))
+            atexit.register(wait_all)
+        ckptr = _PENDING[0]
+    ckptr.save(os.path.abspath(path), _to_jax_tree(tree), force=force)
     return ckptr
 
 

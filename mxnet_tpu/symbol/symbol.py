@@ -206,13 +206,29 @@ class Symbol:
                 if s is not None:
                     known[n] = tuple(s)
         known.update({k: tuple(v) for k, v in kwargs.items() if v is not None})
-        shapes, dtypes = _infer_graph(self, known, {})
+        try:
+            shapes, dtypes = _infer_graph(self, known, {})
+        except MXNetError:
+            raise
+        except Exception as e:
+            # name the underdetermined inputs, like the reference's
+            # InferShape error listing unknown arguments; a failure with
+            # all inputs known is an op-level mismatch — report it as-is
+            hinted = {n.name for n in self._walk()
+                      if n.is_var and n._shape_hint}
+            missing = [n for n in arg_names
+                       if n not in known and n not in hinted]
+            suffix = (" (no shape known for arguments: %s)" % missing
+                      if missing else "")
+            raise MXNetError("infer_shape error: %s%s" % (e, suffix)) from e
         aux_names = self.list_auxiliary_states()
         arg_shapes = [shapes.get(n) for n in arg_names]
         aux_shapes = [shapes.get(n) for n in aux_names]
-        out_shapes = [shapes[o] for o in self.list_outputs()]
-        if not partial and any(s is None for s in arg_shapes + aux_shapes):
-            missing = [n for n in arg_names + aux_names if shapes.get(n) is None]
+        out_shapes = [shapes.get(o) for o in self.list_outputs()]
+        if not partial and any(
+                s is None for s in arg_shapes + aux_shapes + out_shapes):
+            missing = [n for n in arg_names + aux_names + self.list_outputs()
+                       if shapes.get(n) is None]
             raise MXNetError("infer_shape incomplete; unknown for: %s" % missing)
         return arg_shapes, out_shapes, aux_shapes
 

@@ -1,0 +1,151 @@
+// Native-side unit tests for the data plane (reference tests/cpp/ pattern:
+// C++ components get C++ tests — engine/storage/op harness there, the
+// RecordIO framing layer here).  Assert-based standalone binary; built and
+// run by `make -C src test` (wrapped by tests/test_native_cpp.py).
+#include <unistd.h>
+
+#include <cassert>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <string>
+#include <vector>
+
+#include "../io/recordio.h"
+
+namespace {
+
+int failures = 0;
+
+#define CHECK_TRUE(cond)                                              \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__,    \
+                   #cond);                                            \
+      ++failures;                                                     \
+    }                                                                 \
+  } while (0)
+
+std::string TempPath(const char* name) {
+  std::string dir = "/tmp";
+  if (const char* t = std::getenv("TMPDIR")) dir = t;
+  // pid suffix: concurrent runs must not clobber each other's files
+  return dir + "/" + name + "." + std::to_string(getpid());
+}
+
+// Round-trip records of many sizes, including payloads that embed the magic
+// word (must be split into continuation chunks and reassembled losslessly).
+void TestRoundTrip() {
+  const std::string path = TempPath("mxtpu_test_rio.rec");
+  std::mt19937 rng(7);
+  std::vector<std::string> records;
+  for (int i = 0; i < 64; ++i) {
+    size_t len = (i * 37) % 300 + 1;
+    std::string payload(len, '\0');
+    for (auto& c : payload) c = static_cast<char>(rng() & 0xff);
+    if (i % 5 == 0) {
+      // plant the magic word mid-payload to force chunking
+      uint32_t magic = mxtpu::RecordIOWriter::kMagic;
+      if (payload.size() >= 8) std::memcpy(&payload[2], &magic, 4);
+    }
+    records.push_back(payload);
+  }
+  std::vector<uint64_t> offsets;
+  {
+    mxtpu::RecordIOWriter w(path);
+    CHECK_TRUE(w.ok());
+    for (auto& r : records) offsets.push_back(w.WriteRecord(r.data(), r.size()));
+  }
+  {
+    mxtpu::RecordIOReader r(path);
+    CHECK_TRUE(r.ok());
+    std::vector<char> buf;
+    size_t n = 0;
+    while (r.NextRecord(&buf)) {
+      CHECK_TRUE(n < records.size());
+      CHECK_TRUE(buf.size() == records[n].size());
+      CHECK_TRUE(std::memcmp(buf.data(), records[n].data(), buf.size()) == 0);
+      ++n;
+    }
+    CHECK_TRUE(n == records.size());
+  }
+  // indexed access: seek straight to each record (the .idx fast path)
+  {
+    mxtpu::RecordIOReader r(path);
+    std::vector<char> buf;
+    for (size_t i = 0; i < records.size(); i += 7) {
+      r.Seek(offsets[i]);
+      CHECK_TRUE(r.NextRecord(&buf));
+      CHECK_TRUE(buf.size() == records[i].size());
+      CHECK_TRUE(std::memcmp(buf.data(), records[i].data(), buf.size()) == 0);
+    }
+  }
+  std::remove(path.c_str());
+}
+
+// Empty file and missing file behave as clean EOF / not-ok.
+void TestEdgeCases() {
+  const std::string path = TempPath("mxtpu_test_rio_empty.rec");
+  { mxtpu::RecordIOWriter w(path); CHECK_TRUE(w.ok()); }
+  {
+    mxtpu::RecordIOReader r(path);
+    CHECK_TRUE(r.ok());
+    std::vector<char> buf;
+    CHECK_TRUE(!r.NextRecord(&buf));
+  }
+  std::remove(path.c_str());
+  mxtpu::RecordIOReader missing(TempPath("definitely_not_there.rec"));
+  CHECK_TRUE(!missing.ok());
+  // zero-length record is legal
+  const std::string p2 = TempPath("mxtpu_test_rio_zero.rec");
+  {
+    mxtpu::RecordIOWriter w(p2);
+    w.WriteRecord("", 0);
+    w.WriteRecord("x", 1);
+  }
+  {
+    mxtpu::RecordIOReader r(p2);
+    std::vector<char> buf;
+    CHECK_TRUE(r.NextRecord(&buf));
+    CHECK_TRUE(buf.empty());
+    CHECK_TRUE(r.NextRecord(&buf));
+    CHECK_TRUE(buf.size() == 1 && buf[0] == 'x');
+  }
+  std::remove(p2.c_str());
+}
+
+// Tell() after write equals file position a reader can resume from
+// (mirrors python recordio.MXIndexedRecordIO index building).
+void TestTellResume() {
+  const std::string path = TempPath("mxtpu_test_rio_tell.rec");
+  uint64_t second_off;
+  {
+    mxtpu::RecordIOWriter w(path);
+    w.WriteRecord("first", 5);
+    second_off = w.Tell();
+    w.WriteRecord("second", 6);
+  }
+  {
+    mxtpu::RecordIOReader r(path);
+    r.Seek(second_off);
+    std::vector<char> buf;
+    CHECK_TRUE(r.NextRecord(&buf));
+    CHECK_TRUE(std::string(buf.begin(), buf.end()) == "second");
+  }
+  std::remove(path.c_str());
+}
+
+}  // namespace
+
+int main() {
+  TestRoundTrip();
+  TestEdgeCases();
+  TestTellResume();
+  if (failures == 0) {
+    std::printf("ALL NATIVE TESTS PASSED\n");
+    return 0;
+  }
+  std::fprintf(stderr, "%d native test failures\n", failures);
+  return 1;
+}

@@ -25,7 +25,7 @@ def test_quantization_example():
 
 def test_dp_training_example():
     res = _run("distributed_training", "train_dp.py",
-               ["--steps", "20", "--batch-per-device", "4"])
+               ["--steps", "20", "--batch-per-device", "4", "--lr", "0.05"])
     assert res.returncode == 0, res.stdout + res.stderr
     assert "DP TRAINING OK" in res.stdout
     assert "devices=8" in res.stdout

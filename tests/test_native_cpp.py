@@ -1,0 +1,19 @@
+"""Wrapper running the native C++ unit tests (reference tests/cpp/ —
+engine/storage/op C++ tests run under ctest; here `make -C src test`)."""
+import os
+import shutil
+import subprocess
+
+import pytest
+
+SRC = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "src"))
+
+
+def test_native_cpp_suite():
+    cxx = os.environ.get("CXX", "g++")
+    if shutil.which("make") is None or shutil.which(cxx) is None:
+        pytest.skip("native toolchain unavailable")
+    res = subprocess.run(["make", "-C", SRC, "test"],
+                         capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "ALL NATIVE TESTS PASSED" in res.stdout
