@@ -97,6 +97,51 @@ def _flatten(args):
     return [args], -1  # opaque non-tensor
 
 
+def _remat_forward(block, args):
+    """Trace ``block.forward`` under ``jax.checkpoint`` (see
+    ``Block.set_remat``).  The block's params (incl. mutated aux like BN
+    running stats) become explicit inputs/outputs of the rematted pure
+    function so XLA saves only the block boundary, not its interior."""
+    import jax
+
+    flat_in, in_fmt = _flatten(args)
+    if not all(isinstance(a, NDArray) for a in flat_in):
+        return block.forward(*args)  # opaque args: run un-rematted
+    params = sorted(block.collect_params().items())
+    p_vals = tuple(p._data._data for _, p in params)
+    in_vals = tuple(a._data for a in flat_in)
+    fmt_box = [None]
+
+    def pure(p_vals, in_vals):
+        old = [p._data for _, p in params]
+        for (_, p), v in zip(params, p_vals):
+            p._data = NDArray(v)
+        try:
+            ins, _ = _regroup([NDArray(v) for v in in_vals], in_fmt)
+            out = block.forward(*(ins if isinstance(ins, tuple) else (ins,)))
+        finally:
+            post = tuple(p._data._data for _, p in params)
+            for (_, p), o in zip(params, old):
+                p._data = o
+        flat_out, out_fmt = _flatten(out)
+        # non-NDArray outputs (ints, shapes, None) are trace-time constants:
+        # carry them via the box, return only tensors through the checkpoint
+        tensor_idx = [i for i, o in enumerate(flat_out)
+                      if isinstance(o, NDArray)]
+        fmt_box[0] = (out_fmt, tensor_idx, flat_out)
+        return tuple(flat_out[i]._data for i in tensor_idx), post
+
+    out_vals, post = jax.checkpoint(pure, prevent_cse=False)(p_vals, in_vals)
+    for (_, p), v in zip(params, post):
+        p._data = NDArray(v)
+    out_fmt, tensor_idx, flat_template = fmt_box[0]
+    merged = list(flat_template)
+    for i, v in zip(tensor_idx, out_vals):
+        merged[i] = NDArray(v)
+    out, _ = _regroup(merged, out_fmt)
+    return out
+
+
 def _regroup(flat, fmt):
     if fmt is None:
         return None, flat
@@ -259,10 +304,27 @@ class Block:
     def __call__(self, *args):
         for hook in self._forward_pre_hooks:
             hook(self, args)
-        out = self.forward(*args)
+        if getattr(self, "_remat", False) and _TRACING.active:
+            out = _remat_forward(self, args)
+        else:
+            out = self.forward(*args)
         for hook in self._forward_hooks:
             hook(self, args, out)
         return out
+
+    def set_remat(self, active=True):
+        """Recompute this block's activations during backward instead of
+        storing them (the reference's memory mirror,
+        ``MXNET_BACKWARD_DO_MIRROR`` → gradient-mirror path in
+        ``src/executor/graph_executor.cc InitFullGraph``; here
+        ``jax.checkpoint`` applied to this block's subgraph when traced
+        inside a CachedOp / ``gluon.functional`` train step).
+
+        Trades FLOPs for HBM traffic — on TPU the memory-bound backward
+        usually gets FASTER as well as smaller.  Returns self.
+        """
+        self._remat = bool(active)
+        return self
 
     def forward(self, *args):
         raise NotImplementedError

@@ -89,7 +89,9 @@ def async_save(path, tree, force=True):
             _PENDING.append(_checkpointer(use_async=True))
             atexit.register(wait_all)
         ckptr = _PENDING[0]
-    ckptr.save(os.path.abspath(path), _to_jax_tree(tree), force=force)
+        # enqueue under the lock: a concurrent wait_all must not close this
+        # checkpointer between lookup and save
+        ckptr.save(os.path.abspath(path), _to_jax_tree(tree), force=force)
     return ckptr
 
 

@@ -216,7 +216,7 @@ class Symbol:
             # all inputs known is an op-level mismatch — report it as-is
             hinted = {n.name for n in self._walk()
                       if n.is_var and n._shape_hint}
-            missing = [n for n in arg_names
+            missing = [n for n in arg_names + self.list_auxiliary_states()
                        if n not in known and n not in hinted]
             suffix = (" (no shape known for arguments: %s)" % missing
                       if missing else "")

@@ -1,0 +1,72 @@
+"""Block.set_remat — memory-saving recomputation (reference
+MXNET_BACKWARD_DO_MIRROR, docs/faq/env_var.md:93 + gradient-mirror path in
+src/executor/graph_executor.cc InitFullGraph; here jax.checkpoint over the
+block's subgraph)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon import loss as loss_mod
+from mxnet_tpu.gluon.functional import make_train_step
+
+
+def _build(remat):
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"))
+        body = nn.HybridSequential()
+        with body.name_scope():
+            body.add(nn.Dense(32, activation="tanh"),
+                     nn.BatchNorm(),
+                     nn.Dense(32, activation="relu"))
+        net.add(body)
+        net.add(nn.Dense(4))
+    net.initialize()
+    net(mx.nd.zeros((2, 16)))
+    if remat:
+        net[1].set_remat(True)
+    return net
+
+
+def test_remat_numerics_match():
+    """Same loss trajectory and BN-stat updates with and without remat."""
+    import jax
+
+    x = np.random.RandomState(0).rand(16, 16).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 4, (16,)).astype(np.float32)
+    results = []
+    for remat in (False, True):
+        net = _build(remat)
+        step, state, _ = make_train_step(
+            net, loss_mod.SoftmaxCrossEntropyLoss(), learning_rate=0.1)
+        jstep = jax.jit(step)
+        s = state
+        losses = []
+        for i in range(4):
+            s, loss = jstep(s, x, y, jax.random.PRNGKey(i))
+            losses.append(float(loss))
+        results.append((losses, [np.asarray(v)
+                                 for v in jax.tree_util.tree_leaves(s)]))
+    (l0, s0), (l1, s1) = results
+    np.testing.assert_allclose(l0, l1, rtol=1e-5)
+    for a, b in zip(s0, s1):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+
+
+def test_remat_inference_unchanged():
+    net = _build(True)
+    x = mx.nd.array(np.random.RandomState(2).rand(3, 16).astype(np.float32))
+    a = net(x).asnumpy()
+    net[1].set_remat(False)
+    b = net(x).asnumpy()
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_set_remat_returns_self_and_flags():
+    net = _build(False)
+    assert net[1].set_remat(True) is net[1]
+    assert net[1]._remat is True
+    net[1].set_remat(False)
+    assert net[1]._remat is False
