@@ -1,0 +1,82 @@
+"""Fast Gradient Sign Method adversarial examples (reference
+`example/adversary/adversary_generation.ipynb`): train a small classifier,
+then perturb inputs by ``eps * sign(dL/dx)`` and watch accuracy collapse.
+
+Exercises gradient-with-respect-to-INPUT — ``x.attach_grad()`` +
+``autograd.record`` taping data as well as parameters (reference
+``mark_variables``/`autograd.py:216`), which is also what neural-style and
+saliency tooling need.
+
+Run: ``./dev.sh python examples/adversary/fgsm.py``
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+
+def make_blobs(rng, n, classes=4):
+    """Well-separated gaussian blobs on a 2D grid, lifted to 16-D."""
+    centers = np.array([[2, 2], [-2, 2], [-2, -2], [2, -2]], np.float32)
+    y = rng.randint(0, classes, n)
+    x2 = centers[y] + 0.35 * rng.randn(n, 2).astype(np.float32)
+    lift = rng.randn(2, 16).astype(np.float32) * 0  # fixed zero pad channels
+    X = np.concatenate([x2, x2 @ lift], axis=1).astype(np.float32)
+    return X, y.astype(np.float32)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=60)
+    p.add_argument("--eps", type=float, default=0.6)
+    args = p.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, autograd
+    from mxnet_tpu.gluon import nn, Trainer
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    Xtr, ytr = make_blobs(rng, 2048)
+    Xte, yte = make_blobs(rng, 512)
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.2})
+    loss_fn = SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        x, y = nd.array(Xtr), nd.array(ytr)
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(len(Xtr))
+
+    clean_acc = (net(nd.array(Xte)).asnumpy().argmax(1) == yte).mean()
+
+    # FGSM: gradient wrt the INPUT, not the params
+    x = nd.array(Xte)
+    x.attach_grad()
+    with autograd.record():
+        adv_loss = loss_fn(net(x), nd.array(yte))
+    adv_loss.backward()
+    x_adv = nd.array(Xte + args.eps * np.sign(x.grad.asnumpy()))
+    adv_acc = (net(x_adv).asnumpy().argmax(1) == yte).mean()
+
+    print("clean acc %.3f  adversarial acc %.3f (eps=%.2f)"
+          % (clean_acc, adv_acc, args.eps))
+    assert clean_acc > 0.95, "classifier failed to train"
+    assert adv_acc < clean_acc - 0.2, "FGSM failed to degrade accuracy"
+    print("FGSM ADVERSARY OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -320,8 +320,10 @@ class Block:
         ``jax.checkpoint`` applied to this block's subgraph when traced
         inside a CachedOp / ``gluon.functional`` train step).
 
-        Trades FLOPs for HBM traffic — on TPU the memory-bound backward
-        usually gets FASTER as well as smaller.  Returns self.
+        Trades FLOPs for activation memory; roughly speed-neutral on
+        memory-bound models (ResNet-50 bf16 measured ~2% slower — see
+        docs/PERF_NOTES.md — vs the reference mirror's ~30% cost).
+        Returns self.
         """
         self._remat = bool(active)
         return self

@@ -1,0 +1,34 @@
+"""Misc example-family tests: recommenders MF, text CNN, FGSM adversary
+(reference example/{recommenders,cnn_text_classification,adversary})."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _run(subdir, script, args, timeout=900, devices=1):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=%d" % devices)
+    return subprocess.run(
+        [sys.executable, script] + args,
+        cwd=os.path.join(REPO, "examples", subdir), env=env,
+        capture_output=True, text=True, timeout=timeout)
+
+
+def test_matrix_factorization_example():
+    res = _run("recommenders", "matrix_fact.py", ["--epochs", "6"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "MATRIX FACTORIZATION OK" in res.stdout
+
+
+def test_text_cnn_example():
+    res = _run("cnn_text_classification", "train.py", ["--epochs", "4"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "TEXT CNN OK" in res.stdout
+
+
+def test_fgsm_adversary_example():
+    res = _run("adversary", "fgsm.py", [])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "FGSM ADVERSARY OK" in res.stdout
