@@ -25,9 +25,9 @@ def make_blobs(rng, n, classes=4):
     centers = np.array([[2, 2], [-2, 2], [-2, -2], [2, -2]], np.float32)
     y = rng.randint(0, classes, n)
     x2 = centers[y] + 0.35 * rng.randn(n, 2).astype(np.float32)
-    lift = rng.randn(2, 16).astype(np.float32) * 0  # fixed zero pad channels
-    X = np.concatenate([x2, x2 @ lift], axis=1).astype(np.float32)
-    return X, y.astype(np.float32)
+    # zero pad channels: room for the attack to also perturb dead inputs
+    X = np.concatenate([x2, np.zeros((n, 16), np.float32)], axis=1)
+    return X.astype(np.float32), y.astype(np.float32)
 
 
 def main():

@@ -30,6 +30,7 @@ from .collectives import allreduce, allgather, reduce_scatter, pmean, psum_scatt
 from . import dist
 from . import checkpoint
 from .ring import ring_attention, ring_self_attention
+from .pipeline import gpipe, stack_stage_params
 
 __all__ = [
     "make_mesh",
@@ -50,4 +51,6 @@ __all__ = [
     "checkpoint",
     "ring_attention",
     "ring_self_attention",
+    "gpipe",
+    "stack_stage_params",
 ]

@@ -75,7 +75,8 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None):
     # mark accumulators device-varying so the scan carry type matches
     # (shard_map VMA checking, jax ≥0.8)
     try:
-        o0, m0, l0 = (jax.lax.pvary(x, (axis_name,)) for x in (o0, m0, l0))
+        from .shard_map_compat import pvary
+        o0, m0, l0 = (pvary(x, (axis_name,)) for x in (o0, m0, l0))
     except AttributeError:
         pass
     # scan n-1 rotate-steps, then consume the final block without rotating —

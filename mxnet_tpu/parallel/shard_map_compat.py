@@ -1,4 +1,5 @@
-"""shard_map import shim — jax.shard_map (≥0.8) vs jax.experimental.shard_map."""
+"""Version shims: jax.shard_map (≥0.8) vs jax.experimental.shard_map, and
+pvary (deprecated in 0.9) vs lax.pcast(..., to='varying')."""
 from __future__ import annotations
 
 try:
@@ -6,4 +7,14 @@ try:
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map  # type: ignore
 
-__all__ = ["shard_map"]
+
+def pvary(x, axis_names):
+    """Mark ``x`` as varying over the given manual axes (shard_map typing)."""
+    import jax
+
+    if hasattr(jax.lax, "pcast"):  # jax ≥ 0.9
+        return jax.lax.pcast(x, tuple(axis_names), to="varying")
+    return jax.lax.pvary(x, tuple(axis_names))  # pragma: no cover
+
+
+__all__ = ["shard_map", "pvary"]
