@@ -1,5 +1,6 @@
-"""Misc example-family tests: recommenders MF, text CNN, FGSM adversary
-(reference example/{recommenders,cnn_text_classification,adversary})."""
+"""Misc example-family tests: recommenders MF, text CNN, FGSM adversary,
+VAE, bi-LSTM sort (reference example/{recommenders,
+cnn_text_classification,adversary,vae,bi-lstm-sort})."""
 import os
 import subprocess
 import sys
@@ -32,3 +33,17 @@ def test_fgsm_adversary_example():
     res = _run("adversary", "fgsm.py", [])
     assert res.returncode == 0, res.stdout + res.stderr
     assert "FGSM ADVERSARY OK" in res.stdout
+
+
+def test_vae_example():
+    res = _run("vae", "train_vae.py", ["--epochs", "15"], timeout=1200)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "VAE OK" in res.stdout
+
+
+def test_bi_lstm_sort_example():
+    res = _run("bi-lstm-sort", "sort_lstm.py",
+               ["--epochs", "8", "--seq-len", "6", "--hidden", "48"],
+               timeout=1800)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "BI-LSTM SORT OK" in res.stdout
