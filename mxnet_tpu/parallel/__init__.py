@@ -31,6 +31,7 @@ from . import dist
 from . import checkpoint
 from .ring import ring_attention, ring_self_attention
 from .pipeline import gpipe, stack_stage_params
+from .moe import moe_ffn, stack_expert_params
 
 __all__ = [
     "make_mesh",
@@ -53,4 +54,6 @@ __all__ = [
     "ring_self_attention",
     "gpipe",
     "stack_stage_params",
+    "moe_ffn",
+    "stack_expert_params",
 ]
