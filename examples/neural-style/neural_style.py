@@ -1,0 +1,98 @@
+"""Neural style transfer (reference `example/neural-style/nstyle.py`:
+optimize an IMAGE so that deep conv features match a content image while
+the Gram matrices of shallower features match a style image).
+
+The reference descends on the input through a pretrained VGG-19; with zero
+egress there are no pretrained weights here, so a fixed random conv
+feature extractor stands in — random conv features are a known-workable
+style/content signal (random-feature style transfer), and every framework
+mechanism the reference exercises is identical: frozen network, gradient
+with respect to the INPUT pixels, Gram-matrix losses, Adam on the image.
+
+Run: ``./dev.sh python examples/neural-style/neural_style.py``
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--size", type=int, default=32)
+    p.add_argument("--iters", type=int, default=120)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--style-weight", type=float, default=1.0)
+    args = p.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, autograd
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    S = args.size
+
+    # frozen random feature extractor: two conv stages (≡ vgg relu1/relu2)
+    feat1 = nn.HybridSequential()
+    with feat1.name_scope():
+        feat1.add(nn.Conv2D(16, 3, padding=1, activation="relu"))
+    feat2 = nn.HybridSequential()
+    with feat2.name_scope():
+        feat2.add(nn.Conv2D(32, 3, strides=2, padding=1, activation="relu"))
+    for block in (feat1, feat2):
+        block.initialize(mx.init.Xavier())
+
+    def features(img):
+        f1 = feat1(img)
+        return f1, feat2(f1)
+
+    def gram(f):
+        b, c = f.shape[0], f.shape[1]
+        flat = f.reshape((b, c, -1))
+        n = flat.shape[2]
+        return nd.batch_dot(flat, flat.transpose((0, 2, 1))) / n
+
+    # content: smooth gradient image; style: high-frequency checkers
+    yy, xx = np.mgrid[0:S, 0:S].astype(np.float32) / S
+    content = np.stack([yy, xx, (yy + xx) / 2])[None]
+    checker = ((np.indices((S, S)).sum(0) % 2) * 1.0).astype(np.float32)
+    style = np.stack([checker, 1 - checker, checker])[None]
+
+    c_img, s_img = nd.array(content), nd.array(style)
+    _, c_feat = features(c_img)
+    s1, s2 = features(s_img)
+    s_grams = [gram(s1), gram(s2)]
+
+    img = nd.array(rng.rand(1, 3, S, S).astype(np.float32))
+    img.attach_grad()
+
+    # the framework Adam applied to the IMAGE (reference nstyle.py does the
+    # same with mx.optimizer on the img ndarray)
+    opt = mx.optimizer.Adam(learning_rate=args.lr)
+    opt_state = opt.create_state(0, img)
+    losses = []
+    for t in range(1, args.iters + 1):
+        with autograd.record():
+            f1, f2 = features(img)
+            closs = ((f2 - c_feat) ** 2).mean()
+            sloss = sum(((gram(f) - g) ** 2).mean()
+                        for f, g in zip((f1, f2), s_grams))
+            loss = closs + args.style_weight * sloss
+        loss.backward()
+        opt.update(0, img, img.grad, opt_state)
+        losses.append(float(loss.asnumpy()))
+    print("style+content loss %.4f -> %.4f" % (losses[0], losses[-1]))
+    assert losses[-1] < losses[0] * 0.5, "style optimization did not converge"
+    out = img.asnumpy()
+    assert out.shape == (1, 3, S, S) and np.isfinite(out).all()
+    print("NEURAL STYLE OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -56,6 +56,10 @@ def moe_ffn(x, gate_w, expert_params, expert_fn, *, mesh, axis="ep",
     T = x.shape[0]
     if T % E:
         raise ValueError("token count %d must divide over %d devices" % (T, E))
+    if gate_w.shape[-1] != E:
+        raise ValueError(
+            "gate_w routes to %d experts but mesh axis %r has %d devices"
+            % (gate_w.shape[-1], axis, E))
     C = max(1, int(-(-(T // E) * capacity_factor // E)))  # ceil
     p_specs = jax.tree_util.tree_map(lambda _: P(axis), expert_params)
 

@@ -1,5 +1,5 @@
 """Misc example-family tests: recommenders MF, text CNN, FGSM adversary,
-VAE, bi-LSTM sort (reference example/{recommenders,
+VAE, bi-LSTM sort, multi-task, neural-style (reference example/{recommenders,
 cnn_text_classification,adversary,vae,bi-lstm-sort})."""
 import os
 import subprocess
@@ -47,3 +47,15 @@ def test_bi_lstm_sort_example():
                timeout=1800)
     assert res.returncode == 0, res.stdout + res.stderr
     assert "BI-LSTM SORT OK" in res.stdout
+
+
+def test_multitask_example():
+    res = _run("multi-task", "train_multitask.py", [])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "MULTI-TASK OK" in res.stdout
+
+
+def test_neural_style_example():
+    res = _run("neural-style", "neural_style.py", ["--iters", "80"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "NEURAL STYLE OK" in res.stdout
