@@ -1,0 +1,101 @@
+"""Policy-gradient RL (reference `example/reinforcement-learning/` — a3c/
+dqn/ddpg on gym; here REINFORCE on an in-process gridworld, zero-egress).
+
+Environment: 5x5 grid, start at (0,0), goal at (4,4), 20-step episodes,
+reward 1 at the goal else -0.01.  Policy: MLP over one-hot position →
+4 actions; actions are sampled host-side from the softmax probabilities
+inside the environment loop, and the learning pass re-runs the policy
+under ``autograd.record`` to differentiate the log-prob of the taken
+actions weighted by discounted returns — the same actor-loss mechanics as
+the reference's a3c example.
+
+Run: ``./dev.sh python examples/reinforcement-learning/reinforce_gridworld.py``
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+SIZE, GOAL, STEPS = 5, (4, 4), 20
+MOVES = np.array([[0, 1], [0, -1], [1, 0], [-1, 0]])  # E W S N
+
+
+def rollout(net, nd, rng, batch):
+    """Vectorized batch of episodes; returns (states, actions, returns)."""
+    pos = np.zeros((batch, 2), np.int64)
+    all_s, all_a, all_r = [], [], []
+    for _ in range(STEPS):
+        onehot = np.zeros((batch, SIZE * SIZE), np.float32)
+        onehot[np.arange(batch), pos[:, 0] * SIZE + pos[:, 1]] = 1.0
+        logits = net(nd.array(onehot))
+        probs = nd.softmax(logits).asnumpy()
+        # sample per-row actions (np for the env loop; the learning pass
+        # below re-runs the net under autograd)
+        u = rng.rand(batch, 1)
+        act = (probs.cumsum(axis=1) < u).sum(axis=1).clip(0, 3)
+        pos = np.clip(pos + MOVES[act], 0, SIZE - 1)
+        done = (pos[:, 0] == GOAL[0]) & (pos[:, 1] == GOAL[1])
+        r = np.where(done, 1.0, -0.01).astype(np.float32)
+        all_s.append(onehot)
+        all_a.append(act)
+        all_r.append(r)
+        # reset finished episodes to start (continuing task formulation)
+        pos[done] = 0
+    S = np.concatenate(all_s)
+    A = np.concatenate(all_a).astype(np.float32)
+    R = np.stack(all_r)                      # (T, B)
+    G = np.zeros_like(R)
+    run = np.zeros(batch, np.float32)
+    for t in range(STEPS - 1, -1, -1):       # discounted returns
+        run = R[t] + 0.95 * run
+        G[t] = run
+    return S, A, G.reshape(-1), R.sum() / batch
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=150)
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.05)
+    args = p.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, autograd
+    from mxnet_tpu.gluon import nn, Trainer
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(64, activation="tanh"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    trainer = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": args.lr})
+
+    first = last = None
+    for it in range(args.iters):
+        S, A, G, ep_reward = rollout(net, nd, rng, args.batch)
+        adv = (G - G.mean()) / (G.std() + 1e-6)
+        with autograd.record():
+            logp = nd.log_softmax(net(nd.array(S)))
+            taken = nd.pick(logp, nd.array(A), axis=1)
+            loss = -(taken * nd.array(adv.astype(np.float32)))
+        loss.backward()
+        trainer.step(len(S))
+        if first is None:
+            first = ep_reward
+        last = ep_reward
+        if it % 25 == 0:
+            print("iter %d avg episode reward %.3f" % (it, ep_reward))
+    print("episode reward %.3f -> %.3f" % (first, last))
+    assert last > first + 0.3, "policy failed to improve"
+    print("REINFORCE OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,6 +1,7 @@
 """Misc example-family tests: recommenders MF, text CNN, FGSM adversary,
-VAE, bi-LSTM sort, multi-task, neural-style (reference example/{recommenders,
-cnn_text_classification,adversary,vae,bi-lstm-sort})."""
+VAE, bi-LSTM sort, multi-task, neural-style, REINFORCE (reference
+example/{recommenders,cnn_text_classification,adversary,vae,bi-lstm-sort,
+multi-task,neural-style,reinforcement-learning})."""
 import os
 import subprocess
 import sys
@@ -59,3 +60,10 @@ def test_neural_style_example():
     res = _run("neural-style", "neural_style.py", ["--iters", "80"])
     assert res.returncode == 0, res.stdout + res.stderr
     assert "NEURAL STYLE OK" in res.stdout
+
+
+def test_reinforce_example():
+    res = _run("reinforcement-learning", "reinforce_gridworld.py",
+               ["--iters", "100"], timeout=1200)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "REINFORCE OK" in res.stdout
