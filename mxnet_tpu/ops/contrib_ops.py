@@ -17,6 +17,20 @@ import numpy as np
 from .registry import register
 
 
+def _ctc_core(logits, logit_pad, labels, label_pad, blank_id):
+    import optax
+
+    return optax.ctc_loss(logits, logit_pad, labels, label_pad,
+                          blank_id=blank_id)
+
+
+# optax's internal lax.scan misses XLA's eager executable cache on every
+# call (jaxpr consts compare by identity), which leaks one compiled
+# executable per training step until vm.max_map_count kills the process.
+# A module-level jit gives the whole loss a stable cache identity.
+_ctc_core_jit = jax.jit(_ctc_core, static_argnames=("blank_id",))
+
+
 @register("_contrib_CTCLoss", alias=["_contrib_ctc_loss", "CTCLoss", "ctc_loss"])
 def ctc_loss(data, label, data_lengths=None, label_lengths=None, *,
              use_data_lengths=False, use_label_lengths=False, blank_label="first"):
@@ -27,8 +41,6 @@ def ctc_loss(data, label, data_lengths=None, label_lengths=None, *,
     With blank_label='first', blank is id 0 and padding value is 0 (labels are
     1-based); with 'last', blank is C-1 and padding is -1. Returns (N,) loss.
     """
-    import optax
-
     t, n, c = data.shape
     logits = jnp.transpose(data, (1, 0, 2)).astype(jnp.float32)  # (N, T, C)
     label = label.astype(jnp.int32)
@@ -53,7 +65,8 @@ def ctc_loss(data, label, data_lengths=None, label_lengths=None, *,
         blank_id = c - 1
         labels = jnp.where(label < 0, 0, label)  # padding slots masked anyway
 
-    return optax.ctc_loss(logits, logit_pad, labels, label_pad, blank_id=blank_id)
+    return _ctc_core_jit(logits, logit_pad, labels, label_pad,
+                         blank_id=blank_id)
 
 
 @register("_contrib_fft", alias=["fft"])

@@ -67,3 +67,10 @@ def test_reinforce_example():
                ["--iters", "100"], timeout=1200)
     assert res.returncode == 0, res.stdout + res.stderr
     assert "REINFORCE OK" in res.stdout
+
+
+def test_ctc_ocr_example():
+    res = _run("ctc", "ocr_ctc.py",
+               ["--epochs", "6", "--min-exact", "0.5"], timeout=1500)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "CTC OCR OK" in res.stdout
