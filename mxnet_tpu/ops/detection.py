@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .registry import register
+from .registry import register, stable_eager
 
 
 def _pair(v):
@@ -601,6 +601,7 @@ def _proposal_one_image(scores_fg, deltas, im_info, anchors, stride, pre_nms, po
 
 
 @register("_contrib_MultiProposal", alias=["MultiProposal"])
+@stable_eager
 def multi_proposal(
     cls_prob,
     bbox_pred,
@@ -642,6 +643,7 @@ def multi_proposal(
 
 
 @register("_contrib_Proposal", alias=["Proposal"])
+@stable_eager
 def proposal(cls_prob, bbox_pred, im_info, *, rpn_pre_nms_top_n=6000, rpn_post_nms_top_n=300,
              threshold=0.7, rpn_min_size=16, scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
              feature_stride=16, output_score=False, iou_loss=False):
@@ -709,6 +711,7 @@ def _box_iou_corner(a, b):
 
 
 @register("_contrib_MultiBoxTarget", alias=["MultiBoxTarget"])
+@stable_eager
 def multibox_target(
     anchor,
     label,
@@ -833,6 +836,7 @@ def multibox_target(
 
 
 @register("_contrib_MultiBoxDetection", alias=["MultiBoxDetection"])
+@stable_eager
 def multibox_detection(
     cls_prob,
     loc_pred,
@@ -932,6 +936,7 @@ def box_iou(lhs, rhs, *, format="corner"):
 
 
 @register("_contrib_box_nms", alias=["box_nms", "_contrib_box_non_maximum_suppression"])
+@stable_eager
 def box_nms(
     data,
     *,
@@ -990,6 +995,7 @@ def box_nms(
 
 
 @register("_contrib_bipartite_matching", alias=["bipartite_matching"])
+@stable_eager
 def bipartite_matching(data, *, threshold, is_ascend=False, topk=-1):
     """Greedy bipartite matching (reference bounding_box-inl.h
     BipartiteMatchingForward): data (..., N, M) scores; repeatedly take the

@@ -25,6 +25,8 @@ from __future__ import annotations
 import functools
 import inspect
 
+import numpy as np
+
 __all__ = ["register", "get", "list_ops", "OpDef", "alias"]
 
 _REGISTRY = {}
@@ -91,6 +93,46 @@ class OpDef:
 
     def __repr__(self):
         return "OpDef(%s)" % self.name
+
+
+_STABLE_JIT_CACHE = {}
+
+
+def stable_eager(fn):
+    """Give an op a stable XLA executable-cache identity for EAGER calls.
+
+    Ops whose bodies contain ``lax.scan``/``fori_loop``/``while_loop``
+    re-trace the loop on every eager invocation; the traced jaxpr closes
+    over fresh constant arrays whose identity enters the executable cache
+    key, so every training step compiles (and leaks) a new executable until
+    ``vm.max_map_count`` kills the process (the reference never had this
+    class of bug: its kernels were AOT C++).  Routing the call through a
+    per-(op, attr-signature) ``jax.jit`` keys the cache on shapes + attr
+    VALUES instead.  Inside an outer trace the jit call inlines, so jitted
+    paths (CachedOp, make_train_step, Executor) are unaffected.
+    """
+    import jax
+
+    def hashable(v):
+        # static args must be hashable: recursively tuple-ify sequences and
+        # numpy arrays (e.g. scales=np.array([...]) passed by rcnn configs)
+        if isinstance(v, np.ndarray):
+            return hashable(v.tolist())  # nested lists keep their structure
+        if isinstance(v, (list, tuple)):
+            return tuple(hashable(e) for e in v)
+        return v
+
+    @functools.wraps(fn)
+    def wrapper(*args, **attrs):
+        sig = (fn, tuple(sorted(k for k in attrs if k != "key")))
+        jf = _STABLE_JIT_CACHE.get(sig)
+        if jf is None:
+            jf = jax.jit(fn, static_argnames=[k for k in attrs if k != "key"])
+            _STABLE_JIT_CACHE[sig] = jf
+        attrs = {k: v if k == "key" else hashable(v) for k, v in attrs.items()}
+        return jf(*args, **attrs)
+
+    return wrapper
 
 
 def register(name, alias=(), hint=None, aux=(), inputs_fn=None, infer_params=None, aux_update=None, mutates=()):

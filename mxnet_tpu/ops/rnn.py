@@ -12,7 +12,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .registry import register
+from .registry import register, stable_eager
 
 _GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
 
@@ -127,6 +127,7 @@ def _run_layer(x, h0, c0, wi, wh, bi, bh, mode, hidden, reverse,
     else ["data", "parameters", "state"],
     infer_params=lambda attrs, shapes: _rnn_infer(attrs, shapes),
 )
+@stable_eager
 def rnn(
     data,
     parameters,

@@ -1,0 +1,70 @@
+"""Executable-cache stability for control-flow ops (regression for the
+per-step compile leak: eagerly-called lax.scan/fori_loop ops re-traced per
+call, leaking one XLA executable per training step until vm.max_map_count
+killed the process — fixed by ops.registry.stable_eager)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def _nmaps():
+    try:
+        with open("/proc/%d/maps" % os.getpid()) as f:
+            return sum(1 for _ in f)
+    except OSError:  # non-linux
+        pytest.skip("needs /proc/<pid>/maps")
+
+
+def _assert_stable(step, warmup=3, iters=12, budget=8):
+    for _ in range(warmup):
+        step()
+    base = _nmaps()
+    for _ in range(iters):
+        step()
+    grown = _nmaps() - base
+    assert grown <= budget, "leaked %d mappings over %d iters" % (grown, iters)
+
+
+def test_lstm_train_loop_stable():
+    from mxnet_tpu.gluon import rnn
+
+    layer = rnn.LSTM(8, num_layers=1, bidirectional=True, layout="NTC")
+    layer.initialize()
+    x_np = np.random.RandomState(0).rand(2, 6, 4).astype(np.float32)
+
+    def step():
+        x = nd.array(x_np)
+        x.attach_grad()
+        with autograd.record():
+            out = layer(x).mean()
+        out.backward()
+
+    _assert_stable(step)
+
+
+def test_ctc_loss_train_loop_stable():
+    rng = np.random.RandomState(0)
+    acts = rng.randn(10, 4, 6).astype(np.float32)
+    y = nd.array(rng.randint(1, 6, (4, 3)).astype(np.float32))
+
+    def step():
+        x = nd.array(acts)
+        x.attach_grad()
+        with autograd.record():
+            loss = nd.ctc_loss(x, y).mean()
+        loss.backward()
+
+    _assert_stable(step)
+
+
+def test_box_nms_loop_stable():
+    dets = np.random.RandomState(0).rand(1, 30, 6).astype(np.float32)
+
+    def step():
+        nd.contrib.box_nms(nd.array(dets)).asnumpy()
+
+    _assert_stable(step)
