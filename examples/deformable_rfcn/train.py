@@ -48,12 +48,45 @@ def main():
     p.add_argument("--epochs", type=int, default=3)
     p.add_argument("--batches-per-epoch", type=int, default=6)
     p.add_argument("--lr", type=float, default=0.02)
+    p.add_argument("--bench", action="store_true",
+                   help="measure steady-state training img/s at the given "
+                        "data shape (north-star metric: Deformable R-FCN "
+                        "imgs/sec/chip, BASELINE.md)")
+    p.add_argument("--bench-iters", type=int, default=10)
     args = p.parse_args()
 
     net = DeformableRFCN(num_classes=args.num_classes)
     net.initialize()
     trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
                                {"learning_rate": args.lr, "momentum": 0.9})
+
+    def train_step(data, im_info, labels):
+        """One full detection train step (shared by training and --bench so
+        the published img/s measures exactly what training runs)."""
+        with autograd.record():
+            rois, cls_score, bbox_pred, rpn_cls, rpn_bbox = net(data, im_info)
+            cls_loss, bbox_loss = rfcn_losses(
+                rois, cls_score, bbox_pred, labels, args.num_classes)
+            rpn_cls_loss, rpn_bbox_loss = rpn_losses(
+                net, rpn_cls, rpn_bbox, labels, im_info)
+            loss = cls_loss + bbox_loss + rpn_cls_loss + rpn_bbox_loss
+        loss.backward()
+        trainer.step(args.batch_size)
+        return float(loss.asnumpy())
+
+    if args.bench:
+        iters = max(1, args.bench_iters)
+        data, im_info, labels = next(iter(synthetic_batches(
+            args.batch_size, tuple(args.data_shape), 1, args.num_classes)))
+        train_step(data, im_info, labels)  # warmup/compile
+        tic = time.time()
+        for _ in range(iters):
+            train_step(data, im_info, labels)
+        dt = (time.time() - tic) / iters
+        print("rfcn_bench: shape=%s batch=%d  %.2f img/s (%.0f ms/step)"
+              % (tuple(args.data_shape), args.batch_size,
+                 args.batch_size / dt, dt * 1e3))
+        return
 
     first_loss = last_loss = None
     for epoch in range(args.epochs):
@@ -63,16 +96,7 @@ def main():
         for data, im_info, labels in synthetic_batches(
                 args.batch_size, tuple(args.data_shape),
                 args.batches_per_epoch, args.num_classes, seed=epoch):
-            with autograd.record():
-                rois, cls_score, bbox_pred, rpn_cls, rpn_bbox = net(data, im_info)
-                cls_loss, bbox_loss = rfcn_losses(
-                    rois, cls_score, bbox_pred, labels, args.num_classes)
-                rpn_cls_loss, rpn_bbox_loss = rpn_losses(
-                    net, rpn_cls, rpn_bbox, labels, im_info)
-                loss = cls_loss + bbox_loss + rpn_cls_loss + rpn_bbox_loss
-            loss.backward()
-            trainer.step(args.batch_size)
-            total += float(loss.asnumpy())
+            total += train_step(data, im_info, labels)
             n += 1
         avg = total / n
         if first_loss is None:
