@@ -42,3 +42,15 @@ def test_sharded_matmul_matches_replicated():
     w_sh = jax.device_put(w, NamedSharding(mesh, P(None, "mp")))
     y_sh = jax.jit(lambda a, b: a @ b)(jnp.asarray(x), w_sh)
     np.testing.assert_allclose(np.asarray(y_sh), x @ w, rtol=2e-5)
+
+
+def test_pipeline_mlp_example():
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    res = subprocess.run(
+        [sys.executable, "pipeline_mlp.py", "--steps", "120"],
+        cwd=os.path.join(REPO, "examples", "model_parallel"), env=env,
+        capture_output=True, text=True, timeout=900)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "PIPELINE MLP OK" in res.stdout
+    assert "pp=8" in res.stdout
