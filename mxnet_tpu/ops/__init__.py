@@ -20,6 +20,7 @@ from . import contrib_ops  # noqa: F401
 from . import quantization  # noqa: F401
 from . import misc_ops  # noqa: F401
 from . import detection  # noqa: F401
+from . import rcnn_targets  # noqa: F401
 from . import custom  # noqa: F401
 
 _load_all = True

@@ -1,0 +1,281 @@
+"""On-device RCNN training-target assignment — fixed-capacity, jit-fusable.
+
+The reference computes these targets on the HOST: RPN anchor targets inside
+the data loader (``example/rcnn/rcnn/core/loader.py`` AnchorLoader →
+``rcnn/io/rpn.py assign_anchor``) and per-ROI targets as a Python CustomOp
+(``example/rcnn/rcnn/symbol/proposal_target.py:31,82``, config defaults
+``rcnn/config.py:50-66``).  That design forces a device→host→device round
+trip in the middle of every training step, which is exactly what kept the
+round-1 Deformable R-FCN step eager and host-synced.
+
+TPU-native redesign (SURVEY §7.3 "dynamic shapes" hard part): both ops are
+pure jnp with **static output shapes** — candidate sets are fixed capacity,
+subsampling is a rank-over-uniform-noise selection (equivalent in
+distribution to the reference's ``np.random.choice(..., replace=False)``),
+and empty/degenerate cases pad with zero-weight rows exactly where the
+reference pads by repetition.  Randomness enters as an explicit ``noise``
+input (jax purity); pass fresh uniforms each step when training, or omit it
+for deterministic lowest-noise-index selection in tests.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+from .detection import _generate_base_anchors, _box_iou_corner
+
+
+def _iou_plus_one(a, b):
+    """IoU with the +1 pixel convention used by the rcnn example's
+    bbox_overlaps (``rcnn/processing/bbox_transform.py``)."""
+    tl = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    br = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(br - tl + 1.0, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[:, 2] - a[:, 0] + 1.0) * (a[:, 3] - a[:, 1] + 1.0)
+    area_b = (b[:, 2] - b[:, 0] + 1.0) * (b[:, 3] - b[:, 1] + 1.0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union <= 0, 0.0, inter / jnp.maximum(union, 1e-12))
+
+
+def _bbox_transform(ex, gt):
+    """Box regression targets (reference rcnn/processing/bbox_transform.py
+    bbox_transform), vectorized over (N, 4) corner boxes."""
+    ew = ex[:, 2] - ex[:, 0] + 1.0
+    eh = ex[:, 3] - ex[:, 1] + 1.0
+    ecx = ex[:, 0] + 0.5 * (ew - 1.0)
+    ecy = ex[:, 1] + 0.5 * (eh - 1.0)
+    gw = gt[:, 2] - gt[:, 0] + 1.0
+    gh = gt[:, 3] - gt[:, 1] + 1.0
+    gcx = gt[:, 0] + 0.5 * (gw - 1.0)
+    gcy = gt[:, 1] + 0.5 * (gh - 1.0)
+    return jnp.stack(
+        [
+            (gcx - ecx) / (ew + 1e-14),
+            (gcy - ecy) / (eh + 1e-14),
+            jnp.log(jnp.maximum(gw / ew, 1e-12)),
+            jnp.log(jnp.maximum(gh / eh, 1e-12)),
+        ],
+        axis=1,
+    )
+
+
+def _rank_select(mask, noise, limit):
+    """Randomly keep at most ``limit`` True entries of ``mask``.
+
+    Returns (kept_mask, order) where ``order`` lists the kept indices first
+    (in noise-rank order).  With uniform iid noise this selection is
+    equidistributed with ``np.random.choice(where(mask), limit,
+    replace=False)`` — the reference's subsampling primitive.
+    """
+    n = mask.shape[0]
+    key = jnp.where(mask, noise, 2.0)  # non-candidates rank last
+    order = jnp.argsort(key, stable=True)
+    rank = jnp.zeros((n,), jnp.int32).at[order].set(jnp.arange(n, dtype=jnp.int32))
+    kept = mask & (rank < limit)
+    return kept, order
+
+
+@register("_contrib_rpn_anchor_target")
+def rpn_anchor_target(
+    gt_boxes,
+    im_info,
+    noise=None,
+    *,
+    feat_height,
+    feat_width,
+    feature_stride=16,
+    scales=(8, 16, 32),
+    ratios=(0.5, 1, 2),
+    allowed_border=0,
+    batch_rois=256,
+    fg_fraction=0.5,
+    pos_iou_thresh=0.7,
+    neg_iou_thresh=0.3,
+):
+    """RPN anchor target assignment, on device (reference host-side
+    ``rcnn/io/rpn.py assign_anchor`` driven by AnchorLoader; config defaults
+    ``rcnn/config.py:60-66`` RPN_BATCH_SIZE/RPN_FG_FRACTION/..._OVERLAP).
+
+    Inputs: ``gt_boxes`` (B, G, 5) rows [cls, x1, y1, x2, y2] padded with
+    −1; ``im_info`` (B, 3) [h, w, scale]; ``noise`` (B, A_total, 2) iid
+    uniforms driving fg/bg subsampling (omit for deterministic selection).
+    Outputs: label (B, A_total) ∈ {−1 ignore, 0 bg, 1 fg}, bbox_target
+    (B, A_total, 4), bbox_weight (B, A_total, 4) — anchor index order is
+    ``h·(W·A) + w·A + a``, matching MultiProposal's enumeration.
+    """
+    Hf, Wf = int(feat_height), int(feat_width)
+    stride = float(feature_stride)
+    base = jnp.asarray(_generate_base_anchors(stride, scales, ratios))
+    A = base.shape[0]
+    total = Hf * Wf * A
+    max_fg = int(round(batch_rois * fg_fraction))
+
+    shift_x = jnp.arange(Wf, dtype=jnp.float32) * stride
+    shift_y = jnp.arange(Hf, dtype=jnp.float32) * stride
+    shifts = jnp.stack(
+        [
+            jnp.broadcast_to(shift_x[None, :, None], (Hf, Wf, A)),
+            jnp.broadcast_to(shift_y[:, None, None], (Hf, Wf, A)),
+            jnp.broadcast_to(shift_x[None, :, None], (Hf, Wf, A)),
+            jnp.broadcast_to(shift_y[:, None, None], (Hf, Wf, A)),
+        ],
+        axis=-1,
+    )
+    anchors = (shifts + base[None, None, :, :]).reshape(total, 4)
+
+    if noise is None:
+        # deterministic: prefer low anchor index (tests / reproducibility)
+        noise = jnp.broadcast_to(
+            (jnp.arange(total, dtype=jnp.float32) / (total + 1.0))[None, :, None],
+            (gt_boxes.shape[0], total, 2),
+        )
+
+    def one(gt, info, nz):
+        im_h, im_w = info[0], info[1]
+        inside = (
+            (anchors[:, 0] >= -allowed_border)
+            & (anchors[:, 1] >= -allowed_border)
+            & (anchors[:, 2] < im_w + allowed_border)
+            & (anchors[:, 3] < im_h + allowed_border)
+        )
+        gt_valid = gt[:, 0] >= 0  # (G,)
+        num_gt = gt_valid.sum()
+        iou = _iou_plus_one(anchors, gt[:, 1:5])  # (total, G)
+        iou = jnp.where(gt_valid[None, :] & inside[:, None], iou, -1.0)
+        argmax = jnp.argmax(iou, axis=1)
+        max_iou = jnp.maximum(jnp.max(iou, axis=1), 0.0)
+
+        fg = inside & (max_iou >= pos_iou_thresh) & (num_gt > 0)
+        # each valid gt's best anchor is fg (reference assign_anchor rule);
+        # iou already −1 outside/invalid so the argmax lands inside.
+        # scatter-add (not set) so duplicate best-anchor indices stay correct
+        gt_best = jnp.argmax(iou, axis=0)  # (G,)
+        is_best = (
+            jnp.zeros((total,), jnp.int32).at[gt_best].add(gt_valid.astype(jnp.int32)) > 0
+        )
+        fg = fg | (is_best & inside)
+        fg_kept, _ = _rank_select(fg, nz[:, 0], max_fg)
+        n_fg = fg_kept.sum()
+
+        bg = inside & (max_iou < neg_iou_thresh) & ~fg & (num_gt > 0)
+        # no gt at all: every inside anchor is a bg candidate
+        bg = jnp.where(num_gt > 0, bg, inside)
+        max_bg = batch_rois - jnp.minimum(n_fg, max_fg)
+        bg_kept, _ = _rank_select(bg, nz[:, 1], max_bg)
+
+        label = jnp.where(fg_kept, 1.0, jnp.where(bg_kept, 0.0, -1.0))
+        safe_gt = jnp.clip(argmax, 0, gt.shape[0] - 1)
+        tgt = _bbox_transform(anchors, gt[safe_gt, 1:5])
+        w = fg_kept[:, None].astype(jnp.float32)
+        return label, tgt * w, jnp.broadcast_to(w, (total, 4))
+
+    return jax.vmap(one)(gt_boxes, im_info, noise)
+
+
+@register("_contrib_proposal_target")
+def proposal_target(
+    rois,
+    gt_boxes,
+    noise=None,
+    *,
+    num_classes,
+    batch_images,
+    batch_rois=128,
+    fg_fraction=0.25,
+    fg_overlap=0.5,
+    class_agnostic=False,
+):
+    """Per-ROI training targets, on device (reference CustomOp
+    ``rcnn/symbol/proposal_target.py:31-110`` + ``rcnn/io/rcnn.py
+    sample_rois``; config ``rcnn/config.py:50-56`` BATCH_ROIS=128,
+    FG_FRACTION=0.25, FG_THRESH=0.5, BG=[0, 0.5)).
+
+    Inputs: ``rois`` (B·post, 5) [batch_idx|x1..y2] batch-major (the
+    MultiProposal layout); ``gt_boxes`` (B, G, 5) [cls, x1, y1, x2, y2]
+    padded with −1; ``noise`` (B, post+G, 2) iid uniforms.  Ground-truth
+    boxes join the candidate set (reference proposal_target.py:54-56).
+
+    Outputs (all static): rois_out (batch_rois, 5), label (batch_rois,),
+    bbox_target and bbox_weight (batch_rois, 4·K) where K = num_classes
+    (incl. background) or 2 when ``class_agnostic`` (Deformable R-FCN's
+    head regresses 2 classes: bg/fg).  Degenerate images (no candidates)
+    emit zero-weight background rows — gradient-free padding where the
+    reference pads by repeating sampled indices.
+    """
+    B = int(batch_images)
+    C = int(num_classes)
+    K = 2 if class_agnostic else C
+    per_im = int(batch_rois) // B
+    if per_im * B != int(batch_rois):
+        raise ValueError(
+            "batch_rois (%d) must be divisible by batch_images (%d)"
+            % (batch_rois, batch_images))
+    fg_per_im = int(round(fg_fraction * per_im))
+    post = rois.shape[0] // B
+    G = gt_boxes.shape[1]
+    ncand = post + G
+
+    rois_b = rois.reshape(B, post, 5)
+    if noise is None:
+        noise = jnp.broadcast_to(
+            (jnp.arange(ncand, dtype=jnp.float32) / (ncand + 1.0))[None, :, None],
+            (B, ncand, 2),
+        )
+
+    def one(b, rb, gt, nz):
+        gt_valid = gt[:, 0] >= 0
+        num_gt = gt_valid.sum()
+        # candidates: proposals then gt boxes (zero-weight pad rows for
+        # invalid gts — they can never be sampled)
+        gt_rows = jnp.concatenate(
+            [jnp.full((G, 1), b, rois.dtype), gt[:, 1:5]], axis=1)
+        cand = jnp.concatenate([rb, gt_rows], axis=0)  # (ncand, 5)
+        cand_valid = jnp.concatenate(
+            [jnp.ones((post,), bool), gt_valid], axis=0)
+
+        iou = _iou_plus_one(cand[:, 1:5], gt[:, 1:5])  # (ncand, G)
+        iou = jnp.where(gt_valid[None, :], iou, -1.0)
+        argmax = jnp.clip(jnp.argmax(iou, axis=1), 0, G - 1)
+        max_iou = jnp.maximum(jnp.max(iou, axis=1), 0.0)
+
+        fg = cand_valid & (max_iou >= fg_overlap) & (num_gt > 0)
+        fg_kept, fg_order = _rank_select(fg, nz[:, 0], fg_per_im)
+        n_fg = jnp.minimum(fg_kept.sum(), fg_per_im)
+
+        bg = cand_valid & (max_iou < fg_overlap)
+        bg_kept, bg_order = _rank_select(bg, nz[:, 1], per_im - n_fg)
+        n_bg = jnp.minimum(bg_kept.sum(), per_im - n_fg)
+
+        # slot i: i-th sampled fg, then sampled bgs cycled to fill capacity
+        slots = jnp.arange(per_im)
+        bg_slot = jnp.where(n_bg > 0, (slots - n_fg) % jnp.maximum(n_bg, 1), 0)
+        idx = jnp.where(slots < n_fg, fg_order[slots], bg_order[bg_slot])
+        is_fg = slots < n_fg
+        # all-empty degenerate image: zero-weight bg rows on candidate 0
+        any_cand = cand_valid.any()
+        idx = jnp.where(any_cand, idx, 0)
+
+        sel = cand[idx]
+        sel_gt = argmax[idx]
+        cls = jnp.where(is_fg, gt[sel_gt, 0] + 1.0, 0.0)  # 0 = background
+        label = jnp.where(any_cand, cls, 0.0)
+
+        tgt = _bbox_transform(sel[:, 1:5], gt[sel_gt, 1:5])  # (per_im, 4)
+        kcls = (jnp.minimum(cls, 1.0) if class_agnostic else cls).astype(jnp.int32)
+        onehot = jax.nn.one_hot(kcls, K, dtype=rois.dtype)  # (per_im, K)
+        w = (is_fg & any_cand)[:, None, None] * onehot[:, :, None]  # (per_im, K, 1)
+        bbox_target = (w * tgt[:, None, :]).reshape(per_im, 4 * K)
+        bbox_weight = jnp.broadcast_to(w, (per_im, K, 4)).reshape(per_im, 4 * K)
+        return sel, label, bbox_target, bbox_weight
+
+    sel, label, bt, bw = jax.vmap(one)(
+        jnp.arange(B, dtype=rois.dtype), rois_b, gt_boxes, noise)
+    return (
+        sel.reshape(B * per_im, 5),
+        label.reshape(B * per_im),
+        bt.reshape(B * per_im, 4 * K),
+        bw.reshape(B * per_im, 4 * K),
+    )

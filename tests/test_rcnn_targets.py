@@ -1,0 +1,231 @@
+"""On-device RPN anchor / proposal target ops vs the host-numpy oracles.
+
+The oracles are the example-level numpy implementations
+(examples/rcnn/faster_rcnn.py assign_anchor / ProposalTarget CustomOp),
+which themselves mirror the reference's host pipeline
+(rcnn/io/rpn.py assign_anchor, rcnn/symbol/proposal_target.py sample_rois).
+Randomized subsampling can't match draw-for-draw, so the comparisons check
+the deterministic parts exactly (candidate partition, counts, targets for
+forced selections) and distributional invariants for the sampled parts.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples", "rcnn"))
+
+
+def _rand_gt(rng, B, G, im_h, im_w, valid_counts):
+    gt = np.full((B, G, 5), -1.0, np.float32)
+    for b in range(B):
+        for g in range(valid_counts[b]):
+            x1 = rng.uniform(0, im_w - 40)
+            y1 = rng.uniform(0, im_h - 40)
+            w = rng.uniform(16, min(120, im_w - x1 - 1))
+            h = rng.uniform(16, min(120, im_h - y1 - 1))
+            gt[b, g] = [rng.randint(0, 3), x1, y1, x1 + w, y1 + h]
+    return gt
+
+
+def test_rpn_anchor_target_matches_numpy_partition():
+    import faster_rcnn as fr
+
+    rng = np.random.RandomState(0)
+    B, Hf, Wf = 2, 8, 11
+    stride, scales, ratios = 16, (4, 8), (0.5, 1, 2)
+    A = len(scales) * len(ratios)
+    im_info = np.array([[Hf * stride, Wf * stride, 1.0]] * B, np.float32)
+    gt = _rand_gt(rng, B, 4, Hf * stride, Wf * stride, [3, 1])
+
+    # huge batch_rois => no subsampling => deterministic, comparable exactly
+    label, bt, bw = nd.contrib.rpn_anchor_target(
+        nd.array(gt), nd.array(im_info),
+        feat_height=Hf, feat_width=Wf, feature_stride=stride,
+        scales=scales, ratios=ratios, batch_rois=10_000, fg_fraction=0.5,
+    )
+    label, bt, bw = label.asnumpy(), bt.asnumpy(), bw.asnumpy()
+    for b in range(B):
+        lab_np, bt_np, bw_np = fr.assign_anchor(
+            (Hf, Wf), gt[b], im_info[b], stride=stride, scales=scales,
+            ratios=ratios, batch_rois=10_000, fg_fraction=0.5,
+            rng=np.random.RandomState(1),
+        )
+        # fg_fraction*batch_rois >> candidates => oracle never subsamples
+        assert (label[b] == lab_np).all(), (
+            np.where(label[b] != lab_np), label[b][label[b] != lab_np],
+            lab_np[label[b] != lab_np])
+        np.testing.assert_allclose(bt[b], bt_np, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(bw[b], bw_np, rtol=1e-6, atol=0)
+
+
+def test_rpn_anchor_target_subsampling_counts():
+    rng = np.random.RandomState(3)
+    B, Hf, Wf = 1, 16, 16
+    stride = 8
+    im_info = np.array([[Hf * stride, Wf * stride, 1.0]], np.float32)
+    gt = _rand_gt(rng, B, 6, Hf * stride, Wf * stride, [6])
+    noise = rng.rand(B, Hf * Wf * 9, 2).astype(np.float32)
+    label, bt, bw = nd.contrib.rpn_anchor_target(
+        nd.array(gt), nd.array(im_info), nd.array(noise),
+        feat_height=Hf, feat_width=Wf, feature_stride=stride,
+        scales=(2, 4, 8), ratios=(0.5, 1, 2), batch_rois=64, fg_fraction=0.5,
+    )
+    lab = label.asnumpy()[0]
+    n_fg = (lab == 1).sum()
+    n_bg = (lab == 0).sum()
+    assert n_fg <= 32
+    assert n_fg + n_bg == 64
+    # weights exactly mark fg anchors
+    w = bw.asnumpy()[0]
+    assert ((w[:, 0] == 1) == (lab == 1)).all()
+    # two different noises give different subsets (randomness flows through)
+    noise2 = rng.rand(B, Hf * Wf * 9, 2).astype(np.float32)
+    lab2 = nd.contrib.rpn_anchor_target(
+        nd.array(gt), nd.array(im_info), nd.array(noise2),
+        feat_height=Hf, feat_width=Wf, feature_stride=stride,
+        scales=(2, 4, 8), ratios=(0.5, 1, 2), batch_rois=64, fg_fraction=0.5,
+    )[0].asnumpy()[0]
+    assert (lab != lab2).any()
+
+
+def test_rpn_anchor_target_no_gt():
+    im_info = np.array([[128, 128, 1.0]], np.float32)
+    gt = np.full((1, 3, 5), -1.0, np.float32)
+    label, bt, bw = (
+        o.asnumpy() for o in nd.contrib.rpn_anchor_target(
+            nd.array(gt), nd.array(im_info),
+            feat_height=16, feat_width=16, feature_stride=8,
+            scales=(2, 4), ratios=(1.0,), batch_rois=32,
+        )
+    )
+    assert (label[0] == 1).sum() == 0
+    assert (label[0] == 0).sum() == 32
+    assert (bw == 0).all()
+
+
+def _np_iou_p1(a, b):
+    tl = np.maximum(a[:, None, :2], b[None, :, :2])
+    br = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.maximum(br - tl + 1, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[:, 2] - a[:, 0] + 1) * (a[:, 3] - a[:, 1] + 1)
+    area_b = (b[:, 2] - b[:, 0] + 1) * (b[:, 3] - b[:, 1] + 1)
+    return inter / np.maximum(area_a[:, None] + area_b[None, :] - inter, 1e-12)
+
+
+@pytest.mark.parametrize("class_agnostic", [False, True])
+def test_proposal_target_semantics(class_agnostic):
+    rng = np.random.RandomState(5)
+    B, post, G = 2, 40, 4
+    im_h = im_w = 200
+    gt = _rand_gt(rng, B, G, im_h, im_w, [3, 2])
+    rois = np.zeros((B * post, 5), np.float32)
+    for b in range(B):
+        ctr = rng.rand(post, 2) * 160 + 20
+        wh = rng.rand(post, 2) * 60 + 10
+        rois[b * post:(b + 1) * post, 0] = b
+        rois[b * post:(b + 1) * post, 1:3] = np.maximum(ctr - wh / 2, 0)
+        rois[b * post:(b + 1) * post, 3:5] = np.minimum(ctr + wh / 2, 199)
+    num_classes, batch_rois, fgf = 4, 32, 0.25
+    noise = rng.rand(B, post + G, 2).astype(np.float32)
+    out_rois, label, bt, bw = (
+        o.asnumpy() for o in nd.contrib.proposal_target(
+            nd.array(rois), nd.array(gt), nd.array(noise),
+            num_classes=num_classes, batch_images=B, batch_rois=batch_rois,
+            fg_fraction=fgf, class_agnostic=class_agnostic,
+        )
+    )
+    K = 2 if class_agnostic else num_classes
+    per_im = batch_rois // B
+    fg_cap = int(round(fgf * per_im))
+    assert out_rois.shape == (batch_rois, 5)
+    assert bt.shape == (batch_rois, 4 * K) and bw.shape == (batch_rois, 4 * K)
+    for b in range(B):
+        sl = slice(b * per_im, (b + 1) * per_im)
+        sel, lab, t, w = out_rois[sl], label[sl], bt[sl], bw[sl]
+        assert (sel[:, 0] == b).all()
+        n_fg = (lab > 0).sum()
+        assert n_fg <= fg_cap
+        gt_b = gt[b][gt[b][:, 0] >= 0]
+        iou = _np_iou_p1(sel[:, 1:5], gt_b[:, 1:5])
+        max_iou = iou.max(axis=1)
+        # fg slots: iou >= 0.5 and class = gt class + 1; bg slots iou < 0.5
+        assert (max_iou[lab > 0] >= 0.5 - 1e-6).all()
+        assert (max_iou[lab == 0] < 0.5 + 1e-6).all()
+        for j in range(per_im):
+            if lab[j] > 0:
+                k = 1 if class_agnostic else int(lab[j])
+                assert w[j, 4 * k:4 * k + 4].sum() == 4
+                assert w[j].sum() == 4
+                # regression target points at the matched gt
+                g = gt_b[iou[j].argmax()]
+                ex = sel[j, 1:5]
+                ew, eh = ex[2] - ex[0] + 1, ex[3] - ex[1] + 1
+                exp_dx = ((g[1] + g[3]) / 2 - (ex[0] + ex[2]) / 2) / ew
+                np.testing.assert_allclose(t[j, 4 * k], exp_dx, rtol=1e-3, atol=1e-4)
+            else:
+                assert w[j].sum() == 0
+
+
+def test_proposal_target_includes_gt_and_degenerate():
+    # gt boxes join the candidate set => with fg noise favoring them they are
+    # sampled and get label = cls+1 at IoU 1
+    gt = np.array([[[2.0, 10, 10, 60, 60]]], np.float32)
+    rois = np.zeros((4, 5), np.float32)
+    rois[:, 1:5] = [100, 100, 140, 140]  # no overlap with gt
+    noise = np.ones((1, 5, 2), np.float32) * 0.5
+    noise[0, 4, 0] = 0.0  # gt candidate wins fg sampling
+    out_rois, label, bt, bw = (
+        o.asnumpy() for o in nd.contrib.proposal_target(
+            nd.array(rois), nd.array(gt), nd.array(noise),
+            num_classes=4, batch_images=1, batch_rois=4, fg_fraction=0.25,
+        )
+    )
+    assert label[0] == 3.0  # cls 2 + 1
+    np.testing.assert_allclose(out_rois[0, 1:5], [10, 10, 60, 60])
+    # fg target vs itself is (0,0,0,0)
+    np.testing.assert_allclose(bt[0, 12:16], 0, atol=1e-5)
+
+    # degenerate: no gt at all -> all-bg, zero weights
+    gt_e = np.full((1, 2, 5), -1.0, np.float32)
+    _, label_e, _, bw_e = (
+        o.asnumpy() for o in nd.contrib.proposal_target(
+            nd.array(rois), nd.array(gt_e),
+            num_classes=4, batch_images=1, batch_rois=4,
+        )
+    )
+    assert (label_e == 0).all() and (bw_e == 0).all()
+
+
+def test_targets_jit_fuse():
+    """Both ops trace into a jitted function (static shapes end-to-end)."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.rcnn_targets import rpn_anchor_target, proposal_target
+
+    B, Hf, Wf, G, post = 1, 6, 6, 3, 20
+
+    @jax.jit
+    def f(gt, info, rois, nz1, nz2):
+        lab, bt, bw = rpn_anchor_target(
+            gt, info, nz1, feat_height=Hf, feat_width=Wf, feature_stride=8,
+            scales=(4,), ratios=(1.0,), batch_rois=16)
+        r, l2, t2, w2 = proposal_target(
+            rois, gt, nz2, num_classes=3, batch_images=B, batch_rois=8)
+        return lab.sum() + bt.sum() + bw.sum() + r.sum() + l2.sum() + t2.sum() + w2.sum()
+
+    rng = np.random.RandomState(0)
+    gt = jnp.asarray(_rand_gt(rng, B, G, 48, 48, [2]))
+    info = jnp.asarray(np.array([[48, 48, 1.0]], np.float32))
+    rois = jnp.asarray(
+        np.concatenate([np.zeros((post, 1)), rng.rand(post, 2) * 20,
+                        rng.rand(post, 2) * 20 + 24], axis=1).astype(np.float32))
+    v = f(gt, info, rois,
+          jnp.asarray(rng.rand(B, Hf * Wf, 2), jnp.float32),
+          jnp.asarray(rng.rand(B, post + G, 2), jnp.float32))
+    assert np.isfinite(float(v))
