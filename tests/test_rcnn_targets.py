@@ -229,3 +229,20 @@ def test_targets_jit_fuse():
           jnp.asarray(rng.rand(B, Hf * Wf, 2), jnp.float32),
           jnp.asarray(rng.rand(B, post + G, 2), jnp.float32))
     assert np.isfinite(float(v))
+
+
+def test_proposal_target_bg_starved_pads_with_fg():
+    """Every candidate >= fg_overlap: pad slots must repeat sampled fgs WITH
+    their true fg labels (reference sample_rois pads by repeating indices),
+    never relabel a high-IoU box as background."""
+    gt = np.array([[[1.0, 0, 0, 180, 180]]], np.float32)
+    rois = np.zeros((6, 5), np.float32)
+    rois[:, 1:5] = [2, 2, 178, 178]  # all IoU ~1 with the gt
+    out_rois, label, bt, bw = (
+        o.asnumpy() for o in nd.contrib.proposal_target(
+            nd.array(rois), nd.array(gt),
+            num_classes=3, batch_images=1, batch_rois=8, fg_fraction=0.25,
+        )
+    )
+    assert (label == 2.0).all(), label  # cls 1 + 1, no fake backgrounds
+    assert (bw.reshape(8, -1).sum(1) == 4).all()
