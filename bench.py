@@ -1,12 +1,17 @@
-"""Benchmark: ResNet-50 training throughput (img/s), single chip.
+"""Benchmark: north-star throughput, single chip.  Prints ONE JSON line.
 
-Baseline: the reference's own headline number — ResNet-50 training at batch 32
-on 1x K80: 109 img/s (`example/image-classification/README.md:145-156`,
-BASELINE.md).  Prints ONE JSON line.
+Default metric: **Deformable R-FCN (ResNet-101) training img/s** at COCO
+shapes (608x1024, 80 classes) — the model family this reference fork exists
+for (BASELINE.md north star; published ~3.8 img/s on the reference's
+GPU setup, external Deformable-ConvNets repo).  The measured step is the
+FULL detection train step — ResNet-101 + deformable res5, RPN,
+MultiProposal, on-device targets, deformable PS-ROI heads, 4 losses,
+momentum SGD — compiled into one XLA module
+(examples/deformable_rfcn/train_fused.py).
 
-The measured step is the full training step — forward, backward, BatchNorm
-stat update, SGD-momentum — compiled into one XLA module (see
-mxnet_tpu/gluon/functional.py make_train_step).
+``MXNET_BENCH=resnet50`` selects the classification headline instead
+(ResNet-50 train, baseline 109 img/s on 1x K80,
+`example/image-classification/README.md:145-156`).
 """
 import json
 import os
@@ -16,6 +21,8 @@ import numpy as np
 
 
 def main():
+    if os.environ.get("MXNET_BENCH", "rfcn") != "resnet50":
+        return main_rfcn()
     import jax
 
     platform = jax.devices()[0].platform
@@ -71,6 +78,28 @@ def main():
     baseline = 109.0  # 1x K80, batch 32
     print(json.dumps({
         "metric": "resnet50_train_imgs_per_sec",
+        "value": round(imgs_per_sec, 2),
+        "unit": "img/s",
+        "vs_baseline": round(imgs_per_sec / baseline, 3),
+    }))
+
+
+def main_rfcn():
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "examples", "deformable_rfcn"))
+    import jax
+    from train_fused import run_bench
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    batch = int(os.environ.get("MXNET_BENCH_BATCH", 1))
+    iters = int(os.environ.get("MXNET_BENCH_ITERS", 10 if on_tpu else 2))
+    imgs_per_sec, _ms, _loss = run_bench(
+        resnet101=on_tpu, batch=batch, iters=iters,
+        dtype="bfloat16" if on_tpu else None, verbose=False)
+    baseline = 3.8  # Deformable R-FCN reference throughput (BASELINE.md)
+    print(json.dumps({
+        "metric": "deformable_rfcn_r101_coco_train_imgs_per_sec",
         "value": round(imgs_per_sec, 2),
         "unit": "img/s",
         "vs_baseline": round(imgs_per_sec / baseline, 3),

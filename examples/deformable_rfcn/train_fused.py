@@ -65,9 +65,9 @@ def make_rfcn_train_step(net, batch, learning_rate=5e-4, momentum=0.9,
 
     Mixed precision (``compute_dtype='bfloat16'``): parameters and image in
     bf16 for the conv trunk (MXU dtype, halved HBM traffic); box/coordinate
-    math stays fp32 — gt/im_info/rois are never downcast, and MultiProposal
-    upcasts its inputs (a bf16 box grid at 1000 px quantises to 4-px steps,
-    which would corrupt IoU target assignment).
+    math stays fp32 — gt/im_info/rois are never downcast, MultiProposal
+    upcasts its inputs at entry (ops/detection.py multi_proposal), and the
+    PS-ROI pooling computes sample coordinates in fp32.
     """
     import jax
     import jax.numpy as jnp
@@ -128,14 +128,74 @@ def make_rfcn_train_step(net, batch, learning_rate=5e-4, momentum=0.9,
     def step(state, data, im_info, gt, key):
         learn, mom, aux = state
         (loss, (new_aux, parts)), grads = grad_fn(learn, aux, data, im_info, gt, key)
-        mom = [momentum * m + g for m, g in zip(mom, grads)]
-        learn = [p - learning_rate * g for p, g in zip(learn, mom)]
+        if momentum:
+            mom = [momentum * m + g for m, g in zip(mom, grads)]
+            upd = mom
+        else:
+            upd = grads
+        learn = [p - learning_rate * g for p, g in zip(learn, upd)]
         return (learn, mom, new_aux), loss, parts
 
     learn_vals = [vals[i] for i in learn_idx]
     aux_vals = [vals[i] for i in aux_idx]
-    mom_vals = [np.zeros_like(np.asarray(v)) for v in learn_vals]
+    # zeros_like on the jax arrays: shapes/dtypes only, no D2H transfer
+    mom_vals = [jnp.zeros_like(v) for v in learn_vals] if momentum else []
     return step, (learn_vals, mom_vals, aux_vals)
+
+
+def build_net(resnet101, image_shape=None, classes=None):
+    """→ (net, image_shape, classes): the full ResNet-101 north-star model,
+    or the tiny-trunk CPU configuration with the same graph."""
+    if resnet101:
+        shape = tuple(image_shape or (608, 1024))
+        classes = classes or 80
+        net = rfcn_resnet101(classes=classes, image_shape=shape, max_gts=16)
+    else:
+        shape = tuple(image_shape or (64, 96))
+        classes = classes or 3
+        # anchor scales sized for the tiny image (stride 16 ⇒ 16/32-px boxes)
+        net = DeformableRFCN(
+            classes=classes, image_shape=shape, units=(1, 1, 1, 1),
+            scales=(1, 2), ratios=(0.5, 1, 2), rpn_pre_nms=200,
+            rpn_post_nms=32, batch_rois=16, rpn_batch=32, max_gts=8)
+    net.initialize()
+    net.init_params()  # tiny dummy pass; H/W-independent param shapes
+    return net, shape, classes
+
+
+def run_bench(resnet101, batch=1, iters=10, image_shape=None, classes=None,
+              dtype=None, lr=5e-4, windows=3, verbose=True):
+    """Timed chained-step bench (state stays on device; one scalar fetch per
+    window).  → (img_per_sec, ms_per_step, final_loss).  This is THE repo
+    headline measurement — bench.py calls it."""
+    import jax
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    net, shape, classes = build_net(resnet101, image_shape, classes)
+    data, im_info, gt = synthetic_coco(rng, batch, shape, classes, net.max_gts)
+    step, state = make_rfcn_train_step(
+        net, batch, learning_rate=lr, momentum=0.9, compute_dtype=dtype)
+    jstep = jax.jit(step, donate_argnums=(0,))
+    key = jax.random.PRNGKey(0)
+    d = jax.device_put(data)
+    i = jax.device_put(im_info)
+    g = jax.device_put(gt)
+    t0 = time.time()
+    state, loss, parts = jstep(state, d, i, g, key)
+    jax.block_until_ready(loss)
+    if verbose:
+        print("compile+first step: %.1fs  loss=%.4f" % (time.time() - t0, float(loss)))
+    best = None
+    for w in range(windows):
+        t0 = time.perf_counter()
+        for it in range(iters):
+            state, loss, parts = jstep(
+                state, d, i, g, jax.random.fold_in(key, w * 1000 + it))
+        float(loss)  # sync via the scalar; state never leaves the device
+        dt = (time.perf_counter() - t0) / iters
+        best = dt if best is None else min(best, dt)
+    return batch / best, best * 1e3, float(loss)
 
 
 def main():
@@ -159,54 +219,26 @@ def main():
     if args.dtype is None and args.bench and on_tpu:
         args.dtype = "bfloat16"
 
+    if args.bench:
+        img_s, ms, loss = run_bench(
+            args.resnet101, batch=args.batch_size, iters=args.bench_iters,
+            image_shape=args.image_shape, classes=args.classes,
+            dtype=args.dtype, lr=args.lr)
+        print("rfcn_fused_bench: batch=%d dtype=%s  %.2f img/s (%.0f ms/step)"
+              "  loss=%.4f"
+              % (args.batch_size, args.dtype or "float32", img_s, ms, loss))
+        return
+
     mx.random.seed(0)
     rng = np.random.RandomState(0)
-    if args.resnet101:
-        shape = tuple(args.image_shape or (608, 1024))
-        classes = args.classes or 80
-        net = rfcn_resnet101(classes=classes, image_shape=shape, max_gts=16)
-    else:
-        shape = tuple(args.image_shape or (64, 96))
-        classes = args.classes or 3
-        # anchor scales sized for the tiny image (stride 16 ⇒ 16/32-px boxes)
-        net = DeformableRFCN(
-            classes=classes, image_shape=shape, units=(1, 1, 1, 1),
-            scales=(1, 2), ratios=(0.5, 1, 2), rpn_pre_nms=200,
-            rpn_post_nms=32, batch_rois=16, rpn_batch=32, max_gts=8)
-    net.initialize()
-    net.init_params()  # tiny dummy pass; H/W-independent param shapes
+    net, shape, classes = build_net(args.resnet101, args.image_shape, args.classes)
     data, im_info, gt = synthetic_coco(rng, args.batch_size, shape, classes,
                                        net.max_gts)
-
     step, state = make_rfcn_train_step(
         net, args.batch_size, learning_rate=args.lr, momentum=0.9,
         compute_dtype=args.dtype)
     jstep = jax.jit(step, donate_argnums=(0,))
     key = jax.random.PRNGKey(0)
-
-    if args.bench:
-        d = jax.device_put(data)
-        i = jax.device_put(im_info)
-        g = jax.device_put(gt)
-        t0 = time.time()
-        state, loss, parts = jstep(state, d, i, g, key)
-        jax.block_until_ready(loss)
-        print("compile+first step: %.1fs  loss=%.4f" % (time.time() - t0, float(loss)))
-        best = None
-        for w in range(3):
-            t0 = time.perf_counter()
-            for it in range(args.bench_iters):
-                state, loss, parts = jstep(
-                    state, d, i, g, jax.random.fold_in(key, w * 100 + it))
-            jax.block_until_ready(loss)
-            dt = (time.perf_counter() - t0) / args.bench_iters
-            best = dt if best is None else min(best, dt)
-        img_s = args.batch_size / best
-        print("rfcn_fused_bench: shape=%s batch=%d classes=%d dtype=%s  "
-              "%.2f img/s (%.0f ms/step)  loss=%.4f"
-              % (shape, args.batch_size, classes, args.dtype or "float32",
-                 img_s, best * 1e3, float(loss)))
-        return
 
     first = last = None
     for s in range(args.steps):

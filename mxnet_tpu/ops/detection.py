@@ -296,9 +296,12 @@ def deformable_psroi_pooling(
     spp = int(sample_per_part)
     OD = int(output_dim)
     B, C, H, W = data.shape
-    f32 = data.dtype
+    # coordinate math always runs fp32 — bf16 sample positions quantize to
+    # ~0.25 px at COCO feature extents; values stay in the data dtype
+    f32 = jnp.float32
 
     batch_idx = rois[:, 0].astype(jnp.int32)
+    rois = rois.astype(f32)
     xs = jnp.round(rois[:, 1]) * spatial_scale - 0.5
     ys = jnp.round(rois[:, 2]) * spatial_scale - 0.5
     xe = (jnp.round(rois[:, 3]) + 1.0) * spatial_scale - 0.5
@@ -313,30 +316,44 @@ def deformable_psroi_pooling(
     num_classes = 1 if no_trans or trans is None else trans.shape[1] // 2
     ch_per_class = OD // num_classes
     R = rois.shape[0]
+    g2 = group * group
+    if C != OD * g2:
+        raise ValueError(
+            "DeformablePSROIPooling: data has %d channels, needs output_dim"
+            "*group_size^2 = %d*%d = %d" % (C, OD, g2, OD * g2))
 
-    # per-bin group channel map (same as PSROIPooling)
+    # The position-sensitive channel map is separable: channel index =
+    # c·g² + gh(ph)·g + gw(pw).  Lay the data out as (B, ncls, g², H, W,
+    # cpc) so one 5-index gather per corner fetches a CONTIGUOUS
+    # ``ch_per_class``-vector per sample — sample coordinates depend only on
+    # the trans class, never the within-class channel.  This cuts gather
+    # count ~cpc× vs a scalar-per-channel gather (measured 0.5 s → the
+    # whole-step bottleneck at north-star shapes, 81-class cls pooling).
+    datag = data.reshape(B, num_classes, ch_per_class, g2, H, W)
+    datag = datag.transpose(0, 1, 3, 4, 5, 2)  # (B, ncls, g2, H, W, cpc)
+
     ghs = np.clip((np.arange(PH) * group) // PH, 0, group - 1)
     gws = np.clip((np.arange(PW) * group) // PW, 0, group - 1)
-    cin = ((np.arange(OD)[:, None, None] * group + ghs[None, :, None]) * group + gws[None, None, :])
-    cin = jnp.asarray(cin)  # (OD, PH, PW)
+    ghw = jnp.asarray(ghs[:, None] * group + gws[None, :])  # (PH, PW)
     # part cell per bin
     part_h = np.asarray((np.arange(PH) * part) // PH)  # (PH,)
     part_w = np.asarray((np.arange(PW) * part) // PW)
-    class_id = np.asarray(np.arange(OD) // ch_per_class)  # (OD,)
 
     su = jnp.arange(spp, dtype=f32)
     r1 = (slice(None), None, None, None)  # (R,) -> (R,1,1,1)
+    K = num_classes
 
     if no_trans or trans is None:
-        tx = jnp.zeros((R, OD, PH, PW), f32)
-        ty = jnp.zeros((R, OD, PH, PW), f32)
+        tx = jnp.zeros((R, K, PH, PW), f32)
+        ty = jnp.zeros((R, K, PH, PW), f32)
     else:
-        # trans (R, 2·num_classes, part, part) -> per-bin offsets (R,OD,PH,PW)
-        tx = trans[:, class_id * 2][:, :, part_h][:, :, :, part_w] * trans_std
-        ty = trans[:, class_id * 2 + 1][:, :, part_h][:, :, :, part_w] * trans_std
+        # trans (R, 2K, part, part) -> per-class per-bin offsets (R,K,PH,PW)
+        t = trans.reshape(R, K, 2, part, part)
+        tx = t[:, :, 0][:, :, part_h][:, :, :, part_w] * trans_std
+        ty = t[:, :, 1][:, :, part_h][:, :, :, part_w] * trans_std
     wst = jnp.arange(PW, dtype=f32)[None, None, None, :] * bs_w[r1] + xs[r1] + tx * roi_w[r1]
     hst = jnp.arange(PH, dtype=f32)[None, None, :, None] * bs_h[r1] + ys[r1] + ty * roi_h[r1]
-    # sample grid (R, OD, PH, PW, spp, spp)
+    # sample grid (R, K, PH, PW, spp, spp)
     sy = hst[..., None, None] + su[None, None, None, None, :, None] * sub_h[:, None, None, None, None, None]
     sx = wst[..., None, None] + su[None, None, None, None, None, :] * sub_w[:, None, None, None, None, None]
     sy, sx = jnp.broadcast_arrays(sy, sx)
@@ -351,21 +368,81 @@ def deformable_psroi_pooling(
     x1 = jnp.minimum(x0 + 1, W - 1)
     ly = syc - y0.astype(f32)
     lx = sxc - x0.astype(f32)
-    # ONE batched 4-index gather per corner: the batch index rides in the
-    # gather (no per-ROI copy of the feature map — a vmapped ``data[b]``
-    # would materialize an (R, C, H, W) tensor, 11.6 GB at COCO eval scale)
-    b_idx = batch_idx[:, None, None, None, None, None]
-    c_idx = cin[None, ..., None, None]  # (1,OD,PH,PW,1,1)
-    v = (
-        data[b_idx, c_idx, y0, x0] * (1 - ly) * (1 - lx)
-        + data[b_idx, c_idx, y0, x1] * (1 - ly) * lx
-        + data[b_idx, c_idx, y1, x0] * ly * (1 - lx)
-        + data[b_idx, c_idx, y1, x1] * ly * lx
-    )
     lf = live.astype(f32)
-    cnt = lf.sum(axis=(4, 5))
-    s = (v * lf).sum(axis=(4, 5))
-    return jnp.where(cnt > 0, s / jnp.maximum(cnt, 1.0), jnp.zeros((), f32))
+    cnt = lf.sum(axis=(4, 5))[..., None]  # (R, K, PH, PW, 1)
+
+    spp2 = spp * spp
+    if R * K * PH * PW * spp2 * ch_per_class >= (1 << 16):
+        # -- one-hot matmul path (TPU hot path) ---------------------------
+        # Per bin (k, ph, pw): accumulate the 4 live-masked bilinear corner
+        # weights of every (roi, sample) into a dense (R, B·H·W) matrix and
+        # multiply by that bin's flattened plane.  Both forward and the AD
+        # transpose are MXU matmuls — no gather OR scatter touches HBM.
+        # (The scatter-add XLA derives from a gather formulation measured
+        # ~580 ms/step at north-star shapes; this path is ~2 orders less.)
+        w00 = ((1 - ly) * (1 - lx) * lf).reshape(R, K, PH, PW, spp2)
+        w01 = ((1 - ly) * lx * lf).reshape(R, K, PH, PW, spp2)
+        w10 = (ly * (1 - lx) * lf).reshape(R, K, PH, PW, spp2)
+        w11 = (ly * lx * lf).reshape(R, K, PH, PW, spp2)
+        bhw = B * H * W
+        base = batch_idx[:, None, None, None, None] * (H * W)
+        p00 = (base + y0.reshape(R, K, PH, PW, spp2) * W + x0.reshape(R, K, PH, PW, spp2))
+        p01 = (base + y0.reshape(R, K, PH, PW, spp2) * W + x1.reshape(R, K, PH, PW, spp2))
+        p10 = (base + y1.reshape(R, K, PH, PW, spp2) * W + x0.reshape(R, K, PH, PW, spp2))
+        p11 = (base + y1.reshape(R, K, PH, PW, spp2) * W + x1.reshape(R, K, PH, PW, spp2))
+
+        # bins axis: (K, PH, PW) -> NB; planes per bin from the channel map
+        def to_bins(a):  # (R, K, PH, PW, spp2) -> (NB, R, spp2)
+            return a.transpose(1, 2, 3, 0, 4).reshape(K * PH * PW, R, spp2)
+
+        ws = jnp.stack([to_bins(w) for w in (w00, w01, w10, w11)], axis=1)  # (NB,4,R,spp2)
+        ps = jnp.stack([to_bins(p) for p in (p00, p01, p10, p11)], axis=1)
+        # (B, K, g2, H, W, cpc) -> per-bin flattened planes (NB, B·H·W, cpc)
+        kb = np.repeat(np.arange(K), PH * PW)
+        gb = np.tile(np.asarray(ghs[:, None] * group + gws[None, :]).reshape(-1), K)
+        planes = datag.transpose(1, 2, 0, 3, 4, 5).reshape(K, g2, bhw, ch_per_class)
+        planes = planes[kb, gb]  # (NB, bhw, cpc)
+
+        iota = jnp.arange(bhw, dtype=jnp.int32)
+
+        # remat: without it, AD saves each bin's (R, spp2, bhw) comparison
+        # mask as a residual (~1 GB over 49 bins at north-star shapes);
+        # rebuilding A in the backward is a handful of fused element ops
+        @jax.checkpoint
+        def one_bin(args):
+            w4, p4, plane = args  # (4, R, spp2), (4, R, spp2), (bhw, cpc)
+            # A[r, p] = Σ_corners Σ_samples w·[pos == p]; the (R, spp2, bhw)
+            # comparison broadcast fuses into the reduction (never stored)
+            a = sum(
+                jnp.sum(jnp.where(p4[c][..., None] == iota, w4[c][..., None],
+                                  jnp.zeros((), f32)), axis=1)
+                for c in range(4)
+            )  # (R, bhw)
+            return a.astype(datag.dtype) @ plane  # (R, cpc)
+
+        s = jax.lax.map(one_bin, (ws, ps, planes))  # (NB, R, cpc)
+        s = s.reshape(K, PH, PW, R, ch_per_class).transpose(3, 0, 1, 2, 4)
+    else:
+        # -- gather path (small problems / CPU) ---------------------------
+        # batch index rides in the gather (a vmapped ``data[b]`` would
+        # materialize an (R, C, H, W) copy — 11.6 GB at COCO eval scale)
+        b_idx = batch_idx[:, None, None, None, None, None]
+        k_idx = jnp.arange(K)[None, :, None, None, None, None]
+        g_idx = ghw[None, None, :, :, None, None]
+        lyn = ly[..., None]
+        lxn = lx[..., None]
+        v = (
+            datag[b_idx, k_idx, g_idx, y0, x0] * (1 - lyn) * (1 - lxn)
+            + datag[b_idx, k_idx, g_idx, y0, x1] * (1 - lyn) * lxn
+            + datag[b_idx, k_idx, g_idx, y1, x0] * lyn * (1 - lxn)
+            + datag[b_idx, k_idx, g_idx, y1, x1] * lyn * lxn
+        )  # (R, K, PH, PW, spp, spp, cpc)
+        s = (v * lf[..., None]).sum(axis=(4, 5))  # (R, K, PH, PW, cpc)
+
+    out = jnp.where(cnt > 0, s.astype(f32) / jnp.maximum(cnt, 1.0),
+                    jnp.zeros((), f32))
+    # (R, K, PH, PW, cpc) -> (R, K·cpc = OD, PH, PW), in the data dtype
+    return out.transpose(0, 1, 4, 2, 3).reshape(R, OD, PH, PW).astype(data.dtype)
 
 
 def _defconv_inputs(attrs):
@@ -686,6 +763,12 @@ def multi_proposal(
     if output_score, (B·post, 1) scores."""
     if iou_loss:
         raise NotImplementedError("iou_loss=True branch is not supported on TPU build")
+    # box/score math always runs fp32: bf16 scores (8 mantissa bits) collapse
+    # the pre-NMS top-k into index-order ties, and bf16 box coords quantise
+    # to 4-px steps at 1000-px extents (mixed-precision trunks feed bf16 in)
+    cls_prob = cls_prob.astype(jnp.float32)
+    bbox_pred = bbox_pred.astype(jnp.float32)
+    im_info = im_info.astype(jnp.float32)
     anchors = jnp.asarray(_generate_base_anchors(feature_stride, scales, ratios))
     B = cls_prob.shape[0]
     A = anchors.shape[0]
