@@ -215,28 +215,83 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
 
 
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False, train_mode=True):
-    """Functional-style grad (reference autograd.py:270). create_graph not yet supported."""
+    """Functional-style grad (reference autograd.py:270).
+
+    ``create_graph=True`` (higher-order, reference parity): the returned
+    gradients are themselves recorded on the tape — the gradient computation
+    is a pure function of ``variables`` (a ``jax.vjp`` over the replayed
+    prefix), so it becomes one tape entry whose replay jax can differentiate
+    again (vjp-of-vjp).  A later ``backward()``/``grad()`` over anything
+    computed from these gradients yields true second-order derivatives
+    (the WGAN-GP gradient-penalty pattern).  As in the reference,
+    ``retain_graph`` defaults to ``create_graph``.
+    """
     import jax
     import jax.numpy as jnp
 
     from .ndarray.ndarray import NDArray, _wrap
 
-    if create_graph:
-        raise NotImplementedError("higher-order autograd.grad(create_graph=True) is not supported yet")
     heads = heads if isinstance(heads, (list, tuple)) else [heads]
     variables = variables if isinstance(variables, (list, tuple)) else [variables]
+    if retain_graph is None:
+        retain_graph = create_graph
     st = _st()
-    f = _replay(st.tape, heads, variables)
-    outs, vjp_fn = jax.vjp(f, [v._data for v in variables])
+    prefix = list(st.tape)  # the graph that produced ``heads``
     if head_grads is None:
-        cts = [jnp.ones_like(o) for o in outs]
+        hgs = None
     else:
         hg = head_grads if isinstance(head_grads, (list, tuple)) else [head_grads]
-        cts = [g._data if isinstance(g, NDArray) else jnp.asarray(g) for g in hg]
-    (grads,) = vjp_fn(cts)
-    if retain_graph is False:
+        hgs = [g._data if isinstance(g, NDArray) else jnp.asarray(g) for g in hg]
+
+    nvar = len(variables)
+    if create_graph:
+        # every other tape input (network parameters) and any NDArray
+        # head_grad must be a traced input of the recorded grad op —
+        # otherwise the outer backward sees them as constants and
+        # second-order grads w.r.t. them (the WGAN-GP case) silently vanish
+        seen = {id(v) for v in variables}
+        extra = []
+        for e in prefix:
+            for nd_in in e.inputs:
+                if nd_in is not None and id(nd_in) not in seen:
+                    seen.add(id(nd_in))
+                    extra.append(nd_in)
+        hg_nd = [] if head_grads is None else [
+            g for g in (head_grads if isinstance(head_grads, (list, tuple))
+                        else [head_grads])
+            if isinstance(g, NDArray)]
+        all_nd = list(variables) + extra
+        n_all = len(all_nd)
+
+        def grad_fn(*vals):
+            f = _replay(prefix, heads, all_nd)
+            outs, vjp_fn = jax.vjp(f, list(vals[:n_all]))
+            if hgs is None:
+                cts = [jnp.ones_like(o) for o in outs]
+            else:
+                hg_vals = iter(vals[n_all:])
+                orig = (head_grads if isinstance(head_grads, (list, tuple))
+                        else [head_grads])
+                cts = [next(hg_vals) if isinstance(g, NDArray) else c
+                       for g, c in zip(orig, hgs)]
+            (gs,) = vjp_fn(cts)
+            return tuple(gs[:nvar])
+
+        in_nd = all_nd + hg_nd
+        in_vals = [v._data for v in in_nd]
+        gvals = grad_fn(*in_vals)
+        out_nd = [_wrap(g) for g in gvals]
+        _record_op(grad_fn, in_nd, in_vals, {}, out_nd)
+    else:
+        # first-order: differentiate w.r.t. the requested variables only
+        f = _replay(prefix, heads, variables)
+        outs, vjp_fn = jax.vjp(f, [v._data for v in variables])
+        cts = [jnp.ones_like(o) for o in outs] if hgs is None else list(hgs)
+        (gs,) = vjp_fn(cts)
+        out_nd = [_wrap(g) for g in gs]
+    if not retain_graph:
         st.tape = []
-    return [_wrap(g) for g in grads]
+    return out_nd
 
 
 def get_symbol(x):

@@ -142,3 +142,70 @@ def test_training_flag_dropout():
     assert (y2.asnumpy() == 1).all()
     y3 = nd.Dropout(x, p=0.5)  # outside record: inference
     assert (y3.asnumpy() == 1).all()
+
+
+def test_grad_create_graph_second_order():
+    """create_graph=True (reference autograd.py:270): grad of grad.
+    y = x^3: dy/dx = 3x^2, d2y/dx2 = 6x."""
+    x = nd.array(np.array([1.0, 2.0, -3.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        (g,) = autograd.grad([y], [x], create_graph=True)
+        z = (g * g).sum()  # sum (3x^2)^2 -> dz/dx = 2*3x^2*6x = 36x^3
+    z.backward()
+    np.testing.assert_allclose(
+        x.grad.asnumpy(), 36.0 * np.array([1.0, 2.0, -3.0]) ** 3, rtol=1e-5)
+
+
+def test_grad_create_graph_via_grad_twice():
+    """Second order via two grad() calls (no backward)."""
+    x = nd.array(np.array([0.5, 1.5], np.float32))
+    with autograd.record():
+        y = nd.exp(x) * x
+        (g,) = autograd.grad([y], [x], create_graph=True)  # (x+1)e^x
+        (g2,) = autograd.grad([g], [x], create_graph=False)  # (x+2)e^x
+    xv = np.array([0.5, 1.5])
+    np.testing.assert_allclose(g2.asnumpy(), (xv + 2) * np.exp(xv), rtol=1e-5)
+
+
+def test_grad_retain_defaults_match_reference():
+    """retain_graph defaults to create_graph (reference autograd.py:270)."""
+    x = nd.array(np.array([2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    (g,) = autograd.grad([y], [x])  # create_graph=False -> graph freed
+    np.testing.assert_allclose(g.asnumpy(), [4.0])
+    import pytest as _pytest
+    with _pytest.raises(Exception):
+        autograd.backward([y])  # tape gone
+
+
+def test_wgan_gp_style_gradient_penalty_trains():
+    """WGAN-GP pattern: penalty (||dD/dx|| - 1)^2 trains through
+    second-order autograd; the penalty decreases under SGD."""
+    import mxnet_tpu as mx
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    net = mx.gluon.nn.Dense(1)
+    net.initialize()
+    x = nd.array(rng.randn(8, 4).astype(np.float32))
+    net(x)  # materialize params
+    params = list(net.collect_params().values())
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1})
+    losses = []
+    for step in range(12):
+        xi = nd.array(rng.randn(8, 4).astype(np.float32))
+        xi.attach_grad()
+        with autograd.record():
+            out = net(xi).sum()
+            (gx,) = autograd.grad([out], [xi], create_graph=True)
+            gnorm = nd.sqrt((gx * gx).sum(axis=1) + 1e-12)
+            penalty = ((gnorm - 1.0) ** 2).mean()
+        penalty.backward()
+        trainer.step(1)
+        losses.append(float(penalty.asnumpy()))
+    assert losses[-1] < losses[0] * 0.5, losses

@@ -499,3 +499,43 @@ def test_nms_blocked_empty():
     assert _nms_alive_blocked(jnp.zeros((0, 4)), 0.5).shape == (0,)
     out = nd.contrib.box_nms(nd.array(np.zeros((1, 0, 6), np.float32)))
     assert out.shape == (1, 0, 6)
+
+
+def test_deformable_psroi_matmul_path_matches_gather_path():
+    """The one-hot-matmul hot path (engaged above the size threshold,
+    detection.py) must match the gather path in forward AND gradients —
+    the TPU headline runs through it."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops import detection as D
+
+    rng = np.random.RandomState(0)
+    B, OD, g = 2, 6, 3
+    C, H, W = OD * g * g, 12, 16
+    data = jnp.asarray(rng.rand(B, C, H, W).astype(np.float32))
+    R = 40
+    rois = np.zeros((R, 5), np.float32)
+    rois[:, 0] = rng.randint(0, B, R)
+    rois[:, 1:3] = rng.rand(R, 2) * 100
+    rois[:, 3:5] = rois[:, 1:3] + rng.rand(R, 2) * 120 + 8
+    trans = jnp.asarray(0.3 * rng.randn(R, 2, 3, 3).astype(np.float32))
+    kw = dict(spatial_scale=1 / 8, output_dim=OD, group_size=g,
+              pooled_size=3, part_size=3, trans_std=0.1)
+    small = D.deformable_psroi_pooling(data, jnp.asarray(rois), trans, **kw)
+    # tile ROIs 40x to cross the 1<<16 threshold -> matmul path
+    roisL = jnp.asarray(np.tile(rois, (40, 1)))
+    transL = jnp.asarray(np.tile(np.asarray(trans), (40, 1, 1, 1)))
+    big = D.deformable_psroi_pooling(data, roisL, transL, **kw)
+    np.testing.assert_allclose(np.asarray(big[:R]), np.asarray(small),
+                               rtol=1e-5, atol=1e-5)
+
+    f_small = lambda d, t: jnp.sum(
+        D.deformable_psroi_pooling(d, jnp.asarray(rois), t, **kw) ** 2)
+    f_big = lambda d, t: jnp.sum(
+        D.deformable_psroi_pooling(d, roisL, t, **kw)[:R] ** 2)
+    gs = jax.grad(f_small, argnums=(0, 1))(data, trans)
+    gb = jax.grad(f_big, argnums=(0, 1))(data, transL)
+    np.testing.assert_allclose(np.asarray(gs[0]), np.asarray(gb[0]),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gs[1]), np.asarray(gb[1][:R]),
+                               rtol=1e-4, atol=1e-5)
