@@ -238,10 +238,11 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
     st = _st()
     prefix = list(st.tape)  # the graph that produced ``heads``
     if head_grads is None:
+        hg_list = None
         hgs = None
     else:
-        hg = head_grads if isinstance(head_grads, (list, tuple)) else [head_grads]
-        hgs = [g._data if isinstance(g, NDArray) else jnp.asarray(g) for g in hg]
+        hg_list = head_grads if isinstance(head_grads, (list, tuple)) else [head_grads]
+        hgs = [g._data if isinstance(g, NDArray) else jnp.asarray(g) for g in hg_list]
 
     nvar = len(variables)
     if create_graph:
@@ -249,17 +250,20 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
         # head_grad must be a traced input of the recorded grad op —
         # otherwise the outer backward sees them as constants and
         # second-order grads w.r.t. them (the WGAN-GP case) silently vanish
+        # ...but tape-produced intermediates are NOT inputs: their traced
+        # binding is overwritten by the producer entry during replay, so
+        # including them only pins activations and adds dead cotangents
+        produced = {id(o) for e in prefix for o in e.outputs}
         seen = {id(v) for v in variables}
         extra = []
         for e in prefix:
             for nd_in in e.inputs:
-                if nd_in is not None and id(nd_in) not in seen:
+                if (nd_in is not None and id(nd_in) not in seen
+                        and id(nd_in) not in produced):
                     seen.add(id(nd_in))
                     extra.append(nd_in)
-        hg_nd = [] if head_grads is None else [
-            g for g in (head_grads if isinstance(head_grads, (list, tuple))
-                        else [head_grads])
-            if isinstance(g, NDArray)]
+        hg_nd = [] if hg_list is None else [
+            g for g in hg_list if isinstance(g, NDArray)]
         all_nd = list(variables) + extra
         n_all = len(all_nd)
 
@@ -270,10 +274,8 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
                 cts = [jnp.ones_like(o) for o in outs]
             else:
                 hg_vals = iter(vals[n_all:])
-                orig = (head_grads if isinstance(head_grads, (list, tuple))
-                        else [head_grads])
                 cts = [next(hg_vals) if isinstance(g, NDArray) else c
-                       for g, c in zip(orig, hgs)]
+                       for g, c in zip(hg_list, hgs)]
             (gs,) = vjp_fn(cts)
             return tuple(gs[:nvar])
 
@@ -290,7 +292,11 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
         (gs,) = vjp_fn(cts)
         out_nd = [_wrap(g) for g in gs]
     if not retain_graph:
-        st.tape = []
+        # create_graph's recorded grad op must survive a cleared tape — it
+        # replays its prefix from its own closure, and the caller asked for
+        # differentiable gradients (otherwise a later backward through them
+        # fails with a misleading "no variables participate")
+        st.tape = [st.tape[-1]] if (create_graph and st.tape) else []
     return out_nd
 
 

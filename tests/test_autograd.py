@@ -209,3 +209,34 @@ def test_wgan_gp_style_gradient_penalty_trains():
         trainer.step(1)
         losses.append(float(penalty.asnumpy()))
     assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_grad_create_graph_survives_retain_false():
+    """create_graph=True + retain_graph=False: heads' graph is freed but the
+    recorded grad op survives, so the promised differentiable gradients work."""
+    x = nd.array(np.array([2.0, -1.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        (g,) = autograd.grad([y], [x], create_graph=True, retain_graph=False)
+        z = (g * g).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               36.0 * np.array([2.0, -1.0]) ** 3, rtol=1e-5)
+
+
+def test_grad_create_graph_extra_inputs_exclude_intermediates():
+    """The recorded grad op's traced inputs are variables + true leaves only
+    — tape-produced intermediates must not be pinned as dead inputs."""
+    from mxnet_tpu import autograd as ag
+
+    x = nd.array(np.array([1.0, 2.0], np.float32))
+    w = nd.array(np.array([3.0, 4.0], np.float32))  # a leaf "parameter"
+    with autograd.record():
+        t = x * w
+        for _ in range(10):
+            t = t + t * 0.5  # 20 taped intermediates
+        (g,) = autograd.grad([t], [x], create_graph=True)
+    entry = ag._st().tape[-1]
+    # inputs: x (variable) + w (leaf) only
+    assert len(entry.inputs) == 2, [id(i) for i in entry.inputs]
