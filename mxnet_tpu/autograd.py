@@ -152,7 +152,17 @@ def _collect_live_marked():
 
 
 def _replay(tape, heads, var_list):
-    """Build pure fn: marked var values -> head values, by tape replay."""
+    """Build pure fn: marked var values -> head values, by tape replay.
+
+    A differentiation variable that is ALSO a tape-produced intermediate
+    keeps its traced binding — its producer entry must not clobber it, or
+    gradients w.r.t. it silently vanish.  This gives leaf semantics (its
+    own upstream history is cut), matching the reference where attaching a
+    gradient to an intermediate detaches it (``python/mxnet/ndarray/
+    ndarray.py attach_grad`` → ``self.detach()``) — the WGAN-GP
+    interpolated-x̂ pattern.
+    """
+    var_ids = {id(v) for v in var_list}
 
     def f(var_vals):
         env = {id(v): val for v, val in zip(var_list, var_vals)}
@@ -163,7 +173,8 @@ def _replay(tape, heads, var_list):
             out = entry.fn(*args, **entry.attrs)
             outs = out if isinstance(out, tuple) else (out,)
             for nd_out, val in zip(entry.outputs, outs):
-                env[id(nd_out)] = val
+                if id(nd_out) not in var_ids:
+                    env[id(nd_out)] = val
         return [env.get(id(h), h._data) for h in heads]
 
     return f
@@ -291,12 +302,12 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
         cts = [jnp.ones_like(o) for o in outs] if hgs is None else list(hgs)
         (gs,) = vjp_fn(cts)
         out_nd = [_wrap(g) for g in gs]
-    if not retain_graph:
-        # create_graph's recorded grad op must survive a cleared tape — it
-        # replays its prefix from its own closure, and the caller asked for
-        # differentiable gradients (otherwise a later backward through them
-        # fails with a misleading "no variables participate")
-        st.tape = [st.tape[-1]] if (create_graph and st.tape) else []
+    # create_graph keeps the WHOLE graph even under an explicit
+    # retain_graph=False: later losses may mix the returned gradients with
+    # pre-grad intermediates (e.g. ``(y·g).sum()``), and replaying those
+    # from constant snapshots would train on silently wrong gradients
+    if not (retain_graph or create_graph):
+        st.tape = []
     return out_nd
 
 

@@ -240,3 +240,28 @@ def test_grad_create_graph_extra_inputs_exclude_intermediates():
     entry = ag._st().tape[-1]
     # inputs: x (variable) + w (leaf) only
     assert len(entry.inputs) == 2, [id(i) for i in entry.inputs]
+
+
+def test_grad_wrt_tape_produced_intermediate():
+    """grad w.r.t. an intermediate gives its partial derivative (leaf
+    semantics — the reference's attach_grad detaches history)."""
+    x = nd.array(np.array([2.0], np.float32))
+    with autograd.record():
+        t = x * 3.0
+        y = t * t
+        (g,) = autograd.grad([y], [t])
+    np.testing.assert_allclose(g.asnumpy(), 2 * 3 * 2.0 * np.ones(1), rtol=1e-6)
+
+
+def test_grad_create_graph_then_mixed_head_loss():
+    """After create_graph=True (+ retain_graph=False), a loss mixing the
+    returned gradient with pre-grad intermediates still differentiates
+    through BOTH paths: d/dx[y*g] for y=x^3, g=3x^2 is 18x^4 -> 15x^4... """
+    x = nd.array(np.array([2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        (g,) = autograd.grad([y], [x], create_graph=True, retain_graph=False)
+        loss = (y * g).sum()  # = 3x^5  ->  d/dx = 15x^4
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [15.0 * 2.0 ** 4], rtol=1e-5)
