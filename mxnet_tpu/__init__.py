@@ -67,7 +67,13 @@ def __getattr__(name):
         try:
             mod = importlib.import_module(lazy[name], __name__)
         except ImportError as e:
-            # keep hasattr()-style feature detection working
+            # a missing OR broken optional dependency (torch absent, torch's
+            # native extension failing to load, …) reads as "feature absent"
+            # for hasattr()-style probes; an import failure originating in
+            # one of our OWN submodules must surface loudly, not masquerade
+            # as an absent feature
+            if (getattr(e, "name", None) or "").split(".")[0] == __name__.split(".")[0]:
+                raise
             raise AttributeError(
                 "module %r has no attribute %r (%s)" % (__name__, name, e)
             ) from e

@@ -113,32 +113,45 @@ def stable_eager(fn):
     """
     import jax
 
-    def hashable(v):
-        # static args must be hashable: recursively tuple-ify sequences and
-        # numpy arrays (e.g. scales=np.array([...]) passed by rcnn configs)
-        if isinstance(v, np.ndarray):
-            return hashable(v.tolist())  # nested lists keep their structure
-        if isinstance(v, (list, tuple)):
-            return tuple(hashable(e) for e in v)
-        return v
-
     @functools.wraps(fn)
     def wrapper(*args, **attrs):
+        # attrs arrive already canonical (hashable nested tuples): every
+        # @stable_eager op sits under @register, whose wrapper applies
+        # _canon_attr on all invocation paths
         sig = (fn, tuple(sorted(k for k in attrs if k != "key")))
         jf = _STABLE_JIT_CACHE.get(sig)
         if jf is None:
             jf = jax.jit(fn, static_argnames=[k for k in attrs if k != "key"])
             _STABLE_JIT_CACHE[sig] = jf
-        attrs = {k: v if k == "key" else hashable(v) for k, v in attrs.items()}
         return jf(*args, **attrs)
 
     return wrapper
 
 
+def _canon_attr(v):
+    """Canonicalize a sequence attr to nested tuples (numpy arrays included).
+
+    Applied to EVERY op invocation path — eager, stable_eager-jitted, and
+    traced — so an op body always sees the same attr types regardless of
+    route (stable_eager needs hashable statics; giving only that path
+    tuple-ified values would let list/ndarray-sensitive ops silently diverge
+    between eager and jitted calls)."""
+    if isinstance(v, np.ndarray):
+        return _canon_attr(v.tolist())
+    if isinstance(v, (list, tuple)):
+        return tuple(_canon_attr(e) for e in v)
+    return v
+
+
 def register(name, alias=(), hint=None, aux=(), inputs_fn=None, infer_params=None, aux_update=None, mutates=()):
     """Decorator registering a pure jax function as a framework operator."""
 
-    def _reg(fn):
+    def _reg(raw_fn):
+        @functools.wraps(raw_fn)
+        def fn(*args, **attrs):
+            return raw_fn(*args, **{
+                k: v if k == "key" else _canon_attr(v) for k, v in attrs.items()})
+
         opdef = OpDef(
             name,
             fn,

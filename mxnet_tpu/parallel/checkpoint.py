@@ -78,6 +78,10 @@ def async_save(path, tree, force=True):
     One long-lived AsyncCheckpointer is shared by all calls (repeated saves
     reuse its worker instead of leaking one thread pool per call; a second
     save first waits for the previous commit, orbax's usual pipelining).
+    Concurrent ``async_save`` callers serialize on the module lock for the
+    whole enqueue — intentional: orbax's ``save`` blocks until the previous
+    commit finishes anyway, and holding the lock keeps a concurrent
+    :func:`wait_all` from closing the shared checkpointer mid-save.
     Returns an object with ``wait_until_finished()``; :func:`wait_all`
     drains every pending save (call before exit — mirrors the reference's
     ``Engine::WaitForAll`` before shutdown).

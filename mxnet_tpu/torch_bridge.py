@@ -246,6 +246,13 @@ class TorchModule:
     (initialized from the torch module's state, updatable by any Trainer /
     optimizer / KVStore path), execution is torch on host via the CustomOp
     bridge, gradients flow through `autograd.record()` like any op.
+
+    Stateful-buffer contract: a training forward keeps torch buffers
+    (BatchNorm running stats) PURE — the step's one buffer update is applied
+    during the backward recompute.  A training forward whose output never
+    receives a backward pass therefore skips that step's stat update (the
+    reference plugin, which mutated buffers in forward, would have applied
+    it).  Inference forwards never touch buffers in either design.
     """
 
     def __init__(self, torch_module, num_data=1, input_dtypes=None,

@@ -86,3 +86,114 @@ def test_launcher_cli_validation():
     )
     assert res.returncode != 0
     assert "no command given" in res.stderr
+
+
+WORKER_NIGHTLY = textwrap.dedent("""
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import dist
+    from mxnet_tpu import nd, autograd
+
+    dist.init()
+    r, n = dist.rank(), dist.size()
+
+    # --- scenario 1: rowsparse pulls (reference dist_sync_kvstore.py:232
+    # test_sync_push_pull rsp + row_sparse_pull) -------------------------
+    kv = mx.kv.create("dist_sync")
+    ROWS, COLS = 10, 3
+    kv.init("rsp", nd.zeros((ROWS, COLS)))
+    grad = np.zeros((ROWS, COLS), np.float32)
+    grad[r % ROWS] = r + 1          # each rank touches its own row
+    grad[(r + 1) % ROWS] += 0.5     # and overlaps the neighbour's
+    kv.push("rsp", nd.array(grad))
+    expected = np.zeros((ROWS, COLS), np.float32)
+    for q in range(n):
+        expected[q % ROWS] += q + 1
+        expected[(q + 1) % ROWS] += 0.5
+    # subset pull incl. a duplicate row id (gather semantics)
+    rid = nd.array(np.array([1, 1, 3], np.float32))
+    out = nd.zeros((3, COLS))
+    kv.row_sparse_pull("rsp", out=out, row_ids=rid)
+    assert np.allclose(out.asnumpy(), expected[[1, 1, 3]]), out.asnumpy()
+    # full-shape pull with permuted row ids keeps scatter semantics
+    perm = np.random.RandomState(0).permutation(ROWS).astype(np.float32)
+    outf = nd.zeros((ROWS, COLS))
+    kv.row_sparse_pull("rsp", out=outf, row_ids=nd.array(perm))
+    assert np.allclose(outf.asnumpy(), expected), outf.asnumpy()
+
+    # --- scenario 2: 2-bit compression with error feedback
+    # (reference dist_sync_kvstore.py test_sync_2bit_compression) --------
+    kv2 = mx.kv.create("dist_sync")
+    kv2.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv2.init("c", nd.zeros((4,)))
+    # push 0.3: below threshold -> quantized 0 everywhere, residual 0.3
+    kv2.push("c", nd.array(np.full(4, 0.3, np.float32)))
+    o = nd.zeros((4,))
+    kv2.pull("c", out=o)
+    assert np.allclose(o.asnumpy(), 0.0), o.asnumpy()
+    # push 0.3 again: residual 0.6 >= 0.5 -> +0.5 per worker, residual 0.1
+    kv2.push("c", nd.array(np.full(4, 0.3, np.float32)))
+    kv2.pull("c", out=o)
+    assert np.allclose(o.asnumpy(), 0.5 * n), o.asnumpy()
+
+    # --- scenario 3: multiprecision (reference test_sync_push_pull fp16 /
+    # mp sgd, optimizer_op.cc mp_sgd_mom_update) -------------------------
+    kv3 = mx.kv.create("dist_sync")
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, multi_precision=True,
+                              rescale_grad=1.0 / n)
+    kv3.set_optimizer(opt)
+    w16 = nd.array(np.ones(4, np.float16))
+    kv3.init("mp", w16)
+    kv3.push("mp", nd.array(np.full(4, float(r + 1), np.float16)))
+    om = nd.zeros((4,), dtype="float16")
+    kv3.pull("mp", out=om)
+    mean_grad = sum(range(1, n + 1)) / n
+    exp = np.float16(1.0 - 0.1 * mean_grad)
+    assert np.allclose(om.asnumpy(), exp, atol=1e-3), (om.asnumpy(), exp)
+
+    # --- scenario 4: Gluon Trainer over dist_sync (reference
+    # dist_sync_kvstore.py:353 test_gluon_trainer_type) ------------------
+    mx.random.seed(7)  # identical init on every rank
+    netd = mx.gluon.nn.Dense(2)
+    netd.initialize()
+    xb = nd.array(np.ones((2, 3), np.float32) * (r + 1))  # rank-dependent data
+    netd(xb)
+    tr = mx.gluon.Trainer(netd.collect_params(), "sgd",
+                          {"learning_rate": 0.05}, kvstore="dist_sync")
+    with autograd.record():
+        loss = (netd(xb) ** 2).sum()
+    loss.backward()
+    tr.step(2)
+    vals = np.concatenate([p.data().asnumpy().ravel()
+                           for p in netd.collect_params().values()])
+    kv.barrier()
+    print("RANK%d_NIGHTLY %s" % (r, np.round(vals, 5).tolist()), flush=True)
+    dist.shutdown()
+""")
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="local fake cluster uses fork/Gloo")
+def test_dist_sync_kvstore_nightly_seven_processes(tmp_path):
+    """The reference nightly tier's coverage (tests/nightly/
+    dist_sync_kvstore.py, launched -n 7 --launcher local): rowsparse pulls,
+    2-bit compression, multiprecision, and a Gluon Trainer over dist_sync —
+    all on a 7-process fake cluster."""
+    worker = tmp_path / "worker_nightly.py"
+    worker.write_text(WORKER_NIGHTLY)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    for attempt in range(2):
+        res = subprocess.run(
+            [sys.executable, LAUNCH, "-n", "7", "--launcher", "local",
+             sys.executable, str(worker)],
+            env=env, capture_output=True, text=True, timeout=560,
+        )
+        if res.returncode == 0:
+            break
+    assert res.returncode == 0, res.stdout + res.stderr
+    lines = [l for l in res.stdout.splitlines() if "_NIGHTLY" in l]
+    assert len(lines) == 7, res.stdout + res.stderr
+    # trainer left identical parameters on every rank
+    vals = {l.split("_NIGHTLY ")[1] for l in lines}
+    assert len(vals) == 1, vals
