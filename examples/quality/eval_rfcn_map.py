@@ -1,0 +1,149 @@
+"""Detection quality: mAP on deterministic VOC-format synthetic data.
+
+Real VOC/COCO cannot be fetched (no egress; BASELINE.md bars —
+Faster-RCNN VGG16 VOC07 mAP 70.23, ``example/rcnn/README.md:38-42``), so
+this measures the strongest available proxy: the full jit-fused Deformable
+R-FCN training recipe on a deterministic synthetic VOC-format dataset
+(bright rectangles, known ground truth), evaluated with the repo's own
+``VOCMApMetric`` over held-out images.  A rising, stable mAP proves the
+whole pipeline — RPN, proposals, target assignment, deformable PS-ROI
+scoring, box decoding, per-class NMS — learns detection end-to-end.
+
+Run (chip):  python examples/quality/eval_rfcn_map.py --resnet101
+Run (CPU smoke): ./dev.sh python examples/quality/eval_rfcn_map.py --steps 30
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_HERE, "..", ".."))
+sys.path.insert(0, os.path.join(_HERE, "..", "deformable_rfcn"))
+sys.path.insert(0, os.path.join(_HERE, "..", "ssd"))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.functional import functionalize
+from metric import VOCMApMetric
+from train_fused import build_net, make_rfcn_train_step, synthetic_coco
+
+
+def decode_detections(rois, cls_prob, bbox_pred, num_classes, im_shape,
+                      score_thresh=0.05, nms_thresh=0.3, max_det=100):
+    """rois (R,5) + class-agnostic deltas → (1, K, 6) [cls, score, x1..y2].
+
+    Inverse of the training targets' bbox_transform (+1 convention,
+    reference rcnn/processing/bbox_transform.py bbox_pred), then per-class
+    NMS via the registry box_nms op."""
+    from mxnet_tpu.ops.detection import box_nms
+
+    import jax.numpy as jnp
+
+    boxes = rois[:, 1:5]
+    w = boxes[:, 2] - boxes[:, 0] + 1.0
+    h = boxes[:, 3] - boxes[:, 1] + 1.0
+    cx = boxes[:, 0] + 0.5 * (w - 1.0)
+    cy = boxes[:, 1] + 0.5 * (h - 1.0)
+    d = bbox_pred[:, 4:8]  # fg deltas (class-agnostic head)
+    pcx = d[:, 0] * w + cx
+    pcy = d[:, 1] * h + cy
+    pw = np.exp(d[:, 2]) * w
+    ph = np.exp(d[:, 3]) * h
+    x1 = np.clip(pcx - 0.5 * (pw - 1.0), 0, im_shape[1] - 1)
+    y1 = np.clip(pcy - 0.5 * (ph - 1.0), 0, im_shape[0] - 1)
+    x2 = np.clip(pcx + 0.5 * (pw - 1.0), 0, im_shape[1] - 1)
+    y2 = np.clip(pcy + 0.5 * (ph - 1.0), 0, im_shape[0] - 1)
+
+    rows = []
+    for c in range(num_classes):
+        sc = cls_prob[:, c + 1]
+        keep = sc >= score_thresh
+        if not keep.any():
+            continue
+        rows.append(np.stack([
+            np.full(keep.sum(), c, np.float32), sc[keep],
+            x1[keep], y1[keep], x2[keep], y2[keep]], axis=1))
+    if not rows:
+        return np.full((1, 1, 6), -1, np.float32)
+    dat = np.concatenate(rows, axis=0)[None]  # (1, N, 6)
+    # decode NMS on the host CPU backend: per-image detection counts vary,
+    # and recompiling box_nms per shape over the TPU tunnel is wasteful
+    import jax
+
+    with jax.default_device(jax.devices("cpu")[0]):
+        out = np.asarray(box_nms(
+            jnp.asarray(dat), overlap_thresh=nms_thresh, coord_start=2,
+            score_index=1, id_index=0, force_suppress=False))
+    out = out[0]
+    out = out[out[:, 0] >= 0][:max_det]
+    return out[None] if out.size else np.full((1, 1, 6), -1, np.float32)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--resnet101", action="store_true")
+    p.add_argument("--steps", type=int, default=None)
+    p.add_argument("--eval-images", type=int, default=32)
+    p.add_argument("--classes", type=int, default=3)
+    p.add_argument("--lr", type=float, default=2e-3)
+    p.add_argument("--live-bn", action="store_true",
+                   help="train BatchNorm statistics (from-scratch runs; the "
+                        "frozen-BN recipe assumes pretrained weights)")
+    args = p.parse_args()
+
+    import jax
+
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    steps = args.steps or (800 if args.resnet101 else 30)
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    net, shape, classes = build_net(args.resnet101, classes=args.classes,
+                                    frozen_bn=not args.live_bn)
+    step, state = make_rfcn_train_step(
+        net, 1, learning_rate=args.lr, momentum=0.9,
+        compute_dtype="bfloat16" if (on_tpu and args.resnet101) else None)
+    jstep = jax.jit(step, donate_argnums=(0,))
+    key = jax.random.PRNGKey(0)
+
+    for s in range(steps):
+        data, im_info, gt = synthetic_coco(rng, 1, shape, classes, net.max_gts)
+        state, loss, parts = jstep(state, data, im_info, gt,
+                                   jax.random.fold_in(key, s))
+        if s % max(1, steps // 8) == 0:
+            print("step %4d  loss %.4f" % (s, float(loss)), flush=True)
+
+    # --- evaluation: inference forward with the TRAINED parameters -------
+    apply, names, vals, aux_names = functionalize(net, train=False)
+    learn_idx = [i for i, n in enumerate(names) if n not in set(aux_names)]
+    aux_idx = [i for i, n in enumerate(names) if n in set(aux_names)]
+    learn, _mom, aux = state
+    merged = [None] * len(names)
+    for i, v in zip(learn_idx, learn):
+        merged[i] = v
+    for i, v in zip(aux_idx, aux):
+        merged[i] = v
+
+    infer = jax.jit(lambda m, x, i: apply(m, (x, i), jax.random.PRNGKey(0))[0])
+    metric = VOCMApMetric(iou_thresh=0.5)
+    eval_rng = np.random.RandomState(12345)  # held-out stream
+    for _ in range(args.eval_images):
+        data, im_info, gt = synthetic_coco(eval_rng, 1, shape, classes,
+                                           net.max_gts)
+        rois, prob, deltas = infer(merged, data, im_info)
+        dets = decode_detections(
+            np.asarray(rois).astype(np.float32),
+            np.asarray(prob).astype(np.float32),
+            np.asarray(deltas).astype(np.float32), classes, shape)
+        metric.update(dets, gt[:, :, :5])
+    name, value = metric.get()
+    print("FINAL rfcn %s synthetic-VOC %s = %.4f  (steps=%d, classes=%d, "
+          "eval n=%d)" % ("resnet101" if args.resnet101 else "tiny",
+                          name, value, steps, classes, args.eval_images))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""REAL-data accuracy: handwritten digits (sklearn.datasets.load_digits).
+
+The environment has no network egress, so ImageNet/CIFAR/VOC can't be
+fetched (BASELINE.md bars).  ``load_digits`` ships real 8×8 handwritten
+digit images (1,797 samples, 10 classes) inside scikit-learn — the one
+genuine real-image dataset available — so this run gives a measured,
+non-synthetic accuracy point: a LeNet-style CNN (reference
+``example/image-classification/symbols/lenet.py`` family) trained with the
+framework's gluon path to a held-out test accuracy.
+
+Run: ./dev.sh python examples/quality/train_digits.py  (CPU, ~1 min)
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def main(epochs=40, batch=64, lr=0.1, seed=0):
+    from sklearn.datasets import load_digits
+    from sklearn.model_selection import train_test_split
+
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    X, y = load_digits(return_X_y=True)
+    X = (X.astype(np.float32) / 16.0).reshape(-1, 1, 8, 8)
+    y = y.astype(np.float32)
+    Xtr, Xte, ytr, yte = train_test_split(
+        X, y, test_size=0.25, random_state=seed, stratify=y)
+
+    net = mx.gluon.nn.HybridSequential()
+    net.add(
+        mx.gluon.nn.Conv2D(16, 3, padding=1, activation="relu"),
+        mx.gluon.nn.MaxPool2D(2, 2),
+        mx.gluon.nn.Conv2D(32, 3, padding=1, activation="relu"),
+        mx.gluon.nn.MaxPool2D(2, 2),
+        mx.gluon.nn.Flatten(),
+        mx.gluon.nn.Dense(64, activation="relu"),
+        mx.gluon.nn.Dense(10),
+    )
+    net.initialize(mx.init.Xavier())
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": lr, "momentum": 0.9, "wd": 1e-4})
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+
+    n = Xtr.shape[0]
+    for epoch in range(epochs):
+        perm = np.random.permutation(n)
+        tot = 0.0
+        for i in range(0, n - batch + 1, batch):
+            sel = perm[i:i + batch]
+            with autograd.record():
+                out = net(nd.array(Xtr[sel]))
+                loss = loss_fn(out, nd.array(ytr[sel]))
+            loss.backward()
+            trainer.step(batch)
+            tot += float(loss.mean().asnumpy())
+        if epoch % 10 == 9:
+            acc = (net(nd.array(Xte)).asnumpy().argmax(1) == yte).mean()
+            print("epoch %2d  loss %.4f  test acc %.4f"
+                  % (epoch, tot / (n // batch), acc), flush=True)
+
+    train_acc = (net(nd.array(Xtr)).asnumpy().argmax(1) == ytr).mean()
+    test_acc = (net(nd.array(Xte)).asnumpy().argmax(1) == yte).mean()
+    print("FINAL digits: train acc %.4f  TEST acc %.4f  (n_test=%d)"
+          % (train_acc, test_acc, len(yte)))
+    return test_acc
+
+
+if __name__ == "__main__":
+    main()

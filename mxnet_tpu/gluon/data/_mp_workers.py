@@ -1,0 +1,52 @@
+"""Spawned DataLoader worker functions — deliberately jax-free.
+
+This module imports ONLY numpy so that unpickling the worker functions in
+a spawn child never pulls in the mxnet_tpu/jax stack (workers run
+``dataset[i]`` + numpy conversion and nothing else; the design rule is
+that workers never touch jax).  Keep it free of framework imports.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+_MP_DATASET = None  # set in each spawned worker by _mp_init
+
+
+def _mp_init(dataset):
+    global _MP_DATASET
+    _MP_DATASET = dataset
+
+
+def _looks_like_jax_ndarray(s):
+    # duck-typed: the framework NDArray (not importable here) carries _data
+    return hasattr(s, "asnumpy") and hasattr(s, "_data")
+
+
+def _np_sample(s):
+    """Convert one sample's leaves to numpy; jax-backed NDArrays are
+    forbidden in workers (fork/spawn-vs-XLA hazard — the design rule is
+    that workers never touch jax)."""
+    if isinstance(s, tuple):
+        return tuple(_np_sample(x) for x in s)
+    if _looks_like_jax_ndarray(s):
+        raise RuntimeError(
+            "DataLoader(thread_pool=False): dataset __getitem__ returned a "
+            "jax-backed NDArray inside a worker process. Return numpy from "
+            "the dataset (or use thread_pool=True).")
+    return np.asarray(s)
+
+
+def _np_batchify(samples):
+    s0 = samples[0]
+    if isinstance(s0, tuple):
+        return tuple(_np_batchify(list(col)) for col in zip(*samples))
+    return np.asarray(samples)
+
+
+def _mp_worker(indices):
+    return _np_batchify([_np_sample(_MP_DATASET[i]) for i in indices])
+
+
+def _mp_worker_samples(indices):
+    # custom-batchify mode: no worker-side stacking (ragged samples ok)
+    return [_np_sample(_MP_DATASET[i]) for i in indices]

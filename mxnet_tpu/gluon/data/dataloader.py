@@ -1,11 +1,23 @@
 """DataLoader — reference ``python/mxnet/gluon/data/dataloader.py:239``.
 
 The reference forks worker processes and rebuilds NDArrays over POSIX shared
-memory (dataloader.py:26-97).  On TPU the input pipeline is host-side numpy
-until the final device put, so workers here are *threads*: decode/augment in
-PIL/numpy release the GIL, there is no CUDA context to protect, and skipping
-process forking avoids the fork-vs-XLA-client hazard entirely (the reference
-itself has engine fork handlers for this, src/initialize.cc:31-64).
+memory (dataloader.py:26-97).  Both worker models exist here:
+
+* ``thread_pool=True`` (default): decode/augment in PIL/numpy release the
+  GIL, there is no CUDA context to protect, and skipping process forking
+  avoids the fork-vs-XLA-client hazard (the reference itself has engine
+  fork handlers for this, src/initialize.cc:31-64).
+* ``thread_pool=False``: worker PROCESSES, for pure-Python augmentation
+  that holds the GIL (the reference's default model).  Workers use the
+  SPAWN start method — forking a parent with a live XLA client inherits
+  locks/threads and deadlocks nondeterministically (observed; the
+  reference guards the same hazard with engine fork handlers,
+  src/initialize.cc:31-64) — so the dataset must be picklable and workers
+  pay one interpreter start each.  Workers run only ``dataset[i]`` +
+  numpy conversion and never touch jax; batches cross back as pickled
+  numpy and become NDArrays in the parent.  The reference's shared-memory
+  rebuild is a deliberate non-goal: the final hop is a host→device
+  transfer either way, so zero-copy into the parent buys nothing on TPU.
 """
 from __future__ import annotations
 
@@ -46,6 +58,7 @@ class DataLoader:
         num_workers=0,
         pin_memory=False,
         prefetch=None,
+        thread_pool=True,
     ):
         self._dataset = dataset
         if batch_sampler is None:
@@ -63,6 +76,7 @@ class DataLoader:
         self._batch_sampler = batch_sampler
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._num_workers = max(0, num_workers)
+        self._thread_pool = thread_pool
         self._prefetch = max(0, prefetch if prefetch is not None else 2 * self._num_workers)
 
     def __len__(self):
@@ -71,10 +85,53 @@ class DataLoader:
     def _load_batch(self, indices):
         return self._batchify_fn([self._dataset[i] for i in indices])
 
+
+    def _iter_processes(self):
+        """Spawned worker processes (reference's process model,
+        dataloader.py:239; start method rationale in the module docstring).
+
+        The dataset is PICKLED to each worker once (spawn); workers run
+        only ``dataset[i]`` + numpy conversion.  With the default batchify,
+        workers also stack the batch; a custom ``batchify_fn`` receives the
+        raw (numpy) samples in the parent — the same per-sample structure
+        the thread/sequential paths pass, so one batchify works in every
+        worker mode (process-mode datasets must return numpy anyway).
+        """
+        import multiprocessing as mp
+
+        from ._mp_workers import _mp_init, _mp_worker, _mp_worker_samples
+
+        ctx = mp.get_context("spawn")
+        custom = self._batchify_fn is not default_batchify_fn
+        worker = _mp_worker_samples if custom else _mp_worker
+        with ctx.Pool(self._num_workers, initializer=_mp_init,
+                      initargs=(self._dataset,)) as pool:
+            inflight = []
+            it = iter(self._batch_sampler)
+            try:
+                for _ in range(max(1, self._prefetch)):
+                    inflight.append(pool.apply_async(worker, (next(it),)))
+            except StopIteration:
+                pass
+            while inflight:
+                res = inflight.pop(0)
+                try:
+                    inflight.append(pool.apply_async(worker, (next(it),)))
+                except StopIteration:
+                    pass
+                batch = res.get()
+                if custom:
+                    yield self._batchify_fn(batch)
+                else:
+                    yield _np_to_nd(batch)
+
     def __iter__(self):
         if self._num_workers == 0:
             for indices in self._batch_sampler:
                 yield self._load_batch(indices)
+            return
+        if not self._thread_pool:
+            yield from self._iter_processes()
             return
         with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
             futures = []
@@ -92,3 +149,10 @@ class DataLoader:
                 except StopIteration:
                     pass
                 yield f.result()
+
+
+def _np_to_nd(batch):
+    """Numpy batch (possibly nested tuples) -> NDArray structure."""
+    if isinstance(batch, tuple):
+        return [_np_to_nd(b) for b in batch]
+    return nd_array(batch)

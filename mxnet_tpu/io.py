@@ -27,7 +27,7 @@ __all__ = [
     "ResizeIter",
     "PrefetchingIter",
     "MXDataIter",
-    "CSVIter",
+    "CSVIter", "MNISTIter", "LibSVMIter",
     "ImageRecordIter",
 ]
 
@@ -373,6 +373,185 @@ class CSVIter(NDArrayIter):
             label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32)
             label = label.reshape((-1,) + tuple(label_shape)) if label_shape != (1,) else label
         super().__init__(data, label, batch_size=batch_size, **kwargs)
+
+
+class MNISTIter(DataIter):
+    """MNIST idx-ubyte iterator (reference ``src/io/iter_mnist.cc:80``).
+
+    Reads the classic idx format (images magic 2051, labels magic 2049)
+    from ``image``/``label`` paths, normalizes pixels to [0, 1), optionally
+    flattens, shuffles with ``seed``, and partitions the stream
+    (``num_parts``/``part_index``) exactly like the reference's distributed
+    reading (``iter_mnist.cc`` num_parts fields).
+    """
+
+    def __init__(self, image, label, batch_size=1, shuffle=False, flat=False,
+                 seed=0, silent=True, num_parts=1, part_index=0, **kwargs):
+        super().__init__(batch_size)
+        import struct
+
+        with open(image, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            if magic != 2051:
+                raise MXNetError("%s is not an MNIST image file (magic %d)" % (image, magic))
+            img = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+        img = img.reshape(n, rows, cols).astype(np.float32) / 256.0
+        with open(label, "rb") as f:
+            magic, nl = struct.unpack(">II", f.read(8))
+            if magic != 2049:
+                raise MXNetError("%s is not an MNIST label file (magic %d)" % (label, magic))
+            lab = np.frombuffer(f.read(nl), dtype=np.uint8).astype(np.float32)
+        if n != nl:
+            raise MXNetError("image/label count mismatch: %d vs %d" % (n, nl))
+        if shuffle:
+            order = np.random.RandomState(seed).permutation(n)
+            img, lab = img[order], lab[order]
+        # partition AFTER the (seeded, rank-identical) shuffle, as the
+        # reference does, so parts stay disjoint across workers
+        part = n // num_parts
+        sl = slice(part_index * part, (part_index + 1) * part)
+        img, lab = img[sl], lab[sl]
+        data = img.reshape(len(img), rows * cols) if flat else img[:, None]
+        self._inner = NDArrayIter(data, lab, batch_size=batch_size)
+        self.provide_data = self._inner.provide_data
+        self.provide_label = self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+    def getdata(self):
+        return self._inner.getdata()
+
+    def getlabel(self):
+        return self._inner.getlabel()
+
+    def getpad(self):
+        return self._inner.getpad()
+
+    def next(self):
+        return self._inner.next()
+
+
+class LibSVMIter(DataIter):
+    """LibSVM text-format iterator producing CSR batches (reference
+    ``src/io/iter_libsvm.cc`` + the sparse prefetcher stack
+    ``iter_sparse_prefetcher.h``).
+
+    Each line: ``<label> <idx>:<val> <idx>:<val> ...`` (0-based indices, as
+    the reference's ``indexing_mode``\'s default).  ``getdata()`` returns a
+    ``CSRNDArray`` slice; labels may themselves be a libsvm file
+    (multi-output) or the leading column.
+    """
+
+    def __init__(self, data_libsvm, data_shape, batch_size=1,
+                 label_libsvm=None, label_shape=None, round_batch=True,
+                 **kwargs):
+        super().__init__(batch_size)
+        self._shape = tuple(data_shape)
+        labels, indptr, indices, values = [], [0], [], []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                for tok in parts[1:]:
+                    i, v = tok.split(":")
+                    indices.append(int(i))
+                    values.append(float(v))
+                indptr.append(len(indices))
+        self._indptr = np.asarray(indptr, np.int64)
+        self._indices = np.asarray(indices, np.int64)
+        self._values = np.asarray(values, np.float32)
+        self._labels = np.asarray(labels, np.float32)
+        if label_libsvm is not None:
+            # label file is itself libsvm-format sparse rows: idx:val tokens
+            # land at their indices in a dense (label_shape,) row (reference
+            # iter_libsvm.cc label_shape field)
+            raw = []
+            width = 0
+            with open(label_libsvm) as f:
+                for line in f:
+                    parts = line.split()
+                    if not parts:
+                        continue
+                    row = []
+                    for tok in parts:
+                        if ":" in tok:
+                            i, v = tok.split(":")
+                            row.append((int(i), float(v)))
+                        else:
+                            row.append((len(row), float(tok)))
+                    raw.append(row)
+                    width = max(width, 1 + max(i for i, _ in row))
+            shape = tuple(label_shape) if label_shape else (width,)
+            lab = np.zeros((len(raw),) + shape, np.float32)
+            for j, row in enumerate(raw):
+                for i, v in row:
+                    lab[j, i] = v
+            if len(lab) != len(labels):
+                raise MXNetError(
+                    "label_libsvm has %d rows but data_libsvm has %d"
+                    % (len(lab), len(labels)))
+            self._labels = lab
+        self._n = len(self._labels)
+        self._round_batch = round_batch
+        self._cursor = 0
+        self.provide_data = [DataDesc("data", (batch_size,) + self._shape)]
+        self.provide_label = [DataDesc("softmax_label",
+                                       (batch_size,) + (np.shape(self._labels)[1:] or ()))]
+
+    def reset(self):
+        self._cursor = 0
+
+    def _csr_rows(self, start, stop):
+        from .ndarray.sparse import csr_matrix
+
+        rows = []
+        for r in range(start, stop):
+            r = r % self._n  # round_batch wraps (reference batch padding)
+            rows.append((self._indptr[r], self._indptr[r + 1]))
+        indptr = np.zeros(len(rows) + 1, np.int64)
+        idx, val = [], []
+        for j, (a, b) in enumerate(rows):
+            idx.append(self._indices[a:b])
+            val.append(self._values[a:b])
+            indptr[j + 1] = indptr[j] + (b - a)
+        idx = np.concatenate(idx) if idx else np.zeros(0, np.int64)
+        val = np.concatenate(val) if val else np.zeros(0, np.float32)
+        return csr_matrix((val, idx, indptr),
+                          shape=(len(rows),) + self._shape)
+
+    def iter_next(self):
+        if self._cursor >= self._n:
+            return False
+        stop = self._cursor + self.batch_size
+        if stop > self._n and not self._round_batch:
+            # reference batch-loader semantics: round_batch=False discards
+            # the incomplete tail instead of wrapping
+            self._cursor = stop
+            return False
+        self._start = self._cursor
+        self._cursor = stop
+        return True
+
+    def getdata(self):
+        return [self._csr_rows(self._start, self._start + self.batch_size)]
+
+    def getlabel(self):
+        from . import ndarray as _nd
+
+        lab = np.stack([self._labels[r % self._n]
+                        for r in range(self._start, self._start + self.batch_size)])
+        return [_nd.array(lab)]
+
+    def getpad(self):
+        # round_batch=True wraps to fill the batch and REPORTS the wrapped
+        # row count as pad (DataBatch.pad contract: consumers drop them)
+        return max(0, self._start + self.batch_size - self._n)
 
 
 def MXDataIter(*args, **kwargs):
