@@ -125,7 +125,9 @@ def make_rfcn_train_step(net, batch, learning_rate=5e-4, momentum=0.9,
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
-    def step(state, data, im_info, gt, key):
+    def step(state, data, im_info, gt, key, lr=learning_rate):
+        # ``lr`` defaults to the baked constant; schedules pass it per step
+        # as a traced scalar — decays then cost zero recompiles
         learn, mom, aux = state
         (loss, (new_aux, parts)), grads = grad_fn(learn, aux, data, im_info, gt, key)
         if momentum:
@@ -133,7 +135,7 @@ def make_rfcn_train_step(net, batch, learning_rate=5e-4, momentum=0.9,
             upd = mom
         else:
             upd = grads
-        learn = [p - learning_rate * g for p, g in zip(learn, upd)]
+        learn = [p - lr * g for p, g in zip(learn, upd)]
         return (learn, mom, new_aux), loss, parts
 
     learn_vals = [vals[i] for i in learn_idx]
@@ -143,13 +145,14 @@ def make_rfcn_train_step(net, batch, learning_rate=5e-4, momentum=0.9,
     return step, (learn_vals, mom_vals, aux_vals)
 
 
-def build_net(resnet101, image_shape=None, classes=None):
+def build_net(resnet101, image_shape=None, classes=None, frozen_bn=True):
     """→ (net, image_shape, classes): the full ResNet-101 north-star model,
     or the tiny-trunk CPU configuration with the same graph."""
     if resnet101:
         shape = tuple(image_shape or (608, 1024))
         classes = classes or 80
-        net = rfcn_resnet101(classes=classes, image_shape=shape, max_gts=16)
+        net = rfcn_resnet101(classes=classes, image_shape=shape, max_gts=16,
+                             frozen_bn=frozen_bn)
     else:
         shape = tuple(image_shape or (64, 96))
         classes = classes or 3
@@ -157,7 +160,8 @@ def build_net(resnet101, image_shape=None, classes=None):
         net = DeformableRFCN(
             classes=classes, image_shape=shape, units=(1, 1, 1, 1),
             scales=(1, 2), ratios=(0.5, 1, 2), rpn_pre_nms=200,
-            rpn_post_nms=32, batch_rois=16, rpn_batch=32, max_gts=8)
+            rpn_post_nms=32, batch_rois=16, rpn_batch=32, max_gts=8,
+            frozen_bn=frozen_bn)
     net.initialize()
     net.init_params()  # tiny dummy pass; H/W-independent param shapes
     return net, shape, classes

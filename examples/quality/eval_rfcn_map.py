@@ -109,10 +109,18 @@ def main():
     jstep = jax.jit(step, donate_argnums=(0,))
     key = jax.random.PRNGKey(0)
 
+    # staged lr (the recipe's step decays): lr is a TRACED step argument,
+    # so decays cost zero recompiles
+    decay_points = {int(steps * 0.6), int(steps * 0.85)}
+    lr = args.lr
     for s in range(steps):
+        if s in decay_points:
+            lr *= 0.1
+            print("lr -> %g at step %d" % (lr, s), flush=True)
         data, im_info, gt = synthetic_coco(rng, 1, shape, classes, net.max_gts)
         state, loss, parts = jstep(state, data, im_info, gt,
-                                   jax.random.fold_in(key, s))
+                                   jax.random.fold_in(key, s),
+                                   np.float32(lr))
         if s % max(1, steps // 8) == 0:
             print("step %4d  loss %.4f" % (s, float(loss)), flush=True)
 

@@ -72,10 +72,16 @@ class DeformableConv2D(HybridBlock):
         return F.contrib.DeformableConvolution(x, off, weight, bias, **self._kwargs)
 
 
+_FROZEN_BN = [True]  # build-time switch, see DeformableRFCN(frozen_bn=...)
+
+
 def _bn(**kw):
     # detection-recipe BatchNorm: frozen statistics (use_global_stats), the
-    # reference Deformable-ConvNets training configuration
-    return nn.BatchNorm(use_global_stats=True, **kw)
+    # reference Deformable-ConvNets configuration — correct when fine-tuning
+    # from pretrained weights.  From-scratch training (no pretrained weights
+    # exist in this environment) needs LIVE statistics, so the model exposes
+    # ``frozen_bn=False``.
+    return nn.BatchNorm(use_global_stats=_FROZEN_BN[0], **kw)
 
 
 class _Bottleneck(HybridBlock):
@@ -160,8 +166,19 @@ class DeformableRFCN(HybridBlock):
                  scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
                  rpn_pre_nms=6000, rpn_post_nms=300, rpn_min_size=0,
                  batch_rois=128, fg_fraction=0.25, rpn_batch=256,
-                 max_gts=100, **kwargs):
+                 max_gts=100, frozen_bn=True, **kwargs):
         super().__init__(**kwargs)
+        _FROZEN_BN[0] = bool(frozen_bn)  # consumed by _bn during build below
+        try:
+            self._build(classes, image_shape, units, pooled_size, scales,
+                        ratios, rpn_pre_nms, rpn_post_nms, rpn_min_size,
+                        batch_rois, fg_fraction, rpn_batch, max_gts)
+        finally:
+            _FROZEN_BN[0] = True  # restore the module default for later builds
+
+    def _build(self, classes, image_shape, units, pooled_size, scales,
+               ratios, rpn_pre_nms, rpn_post_nms, rpn_min_size, batch_rois,
+               fg_fraction, rpn_batch, max_gts):
         self.classes = classes
         self.k = int(pooled_size)
         self.stride = 16
