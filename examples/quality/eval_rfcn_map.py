@@ -92,6 +92,9 @@ def main():
     p.add_argument("--live-bn", action="store_true",
                    help="train BatchNorm statistics (from-scratch runs; the "
                         "frozen-BN recipe assumes pretrained weights)")
+    p.add_argument("--flat-lr", action="store_true",
+                   help="disable the 60%%/85%% step decay (reproduces the "
+                        "flat-lr rows in QUALITY.md)")
     args = p.parse_args()
 
     import jax
@@ -111,7 +114,7 @@ def main():
 
     # staged lr (the recipe's step decays): lr is a TRACED step argument,
     # so decays cost zero recompiles
-    decay_points = {int(steps * 0.6), int(steps * 0.85)}
+    decay_points = set() if args.flat_lr else {int(steps * 0.6), int(steps * 0.85)}
     lr = args.lr
     for s in range(steps):
         if s in decay_points:

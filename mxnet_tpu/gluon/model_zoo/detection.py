@@ -72,16 +72,13 @@ class DeformableConv2D(HybridBlock):
         return F.contrib.DeformableConvolution(x, off, weight, bias, **self._kwargs)
 
 
-_FROZEN_BN = [True]  # build-time switch, see DeformableRFCN(frozen_bn=...)
-
-
-def _bn(**kw):
+def _bn(frozen, **kw):
     # detection-recipe BatchNorm: frozen statistics (use_global_stats), the
     # reference Deformable-ConvNets configuration — correct when fine-tuning
     # from pretrained weights.  From-scratch training (no pretrained weights
     # exist in this environment) needs LIVE statistics, so the model exposes
-    # ``frozen_bn=False``.
-    return nn.BatchNorm(use_global_stats=_FROZEN_BN[0], **kw)
+    # ``frozen_bn=False``, threaded down as a plain constructor parameter.
+    return nn.BatchNorm(use_global_stats=frozen, **kw)
 
 
 class _Bottleneck(HybridBlock):
@@ -89,13 +86,13 @@ class _Bottleneck(HybridBlock):
     (model_zoo/vision/resnet.py BottleneckV1 + the detection deltas)."""
 
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 dilation=1, deformable=False, **kwargs):
+                 dilation=1, deformable=False, frozen_bn=True, **kwargs):
         super().__init__(**kwargs)
         mid = channels // 4
         with self.name_scope():
             self.body = nn.HybridSequential(prefix="")
             self.body.add(nn.Conv2D(mid, 1, strides=stride, use_bias=False))
-            self.body.add(_bn())
+            self.body.add(_bn(frozen_bn))
             self.body.add(nn.Activation("relu"))
             if deformable:
                 self.body.add(DeformableConv2D(
@@ -105,16 +102,16 @@ class _Bottleneck(HybridBlock):
                 self.body.add(nn.Conv2D(
                     mid, 3, strides=1, padding=dilation, dilation=dilation,
                     use_bias=False))
-            self.body.add(_bn())
+            self.body.add(_bn(frozen_bn))
             self.body.add(nn.Activation("relu"))
             self.body.add(nn.Conv2D(channels, 1, strides=1, use_bias=False))
-            self.body.add(_bn())
+            self.body.add(_bn(frozen_bn))
             if downsample:
                 self.downsample = nn.HybridSequential(prefix="down_")
                 self.downsample.add(nn.Conv2D(
                     channels, 1, strides=stride, use_bias=False,
                     in_channels=in_channels))
-                self.downsample.add(_bn())
+                self.downsample.add(_bn(frozen_bn))
             else:
                 self.downsample = None
 
@@ -128,18 +125,19 @@ class _Bottleneck(HybridBlock):
 
 class _ResStage(HybridBlock):
     def __init__(self, units, channels, stride, in_channels, dilation=1,
-                 deformable=False, **kwargs):
+                 deformable=False, frozen_bn=True, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
             self.stage = nn.HybridSequential(prefix="")
             self.stage.add(_Bottleneck(
                 channels, stride, True, in_channels=in_channels,
-                dilation=dilation, deformable=deformable, prefix="unit1_"))
+                dilation=dilation, deformable=deformable,
+                frozen_bn=frozen_bn, prefix="unit1_"))
             for i in range(units - 1):
                 self.stage.add(_Bottleneck(
                     channels, 1, False, in_channels=channels,
                     dilation=dilation, deformable=deformable,
-                    prefix="unit%d_" % (i + 2)))
+                    frozen_bn=frozen_bn, prefix="unit%d_" % (i + 2)))
 
     def hybrid_forward(self, F, x):
         return self.stage(x)
@@ -168,17 +166,14 @@ class DeformableRFCN(HybridBlock):
                  batch_rois=128, fg_fraction=0.25, rpn_batch=256,
                  max_gts=100, frozen_bn=True, **kwargs):
         super().__init__(**kwargs)
-        _FROZEN_BN[0] = bool(frozen_bn)  # consumed by _bn during build below
-        try:
-            self._build(classes, image_shape, units, pooled_size, scales,
-                        ratios, rpn_pre_nms, rpn_post_nms, rpn_min_size,
-                        batch_rois, fg_fraction, rpn_batch, max_gts)
-        finally:
-            _FROZEN_BN[0] = True  # restore the module default for later builds
+        self._build(classes, image_shape, units, pooled_size, scales,
+                    ratios, rpn_pre_nms, rpn_post_nms, rpn_min_size,
+                    batch_rois, fg_fraction, rpn_batch, max_gts,
+                    bool(frozen_bn))
 
     def _build(self, classes, image_shape, units, pooled_size, scales,
                ratios, rpn_pre_nms, rpn_post_nms, rpn_min_size, batch_rois,
-               fg_fraction, rpn_batch, max_gts):
+               fg_fraction, rpn_batch, max_gts, frozen_bn):
         self.classes = classes
         self.k = int(pooled_size)
         self.stride = 16
@@ -205,15 +200,16 @@ class DeformableRFCN(HybridBlock):
             # the reference's FIXED_PARAMS=['conv1','res2',...])
             self.conv1 = nn.HybridSequential(prefix="conv1_")
             self.conv1.add(nn.Conv2D(64, 7, 2, 3, use_bias=False))
-            self.conv1.add(_bn())
+            self.conv1.add(_bn(frozen_bn))
             self.conv1.add(nn.Activation("relu"))
             self.conv1.add(nn.MaxPool2D(3, 2, 1))
-            self.res2 = _ResStage(units[0], 256, 1, 64, prefix="res2_")
-            self.res3 = _ResStage(units[1], 512, 2, 256, prefix="res3_")
-            self.res4 = _ResStage(units[2], 1024, 2, 512, prefix="res4_")
+            self.res2 = _ResStage(units[0], 256, 1, 64, frozen_bn=frozen_bn, prefix="res2_")
+            self.res3 = _ResStage(units[1], 512, 2, 256, frozen_bn=frozen_bn, prefix="res3_")
+            self.res4 = _ResStage(units[2], 1024, 2, 512, frozen_bn=frozen_bn, prefix="res4_")
             # res5: dilated, deformable, stride 1 (output stride stays 16)
             self.res5 = _ResStage(units[3], 2048, 1, 1024, dilation=2,
-                                  deformable=True, prefix="res5_")
+                                  deformable=True, frozen_bn=frozen_bn,
+                                  prefix="res5_")
             # RPN on res4 (reference rpn_conv_3x3 512)
             self.rpn_conv = nn.Conv2D(512, 3, padding=1, activation="relu",
                                       prefix="rpn_conv_")

@@ -12,6 +12,8 @@ backward kernels of the reference).
 """
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -117,6 +119,23 @@ def convolution(
     stride = _tup(stride, n)
     dilate = _tup(dilate, n)
     pad = _tup(pad, n) if pad is not None else (0,) * n
+    if (n == 2 and layout in (None, "NCHW")
+            and os.environ.get("MXNET_CONV_INTERNAL_LAYOUT") == "NHWC"):
+        # experiment knob: run the conv channels-last internally (NCHW kept
+        # at the API); XLA's layout assignment usually elides the wrapper
+        # transposes — measured in docs/PERF_NOTES.md
+        xt = jnp.transpose(data, (0, 2, 3, 1))
+        wt = jnp.transpose(weight, (0, 2, 3, 1))
+        dnt = jax.lax.conv_dimension_numbers(
+            xt.shape, wt.shape, ("NHWC", "OHWI", "NHWC"))
+        out = jax.lax.conv_general_dilated(
+            xt, wt, window_strides=stride, padding=[(p, p) for p in pad],
+            rhs_dilation=dilate, dimension_numbers=dnt,
+            feature_group_count=num_group)
+        out = jnp.transpose(out, (0, 3, 1, 2))
+        if not no_bias and bias is not None:
+            out = out + bias.reshape(1, -1, 1, 1)
+        return out
     dn = jax.lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dims(n, layout))
     out = jax.lax.conv_general_dilated(
         data,
@@ -240,6 +259,18 @@ def pooling(
     """
     n = data.ndim - 2
     channel_last = layout is not None and len(layout) > 1 and layout[1] != "C"
+    if (n == 2 and layout in (None, "NCHW") and not global_pool
+            and os.environ.get("MXNET_POOL_INTERNAL_LAYOUT") == "NHWC"):
+        # internal-layout knob like Convolution's — measured NEUTRAL-to-
+        # slightly-negative on ResNet-50 (docs/PERF_NOTES.md), so it keys
+        # off its own env var and stays off by default
+        out = pooling(
+            jnp.transpose(data, (0, 2, 3, 1)), kernel=kernel,
+            pool_type=pool_type, stride=stride, pad=pad,
+            pooling_convention=pooling_convention,
+            count_include_pad=count_include_pad, p_value=p_value,
+            layout="NHWC")
+        return jnp.transpose(out, (0, 3, 1, 2))
     if global_pool:
         ax = tuple(range(1, 1 + n)) if channel_last else tuple(range(2, data.ndim))
         if pool_type == "max":
@@ -392,9 +423,21 @@ def batch_norm(
     if use_global_stats or not training:
         mean, var = moving_mean, moving_var
     else:
+        # one-pass stats: both reductions are sibling outputs of ONE fused
+        # read of the activation (jnp.var's two-pass form reads it twice —
+        # ResNet training is HBM-bound and BN touches every activation,
+        # docs/PERF_NOTES.md roofline).  SHIFTED form: squaring (x − m₀)
+        # with the running mean as the per-channel reference keeps the
+        # E[d²]−E[d]² cancellation proportional to |batch mean − running
+        # mean| (small once stats track) instead of |mean|/std — the raw
+        # form catastrophically cancels for large-mean channels.
         x32 = data.astype(jnp.float32)
-        mean = jnp.mean(x32, axis=red)
-        var = jnp.var(x32, axis=red)
+        m0 = moving_mean.astype(jnp.float32).reshape(bshape)
+        d = x32 - m0
+        dmean = jnp.mean(d, axis=red)
+        dex2 = jnp.mean(d * d, axis=red)
+        var = jnp.maximum(dex2 - dmean * dmean, 0.0)
+        mean = dmean + moving_mean.astype(jnp.float32)
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     scale = (g / jnp.sqrt(var + eps)).astype(data.dtype).reshape(bshape)
     shift = (beta - mean * g / jnp.sqrt(var + eps)).astype(data.dtype).reshape(bshape)
