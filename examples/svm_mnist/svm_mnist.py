@@ -1,0 +1,70 @@
+"""SVM-output training — reference ``example/svm_mnist/svm_mnist.py``
+(an MLP trained with ``SVMOutput``'s multiclass hinge gradient instead of
+softmax CE).
+
+Exercises SVMOutput's injected hinge backward (L2-SVM default and the
+``use_linear`` L1 variant) end-to-end on REAL data: sklearn's handwritten
+digits (the reference used MNIST, unreachable offline).
+
+Run: ./dev.sh python examples/svm_mnist/svm_mnist.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def main(epochs=30, batch=64, lr=0.02, use_linear=False, seed=0):
+    from sklearn.datasets import load_digits
+    from sklearn.model_selection import train_test_split
+
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    X, y = load_digits(return_X_y=True)
+    X = (X.astype(np.float32) / 16.0)
+    y = y.astype(np.float32)
+    Xtr, Xte, ytr, yte = train_test_split(X, y, test_size=0.25,
+                                          random_state=seed, stratify=y)
+
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(128, activation="relu"),
+            mx.gluon.nn.Dense(64, activation="relu"),
+            mx.gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": lr, "momentum": 0.9})
+
+    n = Xtr.shape[0]
+    for epoch in range(epochs):
+        perm = np.random.permutation(n)
+        for i in range(0, n - batch + 1, batch):
+            sel = perm[i:i + batch]
+            with autograd.record():
+                scores = net(nd.array(Xtr[sel]))
+                # hinge gradient injected by the layer (reference
+                # svm_output-inl.h); margin/regularization per the example
+                out = nd.SVMOutput(scores, nd.array(ytr[sel]), margin=1.0,
+                                   regularization_coefficient=1.0,
+                                   use_linear=use_linear)
+            out.backward()
+            trainer.step(batch)
+        if epoch % 10 == 9:
+            acc = (net(nd.array(Xte)).asnumpy().argmax(1) == yte).mean()
+            print("epoch %2d  test acc %.4f" % (epoch, acc), flush=True)
+
+    acc = (net(nd.array(Xte)).asnumpy().argmax(1) == yte).mean()
+    print("FINAL svm_%s: test acc %.4f  (n_test=%d)"
+          % ("l1" if use_linear else "l2", acc, len(yte)))
+    return acc
+
+
+if __name__ == "__main__":
+    main()
+    main(use_linear=True, epochs=15)

