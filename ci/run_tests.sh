@@ -1,0 +1,54 @@
+#!/bin/bash
+# CI tiers — the reference's layout (ci/docker/runtime_functions.sh:491-599
+# unit nosetests; tests/nightly/test_all.sh nightly tier; gpu tier re-runs
+# the suite on device) mapped to this repo:
+#
+#   ./ci/run_tests.sh unit      fast unit tier (CPU, virtual 8-dev mesh)
+#   ./ci/run_tests.sh nightly   multi-process dist cluster + example E2E +
+#                               quality trainings (slow, CPU)
+#   ./ci/run_tests.sh tpu       device tier on the attached chip:
+#                               CPU-vs-TPU check_consistency + benches
+#                               (needs the bare axon env: run from the repo
+#                               root WITHOUT PYTHONPATH)
+#   ./ci/run_tests.sh all       unit + nightly
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+NIGHTLY_FILES=(
+  tests/test_launch_dist.py
+  tests/test_examples_classification.py
+  tests/test_examples_detection.py
+  tests/test_examples_rnn_sparse.py
+  tests/test_examples_quant_dp.py
+  tests/test_examples_misc.py
+  tests/test_examples_nce_fcn_svm.py
+  tests/test_example_deformable_rfcn.py
+)
+
+tier="${1:-unit}"
+case "$tier" in
+  unit)
+    ignore=()
+    for f in "${NIGHTLY_FILES[@]}"; do ignore+=(--ignore "$f"); done
+    exec ./dev.sh python -m pytest tests/ -q "${ignore[@]}"
+    ;;
+  nightly)
+    exec ./dev.sh python -m pytest "${NIGHTLY_FILES[@]}" -q
+    ;;
+  tpu)
+    # device tier: consistency sweep on the real chip, then both benches.
+    # PYTHONPATH kills the axon TPU plugin discovery — force it out so a
+    # dev-style shell can't silently fall back to CPU.
+    env -u PYTHONPATH MXNET_TEST_DEVICE=tpu python -m pytest tests/test_consistency_tpu.py -q
+    env -u PYTHONPATH python bench.py
+    env -u PYTHONPATH MXNET_BENCH=resnet50 python bench.py
+    ;;
+  all)
+    "$0" unit
+    "$0" nightly
+    ;;
+  *)
+    echo "usage: $0 {unit|nightly|tpu|all}" >&2
+    exit 2
+    ;;
+esac

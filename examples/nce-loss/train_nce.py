@@ -60,6 +60,7 @@ def main(vocab=500, dim=32, k=8, steps=900, batch=128, lr=20.0, seed=0):
             w = self.embed_out(cand_words)  # (B, 1+k, D)
             return (w * F.expand_dims(h, axis=1)).sum(axis=-1)  # (B, 1+k)
 
+    bce = mx.gluon.loss.SigmoidBinaryCrossEntropyLoss(from_sigmoid=False)
     net = NCEModel()
     # dot-product scores need O(1) logits and embedding-grad touch rate
     # scales as batch*(1+k)/vocab — hence the large-looking lr
@@ -77,11 +78,9 @@ def main(vocab=500, dim=32, k=8, steps=900, batch=128, lr=20.0, seed=0):
         with autograd.record():
             logits = net(nd.array(ctx), nd.array(cands))
             # binary logistic NCE objective
-            # stable softplus (Activation softrelu = jax.nn.softplus):
-            # log(1+exp(x)) overflows fp32 past |x|~88
-            loss = nd.mean(
-                nd.Activation(-logits, act_type="softrelu") * nd.array(target)
-                + nd.Activation(logits, act_type="softrelu") * nd.array(1 - target))
+            # the library's stable binary logistic loss IS the NCE
+            # discriminator objective (gluon/loss.py)
+            loss = nd.mean(bce(logits, nd.array(target)))
         loss.backward()
         trainer.step(1)  # the NCE objective is already a mean over the batch
         losses.append(float(loss.asnumpy()))
