@@ -12,6 +12,7 @@
 #                               root WITHOUT PYTHONPATH)
 #   ./ci/run_tests.sh all       unit + nightly
 set -euo pipefail
+SELF="$(cd "$(dirname "$0")" && pwd)/$(basename "$0")"
 cd "$(dirname "$0")/.."
 
 NIGHTLY_FILES=(
@@ -37,15 +38,23 @@ case "$tier" in
     ;;
   tpu)
     # device tier: consistency sweep on the real chip, then both benches.
-    # PYTHONPATH kills the axon TPU plugin discovery — force it out so a
-    # dev-style shell can't silently fall back to CPU.
-    env -u PYTHONPATH MXNET_TEST_DEVICE=tpu python -m pytest tests/test_consistency_tpu.py -q
-    env -u PYTHONPATH python bench.py
-    env -u PYTHONPATH MXNET_BENCH=resnet50 python bench.py
+    # The axon TPU plugin registers through the ambient PYTHONPATH
+    # (/root/.axon_site sitecustomize); dev-style additions to PYTHONPATH
+    # break its discovery, so reset it to exactly the axon site when that
+    # exists (bare-unset would ALSO break the plugin).
+    if [ -d /root/.axon_site ]; then
+      export PYTHONPATH=/root/.axon_site
+    else
+      echo "tpu tier: /root/.axon_site missing — refusing to fall back to CPU" >&2
+      exit 1
+    fi
+    MXNET_TEST_DEVICE=tpu python -m pytest tests/test_consistency_tpu.py -q
+    python bench.py
+    MXNET_BENCH=resnet50 python bench.py
     ;;
   all)
-    "$0" unit
-    "$0" nightly
+    "$SELF" unit
+    "$SELF" nightly
     ;;
   *)
     echo "usage: $0 {unit|nightly|tpu|all}" >&2
