@@ -8,8 +8,9 @@
 #                               quality trainings (slow, CPU)
 #   ./ci/run_tests.sh tpu       device tier on the attached chip:
 #                               CPU-vs-TPU check_consistency + benches
-#                               (needs the bare axon env: run from the repo
-#                               root WITHOUT PYTHONPATH)
+#                               (needs PYTHONPATH to be EXACTLY the axon
+#                               site — enforced below; both unsetting it
+#                               and adding repo paths break the plugin)
 #   ./ci/run_tests.sh all       unit + nightly
 set -euo pipefail
 SELF="$(cd "$(dirname "$0")" && pwd)/$(basename "$0")"

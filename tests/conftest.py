@@ -40,3 +40,24 @@ def _seed_all(request):
     np.random.seed(seed)
     mx.random.seed(seed)
     yield
+
+
+def load_example_module(name, path):
+    """Load an example file under a UNIQUE sys.modules name (several example
+    dirs ship a ``train.py``; a bare ``import train`` resolves to whichever
+    one another test cached first — order-dependent failures).  Cached by
+    name so repeated loads don't re-execute top-level work."""
+    import importlib.util
+    import sys
+
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except BaseException:
+        del sys.modules[name]  # never leave a half-initialized entry
+        raise
+    return mod

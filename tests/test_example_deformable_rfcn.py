@@ -15,6 +15,12 @@ EXDIR = os.path.abspath(
 sys.path.insert(0, EXDIR)
 
 
+def _load(name, fname):
+    from conftest import load_example_module
+
+    return load_example_module(name, os.path.join(EXDIR, fname))
+
+
 def test_forward_shapes():
     from deformable_rfcn import DeformableRFCN
 
@@ -30,7 +36,7 @@ def test_forward_shapes():
 
 def test_loss_decreases():
     from deformable_rfcn import DeformableRFCN, rfcn_losses, rpn_losses
-    from train import synthetic_batches
+    synthetic_batches = _load("dfrfcn_train", "train.py").synthetic_batches
 
     net = DeformableRFCN(num_classes=2)
     net.initialize()
@@ -57,7 +63,7 @@ def test_all_branches_get_gradients():
     """Deformable offsets, psroi trans, AND the RPN must receive gradients
     (the ROI round() blocks the pooled path to the RPN; rpn_losses covers it)."""
     from deformable_rfcn import DeformableRFCN, rfcn_losses, rpn_losses
-    from train import synthetic_batches
+    synthetic_batches = _load("dfrfcn_train", "train.py").synthetic_batches
 
     net = DeformableRFCN(num_classes=2)
     net.initialize()

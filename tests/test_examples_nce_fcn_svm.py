@@ -76,3 +76,14 @@ def test_svm_example_real_digits():
 
     acc = svm_mnist.main(epochs=8, lr=0.02)
     assert acc > 0.9, acc
+
+
+def test_autoencoder_example_layerwise_plus_finetune():
+    aedir = os.path.join(EX, "autoencoder")
+    if aedir not in sys.path:
+        sys.path.insert(0, aedir)
+    import train_ae
+
+    rec, probe = train_ae.main(pre_epochs=4, fine_epochs=6)
+    assert rec < 0.05, rec            # reconstructs real digits
+    assert probe > 0.5, probe         # 16-d code keeps class structure
