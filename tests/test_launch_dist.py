@@ -62,8 +62,8 @@ def test_dist_sync_kvstore_two_processes(tmp_path):
     worker.write_text(WORKER)
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     # Gloo inter-process connects can time out when the host is saturated
-    # (full-suite runs on one core); one retry keeps the signal without flakes
-    for attempt in range(2):
+    # (full-suite runs on one core); retries keep the signal without flakes
+    for attempt in range(3):
         res = subprocess.run(
             [sys.executable, LAUNCH, "-n", "2", "--launcher", "local",
              sys.executable, str(worker)],
