@@ -3,12 +3,16 @@
 The reference forks worker processes and rebuilds NDArrays over POSIX shared
 memory (dataloader.py:26-97).  Both worker models exist here:
 
-* ``thread_pool=True`` (default): decode/augment in PIL/numpy release the
-  GIL, there is no CUDA context to protect, and skipping process forking
-  avoids the fork-vs-XLA-client hazard (the reference itself has engine
-  fork handlers for this, src/initialize.cc:31-64).
-* ``thread_pool=False``: worker PROCESSES, for pure-Python augmentation
-  that holds the GIL (the reference's default model).  Workers use the
+* ``thread_pool=True`` (default — DELIBERATE DEVIATION from the
+  reference's process-worker default, documented in PARITY.md): threads
+  work for any dataset (NDArray-returning, unpicklable transforms, REPL
+  ``__main__``), decode/augment in PIL/numpy release the GIL, and
+  skipping process forking avoids the fork-vs-XLA-client hazard (the
+  reference itself has engine fork handlers for this,
+  src/initialize.cc:31-64).  Ported pipelines with GIL-bound pure-Python
+  augmentation should pass ``thread_pool=False`` explicitly.
+* ``thread_pool=False``: worker PROCESSES (the reference's model), for
+  pure-Python augmentation that holds the GIL.  Workers use the
   SPAWN start method — forking a parent with a live XLA client inherits
   locks/threads and deadlocks nondeterministically (observed; the
   reference guards the same hazard with engine fork handlers,

@@ -403,14 +403,18 @@ class MNISTIter(DataIter):
             lab = np.frombuffer(f.read(nl), dtype=np.uint8).astype(np.float32)
         if n != nl:
             raise MXNetError("image/label count mismatch: %d vs %d" % (n, nl))
+        # partition FIRST to a contiguous file part with proportional floor
+        # bounds covering all samples (reference iter_mnist.cc seeks to the
+        # part, then shuffles within it). Parts may differ by one sample when
+        # num_parts doesn't divide n — as in the reference — so dist_sync
+        # loops must fix the per-epoch batch count (examples/.../common/fit.py
+        # epoch_size = num_examples/batch/num_workers does exactly this)
+        start = (n * part_index) // num_parts
+        end = (n * (part_index + 1)) // num_parts
+        img, lab = img[start:end], lab[start:end]
         if shuffle:
-            order = np.random.RandomState(seed).permutation(n)
+            order = np.random.RandomState(seed).permutation(len(img))
             img, lab = img[order], lab[order]
-        # partition AFTER the (seeded, rank-identical) shuffle, as the
-        # reference does, so parts stay disjoint across workers
-        part = n // num_parts
-        sl = slice(part_index * part, (part_index + 1) * part)
-        img, lab = img[sl], lab[sl]
         data = img.reshape(len(img), rows * cols) if flat else img[:, None]
         self._inner = NDArrayIter(data, lab, batch_size=batch_size)
         self.provide_data = self._inner.provide_data
@@ -507,12 +511,20 @@ class LibSVMIter(DataIter):
     def reset(self):
         self._cursor = 0
 
+    def _row_index(self, r):
+        # pad rows: round_batch=True wraps to the stream start (reference
+        # iter_batchloader.h:103-121); round_batch=False repeats the last
+        # real row (reference leaves stale slots — consumers drop pad rows)
+        if r < self._n:
+            return r
+        return r % self._n if self._round_batch else self._n - 1
+
     def _csr_rows(self, start, stop):
         from .ndarray.sparse import csr_matrix
 
         rows = []
         for r in range(start, stop):
-            r = r % self._n  # round_batch wraps (reference batch padding)
+            r = self._row_index(r)
             rows.append((self._indptr[r], self._indptr[r + 1]))
         indptr = np.zeros(len(rows) + 1, np.int64)
         idx, val = [], []
@@ -528,14 +540,12 @@ class LibSVMIter(DataIter):
     def iter_next(self):
         if self._cursor >= self._n:
             return False
-        stop = self._cursor + self.batch_size
-        if stop > self._n and not self._round_batch:
-            # reference batch-loader semantics: round_batch=False discards
-            # the incomplete tail instead of wrapping
-            self._cursor = stop
-            return False
+        # reference batch-loader semantics (iter_batchloader.h:102-125): the
+        # incomplete tail batch is still returned, padded to batch_size, with
+        # getpad() == batch_size - real rows; round_batch only controls
+        # whether the NEXT epoch starts mid-stream (we always reset instead)
         self._start = self._cursor
-        self._cursor = stop
+        self._cursor += self.batch_size
         return True
 
     def getdata(self):
@@ -544,12 +554,12 @@ class LibSVMIter(DataIter):
     def getlabel(self):
         from . import ndarray as _nd
 
-        lab = np.stack([self._labels[r % self._n]
+        lab = np.stack([self._labels[self._row_index(r)]
                         for r in range(self._start, self._start + self.batch_size)])
         return [_nd.array(lab)]
 
     def getpad(self):
-        # round_batch=True wraps to fill the batch and REPORTS the wrapped
+        # the tail batch wraps to fill batch_size and REPORTS the wrapped
         # row count as pad (DataBatch.pad contract: consumers drop them)
         return max(0, self._start + self.batch_size - self._n)
 
@@ -624,7 +634,11 @@ class ImageRecordIter(DataIter):
         self.label_width = label_width
         self.data_name = data_name
         self.label_name = label_name
-        self._round_batch = round_batch
+        # round_batch accepted for API parity but inert: the tail batch is
+        # always emitted with getpad() set and undefined pad rows (reference
+        # round_batch=0 behavior); the round_batch=1 wrap-from-start fill is
+        # not implemented — consumers must drop pad rows either way
+        del round_batch
         self._mean = np.array([mean_r, mean_g, mean_b], dtype=np.float32)
         self._std = np.array([std_r, std_g, std_b], dtype=np.float32)
         self._lib = _native.lib()
@@ -816,10 +830,9 @@ class ImageRecordIter(DataIter):
             self._exhausted = True
             raise out[1]
         data, label, valid = out
-        if valid < self.batch_size and not self._round_batch:
-            # round_batch=False: drop the trailing partial batch
-            self._exhausted = True
-            return False
+        # both round_batch modes emit the padded tail batch with
+        # getpad() == batch_size - valid (reference iter_batchloader.h:102-125;
+        # round_batch only changes what fills the pad rows there)
         pad = self.batch_size - valid
         lab = label[:, 0] if self.label_width == 1 else label
         self._current = DataBatch(

@@ -745,10 +745,11 @@ def svm_output(data, label, *, margin=1.0, regularization_coefficient=1.0, use_l
     """SVM output layer (reference src/operator/svm_output.cc).
 
     Forward = identity on the scores.  Like SoftmaxOutput, the layer
-    injects its OWN gradient on backward (reference svm_output-inl.h): for
-    each class j ≠ y with hinge violation z = margin − s_y + s_j > 0,
-    ∂L/∂s_j = c·(1 if L1 else 2z) and s_y receives the negated sum
-    (c = regularization_coefficient).
+    injects its OWN gradient on backward — one-vs-rest hinge per the
+    reference L1_SVM/L2_SVM kernels (svm_output.cc:31-67): the true class
+    k gets −c·[margin > s_k] (L1) or −2c·(margin − s_k)·[margin > s_k]
+    (L2); every other class j independently gets +c·[margin > −s_j] (L1)
+    or +2c·(margin + s_j)·[margin > −s_j] (L2), c = regularization_coefficient.
     """
     return _svm_output_vjp(data, label, float(margin),
                            float(regularization_coefficient), bool(use_linear))
@@ -766,12 +767,17 @@ def _svm_output_fwd(data, label, margin, reg, use_linear):
 def _svm_output_bwd(margin, reg, use_linear, res, g):
     data, label = res
     B, C = data.shape
-    y = label.astype(jnp.int32)
-    s_y = jnp.take_along_axis(data, y[:, None], axis=1)  # (B, 1)
-    z = margin - s_y + data  # (B, C); z == margin at j == y
-    viol = (z > 0) & (jnp.arange(C)[None, :] != y[:, None])
-    gj = jnp.where(viol, reg * (1.0 if use_linear else 2.0 * z), 0.0)
-    grad = gj + jax.nn.one_hot(y, C, dtype=data.dtype) * (-gj.sum(axis=1, keepdims=True))
+    y = label.reshape(B).astype(jnp.int32)
+    is_true = jnp.arange(C)[None, :] == y[:, None]  # (B, C)
+    s = data.astype(jnp.float32)
+    if use_linear:
+        grad = jnp.where(is_true,
+                         -reg * (margin > s).astype(jnp.float32),
+                         reg * (margin > -s).astype(jnp.float32))
+    else:
+        grad = jnp.where(is_true,
+                         jnp.where(margin > s, -2.0 * reg * (margin - s), 0.0),
+                         jnp.where(margin > -s, 2.0 * reg * (margin + s), 0.0))
     return grad.astype(data.dtype), jnp.zeros_like(label)
 
 

@@ -35,19 +35,33 @@ def test_svm_output_hinge_backward_matches_numpy():
                                use_linear=use_linear)
         out.backward()
         g = x.grad.asnumpy()
-        # numpy oracle
+        # numpy oracle: one-vs-rest hinge, reference svm_output.cc L1_SVM/L2_SVM
+        margin, c = 1.0, 0.7
         exp = np.zeros_like(s)
         for i in range(5):
             yi = int(y[i])
             for j in range(4):
                 if j == yi:
-                    continue
-                z = 1.0 - s[i, yi] + s[i, j]
-                if z > 0:
-                    gj = 0.7 * (1.0 if use_linear else 2.0 * z)
-                    exp[i, j] += gj
-                    exp[i, yi] -= gj
+                    if use_linear:
+                        exp[i, j] = -c * float(margin > s[i, j])
+                    else:
+                        exp[i, j] = -c * 2.0 * (margin - s[i, j]) if margin > s[i, j] else 0.0
+                else:
+                    if use_linear:
+                        exp[i, j] = c * float(margin > -s[i, j])
+                    else:
+                        exp[i, j] = c * 2.0 * (margin + s[i, j]) if margin > -s[i, j] else 0.0
         np.testing.assert_allclose(g, exp, rtol=1e-5, atol=1e-6)
+    # advisor round-2 regression case: s=[2,0,0], y=0, margin=1 must give
+    # [0, +c, +c] under L1 (the old Crammer-Singer form gave all-zeros)
+    x = nd.array(np.array([[2.0, 0.0, 0.0]], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        o = nd.SVMOutput(x, nd.array(np.array([0.0], np.float32)),
+                         margin=1.0, regularization_coefficient=1.0,
+                         use_linear=True)
+    o.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [[0.0, 1.0, 1.0]], atol=1e-6)
     # forward is identity on the scores
     np.testing.assert_allclose(out.asnumpy(), s, rtol=1e-6)
 

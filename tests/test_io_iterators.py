@@ -94,10 +94,12 @@ def test_libsvm_iter_sparse_batches(tmp_path):
     np.testing.assert_allclose(d2[0], [0, 0, 0, 0, 2.5])
     np.testing.assert_allclose(d2[1], [1.5, 0, 0, 2.0, 0])
     assert batches[2].pad == 1 and batches[0].pad == 0
-    # round_batch=False discards the incomplete tail instead of wrapping
+    # round_batch=False still emits the padded tail batch (reference
+    # iter_batchloader.h:102-125 returns it with num_batch_padd set)
     it2 = mx.io.LibSVMIter(data_libsvm=str(p), data_shape=(5,), batch_size=2,
                            round_batch=False)
-    assert len(list(it2)) == 2
+    b2 = list(it2)
+    assert len(b2) == 3 and b2[-1].pad == 1 and b2[0].pad == 0
     # reset replays identically
     it.reset()
     again = next(iter(it)).data[0].todense().asnumpy()
