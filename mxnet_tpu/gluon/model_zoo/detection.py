@@ -278,26 +278,33 @@ class DeformableRFCN(HybridBlock):
             feature_stride=self.stride)
         return F.BlockGrad(rois)  # proposals carry no gradient (reference)
 
-    def _head(self, F, c5, rois):
-        """Deformable PS-ROI scoring of ``rois`` → (cls_score, bbox_pred)."""
+    def _head(self, F, c5, rois, rois_per_image=0):
+        """Deformable PS-ROI scoring of ``rois`` → (cls_score, bbox_pred).
+
+        ``rois_per_image``: static per-image roi count when ``rois`` is
+        batch-major grouped (MultiProposal / proposal_target layout) —
+        enables the pooling's block-diagonal O(B) batch path
+        (ops/detection.py deformable_psroi_pooling)."""
         k = self.k
         feat = self.conv_new(c5)
         cls_maps = self.rfcn_cls(feat)
         bbox_maps = self.rfcn_bbox(feat)
         trans_maps = self.rfcn_trans(feat)
         ss = 1.0 / self.stride
+        rpi = int(rois_per_image)
         # stage 1: pool per-bin offsets from the offset fields (no_trans)
         trans = F.contrib.DeformablePSROIPooling(
             trans_maps, rois, spatial_scale=ss, output_dim=2, group_size=k,
-            pooled_size=k, part_size=k, no_trans=True)  # (R, 2, k, k)
+            pooled_size=k, part_size=k, no_trans=True,
+            rois_per_image=rpi)  # (R, 2, k, k)
         cls = F.contrib.DeformablePSROIPooling(
             cls_maps, rois, trans, spatial_scale=ss,
             output_dim=self.classes + 1, group_size=k, pooled_size=k,
-            part_size=k, trans_std=0.1)  # (R, C+1, k, k)
+            part_size=k, trans_std=0.1, rois_per_image=rpi)  # (R, C+1, k, k)
         bbox = F.contrib.DeformablePSROIPooling(
             bbox_maps, rois, trans, spatial_scale=ss, output_dim=8,
             group_size=k, pooled_size=k, part_size=k,
-            trans_std=0.1)  # (R, 8, k, k)
+            trans_std=0.1, rois_per_image=rpi)  # (R, 8, k, k)
         cls_score = F.Reshape(cls, shape=(0, 0, -1)).mean(axis=2)
         bbox_pred = F.Reshape(bbox, shape=(0, 0, -1)).mean(axis=2)
         return cls_score, bbox_pred
@@ -311,7 +318,8 @@ class DeformableRFCN(HybridBlock):
         rpn_cls, rpn_bbox = self._rpn(F, c4)
         rois = self._proposals(F, rpn_cls, rpn_bbox, im_info, batch)
         if gt_boxes is None:  # inference
-            cls_score, bbox_pred = self._head(F, c5, rois)
+            cls_score, bbox_pred = self._head(F, c5, rois,
+                                              rois_per_image=self.rpn_post_nms)
             return rois, F.softmax(cls_score, axis=-1), bbox_pred
 
         Hf, Wf = self.feat_shape
@@ -325,7 +333,8 @@ class DeformableRFCN(HybridBlock):
             num_classes=self.classes + 1, batch_images=batch,
             batch_rois=self.batch_rois * batch,
             fg_fraction=self.fg_fraction, class_agnostic=True)
-        cls_score, bbox_pred = self._head(F, c5, rois_s)
+        cls_score, bbox_pred = self._head(F, c5, rois_s,
+                                          rois_per_image=self.batch_rois)
         return (rpn_cls, rpn_bbox, rpn_label, rpn_bt, rpn_bw,
                 rois_s, label, bbox_target, bbox_weight, cls_score, bbox_pred)
 

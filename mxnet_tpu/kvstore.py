@@ -47,7 +47,6 @@ class KVStore:
         self._optimizer = None
         self._compression = None
         self._residual = {}
-        self._barrier_count = 0
 
     # -- identity ----------------------------------------------------------
     @property
@@ -210,8 +209,8 @@ class KVStore:
         from .parallel import dist
 
         if self._is_dist:
-            self._barrier_count += 1
-            dist.barrier(f"kv_barrier_{self._barrier_count}")
+            # dist.barrier() uniquifies ids with its own sequence counter
+            dist.barrier("kv_barrier")
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         assert self._updater is not None, "no updater/optimizer attached"

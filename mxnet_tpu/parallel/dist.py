@@ -19,9 +19,38 @@ import os
 _initialized = False
 
 
-def init(coordinator_address=None, num_processes=None, process_id=None, **kw):
+class DeadNodeError(RuntimeError):
+    """A collective timed out because specific ranks never arrived.
+
+    The reference detects dead nodes at barrier setup via the scheduler
+    heartbeat (``ps::Postoffice::GetDeadNodes``, kvstore_dist.h:110-118) and
+    aborts with the dead node list; without this, a lost rank silently hangs
+    the whole job.  Carries ``missing_ranks``.
+    """
+
+    def __init__(self, barrier_name, missing_ranks, timeout_ms):
+        self.missing_ranks = list(missing_ranks)
+        super().__init__(
+            "barrier %r timed out after %d ms: rank(s) %s never reported "
+            "arrival (dead-node check over the coordination service) — the "
+            "process(es) most likely died or hung; restart the job "
+            "(reference semantics: checkpoint + relaunch, SURVEY §5.3)"
+            % (barrier_name, timeout_ms,
+               ",".join(str(r) for r in self.missing_ranks)))
+
+
+def init(coordinator_address=None, num_processes=None, process_id=None,
+         initialization_timeout=None, **kw):
     """Initialize multi-host JAX.  Idempotent; no-op in single-process runs
-    unless coordinator env/args are present."""
+    unless coordinator env/args are present.
+
+    ``initialization_timeout`` (seconds; env ``MXNET_DIST_INIT_TIMEOUT``)
+    bounds the startup rendezvous — with a rank missing at launch the
+    survivors fail after this timeout instead of waiting forever (the
+    reference's scheduler barrier behaves the same way via heartbeat
+    timeouts, kvstore_dist.h:110-118).  Note jax's distributed client
+    TERMINATES the process on rendezvous timeout (fatal log, not a
+    catchable exception) — fail-fast semantics, not recoverable ones."""
     global _initialized
     if _initialized:
         return
@@ -34,6 +63,10 @@ def init(coordinator_address=None, num_processes=None, process_id=None, **kw):
         # single-host; jax.distributed not needed
         _initialized = True
         return
+    if initialization_timeout is None and "MXNET_DIST_INIT_TIMEOUT" in os.environ:
+        initialization_timeout = int(os.environ["MXNET_DIST_INIT_TIMEOUT"])
+    if initialization_timeout is not None:
+        kw["initialization_timeout"] = int(initialization_timeout)
     import jax
 
     jax.distributed.initialize(
@@ -63,15 +96,28 @@ def is_coordinator():
     return rank() == 0
 
 
-def barrier(name="mxnet_barrier", timeout_ms=120_000):
+_barrier_seq = 0
+
+
+def barrier(name="mxnet_barrier", timeout_ms=None):
     """Block until every process arrives (reference ``KVStore::Barrier``,
-    ``kvstore_dist.h:96``).  Uses the coordination-service barrier (bounded by
-    ``timeout_ms``) when available; desync/timeout errors propagate — a
-    missing host is a real failure, not something to paper over."""
+    ``kvstore_dist.h:96``).
+
+    ``timeout_ms`` defaults to env ``MXNET_DIST_BARRIER_TIMEOUT_MS`` (else
+    120 s); an explicitly passed value always wins over the env, matching
+    ``init()``'s precedence.  On timeout the coordination-service KV store
+    is queried for per-rank arrival marks and a :class:`DeadNodeError`
+    NAMING the non-arrived ranks is raised — the reference's dead-node
+    check (``ps::Postoffice::GetDeadNodes`` at barrier setup,
+    kvstore_dist.h:110-118) rebuilt on the TPU stack.  A lost rank
+    therefore fails the job fast with a diagnostic instead of hanging it."""
+    global _barrier_seq
     import jax
 
     if jax.process_count() == 1:
         return
+    if timeout_ms is None:
+        timeout_ms = int(os.environ.get("MXNET_DIST_BARRIER_TIMEOUT_MS", 120_000))
     client = getattr(jax._src.distributed.global_state, "client", None)
     if client is None:
         # jax moved the internals, or no coordination-service client (e.g.
@@ -80,7 +126,39 @@ def barrier(name="mxnet_barrier", timeout_ms=120_000):
 
         multihost_utils.sync_global_devices(name)
         return
-    client.wait_at_barrier(name, timeout_ms)
+    # barrier() is collective, so every process sees the same sequence
+    # number; keys (unlike TSL barrier ids) are single-use, hence the suffix
+    _barrier_seq += 1
+    mark = "mxt_arrived/%s/%d" % (name, _barrier_seq)
+    my_mark = "%s/%d" % (mark, jax.process_index())
+    try:
+        client.key_value_set(my_mark, "1")
+    except Exception:
+        import warnings
+
+        warnings.warn("dist.barrier: failed to publish arrival mark %r — "
+                      "on timeout OTHER ranks may misreport this one as "
+                      "dead" % my_mark)
+    try:
+        client.wait_at_barrier("%s_%d" % (name, _barrier_seq), int(timeout_ms))
+    except Exception as exc:
+        missing = []
+        for r in range(jax.process_count()):
+            try:
+                v = client.key_value_try_get("%s/%d" % (mark, r))
+            except Exception:
+                v = None
+            if not v:
+                missing.append(r)
+        if missing:
+            raise DeadNodeError(name, missing, timeout_ms) from exc
+        raise
+    # passed: drop this rank's mark so coordinator KV state stays bounded
+    # over long jobs (barriers can run every sync interval for days)
+    try:
+        client.key_value_delete(my_mark)
+    except Exception:
+        pass
 
 
 def shutdown():

@@ -48,11 +48,17 @@ def _d(*shape, lo=-1.0, hi=1.0):
     return (_R.rand(*shape) * (hi - lo) + lo).astype(np.float32)
 
 
-def _run(dev, fn, args, with_grad):
+def _run(dev, fn, args, with_grad, dtype=None):
     import jax
     import jax.numpy as jnp
 
-    ja = [jax.device_put(a, dev) for a in args]
+    def cast(a):
+        a = np.asarray(a)
+        if dtype is not None and np.issubdtype(a.dtype, np.floating):
+            return a.astype(jnp.dtype(dtype))  # ml_dtypes bfloat16 via jnp
+        return a
+
+    ja = [jax.device_put(cast(a), dev) for a in args]
     with jax.default_matmul_precision("highest"):
         if not with_grad:
             out = jax.jit(fn)(*ja)
@@ -77,15 +83,17 @@ def _run(dev, fn, args, with_grad):
                 [np.asarray(g) for g in grads])
 
 
-def _check(fn, args, with_grad=True, rtol=2e-3, atol=2e-3):
-    cpu_out, cpu_g = _run(_cpu_device(), fn, args, with_grad)
-    tpu_out, tpu_g = _run(_tpu_device(), fn, args, with_grad)
+def _check(fn, args, with_grad=True, rtol=2e-3, atol=2e-3, dtype=None):
+    cpu_out, cpu_g = _run(_cpu_device(), fn, args, with_grad, dtype)
+    tpu_out, tpu_g = _run(_tpu_device(), fn, args, with_grad, dtype)
     for i, (c, t) in enumerate(zip(cpu_out, tpu_out)):
         np.testing.assert_allclose(
-            t, c, rtol=rtol, atol=atol, err_msg="output %d" % i)
+            np.asarray(t, np.float32), np.asarray(c, np.float32),
+            rtol=rtol, atol=atol, err_msg="output %d" % i)
     for i, (c, t) in enumerate(zip(cpu_g, tpu_g)):
         np.testing.assert_allclose(
-            t, c, rtol=rtol, atol=atol, err_msg="grad %d" % i)
+            np.asarray(t, np.float32), np.asarray(c, np.float32),
+            rtol=rtol, atol=atol, err_msg="grad %d" % i)
 
 
 def _op(name, **attrs):
@@ -95,58 +103,75 @@ def _op(name, **attrs):
 
 # --------------------------------------------------------------------------
 # the sweep: (id, fn, args, with_grad, tolerances)
+#
+# ``bf16=True`` (or a tolerance dict) additionally emits a bfloat16 variant
+# of the case — the dtype production actually trains in (VERDICT round-2
+# item 2; reference check_consistency includes fp16 the same way,
+# test_utils.py:470,1207).  bf16 tolerances default to 4e-2: inputs are
+# rounded to 8 mantissa bits on BOTH backends, so remaining divergence is
+# accumulation order, but one bf16 ulp at |x|~1 is 2^-8 ≈ 4e-3 and errors
+# compound through reductions.
 # --------------------------------------------------------------------------
+BF16_TOL = dict(rtol=4e-2, atol=4e-2)
+
+
 def _cases():
     C = []
 
-    def add(name, fn, args, with_grad=True, **tol):
-        C.append(pytest.param(fn, args, with_grad, tol, id=name))
+    def add(name, fn, args, with_grad=True, bf16=None, **tol):
+        C.append(pytest.param(fn, args, with_grad, dict(tol), id=name))
+        if bf16:
+            btol = dict(BF16_TOL)
+            if isinstance(bf16, dict):
+                btol.update(bf16)
+            btol["dtype"] = "bfloat16"
+            C.append(pytest.param(fn, args, with_grad, btol, id=name + "_bf16"))
 
     # elemwise / math (12)
     for u in ["sigmoid", "tanh", "exp", "log", "sqrt", "square", "erf",
               "softsign", "log1p", "rsqrt", "sin", "arctan"]:
         x = _d(4, 5, lo=0.2, hi=2.0)
-        add(u, _op(u), [x])
+        add(u, _op(u), [x], bf16=u in ("sigmoid", "tanh", "exp", "erf"))
     # binary + broadcast (6)
-    add("broadcast_add", _op("broadcast_add"), [_d(3, 1, 4), _d(1, 2, 4)])
-    add("broadcast_mul", _op("broadcast_mul"), [_d(3, 1, 4), _d(1, 2, 4)])
+    add("broadcast_add", _op("broadcast_add"), [_d(3, 1, 4), _d(1, 2, 4)], bf16=True)
+    add("broadcast_mul", _op("broadcast_mul"), [_d(3, 1, 4), _d(1, 2, 4)], bf16=True)
     add("broadcast_div", _op("broadcast_div"), [_d(3, 1, 4), _d(1, 2, 4, lo=0.5, hi=2.0)])
     add("broadcast_maximum", _op("broadcast_maximum"), [_d(3, 4), _d(3, 4)])
-    add("dot", _op("dot"), [_d(6, 7), _d(7, 5)])
-    add("batch_dot", _op("batch_dot"), [_d(3, 4, 5), _d(3, 5, 6)])
+    add("dot", _op("dot"), [_d(6, 7), _d(7, 5)], bf16=True)
+    add("batch_dot", _op("batch_dot"), [_d(3, 4, 5), _d(3, 5, 6)], bf16=True)
     # reductions (6)
-    add("sum_axis", _op("sum", axis=1), [_d(4, 5, 6)])
-    add("mean", _op("mean", axis=(0, 2)), [_d(4, 5, 6)])
-    add("max", _op("max", axis=1), [_d(4, 5, 6)])
-    add("prod", _op("prod", axis=2), [_d(3, 4, 5, lo=0.5, hi=1.5)])
-    add("norm", _op("norm"), [_d(4, 5)])
+    add("sum_axis", _op("sum", axis=1), [_d(4, 5, 6)], bf16=True)
+    add("mean", _op("mean", axis=(0, 2)), [_d(4, 5, 6)], bf16=True)
+    add("max", _op("max", axis=1), [_d(4, 5, 6)], bf16=True)
+    add("prod", _op("prod", axis=2), [_d(3, 4, 5, lo=0.5, hi=1.5)], bf16=True)
+    add("norm", _op("norm"), [_d(4, 5)], bf16=True)
     add("topk", _op("topk", k=3, axis=-1, ret_typ="value"), [_d(4, 9)], False)
     # nn core (12)
     add("Convolution", _op("Convolution", kernel=(3, 3), num_filter=8, pad=(1, 1)),
-        [_d(2, 4, 9, 9), _d(8, 4, 3, 3), _d(8)])
+        [_d(2, 4, 9, 9), _d(8, 4, 3, 3), _d(8)], bf16=True)
     add("Convolution_stride", _op("Convolution", kernel=(3, 3), num_filter=6,
                                   stride=(2, 2), no_bias=True),
-        [_d(2, 3, 11, 11), _d(6, 3, 3, 3)])
+        [_d(2, 3, 11, 11), _d(6, 3, 3, 3)], bf16=True)
     add("Deconvolution", _op("Deconvolution", kernel=(2, 2), num_filter=5,
                              stride=(2, 2), no_bias=True),
-        [_d(2, 3, 5, 5), _d(3, 5, 2, 2)])
+        [_d(2, 3, 5, 5), _d(3, 5, 2, 2)], bf16=True)
     add("FullyConnected", _op("FullyConnected", num_hidden=7),
-        [_d(4, 10), _d(7, 10), _d(7)])
+        [_d(4, 10), _d(7, 10), _d(7)], bf16=True)
     add("Pooling_max", _op("Pooling", kernel=(2, 2), pool_type="max", stride=(2, 2)),
-        [_d(2, 3, 8, 8)])
+        [_d(2, 3, 8, 8)], bf16=True)
     add("Pooling_avg", _op("Pooling", kernel=(3, 3), pool_type="avg", pad=(1, 1)),
-        [_d(2, 3, 8, 8)])
-    add("softmax", _op("softmax", axis=-1), [_d(4, 9)])
-    add("log_softmax", _op("log_softmax", axis=-1), [_d(4, 9)])
-    add("Activation_relu", _op("Activation", act_type="relu"), [_d(4, 5)])
+        [_d(2, 3, 8, 8)], bf16=True)
+    add("softmax", _op("softmax", axis=-1), [_d(4, 9)], bf16=True)
+    add("log_softmax", _op("log_softmax", axis=-1), [_d(4, 9)], bf16=True)
+    add("Activation_relu", _op("Activation", act_type="relu"), [_d(4, 5)], bf16=True)
     add("LeakyReLU_elu", _op("LeakyReLU", act_type="elu", slope=0.3), [_d(4, 5)])
-    add("LayerNorm", _op("LayerNorm"), [_d(4, 6), _d(6, lo=0.5, hi=1.5), _d(6)])
-    add("L2Normalization", _op("L2Normalization"), [_d(3, 4, 5)])
+    add("LayerNorm", _op("LayerNorm"), [_d(4, 6), _d(6, lo=0.5, hi=1.5), _d(6)], bf16=True)
+    add("L2Normalization", _op("L2Normalization"), [_d(3, 4, 5)], bf16=True)
     # BatchNorm fwd (aux mutation excluded from grad comparison)
     bn = _op("BatchNorm", fix_gamma=False)
     add("BatchNorm", lambda x, g, b, mm, mv: bn(x, g, b, mm, mv)[0],
         [_d(3, 4, 5, 5), _d(4, lo=0.5, hi=1.5), _d(4),
-         np.zeros(4, np.float32), np.ones(4, np.float32)])
+         np.zeros(4, np.float32), np.ones(4, np.float32)], bf16=True)
     # shape / indexing (8)
     add("transpose", _op("transpose", axes=(0, 2, 1)), [_d(3, 4, 5)])
     add("Reshape", _op("Reshape", shape=(0, -1)), [_d(3, 4, 5)])
@@ -165,11 +190,11 @@ def _cases():
     add("SwapAxis", _op("SwapAxis", dim1=0, dim2=2), [_d(3, 4, 5)])
     add("slice_axis", _op("slice_axis", axis=1, begin=1, end=4), [_d(3, 5, 2)])
     # losses (3)
-    add("smooth_l1", _op("smooth_l1", scalar=2.0), [_d(4, 5)])
+    add("smooth_l1", _op("smooth_l1", scalar=2.0), [_d(4, 5)], bf16=True)
     add("softmax_cross_entropy", _op("softmax_cross_entropy"),
-        [_d(4, 6), np.array([0, 2, 5, 1], np.float32)])
+        [_d(4, 6), np.array([0, 2, 5, 1], np.float32)], bf16=True)
     add("SoftmaxOutput", _op("SoftmaxOutput"),
-        [_d(4, 6), np.array([0, 2, 5, 1], np.float32)], False)
+        [_d(4, 6), np.array([0, 2, 5, 1], np.float32)], False, bf16=True)
     # detection set (10) — the north-star ops
     rois = np.concatenate([
         np.zeros((8, 1), np.float32),
@@ -177,31 +202,34 @@ def _cases():
         axis=1)
     rois[:, 3:] += 2.0
     add("ROIPooling", _op("ROIPooling", pooled_size=(3, 3), spatial_scale=0.5),
-        [_d(1, 4, 10, 10), rois])
+        [_d(1, 4, 10, 10), rois], bf16=True)
     add("ROIAlign", _op("_contrib_ROIAlign", pooled_size=(3, 3),
                         spatial_scale=0.5, sample_ratio=2),
-        [_d(1, 4, 10, 10), rois])
+        [_d(1, 4, 10, 10), rois], bf16=True)
     add("PSROIPooling", _op("_contrib_PSROIPooling", spatial_scale=0.5,
                             output_dim=2, pooled_size=3),
-        [_d(1, 18, 10, 10), rois])
+        [_d(1, 18, 10, 10), rois], bf16=True)
     add("DefPSROIPooling_gather",
         _op("_contrib_DeformablePSROIPooling", spatial_scale=0.5, output_dim=2,
             group_size=3, pooled_size=3, part_size=3, trans_std=0.1),
-        [_d(1, 18, 10, 10), rois, 0.2 * _d(8, 2, 3, 3)])
+        [_d(1, 18, 10, 10), rois, 0.2 * _d(8, 2, 3, 3)], bf16=True)
     bigrois = np.tile(rois, (40, 1))
     add("DefPSROIPooling_matmul",
         _op("_contrib_DeformablePSROIPooling", spatial_scale=0.5, output_dim=2,
             group_size=3, pooled_size=3, part_size=3, trans_std=0.1),
-        [_d(1, 18, 10, 10), bigrois, 0.2 * _d(320, 2, 3, 3)])
+        [_d(1, 18, 10, 10), bigrois, 0.2 * _d(320, 2, 3, 3)], bf16=True)
     add("DeformableConvolution",
         _op("_contrib_DeformableConvolution", kernel=(3, 3), num_filter=6,
             pad=(1, 1), num_deformable_group=2, no_bias=True),
-        [_d(1, 4, 8, 8), 0.5 * _d(1, 36, 8, 8), _d(6, 4, 3, 3)])
+        [_d(1, 4, 8, 8), 0.5 * _d(1, 36, 8, 8), _d(6, 4, 3, 3)], bf16=True)
     add("MultiProposal",
         _op("_contrib_MultiProposal", rpn_pre_nms_top_n=60, rpn_post_nms_top_n=12,
             scales=(4, 8), ratios=(0.5, 1, 2), feature_stride=16, rpn_min_size=4),
         [np.sort(_R.rand(1, 12, 5, 7).astype(np.float32), axis=1),  # 2A=12
          0.1 * _d(1, 24, 5, 7), np.array([[80, 112, 1.0]], np.float32)], False)
+    # (no bf16 MultiProposal/box_nms variants: bf16-rounded scores collapse
+    # into exact ties and CPU/TPU break them in different orders — discrete
+    # keep-set divergence no numeric tolerance can absorb, like plain topk)
     nmsdat = np.concatenate([
         _R.randint(0, 3, (1, 64, 1)).astype(np.float32),
         _R.rand(1, 64, 1).astype(np.float32),
@@ -211,12 +239,12 @@ def _cases():
                        score_index=1, id_index=0), [nmsdat], False)
     add("box_iou", _op("_contrib_box_iou"),
         [np.sort(_R.rand(6, 2, 2) * 10, axis=1).reshape(6, 4).astype(np.float32),
-         np.sort(_R.rand(4, 2, 2) * 10, axis=1).reshape(4, 4).astype(np.float32)])
+         np.sort(_R.rand(4, 2, 2) * 10, axis=1).reshape(4, 4).astype(np.float32)], bf16=True)
     anchors = np.sort(_R.rand(1, 20, 2, 2), axis=2).reshape(1, 20, 4).astype(np.float32)
     lab = np.full((1, 3, 5), -1.0, np.float32)
     lab[0, 0] = [1, 0.1, 0.1, 0.6, 0.7]
     add("MultiBoxTarget", _op("_contrib_MultiBoxTarget"),
-        [anchors, lab, _d(1, 2, 20)], False)
+        [anchors, lab, _d(1, 2, 20)], False, bf16=True)
     # rcnn targets (2)
     gt = np.full((1, 4, 5), -1.0, np.float32)
     gt[0, 0] = [0, 4, 4, 40, 40]
@@ -224,19 +252,19 @@ def _cases():
     add("rpn_anchor_target",
         _op("_contrib_rpn_anchor_target", feat_height=5, feat_width=6,
             feature_stride=16, scales=(2, 4), ratios=(0.5, 1, 2), batch_rois=32),
-        [gt, np.array([[80, 96, 1.0]], np.float32)], False)
+        [gt, np.array([[80, 96, 1.0]], np.float32)], False, bf16=True)
     prois = np.concatenate([
         np.zeros((20, 1), np.float32),
         np.sort(_R.rand(20, 2, 2) * 60, axis=1).reshape(20, 4).astype(np.float32)],
         axis=1)
     add("proposal_target",
         _op("_contrib_proposal_target", num_classes=4, batch_images=1,
-            batch_rois=8), [prois, gt], False)
+            batch_rois=8), [prois, gt], False, bf16=True)
     # linalg (3)
     spd = _d(4, 4)
     spd = spd @ spd.T + 4 * np.eye(4, dtype=np.float32)
     add("linalg_potrf", _op("_linalg_potrf"), [spd])
-    add("linalg_gemm2", _op("_linalg_gemm2"), [_d(3, 4), _d(4, 5)])
+    add("linalg_gemm2", _op("_linalg_gemm2"), [_d(3, 4), _d(4, 5)], bf16=True)
     add("linalg_sumlogdiag", _op("_linalg_sumlogdiag"), [spd])
     return C
 

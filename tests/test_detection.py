@@ -539,3 +539,49 @@ def test_deformable_psroi_matmul_path_matches_gather_path():
                                rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(np.asarray(gs[1]), np.asarray(gb[1][:R]),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_psroi_grouped_path_matches_ungrouped():
+    """The block-diagonal batch-major path (``rois_per_image`` hint, the
+    O(B) batch-scaling fix) must match the general path bit-for-bit in
+    forward and gradients for grouped rois."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops import detection as D
+
+    rng = np.random.RandomState(1)
+    B, OD, g = 3, 6, 3
+    C, H, W = OD * g * g, 12, 16
+    data = jnp.asarray(rng.rand(B, C, H, W).astype(np.float32))
+    Rb = 40
+    R = B * Rb
+    rois = np.zeros((R, 5), np.float32)
+    rois[:, 0] = np.repeat(np.arange(B), Rb)  # batch-major grouping
+    rois[:, 1:3] = rng.rand(R, 2) * 100
+    rois[:, 3:5] = rois[:, 1:3] + rng.rand(R, 2) * 120 + 8
+    trans = jnp.asarray(0.3 * rng.randn(R, 2, 3, 3).astype(np.float32))
+    roisj = jnp.asarray(rois)
+    kw = dict(spatial_scale=1 / 8, output_dim=OD, group_size=g,
+              pooled_size=3, part_size=3, trans_std=0.1)
+    # R*K*PH*PW*spp2*cpc = 120*1*9*16*2 >= 1<<16 -> both runs take matmul path
+    plain = D.deformable_psroi_pooling(data, roisj, trans, **kw)
+    grouped = D.deformable_psroi_pooling(data, roisj, trans,
+                                         rois_per_image=Rb, **kw)
+    np.testing.assert_allclose(np.asarray(grouped), np.asarray(plain),
+                               rtol=1e-5, atol=1e-6)
+
+    f_p = lambda d, t: jnp.sum(
+        D.deformable_psroi_pooling(d, roisj, t, **kw) ** 2)
+    f_g = lambda d, t: jnp.sum(
+        D.deformable_psroi_pooling(d, roisj, t, rois_per_image=Rb, **kw) ** 2)
+    gp = jax.grad(f_p, argnums=(0, 1))(data, trans)
+    gg = jax.grad(f_g, argnums=(0, 1))(data, trans)
+    np.testing.assert_allclose(np.asarray(gg[0]), np.asarray(gp[0]),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gg[1]), np.asarray(gp[1]),
+                               rtol=1e-4, atol=1e-5)
+    # a wrong rois_per_image (not matching R) safely falls back to general
+    fallback = D.deformable_psroi_pooling(data, roisj, trans,
+                                          rois_per_image=7, **kw)
+    np.testing.assert_allclose(np.asarray(fallback), np.asarray(plain),
+                               rtol=1e-6, atol=0)

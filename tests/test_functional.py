@@ -76,7 +76,10 @@ class TestFunctionalize:
 
 
 class TestGraftEntry:
-    def test_dryrun_multichip_small(self):
+    def test_dryrun_multichip_small(self, monkeypatch):
         import __graft_entry__ as g
 
+        # tiny detection trunk here: the unit tier checks the wiring; the
+        # driver's real dryrun_multichip(8) runs the full ResNet-101 trunk
+        monkeypatch.setenv("MXNET_DRYRUN_TINY_DETECTION", "1")
         g.dryrun_multichip(4)
