@@ -96,7 +96,11 @@ def main_rfcn():
     from train_fused import run_bench
 
     on_tpu = jax.devices()[0].platform == "tpu"
-    batch = int(os.environ.get("MXNET_BENCH_BATCH", 1))
+    # batch 4 is the single-chip throughput optimum (roofline-verified:
+    # examples/quality/rfcn_roofline.py — 24 img/s at 80% of the HBM bound;
+    # batch 1 runs at 19 img/s / 86%); batch scaling beyond 4 is capped by
+    # near-linear bytes/step growth, see docs/PERF_NOTES.md
+    batch = int(os.environ.get("MXNET_BENCH_BATCH", 4 if on_tpu else 1))
     iters = int(os.environ.get("MXNET_BENCH_ITERS", 10 if on_tpu else 2))
     imgs_per_sec, _ms, _loss = run_bench(
         resnet101=on_tpu, batch=batch, iters=iters,

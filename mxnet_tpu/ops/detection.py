@@ -95,19 +95,24 @@ def roi_pooling(data, rois, *, pooled_size, spatial_scale):
     B, C, H, W = data.shape
     R = rois.shape[0]
     f32 = data.dtype
+    # bin-boundary math always runs fp32 (deformable_psroi_pooling's
+    # discipline): bf16 products near integers floor/ceil differently per
+    # backend, shifting integer bin extents wholesale
+    cf = jnp.float32
+    rois = rois.astype(cf)
 
     batch_idx = rois[:, 0].astype(jnp.int32)
     xs = jnp.round(rois[:, 1] * spatial_scale).astype(jnp.int32)
     ys = jnp.round(rois[:, 2] * spatial_scale).astype(jnp.int32)
     xe = jnp.round(rois[:, 3] * spatial_scale).astype(jnp.int32)
     ye = jnp.round(rois[:, 4] * spatial_scale).astype(jnp.int32)
-    roi_h = jnp.maximum(ye - ys + 1, 1).astype(f32)  # (R,)
-    roi_w = jnp.maximum(xe - xs + 1, 1).astype(f32)
+    roi_h = jnp.maximum(ye - ys + 1, 1).astype(cf)  # (R,)
+    roi_w = jnp.maximum(xe - xs + 1, 1).astype(cf)
     bs_h = roi_h / PH
     bs_w = roi_w / PW
 
-    ph = jnp.arange(PH, dtype=f32)
-    pw = jnp.arange(PW, dtype=f32)
+    ph = jnp.arange(PH, dtype=cf)
+    pw = jnp.arange(PW, dtype=cf)
     # bin bounds per (R, PH) before roi offset, then clipped into the map
     hstart = jnp.floor(ph[None, :] * bs_h[:, None]).astype(jnp.int32) + ys[:, None]
     hend = jnp.ceil((ph[None, :] + 1) * bs_h[:, None]).astype(jnp.int32) + ys[:, None]
@@ -152,6 +157,9 @@ def roi_align(data, rois, *, pooled_size, spatial_scale, sample_ratio=-1):
     PH, PW = _pair(pooled_size)
     B, C, H, W = data.shape
     f32 = data.dtype
+    # sample-coordinate math always fp32 (see roi_pooling note)
+    cf = jnp.float32
+    rois = rois.astype(cf)
 
     batch_idx = rois[:, 0].astype(jnp.int32)
     x1 = rois[:, 1] * spatial_scale
@@ -174,14 +182,14 @@ def roi_align(data, rois, *, pooled_size, spatial_scale, sample_ratio=-1):
         grid_h = jnp.clip(jnp.ceil(bs_h), 1, gh_max)
         grid_w = jnp.clip(jnp.ceil(bs_w), 1, gw_max)
 
-    iy = jnp.arange(gh_max, dtype=f32)
-    ix = jnp.arange(gw_max, dtype=f32)
+    iy = jnp.arange(gh_max, dtype=cf)
+    ix = jnp.arange(gw_max, dtype=cf)
 
     def one_roi(b, ys, xs, bh, bw, gh, gw):
         feat = data[b]  # (C,H,W)
-        # sample coords (PH, gh_max) / (PW, gw_max)
-        py = ys + jnp.arange(PH, dtype=f32)[:, None] * bh + (iy[None, :] + 0.5) * bh / gh
-        px = xs + jnp.arange(PW, dtype=f32)[:, None] * bw + (ix[None, :] + 0.5) * bw / gw
+        # sample coords (PH, gh_max) / (PW, gw_max), fp32
+        py = ys + jnp.arange(PH, dtype=cf)[:, None] * bh + (iy[None, :] + 0.5) * bh / gh
+        px = xs + jnp.arange(PW, dtype=cf)[:, None] * bw + (ix[None, :] + 0.5) * bw / gw
         # inclusion rule y ∈ [-1, H] (roi_align.cc bilinear pre-check)
         my = (iy[None, :] < gh) & (py >= -1.0) & (py <= H)  # (PH, gh_max)
         mx = (ix[None, :] < gw) & (px >= -1.0) & (px <= W)  # (PW, gw_max)
@@ -189,9 +197,9 @@ def roi_align(data, rois, *, pooled_size, spatial_scale, sample_ratio=-1):
         yy = jnp.broadcast_to(py.reshape(PH, gh_max, 1, 1), (PH, gh_max, PW, gw_max))
         xx = jnp.broadcast_to(px.reshape(1, 1, PW, gw_max), (PH, gh_max, PW, gw_max))
         v = _bilinear_hw(feat, yy.reshape(-1), xx.reshape(-1)).reshape(C, PH, gh_max, PW, gw_max)
-        m = (my[:, :, None, None] & mx[None, None, :, :]).astype(f32)
+        m = (my[:, :, None, None] & mx[None, None, :, :]).astype(v.dtype)
         s = (v * m[None]).sum(axis=(2, 4))  # (C, PH, PW)
-        return s / (gh * gw)
+        return (s / (gh * gw).astype(v.dtype)).astype(f32)
 
     return jax.vmap(one_roi)(batch_idx, y1, x1, bs_h, bs_w, grid_h, grid_w)
 
@@ -214,6 +222,9 @@ def psroi_pooling(data, rois, *, spatial_scale, output_dim, pooled_size, group_s
     B, C, H, W = data.shape
     f32 = data.dtype
     OD = int(output_dim)
+    # bin-boundary math always fp32 (see roi_pooling note)
+    cf = jnp.float32
+    rois = rois.astype(cf)
 
     batch_idx = rois[:, 0].astype(jnp.int32)
     xs = jnp.round(rois[:, 1]) * spatial_scale
@@ -225,8 +236,8 @@ def psroi_pooling(data, rois, *, spatial_scale, output_dim, pooled_size, group_s
     bs_h = roi_h / PH
     bs_w = roi_w / PW
 
-    ph = jnp.arange(PH, dtype=f32)
-    pw = jnp.arange(PW, dtype=f32)
+    ph = jnp.arange(PH, dtype=cf)
+    pw = jnp.arange(PW, dtype=cf)
     hstart = jnp.clip(jnp.floor(ph[None, :] * bs_h[:, None] + ys[:, None]).astype(jnp.int32), 0, H)
     hend = jnp.clip(jnp.ceil((ph[None, :] + 1) * bs_h[:, None] + ys[:, None]).astype(jnp.int32), 0, H)
     wstart = jnp.clip(jnp.floor(pw[None, :] * bs_w[:, None] + xs[:, None]).astype(jnp.int32), 0, W)
@@ -256,10 +267,11 @@ def psroi_pooling(data, rois, *, spatial_scale, output_dim, pooled_size, group_s
         return s_all[cin, p_idx, q_idx]  # (OD, PH, PW)
 
     out = jax.vmap(one)(batch_idx, mask_h, mask_w)  # (R, OD, PH, PW)
-    cnt_h = (hend - hstart)[:, None, :, None].astype(f32)
-    cnt_w = (wend - wstart)[:, None, None, :].astype(f32)
+    cnt_h = (hend - hstart)[:, None, :, None].astype(cf)
+    cnt_w = (wend - wstart)[:, None, None, :].astype(cf)
     area = cnt_h * cnt_w
-    return jnp.where(area > 0, out / jnp.maximum(area, 1.0), jnp.zeros((), f32))
+    return jnp.where(area > 0, out.astype(cf) / jnp.maximum(area, 1.0),
+                     jnp.zeros((), cf)).astype(f32)
 
 
 # ---------------------------------------------------------------------------
@@ -281,6 +293,7 @@ def deformable_psroi_pooling(
     sample_per_part=4,
     trans_std=0.0,
     no_trans=False,
+    rois_per_image=0,
 ):
     """Deformable position-sensitive ROI pooling (Deformable R-FCN).
 
@@ -289,6 +302,22 @@ def deformable_psroi_pooling(
     sample_per_part × sample_per_part grid of bilinear samples, shifted by
     ``trans`` offsets (scaled by trans_std and roi size); samples outside
     (−0.5, size−0.5) are dropped; output is sum / live-count (0 if none).
+
+    ``rois_per_image`` (static, optional): caller's guarantee that rois are
+    batch-major grouped — roi r belongs to image r // rois_per_image (the
+    MultiProposal / proposal_target layout).  Enables the block-diagonal
+    batched formulation: the one-hot accumulation matrix becomes
+    (B, R/B, H·W) instead of (R, B·H·W), cutting the A-matrix build and
+    the MXU matmuls from O(B²) to O(B).  This was the batch>1 scaling
+    killer at north-star shapes (roofline: batch 4 measured 2.2× the HBM
+    bound with the ungrouped form).
+
+    WARNING: the grouped path TRUSTS this layout and ignores the rois'
+    batch_idx column — interleaved or shuffled rois with ``rois_per_image``
+    set silently pool from the wrong image (a traced value can't be
+    asserted).  Only pass it when the rois come straight from
+    MultiProposal/proposal_target or an equivalently grouped source; a
+    value that doesn't divide R falls back to the general path.
     """
     PH = PW = int(pooled_size)
     group = int(group_size)
@@ -372,66 +401,104 @@ def deformable_psroi_pooling(
     cnt = lf.sum(axis=(4, 5))[..., None]  # (R, K, PH, PW, 1)
 
     spp2 = spp * spp
+    Rb = int(rois_per_image)
+    grouped = Rb > 0 and R == B * Rb
     if R * K * PH * PW * spp2 * ch_per_class >= (1 << 16):
-        # -- one-hot matmul path (TPU hot path) ---------------------------
-        # Per bin (k, ph, pw): accumulate the 4 live-masked bilinear corner
-        # weights of every (roi, sample) into a dense (R, B·H·W) matrix and
-        # multiply by that bin's flattened plane.  Both forward and the AD
-        # transpose are MXU matmuls — no gather OR scatter touches HBM.
-        # (The scatter-add XLA derives from a gather formulation measured
-        # ~580 ms/step at north-star shapes; this path is ~2 orders less.)
-        w00 = ((1 - ly) * (1 - lx) * lf).reshape(R, K, PH, PW, spp2)
-        w01 = ((1 - ly) * lx * lf).reshape(R, K, PH, PW, spp2)
-        w10 = (ly * (1 - lx) * lf).reshape(R, K, PH, PW, spp2)
-        w11 = (ly * lx * lf).reshape(R, K, PH, PW, spp2)
-        bhw = B * H * W
-        base = batch_idx[:, None, None, None, None] * (H * W)
-        p00 = (base + y0.reshape(R, K, PH, PW, spp2) * W + x0.reshape(R, K, PH, PW, spp2))
-        p01 = (base + y0.reshape(R, K, PH, PW, spp2) * W + x1.reshape(R, K, PH, PW, spp2))
-        p10 = (base + y1.reshape(R, K, PH, PW, spp2) * W + x0.reshape(R, K, PH, PW, spp2))
-        p11 = (base + y1.reshape(R, K, PH, PW, spp2) * W + x1.reshape(R, K, PH, PW, spp2))
+        # -- separable one-hot matmul path (TPU hot path) -----------------
+        # Per bin (k, ph, pw): accumulate every (roi, sample)'s live-masked
+        # bilinear footprint into a dense accumulation matrix A and multiply
+        # by that bin's flattened plane.  Both directions are MXU matmuls —
+        # no gather OR scatter touches HBM (the scatter-add XLA derives from
+        # a gather formulation measured ~580 ms/step at north-star shapes).
+        #
+        # The 4-corner footprint is SEPARABLE:
+        #   Σ_corners w_c·e(y_c,x_c) = [(1−ly)e_{y0}+ly·e_{y1}] ⊗
+        #                              [(1−lx)e_{x0}+lx·e_{x1}]
+        # so A[r] = Σ_s yv[r,s,:] ⊗ xv[r,s,:] — a rank-spp2 outer-product
+        # batch matmul.  One-hot compares run over H and W separately
+        # (~(H+W)/(H·W)·¼ of the fused-compare cost that profiled as ~70
+        # ms/step of VPU time at batch 4) and the contraction rides the MXU.
+        # Grouped (batch-major) rois additionally make the plane matmul
+        # block-diagonal: (B, Rb, H·W) per-image blocks instead of one
+        # (R, B·H·W) matrix — O(B), not O(B²), in batch.
+        hw = H * W
+        bhw = B * hw
+        NB = K * PH * PW
 
-        # bins axis: (K, PH, PW) -> NB; planes per bin from the channel map
-        def to_bins(a):  # (R, K, PH, PW, spp2) -> (NB, R, spp2)
-            return a.transpose(1, 2, 3, 0, 4).reshape(K * PH * PW, R, spp2)
+        if grouped:
+            def to_bins(a, dt):  # (R=B·Rb,K,PH,PW,spp,spp) -> (NB,B,Rb,spp2)
+                return (a.astype(dt).reshape(B, Rb, K, PH, PW, spp2)
+                        .transpose(2, 3, 4, 0, 1, 5).reshape(NB, B, Rb, spp2))
+        else:
+            def to_bins(a, dt):  # -> (NB, R, spp2)
+                return (a.astype(dt).reshape(R, K, PH, PW, spp2)
+                        .transpose(1, 2, 3, 0, 4).reshape(NB, R, spp2))
 
-        ws = jnp.stack([to_bins(w) for w in (w00, w01, w10, w11)], axis=1)  # (NB,4,R,spp2)
-        ps = jnp.stack([to_bins(p) for p in (p00, p01, p10, p11)], axis=1)
-        # (B, K, g2, H, W, cpc) -> per-bin flattened planes (NB, B·H·W, cpc)
+        # ungrouped: the batch offset rides in the row index (gy = b·H + y,
+        # flat position gy·W + x ≡ b·hw + y·W + x — matches plane layout)
+        yoff = (0 if grouped
+                else batch_idx[:, None, None, None, None, None] * H)
+        ybins0 = to_bins(y0 + yoff, jnp.int32)
+        ybins1 = to_bins(y1 + yoff, jnp.int32)
+        xbins0 = to_bins(x0, jnp.int32)
+        xbins1 = to_bins(x1, jnp.int32)
+        lybins = to_bins(ly, f32)
+        lxbins = to_bins(lx, f32)
+        lfbins = to_bins(lf, f32)
+
+        # per-bin flattened planes from the position-sensitive channel map:
+        # grouped (NB, B, H·W, cpc), ungrouped (NB, B·H·W, cpc)
         kb = np.repeat(np.arange(K), PH * PW)
         gb = np.tile(np.asarray(ghs[:, None] * group + gws[None, :]).reshape(-1), K)
-        planes = datag.transpose(1, 2, 0, 3, 4, 5).reshape(K, g2, bhw, ch_per_class)
-        planes = planes[kb, gb]  # (NB, bhw, cpc)
+        planes = datag.transpose(1, 2, 0, 3, 4, 5).reshape(K, g2, B, hw, ch_per_class)
+        planes = planes[kb, gb]  # (NB, B, hw, cpc)
+        if not grouped:
+            # B already precedes hw, so the flat index stays b·hw + y·W + x
+            planes = planes.reshape(NB, bhw, ch_per_class)
 
-        iota = jnp.arange(bhw, dtype=jnp.int32)
+        iota_y = jnp.arange(H if grouped else B * H, dtype=jnp.int32)
+        iota_x = jnp.arange(W, dtype=jnp.int32)
+        # fp32 inputs must not silently drop to the TPU's default bf16
+        # matmul passes (~5e-3 pooled-score error, measured); the A-build
+        # einsum always runs HIGHEST — its cost is trivial and the old
+        # compare-select formulation accumulated exactly in f32
+        prec = (jax.lax.Precision.HIGHEST
+                if datag.dtype == jnp.float32 else None)
 
-        # remat: without it, AD saves each bin's (R, spp2, bhw) comparison
-        # mask as a residual (~1 GB over 49 bins at north-star shapes);
-        # rebuilding A in the backward is a handful of fused element ops
+        # remat: without it, AD saves each bin's A (and yv/xv) as residuals
+        # (~0.5 GB over 49 bins at north-star shapes); rebuilding them in
+        # the backward is a handful of fused element ops + tiny matmuls
         @jax.checkpoint
         def one_bin(args):
-            w4, p4, plane = args  # (4, R, spp2), (4, R, spp2), (bhw, cpc)
-            # A[r, p] = Σ_corners Σ_samples w·[pos == p]; the (R, spp2, bhw)
-            # comparison broadcast fuses into the reduction (never stored)
-            a = sum(
-                jnp.sum(jnp.where(p4[c][..., None] == iota, w4[c][..., None],
-                                  jnp.zeros((), f32)), axis=1)
-                for c in range(4)
-            )  # (R, bhw)
-            # fp32 inputs must not silently drop to the TPU's default bf16
-            # matmul passes (~5e-3 pooled-score error, measured)
-            prec = (jax.lax.Precision.HIGHEST
-                    if datag.dtype == jnp.float32 else None)
+            yb0, yb1, xb0, xb1, lyb, lxb, lfb, plane = args
+            yv = ((1.0 - lyb)[..., None] * (yb0[..., None] == iota_y)
+                  + lyb[..., None] * (yb1[..., None] == iota_y))
+            xv = lfb[..., None] * (
+                (1.0 - lxb)[..., None] * (xb0[..., None] == iota_x)
+                + lxb[..., None] * (xb1[..., None] == iota_x))
+            if grouped:
+                # (B,Rb,spp2,H) ⊗ (B,Rb,spp2,W) -> (B,Rb,hw) block-diagonal
+                a = jnp.einsum("brsh,brsw->brhw", yv, xv,
+                               precision=jax.lax.Precision.HIGHEST)
+                a = a.reshape(a.shape[0], a.shape[1], hw)
+                return jnp.einsum("brp,bpc->brc", a.astype(datag.dtype),
+                                  plane, precision=prec)
+            a = jnp.einsum("rsh,rsw->rhw", yv, xv,
+                           precision=jax.lax.Precision.HIGHEST)
+            a = a.reshape(a.shape[0], bhw)
             return jnp.matmul(a.astype(datag.dtype), plane, precision=prec)
 
         # scan with unroll: one_bin per bin, but 7 bins inline per loop
-        # iteration — sequential depth NB/7 instead of NB (the three pool
-        # calls' fwd+bwd map-loops measured ~17 ms/step of the fused
-        # detection step at north-star shapes)
+        # iteration — sequential depth NB/7 instead of NB
         _, s = jax.lax.scan(
-            lambda _, args: (None, one_bin(args)), None, (ws, ps, planes),
-            unroll=7)  # (NB, R, cpc)
-        s = s.reshape(K, PH, PW, R, ch_per_class).transpose(3, 0, 1, 2, 4)
+            lambda _, args: (None, one_bin(args)), None,
+            (ybins0, ybins1, xbins0, xbins1, lybins, lxbins, lfbins, planes),
+            unroll=7)  # grouped (NB, B, Rb, cpc) / ungrouped (NB, R, cpc)
+        if grouped:
+            s = (s.reshape(K, PH, PW, B, Rb, ch_per_class)
+                 .transpose(3, 4, 0, 1, 2, 5).reshape(R, K, PH, PW, ch_per_class))
+        else:
+            s = s.reshape(K, PH, PW, R, ch_per_class).transpose(3, 0, 1, 2, 4)
     else:
         # -- gather path (small problems / CPU) ---------------------------
         # batch index rides in the gather (a vmapped ``data[b]`` would

@@ -563,7 +563,9 @@ def test_deformable_psroi_grouped_path_matches_ungrouped():
     roisj = jnp.asarray(rois)
     kw = dict(spatial_scale=1 / 8, output_dim=OD, group_size=g,
               pooled_size=3, part_size=3, trans_std=0.1)
-    # R*K*PH*PW*spp2*cpc = 120*1*9*16*2 >= 1<<16 -> both runs take matmul path
+    # R*K*PH*PW*spp2*cpc = 120*1*9*16*6 = 103,680 >= 1<<16 = 65,536 -> both
+    # runs take the matmul path (shrinking OD below 4 would drop under the
+    # threshold and test the gather path vacuously)
     plain = D.deformable_psroi_pooling(data, roisj, trans, **kw)
     grouped = D.deformable_psroi_pooling(data, roisj, trans,
                                          rois_per_image=Rb, **kw)
