@@ -25,6 +25,7 @@ NIGHTLY_FILES=(
   tests/test_examples_misc.py
   tests/test_examples_nce_fcn_svm.py
   tests/test_example_deformable_rfcn.py
+  tests/test_examples_round3.py
 )
 
 tier="${1:-unit}"

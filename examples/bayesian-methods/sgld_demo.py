@@ -1,0 +1,83 @@
+"""SGLD posterior sampling — reference ``example/bayesian-methods/``
+(``sgld.ipynb`` + ``bdk_demo.py`` run_synthetic_SGLD: the Welling & Teh
+2011 mixture-posterior experiment).
+
+Same experiment, TPU-idiomatic: the gaussian-mixture log-posterior gradient
+is plain autograd on a jit-able loss (the reference hand-codes it in numpy,
+``bdk_demo.py synthetic_grad:119``), and SGLD's injected noise comes from
+the framework optimizer (``mx.optimizer.SGLD``).  The sampled θ₁ histogram
+must recover BOTH posterior modes — the property the paper's figure shows.
+
+Run: ./dev.sh python examples/bayesian-methods/sgld_demo.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+SIGMA1, SIGMA2, SIGMAX = 10.0, 1.0, 2.0
+
+
+def make_data(n=100, seed=10):
+    """x ~ ½N(θ₁,σx²)+½N(θ₁+θ₂,σx²) at true θ=(0,1) (Welling&Teh §5.1)."""
+    rng = np.random.RandomState(seed)
+    comp = rng.rand(n) < 0.5
+    x = np.where(comp, rng.randn(n) * SIGMAX + 0.0,
+                 rng.randn(n) * SIGMAX + 1.0)
+    return x.astype(np.float32)
+
+
+def neg_log_posterior(theta, xb, n_total):
+    """−log p(θ)·scale − Σ log p(x|θ), minibatch-rescaled (the SGLD
+    gradient target; reference synthetic_grad)."""
+    t1, t2 = theta[0], theta[1]
+    lik1 = nd.exp(-0.5 * ((xb - t1) ** 2) / SIGMAX ** 2)
+    lik2 = nd.exp(-0.5 * ((xb - t1 - t2) ** 2) / SIGMAX ** 2)
+    log_lik = nd.log(0.5 * lik1 + 0.5 * lik2 + 1e-12).sum()
+    log_prior = (-0.5 * (t1 ** 2) / SIGMA1 ** 2
+                 - 0.5 * (t2 ** 2) / SIGMA2 ** 2)
+    batch = xb.shape[0]
+    return -(log_prior + (n_total / batch) * log_lik)
+
+
+def main(n_samples=12000, batch=10, seed=0, burn_in=2000):
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    X = make_data()
+    n = len(X)
+    theta = nd.array(np.array([0.1, 0.1], np.float32))
+    theta.attach_grad()
+    # polynomial step-size decay a(b+t)^-γ as in the paper/reference
+    opt = mx.optimizer.create("sgld", learning_rate=0.05,
+                              lr_scheduler=mx.lr_scheduler.PolyScheduler(
+                                  max_update=n_samples, base_lr=0.05,
+                                  final_lr=0.0001, pwr=0.55))
+    samples = []
+    for t in range(n_samples):
+        idx = np.random.randint(0, n, batch)
+        xb = nd.array(X[idx])
+        with autograd.record():
+            loss = neg_log_posterior(theta, xb, n)
+        loss.backward()
+        opt.update(0, theta, theta.grad, None)
+        if t >= burn_in:
+            samples.append(theta.asnumpy().copy())
+    S = np.asarray(samples)
+    # the θ₁ posterior is bimodal (modes near 0 and ~1): both must be hit
+    lo = float((S[:, 0] < 0.4).mean())
+    hi = float((S[:, 0] > 0.6).mean())
+    print("sgld: %d samples, theta1 mass below 0.4: %.2f, above 0.6: %.2f "
+          "(bimodal => both > 0.05)" % (len(S), lo, hi))
+    return S
+
+
+if __name__ == "__main__":
+    main()

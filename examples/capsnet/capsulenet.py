@@ -1,0 +1,152 @@
+"""CapsNet (Dynamic Routing Between Capsules) — reference
+``example/capsnet/{capsulenet.py,capsulelayers.py}``.
+
+The reference builds squash / primary-caps / routing as symbol-graph
+helpers with the 3-iteration routing loop unrolled into the symbol graph
+(capsulelayers.py CapsuleLayer.__call__).  Here the same three pieces are
+Gluon HybridBlocks whose routing loop is a STATIC Python unroll inside
+``hybrid_forward`` — jit sees a fixed 3-step dataflow (routing logits are
+recomputed, never carried as Python state), so the whole net compiles to
+one XLA module.  Margin loss matches capsulenet.py:L? (m+ 0.9, m− 0.1,
+λ 0.5).
+
+Run: ./dev.sh python examples/capsnet/capsulenet.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+def squash(F, s, axis):
+    """v = |s|²/(1+|s|²) · s/|s| (reference capsulelayers.py squash)."""
+    sq = F.sum(F.square(s), axis=axis, keepdims=True)
+    return F.broadcast_mul(s, sq / (1.0 + sq) / F.sqrt(sq + 1e-9))
+
+
+class PrimaryCaps(gluon.HybridBlock):
+    """Conv -> (B, n_caps, dim) capsules, squashed (primary_caps)."""
+
+    def __init__(self, dim_vector=8, n_channels=8, kernel=3, stride=2, **kw):
+        super().__init__(**kw)
+        self.dim = dim_vector
+        with self.name_scope():
+            self.conv = nn.Conv2D(dim_vector * n_channels, kernel, stride)
+
+    def hybrid_forward(self, F, x):
+        out = self.conv(x)  # (B, dim*ch, H, W)
+        out = F.Reshape(out, shape=(0, -1, self.dim))
+        return squash(F, out, axis=2)
+
+
+class DigitCaps(gluon.HybridBlock):
+    """Fully-connected capsule layer with dynamic routing (CapsuleLayer).
+
+    W: (in_caps, out_caps, in_dim, out_dim).  Routing: 3 iterations of
+    softmax(b) coupling -> weighted sum -> squash -> agreement update, the
+    loop statically unrolled (XLA-friendly; the reference unrolls into the
+    symbol graph the same way).
+    """
+
+    def __init__(self, in_caps, out_caps=10, in_dim=8, out_dim=16,
+                 num_routing=3, **kw):
+        super().__init__(**kw)
+        self.nr = int(num_routing)
+        self.ic, self.idim = in_caps, in_dim
+        self.oc, self.od = out_caps, out_dim
+        with self.name_scope():
+            self.w = self.params.get(
+                "weight", shape=(in_caps, out_caps, in_dim, out_dim),
+                init=mx.init.Normal(0.1))
+
+    def hybrid_forward(self, F, x, w):
+        # u_hat[b,i,j,d'] = Σ_d x[b,i,d]·W[i,j,d,d'] — broadcast-and-reduce
+        # (XLA fuses this into a batched contraction; B·in·out·8·16 floats)
+        x5 = F.Reshape(x, shape=(-1, self.ic, 1, self.idim, 1))
+        w5 = F.Reshape(w, shape=(1, self.ic, self.oc, self.idim, self.od))
+        u_hat = F.sum(F.broadcast_mul(x5, w5), axis=3)  # (B, in, out, od)
+        # routing by agreement; coupling logits recomputed functionally
+        b_ij = F.zeros_like(F.slice_axis(u_hat, axis=3, begin=0, end=1))
+        b_ij = F.Reshape(b_ij, shape=(0, 0, -1))  # (B, in, out)
+        u_nograd = F.BlockGrad(u_hat)
+        for it in range(self.nr):
+            c = F.softmax(b_ij, axis=2)  # coupling over out-caps
+            # last iteration lets gradients flow through u_hat (reference
+            # routes on stop-gradient predictions except the final pass)
+            u = u_hat if it == self.nr - 1 else u_nograd
+            s = F.sum(F.broadcast_mul(u, F.Reshape(c, shape=(0, 0, 0, 1))),
+                      axis=1)  # (B, out, od)
+            v = squash(F, s, axis=2)
+            if it < self.nr - 1:
+                v4 = F.Reshape(v, shape=(0, 1, -1, self.od))  # (B,1,out,od)
+                b_ij = b_ij + F.sum(F.broadcast_mul(u_nograd, v4), axis=3)
+        return v  # (B, out_caps, out_dim)
+
+
+class CapsNet(gluon.HybridBlock):
+    def __init__(self, classes=10, in_caps=None, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.conv1 = nn.Conv2D(64, 3, 1, activation="relu")
+            self.primary = PrimaryCaps(dim_vector=8, n_channels=8)
+            self.digit = DigitCaps(in_caps=in_caps, out_caps=classes)
+
+    def hybrid_forward(self, F, x):
+        v = self.digit(self.primary(self.conv1(x)))
+        # class scores are capsule lengths
+        return F.sqrt(F.sum(F.square(v), axis=2) + 1e-9)
+
+
+def margin_loss(F, lengths, y, classes, m_pos=0.9, m_neg=0.1, lam=0.5):
+    """L = T·max(0, m+−|v|)² + λ(1−T)·max(0, |v|−m−)² (capsulenet.py)."""
+    t = F.one_hot(y, classes)
+    pos = F.square(F.maximum(0.0, m_pos - lengths))
+    neg = F.square(F.maximum(0.0, lengths - m_neg))
+    return F.sum(t * pos + lam * (1.0 - t) * neg, axis=1)
+
+
+def main(epochs=12, batch=64, lr=0.002, seed=0):
+    from sklearn.datasets import load_digits
+    from sklearn.model_selection import train_test_split
+
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    X, y = load_digits(return_X_y=True)
+    X = (X.astype(np.float32) / 16.0).reshape(-1, 1, 8, 8)
+    Xtr, Xte, ytr, yte = train_test_split(X, y.astype(np.float32),
+                                          test_size=0.25, random_state=seed,
+                                          stratify=y)
+    # 8x8 input -> conv1 (3x3) 6x6 -> primary (3x3 s2) 2x2 x 8ch = 32 caps
+    net = CapsNet(classes=10, in_caps=32)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": lr})
+    import mxnet_tpu.ndarray as F
+
+    n = len(Xtr)
+    for ep in range(epochs):
+        perm = np.random.permutation(n)
+        for s in range(0, n - batch + 1, batch):
+            idx = perm[s:s + batch]
+            xb, yb = nd.array(Xtr[idx]), nd.array(ytr[idx])
+            with autograd.record():
+                lengths = net(xb)
+                loss = margin_loss(F, lengths, yb, 10).mean()
+            loss.backward()
+            trainer.step(batch)
+    preds = np.argmax(net(nd.array(Xte)).asnumpy(), axis=1)
+    acc = float((preds == yte).mean())
+    print("capsnet: test acc %.4f (3-iteration dynamic routing)" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""SparseSGD — DSD (Dense-Sparse-Dense) pruning optimizer, reference
+``example/dsd/sparse_sgd.py``.
+
+Same contract as the reference: an SGD whose per-weight masks prune the
+smallest-|w| entries (by sparsity percentage, via topk-mask semantics) or
+everything under a threshold, applied to weight, grad and momentum each
+update; the schedule switches sparsity levels at ``pruning_switch_epoch``
+boundaries (epochs counted per-index from ``batches_per_epoch``, the
+reference's bookkeeping).  Masks recompute once per phase switch and stay
+fixed until the next one — dense phases use sparsity/threshold 0 (no mask).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from mxnet_tpu import nd
+from mxnet_tpu.optimizer import SGD, register
+
+
+@register
+class SparseSGD(SGD):
+    def __init__(self, pruning_switch_epoch, batches_per_epoch,
+                 weight_sparsity=None, bias_sparsity=None,
+                 weight_threshold=None, bias_threshold=None, **kwargs):
+        super().__init__(**kwargs)
+        self.pruning_switch_epoch = list(pruning_switch_epoch)
+        self.batches_per_epoch = int(batches_per_epoch)
+        self.weight_sparsity = weight_sparsity
+        self.bias_sparsity = bias_sparsity
+        self.weight_threshold = weight_threshold
+        self.bias_threshold = bias_threshold
+        if weight_sparsity is not None:
+            assert len(weight_sparsity) == len(bias_sparsity), \
+                "weight and bias sparsity lists must pair up"
+        else:
+            assert len(weight_threshold) == len(bias_threshold), \
+                "weight and bias threshold lists must pair up"
+        self.masks = {}        # index -> mask NDArray or None (dense)
+        self._mask_phase = {}  # index -> phase the mask was built for
+        self._steps = {}       # index -> update count
+        self.mask_history = {}  # (index, phase) -> pruned fraction
+
+    # -- schedule ---------------------------------------------------------
+    def _phase_of(self, index):
+        """Phase = how many switch epochs this index's training has passed
+        (reference pruning_switch_epoch, ascending)."""
+        epoch = self._steps.get(index, 0) // self.batches_per_epoch
+        phase = 0
+        for e in self.pruning_switch_epoch:
+            if epoch >= e:
+                phase += 1
+        return phase
+
+    def _mask_for(self, phase, weight):
+        levels = self.weight_sparsity or self.weight_threshold
+        phase = min(phase, len(levels) - 1)
+        is_bias = weight.ndim == 1
+        w = np.abs(weight.asnumpy())
+        if self.weight_sparsity is not None:
+            sparsity = float((self.bias_sparsity if is_bias
+                              else self.weight_sparsity)[phase])
+            keep = int(round(w.size * (100.0 - sparsity) / 100.0))
+            if keep >= w.size:
+                return None  # dense phase
+            if keep == 0:
+                return nd.array(np.zeros_like(w, np.float32))
+            # keep the largest-|w| entries (reference topk ret_typ='mask')
+            cut = np.partition(w.ravel(), w.size - keep)[w.size - keep]
+            mask = (w >= cut).astype(np.float32)
+        else:
+            thr = float((self.bias_threshold if is_bias
+                         else self.weight_threshold)[phase])
+            if thr <= 0:
+                return None
+            mask = (w >= thr).astype(np.float32)
+        return nd.array(mask)
+
+    # -- update -----------------------------------------------------------
+    def update(self, index, weight, grad, state):
+        self._steps[index] = self._steps.get(index, 0) + 1
+        phase = self._phase_of(index)
+        if self._mask_phase.get(index) != phase:
+            self.masks[index] = self._mask_for(phase, weight)
+            self._mask_phase[index] = phase
+            m = self.masks[index]
+            self.mask_history[(index, phase)] = (
+                0.0 if m is None else 1.0 - float(m.asnumpy().mean()))
+        mask = self.masks.get(index)
+        if mask is not None:
+            weight *= mask
+            grad = grad * mask
+            if state is not None:
+                state *= mask
+        super().update(index, weight, grad, state)
+
+    @staticmethod
+    def sparsity_of(weight):
+        w = weight.asnumpy()
+        return float((w == 0).mean())

@@ -1,0 +1,147 @@
+"""DeepSpeech-style speech recognition — reference
+``example/speech_recognition/`` (``arch_deepspeech.py``: conv front-end
+over spectrograms + stacked bidirectional recurrent layers + CTC, trained
+through a bucketing module over variable utterance lengths,
+``stt_bucketing_module.py``).
+
+TPU-native shape of the same design: a Gluon net (Conv2D front-end ×
+BiGRU stack × per-frame vocab head) trained with ``gluon.loss.CTCLoss``
+using EXPLICIT pred/label lengths — utterances are bucketed to a few
+static padded lengths, so jit compiles once per bucket (the reference's
+BucketingModule served the same purpose for cuDNN kernels).  Data is a
+synthetic phone-to-spectrogram generator (no egress): each token emits a
+variable-width band pattern, unaligned — the CTC problem.
+
+Run: ./dev.sh python examples/speech_recognition/deepspeech.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn, rnn
+
+VOCAB = 6           # tokens 1..6; 0 reserved (blank rides as class VOCAB)
+NFREQ = 16          # spectrogram bins
+BUCKETS = (24, 36)  # padded utterance lengths (frames)
+
+
+def synth_utterances(rng, n, max_tokens=5):
+    """Token sequences → unaligned spectrogram band runs, bucketed."""
+    data = {b: [] for b in BUCKETS}
+    for _ in range(n):
+        ntok = rng.randint(2, max_tokens + 1)
+        toks = rng.randint(1, VOCAB + 1, ntok)
+        frames = []
+        for t in toks:
+            w = rng.randint(3, 7)
+            f = np.zeros((w, NFREQ), np.float32)
+            band = (t - 1) * 2
+            f[:, band:band + 3] = 1.0
+            frames.append(f)
+        utt = np.concatenate(frames, axis=0)
+        T = len(utt)
+        b = next((b for b in BUCKETS if T <= b), None)
+        if b is None:
+            continue
+        x = np.zeros((b, NFREQ), np.float32)
+        x[:T] = utt
+        lab = np.zeros((max_tokens,), np.float32)
+        lab[:ntok] = toks
+        data[b].append((x, T, lab, ntok))
+    out = {}
+    for b, rows in data.items():
+        if not rows:
+            continue
+        X = np.stack([r[0] for r in rows]) + 0.1 * rng.randn(
+            len(rows), b, NFREQ).astype(np.float32)
+        out[b] = (X, np.array([r[1] for r in rows], np.float32),
+                  np.stack([r[2] for r in rows]),
+                  np.array([r[3] for r in rows], np.float32))
+    return out
+
+
+class DeepSpeechNet(gluon.Block):
+    """Conv front-end + BiGRU stack + vocab head (arch_deepspeech.py
+    topology at toy scale)."""
+
+    def __init__(self, hidden=64, layers=2, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.conv = nn.Conv2D(8, (5, 5), strides=(1, 1), padding=(2, 2),
+                                  activation="relu")
+            self.birnn = rnn.GRU(hidden, num_layers=layers,
+                                 bidirectional=True, layout="NTC")
+            self.head = nn.Dense(VOCAB + 1, flatten=False)  # +1 CTC blank
+
+    def forward(self, x):  # x (N, T, F)
+        c = self.conv(x.expand_dims(1))            # (N, 8, T, F)
+        c = c.transpose((0, 2, 1, 3)).reshape((0, 0, -1))  # (N, T, 8F)
+        h = self.birnn(c)                          # (N, T, 2H)
+        return self.head(h)                        # (N, T, V+1)
+
+
+def greedy_decode(logits, lengths):
+    ids = logits.asnumpy().argmax(-1)
+    out = []
+    for row, T in zip(ids, lengths.astype(int)):
+        seq, prev = [], -1
+        for t in row[:T]:
+            if t != prev and t != VOCAB:  # collapse repeats, drop blank
+                seq.append(int(t) + 1)    # head class i ↦ token i+1
+            prev = t
+        out.append(seq)
+    return out
+
+
+def main(steps=160, batch=16, lr=0.02, seed=0):
+    mx.random.seed(seed)
+    rng = np.random.RandomState(seed)
+    train = synth_utterances(rng, 400)
+    test = synth_utterances(np.random.RandomState(seed + 1), 80)
+
+    net = DeepSpeechNet()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": lr})
+    # labels are 1..V; CTCLoss blank_label='last' expects classes 0..V-1
+    # with blank V — shift labels down by 1 at the loss boundary
+    ctc = gluon.loss.CTCLoss(layout="NTC", label_layout="NT")
+    buckets = sorted(train)
+    losses = []
+    for s in range(steps):
+        b = buckets[s % len(buckets)]
+        X, TL, Y, YL = train[b]
+        idx = rng.randint(0, len(X), min(batch, len(X)))
+        xb = nd.array(X[idx])
+        with autograd.record():
+            logits = net(xb)
+            loss = ctc(logits, nd.array(Y[idx] - 1.0),
+                       nd.array(TL[idx]), nd.array(YL[idx])).mean()
+        loss.backward()
+        trainer.step(len(idx))
+        losses.append(float(loss.asnumpy()))
+
+    # token accuracy via greedy decode on held-out utterances
+    correct = total = 0
+    for b, (X, TL, Y, YL) in sorted(test.items()):
+        dec = greedy_decode(net(nd.array(X)), TL)
+        for d, y, L in zip(dec, Y, YL.astype(int)):
+            ref = [int(v) for v in y[:L]]
+            total += L
+            correct += sum(1 for a, r in zip(d, ref) if a == r)
+    acc = correct / max(total, 1)
+    print("deepspeech: ctc loss %.3f -> %.3f, greedy token acc %.3f "
+          "(buckets %s)" % (np.mean(losses[:10]), np.mean(losses[-10:]),
+                            acc, buckets))
+    return np.asarray(losses), acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,124 @@
+"""Stochastic-depth residual training — reference
+``example/stochastic-depth/{sd_module.py,sd_cifar10.py}``.
+
+The reference implements stochastic depth as a custom ``BaseModule``
+subclass that coin-flips per forward whether to execute the compute branch
+(sd_module.py StochasticDepthModule) and chains 100+ of them in a
+SequentialModule, with a linearly-decaying death schedule
+(sd_cifar10.py: death_rate ramps 0 → 0.5 with depth).
+
+TPU-native redesign: a branch that vanishes at runtime is a dynamic graph —
+hostile to XLA.  Instead the whole-batch survival gate IS a one-scalar
+Dropout (axes=all ⇒ a single Bernoulli decision scaled by 1/(1−p)): the
+graph stays static, the gate compiles into the fused step, and expectation
+matches the reference's test-time (1−death_rate) scaling.  The schedule and
+the residual topology mirror sd_cifar10.py.
+
+Run: ./dev.sh python examples/stochastic-depth/sd_cifar10.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+class StochasticDepthBlock(gluon.HybridBlock):
+    """Residual unit whose compute branch dies with ``death_rate`` per batch
+    (one Bernoulli for the whole batch, as the reference's per-forward coin
+    flip): out = skip(x) + SurvivalGate(branch(x))."""
+
+    def __init__(self, channels, death_rate, downsample=False, **kw):
+        super().__init__(**kw)
+        self.death_rate = float(death_rate)
+        stride = 2 if downsample else 1
+        with self.name_scope():
+            self.body = nn.HybridSequential(prefix="body_")
+            self.body.add(nn.Conv2D(channels, 3, stride, 1, use_bias=False),
+                          nn.BatchNorm(), nn.Activation("relu"),
+                          nn.Conv2D(channels, 3, 1, 1, use_bias=False),
+                          nn.BatchNorm())
+            self.sc = (nn.Conv2D(channels, 1, stride, use_bias=False)
+                       if downsample else None)
+
+    def hybrid_forward(self, F, x):
+        branch = self.body(x)
+        if self.death_rate >= 1.0:
+            # fully dead: identity block (1/(1-p) scaling is degenerate)
+            branch = F.zeros_like(branch)
+        elif self.death_rate > 0:
+            # axes over every dim -> shape-(1,1,1,1) Bernoulli: the whole
+            # branch survives or dies together, pre-scaled by 1/(1-p) so
+            # inference needs no rescale (same expectation as the
+            # reference's test-time (1-death_rate) multiply)
+            branch = F.Dropout(branch, p=self.death_rate, axes=(0, 1, 2, 3))
+        skip = self.sc(x) if self.sc is not None else x
+        return F.Activation(branch + skip, act_type="relu")
+
+
+def build_net(classes=10, blocks_per_stage=(3, 3), channels=(16, 32),
+              death_mode="linear_decay", death_rate=0.5):
+    """Linear-decay death schedule over depth (sd_cifar10.py:120-133:
+    block i of L dies with rate i/L * death_rate; 'uniform' uses the flat
+    rate everywhere)."""
+    net = nn.HybridSequential()
+    total = sum(blocks_per_stage)
+    i = 0
+    with net.name_scope():
+        net.add(nn.Conv2D(channels[0], 3, 1, 1, use_bias=False),
+                nn.BatchNorm(), nn.Activation("relu"))
+        for s, (nb, ch) in enumerate(zip(blocks_per_stage, channels)):
+            for b in range(nb):
+                rate = (death_rate * (i + 1) / total
+                        if death_mode == "linear_decay" else death_rate)
+                net.add(StochasticDepthBlock(ch, rate,
+                                             downsample=(b == 0 and s > 0)))
+                i += 1
+        net.add(nn.GlobalAvgPool2D(), nn.Dense(classes))
+    return net
+
+
+def main(epochs=14, batch=64, lr=0.1, seed=0, death_rate=0.5):
+    from sklearn.datasets import load_digits
+    from sklearn.model_selection import train_test_split
+
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    X, y = load_digits(return_X_y=True)
+    X = (X.astype(np.float32) / 16.0).reshape(-1, 1, 8, 8)
+    Xtr, Xte, ytr, yte = train_test_split(X, y.astype(np.float32),
+                                          test_size=0.25, random_state=seed,
+                                          stratify=y)
+    net = build_net(classes=10, death_rate=death_rate)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": lr, "momentum": 0.9, "wd": 1e-4})
+    lossfn = gluon.loss.SoftmaxCrossEntropyLoss()
+    n = len(Xtr)
+    for ep in range(epochs):
+        perm = np.random.permutation(n)
+        tot = 0.0
+        for s in range(0, n - batch + 1, batch):
+            idx = perm[s:s + batch]
+            xb, yb = nd.array(Xtr[idx]), nd.array(ytr[idx])
+            with autograd.record():
+                loss = lossfn(net(xb), yb)
+            loss.backward()
+            trainer.step(batch)
+            tot += float(loss.mean().asnumpy())
+    preds = np.argmax(net(nd.array(Xte)).asnumpy(), axis=1)
+    acc = float((preds == yte).mean())
+    print("stochastic-depth: test acc %.4f (death_rate %.2f, linear decay)"
+          % (acc, death_rate))
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -265,15 +265,25 @@ class Module(BaseModule):
         kv, update_on_kvstore = _create_kvstore(
             kvstore, 1, {n: self._exec.arg_dict[n] for n in self._param_names}
         )
+        # loss-op backwards emit per-sample gradients; normalize by the
+        # global batch like the reference (module.py:497 rescale_grad)
+        batch_size = self._data_shapes[0].shape[0]
+        if kv and "dist" in kv.type:
+            batch_size *= kv.num_workers
         if isinstance(optimizer, str):
-            # loss-op backwards emit per-sample gradients; normalize by the
-            # global batch like the reference (module.py:497 rescale_grad)
-            batch_size = self._data_shapes[0].shape[0]
-            if kv and "dist" in kv.type:
-                batch_size *= kv.num_workers
             optimizer_params = dict(optimizer_params or {})
             optimizer_params.setdefault("rescale_grad", 1.0 / batch_size)
             optimizer = opt_mod.create(optimizer, **optimizer_params)
+        elif optimizer.rescale_grad != 1.0 / batch_size:
+            # reference module.py:523-528: a manually-built optimizer keeps
+            # its own rescale_grad, but a mismatch silently mis-scales
+            # gradients by the batch size — warn exactly like the reference
+            import warnings
+
+            warnings.warn(
+                "Optimizer created manually outside Module but rescale_grad "
+                "is %g rather than 1.0/batch_size (%g). Is this intended?"
+                % (optimizer.rescale_grad, 1.0 / batch_size))
         optimizer.idx2name = {i: n for i, n in enumerate(self._param_names)}
         if hasattr(self._symbol, "attr_dict"):
             optimizer.sym_info = (self._symbol.attr_dict(), self._symbol.list_arguments())

@@ -1,0 +1,140 @@
+"""Round-3 example families (VERDICT round-2 missing item 1): the
+highest-value reference example directories still unported after round 2 —
+stochastic-depth, capsnet, dsd, bayesian-methods (SGLD), speech_recognition
+(bucketed CTC), gan (conditional GAN).  Each test is the family's synthetic
+E2E run at reduced scale (nightly tier)."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+EX = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "examples"))
+for sub in ("stochastic-depth", "capsnet", "dsd", "bayesian-methods",
+            "speech_recognition", "gan"):
+    p = os.path.join(EX, sub)
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+
+def test_stochastic_depth_trains_and_gates():
+    import sd_cifar10
+
+    acc = sd_cifar10.main(epochs=8, death_rate=0.5)
+    assert acc > 0.9, acc
+    # death_rate=1: the compute branch must be fully dead at train time —
+    # its conv params get exactly zero gradient through the gate
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, nd
+
+    mx.random.seed(0)
+    blk = sd_cifar10.StochasticDepthBlock(4, death_rate=1.0)
+    blk.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(0).randn(2, 4, 8, 8).astype(np.float32))
+    with autograd.record():
+        out = blk(x)
+    out.backward()
+    g = blk.body[0].weight.grad().asnumpy()
+    assert np.allclose(g, 0.0), np.abs(g).max()
+
+
+def test_capsnet_routing_learns_digits():
+    import capsulenet
+
+    acc = capsulenet.main(epochs=8)
+    assert acc > 0.85, acc
+
+
+def test_capsnet_squash_norm_bound():
+    """Squash must map any capsule to length < 1, preserving direction."""
+    import jax.numpy as jnp
+    import capsulenet
+    import mxnet_tpu.ndarray as F
+
+    from mxnet_tpu import nd
+
+    s = nd.array(np.random.RandomState(0).randn(4, 3, 8).astype(np.float32) * 10)
+    v = capsulenet.squash(F, s, axis=2).asnumpy()
+    lens = np.linalg.norm(v, axis=2)
+    assert (lens < 1.0).all() and (lens > 0.5).all()  # big inputs -> ~1
+    cos = (v * s.asnumpy()).sum(2) / (
+        np.linalg.norm(v, axis=2) * np.linalg.norm(s.asnumpy(), axis=2))
+    np.testing.assert_allclose(cos, 1.0, atol=1e-5)
+
+
+def test_dsd_sparse_phase_prunes_and_recovers():
+    import mlp as dsd_mlp
+
+    acc, opt = dsd_mlp.main(epochs_per_phase=4, sparsity=60.0)
+    assert acc > 0.9, acc
+    # the sparse phase (phase 1) must have pruned ~60% of each fc weight,
+    # and the final phase (2) lifted the mask (dense again)
+    sparse = {k: v for k, v in opt.mask_history.items() if k[1] == 1 and v > 0}
+    assert sparse, opt.mask_history
+    assert all(0.5 < v < 0.7 for v in sparse.values()), sparse
+    assert all(opt.mask_history.get((k[0], 2), 0.0) == 0.0 for k in sparse)
+    assert any(p == 2 for p in opt._mask_phase.values()), opt._mask_phase
+
+
+def test_dsd_mask_semantics_unit():
+    """SparseSGD masks weight/grad/momentum every update (reference
+    sparse_sgd.py preprocessing) — pruned entries stay exactly zero."""
+    from sparse_sgd import SparseSGD
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    mx.random.seed(0)
+    w = nd.array(np.array([[5.0, 0.01, 3.0, 0.02]], np.float32))
+    opt = SparseSGD(pruning_switch_epoch=[0], batches_per_epoch=1,
+                    weight_sparsity=[50.0], bias_sparsity=[0.0],
+                    learning_rate=0.1, momentum=0.9)
+    state = opt.create_state(0, w)
+    for _ in range(3):
+        g = nd.array(np.ones((1, 4), np.float32))
+        opt.update(0, w, g, state)
+    out = w.asnumpy()
+    assert out[0, 1] == 0.0 and out[0, 3] == 0.0, out   # pruned
+    assert out[0, 0] != 0.0 and out[0, 2] != 0.0, out   # survivors train
+
+
+def test_sgld_recovers_bimodal_posterior():
+    import sgld_demo
+
+    S = sgld_demo.main(n_samples=4000, burn_in=800)
+    lo = (S[:, 0] < 0.4).mean()
+    hi = (S[:, 0] > 0.6).mean()
+    # both posterior modes visited (the Welling & Teh property; a plain
+    # SGD would collapse into one)
+    assert lo > 0.05 and hi > 0.05, (lo, hi)
+    assert np.isfinite(S).all()
+
+
+def test_deepspeech_ctc_buckets_learn():
+    import deepspeech
+
+    losses, acc = deepspeech.main(steps=120)
+    assert np.mean(losses[-10:]) < 0.5 * np.mean(losses[:10]), (
+        losses[:3], losses[-3:])
+    assert acc > 0.5, acc  # chance is ~1/6 per token
+
+
+def test_cgan_conditional_fidelity():
+    import cgan
+
+    acc = cgan.main(steps=1200)
+    assert acc > 0.4, acc  # chance 0.10; conditioning must clearly bind
+
+
+def test_ssd_fused_real_graph_smoke():
+    """The VGG16-reduced SSD fused train step (examples/ssd/train_fused.py)
+    at reduced size but the REAL graph: loss finite and decreasing."""
+    import subprocess
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(EX, "ssd", "train_fused.py"),
+         "--steps", "6"],
+        capture_output=True, text=True, timeout=1200,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "SSD FUSED TRAIN OK" in r.stdout
