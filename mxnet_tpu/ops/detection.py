@@ -677,10 +677,55 @@ def _nms_alive_blocked(boxes, thresh, tile=256, plus_one=1.0, valid=None,
     suppress nor survive).  ``ids`` (with ``force_suppress=False``) restricts
     suppression to equal-id pairs — the per-class NMS of box_nms /
     MultiBoxDetection.  Returns a bool (N,) mask.
+
+    On TPU at production sizes this dispatches to the Pallas kernel
+    (``pallas_kernels.nms_alive_pallas`` — identical survivors, measured
+    ~2.3x faster, docs/PERF_NOTES.md "Pallas head-to-head"); the choice
+    rides ``lax.platform_dependent`` so a CPU lowering in a TPU process
+    (the consistency tier) still gets the XLA formulation.
+    ``MXNET_NMS_IMPL=xla|pallas`` overrides the auto choice.
     """
+    import os
+
     N = boxes.shape[0]
     if N == 0:
         return jnp.zeros((0,), bool)
+    impl = os.environ.get("MXNET_NMS_IMPL", "auto")
+    # the kernel needs a static threshold; a traced thresh can't take the
+    # pallas path (np.float32 etc. coerce fine)
+    static_thresh = not isinstance(thresh, jax.core.Tracer)
+    if impl == "pallas" and not static_thresh:
+        import warnings
+
+        warnings.warn("MXNET_NMS_IMPL=pallas ignored: NMS threshold is a "
+                      "traced value; using the XLA formulation")
+    if impl != "xla" and static_thresh:
+
+        def pallas_path(interpret):
+            from .pallas_kernels import nms_alive_pallas
+
+            v = jnp.ones((N,), bool) if valid is None else valid
+            return nms_alive_pallas(
+                boxes, v, ids, thresh=float(thresh),
+                plus_one=float(plus_one), force_suppress=force_suppress,
+                interpret=interpret)
+
+        if impl == "pallas":  # forced (tests); interpret off-TPU
+            return pallas_path(jax.default_backend() != "tpu")
+        if N >= 1024:
+            return jax.lax.platform_dependent(
+                tpu=lambda: pallas_path(False),
+                default=lambda: _nms_alive_blocked_xla(
+                    boxes, thresh, tile, plus_one, valid, ids,
+                    force_suppress))
+    return _nms_alive_blocked_xla(boxes, thresh, tile, plus_one, valid, ids,
+                                  force_suppress)
+
+
+def _nms_alive_blocked_xla(boxes, thresh, tile, plus_one, valid, ids,
+                           force_suppress):
+    """The XLA formulation of the blocked greedy scan (docstring above)."""
+    N = boxes.shape[0]
     T = int(min(tile, N))
     nb = -(-N // T)
     Np = nb * T

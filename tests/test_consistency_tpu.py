@@ -237,6 +237,18 @@ def _cases():
     ], axis=2)
     add("box_nms", _op("_contrib_box_nms", overlap_thresh=0.5, coord_start=2,
                        score_index=1, id_index=0), [nmsdat], False)
+    # big-N variant: N>=1024 routes through the Pallas NMS kernel on TPU
+    # (ops/pallas_kernels.nms_alive_pallas) while CPU stays on the XLA
+    # formulation — this case cross-checks the two implementations on the
+    # actual hardware dispatch boundary
+    nmsbig = np.concatenate([
+        _R.randint(0, 8, (1, 1536, 1)).astype(np.float32),
+        _R.rand(1, 1536, 1).astype(np.float32),
+        np.sort(_R.rand(1, 1536, 2, 2) * 300, axis=2).reshape(1, 1536, 4).astype(np.float32),
+    ], axis=2)
+    add("box_nms_pallas_dispatch",
+        _op("_contrib_box_nms", overlap_thresh=0.5, coord_start=2,
+            score_index=1, id_index=0), [nmsbig], False)
     add("box_iou", _op("_contrib_box_iou"),
         [np.sort(_R.rand(6, 2, 2) * 10, axis=1).reshape(6, 4).astype(np.float32),
          np.sort(_R.rand(4, 2, 2) * 10, axis=1).reshape(4, 4).astype(np.float32)], bf16=True)

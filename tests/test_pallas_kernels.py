@@ -42,3 +42,98 @@ def test_3d_shape_and_uneven_rows():
     rr = jnp.asarray(np.abs(x).max())
     q = quantize_int8_pallas(jnp.asarray(x), rr, interpret=True)
     assert q.shape == x.shape and q.dtype == jnp.int8
+
+
+# ---------------------------------------------------------------------------
+# Blocked greedy NMS kernel (VERDICT r2 item 3)
+# ---------------------------------------------------------------------------
+
+def _rand_boxes(rng, *lead, n, extent=800.0):
+    ctr = rng.uniform(0, extent, lead + (n, 2))
+    wh = rng.uniform(8, 250, lead + (n, 2))
+    return np.concatenate([ctr - wh / 2, ctr + wh / 2], -1).astype(np.float32)
+
+
+def test_nms_pallas_matches_xla_blocked():
+    import jax
+    from mxnet_tpu.ops.detection import _nms_alive_blocked
+    from mxnet_tpu.ops.pallas_kernels import nms_alive_pallas
+
+    rng = np.random.RandomState(0)
+    for n in (100, 300, 700):  # below, at, and across the 256 tile
+        boxes = jnp.asarray(_rand_boxes(rng, n=n))
+        valid = jnp.asarray(rng.rand(n) > 0.1)
+        ref = np.asarray(_nms_alive_blocked(boxes, 0.5, valid=valid))
+        got = np.asarray(nms_alive_pallas(boxes, valid, None, thresh=0.5,
+                                          interpret=True))
+        np.testing.assert_array_equal(ref, got)
+
+
+def test_nms_pallas_per_class_ids():
+    from mxnet_tpu.ops.detection import _nms_alive_blocked
+    from mxnet_tpu.ops.pallas_kernels import nms_alive_pallas
+
+    rng = np.random.RandomState(1)
+    n = 400
+    boxes = jnp.asarray(_rand_boxes(rng, n=n))
+    valid = jnp.asarray(rng.rand(n) > 0.05)
+    ids = jnp.asarray(rng.randint(0, 6, n))
+    ref = np.asarray(_nms_alive_blocked(
+        boxes, 0.5, valid=valid, ids=ids, force_suppress=False, plus_one=0.0))
+    got = np.asarray(nms_alive_pallas(
+        boxes, valid, ids, thresh=0.5, plus_one=0.0, force_suppress=False,
+        interpret=True))
+    np.testing.assert_array_equal(ref, got)
+
+
+def test_nms_pallas_vmap_hits_batched_grid():
+    import jax
+    from mxnet_tpu.ops.detection import _nms_alive_blocked
+    from mxnet_tpu.ops.pallas_kernels import nms_alive_pallas
+
+    rng = np.random.RandomState(2)
+    B, n = 3, 512
+    boxes = jnp.asarray(_rand_boxes(rng, B, n=n))
+    valid = jnp.asarray(rng.rand(B, n) > 0.1)
+    got = np.asarray(jax.vmap(
+        lambda b, v: nms_alive_pallas(b, v, None, thresh=0.5,
+                                      interpret=True))(boxes, valid))
+    ref = np.stack([np.asarray(_nms_alive_blocked(
+        boxes[i], 0.5, valid=valid[i])) for i in range(B)])
+    np.testing.assert_array_equal(ref, got)
+
+
+def test_nms_pallas_grad_is_zero_not_error():
+    """The survivor mask is piecewise-constant: grad through a consumer
+    must flow through box VALUES only (same as the XLA bool-mask path)."""
+    import jax
+    from mxnet_tpu.ops.pallas_kernels import nms_alive_pallas
+
+    rng = np.random.RandomState(3)
+    n = 300
+    boxes = jnp.asarray(_rand_boxes(rng, n=n))
+    valid = jnp.ones((n,), bool)
+
+    def loss(b):
+        alive = nms_alive_pallas(b, valid, None, thresh=0.5, interpret=True)
+        return jnp.where(alive[:, None], b, 0.0).sum()
+
+    g = np.asarray(jax.grad(loss)(boxes))
+    alive = np.asarray(nms_alive_pallas(boxes, valid, None, thresh=0.5,
+                                        interpret=True))
+    np.testing.assert_array_equal(
+        g, np.broadcast_to(np.where(alive[:, None], 1.0, 0.0), g.shape))
+
+
+def test_dispatch_env_override(monkeypatch):
+    """MXNET_NMS_IMPL=pallas routes _nms_alive_blocked through the kernel
+    on CPU (interpret); =xla keeps the jnp path; results identical."""
+    from mxnet_tpu.ops import detection
+
+    rng = np.random.RandomState(4)
+    boxes = jnp.asarray(_rand_boxes(rng, n=200))
+    monkeypatch.setenv("MXNET_NMS_IMPL", "xla")
+    ref = np.asarray(detection._nms_alive_blocked(boxes, 0.6))
+    monkeypatch.setenv("MXNET_NMS_IMPL", "pallas")
+    got = np.asarray(detection._nms_alive_blocked(boxes, 0.6))
+    np.testing.assert_array_equal(ref, got)
