@@ -26,6 +26,8 @@ NIGHTLY_FILES=(
   tests/test_examples_nce_fcn_svm.py
   tests/test_example_deformable_rfcn.py
   tests/test_examples_round3.py
+  tests/test_examples_round3b.py
+  tests/test_quality_map.py
 )
 
 tier="${1:-unit}"

@@ -1,0 +1,124 @@
+"""Multi-digit CAPTCHA recognition — reference ``example/captcha/``
+(``mxnet_captcha.R``: a CNN over 4-digit captcha images with a length-4
+multi-label softmax head; the reference ships it as an R-frontend example,
+the capability here is the Python/TPU port).
+
+Synthetic captchas: 4 digits rendered as 7-segment-style glyph masks at
+jittered positions on a noisy canvas; the net reads out all 4 positions
+with one shared trunk and a (4*10)-way head reshaped to (B,4,10) —
+exactly the R example's ``mx.symbol.Reshape -> SoftmaxOutput(multi)``
+structure.
+
+Run: ./dev.sh python examples/captcha/captcha_recognition.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+# 7-segment truth table: which of (top, tl, tr, mid, bl, br, bottom) light up
+_SEGS = {
+    0: (1, 1, 1, 0, 1, 1, 1), 1: (0, 0, 1, 0, 0, 1, 0),
+    2: (1, 0, 1, 1, 1, 0, 1), 3: (1, 0, 1, 1, 0, 1, 1),
+    4: (0, 1, 1, 1, 0, 1, 0), 5: (1, 1, 0, 1, 0, 1, 1),
+    6: (1, 1, 0, 1, 1, 1, 1), 7: (1, 0, 1, 0, 0, 1, 0),
+    8: (1, 1, 1, 1, 1, 1, 1), 9: (1, 1, 1, 1, 0, 1, 1),
+}
+
+
+def _draw_digit(canvas, d, x0, y0, h=12, w=8):
+    t, tl, tr, m, bl, br, b = _SEGS[d]
+    x1, y1 = x0 + w, y0 + h
+    ym = y0 + h // 2
+    if t:
+        canvas[y0:y0 + 2, x0:x1] = 1.0
+    if m:
+        canvas[ym:ym + 2, x0:x1] = 1.0
+    if b:
+        canvas[y1 - 2:y1, x0:x1] = 1.0
+    if tl:
+        canvas[y0:ym, x0:x0 + 2] = 1.0
+    if tr:
+        canvas[y0:ym, x1 - 2:x1] = 1.0
+    if bl:
+        canvas[ym:y1, x0:x0 + 2] = 1.0
+    if br:
+        canvas[ym:y1, x1 - 2:x1] = 1.0
+
+
+def make_captchas(rng, n, digits=4, h=20, w=56):
+    xs = rng.rand(n, 1, h, w).astype(np.float32) * 0.3
+    ys = rng.randint(0, 10, (n, digits))
+    for i in range(n):
+        for j in range(digits):
+            _draw_digit(xs[i, 0], int(ys[i, j]),
+                        2 + j * 13 + rng.randint(0, 3), rng.randint(2, 6))
+    return xs, ys.astype(np.int32)
+
+
+class CaptchaNet(gluon.HybridBlock):
+    """Conv trunk + one (digits*10) head (mxnet_captcha.R net structure)."""
+
+    def __init__(self, digits=4, **kw):
+        super().__init__(**kw)
+        self.digits = digits
+        with self.name_scope():
+            self.features = nn.HybridSequential()
+            self.features.add(
+                nn.Conv2D(32, 3, padding=1), nn.Activation("relu"),
+                nn.MaxPool2D(2),
+                nn.Conv2D(64, 3, padding=1), nn.Activation("relu"),
+                nn.MaxPool2D(2),
+                nn.Flatten(), nn.Dense(256, activation="relu"))
+            self.head = nn.Dense(digits * 10)
+
+    def hybrid_forward(self, F, x):
+        z = self.head(self.features(x))
+        return F.reshape(z, (0, self.digits, 10))
+
+
+def main(epochs=8, batch=64, n_train=2048, n_val=256):
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    xs, ys = make_captchas(rng, n_train + n_val)
+
+    net = CaptchaNet()
+    net.initialize(mx.init.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss(axis=-1)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+
+    for epoch in range(epochs):
+        perm = rng.permutation(n_train)
+        tot = 0.0
+        for s in range(0, n_train, batch):
+            idx = perm[s:s + batch]
+            x = nd.array(xs[idx])
+            y = nd.array(ys[idx].astype(np.float32))
+            with autograd.record():
+                logits = net(x)            # (B, 4, 10)
+                loss = loss_fn(logits, y).mean()
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asnumpy())
+        print("epoch %d  loss %.4f" % (epoch, tot / (n_train // batch)))
+
+    pred = net(nd.array(xs[n_train:])).asnumpy().argmax(-1)
+    per_digit = (pred == ys[n_train:]).mean()
+    per_captcha = (pred == ys[n_train:]).all(axis=1).mean()
+    print("val per-digit acc %.3f, whole-captcha acc %.3f"
+          % (per_digit, per_captcha))
+    return per_digit, per_captcha
+
+
+if __name__ == "__main__":
+    main()

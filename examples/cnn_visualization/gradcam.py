@@ -1,0 +1,155 @@
+"""Grad-CAM + guided backprop — reference
+``example/cnn_visualization/{gradcam.py,gradcam_demo.py}``.
+
+Three capabilities:
+
+* **Grad-CAM** (reference ``get_cam``): channel-mean of the target conv
+  layer's output gradient weights its activation map into a class-evidence
+  heatmap.  Capture uses the reference's own idiom — ``attach_grad()`` on
+  the intermediate inside ``autograd.record`` (which, as in MXNet, detaches
+  it into a leaf whose ``.grad`` fills on backward).
+* **Guided backprop** (reference ReluOp CustomOp, Springenberg et al.
+  sec 3.4): a ReLU CustomOp whose backward also zeroes negative upstream
+  gradients, toggled by a class flag exactly like the reference's
+  ``ReluOp.guided_backprop``.
+* **Saliency post-processing** (reference gradcam_demo.py), cv2-free.
+
+Run: ./dev.sh python examples/cnn_visualization/gradcam.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+class ReluOp(mx.operator.CustomOp):
+    """ReLU with switchable guided backprop (reference gradcam.py:29-61)."""
+
+    guided_backprop = False
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0]
+        self.assign(out_data[0], req[0], nd.maximum(x, nd.zeros_like(x)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        if ReluOp.guided_backprop:
+            y = out_data[0]
+            dy = out_grad[0]
+            dx = nd.maximum(dy, nd.zeros_like(dy)) * (y > 0)
+        else:
+            dx = out_grad[0] * (in_data[0] > 0)
+        self.assign(in_grad[0], req[0], dx)
+
+
+def set_guided_backprop(mode=True):
+    ReluOp.guided_backprop = mode
+
+
+@mx.operator.register("gradcam_relu")
+class ReluProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shapes):
+        return (in_shapes[0],), (in_shapes[0],), ()
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return ReluOp()
+
+
+class Activation(gluon.HybridBlock):
+    """Drop-in for nn.Activation('relu') routing through the CustomOp
+    (reference gradcam.py Activation)."""
+
+    def hybrid_forward(self, F, x):
+        return F.Custom(x, op_type="gradcam_relu")
+
+
+def build_cnn(classes=4):
+    net = nn.HybridSequential(prefix="net_")
+    with net.name_scope():
+        net.add(nn.Conv2D(16, 3, padding=1), Activation(),
+                nn.MaxPool2D(2),
+                nn.Conv2D(32, 3, padding=1), Activation(),
+                nn.MaxPool2D(2),
+                nn.Flatten(), nn.Dense(classes))
+    return net
+
+
+def get_cam(net, x, class_id, capture_index=3):
+    """Grad-CAM heatmap (reference gradcam.py get_cam)."""
+    x = nd.array(x) if not isinstance(x, nd.NDArray) else x
+    feat = None
+    with autograd.record():
+        h = x
+        for i, blk in enumerate(net):
+            h = blk(h)
+            if i == capture_index:
+                h.attach_grad()   # leaf capture, as the reference Conv2D does
+                feat = h
+        score = h[:, class_id].sum()
+    score.backward()
+    w = feat.grad.asnumpy().mean(axis=(2, 3), keepdims=True)  # (B,C,1,1)
+    cam = np.maximum((w * feat.asnumpy()).sum(axis=1), 0)      # (B,H,W)
+    cam /= cam.max() + 1e-12
+    return cam
+
+
+def get_guided_grad(net, x, class_id):
+    """Image-space guided-backprop saliency (reference get_guided_grad_image):
+    flip the ReluOp flag, backprop the class score to the image."""
+    x = nd.array(x) if not isinstance(x, nd.NDArray) else x
+    x.attach_grad()
+    set_guided_backprop(True)
+    try:
+        with autograd.record():
+            score = net(x)[:, class_id].sum()
+        score.backward()
+    finally:
+        set_guided_backprop(False)
+    return x.grad.asnumpy()
+
+
+def main():
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    net = build_cnn()
+    net.initialize(mx.init.Xavier())
+
+    # an image whose class evidence sits in one quadrant
+    x = rng.rand(1, 3, 32, 32).astype(np.float32) * 0.1
+    x[:, :, 16:, 16:] += 1.0
+    cam = get_cam(net, x, class_id=1)
+    print("gradcam heatmap", cam.shape, "max at",
+          np.unravel_index(cam[0].argmax(), cam[0].shape))
+
+    sal = get_guided_grad(net, x, class_id=1)
+    plain = None
+    x2 = nd.array(x)
+    x2.attach_grad()
+    with autograd.record():
+        s = net(x2)[:, 1].sum()
+    s.backward()
+    plain = x2.grad.asnumpy()
+    print("guided saliency: neg-fraction %.3f vs plain backprop %.3f"
+          % (float((sal < 0).mean()), float((plain < 0).mean())))
+    return cam, sal
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,117 @@
+"""Kaggle National Data Science Bowl 1 (plankton) — reference
+``example/kaggle-ndsb1/{symbol_dsb.py,train_dsb.py,gen_img_list.py}``.
+
+The reference recipe: build train/val image lists, pack to RecordIO
+(im2rec), train the ``symbol_dsb`` conv net with aspect-augmentation via
+``ImageRecordIter``.  Offline port: synthetic "plankton" (procedural blob
+silhouettes per class, the dataset's grayscale shape-classification
+character) packed through the SAME .rec pipeline, then the dsb symbol at
+reduced width.
+
+Run: ./dev.sh python examples/kaggle-ndsb1/train_dsb.py
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def get_symbol(num_classes=6, width=1):
+    """symbol_dsb.py:21-47 scaled by ``width`` (reference trains 121-way)."""
+    net = mx.sym.Variable("data")
+    for nf, k, pool in [(8 * width, 5, True), (16 * width, 3, True),
+                        (32 * width, 3, True)]:
+        net = mx.sym.Convolution(net, kernel=(k, k), num_filter=nf,
+                                 pad=(k // 2, k // 2))
+        net = mx.sym.Activation(net, act_type="relu")
+        if pool:
+            net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2),
+                                 stride=(2, 2))
+    net = mx.sym.Flatten(net)
+    net = mx.sym.Dropout(net, p=0.25)
+    net = mx.sym.FullyConnected(net, num_hidden=64)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def draw_plankton(rng, cls, size=32):
+    """Procedural class-conditional silhouettes (disk / ring / bar / cross /
+    twin disks / wedge) with position jitter — grayscale shape
+    classification, the dataset's character."""
+    yy, xx = np.mgrid[:size, :size].astype(np.float32)
+    cy, cx = size / 2 + rng.randn(2) * 2
+    dy, dx = yy - cy, xx - cx
+    r = np.sqrt(dy ** 2 + dx ** 2)
+    s = size / 4 + rng.randn() * 1.0
+    if cls == 0:
+        mask = r < s
+    elif cls == 1:
+        mask = (r < s) & (r > s * 0.55)
+    elif cls == 2:
+        mask = (np.abs(dy) < s * 0.35) & (np.abs(dx) < s * 1.4)
+    elif cls == 3:
+        mask = ((np.abs(dy) < s * 0.3) | (np.abs(dx) < s * 0.3)) & (r < s * 1.3)
+    elif cls == 4:
+        mask = (np.sqrt((dy - s * 0.8) ** 2 + dx ** 2) < s * 0.55) | (
+            np.sqrt((dy + s * 0.8) ** 2 + dx ** 2) < s * 0.55)
+    else:
+        mask = (r < s * 1.2) & (np.abs(np.arctan2(dy, dx)) < 0.9)
+    img = mask.astype(np.float32) + rng.rand(size, size) * 0.15
+    return (np.clip(img, 0, 1) * 255).astype(np.uint8)
+
+
+def build_rec(path, rng, n, classes, size=32):
+    """gen_img_list.py + im2rec collapsed: pack synthetic JPEGs to .rec."""
+    from PIL import Image
+
+    rec = mx.recordio.MXIndexedRecordIO(path + ".idx", path, "w")
+    labels = rng.randint(0, classes, n)
+    for i in range(n):
+        img = draw_plankton(rng, int(labels[i]), size)
+        buf = _io.BytesIO()
+        Image.fromarray(np.stack([img] * 3, -1)).save(buf, format="JPEG",
+                                                      quality=92)
+        rec.write_idx(i, mx.recordio.pack(
+            mx.recordio.IRHeader(0, float(labels[i]), i, 0), buf.getvalue()))
+    rec.close()
+    return labels
+
+
+def main(classes=6, epochs=10, batch=32, n_train=640, n_val=128, seed=0):
+    mx.random.seed(seed)
+    rng = np.random.RandomState(seed)
+    with tempfile.TemporaryDirectory() as td:
+        tr_rec = os.path.join(td, "train.rec")
+        va_rec = os.path.join(td, "val.rec")
+        build_rec(tr_rec, rng, n_train, classes)
+        build_rec(va_rec, rng, n_val, classes)
+        train = mx.io.ImageRecordIter(
+            path_imgrec=tr_rec, data_shape=(3, 28, 28), batch_size=batch,
+            rand_crop=True, rand_mirror=True, shuffle=True)
+        val = mx.io.ImageRecordIter(
+            path_imgrec=va_rec, data_shape=(3, 28, 28), batch_size=batch)
+
+        mod = mx.mod.Module(get_symbol(classes))
+        mod.fit(train, eval_data=val, num_epoch=epochs, optimizer="adam",
+                optimizer_params={"learning_rate": 2e-3},
+                eval_metric="acc")
+        val.reset()
+        metric = mx.metric.Accuracy()
+        mod.score(val, metric)
+        acc = metric.get()[1]
+        print("ndsb1 synthetic val acc %.3f" % acc)
+        return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+"""Kaggle Second National Data Science Bowl (cardiac volume) — reference
+``example/kaggle-ndsb2/Train.py``.
+
+The reference predicts end-systole/diastole heart volume from 30-frame MRI
+loops: frame differences via SliceChannel → lenet trunk → a 600-way
+LogisticRegressionOutput head trained against the CDF encoding
+``P(volume < v)`` (Train.py encode_label), scored with CRPS after a
+monotonic sweep.  Port keeps every stage — difference frames, CDF target,
+isotonic fix-up, CRPS — on synthetic pulsating-disk "MRI" loops whose
+ground-truth volume is the disk's systolic area, fed through CSVIter
+exactly like the reference's csv pipeline.
+
+Run: ./dev.sh python examples/kaggle-ndsb2/Train.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+FRAMES = 10   # reference uses 30-frame loops
+BINS = 60     # reference encodes 600 volume bins
+SIZE = 24
+
+
+def get_lenet():
+    """Frame-difference lenet (Train.py get_lenet), reduced geometry."""
+    source = mx.sym.Variable("data")
+    source = (source - 128) * (1.0 / 128)
+    frames = mx.sym.SliceChannel(source, num_outputs=FRAMES)
+    diffs = [frames[i + 1] - frames[i] for i in range(FRAMES - 1)]
+    source = mx.sym.Concat(*diffs)
+    net = mx.sym.Convolution(source, kernel=(5, 5), num_filter=16)
+    net = mx.sym.BatchNorm(net, fix_gamma=True)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    net = mx.sym.Convolution(net, kernel=(3, 3), num_filter=16)
+    net = mx.sym.BatchNorm(net, fix_gamma=True)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    flat = mx.sym.Flatten(net)
+    flat = mx.sym.Dropout(flat)
+    fc1 = mx.sym.FullyConnected(flat, num_hidden=BINS)
+    return mx.sym.LogisticRegressionOutput(fc1, name="softmax")
+
+
+def CRPS(label, pred):
+    """Continuous ranked probability score after the reference's monotonic
+    fix-up sweep (Train.py:59-64)."""
+    pred = pred.copy()
+    for j in range(pred.shape[1] - 1):
+        pred[:, j + 1] = np.maximum(pred[:, j + 1], pred[:, j])
+    return float(np.sum(np.square(label - pred)) / label.size)
+
+
+def encode_label(vol):
+    """volume scalar → CDF target 1[v < bins] (Train.py encode_label)."""
+    return np.array([(x < np.arange(BINS)) for x in vol], np.uint8)
+
+
+def make_loops(rng, n):
+    """Pulsating disk: radius oscillates over the loop; systolic volume
+    (the label) is the minimum disk area, in bin units."""
+    data = np.zeros((n, FRAMES, SIZE, SIZE), np.float32)
+    vols = np.zeros(n)
+    yy, xx = np.mgrid[:SIZE, :SIZE]
+    for i in range(n):
+        r_dia = rng.uniform(6, 10)
+        r_sys = r_dia * rng.uniform(0.45, 0.8)
+        vols[i] = np.pi * r_sys ** 2 * (BINS / (np.pi * 10 ** 2))
+        cy, cx = SIZE / 2 + rng.randn(2)
+        for t in range(FRAMES):
+            r = r_sys + (r_dia - r_sys) * 0.5 * (
+                1 + np.cos(2 * np.pi * t / FRAMES))
+            mask = ((yy - cy) ** 2 + (xx - cx) ** 2) < r ** 2
+            data[i, t] = mask * 200.0 + rng.rand(SIZE, SIZE) * 20
+    return data, vols
+
+
+def main(epochs=12, batch=32, n_train=384, n_val=96, seed=0):
+    mx.random.seed(seed)
+    rng = np.random.RandomState(seed)
+    data, vols = make_loops(rng, n_train + n_val)
+    cdf = encode_label(vols)
+
+    with tempfile.TemporaryDirectory() as td:
+        dtr = os.path.join(td, "train-data.csv")
+        ltr = os.path.join(td, "train-systole.csv")
+        np.savetxt(dtr, data[:n_train].reshape(n_train, -1), delimiter=",",
+                   fmt="%g")
+        np.savetxt(ltr, cdf[:n_train], delimiter=",", fmt="%g")
+        train = mx.io.CSVIter(data_csv=dtr, data_shape=(FRAMES, SIZE, SIZE),
+                              label_csv=ltr, label_shape=(BINS,),
+                              batch_size=batch)
+        mod = mx.mod.Module(get_lenet())
+        mod.fit(train, num_epoch=epochs, optimizer="adam",
+                optimizer_params={"learning_rate": 2e-3})
+
+    pred = mod.predict(mx.io.NDArrayIter(
+        data[n_train:], None, batch)).asnumpy()
+    crps = CRPS(cdf[n_train:], pred)
+    base = CRPS(cdf[n_train:],
+                np.tile(cdf[:n_train].mean(0), (n_val, 1)))
+    print("ndsb2 val CRPS %.4f (train-mean baseline %.4f)" % (crps, base))
+    return crps, base
+
+
+if __name__ == "__main__":
+    main()

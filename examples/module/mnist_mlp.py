@@ -1,0 +1,80 @@
+"""Module-API MLP walkthrough — reference ``example/module/mnist_mlp.py``.
+
+Shows the low-level Module lifecycle the reference demonstrates instead of
+``fit()``: bind → init_params → init_optimizer → per-batch
+forward/update_metric/backward/update, then checkpoint save/load round-trip
+(mnist_mlp.py's "intermediate-level" and "high-level" halves).
+
+Run: ./dev.sh python examples/module/mnist_mlp.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def mlp_sym(classes=10):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=64)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=32)
+    net = mx.sym.Activation(net, name="relu2", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc3", num_hidden=classes)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def synthetic_mnist(rng, n, classes=10, dim=64):
+    centers = rng.randn(classes, dim) * 2.5
+    y = rng.randint(0, classes, n)
+    x = centers[y] + rng.randn(n, dim) * 0.7
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def main(epochs=10, batch=50, tmpdir="/tmp"):
+    rng = np.random.RandomState(7)
+    xs, ys = synthetic_mnist(rng, 1500)
+    train = mx.io.NDArrayIter(xs[:1000], ys[:1000], batch, shuffle=True)
+    val = mx.io.NDArrayIter(xs[1000:], ys[1000:], batch)
+
+    # --- intermediate-level API (mnist_mlp.py:52-77) --------------------
+    mod = mx.mod.Module(mlp_sym())
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    metric = mx.metric.create("acc")
+    for epoch in range(epochs):
+        train.reset()
+        metric.reset()
+        for batch_data in train:
+            mod.forward(batch_data, is_train=True)
+            mod.update_metric(metric, batch_data.label)
+            mod.backward()
+            mod.update()
+        print("epoch %d, train %s=%.3f" % (epoch, *metric.get()))
+
+    # --- checkpoint round-trip (mnist_mlp.py high-level half) -----------
+    prefix = os.path.join(tmpdir, "module_mnist_mlp")
+    mod.save_checkpoint(prefix, epochs)
+    sym, args, auxs = mx.model.load_checkpoint(prefix, epochs)
+    mod2 = mx.mod.Module(sym)
+    mod2.bind(data_shapes=val.provide_data, for_training=False)
+    mod2.set_params(args, auxs)
+    metric.reset()
+    mod2.score(val, metric)
+    acc = metric.get()[1]
+    print("restored-module val acc %.3f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""Custom Python loss via SequentialModule — reference
+``example/module/python_loss.py``: a feature MLP Module chained with a
+``PythonLossModule`` whose gradient is a hand-written numpy function
+(multiclass hinge), wired together by ``SequentialModule.add(...,
+take_labels=True, auto_wiring=True)``.
+
+Run: ./dev.sh python examples/module/python_loss.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def mc_hinge_grad(scores, labels):
+    """Crammer-Singer multiclass hinge subgradient (python_loss.py:25-41):
+    push down the most-violating class, pull up the true class."""
+    scores = scores.asnumpy()
+    labels = labels.asnumpy().astype(int)
+    n, _ = scores.shape
+    grad = np.zeros_like(scores)
+    for i in range(n):
+        viol = 1.0 + scores[i] - scores[i, labels[i]]
+        viol[labels[i]] = 0.0
+        j = int(viol.argmax())
+        if viol[j] > 0:
+            grad[i, labels[i]] -= 1.0
+            grad[i, j] += 1.0
+    return mx.nd.array(grad / n)
+
+
+def main(epochs=10, batch=64, classes=5, dim=24):
+    rng = np.random.RandomState(3)
+    centers = rng.randn(classes, dim) * 3
+    y = rng.randint(0, classes, 1200)
+    x = (centers[y] + rng.randn(1200, dim)).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=64)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=classes)
+
+    mlp = mx.mod.Module(net, label_names=())
+    loss = mx.mod.PythonLossModule(grad_func=mc_hinge_grad)
+    mod = mx.mod.SequentialModule().add(mlp).add(
+        loss, take_labels=True, auto_wiring=True)
+
+    it = mx.io.NDArrayIter(x, y.astype(np.float32), batch, shuffle=True)
+    mod.fit(it, num_epoch=epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2, "momentum": 0.9})
+
+    # score by argmax over the feature module's raw scores
+    it.reset()
+    correct = total = 0
+    for b in it:
+        mod.forward(b, is_train=False)
+        pred = mod.get_outputs()[0].asnumpy().argmax(axis=1)
+        lab = b.label[0].asnumpy().astype(int)
+        correct += int((pred == lab).sum())
+        total += lab.shape[0]
+    acc = correct / total
+    print("python hinge-loss module train acc %.3f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main()

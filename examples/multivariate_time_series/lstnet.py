@@ -1,0 +1,141 @@
+"""LSTNet multivariate time-series forecasting — reference
+``example/multivariate_time_series/src/lstnet.py`` (Lai et al., LSTNet).
+
+Same four components as the reference symbol graph, on the Module API:
+
+* causal CNN bank over the (q, num_series) window (multiple filter widths,
+  left-padded so output length == q);
+* GRU over the CNN features (reference stacked ``mx.rnn`` cells unrolled);
+* skip-GRU sampling the sequence every ``seasonal_period`` steps;
+* per-series autoregressive linear head added to the neural output
+  (the component that makes LSTNet robust to scale drift).
+
+Offline data: synthetic seasonal multivariate series (sines with per-series
+phase + trend + noise) instead of the electricity.txt download.
+
+Run: ./dev.sh python examples/multivariate_time_series/lstnet.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def synthetic_series(rng, T=2000, series=4, period=24):
+    t = np.arange(T)[:, None]
+    phase = rng.rand(1, series) * 2 * np.pi
+    scale = 0.5 + rng.rand(1, series)
+    x = (np.sin(2 * np.pi * t / period + phase) * scale
+         + 0.0002 * t * rng.randn(1, series)
+         + 0.1 * rng.randn(T, series))
+    return x.astype(np.float32)
+
+
+def build_iters(x, q, horizon, splits=(0.6, 0.2), batch=64):
+    """Window the series into (n, q, series) → (n, series) examples
+    (reference build_iters)."""
+    n = x.shape[0] - q - horizon + 1
+    xs = np.stack([x[i:i + q] for i in range(n)])
+    ys = x[q + horizon - 1:q + horizon - 1 + n]
+    n_tr = int(n * splits[0])
+    n_va = int(n * splits[1])
+    mk = lambda a, b: mx.io.NDArrayIter(xs[a:b], ys[a:b], batch,
+                                        label_name="lro_label")
+    return mk(0, n_tr), mk(n_tr, n_tr + n_va), mk(n_tr + n_va, n)
+
+
+def sym_gen(q, series, filter_list=(3, 6, 12), num_filter=24, rnn_hidden=32,
+            skip_hidden=16, seasonal_period=24, dropout=0.1):
+    """The LSTNet symbol (reference sym_gen, lstnet.py:121-188)."""
+    X = mx.sym.Variable("data")            # (B, q, series)
+    Y = mx.sym.Variable("lro_label")
+
+    conv_input = mx.sym.reshape(X, shape=(0, 1, q, -1))
+    outputs = []
+    for fs in filter_list:
+        padi = mx.sym.pad(conv_input, mode="constant", constant_value=0,
+                          pad_width=(0, 0, 0, 0, fs - 1, 0, 0, 0))
+        convi = mx.sym.Convolution(padi, kernel=(fs, series),
+                                   num_filter=num_filter)
+        acti = mx.sym.Activation(convi, act_type="relu")
+        # (B, F, q, 1) -> (B, q, F)
+        trans = mx.sym.reshape(
+            mx.sym.transpose(acti, axes=(0, 2, 1, 3)), shape=(0, 0, 0))
+        outputs.append(trans)
+    cnn_features = mx.sym.Concat(*outputs, dim=2)
+    cnn_features = mx.sym.Dropout(cnn_features, p=dropout)
+
+    # GRU over the full window (reference stacks mx.rnn cells + unroll)
+    from mxnet_tpu import rnn as mrnn
+
+    cell = mrnn.SequentialRNNCell()
+    cell.add(mrnn.GRUCell(rnn_hidden, prefix="gru_"))
+    cell.add(mrnn.DropoutCell(dropout))
+    outputs, _ = cell.unroll(q, inputs=cnn_features, merge_outputs=False)
+    rnn_features = outputs[-1]                           # (B, H)
+
+    # skip-GRU: tap outputs every seasonal_period steps, newest first
+    # (reference lstnet.py:165-170 reverses then samples)
+    skip_cell = mrnn.SequentialRNNCell()
+    skip_cell.add(mrnn.GRUCell(skip_hidden, prefix="skipgru_"))
+    skip_cell.add(mrnn.DropoutCell(dropout))
+    skip_outputs, _ = skip_cell.unroll(q, inputs=cnn_features,
+                                       merge_outputs=False)
+    taps = [skip_outputs[i] for i in range(q - 1, -1, -seasonal_period)]
+    skip_features = mx.sym.concat(*taps, dim=1)
+
+    # per-series AR head (reference lstnet.py:173-178)
+    ar_list = []
+    for i in range(series):
+        ts = mx.sym.slice_axis(X, axis=2, begin=i, end=i + 1)
+        ar_list.append(mx.sym.FullyConnected(ts, num_hidden=1))
+    ar_output = mx.sym.concat(*ar_list, dim=1)
+
+    neural = mx.sym.concat(rnn_features, skip_features, dim=1)
+    neural_output = mx.sym.FullyConnected(neural, num_hidden=series)
+    model_output = neural_output + ar_output
+    return mx.sym.LinearRegressionOutput(model_output, Y, name="lro")
+
+
+def main(epochs=8, q=48, series=4, horizon=3, batch=64, seed=0):
+    mx.random.seed(seed)
+    rng = np.random.RandomState(seed)
+    x = synthetic_series(rng, series=series)
+    train_it, val_it, _ = build_iters(x, q, horizon, batch=batch)
+    net = sym_gen(q, series)
+
+    mod = mx.mod.Module(net, label_names=("lro_label",))
+    mod.bind(data_shapes=train_it.provide_data,
+             label_shapes=train_it.provide_label)
+    mod.init_params(mx.init.Uniform(0.1))
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 2e-3})
+    metric = mx.metric.MSE()
+    for epoch in range(epochs):
+        train_it.reset()
+        metric.reset()
+        for b in train_it:
+            mod.forward(b, is_train=True)
+            mod.update_metric(metric, b.label)
+            mod.backward()
+            mod.update()
+        print("epoch %d  train mse %.4f" % (epoch, metric.get()[1]))
+
+    val_it.reset()
+    metric.reset()
+    mod.score(val_it, metric)
+    mse = metric.get()[1]
+    naive = float(np.mean((x[q + horizon - 1:] - x[q - 1:-(horizon)]) ** 2))
+    print("val mse %.4f vs naive-persistence %.4f" % (mse, naive))
+    return mse, naive
+
+
+if __name__ == "__main__":
+    main()

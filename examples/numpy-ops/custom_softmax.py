@@ -1,0 +1,97 @@
+"""CustomOp softmax written against the numpy-facing bridge — reference
+``example/numpy-ops/custom_softmax.py`` (and its ``numpy_softmax.py``
+NumpyOp twin; both define softmax+CE fused forward/backward by hand).
+
+The CustomOp protocol is the reference's escape hatch for ops authored in
+Python/numpy (``python/mxnet/operator.py``).  Here the bridge runs the
+numpy bodies through ``jax.pure_callback`` with a ``custom_vjp`` around
+them (mxnet_tpu/operator.py), so the hand-written backward participates in
+jit-compiled training exactly like the reference's engine-scheduled one.
+
+Run: ./dev.sh python examples/numpy-ops/custom_softmax.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+class Softmax(mx.operator.CustomOp):
+    """Fused softmax + cross-entropy grad (custom_softmax.py:25-36): the
+    forward emits probabilities; the backward ignores the incoming grad
+    (``need_top_grad=False``) and writes p - onehot(label) directly."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        y = np.exp(x - x.max(axis=1, keepdims=True))
+        y /= y.sum(axis=1, keepdims=True)
+        self.assign(out_data[0], req[0], mx.nd.array(y))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        lab = in_data[1].asnumpy().ravel().astype(np.int64)
+        y = out_data[0].asnumpy().copy()
+        y[np.arange(lab.shape[0]), lab] -= 1.0
+        self.assign(in_grad[0], req[0], mx.nd.array(y / lab.shape[0]))
+
+
+@mx.operator.register("numpy_softmax")
+class SoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = (in_shape[0][0],)
+        return [data_shape, label_shape], [data_shape], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Softmax()
+
+
+def make_blobs(rng, n, classes=4, dim=16):
+    """Linearly separable gaussian blobs (offline stand-in for MNIST)."""
+    centers = rng.randn(classes, dim) * 3
+    y = rng.randint(0, classes, n)
+    x = centers[y] + rng.randn(n, dim)
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def main(epochs=12, batch=64, classes=4):
+    rng = np.random.RandomState(0)
+    xs, ys = make_blobs(rng, 1024, classes)
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=64)
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, name="fc2", num_hidden=classes)
+    net = mx.sym.Custom(fc2, label, name="softmax", op_type="numpy_softmax")
+
+    mod = mx.mod.Module(net, label_names=("softmax_label",))
+    it = mx.io.NDArrayIter(xs, ys, batch, shuffle=True,
+                           label_name="softmax_label")
+    mod.fit(it, num_epoch=epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            eval_metric="acc")
+    it.reset()
+    metric = mx.metric.Accuracy()
+    mod.score(it, metric)
+    acc = metric.get()[1]
+    print("custom numpy softmax final train acc %.3f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,88 @@
+"""Class-weighted logistic regression as a CustomOp — reference
+``example/numpy-ops/custom_sparse_sqr.py`` sibling
+``weighted_logistic_regression.py``: the backward scales positive and
+negative examples' gradients differently (class-imbalance handling the
+stock LogisticRegressionOutput cannot express).
+
+Run: ./dev.sh python examples/numpy-ops/weighted_logistic_regression.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+class WeightedLogisticRegression(mx.operator.CustomOp):
+    def __init__(self, pos_grad_scale, neg_grad_scale):
+        self.pos = float(pos_grad_scale)
+        self.neg = float(neg_grad_scale)
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0],
+                    mx.nd.divide(1.0, 1.0 + mx.nd.exp(-in_data[0])))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        # reference weighted_logistic_regression.py:27-29: grad =
+        # ((p-1)·y·pos + p·(1-y)·neg) / n  — positives pulled with ``pos``,
+        # negatives pushed with ``neg``
+        p = out_data[0].asnumpy()
+        y = in_data[1].asnumpy()
+        g = ((p - 1.0) * y * self.pos + p * (1.0 - y) * self.neg) / p.shape[1]
+        self.assign(in_grad[0], req[0], mx.nd.array(g))
+
+
+@mx.operator.register("weighted_logistic_regression")
+class WeightedLogisticRegressionProp(mx.operator.CustomOpProp):
+    def __init__(self, pos_grad_scale, neg_grad_scale):
+        self.pos = pos_grad_scale
+        self.neg = neg_grad_scale
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return [in_shape[0], in_shape[0]], [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return WeightedLogisticRegression(self.pos, self.neg)
+
+
+def main(pos=5.0, neg=0.1):
+    rng = np.random.RandomState(0)
+    m, n = 32, 8
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    out = mx.sym.Custom(data, label, op_type="weighted_logistic_regression",
+                        pos_grad_scale=pos, neg_grad_scale=neg)
+    x = rng.randn(m, n).astype(np.float32)
+    y = (rng.rand(m, n) > 0.8).astype(np.float32)  # imbalanced positives
+
+    exe = out.simple_bind(mx.cpu(), data=(m, n), label=(m, n),
+                          grad_req={"data": "write", "label": "null"})
+    exe.arg_dict["data"][:] = x
+    exe.arg_dict["label"][:] = y
+    exe.forward(is_train=True)
+    exe.backward()
+    g = exe.grad_dict["data"].asnumpy()
+    p = exe.outputs[0].asnumpy()
+    ref = ((p - 1) * y * pos + p * (1 - y) * neg) / n
+    assert np.allclose(g, ref, atol=1e-5)
+    # the asymmetry is the point: positive-example grads outweigh negatives
+    ratio = np.abs(g[y > 0.5]).mean() / np.abs(g[y < 0.5]).mean()
+    print("weighted grads: |pos|/|neg| mean ratio = %.1f" % ratio)
+    return ratio
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""Profiling a matmul executor — reference
+``example/profiler/profiler_matmul.py``: set_config → simple_bind a dot →
+toggle set_state('run'/'stop') around a window of iterations → dump a
+chrome-trace JSON.
+
+Run: ./dev.sh python examples/profiler/profiler_matmul.py
+     (open the JSON in chrome://tracing or Perfetto)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx
+
+
+def main(iter_num=20, begin=5, end=15, n=256, filename=None):
+    filename = filename or os.path.join(tempfile.gettempdir(),
+                                        "profile_matmul.json")
+    mx.profiler.set_config(profile_symbolic=True, filename=filename)
+    print("profile file saves to", filename)
+
+    A = mx.sym.Variable("A")
+    B = mx.sym.Variable("B")
+    C = mx.sym.dot(A, B)
+    executor = C.simple_bind(mx.cpu(), grad_req="null", A=(n, n), B=(n, n))
+    executor.arg_dict["A"][:] = mx.random.uniform(-1, 1, shape=(n, n))
+    executor.arg_dict["B"][:] = mx.random.uniform(-1, 1, shape=(n, n))
+
+    t0 = t1 = None
+    for i in range(iter_num):
+        if i == begin:
+            t0 = time.perf_counter()
+            mx.profiler.set_state("run")
+        if i == end:
+            t1 = time.perf_counter()
+            mx.profiler.set_state("stop")
+        executor.forward()
+        executor.outputs[0].wait_to_read()
+    mx.profiler.dump()
+    dur = t1 - t0
+    print("profiled window: %.1f ms (%.2f ms/forward)"
+          % (dur * 1e3, dur * 1e3 / (end - begin)))
+
+    with open(filename) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"] if isinstance(trace, dict) else trace
+    print("trace has %d events" % len(events))
+    return len(events)
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--iter_num", type=int, default=20)
+    p.add_argument("--profile_filename", type=str, default=None)
+    a = p.parse_args()
+    main(iter_num=a.iter_num, filename=a.profile_filename)

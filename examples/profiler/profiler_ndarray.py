@@ -1,0 +1,49 @@
+"""Profiling eager NDArray work — reference
+``example/profiler/profiler_ndarray.py`` (it runs an NDArray op sweep under
+the profiler).  Here: a burst of eager ops between set_state('run'/'stop'),
+plus a custom domain/counter and a frame marker — the instrumentation
+surface of ``mxnet_tpu/profiler.py``.
+
+Run: ./dev.sh python examples/profiler/profiler_ndarray.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx
+
+
+def main():
+    filename = os.path.join(tempfile.gettempdir(), "profile_ndarray.json")
+    mx.profiler.set_config(profile_imperative=True, filename=filename)
+    mx.profiler.set_state("run")
+
+    domain = mx.profiler.Domain("ndarray_sweep")
+    counter = mx.profiler.Counter(domain, "bytes_touched", 0)
+    with mx.profiler.Frame(domain, "sweep"):
+        a = mx.nd.random.uniform(-1, 1, shape=(512, 512))
+        b = mx.nd.random.uniform(-1, 1, shape=(512, 512))
+        for _ in range(8):
+            c = mx.nd.dot(a, b) + a * 2 - b.sum(axis=1, keepdims=True)
+            counter += int(c.size * 4)
+        c.wait_to_read()
+
+    mx.profiler.set_state("stop")
+    mx.profiler.dump()
+    with open(filename) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"] if isinstance(trace, dict) else trace
+    names = {e.get("name") for e in events if isinstance(e, dict)}
+    print("trace: %d events; has sweep frame: %s"
+          % (len(events), "sweep" in names))
+    return len(events)
+
+
+if __name__ == "__main__":
+    main()

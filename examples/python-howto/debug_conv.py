@@ -1,0 +1,48 @@
+"""Debugging a single conv with a Monitor — reference
+``example/python-howto/debug_conv.py``: bind a one-op module, install a
+monitor on its executor, and inspect every input/output tensor of the op.
+
+Run: ./dev.sh python examples/python-howto/debug_conv.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx
+
+
+class SimpleData:
+    def __init__(self, data):
+        self.data = data
+        self.label = None
+        self.pad = 0
+
+
+def main():
+    data_shape = (1, 3, 5, 5)
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data, kernel=(3, 3), pad=(1, 1), stride=(1, 1),
+                              num_filter=1)
+    mon = mx.monitor.Monitor(1, monitor_all=True)
+    mod = mx.mod.Module(conv, label_names=())
+    mod.bind(data_shapes=[("data", data_shape)])
+    mod.init_params()
+    mod.install_monitor(mon)
+
+    mon.tic()
+    mod.forward(SimpleData([mx.nd.ones(data_shape)]), is_train=False)
+    res = mod.get_outputs()[0].asnumpy()
+    entries = mon.toc()
+    for _step, name, stat in entries:
+        print("%-40s %s" % (name, stat))
+    print("conv output:\n", res[0, 0])
+    assert res.shape == (1, 1, 5, 5)
+    return res
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""Weight/activation monitoring during training — reference
+``example/python-howto/monitor_weights.py``: install a ``Monitor`` with a
+norm statistic on a Module and print per-batch tensor stats.
+
+Run: ./dev.sh python examples/python-howto/monitor_weights.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def norm_stat(d):
+    """RMS norm, the reference's statistic (monitor_weights.py:36-37);
+    the monitor hands the tensor over as numpy."""
+    return np.linalg.norm(d) / np.sqrt(d.size)
+
+
+def main(batches=6):
+    rng = np.random.RandomState(0)
+    x = rng.randn(256, 20).astype(np.float32)
+    y = (x[:, :10].sum(1) > 0).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=2)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    mon = mx.monitor.Monitor(interval=2, stat_func=norm_stat,
+                             pattern=".*weight", monitor_all=True)
+    mod = mx.mod.Module(net)
+    it = mx.io.NDArrayIter(x, y, 64)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+    mod.install_monitor(mon)
+
+    seen = []
+    for i, b in enumerate(it):
+        if i >= batches:
+            break
+        mon.tic()
+        mod.forward(b, is_train=True)
+        mod.backward()
+        mod.update()
+        for step, name, stat in mon.toc():
+            seen.append(name)
+            print("batch %d  %-24s %s" % (step, name, stat))
+    assert any("weight" in n for n in seen)
+    return seen
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""Grouped multi-output symbols — reference
+``example/python-howto/multiple_outputs.py``: tap an internal layer (fc1)
+next to the loss head with ``mx.sym.Group`` and read both from one
+executor forward.
+
+Run: ./dev.sh python examples/python-howto/multiple_outputs.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def main():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=128)
+    net = mx.sym.Activation(fc1, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=64)
+    out = mx.sym.SoftmaxOutput(net, name="softmax")
+    group = mx.sym.Group([fc1, out])
+    print("group outputs:", group.list_outputs())
+
+    exe = group.simple_bind(mx.cpu(), data=(4, 32),
+                            grad_req="null")
+    exe.arg_dict["data"][:] = np.random.RandomState(0).randn(4, 32)
+    exe.forward(is_train=False)
+    feats, probs = exe.outputs
+    print("fc1 tap", feats.shape, "softmax", probs.shape,
+          "rows sum to", float(probs.asnumpy().sum(1)[0]))
+    return feats.shape, probs.shape
+
+
+if __name__ == "__main__":
+    main()

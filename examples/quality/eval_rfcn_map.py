@@ -20,15 +20,26 @@ import sys
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.join(_HERE, "..", ".."))
-sys.path.insert(0, os.path.join(_HERE, "..", "deformable_rfcn"))
-sys.path.insert(0, os.path.join(_HERE, "..", "ssd"))
 
 import numpy as np
 
 import mxnet_tpu as mx
 from mxnet_tpu.gluon.functional import functionalize
-from metric import VOCMApMetric
-from train_fused import build_net, make_rfcn_train_step, synthetic_coco
+
+
+from mxnet_tpu.test_utils import load_module_by_path
+
+
+def _load(name, *relpath):
+    return load_module_by_path(os.path.join(_HERE, "..", *relpath), name)
+
+
+_ssd_metric = _load("_ssd_metric", "ssd", "metric.py")
+_rfcn = _load("_rfcn_train_fused", "deformable_rfcn", "train_fused.py")
+VOCMApMetric = _ssd_metric.VOCMApMetric
+build_net = _rfcn.build_net
+make_rfcn_train_step = _rfcn.make_rfcn_train_step
+synthetic_coco = _rfcn.synthetic_coco
 
 
 def decode_detections(rois, cls_prob, bbox_pred, num_classes, im_shape,
@@ -69,10 +80,21 @@ def decode_detections(rois, cls_prob, bbox_pred, num_classes, im_shape,
     if not rows:
         return np.full((1, 1, 6), -1, np.float32)
     dat = np.concatenate(rows, axis=0)[None]  # (1, N, 6)
-    # decode NMS on the host CPU backend: per-image detection counts vary,
-    # and recompiling box_nms per shape over the TPU tunnel is wasteful
+    # decode NMS on the host CPU backend (recompiling per shape over the
+    # TPU tunnel is wasteful), padded to a fixed-size bucket: per-image
+    # detection counts vary, and an exact-N jit would recompile for nearly
+    # every eval image (seconds each on this host — the former n=500 eval
+    # bottleneck).  Pad rows score -1 sort behind real ones and decode to
+    # class -1, which the metric update drops.
     import jax
 
+    cap = 512
+    n = dat.shape[1]
+    if n < cap:
+        pad = np.full((1, cap - n, 6), -1, np.float32)
+        dat = np.concatenate([dat, pad], axis=1)
+    else:
+        dat = dat[:, np.argsort(-dat[0, :, 1])[:cap]]
     with jax.default_device(jax.devices("cpu")[0]):
         out = np.asarray(box_nms(
             jnp.asarray(dat), overlap_thresh=nms_thresh, coord_start=2,
@@ -86,9 +108,15 @@ def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--resnet101", action="store_true")
     p.add_argument("--steps", type=int, default=None)
-    p.add_argument("--eval-images", type=int, default=32)
+    p.add_argument("--eval-images", type=int, default=500,
+                   help="held-out eval set size; n=500 bounds mAP noise to "
+                        "a few points (the old n=48 default produced the "
+                        "spurious 3000-vs-6000-step 'regression', "
+                        "QUALITY.md)")
     p.add_argument("--classes", type=int, default=3)
     p.add_argument("--lr", type=float, default=2e-3)
+    p.add_argument("--map-floor", type=float, default=None,
+                   help="exit 1 if final mAP falls below this (CI tier)")
     p.add_argument("--live-bn", action="store_true",
                    help="train BatchNorm statistics (from-scratch runs; the "
                         "frozen-BN recipe assumes pretrained weights)")
@@ -154,6 +182,9 @@ def main():
     print("FINAL rfcn %s synthetic-VOC %s = %.4f  (steps=%d, classes=%d, "
           "eval n=%d)" % ("resnet101" if args.resnet101 else "tiny",
                           name, value, steps, classes, args.eval_images))
+    if args.map_floor is not None and value < args.map_floor:
+        print("FAIL: mAP %.4f below floor %.4f" % (value, args.map_floor))
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -39,6 +39,7 @@ class Executor:
             self._grad_req = dict(grad_req or {})
         self.outputs = []
         self._monitor = None
+        self._monitor_all = False
         self._fwd_cache = {}
         self._bwd_cache = None
         self._plan = self._make_plan()
@@ -176,8 +177,19 @@ class Executor:
         arg_vals = [self.arg_dict[n]._data for n in self._arg_names]
         aux_vals = [self.aux_dict[n]._data for n in self._aux_names]
         key = _rnd.next_key()
+        from . import profiler as _prof
+
+        _pt0 = _prof._now_us() if _prof._symbolic_profiling_active() else None
         if self._monitor is not None:
             cb = self._monitor
+            if self._monitor_all:
+                # reference monitor_all=True also reports every node INPUT
+                # (graph_executor.cc ExecuteMonCallback input loop) — for a
+                # flat executor that is the arg/aux arrays themselves
+                for n in self._arg_names:
+                    cb(n, self.arg_dict[n])
+                for n in self._aux_names:
+                    cb(n, self.aux_dict[n])
             heads, new_aux = self._graph_fn(
                 bool(is_train), monitor=lambda n, v: cb(n, _wrap(v))
             )(arg_vals, aux_vals, key)
@@ -188,6 +200,10 @@ class Executor:
         self.outputs = [_wrap(h) for h in heads]
         self._last_key = key
         self._last_is_train = bool(is_train)
+        if _pt0 is not None:
+            # duration = trace+enqueue (async dispatch), same caveat as the
+            # eager per-op events; the XLA device timeline is use_xla_trace
+            _prof._emit_op("Executor::Forward", _pt0, _prof._now_us() - _pt0)
         return self.outputs
 
     def backward(self, out_grads=None, is_train=True):
@@ -201,6 +217,9 @@ class Executor:
         )
         if not diff_names:
             return
+        from . import profiler as _prof
+
+        _pt0 = _prof._now_us() if _prof._symbolic_profiling_active() else None
         aux_vals = [self.aux_dict[n]._data for n in self._aux_names]
         key = getattr(self, "_last_key", None)
         if key is None:
@@ -251,6 +270,9 @@ class Executor:
                 tgt._rebind(tgt._data + g)
             else:
                 tgt._rebind(g)
+        if _pt0 is not None:
+            _prof._emit_op("Executor::Backward", _pt0,
+                           _prof._now_us() - _pt0)
 
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
         """Re-bind with new shapes (reference GraphExecutor::Reshape:1053).
@@ -295,8 +317,10 @@ class Executor:
 
     def set_monitor_callback(self, callback, monitor_all=False):
         """Install per-output inspection (reference executor.h:172 monitor).
-        Forward runs un-jitted while a monitor is installed."""
+        Forward runs un-jitted while a monitor is installed; monitor_all
+        additionally reports node inputs (args/aux — weights included)."""
         self._monitor = callback
+        self._monitor_all = bool(monitor_all)
 
     @property
     def output_dict(self):

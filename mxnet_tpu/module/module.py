@@ -315,14 +315,17 @@ class Module(BaseModule):
         if is_train is None:
             is_train = self.for_training
 
-        # MutableModule semantics: reshape on a new batch shape
-        new_descs = _as_descs(data_batch.provide_data) if data_batch.provide_data else [
+        # MutableModule semantics: reshape on a new batch shape.  Any object
+        # with a .data list is a valid batch (reference module.py duck-types
+        # the same way — example/python-howto/debug_conv.py SimpleData)
+        provide = getattr(data_batch, "provide_data", None)
+        new_descs = _as_descs(provide) if provide else [
             DataDesc(n, a.shape) for n, a in zip(self._data_names, data_batch.data)
         ]
         if [d.shape for d in new_descs] != [d.shape for d in self._data_shapes]:
-            if data_batch.provide_label:
+            if getattr(data_batch, "provide_label", None):
                 new_labels = _as_descs(data_batch.provide_label)
-            elif data_batch.label is not None and self._label_shapes:
+            elif getattr(data_batch, "label", None) is not None and self._label_shapes:
                 new_labels = [DataDesc(n, a.shape) for n, a in zip(self._label_names, data_batch.label)]
             elif self._label_shapes:
                 # label-less batch (predict): rescale label batch dims to match
@@ -336,7 +339,7 @@ class Module(BaseModule):
         feed = {}
         for name, arr in zip(self._data_names, data_batch.data):
             feed[name] = arr
-        if self._label_shapes and data_batch.label is not None:
+        if self._label_shapes and getattr(data_batch, "label", None) is not None:
             for name, arr in zip(self._label_names, data_batch.label):
                 feed[name] = arr
         elif self._label_shapes:

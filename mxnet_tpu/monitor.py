@@ -30,7 +30,8 @@ class Monitor:
     ``pattern`` (regex on tensor names), ``sort`` (sort results by name).
     """
 
-    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
+                 monitor_all=False):
         if stat_func is None:
 
             def stat_func(x):
@@ -44,6 +45,9 @@ class Monitor:
         self.exes = []
         self.re_prog = re.compile(pattern)
         self.sort = sort
+        # reference monitor.py:66 — monitor inputs+outputs of every node,
+        # not just outputs; install() inherits this unless overridden
+        self.monitor_all = monitor_all
 
     # executor callback — receives (name, value) per node output
     def _stat_helper(self, name, value):
@@ -52,8 +56,10 @@ class Monitor:
         arr = np.asarray(value)
         self.queue.append((self.step, name, self.stat_func(arr)))
 
-    def install(self, exe, monitor_all=False):
+    def install(self, exe, monitor_all=None):
         """Attach to an executor (reference Monitor.install)."""
+        if monitor_all is None:
+            monitor_all = self.monitor_all
         exe.set_monitor_callback(self._stat_helper, monitor_all)
         self.exes.append(exe)
 

@@ -304,6 +304,36 @@ def load(fname):
 
 
 # ---------------------------------------------------------------------------
+# module-level arithmetic helpers (reference mxnet/ndarray/ndarray.py
+# add/subtract/... — scalar-or-array aware; the NDArray magic methods
+# already dispatch to broadcast/scalar ops, so delegate to them)
+# ---------------------------------------------------------------------------
+
+def _arith(name, op):
+    def f(lhs, rhs):
+        if not isinstance(lhs, NDArray) and not isinstance(rhs, NDArray):
+            if np.isscalar(lhs) and np.isscalar(rhs):
+                # reference _ufunc_helper returns a plain Python number
+                # for scalar-scalar
+                return op(lhs, rhs)
+            lhs = array(lhs)
+        return op(lhs, rhs)
+
+    f.__name__ = name
+    f.__doc__ = ("Element-wise %s with scalar-or-array operands "
+                 "(reference ndarray.py %s)." % (name, name))
+    return f
+
+
+add = _arith("add", lambda l, r: l + r)
+subtract = _arith("subtract", lambda l, r: l - r)
+multiply = _arith("multiply", lambda l, r: l * r)
+divide = _arith("divide", lambda l, r: l / r)
+true_divide = _arith("true_divide", lambda l, r: l / r)
+modulo = _arith("modulo", lambda l, r: l % r)
+power = _arith("power", lambda l, r: l ** r)
+
+# ---------------------------------------------------------------------------
 # nd.random namespace (reference mxnet/ndarray/random.py)
 # ---------------------------------------------------------------------------
 
@@ -311,30 +341,41 @@ random = types.ModuleType(__name__ + ".random")
 random.__doc__ = "Random distribution generators (reference nd.random)."
 
 
-def _make_random(fname, opname):
+def _make_random(fname, opname, posnames):
     opdef = _registry.get(opname)
 
     def rnd_func(*args, **kwargs):
-        return _invoke(opdef, args, kwargs)
+        # reference nd.random samplers take their distribution params
+        # positionally (mxnet/ndarray/random.py uniform(low, high, shape...));
+        # map them onto the op's keyword-only params
+        for name, val in zip(posnames, args):
+            if name in kwargs:
+                raise TypeError("%s() got multiple values for '%s'"
+                                % (fname, name))
+            kwargs[name] = val
+        extra = args[len(posnames):]
+        return _invoke(opdef, extra, kwargs)
 
     rnd_func.__name__ = fname
     rnd_func.__doc__ = opdef.__doc__
     return rnd_func
 
 
-for _fname, _opname in [
-    ("uniform", "_random_uniform"),
-    ("normal", "_random_normal"),
-    ("gamma", "_random_gamma"),
-    ("exponential", "_random_exponential"),
-    ("poisson", "_random_poisson"),
-    ("negative_binomial", "_random_negative_binomial"),
-    ("generalized_negative_binomial", "_random_generalized_negative_binomial"),
-    ("randint", "_random_randint"),
-    ("multinomial", "_sample_multinomial"),
-    ("shuffle", "_shuffle"),
+for _fname, _opname, _pos in [
+    ("uniform", "_random_uniform", ("low", "high", "shape", "dtype")),
+    ("normal", "_random_normal", ("loc", "scale", "shape", "dtype")),
+    ("gamma", "_random_gamma", ("alpha", "beta", "shape", "dtype")),
+    ("exponential", "_random_exponential", ("lam", "shape", "dtype")),
+    ("poisson", "_random_poisson", ("lam", "shape", "dtype")),
+    ("negative_binomial", "_random_negative_binomial",
+     ("k", "p", "shape", "dtype")),
+    ("generalized_negative_binomial", "_random_generalized_negative_binomial",
+     ("mu", "alpha", "shape", "dtype")),
+    ("randint", "_random_randint", ("low", "high", "shape", "dtype")),
+    ("multinomial", "_sample_multinomial", ()),
+    ("shuffle", "_shuffle", ()),
 ]:
-    setattr(random, _fname, _make_random(_fname, _opname))
+    setattr(random, _fname, _make_random(_fname, _opname, _pos))
 sys.modules[random.__name__] = random
 
 # ---------------------------------------------------------------------------
@@ -349,3 +390,37 @@ from .sparse import (  # noqa: E402,F401
 )
 
 __all__ += ["sparse", "BaseSparseNDArray", "CSRNDArray", "RowSparseNDArray", "cast_storage"]
+
+# ---------------------------------------------------------------------------
+# fluent methods (reference mxnet/ndarray/ndarray.py "Convenience fluent
+# method for X" set — x.log() ≡ nd.log(x) for every listed op).  Hand-written
+# methods on NDArray win; only the missing ones are attached here.
+# ---------------------------------------------------------------------------
+
+_FLUENT = (
+    "reshape_like zeros_like ones_like broadcast_axes repeat pad swapaxes "
+    "split slice slice_axis slice_like take one_hot pick sort topk argsort "
+    "argmax argmax_channel argmin clip abs sign flatten expand_dims tile "
+    "transpose flip sum nansum prod nanprod mean max min norm round rint "
+    "fix floor ceil trunc sin cos tan arcsin arccos arctan degrees radians "
+    "sinh cosh tanh arcsinh arccosh arctanh exp expm1 log log10 log2 log1p "
+    "sqrt rsqrt cbrt rcbrt square reciprocal relu sigmoid softmax "
+    "log_softmax squeeze"
+).split()
+
+
+def _make_fluent(opname):
+    opdef = _registry.get(opname)
+
+    def fluent(self, *args, **kwargs):
+        return _invoke(opdef, (self,) + args, kwargs)
+
+    fluent.__name__ = opname
+    fluent.__doc__ = ("Convenience fluent method for nd.%s (reference "
+                      "ndarray.py fluent set)." % opname)
+    return fluent
+
+
+for _fname in _FLUENT:
+    if not hasattr(NDArray, _fname) and _registry.exists(_fname):
+        setattr(NDArray, _fname, _make_fluent(_fname))

@@ -710,8 +710,15 @@ def _nms_alive_blocked(boxes, thresh, tile=256, plus_one=1.0, valid=None,
                 plus_one=float(plus_one), force_suppress=force_suppress,
                 interpret=interpret)
 
-        if impl == "pallas":  # forced (tests); interpret off-TPU
-            return pallas_path(jax.default_backend() != "tpu")
+        if impl == "pallas":
+            # forced: pallas on every platform, but the interpret choice
+            # must follow the LOWERING platform, not default_backend() — a
+            # CPU-placed NMS in a TPU process (eval decode under
+            # jax.default_device(cpu), the consistency tier's CPU leg)
+            # cannot lower a Mosaic kernel
+            return jax.lax.platform_dependent(
+                tpu=lambda: pallas_path(False),
+                default=lambda: pallas_path(True))
         if N >= 1024:
             return jax.lax.platform_dependent(
                 tpu=lambda: pallas_path(False),

@@ -82,6 +82,16 @@ def _op_profiling_active():
     )
 
 
+def _symbolic_profiling_active():
+    """Per-forward/backward hook for the symbolic executor
+    (reference profile_symbolic: GraphExecutor operator bracketing)."""
+    return (
+        _state == "run"
+        and not _paused
+        and (_config["profile_symbolic"] or _config["profile_all"])
+    )
+
+
 def _emit_op(name, t0_us, dur_us):
     """One operator execution (reference ThreadedEngine::ExecuteOprBlock
     bracketing, threaded_engine.h:335). Eager jax dispatch is async, so the

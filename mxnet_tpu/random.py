@@ -9,7 +9,10 @@ from __future__ import annotations
 
 import threading
 
-__all__ = ["seed", "next_key", "current_seed", "key_provider"]
+__all__ = ["seed", "next_key", "current_seed", "key_provider",
+           "uniform", "normal", "randn", "gamma", "exponential", "poisson",
+           "negative_binomial", "generalized_negative_binomial", "randint",
+           "multinomial", "shuffle"]
 
 _state = threading.local()
 
@@ -79,3 +82,40 @@ class key_provider:
 
     def __exit__(self, *a):
         _state.provider = self._prev
+
+
+# ---------------------------------------------------------------------------
+# module-level samplers (reference python/mxnet/random.py re-exports the
+# nd.random generators at mx.random.*; randn is the positional-shape variant
+# of normal, random.py:126)
+# ---------------------------------------------------------------------------
+
+def _delegate(name):
+    def f(*args, **kwargs):
+        from .ndarray import random as _ndrandom
+
+        return getattr(_ndrandom, name)(*args, **kwargs)
+
+    f.__name__ = name
+    f.__doc__ = "mx.random.%s — see nd.random.%s (reference random.py)." % (
+        name, name)
+    return f
+
+
+uniform = _delegate("uniform")
+normal = _delegate("normal")
+gamma = _delegate("gamma")
+exponential = _delegate("exponential")
+poisson = _delegate("poisson")
+negative_binomial = _delegate("negative_binomial")
+generalized_negative_binomial = _delegate("generalized_negative_binomial")
+randint = _delegate("randint")
+multinomial = _delegate("multinomial")
+shuffle = _delegate("shuffle")
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype="float32"):
+    """Standard-normal sample with positional dims (reference random.py randn)."""
+    from .ndarray import random as _ndrandom
+
+    return _ndrandom.normal(loc, scale, tuple(shape) or (1,), dtype)

@@ -243,3 +243,25 @@ def simple_forward(sym, ctx=None, is_train=False, **inputs):
 
 def discard_stderr(fn):
     return fn
+
+
+def load_module_by_path(path, name=None):
+    """Import a python file by explicit path, bypassing sys.path.
+
+    Several example families reuse file names (two ``train_fused.py``, two
+    ``metric.py``), so ``sys.path``-based imports silently grab whichever
+    directory was prepended last — tests and cross-example imports load by
+    path instead.
+    """
+    import importlib.util
+    import os
+    import sys
+
+    if name is None:
+        name = "_bypath_" + os.path.abspath(path).strip(os.sep).replace(
+            os.sep, "_").replace("-", "_").replace(".", "_")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod

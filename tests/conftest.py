@@ -46,18 +46,17 @@ def load_example_module(name, path):
     """Load an example file under a UNIQUE sys.modules name (several example
     dirs ship a ``train.py``; a bare ``import train`` resolves to whichever
     one another test cached first — order-dependent failures).  Cached by
-    name so repeated loads don't re-execute top-level work."""
-    import importlib.util
+    name so repeated loads don't re-execute top-level work.  The load itself
+    is ``mxnet_tpu.test_utils.load_module_by_path`` (the one shared
+    implementation)."""
     import sys
 
     if name in sys.modules:
         return sys.modules[name]
-    spec = importlib.util.spec_from_file_location(name, path)
-    mod = importlib.util.module_from_spec(spec)
-    sys.modules[name] = mod
+    from mxnet_tpu.test_utils import load_module_by_path
+
     try:
-        spec.loader.exec_module(mod)
+        return load_module_by_path(path, name)
     except BaseException:
-        del sys.modules[name]  # never leave a half-initialized entry
+        sys.modules.pop(name, None)  # never leave a half-initialized entry
         raise
-    return mod

@@ -1,6 +1,5 @@
 """Smoke tests for the detection example models (reference example/ssd,
 example/rcnn — SURVEY §2.4 required end-to-end capability)."""
-import importlib.util
 import os
 import sys
 
@@ -14,11 +13,9 @@ _EX = os.path.join(os.path.dirname(__file__), "..", "examples")
 
 
 def _load(name, path):
-    spec = importlib.util.spec_from_file_location(name, path)
-    mod = importlib.util.module_from_spec(spec)
-    sys.modules[name] = mod
-    spec.loader.exec_module(mod)
-    return mod
+    from conftest import load_example_module
+
+    return load_example_module(name, path)
 
 
 @pytest.fixture(scope="module")

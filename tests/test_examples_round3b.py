@@ -1,0 +1,128 @@
+"""Round-3 example families, second wave (VERDICT round-2 missing item 1):
+numpy-ops, module, python-howto, profiler, captcha, cnn_visualization,
+deep-embedded-clustering, multivariate_time_series, rnn-time-major,
+kaggle-ndsb1/2, memcost, cnn_chinese_text_classification, adversarial_vae.
+Each test is the family's synthetic E2E run at reduced scale (nightly
+tier)."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+EX = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "examples"))
+
+
+def _load(family, fname):
+    """Load an example module by explicit path (several families reuse
+    file names, so sys.path imports would collide)."""
+    from mxnet_tpu.test_utils import load_module_by_path
+
+    return load_module_by_path(
+        os.path.join(EX, family, fname),
+        "_ex_%s_%s" % (family.replace("-", "_"), fname[:-3]))
+
+
+def test_numpy_ops_custom_softmax_learns():
+    m = _load("numpy-ops", "custom_softmax.py")
+    assert m.main(epochs=8) > 0.9
+
+
+def test_numpy_ops_weighted_logistic_grads():
+    m = _load("numpy-ops", "weighted_logistic_regression.py")
+    # pos/neg grad scale 5.0/0.1 must actually skew the gradient magnitudes
+    assert m.main(pos=5.0, neg=0.1) > 5.0
+
+
+def test_module_mnist_mlp_checkpoint_roundtrip():
+    m = _load("module", "mnist_mlp.py")
+    assert m.main(epochs=6) > 0.9
+
+
+def test_module_python_loss_hinge():
+    m = _load("module", "python_loss.py")
+    assert m.main(epochs=8) > 0.9
+
+
+def test_python_howto_trio():
+    mo = _load("python-howto", "multiple_outputs.py")
+    feats, probs = mo.main()
+    assert feats == (4, 128)
+    dc = _load("python-howto", "debug_conv.py")
+    assert dc.main().shape == (1, 1, 5, 5)
+    mw = _load("python-howto", "monitor_weights.py")
+    seen = mw.main(batches=4)
+    assert any("weight" in n for n in seen)
+
+
+def test_python_howto_data_iter_rec_pipeline():
+    di = _load("python-howto", "data_iter.py")
+    assert di.main() == 48
+
+
+def test_profiler_traces():
+    pm = _load("profiler", "profiler_matmul.py")
+    assert pm.main(iter_num=8, begin=2, end=6, n=64) > 0
+    pn = _load("profiler", "profiler_ndarray.py")
+    assert pn.main() > 0
+
+
+def test_captcha_multi_digit():
+    m = _load("captcha", "captcha_recognition.py")
+    per_digit, _per_captcha = m.main(epochs=5, n_train=1024, n_val=128)
+    assert per_digit > 0.8
+
+
+def test_cnn_visualization_gradcam():
+    m = _load("cnn_visualization", "gradcam.py")
+    cam, sal = m.main()
+    assert cam.shape == (1, 16, 16)
+    # the class-evidence peak must land in the bright quadrant
+    iy, ix = np.unravel_index(cam[0].argmax(), cam[0].shape)
+    assert iy >= 6 and ix >= 6, (iy, ix)
+    assert sal.shape == (1, 3, 32, 32)
+
+
+def test_dec_clusters_blobs():
+    m = _load("deep-embedded-clustering", "dec.py")
+    assert m.main(n=900, max_iter=8) > 0.6
+
+
+def test_lstnet_beats_persistence():
+    m = _load("multivariate_time_series", "lstnet.py")
+    mse, naive = m.main(epochs=5)
+    assert mse < naive * 0.25, (mse, naive)
+
+
+def test_rnn_time_major_lm():
+    m = _load("rnn-time-major", "rnn_cell_demo.py")
+    ppl = m.main(epochs=3)
+    assert ppl < 6.0, ppl  # uniform = vocab = 12
+
+
+def test_ndsb1_plankton_shapes():
+    m = _load("kaggle-ndsb1", "train_dsb.py")
+    assert m.main(epochs=8, n_train=512, n_val=96) > 0.7
+
+
+def test_ndsb2_cdf_crps():
+    m = _load("kaggle-ndsb2", "Train.py")
+    crps, base = m.main(epochs=8, n_train=256, n_val=64)
+    assert crps < base, (crps, base)
+
+
+def test_memcost_mirror_tradeoff():
+    m = _load("memcost", "inception_memcost.py")
+    (f0, _), (f1, _) = m.main()
+    assert f1 > f0 * 1.1  # recompute engaged
+
+
+def test_chinese_char_cnn():
+    m = _load("cnn_chinese_text_classification", "text_cnn.py")
+    assert m.main(epochs=6) > 0.85
+
+
+def test_adversarial_vae_learned_similarity():
+    m = _load("adversarial_vae", "vaegan.py")
+    mse, base = m.main(epochs=4, n=384)
+    assert mse < base, (mse, base)
