@@ -55,6 +55,11 @@ case "$tier" in
     MXNET_TEST_DEVICE=tpu python -m pytest tests/test_consistency_tpu.py -q
     python bench.py
     MXNET_BENCH=resnet50 python bench.py
+    # detection-quality gate on the chip (VERDICT r2 item 5): full R-101
+    # recipe, on-device synthetic stream, n=500 eval; calibrated 0.1757 —
+    # floor at 0.10 (see QUALITY.md)
+    python examples/quality/eval_rfcn_map.py --resnet101 --steps 3000 \
+      --live-bn --map-floor 0.10
     ;;
   all)
     "$SELF" unit

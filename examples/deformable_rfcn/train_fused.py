@@ -50,6 +50,48 @@ def synthetic_coco(rng, batch, image_shape, classes, max_gts):
     return data, im_info, gt
 
 
+def synthetic_coco_device(key, batch, image_shape, classes, max_gts):
+    """``synthetic_coco`` generated ON DEVICE from a PRNG key (all jnp; call
+    inside jit).  Same construction — noise canvas, 1..min(G,8) rectangles
+    of 0.08-0.5 relative size painted +0.8 onto channel ``cls % 3``, raw
+    float coords in gt, -1 padding — but zero host work and zero H2D: over
+    the tunnel, host-side generation costs ~0.6 s/step of transfer (a 608
+    x1024 batch is 7.5 MB at ~15 MB/s) vs ~10 ms dispatch for this path."""
+    import jax
+    import jax.numpy as jnp
+
+    h, w = image_shape
+    kn, kg, kc, kw, kh, kx, ky = jax.random.split(key, 7)
+    data = jax.random.uniform(kn, (batch, 3, h, w), jnp.float32) * 0.2
+    n_boxes = jax.random.randint(kg, (batch,), 1, min(max_gts, 8) + 1)
+    cls = jax.random.randint(kc, (batch, max_gts), 0, classes)
+    bw = (jax.random.uniform(kw, (batch, max_gts)) * 0.42 + 0.08) * w
+    bh = (jax.random.uniform(kh, (batch, max_gts)) * 0.42 + 0.08) * h
+    x1 = jax.random.uniform(kx, (batch, max_gts)) * (w - bw)
+    y1 = jax.random.uniform(ky, (batch, max_gts)) * (h - bh)
+    valid = jnp.arange(max_gts)[None, :] < n_boxes[:, None]
+    gt = jnp.where(
+        valid[..., None],
+        jnp.stack([cls.astype(jnp.float32), x1, y1, x1 + bw, y1 + bh], -1),
+        -1.0)
+    yy = jnp.arange(h, dtype=jnp.float32)[:, None]
+    xx = jnp.arange(w, dtype=jnp.float32)[None, :]
+    chan = jax.nn.one_hot(cls % 3, 3)                      # (B, G, 3)
+
+    def paint(g, img):
+        # int() truncation bounds, as the host generator paints
+        m = ((yy >= jnp.floor(y1[:, g, None, None]))
+             & (yy < jnp.floor(y1[:, g] + bh[:, g])[:, None, None])
+             & (xx >= jnp.floor(x1[:, g, None, None]))
+             & (xx < jnp.floor(x1[:, g] + bw[:, g])[:, None, None])
+             & valid[:, g, None, None])
+        return img + 0.8 * m[:, None] * chan[:, g, :, None, None]
+
+    data = jax.lax.fori_loop(0, max_gts, paint, data)
+    im_info = jnp.tile(jnp.array([h, w, 1.0], jnp.float32), (batch, 1))
+    return data, im_info, gt
+
+
 def _smooth_l1(pred, target, weight, sigma):
     """Weighted smooth-L1 via the registered op (ops/elemwise.py smooth_l1,
     reference mshadow_op.h smooth_l1_loss)."""

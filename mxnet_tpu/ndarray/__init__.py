@@ -365,7 +365,6 @@ for _fname, _opname, _pos in [
     ("uniform", "_random_uniform", ("low", "high", "shape", "dtype")),
     ("normal", "_random_normal", ("loc", "scale", "shape", "dtype")),
     ("gamma", "_random_gamma", ("alpha", "beta", "shape", "dtype")),
-    ("exponential", "_random_exponential", ("lam", "shape", "dtype")),
     ("poisson", "_random_poisson", ("lam", "shape", "dtype")),
     ("negative_binomial", "_random_negative_binomial",
      ("k", "p", "shape", "dtype")),
@@ -376,6 +375,20 @@ for _fname, _opname, _pos in [
     ("shuffle", "_shuffle", ()),
 ]:
     setattr(random, _fname, _make_random(_fname, _opname, _pos))
+
+
+def _random_exponential_frontend(scale=1.0, shape=(1,), dtype="float32",
+                                 **kwargs):
+    """Reference nd.random.exponential takes the MEAN (``scale``) and
+    converts to the op's rate (mxnet/ndarray/random.py exponential:
+    lam = 1/scale); the raw-rate form stays available as
+    ``nd._random_exponential(lam=...)``."""
+    opdef = _registry.get("_random_exponential")
+    return _invoke(opdef, (), dict(lam=1.0 / scale, shape=shape,
+                                   dtype=dtype, **kwargs))
+
+
+random.exponential = _random_exponential_frontend
 sys.modules[random.__name__] = random
 
 # ---------------------------------------------------------------------------

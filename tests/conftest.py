@@ -48,15 +48,11 @@ def load_example_module(name, path):
     one another test cached first — order-dependent failures).  Cached by
     name so repeated loads don't re-execute top-level work.  The load itself
     is ``mxnet_tpu.test_utils.load_module_by_path`` (the one shared
-    implementation)."""
+    implementation, which also owns the failed-exec cleanup)."""
     import sys
 
     if name in sys.modules:
         return sys.modules[name]
     from mxnet_tpu.test_utils import load_module_by_path
 
-    try:
-        return load_module_by_path(path, name)
-    except BaseException:
-        sys.modules.pop(name, None)  # never leave a half-initialized entry
-        raise
+    return load_module_by_path(path, name)

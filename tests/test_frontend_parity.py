@@ -1,0 +1,123 @@
+"""Frontend parity surfaces added in round 3: NDArray fluent methods,
+module-level arithmetic helpers, positional random-sampler args,
+mx.random re-exports, Monitor(monitor_all=), symbolic profiler events
+(reference python/mxnet/{ndarray/ndarray.py,random.py,monitor.py}
+fluent/ufunc/sampler sets)."""
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_fluent_methods_match_functions():
+    x = nd.array(np.linspace(0.5, 2.0, 12).reshape(3, 4).astype(np.float32))
+    for name in ["log", "exp", "sqrt", "square", "sigmoid", "tanh", "relu",
+                 "floor", "ceil", "round", "log1p", "expm1", "rsqrt"]:
+        np.testing.assert_allclose(
+            getattr(x, name)().asnumpy(),
+            getattr(nd, name)(x).asnumpy(), rtol=1e-6,
+            err_msg=name)
+
+
+def test_fluent_with_args_and_chaining():
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose(x.sum(axis=1).asnumpy(), [6, 22, 38])
+    assert x.topk(k=2).shape == (3, 2)
+    chained = x.square().sum(axis=0).sqrt()
+    np.testing.assert_allclose(chained.asnumpy(),
+                               np.sqrt((np.arange(12).reshape(3, 4) ** 2)
+                                       .sum(0)), rtol=1e-6)
+
+
+def test_module_level_arith_helpers():
+    a = nd.array([2.0, 4.0])
+    np.testing.assert_allclose(nd.divide(a, 2.0).asnumpy(), [1, 2])
+    np.testing.assert_allclose(nd.divide(8.0, a).asnumpy(), [4, 2])
+    np.testing.assert_allclose(nd.power(a, 2).asnumpy(), [4, 16])
+    np.testing.assert_allclose(nd.subtract(a, a).asnumpy(), [0, 0])
+    np.testing.assert_allclose(nd.modulo(nd.array([5.0]), 3.0).asnumpy(), [2])
+    # scalar-scalar returns a plain python number (reference _ufunc_helper)
+    r = nd.multiply(3.0, 4.0)
+    assert isinstance(r, float) and r == 12.0
+
+
+def test_random_positional_args_and_reexports():
+    mx.random.seed(11)
+    u = mx.random.uniform(-2.0, -1.0, shape=(100,))
+    arr = u.asnumpy()
+    assert (arr >= -2).all() and (arr <= -1).all()
+    n = nd.random.normal(10.0, 0.1, (200,))
+    assert abs(float(n.asnumpy().mean()) - 10.0) < 0.1
+    r = mx.random.randn(3, 4)
+    assert r.shape == (3, 4)
+    ri = mx.random.randint(5, 8, shape=(50,))
+    vals = set(ri.asnumpy().astype(int).tolist())
+    assert vals.issubset({5, 6, 7})
+    # exponential takes the MEAN (scale), converted to the op's rate
+    # (reference random.py exponential: lam = 1/scale)
+    e = mx.random.exponential(4.0, shape=(4000,))
+    assert abs(float(e.asnumpy().mean()) - 4.0) < 0.5
+    with pytest.raises(TypeError):
+        nd.random.uniform(0.0, 1.0, low=0.5)  # duplicate param
+
+
+def test_monitor_all_reports_weights():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=4)
+    exe = net.simple_bind(mx.cpu(), data=(2, 3), grad_req="null")
+    exe.arg_dict["data"][:] = 1.0
+    seen = []
+    mon = mx.monitor.Monitor(1, pattern=".*", monitor_all=True)
+    mon.install(exe)
+    mon.tic()
+    exe.forward(is_train=False)
+    names = [n for _s, n, _v in mon.toc()]
+    assert "fc1_weight" in names  # inputs reported only with monitor_all
+    mon2 = mx.monitor.Monitor(1, pattern=".*")  # outputs only
+    mon2.install(exe)
+    mon2.tic()
+    exe.forward(is_train=False)
+    names2 = [n for _s, n, _v in mon2.toc()]
+    assert "fc1_weight" not in names2
+    assert any("output" in n for n in names2)
+
+
+def test_profile_symbolic_executor_events():
+    fname = os.path.join(tempfile.gettempdir(), "prof_sym_test.json")
+    mx.profiler.set_config(profile_symbolic=True, filename=fname)
+    mx.profiler.set_state("run")
+    x = mx.sym.Variable("x")
+    y = mx.sym.FullyConnected(x, num_hidden=4)
+    exe = y.simple_bind(mx.cpu(), x=(2, 3))
+    exe.arg_dict["x"][:] = 1.0
+    exe.forward(is_train=True)
+    exe.backward()
+    mx.profiler.set_state("stop")
+    mx.profiler.dump()
+    with open(fname) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"] if isinstance(trace, dict) else trace
+    names = [e.get("name") for e in events if isinstance(e, dict)]
+    assert "Executor::Forward" in names
+    assert "Executor::Backward" in names
+
+
+def test_module_forward_duck_typed_batch():
+    """Any object with .data is a batch (reference debug_conv.py idiom)."""
+
+    class SimpleData:
+        def __init__(self, data):
+            self.data = data
+
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data, kernel=(3, 3), pad=(1, 1), num_filter=1)
+    mod = mx.mod.Module(conv, label_names=())
+    mod.bind(data_shapes=[("data", (1, 3, 5, 5))])
+    mod.init_params()
+    mod.forward(SimpleData([nd.ones((1, 3, 5, 5))]), is_train=False)
+    assert mod.get_outputs()[0].shape == (1, 1, 5, 5)

@@ -1,0 +1,62 @@
+"""Tooling ports (reference ``tools/``): parse_log markdown tables,
+rec2idx index reconstruction, kill-mxnet command construction,
+diagnose report."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+TOOLS = os.path.join(REPO, "tools")
+
+
+def _load(fname):
+    from mxnet_tpu.test_utils import load_module_by_path
+
+    return load_module_by_path(os.path.join(TOOLS, fname))
+
+
+def test_parse_log_markdown():
+    pl = _load("parse_log.py")
+    lines = [
+        "INFO:root:Epoch[0] Train-accuracy=0.5",
+        "INFO:root:Epoch[0] Validation-accuracy=0.4",
+        "INFO:root:Epoch[0] Time cost=1.5",
+        "INFO:root:Epoch[1] Train-accuracy=0.8",
+        "noise line",
+    ]
+    d = pl.parse(lines)
+    assert d[0] == [0.5, 0.4, 1.5]
+    assert d[1][0] == 0.8
+    md = pl.to_markdown(d)
+    assert md.splitlines()[0].startswith("| epoch |")
+
+
+def test_rec2idx_roundtrip(tmp_path):
+    from mxnet_tpu import recordio
+
+    rec = str(tmp_path / "a.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    for i in range(7):
+        w.write(b"payload%d" % i)
+    w.close()
+    r2i = _load("rec2idx.py")
+    assert r2i.create_index(rec, rec + ".idx") == 7
+    r = recordio.MXIndexedRecordIO(rec + ".idx", rec, "r")
+    assert r.read_idx(5) == b"payload5"
+    r.close()
+
+
+def test_kill_mxnet_command():
+    km = _load("kill-mxnet.py")
+    cmd = km.kill_command("bob", "train.py")
+    assert "grep 'train.py'" in cmd and '"bob"' in cmd and "kill -9" in cmd
+
+
+def test_diagnose_runs():
+    res = subprocess.run([sys.executable, os.path.join(TOOLS, "diagnose.py")],
+                         capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stderr[-500:]
+    assert "Framework Info" in res.stdout
+    assert "jax" in res.stdout

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Environment diagnostics — reference ``tools/diagnose.py`` (OS /
+hardware / python / framework report users paste into bug reports).  The
+network-mirror checks are dropped (no egress here); in their place the
+TPU-relevant facts: jax/jaxlib versions, visible devices and platform,
+virtual-device env knobs, and whether the native C++ data plane loaded.
+
+Usage: python tools/diagnose.py            (with the ambient TPU env)
+       ./dev.sh python tools/diagnose.py   (CPU/virtual-mesh env)
+"""
+from __future__ import annotations
+
+import os
+import platform
+import sys
+
+
+def check_python():
+    print("----------Python Info----------")
+    print("Version      :", platform.python_version())
+    print("Compiler     :", platform.python_compiler())
+    print("Build        :", platform.python_build())
+    print("Arch         :", platform.architecture())
+
+
+def check_os():
+    print("----------System Info----------")
+    print("Platform     :", platform.platform())
+    print("system       :", platform.system())
+    print("node         :", platform.node())
+    print("release      :", platform.release())
+    print("version      :", platform.version())
+
+
+def check_hardware():
+    print("----------Hardware Info----------")
+    print("machine      :", platform.machine())
+    print("processor    :", platform.processor())
+    if platform.system() == "Linux":
+        try:
+            with open("/proc/cpuinfo") as f:
+                cores = sum(1 for ln in f if ln.startswith("processor"))
+            print("cpu cores    :", cores)
+            with open("/proc/meminfo") as f:
+                for ln in f:
+                    if ln.startswith(("MemTotal", "MemAvailable")):
+                        print(ln.strip())
+        except OSError:
+            pass
+
+
+def check_framework():
+    print("----------Framework Info----------")
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".."))
+    import mxnet_tpu as mx
+
+    print("mxnet_tpu    :", os.path.dirname(mx.__file__))
+    import jax
+    import jaxlib
+
+    print("jax          :", jax.__version__)
+    print("jaxlib       :", jaxlib.__version__)
+    print("backend      :", jax.default_backend())
+    for d in jax.devices():
+        print("device       :", d, "(platform=%s)" % d.platform)
+    for knob in ("JAX_PLATFORMS", "XLA_FLAGS", "PYTHONPATH"):
+        print("%-12s : %s" % (knob, os.environ.get(knob, "<unset>")))
+    for knob in sorted(k for k in os.environ if k.startswith("MXNET_")):
+        print("%-12s : %s" % (knob, os.environ[knob]))
+    from mxnet_tpu import _native
+
+    try:
+        _native.lib()
+        print("native io    : loaded")
+    except Exception as e:  # noqa: BLE001 — diagnostics must not crash
+        print("native io    : unavailable (%s; pure-python fallback)"
+              % type(e).__name__)
+
+
+def main():
+    check_python()
+    check_os()
+    check_hardware()
+    check_framework()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Kill stray training processes across a cluster — reference
+``tools/kill-mxnet.py`` (ssh to every host in a hostfile and kill the
+named program).  Matches the ``tools/launch.py`` ssh cluster mode of
+``parallel/dist.py``.
+
+Usage: python tools/kill-mxnet.py <hostfile> <user> <prog>
+"""
+from __future__ import annotations
+
+import subprocess
+import sys
+
+
+def kill_command(user, prog_name):
+    return (
+        "ps aux | "
+        "grep -v grep | "
+        "grep '" + prog_name + "' | "
+        "awk '{if($1==\"" + user + "\")print $2;}' | "
+        "xargs -r kill -9"
+    )
+
+
+def main(argv):
+    if len(argv) != 4:
+        print("usage: %s <hostfile> <user> <prog>" % argv[0])
+        return 1
+    host_file, user, prog_name = argv[1:4]
+    cmd = kill_command(user, prog_name)
+    print(cmd)
+    procs = []
+    with open(host_file) as f:
+        for host in f:
+            host = host.strip()
+            if not host:
+                continue
+            if ":" in host:
+                host = host[:host.index(":")]
+            print(host)
+            procs.append(subprocess.Popen(
+                ["ssh", "-oStrictHostKeyChecking=no", host, cmd],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    for p in procs:
+        p.wait()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Parse a training log into a markdown table — reference
+``tools/parse_log.py`` (same regexes over the Speedometer/epoch-callback
+log lines this repo's ``mx.callback`` emits).
+
+Usage: python tools/parse_log.py train.log --metric-names accuracy
+"""
+from __future__ import annotations
+
+import argparse
+import re
+
+
+def parse(lines, metric_names=("accuracy",)):
+    """→ {epoch: [train_m0, val_m0, ..., time]} (reference parse loop)."""
+    res = ([re.compile(r".*Epoch\[(\d+)\] Train-" + s + r".*=([.\d]+)")
+            for s in metric_names]
+           + [re.compile(r".*Epoch\[(\d+)\] Validation-" + s + r".*=([.\d]+)")
+              for s in metric_names]
+           + [re.compile(r".*Epoch\[(\d+)\] Time.*=([.\d]+)")])
+    data = {}
+    for line in lines:
+        for i, r in enumerate(res):
+            m = r.match(line)
+            if m is not None:
+                epoch = int(m.groups()[0])
+                val = float(m.groups()[1])
+                row = data.setdefault(epoch, [[0.0, 0] for _ in res])
+                row[i][0] += val
+                row[i][1] += 1
+                break
+    return {e: [c[0] / c[1] if c[1] else float("nan") for c in row]
+            for e, row in sorted(data.items())}
+
+
+def to_markdown(data, metric_names=("accuracy",)):
+    heads = (["epoch"] + ["train-%s" % s for s in metric_names]
+             + ["val-%s" % s for s in metric_names] + ["time"])
+    out = ["| " + " | ".join(heads) + " |",
+           "| " + " | ".join("---" for _ in heads) + " |"]
+    for e, vals in data.items():
+        out.append("| %d | %s |" % (e, " | ".join("%.4g" % v for v in vals)))
+    return "\n".join(out)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("logfile", nargs=1, type=str)
+    p.add_argument("--format", type=str, default="markdown",
+                   choices=["markdown", "none"])
+    p.add_argument("--metric-names", type=str, nargs="+",
+                   default=["accuracy"])
+    args = p.parse_args()
+    with open(args.logfile[0]) as f:
+        data = parse(f.readlines(), args.metric_names)
+    if args.format == "markdown":
+        print(to_markdown(data, args.metric_names))
+    return data
+
+
+if __name__ == "__main__":
+    main()

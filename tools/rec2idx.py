@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Rebuild the .idx companion of a .rec pack — reference
+``tools/rec2idx.py`` (IndexCreator walking the RecordIO framing and
+emitting ``key\\toffset`` lines so MXIndexedRecordIO can random-access).
+
+Usage: python tools/rec2idx.py data/train.rec data/train.idx
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+from mxnet_tpu import recordio
+
+
+def create_index(rec_path, idx_path, key_type=int):
+    """Sequential scan; record i gets key i at its byte offset (reference
+    IndexCreator.create_index)."""
+    reader = recordio.MXRecordIO(rec_path, "r")
+    counter = 0
+    with open(idx_path, "w") as f:
+        while True:
+            pos = reader.tell()
+            item = reader.read()
+            if item is None:
+                break
+            f.write("%s\t%d\n" % (key_type(counter), pos))
+            counter += 1
+    reader.close()
+    return counter
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("record", help="path to the .rec file")
+    p.add_argument("index", help="path of the .idx to write")
+    args = p.parse_args()
+    n = create_index(args.record, args.index)
+    print("wrote %d index entries to %s" % (n, args.index))
+
+
+if __name__ == "__main__":
+    main()
