@@ -11,11 +11,12 @@ __all__ = ["module_checkpoint", "do_checkpoint", "log_train_metric", "Speedomete
 
 def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
     """Checkpoint callback for Module (reference callback.py module_checkpoint)."""
-    period = int(max(1, period))
+    every = max(int(period), 1)
 
     def _callback(iter_no, sym=None, arg=None, aux=None):
-        if (iter_no + 1) % period == 0:
-            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
+        epoch = iter_no + 1
+        if epoch % every == 0:
+            mod.save_checkpoint(prefix, epoch, save_optimizer_states)
 
     return _callback
 
@@ -24,73 +25,76 @@ def do_checkpoint(prefix, period=1):
     """Epoch-end checkpoint callback (reference callback.py:55)."""
     from .model import save_checkpoint
 
-    period = int(max(1, period))
+    every = max(int(period), 1)
 
     def _callback(iter_no, sym, arg, aux):
-        if (iter_no + 1) % period == 0:
-            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+        epoch = iter_no + 1
+        if epoch % every == 0:
+            save_checkpoint(prefix, epoch, sym, arg, aux)
 
     return _callback
 
 
 def log_train_metric(period, auto_reset=False):
     def _callback(param):
-        if param.nbatch % period == 0 and param.eval_metric is not None:
-            name_value = param.eval_metric.get_name_value()
-            for name, value in name_value:
-                logging.info("Iter[%d] Batch[%d] Train-%s=%f", param.epoch, param.nbatch, name, value)
-            if auto_reset:
-                param.eval_metric.reset()
+        if param.nbatch % period or param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Iter[%d] Batch[%d] Train-%s=%f", param.epoch, param.nbatch, name, value)
+        if auto_reset:
+            param.eval_metric.reset()
 
     return _callback
 
 
 class Speedometer:
-    """Log samples/sec every `frequent` batches (reference callback.py:120)."""
+    """Log samples/sec every `frequent` batches (log-format parity with
+    reference callback.py:120; timing is tracked as a window mark that is
+    re-established whenever the batch counter rewinds, i.e. a new epoch)."""
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
         self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
         self.auto_reset = auto_reset
+        self._mark = None       # timestamp opening the current window
+        self._prev_count = -1
 
     def __call__(self, param):
         count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-        if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    if self.auto_reset:
-                        param.eval_metric.reset()
-                    msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec" % (param.epoch, count, speed)
-                    msg += "\t%s=%f" * len(name_value)
-                    logging.info(msg, *sum(name_value, ()))
-                else:
-                    logging.info(
-                        "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec", param.epoch, count, speed
-                    )
-                self.tic = time.time()
-        else:
-            self.init = True
-            self.tic = time.time()
+        rewound = count < self._prev_count
+        self._prev_count = count
+        if self._mark is None or rewound:
+            self._mark = time.time()
+            return
+        if count % self.frequent:
+            return
+        rate = self.frequent * self.batch_size / (time.time() - self._mark)
+        self._emit(param, count, rate)
+        self._mark = time.time()
+
+    def _emit(self, param, count, rate):
+        metric = param.eval_metric
+        if metric is None:
+            logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                         param.epoch, count, rate)
+            return
+        pairs = metric.get_name_value()
+        if self.auto_reset:
+            metric.reset()
+        parts = ["Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec" % (param.epoch, count, rate)]
+        parts.extend("%s=%f" % (name, value) for name, value in pairs)
+        logging.info("\t".join(parts))
 
 
 class ProgressBar:
     """ASCII progress bar (reference callback.py ProgressBar)."""
 
     def __init__(self, total, length=80):
-        self.bar_len = length
-        self.total = total
+        self.bar_len = int(length)
+        self.total = float(total)
 
     def __call__(self, param):
-        count = param.nbatch
-        filled_len = int(round(self.bar_len * count / float(self.total)))
-        percents = math.ceil(100.0 * count / float(self.total))
-        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
-        logging.info("[%s] %s%s\r", prog_bar, percents, "%")
+        frac = param.nbatch / self.total
+        filled = int(round(self.bar_len * frac))
+        bar = "=" * filled + "-" * (self.bar_len - filled)
+        logging.info("[%s] %s%s\r", bar, math.ceil(100.0 * frac), "%")

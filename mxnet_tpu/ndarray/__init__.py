@@ -362,15 +362,18 @@ def _make_random(fname, opname, posnames):
 
 
 for _fname, _opname, _pos in [
-    ("uniform", "_random_uniform", ("low", "high", "shape", "dtype")),
-    ("normal", "_random_normal", ("loc", "scale", "shape", "dtype")),
-    ("gamma", "_random_gamma", ("alpha", "beta", "shape", "dtype")),
-    ("poisson", "_random_poisson", ("lam", "shape", "dtype")),
+    # trailing ctx/out: the reference samplers accept them positionally too
+    # (mxnet/ndarray/random.py uniform(low, high, shape, dtype, ctx, out));
+    # _invoke already handles both as keywords
+    ("uniform", "_random_uniform", ("low", "high", "shape", "dtype", "ctx", "out")),
+    ("normal", "_random_normal", ("loc", "scale", "shape", "dtype", "ctx", "out")),
+    ("gamma", "_random_gamma", ("alpha", "beta", "shape", "dtype", "ctx", "out")),
+    ("poisson", "_random_poisson", ("lam", "shape", "dtype", "ctx", "out")),
     ("negative_binomial", "_random_negative_binomial",
-     ("k", "p", "shape", "dtype")),
+     ("k", "p", "shape", "dtype", "ctx", "out")),
     ("generalized_negative_binomial", "_random_generalized_negative_binomial",
-     ("mu", "alpha", "shape", "dtype")),
-    ("randint", "_random_randint", ("low", "high", "shape", "dtype")),
+     ("mu", "alpha", "shape", "dtype", "ctx", "out")),
+    ("randint", "_random_randint", ("low", "high", "shape", "dtype", "ctx", "out")),
     ("multinomial", "_sample_multinomial", ()),
     ("shuffle", "_shuffle", ()),
 ]:

@@ -232,7 +232,7 @@ def _nms_pallas_batched(boxes, valid, idv, thresh, plus_one, use_ids,
     return alive[:, 0, :N] > 0.0
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=64)  # keyed on per-call threshold: keep bounded
 def _nms_single(thresh, plus_one, use_ids, interpret):
     """Single-image entry with a custom vmap rule: a vmapped call lands on
     the natively-batched (B, nb) grid instead of pallas' generic batching

@@ -1,8 +1,15 @@
-"""Bucketed sequence IO — reference ``python/mxnet/rnn/io.py``
-(encode_sentences :30, BucketSentenceIter :78)."""
+"""Bucketed sequence IO.
+
+Parity surface: ``encode_sentences`` / ``BucketSentenceIter`` from the
+reference ``python/mxnet/rnn/io.py`` (behavioral contract only; the
+implementation here is organised around per-bucket padded matrices with
+permutation-based shuffling and fetch-time label shifting, which suits the
+TPU story: every bucket length is one static-shape jit specialization, so
+the iterator's job is to emit fixed-shape batches keyed by bucket length).
+"""
 from __future__ import annotations
 
-import bisect
+import logging
 import random as pyrandom
 
 import numpy as np
@@ -15,35 +22,50 @@ __all__ = ["encode_sentences", "BucketSentenceIter"]
 
 def encode_sentences(sentences, vocab=None, invalid_label=-1, invalid_key="\n",
                      start_label=0):
-    """Token lists -> id lists, building/extending vocab (reference :30)."""
-    idx = start_label
-    if vocab is None:
-        vocab = {invalid_key: invalid_label}
-        new_vocab = True
+    """Map token sequences to integer-id sequences.
+
+    With ``vocab=None`` a fresh vocabulary is grown on the fly: ids are
+    handed out in first-seen order starting at ``start_label``, and the id
+    reserved for padding (``invalid_label``, bound to ``invalid_key``) is
+    never assigned to a real token.  With an explicit ``vocab`` the mapping
+    is closed: unseen tokens are an error.
+
+    Returns ``(encoded, vocab)``.
+    """
+    if vocab is not None:
+        # Closed vocabulary: pure lookup, loud failure on novel tokens.
+        def lookup(tok):
+            assert tok in vocab, "Unknown token %s" % tok
+            return vocab[tok]
     else:
-        new_vocab = False
-    res = []
-    for sent in sentences:
-        coded = []
-        for word in sent:
-            if word not in vocab:
-                assert new_vocab, "Unknown token %s" % word
-                if idx == invalid_label:
-                    idx += 1
-                vocab[word] = idx
-                idx += 1
-            coded.append(vocab[word])
-        res.append(coded)
-    return res, vocab
+        vocab = {invalid_key: invalid_label}
+        counter = [start_label]
+
+        def lookup(tok):
+            known = vocab.get(tok)
+            if known is not None and (known != invalid_label or tok == invalid_key):
+                return known
+            nxt = counter[0]
+            if nxt == invalid_label:   # padding id stays reserved
+                nxt += 1
+            counter[0] = nxt + 1
+            vocab[tok] = nxt
+            return nxt
+
+    encoded = [[lookup(tok) for tok in sent] for sent in sentences]
+    return encoded, vocab
 
 
 class BucketSentenceIter(DataIter):
-    """Bucketed iterator over encoded sentences (reference :78).
+    """Fixed-shape batches over variable-length sequences via bucketing.
 
-    Pads each sentence up to its bucket length; yields batches whose
-    ``bucket_key`` is the bucket length (pairs with BucketingModule — on TPU
-    each bucket is one jit specialization, the reference's per-bucket
-    executor).
+    Each bucket length becomes one jit specialization downstream (the
+    reference's per-bucket executor, our per-bucket compiled step), so the
+    iterator groups sentences by the smallest bucket that fits, pads each
+    group into one dense ``(n_sent, bucket_len)`` matrix, and emits
+    ``batch_size``-row slices tagged with ``bucket_key``.  Labels are the
+    next-token shift of the data and are produced at fetch time rather than
+    materialised per epoch.
     """
 
     def __init__(self, sentences, batch_size, buckets=None, invalid_label=-1,
@@ -51,86 +73,92 @@ class BucketSentenceIter(DataIter):
                  layout="NT"):
         super().__init__(batch_size)
         if not buckets:
-            buckets = [
-                i for i, j in enumerate(np.bincount([len(s) for s in sentences]))
-                if j >= batch_size
-            ]
-        buckets.sort()
-        ndiscard = 0
-        self.data = [[] for _ in buckets]
-        for sent in sentences:
-            buck = bisect.bisect_left(buckets, len(sent))
-            if buck == len(buckets):
-                ndiscard += 1
-                continue
-            buff = np.full((buckets[buck],), invalid_label, dtype=dtype)
-            buff[: len(sent)] = sent
-            self.data[buck].append(buff)
-        # empty buckets must stay 2-D (0, bucket_len) so reset()'s label
-        # shift and batching indexing stay valid
-        self.data = [
-            np.asarray(i, dtype=dtype).reshape(len(i), buckets[k])
-            for k, i in enumerate(self.data)
-        ]
-        if ndiscard:
-            print("WARNING: discarded %d sentences longer than the largest bucket." % ndiscard)
-
+            buckets = self._auto_buckets(sentences, batch_size)
+        self.buckets = sorted(buckets)
         self.batch_size = batch_size
-        self.buckets = buckets
+        self.invalid_label = invalid_label
         self.data_name = data_name
         self.label_name = label_name
         self.dtype = dtype
-        self.invalid_label = invalid_label
-        self.nddata = []
-        self.ndlabel = []
-        self.major_axis = layout.find("N")
         self.layout = layout
-        self.default_bucket_key = max(buckets)
+        self.major_axis = layout.find("N")
+        self.default_bucket_key = max(self.buckets)
 
-        shape = (
-            (batch_size, self.default_bucket_key)
-            if self.major_axis == 0
-            else (self.default_bucket_key, batch_size)
-        )
-        self.provide_data = [DataDesc(data_name, shape, dtype, layout=layout)]
-        self.provide_label = [DataDesc(label_name, shape, dtype, layout=layout)]
+        self.data = self._pack(sentences)
+        self._schedule = []          # [(bucket_idx, start_row)] for one epoch
+        self._cursor = 0
 
-        self.idx = []
-        for i, buck in enumerate(self.data):
-            self.idx.extend([(i, j) for j in range(0, len(buck) - batch_size + 1, batch_size)])
-        self.curr_idx = 0
+        full_shape = (batch_size, self.default_bucket_key)
+        if self.major_axis != 0:
+            full_shape = full_shape[::-1]
+        self.provide_data = [DataDesc(data_name, full_shape, dtype, layout=layout)]
+        self.provide_label = [DataDesc(label_name, full_shape, dtype, layout=layout)]
         self.reset()
 
+    @staticmethod
+    def _auto_buckets(sentences, batch_size):
+        """One bucket per sentence length that occurs >= batch_size times."""
+        freq = {}
+        for sent in sentences:
+            freq[len(sent)] = freq.get(len(sent), 0) + 1
+        chosen = [n for n, c in sorted(freq.items()) if c >= batch_size]
+        assert chosen, "no bucket holds >= batch_size sentences; pass buckets="
+        return chosen
+
+    def _pack(self, sentences):
+        """Group sentences into dense padded matrices, one per bucket."""
+        groups = [[] for _ in self.buckets]
+        dropped = 0
+        for sent in sentences:
+            dest = None
+            for k, blen in enumerate(self.buckets):
+                if len(sent) <= blen:
+                    dest = k
+                    break
+            if dest is None:
+                dropped += 1
+                continue
+            groups[dest].append(sent)
+        if dropped:
+            logging.getLogger(__name__).warning(
+                "BucketSentenceIter: dropped %d sentences longer than the "
+                "largest bucket (%d)", dropped, self.buckets[-1])
+        packed = []
+        for blen, group in zip(self.buckets, groups):
+            mat = np.full((len(group), blen), self.invalid_label, dtype=self.dtype)
+            for row, sent in enumerate(group):
+                mat[row, : len(sent)] = sent
+            packed.append(mat)
+        return packed
+
     def reset(self):
-        self.curr_idx = 0
-        pyrandom.shuffle(self.idx)
-        for buck in self.data:
-            np.random.shuffle(buck)
-        self.nddata = []
-        self.ndlabel = []
-        for buck in self.data:
-            label = np.empty_like(buck)
-            label[:, :-1] = buck[:, 1:]
-            label[:, -1] = self.invalid_label
-            self.nddata.append(buck)
-            self.ndlabel.append(label)
+        """Reshuffle rows within buckets and the batch visitation order."""
+        self._cursor = 0
+        for k, mat in enumerate(self.data):
+            self.data[k] = mat[np.random.permutation(len(mat))]
+        self._schedule = [
+            (k, start)
+            for k, mat in enumerate(self.data)
+            for start in range(0, len(mat) - self.batch_size + 1, self.batch_size)
+        ]
+        pyrandom.shuffle(self._schedule)
 
     def next(self):
-        if self.curr_idx == len(self.idx):
+        if self._cursor >= len(self._schedule):
             raise StopIteration
-        i, j = self.idx[self.curr_idx]
-        self.curr_idx += 1
-        if self.major_axis == 1:
-            data = self.nddata[i][j : j + self.batch_size].T
-            label = self.ndlabel[i][j : j + self.batch_size].T
-        else:
-            data = self.nddata[i][j : j + self.batch_size]
-            label = self.ndlabel[i][j : j + self.batch_size]
+        k, start = self._schedule[self._cursor]
+        self._cursor += 1
+        rows = self.data[k][start : start + self.batch_size]
+        # next-token target: shift left, pad the final step
+        tail = np.full((rows.shape[0], 1), self.invalid_label, dtype=rows.dtype)
+        labels = np.concatenate([rows[:, 1:], tail], axis=1)
+        if self.major_axis != 0:   # time-major layout
+            rows, labels = rows.T, labels.T
         return DataBatch(
-            [array(data)],
-            [array(label)],
+            [array(rows)],
+            [array(labels)],
             pad=0,
-            bucket_key=self.buckets[i],
-            provide_data=[DataDesc(self.data_name, data.shape, self.dtype, layout=self.layout)],
-            provide_label=[DataDesc(self.label_name, label.shape, self.dtype, layout=self.layout)],
+            bucket_key=self.buckets[k],
+            provide_data=[DataDesc(self.data_name, rows.shape, self.dtype, layout=self.layout)],
+            provide_label=[DataDesc(self.label_name, labels.shape, self.dtype, layout=self.layout)],
         )

@@ -8,16 +8,19 @@ Usage: python tools/kill-mxnet.py <hostfile> <user> <prog>
 """
 from __future__ import annotations
 
+import shlex
 import subprocess
 import sys
 
 
 def kill_command(user, prog_name):
+    # shlex.quote: a prog/user containing shell metacharacters must not be
+    # able to break out of the remote pipeline
     return (
         "ps aux | "
         "grep -v grep | "
-        "grep '" + prog_name + "' | "
-        "awk '{if($1==\"" + user + "\")print $2;}' | "
+        "grep -F -- " + shlex.quote(prog_name) + " | "
+        "awk -v u=" + shlex.quote(user) + " '{if($1==u)print $2;}' | "
         "xargs -r kill -9"
     )
 
