@@ -27,7 +27,9 @@ NIGHTLY_FILES=(
   tests/test_example_deformable_rfcn.py
   tests/test_examples_round3.py
   tests/test_examples_round3b.py
+  tests/test_examples_round4.py
   tests/test_quality_map.py
+  tests/test_quality_map_frcnn.py
 )
 
 tier="${1:-unit}"

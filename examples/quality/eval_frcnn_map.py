@@ -1,0 +1,206 @@
+"""Faster R-CNN VGG16 detection quality: mAP on synthetic VOC-format data.
+
+BASELINE config 2's quality bar is VOC07 mAP 70.23
+(``example/rcnn/README.md:38-42``); real VOC cannot be fetched (no egress),
+so — exactly like the R-FCN gate (eval_rfcn_map.py) — this measures the
+strongest available proxy: the full jit-fused Faster-RCNN recipe
+(examples/rcnn/train_fused.py) trained on deterministic synthetic
+rectangles and evaluated with ``VOCMApMetric`` over a held-out stream.
+A rising mAP proves RPN → proposals → class-specific targets → ROIPooling
+→ fc heads → per-class decode+NMS learns detection end-to-end.
+
+Class-SPECIFIC decode: unlike R-FCN's class-agnostic head, each class c
+has its own 4 deltas at ``bbox_pred[:, 4(c+1):4(c+2)]``, un-normalized by
+BBOX_STDS before applying (reference rcnn/core/tester.py pred_eval →
+bbox_pred with stds multiplied back).
+
+Run (chip):      python examples/quality/eval_frcnn_map.py --vgg16
+Run (CPU smoke): ./dev.sh python examples/quality/eval_frcnn_map.py --steps 30
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_HERE, "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.functional import functionalize
+from mxnet_tpu.test_utils import load_module_by_path
+
+
+def _load(name, *relpath):
+    return load_module_by_path(os.path.join(_HERE, "..", *relpath), name)
+
+
+_ssd_metric = _load("_ssd_metric_frcnn", "ssd", "metric.py")
+_frcnn = _load("_frcnn_train_fused", "rcnn", "train_fused.py")
+VOCMApMetric = _ssd_metric.VOCMApMetric
+build_net = _frcnn.build_net
+make_frcnn_train_step = _frcnn.make_frcnn_train_step
+synthetic_voc = _frcnn.synthetic_voc
+synthetic_voc_device = _frcnn.synthetic_voc_device
+
+
+def decode_detections(rois, cls_prob, bbox_pred, num_classes, im_shape,
+                      box_stds=(0.1, 0.1, 0.2, 0.2),
+                      score_thresh=0.05, nms_thresh=0.3, max_det=100):
+    """rois (R,5) + class-specific deltas (R, 4(C+1)) → (1, K, 6)
+    [cls, score, x1..y2] after per-class delta application and NMS."""
+    from mxnet_tpu.ops.detection import box_nms
+
+    import jax
+    import jax.numpy as jnp
+
+    boxes = rois[:, 1:5]
+    w = boxes[:, 2] - boxes[:, 0] + 1.0
+    h = boxes[:, 3] - boxes[:, 1] + 1.0
+    cx = boxes[:, 0] + 0.5 * (w - 1.0)
+    cy = boxes[:, 1] + 0.5 * (h - 1.0)
+    stds = np.asarray(box_stds, np.float32)
+
+    rows = []
+    for c in range(num_classes):
+        d = bbox_pred[:, 4 * (c + 1): 4 * (c + 2)] * stds[None, :]
+        pcx = d[:, 0] * w + cx
+        pcy = d[:, 1] * h + cy
+        pw = np.exp(np.clip(d[:, 2], -10, 10)) * w
+        ph = np.exp(np.clip(d[:, 3], -10, 10)) * h
+        x1 = np.clip(pcx - 0.5 * (pw - 1.0), 0, im_shape[1] - 1)
+        y1 = np.clip(pcy - 0.5 * (ph - 1.0), 0, im_shape[0] - 1)
+        x2 = np.clip(pcx + 0.5 * (pw - 1.0), 0, im_shape[1] - 1)
+        y2 = np.clip(pcy + 0.5 * (ph - 1.0), 0, im_shape[0] - 1)
+        sc = cls_prob[:, c + 1]
+        keep = sc >= score_thresh
+        if not keep.any():
+            continue
+        rows.append(np.stack([
+            np.full(keep.sum(), c, np.float32), sc[keep],
+            x1[keep], y1[keep], x2[keep], y2[keep]], axis=1))
+    if not rows:
+        return np.full((1, 1, 6), -1, np.float32)
+    dat = np.concatenate(rows, axis=0)[None]  # (1, N, 6)
+    # fixed-size bucket + host-CPU NMS (see eval_rfcn_map.py: an exact-N jit
+    # would recompile per eval image)
+    cap = 512
+    n = dat.shape[1]
+    if n < cap:
+        dat = np.concatenate(
+            [dat, np.full((1, cap - n, 6), -1, np.float32)], axis=1)
+    else:
+        dat = dat[:, np.argsort(-dat[0, :, 1])[:cap]]
+    with jax.default_device(jax.devices("cpu")[0]):
+        out = np.asarray(box_nms(
+            jnp.asarray(dat), overlap_thresh=nms_thresh, coord_start=2,
+            score_index=1, id_index=0, force_suppress=False))
+    out = out[0]
+    out = out[out[:, 0] >= 0][:max_det]
+    return out[None] if out.size else np.full((1, 1, 6), -1, np.float32)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--vgg16", action="store_true")
+    p.add_argument("--steps", type=int, default=None)
+    p.add_argument("--eval-images", type=int, default=500)
+    p.add_argument("--classes", type=int, default=3)
+    p.add_argument("--lr", type=float, default=2e-3)
+    p.add_argument("--map-floor", type=float, default=None,
+                   help="exit 1 if final mAP falls below this (CI tier)")
+    p.add_argument("--host-data", action="store_true")
+    p.add_argument("--flat-lr", action="store_true")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    import jax
+
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    steps = args.steps or (800 if args.vgg16 else 30)
+
+    mx.random.seed(args.seed)
+    rng = np.random.RandomState(args.seed)
+    net, shape, classes = build_net(args.vgg16, classes=args.classes)
+    step, state = make_frcnn_train_step(
+        net, 1, learning_rate=args.lr, momentum=0.9,
+        compute_dtype="bfloat16" if (on_tpu and args.vgg16) else None)
+    key = jax.random.PRNGKey(args.seed)
+    use_device_data = on_tpu and not args.host_data
+
+    if use_device_data:
+        def step_with_data(st, sidx, lr_v):
+            kd, ks = jax.random.split(jax.random.fold_in(key, sidx))
+            data, im_info, gt = synthetic_voc_device(
+                kd, 1, shape, classes, net.max_gts)
+            return step(st, data, im_info, gt, ks, lr_v)
+
+        jstep_dev = jax.jit(step_with_data, donate_argnums=(0,))
+    else:
+        jstep = jax.jit(step, donate_argnums=(0,))
+
+    decay_points = set() if args.flat_lr else {int(steps * 0.6), int(steps * 0.85)}
+    lr = args.lr
+    for s in range(steps):
+        if s in decay_points:
+            lr *= 0.1
+            print("lr -> %g at step %d" % (lr, s), flush=True)
+        if use_device_data:
+            state, loss, parts = jstep_dev(state, np.int32(s), np.float32(lr))
+        else:
+            data, im_info, gt = synthetic_voc(rng, 1, shape, classes,
+                                              net.max_gts)
+            state, loss, parts = jstep(state, data, im_info, gt,
+                                       jax.random.fold_in(key, s),
+                                       np.float32(lr))
+        if s % max(1, steps // 8) == 0:
+            print("step %4d  loss %.4f" % (s, float(loss)), flush=True)
+
+    # --- evaluation: inference twin at the TEST proposal config ----------
+    eval_net, _, _ = build_net(args.vgg16, classes=args.classes,
+                               rpn_pre_nms=6000 if args.vgg16 else None,
+                               rpn_post_nms=300 if args.vgg16 else None)
+    apply, names, vals, aux_names = functionalize(eval_net, train=False)
+    learn_idx = [i for i, n in enumerate(names) if n not in set(aux_names)]
+    aux_idx = [i for i, n in enumerate(names) if n in set(aux_names)]
+    learn, _mom, aux = state
+    merged = [None] * len(names)
+    for i, v in zip(learn_idx, learn):
+        merged[i] = v
+    for i, v in zip(aux_idx, aux):
+        merged[i] = v
+
+    infer = jax.jit(lambda m, x, i: apply(m, (x, i), jax.random.PRNGKey(0))[0])
+    metric = VOCMApMetric(iou_thresh=0.5)
+    eval_rng = np.random.RandomState(12345)
+    if use_device_data:
+        ekey = jax.random.PRNGKey(54321)
+        gen = jax.jit(lambda i: synthetic_voc_device(
+            jax.random.fold_in(ekey, i), 1, shape, classes, net.max_gts))
+    for _i in range(args.eval_images):
+        if use_device_data:
+            data, im_info, gt = gen(np.int32(_i))
+            gt = np.asarray(gt)
+        else:
+            data, im_info, gt = synthetic_voc(eval_rng, 1, shape, classes,
+                                              net.max_gts)
+        rois, prob, deltas = infer(merged, data, im_info)
+        dets = decode_detections(
+            np.asarray(rois).astype(np.float32),
+            np.asarray(prob).astype(np.float32),
+            np.asarray(deltas).astype(np.float32), classes, shape,
+            box_stds=net.box_stds)
+        metric.update(dets, gt[:, :, :5])
+    name, value = metric.get()
+    print("FINAL frcnn %s synthetic-VOC %s = %.4f  (steps=%d, classes=%d, "
+          "eval n=%d)" % ("vgg16" if args.vgg16 else "tiny",
+                          name, value, steps, classes, args.eval_images))
+    if args.map_floor is not None and value < args.map_floor:
+        print("FAIL: mAP %.4f below floor %.4f" % (value, args.map_floor))
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

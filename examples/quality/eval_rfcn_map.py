@@ -127,6 +127,10 @@ def main():
     p.add_argument("--flat-lr", action="store_true",
                    help="disable the 60%%/85%% step decay (reproduces the "
                         "flat-lr rows in QUALITY.md)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="master seed (init + train stream + eval stream); "
+                        "non-zero seeds are the floor-calibration runs, "
+                        "QUALITY.md §3")
     args = p.parse_args()
 
     import jax
@@ -134,14 +138,14 @@ def main():
     on_tpu = any(d.platform == "tpu" for d in jax.devices())
     steps = args.steps or (800 if args.resnet101 else 30)
 
-    mx.random.seed(0)
-    rng = np.random.RandomState(0)
+    mx.random.seed(args.seed)
+    rng = np.random.RandomState(args.seed)
     net, shape, classes = build_net(args.resnet101, classes=args.classes,
                                     frozen_bn=not args.live_bn)
     step, state = make_rfcn_train_step(
         net, 1, learning_rate=args.lr, momentum=0.9,
         compute_dtype="bfloat16" if (on_tpu and args.resnet101) else None)
-    key = jax.random.PRNGKey(0)
+    key = jax.random.PRNGKey(args.seed)
     # On the chip, generate the batch ON DEVICE inside the jitted step: over
     # the tunnel, host generation + H2D costs ~0.6 s/step (7.5 MB batch at
     # ~15 MB/s, plus an eager fold_in roundtrip) vs ~10 ms dispatch for the

@@ -1,0 +1,265 @@
+"""Faster R-CNN VGG16 end-to-end, jit-fused — BASELINE config 2.
+
+The reference recipe is ``example/rcnn/train_end2end.py`` (VGG16 symbol
+``rcnn/symbol/symbol_vgg.py``, 600×1000 input, host-side AnchorLoader +
+proposal_target CustomOp, MutableModule rebinds per shape bucket).  The
+TPU-native redesign compiles the ENTIRE train step — VGG16 trunk, RPN,
+MultiProposal, on-device anchor/proposal targets, 7×7 ROIPooling, fc6/fc7
+heads, all four losses, momentum SGD — into ONE XLA module at ONE static
+shape (608×1024, the (600, 1000) resize bucket rounded to stride multiples),
+exactly like the Deformable R-FCN north-star driver
+(examples/deformable_rfcn/train_fused.py).
+
+Mixed precision: bf16 trunk/fc (MXU dtype), fp32 box math throughout —
+gt/im_info/rois never downcast, MultiProposal upcasts at entry, ROIPooling
+does its bin arithmetic in fp32.
+
+Usage:
+  python examples/rcnn/train_fused.py                 # tiny CPU run
+  python examples/rcnn/train_fused.py --vgg16 --bench \
+      --image-shape 608 1024          # chip measurement (BASELINE config 2)
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.functional import functionalize
+from mxnet_tpu.gluon.model_zoo.detection import FasterRCNN, faster_rcnn_vgg16
+from mxnet_tpu.test_utils import load_module_by_path
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_rfcn = load_module_by_path(
+    os.path.join(_HERE, "..", "deformable_rfcn", "train_fused.py"),
+    "_rfcn_train_fused_for_frcnn")
+# same synthetic dataset family as the north star (bright rectangles on
+# noise, -1-padded gt) — the detection pipelines share one data story
+synthetic_voc = _rfcn.synthetic_coco
+synthetic_voc_device = _rfcn.synthetic_coco_device
+
+
+def _smooth_l1(pred, target, weight, sigma):
+    from mxnet_tpu.ops.elemwise import smooth_l1
+
+    return smooth_l1((pred - target) * weight, scalar=sigma)
+
+
+def make_frcnn_train_step(net, batch, learning_rate=1e-3, momentum=0.9,
+                          compute_dtype=None):
+    """→ (step, state): ``step(state, data, im_info, gt, key, lr) ->
+    (state, loss, parts)``, fully jittable, state donate-able.
+
+    Loss heads follow the reference e2e symbol (symbol_vgg.py get_vgg_train):
+    RPN softmax CE over sampled anchors + smooth-L1(σ=3)/RPN_BATCH; R-CNN
+    softmax CE over the 128 sampled rois + class-specific
+    smooth-L1(σ=1)/BATCH_ROIS with normalized targets (BBOX_STDS).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    apply, names, vals, aux_names = functionalize(net, train=True)
+    aux_set = set(aux_names)
+    learn_idx = [i for i, n in enumerate(names) if n not in aux_set]
+    aux_idx = [i for i, n in enumerate(names) if n in aux_set]
+    Hf, Wf = net.feat_shape
+    A = net.num_anchors
+    a_total = Hf * Wf * A
+    ncand = net.rpn_post_nms + net.max_gts
+    cdtype = jnp.dtype(compute_dtype) if compute_dtype is not None else None
+
+    def loss_fn(learn, aux, data, im_info, gt, key):
+        merged = [None] * len(names)
+        for i, v in zip(learn_idx, learn):
+            merged[i] = v.astype(cdtype) if cdtype is not None else v
+        for i, v in zip(aux_idx, aux):
+            merged[i] = v
+        k1, k2, k3 = jax.random.split(key, 3)
+        nz_rpn = jax.random.uniform(k1, (batch, a_total, 2), jnp.float32)
+        nz_prop = jax.random.uniform(k2, (batch, ncand, 2), jnp.float32)
+        x = data.astype(cdtype) if cdtype is not None else data
+        outs, new_aux = apply(merged, (x, im_info, gt, nz_rpn, nz_prop), k3)
+        (rpn_cls, rpn_bbox, rpn_label, rpn_bt, rpn_bw,
+         _rois, label, bbox_target, bbox_weight, cls_score, bbox_pred) = (
+            jnp.asarray(o).astype(jnp.float32) for o in outs)
+
+        # RPN losses (anchor order h·(W·A)+w·A+a, as rpn_anchor_target)
+        logits = rpn_cls.reshape(batch, 2, A, Hf, Wf).transpose(0, 3, 4, 2, 1)
+        logits = logits.reshape(batch, a_total, 2)
+        valid = rpn_label >= 0
+        lab = jnp.maximum(rpn_label, 0.0).astype(jnp.int32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ce = -jnp.take_along_axis(logp, lab[..., None], axis=-1)[..., 0]
+        rpn_cls_loss = jnp.where(valid, ce, 0.0).sum() / jnp.maximum(valid.sum(), 1)
+        bp = rpn_bbox.reshape(batch, A, 4, Hf, Wf).transpose(0, 3, 4, 1, 2)
+        bp = bp.reshape(batch, a_total, 4)
+        rpn_bbox_loss = _smooth_l1(bp, rpn_bt, rpn_bw, 3.0).sum() / (
+            net.rpn_batch * batch)
+
+        # R-CNN head: class-specific regression (4·(C+1) deltas per roi)
+        logp2 = jax.nn.log_softmax(cls_score, axis=-1)
+        rcnn_cls_loss = -jnp.take_along_axis(
+            logp2, label.astype(jnp.int32)[:, None], axis=1).mean()
+        rcnn_bbox_loss = _smooth_l1(bbox_pred, bbox_target, bbox_weight, 1.0
+                                    ).sum() / label.shape[0]
+
+        total = rpn_cls_loss + rpn_bbox_loss + rcnn_cls_loss + rcnn_bbox_loss
+        parts = jnp.stack([rpn_cls_loss, rpn_bbox_loss, rcnn_cls_loss,
+                           rcnn_bbox_loss])
+        return total, (new_aux, parts)
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def step(state, data, im_info, gt, key, lr=learning_rate):
+        learn, mom, aux = state
+        (loss, (new_aux, parts)), grads = grad_fn(learn, aux, data, im_info,
+                                                  gt, key)
+        if momentum:
+            mom = [momentum * m + g for m, g in zip(mom, grads)]
+            upd = mom
+        else:
+            upd = grads
+        learn = [p - lr * g for p, g in zip(learn, upd)]
+        return (learn, mom, new_aux), loss, parts
+
+    import jax.numpy as jnp  # noqa: F811  (zeros_like below)
+    learn_vals = [vals[i] for i in learn_idx]
+    aux_vals = [vals[i] for i in aux_idx]
+    mom_vals = [jnp.zeros_like(v) for v in learn_vals] if momentum else []
+    return step, (learn_vals, mom_vals, aux_vals)
+
+
+def build_net(vgg16, image_shape=None, classes=None, rpn_pre_nms=None,
+              rpn_post_nms=None, init=True):
+    """→ (net, image_shape, classes): the full VGG16 config-2 model, or a
+    tiny-trunk CPU configuration with the same graph.
+
+    ``rpn_pre_nms/rpn_post_nms`` override the TRAIN proposal counts
+    (12000/2000); pass the reference TEST config (6000/300,
+    rcnn/config.py:95-96) to build the inference twin — parameter names and
+    shapes are proposal-count independent, so trained values drop in."""
+    if vgg16:
+        shape = tuple(image_shape or (608, 1024))
+        classes = classes or 20
+        net = faster_rcnn_vgg16(classes=classes, image_shape=shape,
+                                max_gts=16,
+                                rpn_pre_nms=rpn_pre_nms or 12000,
+                                rpn_post_nms=rpn_post_nms or 2000)
+    else:
+        shape = tuple(image_shape or (64, 96))
+        classes = classes or 3
+        net = FasterRCNN(
+            classes=classes, image_shape=shape,
+            filters=(8, 16, 32, 32, 32), units=(1, 1, 1, 1, 1), fc_hidden=64,
+            scales=(1, 2), ratios=(0.5, 1, 2),
+            rpn_pre_nms=rpn_pre_nms or 200,
+            rpn_post_nms=rpn_post_nms or 32,
+            batch_rois=16, rpn_batch=32, max_gts=8)
+    if init:
+        net.initialize()
+        net.init_params()
+    return net, shape, classes
+
+
+def run_bench(vgg16, batch=1, iters=10, image_shape=None, classes=None,
+              dtype=None, lr=1e-3, windows=3, verbose=True):
+    """Timed chained-step bench (state stays on device; one scalar fetch per
+    window) → (img_per_sec, ms_per_step, final_loss)."""
+    import jax
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    net, shape, classes = build_net(vgg16, image_shape, classes)
+    data, im_info, gt = synthetic_voc(rng, batch, shape, classes, net.max_gts)
+    step, state = make_frcnn_train_step(
+        net, batch, learning_rate=lr, momentum=0.9, compute_dtype=dtype)
+    jstep = jax.jit(step, donate_argnums=(0,))
+    key = jax.random.PRNGKey(0)
+    d = jax.device_put(data)
+    i = jax.device_put(im_info)
+    g = jax.device_put(gt)
+    t0 = time.time()
+    state, loss, parts = jstep(state, d, i, g, key)
+    jax.block_until_ready(loss)
+    if verbose:
+        print("compile+first step: %.1fs  loss=%.4f" % (time.time() - t0, float(loss)))
+    best = None
+    for w in range(windows):
+        keys = [jax.random.fold_in(key, w * 1000 + it) for it in range(iters)]
+        jax.block_until_ready(keys[-1])
+        t0 = time.perf_counter()
+        for it in range(iters):
+            state, loss, parts = jstep(state, d, i, g, keys[it])
+        float(loss)
+        dt = (time.perf_counter() - t0) / iters
+        best = dt if best is None else min(best, dt)
+    return batch / best, best * 1e3, float(loss)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--vgg16", action="store_true",
+                   help="full VGG16 trunk (default: tiny trunk for CPU)")
+    p.add_argument("--image-shape", type=int, nargs=2, default=None)
+    p.add_argument("--batch-size", type=int, default=1)
+    p.add_argument("--classes", type=int, default=None)
+    p.add_argument("--steps", type=int, default=12)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--dtype", default=None)
+    p.add_argument("--bench", action="store_true")
+    p.add_argument("--bench-iters", type=int, default=10)
+    args = p.parse_args()
+
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if args.dtype is None and args.bench and on_tpu:
+        args.dtype = "bfloat16"
+
+    if args.bench:
+        img_s, ms, loss = run_bench(
+            args.vgg16, batch=args.batch_size, iters=args.bench_iters,
+            image_shape=args.image_shape, classes=args.classes,
+            dtype=args.dtype, lr=args.lr)
+        print("frcnn_fused_bench: batch=%d dtype=%s  %.2f img/s (%.0f ms/step)"
+              "  loss=%.4f"
+              % (args.batch_size, args.dtype or "float32", img_s, ms, loss))
+        return
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    net, shape, classes = build_net(args.vgg16, args.image_shape, args.classes)
+    data, im_info, gt = synthetic_voc(rng, args.batch_size, shape, classes,
+                                      net.max_gts)
+    step, state = make_frcnn_train_step(
+        net, args.batch_size, learning_rate=args.lr, momentum=0.9,
+        compute_dtype=args.dtype)
+    jstep = jax.jit(step, donate_argnums=(0,))
+    key = jax.random.PRNGKey(0)
+
+    first = last = None
+    for s in range(args.steps):
+        data, im_info, gt = synthetic_voc(rng, args.batch_size, shape,
+                                          classes, net.max_gts)
+        state, loss, parts = jstep(state, data, im_info, gt,
+                                   jax.random.fold_in(key, s))
+        l = float(loss)
+        pr = [float(x) for x in np.asarray(parts)]
+        print("step %2d  loss=%.4f  (rpn_cls %.3f rpn_bbox %.3f "
+              "rcnn_cls %.3f rcnn_bbox %.3f)" % (s, l, *pr))
+        if first is None:
+            first = l
+        last = l
+    assert np.isfinite(last), "loss diverged"
+    assert last < first, "loss did not decrease (first=%.4f last=%.4f)" % (first, last)
+    print("FASTER-RCNN FUSED TRAIN OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -34,7 +34,8 @@ import numpy as np
 from ..block import HybridBlock
 from .. import nn
 
-__all__ = ["DeformableConv2D", "DeformableRFCN", "rfcn_resnet101"]
+__all__ = ["DeformableConv2D", "DeformableRFCN", "rfcn_resnet101",
+           "FasterRCNN", "faster_rcnn_vgg16"]
 
 
 class DeformableConv2D(HybridBlock):
@@ -343,3 +344,186 @@ def rfcn_resnet101(classes=80, image_shape=(608, 1024), **kwargs):
     """Deformable R-FCN with the ResNet-101 trunk (BASELINE north star)."""
     return DeformableRFCN(classes=classes, image_shape=image_shape,
                           units=(3, 4, 23, 3), **kwargs)
+
+
+class FasterRCNN(HybridBlock):
+    """Faster R-CNN, training graph in one HybridBlock (BASELINE config 2).
+
+    The reference recipe is ``example/rcnn`` end-to-end training
+    (``train_end2end.py:34-47``, symbol ``rcnn/symbol/symbol_vgg.py
+    get_vgg_train``): VGG16 trunk at stride 16 (no pool5), RPN on conv5_3,
+    Proposal → proposal_target (class-SPECIFIC bbox regression, normalized
+    targets) → 7×7 ROIPooling → fc6/fc7 (4096, dropout 0.5) → per-class
+    score + 4·(C+1) box deltas.  Same fixed-capacity/static-shape design as
+    ``DeformableRFCN`` so the whole train step compiles to one XLA module.
+
+    ``forward(data, im_info, gt_boxes, nz_rpn, nz_prop)`` (train) returns
+    every loss ingredient; inference: ``(data, im_info)`` →
+    (rois, cls_prob, bbox_pred).
+
+    Parameters
+    ----------
+    classes : foreground classes (VOC: 20).
+    image_shape : static (H, W) the model compiles for — the TPU analog of
+        the reference's (600, 1000) short/max-side resize buckets.
+    filters / units : trunk stage widths and conv counts; the defaults are
+        VGG16 ((64,128,256,512,512), (2,2,3,3,3)); tests shrink them.
+    """
+
+    def __init__(self, classes=20, image_shape=(608, 1024),
+                 filters=(64, 128, 256, 512, 512), units=(2, 2, 3, 3, 3),
+                 fc_hidden=4096, pooled_size=7,
+                 scales=(8, 16, 32), ratios=(0.5, 1, 2),
+                 rpn_pre_nms=12000, rpn_post_nms=2000, rpn_min_size=0,
+                 batch_rois=128, fg_fraction=0.25, rpn_batch=256,
+                 max_gts=100, box_stds=(0.1, 0.1, 0.2, 0.2),
+                 dropout=0.5, **kwargs):
+        super().__init__(**kwargs)
+        self.classes = int(classes)
+        self.image_shape = tuple(image_shape)
+        if len(units) != 5 or len(filters) != 5:
+            # stride is pinned by the 4 between-stage pools; a different
+            # stage count would silently break feat_shape below
+            raise ValueError("FasterRCNN trunk needs exactly 5 stages "
+                             "(VGG topology), got units=%r" % (units,))
+        self.stride = 16
+        H, W = self.image_shape
+        if H % self.stride or W % self.stride:
+            raise ValueError("image_shape must be divisible by 16, got %r"
+                             % (self.image_shape,))
+        self.feat_shape = (H // self.stride, W // self.stride)
+        self.scales = tuple(scales)
+        self.ratios = tuple(ratios)
+        self.num_anchors = len(scales) * len(ratios)
+        self.pooled = int(pooled_size)
+        self.rpn_pre_nms = int(rpn_pre_nms)
+        self.rpn_post_nms = int(rpn_post_nms)
+        self.rpn_min_size = int(rpn_min_size) or self.stride
+        self.batch_rois = int(batch_rois)
+        self.fg_fraction = float(fg_fraction)
+        self.rpn_batch = int(rpn_batch)
+        self.max_gts = int(max_gts)
+        self.box_stds = tuple(box_stds) if box_stds is not None else None
+        A = self.num_anchors
+        with self.name_scope():
+            # VGG trunk: len(units) stages, 2×2 max-pool between stages
+            # (NOT after the last — symbol_vgg.py drops pool5, stride 16)
+            self.stages = []
+            for s, (n, f) in enumerate(zip(units, filters)):
+                stage = nn.HybridSequential(prefix="conv%d_" % (s + 1))
+                with stage.name_scope():
+                    for _ in range(n):
+                        stage.add(nn.Conv2D(f, 3, padding=1,
+                                            activation="relu"))
+                self.stages.append(stage)
+                setattr(self, "conv%d" % (s + 1), stage)
+            self.rpn_conv = nn.Conv2D(min(512, filters[-1] * 2), 3, padding=1,
+                                      activation="relu", prefix="rpn_conv_")
+            self.rpn_cls = nn.Conv2D(2 * A, 1, prefix="rpn_cls_")
+            self.rpn_bbox = nn.Conv2D(4 * A, 1, prefix="rpn_bbox_")
+            self.fc6 = nn.Dense(fc_hidden, activation="relu", prefix="fc6_")
+            self.drop6 = nn.Dropout(dropout)
+            self.fc7 = nn.Dense(fc_hidden, activation="relu", prefix="fc7_")
+            self.drop7 = nn.Dropout(dropout)
+            self.cls_score = nn.Dense(self.classes + 1, prefix="cls_score_")
+            self.bbox_pred = nn.Dense(4 * (self.classes + 1),
+                                      prefix="bbox_pred_")
+
+    def init_params(self, ctx=None):
+        """Materialise deferred parameters with one tiny probe pass.
+
+        Conv parameter shapes are H/W-independent; the fc6 input dim is
+        ``filters[-1]·pooled²`` regardless of image size, so a probe at the
+        pooled resolution creates every head parameter too."""
+        from ... import nd as _nd
+
+        x = _nd.zeros((1, 3, 64, 64))
+        for stage in self.stages[:-1]:
+            x = _nd.Pooling(stage(x), kernel=(2, 2), stride=(2, 2),
+                            pool_type="max")
+        c5 = self.stages[-1](x)
+        t = self.rpn_conv(c5)
+        self.rpn_cls(t)
+        self.rpn_bbox(t)
+        head = _nd.zeros((1, int(c5.shape[1]) * self.pooled * self.pooled))
+        h = self.fc7(self.fc6(head))
+        self.cls_score(h)
+        self.bbox_pred(h)
+
+    # -- pieces -----------------------------------------------------------
+
+    def _features(self, F, x):
+        """VGG trunk → conv5_3 features at stride 16.  conv1/conv2 are the
+        reference's FIXED_PARAMS (train_end2end fixes them): gradients are
+        cut below conv3, which also skips their (stride-2/4) activation
+        gradients entirely."""
+        for s, stage in enumerate(self.stages):
+            x = stage(x)
+            if s == 1:
+                x = F.BlockGrad(x)
+            if s < len(self.stages) - 1:
+                x = F.Pooling(x, kernel=(2, 2), stride=(2, 2),
+                              pool_type="max")
+        return x
+
+    def _proposals(self, F, rpn_cls, rpn_bbox, im_info, batch):
+        A = self.num_anchors
+        Hf, Wf = self.feat_shape
+        score = F.Reshape(rpn_cls, shape=(batch, 2, A * Hf, Wf))
+        prob = F.softmax(score, axis=1)
+        prob = F.Reshape(prob, shape=(batch, 2 * A, Hf, Wf))
+        rois = F.contrib.MultiProposal(
+            prob, rpn_bbox, im_info,
+            rpn_pre_nms_top_n=self.rpn_pre_nms,
+            rpn_post_nms_top_n=self.rpn_post_nms,
+            threshold=0.7, rpn_min_size=self.rpn_min_size,
+            scales=self.scales, ratios=self.ratios,
+            feature_stride=self.stride)
+        return F.BlockGrad(rois)
+
+    def _head(self, F, c5, rois):
+        """ROIPool → flatten → fc6/fc7 (dropout) → class scores + per-class
+        deltas (symbol_vgg.py:107-122)."""
+        pooled = F.ROIPooling(c5, rois, pooled_size=(self.pooled, self.pooled),
+                              spatial_scale=1.0 / self.stride)
+        flat = F.Flatten(pooled)
+        h = self.drop6(self.fc6(flat))
+        h = self.drop7(self.fc7(h))
+        return self.cls_score(h), self.bbox_pred(h)
+
+    # -- forward ----------------------------------------------------------
+
+    def hybrid_forward(self, F, data, im_info, gt_boxes=None, nz_rpn=None,
+                       nz_prop=None):
+        batch = data.shape[0]
+        c5 = self._features(F, data)
+        t = self.rpn_conv(c5)
+        rpn_cls, rpn_bbox = self.rpn_cls(t), self.rpn_bbox(t)
+        rois = self._proposals(F, rpn_cls, rpn_bbox, im_info, batch)
+        if gt_boxes is None:  # inference
+            cls_score, bbox_pred = self._head(F, c5, rois)
+            return rois, F.softmax(cls_score, axis=-1), bbox_pred
+
+        Hf, Wf = self.feat_shape
+        rpn_label, rpn_bt, rpn_bw = F.contrib.rpn_anchor_target(
+            gt_boxes, im_info, nz_rpn,
+            feat_height=Hf, feat_width=Wf, feature_stride=self.stride,
+            scales=self.scales, ratios=self.ratios,
+            batch_rois=self.rpn_batch, fg_fraction=0.5)
+        rois_s, label, bbox_target, bbox_weight = F.contrib.proposal_target(
+            rois, gt_boxes, nz_prop,
+            num_classes=self.classes + 1, batch_images=batch,
+            batch_rois=self.batch_rois * batch,
+            fg_fraction=self.fg_fraction, class_agnostic=False,
+            box_stds=self.box_stds)
+        cls_score, bbox_pred = self._head(F, c5, rois_s)
+        return (rpn_cls, rpn_bbox, rpn_label, rpn_bt, rpn_bw,
+                rois_s, label, bbox_target, bbox_weight, cls_score, bbox_pred)
+
+
+def faster_rcnn_vgg16(classes=20, image_shape=(608, 1024), **kwargs):
+    """Faster R-CNN with the full VGG16 trunk (BASELINE config 2:
+    ``example/rcnn/train_end2end.py`` + ``symbol_vgg.py get_vgg_train``)."""
+    return FasterRCNN(classes=classes, image_shape=image_shape,
+                      filters=(64, 128, 256, 512, 512),
+                      units=(2, 2, 3, 3, 3), fc_hidden=4096, **kwargs)

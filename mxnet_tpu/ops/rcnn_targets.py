@@ -183,11 +183,18 @@ def proposal_target(
     fg_fraction=0.25,
     fg_overlap=0.5,
     class_agnostic=False,
+    box_stds=None,
 ):
     """Per-ROI training targets, on device (reference CustomOp
     ``rcnn/symbol/proposal_target.py:31-110`` + ``rcnn/io/rcnn.py
     sample_rois``; config ``rcnn/config.py:50-56`` BATCH_ROIS=128,
     FG_FRACTION=0.25, FG_THRESH=0.5, BG=[0, 0.5)).
+
+    ``box_stds``: per-coordinate target scaling (reference
+    TRAIN.BBOX_NORMALIZATION_PRECOMPUTED + BBOX_STDS (0.1, 0.1, 0.2, 0.2),
+    enabled by ``train_end2end.py:38``); targets are divided by the stds so
+    the regression head trains on ~unit-variance values, and inference
+    multiplies predictions back.
 
     Inputs: ``rois`` (B·post, 5) [batch_idx|x1..y2] batch-major (the
     MultiProposal layout); ``gt_boxes`` (B, G, 5) [cls, x1, y1, x2, y2]
@@ -261,6 +268,8 @@ def proposal_target(
         label = jnp.where(is_fg, gt[sel_gt, 0] + 1.0, 0.0)  # 0 = background
 
         tgt = _bbox_transform(sel[:, 1:5], gt[sel_gt, 1:5])  # (per_im, 4)
+        if box_stds is not None:
+            tgt = tgt / jnp.asarray(box_stds, tgt.dtype)[None, :]
         kcls = (jnp.minimum(label, 1.0) if class_agnostic else label).astype(jnp.int32)
         onehot = jax.nn.one_hot(kcls, K, dtype=rois.dtype)  # (per_im, K)
         w = is_fg[:, None, None] * onehot[:, :, None]  # (per_im, K, 1)

@@ -1,0 +1,30 @@
+"""Round-4 example E2E tests (nightly tier): the Faster-RCNN VGG16
+fused recipe (BASELINE config 2, reference example/rcnn/train_end2end.py)
+runs end-to-end as a script and learns."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _run(script, *args, timeout=3600):
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, script), *args],
+        capture_output=True, text=True, timeout=timeout)
+    tail = "\n".join(res.stdout.splitlines()[-8:]) + res.stderr[-2000:]
+    assert res.returncode == 0, "%s failed:\n%s" % (script, tail)
+    return res.stdout
+
+
+def test_frcnn_train_fused_script():
+    out = _run("examples/rcnn/train_fused.py",
+               "--steps", "40", "--lr", "0.02")
+    assert "FASTER-RCNN FUSED TRAIN OK" in out
+
+
+def test_frcnn_train_fused_bench_mode():
+    """--bench exercises the donated-state chained-step bench path."""
+    out = _run("examples/rcnn/train_fused.py",
+               "--bench", "--bench-iters", "2")
+    assert "frcnn_fused_bench:" in out

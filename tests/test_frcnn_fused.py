@@ -1,0 +1,180 @@
+"""Jit-fused Faster R-CNN VGG16 (model_zoo.detection FasterRCNN) —
+BASELINE config 2 (reference example/rcnn/train_end2end.py +
+rcnn/symbol/symbol_vgg.py).
+
+Covers: model build (train + inference forwards), class-SPECIFIC bbox
+targets/weights, the single-XLA-module train step
+(examples/rcnn/train_fused.py make_frcnn_train_step), gradient flow into
+every head with the conv1/conv2 FIXED_PARAMS cut, and loss decrease.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+EXDIR = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "examples", "rcnn"))
+if EXDIR not in sys.path:
+    sys.path.insert(0, EXDIR)
+
+
+def _tiny_net(**kw):
+    from mxnet_tpu.gluon.model_zoo.detection import FasterRCNN
+
+    cfg = dict(classes=3, image_shape=(64, 96),
+               filters=(8, 16, 32, 32, 32), units=(1, 1, 1, 1, 1),
+               fc_hidden=64, scales=(1, 2), ratios=(0.5, 1, 2),
+               rpn_pre_nms=200, rpn_post_nms=32, batch_rois=16,
+               rpn_batch=32, max_gts=8)
+    cfg.update(kw)
+    net = FasterRCNN(**cfg)
+    net.initialize()
+    return net
+
+
+def test_model_forward_shapes_train_and_infer():
+    mx.random.seed(0)
+    net = _tiny_net()
+    rng = np.random.RandomState(0)
+    B = 2
+    x = nd.array(rng.randn(B, 3, 64, 96).astype(np.float32))
+    info = nd.array(np.array([[64, 96, 1.0]] * B, np.float32))
+    gt = np.full((B, 8, 5), -1.0, np.float32)
+    gt[0, 0] = [1, 4, 4, 40, 40]
+    gt[1, 0] = [0, 10, 20, 60, 60]
+    Hf, Wf = net.feat_shape
+    A = net.num_anchors
+    C1 = net.classes + 1
+    nz1 = nd.array(rng.rand(B, Hf * Wf * A, 2).astype(np.float32))
+    nz2 = nd.array(rng.rand(B, net.rpn_post_nms + 8, 2).astype(np.float32))
+    outs = net(x, info, nd.array(gt), nz1, nz2)
+    assert outs[0].shape == (B, 2 * A, Hf, Wf)        # rpn_cls
+    assert outs[5].shape == (B * 16, 5)               # sampled rois
+    assert outs[9].shape == (B * 16, C1)              # cls_score
+    assert outs[10].shape == (B * 16, 4 * C1)         # class-SPECIFIC deltas
+    # class-specific weights: the 4 active columns must sit in the slot of
+    # the roi's own class (background rois have all-zero weight)
+    label = outs[6].asnumpy()
+    bw = outs[8].asnumpy().reshape(B * 16, C1, 4)
+    for r in range(B * 16):
+        c = int(label[r])
+        active = bw[r].sum(axis=1) > 0
+        if active.any():
+            assert active[c] and active.sum() == 1, (r, c, active)
+    rois, prob, deltas = net(x, info)                 # inference path
+    assert rois.shape == (B * net.rpn_post_nms, 5)
+    assert prob.shape == (B * net.rpn_post_nms, C1)
+    assert deltas.shape == (B * net.rpn_post_nms, 4 * C1)
+    np.testing.assert_allclose(prob.asnumpy().sum(-1), 1.0, rtol=1e-4)
+
+
+def test_box_stds_normalization():
+    """proposal_target's box_stds divides targets; stds=None leaves raw."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.rcnn_targets import proposal_target
+
+    rng = np.random.RandomState(3)
+    rois = np.concatenate(
+        [np.zeros((8, 1), np.float32),
+         np.sort(rng.rand(8, 4).astype(np.float32) * 60, axis=1)], axis=1)
+    gt = np.full((1, 4, 5), -1.0, np.float32)
+    gt[0, 0] = [1, 5, 5, 40, 40]
+    kw = dict(num_classes=4, batch_images=1, batch_rois=8, fg_fraction=0.5)
+    _, _, bt_raw, bw = proposal_target(jnp.asarray(rois), jnp.asarray(gt), **kw)
+    _, _, bt_norm, _ = proposal_target(jnp.asarray(rois), jnp.asarray(gt),
+                                       box_stds=(0.1, 0.1, 0.2, 0.2), **kw)
+    bt_raw, bt_norm, bw = map(np.asarray, (bt_raw, bt_norm, bw))
+    act = bw > 0
+    assert act.any()
+    stds = np.tile([0.1, 0.1, 0.2, 0.2], 4)
+    np.testing.assert_allclose(bt_norm[act], (bt_raw / stds[None, :])[act],
+                               rtol=1e-5)
+
+
+def test_fused_step_gradients_reach_every_head():
+    import jax
+    from train_fused import make_frcnn_train_step, synthetic_voc
+
+    mx.random.seed(1)
+    net = _tiny_net()
+    rng = np.random.RandomState(1)
+    data, im_info, gt = synthetic_voc(rng, 1, (64, 96), 3, net.max_gts)
+    net(mx.nd.array(data), mx.nd.array(im_info))  # materialise params
+
+    from mxnet_tpu.gluon.functional import functionalize
+    apply, names, vals, aux_names = functionalize(net, train=True)
+    learn_names = [n for n in names if n not in set(aux_names)]
+
+    step, state = make_frcnn_train_step(net, 1, learning_rate=0.01,
+                                        momentum=0.9)
+    jstep = jax.jit(step)
+    new_state, loss, parts = jstep(state, data, im_info, gt,
+                                   jax.random.PRNGKey(0))
+    assert np.isfinite(float(loss))
+    grads = {n: np.asarray(g) for n, g in zip(learn_names, new_state[1])}
+    got = {k: any(np.abs(v).max() > 0 for n, v in grads.items() if k in n)
+           for k in ("rpn_cls", "rpn_bbox", "rpn_conv", "fc6", "fc7",
+                     "cls_score", "bbox_pred", "conv5_", "conv4_", "conv3_")}
+    assert all(got.values()), got
+    # FIXED_PARAMS: conv1/conv2 gradients exactly zero (BlockGrad below conv3)
+    frozen = [np.abs(v).max() for n, v in grads.items()
+              if "conv1_" in n or "conv2_" in n]
+    assert frozen and max(frozen) == 0.0
+
+
+def test_fused_step_trains():
+    import jax
+    from train_fused import make_frcnn_train_step, synthetic_voc
+
+    mx.random.seed(2)
+    net = _tiny_net()
+    rng = np.random.RandomState(2)
+    data, im_info, gt = synthetic_voc(rng, 1, (64, 96), 3, net.max_gts)
+    net(mx.nd.array(data), mx.nd.array(im_info))
+    step, state = make_frcnn_train_step(net, 1, learning_rate=0.02,
+                                        momentum=0.9)
+    jstep = jax.jit(step, donate_argnums=(0,))
+    key = jax.random.PRNGKey(0)
+    losses = []
+    for s in range(10):
+        data, im_info, gt = synthetic_voc(rng, 1, (64, 96), 3, net.max_gts)
+        state, loss, parts = jstep(state, data, im_info, gt,
+                                   jax.random.fold_in(key, s))
+        losses.append(float(loss))
+    assert all(np.isfinite(l) for l in losses)
+    assert np.mean(losses[-3:]) < np.mean(losses[:3]), losses
+
+
+def test_eval_decode_roundtrip():
+    """decode_detections inverts proposal_target's normalized transform:
+    perfect (normalized) deltas for a roi must decode back to the gt box."""
+    from mxnet_tpu.ops.rcnn_targets import _bbox_transform
+    import importlib.util
+    import jax.numpy as jnp
+
+    spec = importlib.util.spec_from_file_location(
+        "_eval_frcnn", os.path.join(
+            os.path.dirname(__file__), "..", "examples", "quality",
+            "eval_frcnn_map.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+
+    stds = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+    roi = np.array([[0, 10.0, 12.0, 50.0, 44.0]], np.float32)
+    gtb = np.array([[18.0, 6.0, 61.0, 39.0]], np.float32)
+    tgt = np.asarray(_bbox_transform(jnp.asarray(roi[:, 1:5]),
+                                     jnp.asarray(gtb))) / stds[None]
+    C = 3
+    cls = 1  # foreground class index
+    deltas = np.zeros((1, 4 * (C + 1)), np.float32)
+    deltas[0, 4 * (cls + 1): 4 * (cls + 2)] = tgt[0]
+    prob = np.zeros((1, C + 1), np.float32)
+    prob[0, cls + 1] = 0.9
+    dets = m.decode_detections(roi, prob, deltas, C, (96, 96),
+                               box_stds=tuple(stds))
+    assert dets.shape[0] == 1 and dets[0, 0, 0] == cls
+    np.testing.assert_allclose(dets[0, 0, 2:6], gtb[0], atol=0.5)

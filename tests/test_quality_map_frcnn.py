@@ -1,0 +1,27 @@
+"""Faster-RCNN detection-quality regression gate (VERDICT round-3 item 1).
+
+Runs the full jit-fused Faster-RCNN synthetic-VOC recipe
+(examples/quality/eval_frcnn_map.py) at the calibrated nightly config and
+fails if mAP drops below the floor.  Same discipline as the R-FCN gate
+(tests/test_quality_map.py): seeded train stream, init, and held-out
+n=500 eval stream, so a drop means a real pipeline change, not noise.
+
+Calibration (this config, CPU, seeds 0/1/2): see QUALITY.md §3 —
+floor = worst seed − ~25% margin.
+"""
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+SCRIPT = os.path.join(REPO, "examples", "quality", "eval_frcnn_map.py")
+
+
+def test_frcnn_synthetic_map_floor():
+    res = subprocess.run(
+        [sys.executable, SCRIPT, "--steps", "1200", "--eval-images", "500",
+         "--lr", "0.02", "--map-floor", "0.04"],
+        capture_output=True, text=True, timeout=5400)
+    tail = "\n".join(res.stdout.splitlines()[-5:]) + res.stderr[-2000:]
+    assert res.returncode == 0, tail
+    assert "FINAL frcnn" in res.stdout, tail
