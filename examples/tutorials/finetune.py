@@ -1,0 +1,109 @@
+"""Runnable companion to docs/tutorials/finetune.md (reference
+``docs/faq/finetune.md``): pretrain a small CNN, then fine-tune it onto a
+new label space by symbol surgery (get_internals → new FC head) with the
+trunk held fixed (``fixed_param_names``, the reference's recipe).
+
+Run: ./dev.sh python examples/tutorials/finetune.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def make_data(rng, n, classes, noise=0.15):
+    """8×8 single-channel images whose class is a bright row index."""
+    x = rng.rand(n, 1, 8, 8).astype(np.float32) * noise
+    y = rng.randint(0, classes, n)
+    for i, c in enumerate(y):
+        x[i, 0, c % 8] += 1.0
+    return x, y.astype(np.float32)
+
+
+def feature_net(classes):
+    data = sym.Variable("data")
+    h = sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1), name="conv1")
+    h = sym.Activation(h, act_type="relu")
+    h = sym.Convolution(h, num_filter=16, kernel=(3, 3), pad=(1, 1), name="conv2")
+    h = sym.Activation(h, act_type="relu", name="features")
+    h = sym.Flatten(h)
+    h = sym.FullyConnected(h, num_hidden=classes, name="fc_out")
+    return sym.SoftmaxOutput(h, name="softmax")
+
+
+def fit(mod, x, y, epochs, batch=32, lr=0.1):
+    it = mx.io.NDArrayIter(x, y, batch, shuffle=True, label_name="softmax_label")
+    mod.fit(it, num_epoch=epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": lr},
+            eval_metric="acc",
+            initializer=mx.init.Xavier())
+
+
+def accuracy(mod, x, y, batch=32):
+    it = mx.io.NDArrayIter(x, y, batch, label_name="softmax_label")
+    m = mx.metric.Accuracy()
+    mod.score(it, m)
+    return m.get()[1]
+
+
+def main():
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+
+    # --- stage 1: "pretrain" on the 8-class source task -------------------
+    net = feature_net(8)
+    xs, ys = make_data(rng, 512, 8)
+    mod = mx.mod.Module(net, data_names=["data"], label_names=["softmax_label"])
+    fit(mod, xs, ys, epochs=4)
+    acc_src = accuracy(mod, *make_data(rng, 256, 8))
+    print("source-task accuracy: %.3f" % acc_src)
+    assert acc_src > 0.8, acc_src
+
+    prefix = os.path.join(tempfile.mkdtemp(), "pretrained")
+    mod.save_checkpoint(prefix, 1)
+
+    # --- stage 2: fine-tune onto a 3-class target task --------------------
+    # (reference finetune.md get_fine_tune_model: truncate at the feature
+    # layer, attach a fresh FC, keep the trunk fixed)
+    loaded_sym, arg_params, aux_params = mx.model.load_checkpoint(prefix, 1)
+    features = loaded_sym.get_internals()["features_output"]
+    h = sym.Flatten(features)
+    h = sym.FullyConnected(h, num_hidden=3, name="fc_new")
+    tuned = sym.SoftmaxOutput(h, name="softmax")
+
+    trunk_params = [n for n in tuned.list_arguments()
+                    if n.startswith(("conv1", "conv2"))]
+    ft = mx.mod.Module(tuned, data_names=["data"],
+                       label_names=["softmax_label"],
+                       fixed_param_names=trunk_params)
+    xt, yt = make_data(rng, 256, 3)
+    it = mx.io.NDArrayIter(xt, yt, 32, shuffle=True, label_name="softmax_label")
+    # fit seeds from the checkpoint: pretrained weights where names match
+    # (the trunk), fresh Xavier for the new head (allow_missing)
+    drop_old_head = {n: v for n, v in arg_params.items()
+                     if not n.startswith("fc_out")}
+    ft.fit(it, num_epoch=4, optimizer="sgd",
+           optimizer_params={"learning_rate": 0.1}, eval_metric="acc",
+           initializer=mx.init.Xavier(), arg_params=drop_old_head,
+           aux_params=aux_params, allow_missing=True)
+    acc_tgt = accuracy(ft, *make_data(rng, 256, 3))
+    print("target-task accuracy after fine-tune: %.3f" % acc_tgt)
+    assert acc_tgt > 0.8, acc_tgt
+    # the fixed trunk still equals the checkpoint exactly
+    after = ft.get_params()[0]
+    for n in trunk_params:
+        np.testing.assert_array_equal(arg_params[n].asnumpy(),
+                                      after[n].asnumpy())
+    print("FINETUNE TUTORIAL OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -195,6 +195,14 @@ for _name in _registry.list_ops(include_aliases=True):
 sys.modules[contrib.__name__] = contrib
 
 
+def __getattr__(name):
+    """Ops registered AFTER import (ops.registry.register at runtime —
+    tutorials, tests, user extensions) resolve dynamically (PEP 562)."""
+    if not name.startswith("__") and _registry.exists(name):
+        return _make_op_func(_registry.get(name), name)
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))
+
+
 # ---------------------------------------------------------------------------
 # creation functions with ctx handling (reference ndarray.py zeros/ones/...)
 # ---------------------------------------------------------------------------

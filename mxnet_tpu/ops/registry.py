@@ -199,6 +199,16 @@ def exists(name):
     return name in _REGISTRY
 
 
+def unregister(name):
+    """Remove an op and every alias pointing at it (late/tutorial/test
+    registrations; the frontends resolve late-registered names dynamically
+    via module ``__getattr__``, so removal takes effect immediately for
+    names not yet cached on the module)."""
+    opdef = _REGISTRY.pop(name)
+    for k in [k for k, v in _REGISTRY.items() if v is opdef]:
+        del _REGISTRY[k]
+
+
 def list_ops(include_aliases=False):
     """All registered canonical op names (sorted)."""
     if include_aliases:

@@ -1,0 +1,25 @@
+"""Tutorial companion scripts (docs/tutorials/ — VERDICT round-3 item 9)
+run end-to-end in the nightly tier: the code the docs show is code that
+works."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.mark.parametrize("script,marker", [
+    ("finetune.py", "FINETUNE TUTORIAL OK"),
+    ("bucketing.py", "BUCKETING TUTORIAL OK"),
+    ("multi_devices.py", "MULTI-DEVICES TUTORIAL OK"),
+    ("new_op.py", "NEW-OP TUTORIAL OK"),
+])
+def test_tutorial_script(script, marker):
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "tutorials", script)],
+        capture_output=True, text=True, timeout=1800)
+    tail = "\n".join(res.stdout.splitlines()[-8:]) + res.stderr[-2000:]
+    assert res.returncode == 0, "%s failed:\n%s" % (script, tail)
+    assert marker in res.stdout, tail
