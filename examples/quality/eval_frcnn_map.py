@@ -159,18 +159,14 @@ def main():
             print("step %4d  loss %.4f" % (s, float(loss)), flush=True)
 
     # --- evaluation: inference twin at the TEST proposal config ----------
+    from mxnet_tpu.gluon.functional import merge_params
+
     eval_net, _, _ = build_net(args.vgg16, classes=args.classes,
                                rpn_pre_nms=6000 if args.vgg16 else None,
                                rpn_post_nms=300 if args.vgg16 else None)
     apply, names, vals, aux_names = functionalize(eval_net, train=False)
-    learn_idx = [i for i, n in enumerate(names) if n not in set(aux_names)]
-    aux_idx = [i for i, n in enumerate(names) if n in set(aux_names)]
     learn, _mom, aux = state
-    merged = [None] * len(names)
-    for i, v in zip(learn_idx, learn):
-        merged[i] = v
-    for i, v in zip(aux_idx, aux):
-        merged[i] = v
+    merged = merge_params(names, aux_names, learn, aux)
 
     infer = jax.jit(lambda m, x, i: apply(m, (x, i), jax.random.PRNGKey(0))[0])
     metric = VOCMApMetric(iou_thresh=0.5)

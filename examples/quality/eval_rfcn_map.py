@@ -128,9 +128,10 @@ def main():
                    help="disable the 60%%/85%% step decay (reproduces the "
                         "flat-lr rows in QUALITY.md)")
     p.add_argument("--seed", type=int, default=0,
-                   help="master seed (init + train stream + eval stream); "
-                        "non-zero seeds are the floor-calibration runs, "
-                        "QUALITY.md §3")
+                   help="seed for init + train stream (the held-out eval "
+                        "stream stays FIXED so cross-seed variation is "
+                        "model-only); non-zero seeds are the "
+                        "floor-calibration runs, QUALITY.md §3")
     args = p.parse_args()
 
     import jax
@@ -188,15 +189,11 @@ def main():
             print("step %4d  loss %.4f" % (s, float(loss)), flush=True)
 
     # --- evaluation: inference forward with the TRAINED parameters -------
+    from mxnet_tpu.gluon.functional import merge_params
+
     apply, names, vals, aux_names = functionalize(net, train=False)
-    learn_idx = [i for i, n in enumerate(names) if n not in set(aux_names)]
-    aux_idx = [i for i, n in enumerate(names) if n in set(aux_names)]
     learn, _mom, aux = state
-    merged = [None] * len(names)
-    for i, v in zip(learn_idx, learn):
-        merged[i] = v
-    for i, v in zip(aux_idx, aux):
-        merged[i] = v
+    merged = merge_params(names, aux_names, learn, aux)
 
     infer = jax.jit(lambda m, x, i: apply(m, (x, i), jax.random.PRNGKey(0))[0])
     metric = VOCMApMetric(iou_thresh=0.5)

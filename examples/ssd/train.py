@@ -105,8 +105,11 @@ def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--train-rec", default=None, help=".rec file (ImageDetIter)")
     p.add_argument("--synthetic", action="store_true", default=False)
+    # default = the reference's real SSD-300 resolution (the 64×64 toy
+    # shape is still reachable explicitly for smoke runs; the fast path at
+    # this shape is train_fused.py / the eval_ssd_map.py quality gate)
     p.add_argument("--batch-size", type=int, default=8)
-    p.add_argument("--data-shape", type=int, nargs=3, default=[3, 64, 64])
+    p.add_argument("--data-shape", type=int, nargs=3, default=[3, 300, 300])
     p.add_argument("--num-classes", type=int, default=2)
     p.add_argument("--epochs", type=int, default=5)
     p.add_argument("--batches-per-epoch", type=int, default=16)

@@ -192,20 +192,13 @@ def run_bench(size=300, classes=20, train_batch=8, score_batch=16, iters=10,
 
 
 def _merge_vals(net, state):
-    """Reassemble functionalize(train=False)'s value list (learnables +
-    aux running stats) from a trained train-step state."""
-    from mxnet_tpu.gluon.functional import functionalize
+    """Reassemble functionalize's value list (learnables + aux running
+    stats) from a trained train-step state."""
+    from mxnet_tpu.gluon.functional import functionalize, merge_params
 
-    apply, names, vals, aux_names = functionalize(net, train=True)
-    aux_set = set(aux_names)
-    learn, mom, aux = state
-    out, li, ai = [], 0, 0
-    for n in names:
-        if n in aux_set:
-            out.append(aux[ai]); ai += 1
-        else:
-            out.append(learn[li]); li += 1
-    return out
+    _apply, names, _vals, aux_names = functionalize(net, train=True)
+    learn, _mom, aux = state
+    return merge_params(names, aux_names, learn, aux)
 
 
 def main():

@@ -53,38 +53,47 @@ def _vgg_stage(n, ch, pool=True, ceil=False):
 
 
 class VGGSSD(HybridBlock):
-    """VGG16-reduced SSD; ``config`` is SSD300 or SSD512."""
+    """VGG16-reduced SSD; ``config`` is SSD300 or SSD512.
 
-    def __init__(self, num_classes, config, **kw):
+    ``width`` scales every trunk/extra channel count (heads keep their
+    anchor-determined output channels).  Feature-map shapes — and therefore
+    the anchor menu (8732 @300², 24564 @512²) — are width-independent, so
+    ``width<1`` gives a CPU-affordable model whose MultiBoxTarget/Detection
+    shapes are EXACTLY the real ones (the quality gate's point)."""
+
+    def __init__(self, num_classes, config, width=1.0, **kw):
         super().__init__(**kw)
         self.num_classes = num_classes
         self.cfg = config
-        nstage = len(config["sizes"])
         self.anchors_per = [len(s) + len(r) - 1
                             for s, r in zip(config["sizes"], config["ratios"])]
+
+        def W(c):
+            return max(8, int(round(c * width)))
+
         with self.name_scope():
-            self.conv1 = _vgg_stage(2, 64)
-            self.conv2 = _vgg_stage(2, 128)
-            self.conv3 = _vgg_stage(3, 256, ceil=True)   # 75 -> 38 (ceil)
-            self.conv4 = _vgg_stage(3, 512, pool=False)  # source 0 (38x38)
+            self.conv1 = _vgg_stage(2, W(64))
+            self.conv2 = _vgg_stage(2, W(128))
+            self.conv3 = _vgg_stage(3, W(256), ceil=True)  # 75 -> 38 (ceil)
+            self.conv4 = _vgg_stage(3, W(512), pool=False)  # source 0 (38x38)
             self.pool4 = nn.MaxPool2D(2, 2)
-            self.conv5 = _vgg_stage(3, 512, pool=False)
+            self.conv5 = _vgg_stage(3, W(512), pool=False)
             self.pool5 = nn.MaxPool2D(3, 1, 1)           # stride-1 (reference)
-            self.fc6 = nn.Conv2D(1024, 3, padding=6, dilation=6,
+            self.fc6 = nn.Conv2D(W(1024), 3, padding=6, dilation=6,
                                  activation="relu")      # atrous fc6
-            self.fc7 = nn.Conv2D(1024, 1, activation="relu")  # source 1
+            self.fc7 = nn.Conv2D(W(1024), 1, activation="relu")  # source 1
             self.extras = nn.HybridSequential(prefix="extra_")
             for (c1, c2) in config["extra"]:
                 blk = nn.HybridSequential()
-                blk.add(nn.Conv2D(c1, 1, activation="relu"),
-                        nn.Conv2D(c2, 3, strides=2, padding=1,
+                blk.add(nn.Conv2D(W(c1), 1, activation="relu"),
+                        nn.Conv2D(W(c2), 3, strides=2, padding=1,
                                   activation="relu"))
                 self.extras.add(blk)
             self.tails = nn.HybridSequential(prefix="tail_")
             for _ in range(config["tail"]):
                 blk = nn.HybridSequential()
-                blk.add(nn.Conv2D(128, 1, activation="relu"),
-                        nn.Conv2D(256, 3, activation="relu"))  # valid conv
+                blk.add(nn.Conv2D(W(128), 1, activation="relu"),
+                        nn.Conv2D(W(256), 3, activation="relu"))  # valid conv
                 self.tails.add(blk)
             self.cls_heads = nn.HybridSequential(prefix="cls_")
             self.box_heads = nn.HybridSequential(prefix="box_")

@@ -13,7 +13,7 @@ from .. import random as _rnd
 from ..ndarray.ndarray import NDArray
 from .block import Block, _swap_trace_call
 
-__all__ = ["functionalize", "make_train_step"]
+__all__ = ["functionalize", "merge_params", "make_train_step"]
 
 
 def functionalize(net, train=False):
@@ -49,6 +49,20 @@ def functionalize(net, train=False):
         return out_vals if len(out_vals) > 1 else out_vals[0], new_aux
 
     return apply, param_names, param_vals, aux_names
+
+
+def merge_params(names, aux_names, learn, aux):
+    """Reassemble ``functionalize``'s ordered value list from a train-step
+    state's (learn, aux) split — the eval-side inverse of the learn/aux
+    partition every make_*_train_step performs."""
+    aux_set = set(aux_names)
+    merged, li, ai = [], 0, 0
+    for n in names:
+        if n in aux_set:
+            merged.append(aux[ai]); ai += 1
+        else:
+            merged.append(learn[li]); li += 1
+    return merged
 
 
 def make_train_step(net, loss_fn, learning_rate=0.01, momentum=0.0,

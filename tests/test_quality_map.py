@@ -8,9 +8,10 @@ round-2 "3000 vs 6000 step regression" was n=48 eval noise, see
 QUALITY.md) — so on one platform the score is reproducible and a drop
 means a real detection-pipeline change, not sampling luck.
 
-Calibration (this config, CPU): mAP 0.0468.  Floor 0.025 ≈ half of that —
-far above a broken pipeline (~0.002 at 120 steps, ~0 untrained) and safe
-against cross-platform numeric drift.
+Calibration (this config, CPU, round 4): seeds 0/1/2 → mAP 0.0468 /
+0.0440 / 0.0591.  Floor 0.035 = worst seed − ~20% margin (VERDICT round-3
+item 5: with n=500 the old 2× slack was unjustified) — still far above a
+broken pipeline (~0.002 at 120 steps, ~0 untrained).
 """
 import os
 import subprocess
@@ -23,7 +24,7 @@ SCRIPT = os.path.join(REPO, "examples", "quality", "eval_rfcn_map.py")
 def test_rfcn_synthetic_map_floor():
     res = subprocess.run(
         [sys.executable, SCRIPT, "--steps", "1200", "--eval-images", "500",
-         "--live-bn", "--map-floor", "0.025"],
+         "--live-bn", "--map-floor", "0.035"],
         capture_output=True, text=True, timeout=5400)
     tail = "\n".join(res.stdout.splitlines()[-5:]) + res.stderr[-2000:]
     assert res.returncode == 0, tail

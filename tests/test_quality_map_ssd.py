@@ -1,0 +1,28 @@
+"""SSD detection-quality regression gate at REAL resolution (VERDICT
+round-3 item 4).
+
+Runs the seeded synthetic-VOC SSD-300 recipe
+(examples/quality/eval_ssd_map.py) at the calibrated nightly config —
+width-0.25 trunk but the REAL 8,732-anchor menu at 300², so a
+MultiBoxTarget/Detection bug at real anchor shapes fails CI — and gates
+on the mAP floor.
+
+Calibration (this config, CPU, seeds 0/1/2): see QUALITY.md §3 —
+floor = worst seed − ~25% margin.
+"""
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+SCRIPT = os.path.join(REPO, "examples", "quality", "eval_ssd_map.py")
+
+
+def test_ssd_synthetic_map_floor():
+    res = subprocess.run(
+        [sys.executable, SCRIPT, "--steps", "600", "--eval-images", "500",
+         "--map-floor", "0.10"],
+        capture_output=True, text=True, timeout=7200)
+    tail = "\n".join(res.stdout.splitlines()[-5:]) + res.stderr[-2000:]
+    assert res.returncode == 0, tail
+    assert "FINAL ssd300" in res.stdout, tail
