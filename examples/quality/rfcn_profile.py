@@ -1,0 +1,150 @@
+"""Per-op on-chip trace of the north-star R-FCN train step (VERDICT
+round-3 item 3: attribute the gap between the HBM roofline bound and the
+measured step).
+
+Runs N profiled steps of the batch-B fused Deformable R-FCN step under
+``jax.profiler.trace``, parses the chrome-trace device lane, and prints a
+duration-by-kernel-class table: where every microsecond of the step goes.
+
+Run (chip): python examples/quality/rfcn_profile.py --batch 4
+Also works for the Faster-RCNN step: --model frcnn
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+import tempfile
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_HERE, "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import load_module_by_path
+
+
+# kernel-name → class rules, most specific first (XLA fusion names keep
+# the dominant op in the name)
+CLASS_RULES = [
+    ("sort", r"sort"),
+    ("nms/iou (detection)", r"(iou|nms|while)"),
+    ("conv (MXU)", r"convolution|conv_general"),
+    ("matmul (MXU)", r"dot|einsum"),
+    ("scatter/gather", r"scatter|gather|dynamic-slice|dynamic_update"),
+    ("reduce/norm", r"reduce|all-reduce"),
+    ("copy/layout", r"copy|transpose|bitcast|reshape"),
+    ("rng", r"rng|threefry"),
+    ("elementwise/other fusion", r"fusion|add|multiply|select"),
+]
+
+
+def classify(name):
+    n = name.lower()
+    for cls, pat in CLASS_RULES:
+        if re.search(pat, n):
+            return cls
+    return "other"
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--iters", type=int, default=6)
+    p.add_argument("--model", default="rfcn", choices=("rfcn", "frcnn"))
+    p.add_argument("--image-shape", type=int, nargs=2, default=None)
+    p.add_argument("--keep-trace", default=None,
+                   help="directory to keep the raw trace in")
+    args = p.parse_args()
+
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if args.model == "rfcn":
+        m = load_module_by_path(
+            os.path.join(_HERE, "..", "deformable_rfcn", "train_fused.py"),
+            "_rfcn_prof")
+        net, shape, classes = m.build_net(on_tpu, args.image_shape)
+        step, state = m.make_rfcn_train_step(
+            net, args.batch, compute_dtype="bfloat16" if on_tpu else None)
+        data, im_info, gt = m.synthetic_coco(
+            np.random.RandomState(0), args.batch, shape, classes, net.max_gts)
+        sargs = (jax.device_put(data), jax.device_put(im_info),
+                 jax.device_put(gt))
+    else:
+        m = load_module_by_path(
+            os.path.join(_HERE, "..", "rcnn", "train_fused.py"),
+            "_frcnn_prof")
+        net, shape, classes = m.build_net(on_tpu, args.image_shape)
+        step, state = m.make_frcnn_train_step(
+            net, args.batch, compute_dtype="bfloat16" if on_tpu else None)
+        data, im_info, gt = m.synthetic_voc(
+            np.random.RandomState(0), args.batch, shape, classes, net.max_gts)
+        sargs = (jax.device_put(data), jax.device_put(im_info),
+                 jax.device_put(gt))
+
+    jstep = jax.jit(step, donate_argnums=(0,))
+    key = jax.random.PRNGKey(0)
+    state, loss, _parts = jstep(state, *sargs, key)  # compile
+    jax.block_until_ready(loss)
+
+    tdir = args.keep_trace or tempfile.mkdtemp(prefix="rfcn_prof_")
+    keys = [jax.random.fold_in(key, i) for i in range(args.iters)]
+    jax.block_until_ready(keys[-1])
+    with jax.profiler.trace(tdir):
+        for i in range(args.iters):
+            state, loss, _parts = jstep(state, *sargs, keys[i])
+        float(loss)
+
+    traces = sorted(glob.glob(os.path.join(
+        tdir, "plugins", "profile", "*", "*.trace.json.gz")))
+    assert traces, "no trace produced under %s" % tdir
+    with gzip.open(traces[-1]) as f:
+        tr = json.load(f)
+    ev = tr.get("traceEvents", [])
+    dev_pids = {e["pid"] for e in ev
+                if e.get("ph") == "M" and e.get("name") == "process_name"
+                and "TPU" in e["args"].get("name", "")}
+    on_device_lane = bool(dev_pids)
+    if not on_device_lane:
+        # CPU backend: no device lane — XLA ops run inside host threads.
+        # Keep events that look like XLA kernels (drop Python/runtime ones).
+        dev_pids = {e["pid"] for e in ev if e.get("ph") == "X"}
+    by_name = collections.Counter()
+    for e in ev:
+        if e.get("ph") == "X" and e.get("pid") in dev_pids:
+            name = e.get("name", "?")
+            if name.startswith("jit_"):   # the whole-module envelope event
+                continue
+            if not on_device_lane and (
+                    "$" in name or ".py" in name or name.startswith("Pjit")
+                    or classify(name) == "other"):
+                continue
+            by_name[name] += e.get("dur", 0)
+
+    by_class = collections.Counter()
+    for name, dur in by_name.items():
+        by_class[classify(name)] += dur
+    total = sum(by_class.values())
+    per_step = total / args.iters / 1e3
+    print("%s batch=%d %s: device busy %.1f ms/step over %d steps"
+          % (args.model, args.batch, shape, per_step, args.iters))
+    print("%-28s %9s %7s" % ("class", "ms/step", "%"))
+    for cls, dur in by_class.most_common():
+        print("%-28s %9.2f %6.1f%%"
+              % (cls, dur / args.iters / 1e3, 100.0 * dur / total))
+    print("\ntop kernels:")
+    for name, dur in by_name.most_common(18):
+        print("  %8.2f ms/step  %s" % (dur / args.iters / 1e3, name[:110]))
+    if not args.keep_trace:
+        print("(trace dir: %s)" % tdir)
+
+
+if __name__ == "__main__":
+    main()

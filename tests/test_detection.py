@@ -391,6 +391,47 @@ def test_deformable_convolution_grad():
         assert_almost_equal(np.asarray(g)[idx], np.asarray(num), rtol=2e-2, atol=1e-2, names=(name, "fd"))
 
 
+def test_deformable_convolution_matmul_path():
+    """The separable one-hot-matmul sampling path (engaged above the
+    N·H·W size threshold; the TPU north-star res5 runs through it) must
+    match the numpy oracle in forward and finite differences in grad."""
+    import jax
+    from mxnet_tpu.ops.registry import get as get_op
+
+    np.random.seed(7)
+    op = get_op("_contrib_DeformableConvolution")
+    B, C, H, W, dg, F = 1, 4, 28, 28, 2, 4
+    # K2·Ho·Wo·H·W = 9·784·784 ≈ 5.5M ≥ 2^22 → matmul path
+    data = np.random.randn(B, C, H, W).astype(np.float32)
+    weight = np.random.randn(F, C, 3, 3).astype(np.float32)
+    offset = 0.5 * np.random.randn(B, 2 * dg * 9, H, W).astype(np.float32)
+    out = np.asarray(op.fn(data, offset, weight, None, kernel=(3, 3),
+                           num_filter=F, pad=(1, 1),
+                           num_deformable_group=dg, no_bias=True))
+    exp = np_deformable_conv(data, offset, weight, None, (3, 3), (1, 1),
+                             (1, 1), (1, 1), 1, dg)
+    assert_almost_equal(out, exp, rtol=1e-3, atol=1e-4)
+
+    def f(d, o, w):
+        return op.fn(d, o, w, None, kernel=(3, 3), num_filter=F,
+                     pad=(1, 1), num_deformable_group=dg, no_bias=True).sum()
+
+    g_data, g_off, g_w = jax.grad(f, argnums=(0, 1, 2))(data, offset, weight)
+    eps = np.float32(1e-2)
+    for arr, g, name in [(data, g_data, "data"), (offset, g_off, "offset"),
+                         (weight, g_w, "weight")]:
+        idx = tuple(np.unravel_index(
+            np.argmax(np.abs(np.asarray(g))), arr.shape))
+        p = arr.copy(); p[idx] += eps
+        m = arr.copy(); m[idx] -= eps
+        pick = lambda v: (v if name == "data" else data,
+                          v if name == "offset" else offset,
+                          v if name == "weight" else weight)
+        num = (f(*pick(p)) - f(*pick(m))) / (2 * eps)
+        assert_almost_equal(np.asarray(g)[idx], np.asarray(num),
+                            rtol=2e-2, atol=1e-2, names=(name, "fd"))
+
+
 def test_multi_proposal():
     np.random.seed(3)
     B, A, Hf, Wf = 2, 9, 4, 4
