@@ -136,7 +136,9 @@ def main_frcnn():
     from train_fused import run_bench
 
     on_tpu = jax.devices()[0].platform == "tpu"
-    batch = int(os.environ.get("MXNET_BENCH_BATCH", 1))
+    # batch 8 is the round-4 optimum (55.7 img/s; 16 plateaus at 57.3 —
+    # docs/PERF_NOTES.md Faster-RCNN section)
+    batch = int(os.environ.get("MXNET_BENCH_BATCH", 8 if on_tpu else 1))
     iters = int(os.environ.get("MXNET_BENCH_ITERS", 10 if on_tpu else 2))
     imgs_per_sec, _ms, _loss = run_bench(
         vgg16=on_tpu, batch=batch, iters=iters,

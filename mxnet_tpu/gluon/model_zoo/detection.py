@@ -481,11 +481,14 @@ class FasterRCNN(HybridBlock):
             feature_stride=self.stride)
         return F.BlockGrad(rois)
 
-    def _head(self, F, c5, rois):
+    def _head(self, F, c5, rois, rois_per_image=0):
         """ROIPool → flatten → fc6/fc7 (dropout) → class scores + per-class
-        deltas (symbol_vgg.py:107-122)."""
+        deltas (symbol_vgg.py:107-122).  ``rois_per_image``: static count
+        when rois are batch-major grouped (MultiProposal/proposal_target
+        layout) — enables the pooling's gather-free grouped path."""
         pooled = F.ROIPooling(c5, rois, pooled_size=(self.pooled, self.pooled),
-                              spatial_scale=1.0 / self.stride)
+                              spatial_scale=1.0 / self.stride,
+                              rois_per_image=int(rois_per_image))
         flat = F.Flatten(pooled)
         h = self.drop6(self.fc6(flat))
         h = self.drop7(self.fc7(h))
@@ -501,7 +504,8 @@ class FasterRCNN(HybridBlock):
         rpn_cls, rpn_bbox = self.rpn_cls(t), self.rpn_bbox(t)
         rois = self._proposals(F, rpn_cls, rpn_bbox, im_info, batch)
         if gt_boxes is None:  # inference
-            cls_score, bbox_pred = self._head(F, c5, rois)
+            cls_score, bbox_pred = self._head(F, c5, rois,
+                                              rois_per_image=self.rpn_post_nms)
             return rois, F.softmax(cls_score, axis=-1), bbox_pred
 
         Hf, Wf = self.feat_shape
@@ -516,7 +520,8 @@ class FasterRCNN(HybridBlock):
             batch_rois=self.batch_rois * batch,
             fg_fraction=self.fg_fraction, class_agnostic=False,
             box_stds=self.box_stds)
-        cls_score, bbox_pred = self._head(F, c5, rois_s)
+        cls_score, bbox_pred = self._head(F, c5, rois_s,
+                                          rois_per_image=self.batch_rois)
         return (rpn_cls, rpn_bbox, rpn_label, rpn_bt, rpn_bw,
                 rois_s, label, bbox_target, bbox_weight, cls_score, bbox_pred)
 

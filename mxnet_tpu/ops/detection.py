@@ -83,13 +83,22 @@ _bilinear_hw = jax.vmap(_bilinear, in_axes=(0, None, None))  # over channels
 
 
 @register("ROIPooling", alias=["_contrib_ROIPooling"])
-def roi_pooling(data, rois, *, pooled_size, spatial_scale):
+def roi_pooling(data, rois, *, pooled_size, spatial_scale, rois_per_image=0):
     """Max pooling over ROI bins (reference src/operator/roi_pooling.cc:62).
 
     data: (B, C, H, W); rois: (R, 5) [batch_idx, x1, y1, x2, y2] in image
     coords.  Integer rounding semantics: roi corners are ``round(coord *
     spatial_scale)``, bins are [floor(ph·bs), ceil((ph+1)·bs)) clipped to the
     map, empty bins output 0 (roi_pooling.cc:69-117).
+
+    ``rois_per_image`` (static, optional): caller's guarantee that rois are
+    batch-major grouped (the MultiProposal / proposal_target layout) —
+    image axes then align by indexing and the per-roi ``data[batch_idx]``
+    gather disappears.  The chip profile of the batch-4 Faster-RCNN step
+    showed that gather as a sequential while + ~1.3 GB of feature-map
+    copies (~65 ms/step of a 120 ms step); the grouped path is the same
+    separable masked-max with zero gathers.  Like the deformable pooling's
+    hint, this TRUSTS the layout and ignores the batch_idx column.
     """
     PH, PW = _pair(pooled_size)
     B, C, H, W = data.shape
@@ -127,15 +136,26 @@ def roi_pooling(data, rois, *, pooled_size, spatial_scale):
     mask_w = (ww[None, None, :] >= wstart[:, :, None]) & (ww[None, None, :] < wend[:, :, None])  # (R,PW,W)
 
     neg = jnp.array(-np.inf, f32)
+    Rb = int(rois_per_image)
+    if Rb > 0 and R == B * Rb:
+        # grouped path: roi r belongs to image r // Rb — pure indexing
+        mh = mask_h.reshape(B, Rb, PH, H)
+        mw = mask_w.reshape(B, Rb, PW, W)
+        # separable masked max, image axes aligned; XLA fuses select+reduce
+        t = jnp.where(mh[:, :, :, None, :, None], data[:, None, None], neg
+                      ).max(axis=4)                       # (B,Rb,PH,C,W)
+        o = jnp.where(mw[:, :, None, None, :], t[..., None, :], neg
+                      ).max(axis=5)                       # (B,Rb,PH,C,PW)
+        out = o.transpose(0, 1, 3, 2, 4).reshape(R, C, PH, PW)
+    else:
+        def one_roi(b, mh, mw):
+            feat = data[b]  # (C, H, W)
+            # separable masked max: over H then W; XLA fuses select+reduce
+            t = jnp.where(mh[:, None, :, None], feat[None], neg).max(axis=2)  # (PH,C,W)
+            o = jnp.where(mw[:, None, None, :], t[None], neg).max(axis=3)  # (PW,PH,C)
+            return o.transpose(2, 1, 0)  # (C, PH, PW)
 
-    def one_roi(b, mh, mw):
-        feat = data[b]  # (C, H, W)
-        # separable masked max: over H then W; XLA fuses select+reduce
-        t = jnp.where(mh[:, None, :, None], feat[None], neg).max(axis=2)  # (PH,C,W)
-        o = jnp.where(mw[:, None, None, :], t[None], neg).max(axis=3)  # (PW,PH,C)
-        return o.transpose(2, 1, 0)  # (C, PH, PW)
-
-    out = jax.vmap(one_roi)(batch_idx, mask_h, mask_w)  # (R, C, PH, PW)
+        out = jax.vmap(one_roi)(batch_idx, mask_h, mask_w)  # (R, C, PH, PW)
     empty = (hend <= hstart)[:, None, :, None] | (wend <= wstart)[:, None, None, :]
     return jnp.where(empty, jnp.zeros((), f32), out)
 

@@ -391,6 +391,35 @@ def test_deformable_convolution_grad():
         assert_almost_equal(np.asarray(g)[idx], np.asarray(num), rtol=2e-2, atol=1e-2, names=(name, "fd"))
 
 
+def test_roi_pooling_grouped_path_matches_ungrouped():
+    """The gather-free grouped path (``rois_per_image`` hint, the
+    Faster-RCNN head's layout) must match the general path bit-for-bit in
+    forward and gradients for batch-major rois."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.detection import roi_pooling
+
+    rng = np.random.RandomState(5)
+    B, C, H, W, Rb = 3, 8, 12, 16, 10
+    R = B * Rb
+    data = jnp.asarray(rng.rand(B, C, H, W).astype(np.float32))
+    rois = np.zeros((R, 5), np.float32)
+    rois[:, 0] = np.repeat(np.arange(B), Rb)
+    rois[:, 1:3] = rng.rand(R, 2) * 100
+    rois[:, 3:5] = rois[:, 1:3] + rng.rand(R, 2) * 100 + 8
+    kw = dict(pooled_size=4, spatial_scale=1 / 8)
+    base = roi_pooling(data, jnp.asarray(rois), **kw)
+    grouped = roi_pooling(data, jnp.asarray(rois), rois_per_image=Rb, **kw)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(grouped))
+    g0 = jax.grad(lambda d: (roi_pooling(d, jnp.asarray(rois), **kw) ** 2
+                             ).sum())(data)
+    g1 = jax.grad(lambda d: (roi_pooling(d, jnp.asarray(rois),
+                                         rois_per_image=Rb, **kw) ** 2
+                             ).sum())(data)
+    np.testing.assert_allclose(np.asarray(g0), np.asarray(g1),
+                               rtol=1e-6, atol=1e-6)
+
+
 def test_deformable_convolution_matmul_path():
     """The separable one-hot-matmul sampling path (engaged above the
     N·H·W size threshold; the TPU north-star res5 runs through it) must
