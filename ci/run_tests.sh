@@ -64,6 +64,10 @@ case "$tier" in
     # seeds 0/1/2 (QUALITY.md §3) — floor 0.14 = worst seed − ~20%
     python examples/quality/eval_rfcn_map.py --resnet101 --steps 3000 \
       --live-bn --map-floor 0.14
+    # Faster-RCNN VGG16 chip gate (round 4): seeds 0/1/2 → 0.8085/0.7883/
+    # 0.8113 — floor 0.63 = worst − ~20% (QUALITY.md §3)
+    python examples/quality/eval_frcnn_map.py --vgg16 --steps 3000 \
+      --map-floor 0.63
     ;;
   all)
     "$SELF" unit

@@ -162,7 +162,14 @@ def build_net(vgg16, image_shape=None, classes=None, rpn_pre_nms=None,
             rpn_post_nms=rpn_post_nms or 32,
             batch_rois=16, rpn_batch=32, max_gts=8)
     if init:
-        net.initialize()
+        # He/MSRA-style init: the VGG trunk has NO normalization layers, so
+        # default-uniform init explodes activations over 13 relu convs at
+        # 608×1024 (first-step CE was ~200 vs the ~log(C+1) a calibrated
+        # head gives).  The reference recipe sidesteps this with pretrained
+        # trunk weights + Normal(0.01) new layers; from-scratch synthetic
+        # training needs variance-preserving init instead.
+        net.initialize(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                                      magnitude=2))
         net.init_params()
     return net, shape, classes
 

@@ -657,8 +657,15 @@ def deformable_convolution(
                 + lxb[:, None] * (xb1[:, None] == iota_x))        # (N, W)
             a = jnp.einsum("nh,nw->nhw", yv, xv,
                            precision=jax.lax.Precision.HIGHEST)
+            # defensive: pin f32 accumulation for bf16 inputs on every
+            # backend (the MXU's native behavior; XLA:CPU may otherwise
+            # accumulate bf16).  NOT testable via the consistency tier —
+            # its bf16 variant of this path is excluded for the unrelated
+            # floor()-bin-flip reason (test_consistency_tpu.py case note).
             return jnp.matmul(a.reshape(N, H * W).astype(f32), ft,
-                              precision=prec)                     # (N, cpg)
+                              precision=prec,
+                              preferred_element_type=jnp.float32
+                              ).astype(f32)                       # (N, cpg)
 
         flat = lambda a: a.reshape(B * DG, N)
         _, col = jax.lax.scan(

@@ -203,6 +203,10 @@ def _cases():
     rois[:, 3:] += 2.0
     add("ROIPooling", _op("ROIPooling", pooled_size=(3, 3), spatial_scale=0.5),
         [_d(1, 4, 10, 10), rois], bf16=True)
+    add("ROIPooling_grouped",  # the Faster-RCNN head's gather-free path
+        _op("ROIPooling", pooled_size=(3, 3), spatial_scale=0.5,
+            rois_per_image=8),
+        [_d(1, 4, 10, 10), rois], bf16=True)
     add("ROIAlign", _op("_contrib_ROIAlign", pooled_size=(3, 3),
                         spatial_scale=0.5, sample_ratio=2),
         [_d(1, 4, 10, 10), rois], bf16=True)
@@ -222,6 +226,15 @@ def _cases():
         _op("_contrib_DeformableConvolution", kernel=(3, 3), num_filter=6,
             pad=(1, 1), num_deformable_group=2, no_bias=True),
         [_d(1, 4, 8, 8), 0.5 * _d(1, 36, 8, 8), _d(6, 4, 3, 3)], bf16=True)
+    add("DeformableConvolution_matmul",  # K2·Ho·Wo·H·W ≥ 2^22 → the
+        # separable one-hot-matmul sampling path (the res5 hot path).
+        # fp32 only: with 7k offset-driven samples, bf16-rounded offsets
+        # flip floor() bins for ~2% of samples vs the f32 oracle (the same
+        # score-discontinuity rationale that excludes bf16 MultiProposal)
+        _op("_contrib_DeformableConvolution", kernel=(3, 3), num_filter=6,
+            pad=(1, 1), num_deformable_group=2, no_bias=True),
+        [_d(1, 4, 28, 28), 0.5 * _d(1, 36, 28, 28), _d(6, 4, 3, 3)],
+        bf16=False)
     add("MultiProposal",
         _op("_contrib_MultiProposal", rpn_pre_nms_top_n=60, rpn_post_nms_top_n=12,
             scales=(4, 8), ratios=(0.5, 1, 2), feature_stride=16, rpn_min_size=4),
