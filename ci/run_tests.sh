@@ -68,6 +68,10 @@ case "$tier" in
     # 0.8113 — floor 0.63 = worst − ~20% (QUALITY.md §3)
     python examples/quality/eval_frcnn_map.py --vgg16 --steps 3000 \
       --map-floor 0.63
+    # SSD-300 full-width chip gate (round 4, with lr warmup): seeds 0/1/2
+    # → 0.6802/0.9034/0.9214 — floor 0.54 = worst − ~20% (QUALITY.md §3)
+    python examples/quality/eval_ssd_map.py --full --steps 2000 \
+      --map-floor 0.54
     ;;
   all)
     "$SELF" unit

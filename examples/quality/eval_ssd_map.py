@@ -130,19 +130,25 @@ def main():
     else:
         jstep = jax.jit(step, donate_argnums=(0,))
 
+    # linear warmup then step decay: from-scratch SSD is warmup-sensitive —
+    # without it the hard-negative-mining cold start collapses some seeds
+    # (chip calibration measured 0.35 vs 0.90 across seeds pre-warmup)
     decay_points = {int(steps * 0.6), int(steps * 0.85)}
+    warmup = max(1, steps // 10)
     lr = args.lr
     for s in range(steps):
         if s in decay_points:
             lr *= 0.1
             print("lr -> %g at step %d" % (lr, s), flush=True)
+        lr_t = lr * min(1.0, (s + 1) / warmup)
         if use_device_data:
-            state, loss, parts = jstep_dev(state, np.int32(s), np.float32(lr))
+            state, loss, parts = jstep_dev(state, np.int32(s),
+                                           np.float32(lr_t))
         else:
             data, gt = synthetic_voc(rng, args.batch, args.size, args.classes)
             state, loss, parts = jstep(state, data, gt,
                                        jax.random.fold_in(key, s),
-                                       np.float32(lr))
+                                       np.float32(lr_t))
         if s % max(1, steps // 8) == 0:
             print("step %4d  loss %.4f" % (s, float(loss)), flush=True)
 

@@ -8,7 +8,6 @@ targets/weights, the single-XLA-module train step
 every head with the conv1/conv2 FIXED_PARAMS cut, and loss decrease.
 """
 import os
-import sys
 
 import numpy as np
 import pytest
@@ -18,8 +17,16 @@ from mxnet_tpu import nd
 
 EXDIR = os.path.abspath(
     os.path.join(os.path.dirname(__file__), "..", "examples", "rcnn"))
-if EXDIR not in sys.path:
-    sys.path.insert(0, EXDIR)
+
+
+def _train_fused():
+    # unique module name: a bare ``import train_fused`` collides with the
+    # deformable_rfcn example module of the same name when the full suite
+    # imports both (test_rfcn_fused.py wins the sys.modules slot)
+    from mxnet_tpu.test_utils import load_module_by_path
+
+    return load_module_by_path(os.path.join(EXDIR, "train_fused.py"),
+                               "_frcnn_train_fused_tests")
 
 
 def _tiny_net(**kw):
@@ -97,7 +104,9 @@ def test_box_stds_normalization():
 
 def test_fused_step_gradients_reach_every_head():
     import jax
-    from train_fused import make_frcnn_train_step, synthetic_voc
+
+    tf = _train_fused()
+    make_frcnn_train_step, synthetic_voc = tf.make_frcnn_train_step, tf.synthetic_voc
 
     mx.random.seed(1)
     net = _tiny_net()
@@ -128,7 +137,9 @@ def test_fused_step_gradients_reach_every_head():
 
 def test_fused_step_trains():
     import jax
-    from train_fused import make_frcnn_train_step, synthetic_voc
+
+    tf = _train_fused()
+    make_frcnn_train_step, synthetic_voc = tf.make_frcnn_train_step, tf.synthetic_voc
 
     mx.random.seed(2)
     net = _tiny_net()

@@ -5,7 +5,6 @@ train step (examples/deformable_rfcn/train_fused.py make_rfcn_train_step),
 gradient flow into every head, and loss decrease over a few steps.
 """
 import os
-import sys
 
 import numpy as np
 import pytest
@@ -15,8 +14,16 @@ from mxnet_tpu import nd
 
 EXDIR = os.path.abspath(
     os.path.join(os.path.dirname(__file__), "..", "examples", "deformable_rfcn"))
-if EXDIR not in sys.path:
-    sys.path.insert(0, EXDIR)
+
+
+def _train_fused():
+    # load by unique module name: three example dirs ship a train_fused.py
+    # and a bare import races for the sys.modules slot (same fix as
+    # test_frcnn_fused.py)
+    from mxnet_tpu.test_utils import load_module_by_path
+
+    return load_module_by_path(os.path.join(EXDIR, "train_fused.py"),
+                               "_rfcn_train_fused_tests")
 
 
 def _tiny_net(**kw):
@@ -58,7 +65,9 @@ def test_model_forward_shapes_train_and_infer():
 
 def test_fused_step_gradients_reach_every_head():
     import jax
-    from train_fused import make_rfcn_train_step, synthetic_coco
+
+    tf = _train_fused()
+    make_rfcn_train_step, synthetic_coco = tf.make_rfcn_train_step, tf.synthetic_coco
 
     mx.random.seed(1)
     net = _tiny_net()
@@ -89,7 +98,9 @@ def test_fused_step_gradients_reach_every_head():
 
 def test_fused_step_trains():
     import jax
-    from train_fused import make_rfcn_train_step, synthetic_coco
+
+    tf = _train_fused()
+    make_rfcn_train_step, synthetic_coco = tf.make_rfcn_train_step, tf.synthetic_coco
 
     mx.random.seed(2)
     net = _tiny_net()

@@ -51,7 +51,11 @@ def test_rec2idx_roundtrip(tmp_path):
 def test_kill_mxnet_command():
     km = _load("kill-mxnet.py")
     cmd = km.kill_command("bob", "train.py")
-    assert "grep 'train.py'" in cmd and '"bob"' in cmd and "kill -9" in cmd
+    # shlex-quoted fixed-string grep (round-4 hardening): metachars inert
+    assert "grep -F -- train.py" in cmd and "u=bob" in cmd and "kill -9" in cmd
+    import shlex
+    hostile = "x'; rm -rf /; '"
+    assert shlex.quote(hostile) in km.kill_command("bob", hostile)
 
 
 def test_diagnose_runs():
