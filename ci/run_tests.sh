@@ -72,6 +72,9 @@ case "$tier" in
     # → 0.6802/0.9034/0.9214 — floor 0.54 = worst − ~20% (QUALITY.md §3)
     python examples/quality/eval_ssd_map.py --full --steps 2000 \
       --map-floor 0.54
+    # SSD-512 at the 24564-anchor menu: single-seed 0.8868, floor 0.60
+    python examples/quality/eval_ssd_map.py --full --size 512 --steps 2000 \
+      --map-floor 0.60
     ;;
   all)
     "$SELF" unit
