@@ -105,15 +105,26 @@ def kvstore_two_process():
     worker = os.path.join(tempfile.mkdtemp(), "worker.py")
     with open(worker, "w") as f:
         f.write(KV_WORKER)
-    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    # rendezvous timeout raised above the 300 s jax default, subprocess
+    # budget raised with it, and a timed-out attempt counts as a retry:
+    # under a saturated 1-core host (full nightly suite) Gloo connects can
+    # take minutes
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               MXNET_DIST_INIT_TIMEOUT="420")
+    res = None
     for _attempt in range(3):
-        res = subprocess.run(
-            [sys.executable, os.path.join(REPO, "tools", "launch.py"),
-             "-n", "2", "--launcher", "local", sys.executable, worker],
-            env=env, capture_output=True, text=True, timeout=420)
+        try:
+            res = subprocess.run(
+                [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+                 "-n", "2", "--launcher", "local", sys.executable, worker],
+                env=env, capture_output=True, text=True, timeout=540)
+        except subprocess.TimeoutExpired:
+            continue
         if res.returncode == 0:
             break
-    assert res.returncode == 0, res.stdout + res.stderr
+    assert res is not None and res.returncode == 0, (
+        "launch attempts timed out" if res is None
+        else res.stdout + res.stderr)
     lines = sorted(l.split("_OK ")[1] for l in res.stdout.splitlines()
                    if "_OK" in l)
     assert len(lines) == 2 and lines[0] == lines[1], res.stdout

@@ -7,11 +7,12 @@ width-0.25 trunk but the REAL 8,732-anchor menu at 300², so a
 MultiBoxTarget/Detection bug at real anchor shapes fails CI — and gates
 on the mAP floor.
 
-Floor: pre-warmup seeds spread 0.0172-0.1149 (600 steps is the
-high-variance regime); lr warmup (added after chip seed 0 collapsed
-0.90→0.35 without it) is expected to tighten this — the floor below is
-provisional catastrophic-only (a broken target assignment scores ~0.000x)
-until the warmup 3-seed recalibration lands in QUALITY.md §3.
+Calibration (this config, CPU, round 4, with lr warmup): seeds 0/1/2 →
+mAP 0.0603 / 0.0164 / 0.2133.  The w0.25 600-step config is intrinsically
+high-variance (warmup rescued the full-width chip config's collapsed seed
+but not this narrow one), so the floor is worst seed − ~27% = **0.012** —
+still 20× above a broken target assignment (~0.0005 at smoke length),
+which is the failure mode this gate exists to catch.
 """
 import os
 import subprocess
