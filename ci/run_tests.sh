@@ -56,7 +56,13 @@ case "$tier" in
       echo "tpu tier: /root/.axon_site missing — refusing to fall back to CPU" >&2
       exit 1
     fi
-    MXNET_TEST_DEVICE=tpu python -m pytest tests/test_consistency_tpu.py -q
+    # one FULL retry: the axon tunnel occasionally drops a remote_compile
+    # mid-read ("response body closed before all bytes"), surfacing as a
+    # JaxRuntimeError on a random case — environmental, not numeric; real
+    # consistency failures reproduce on the retry.  A full re-run (not
+    # --last-failed) so a hard crash can't leave cases silently unexecuted
+    MXNET_TEST_DEVICE=tpu python -m pytest tests/test_consistency_tpu.py -q \
+      || MXNET_TEST_DEVICE=tpu python -m pytest tests/test_consistency_tpu.py -q
     python bench.py
     MXNET_BENCH=resnet50 python bench.py
     # detection-quality gate on the chip (VERDICT r2 item 5): full R-101

@@ -1,4 +1,11 @@
-"""Faster R-CNN end-to-end training — reference ``example/rcnn/train_end2end.py``.
+"""Faster R-CNN end-to-end training, eager path — reference
+``example/rcnn/train_end2end.py``.
+
+This is the flexible eager/Trainer loop on a small ad-hoc trunk (useful
+for stepping through the pipeline).  The FULL-FIDELITY config-2 recipe —
+VGG16 trunk at 608×1024, one-XLA-module fused step, chip-benched
+(55.7 img/s) and mAP-gated — is ``train_fused.py`` in this directory;
+use that for anything beyond debugging.
 
 --synthetic generates a shapes dataset (pixel-coordinate gt boxes) so the
 whole pipeline runs anywhere; pass a detection .rec for real data.
