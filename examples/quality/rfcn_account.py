@@ -1,0 +1,229 @@
+"""Per-execution roofline accounting of the fused detection train step.
+
+VERDICT round-4 item 1: the batch-8 north star measured 235 ms against a
+188.9 ms "naive" HBM bound (80%) computed from the compiled module's
+aggregate cost analysis — and that bound is wrong in BOTH directions:
+
+* ``while`` bodies are counted ONCE by ``Compiled.cost_analysis()``, not
+  once per trip (the pooling/deformable scans run NB=49 iterations), so
+  the naive bound UNDERcounts loop bytes;
+* fusion operands that stay resident in VMEM across the fusion boundary
+  are counted as HBM traffic, so it OVERcounts streamed bytes (the
+  round-4 "A-matrix never re-read" explanation — visible in the trace as
+  loop fusions with apparent bandwidth ABOVE the 819 GB/s HBM peak).
+
+This tool replaces that aggregate with a per-execution accounting built
+from the device trace itself: every "XLA Ops" event carries XLA's
+per-instruction ``bytes_accessed`` and ``model_flops``, so summing over
+*leaf* events (envelope events like the scan ``while`` contain their body
+events — interval containment on the lane gives the nesting) counts each
+loop iteration exactly once at instruction granularity.  Reported:
+
+* module wall per step ("XLA Modules" lane — the true device time);
+* leaf-sum ms (≈ wall when the TensorCore runs ops serially — a check
+  that the attribution covers 100% of the step);
+* corrected HBM/MXU bounds and the **per-op serial roofline**
+  Σ max(bytes/BW_peak, flops/MXU_peak) — the defended bound;
+* a ms-by-ms table by HLO category with achieved bandwidth.
+
+Run (chip): python examples/quality/rfcn_account.py --batch 8
+Also: --model frcnn, --batches 1 4 8 for a scaling table.
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+import tempfile
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_HERE, "..", ".."))
+
+import numpy as np
+
+V5E_HBM_BPS = 819e9
+V5E_BF16_FLOPS = 197e12
+
+
+def build_step(model, batch, image_shape):
+    import jax
+
+    from mxnet_tpu.test_utils import load_module_by_path
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if model == "rfcn":
+        m = load_module_by_path(
+            os.path.join(_HERE, "..", "deformable_rfcn", "train_fused.py"),
+            "_rfcn_acct")
+        net, shape, classes = m.build_net(on_tpu, image_shape)
+        step, state = m.make_rfcn_train_step(
+            net, batch, compute_dtype="bfloat16" if on_tpu else None)
+        data, im_info, gt = m.synthetic_coco(
+            np.random.RandomState(0), batch, shape, classes, net.max_gts)
+    else:
+        m = load_module_by_path(
+            os.path.join(_HERE, "..", "rcnn", "train_fused.py"), "_frcnn_acct")
+        net, shape, classes = m.build_net(on_tpu, image_shape)
+        step, state = m.make_frcnn_train_step(
+            net, batch, compute_dtype="bfloat16" if on_tpu else None)
+        data, im_info, gt = m.synthetic_voc(
+            np.random.RandomState(0), batch, shape, classes, net.max_gts)
+    import jax
+
+    sargs = (jax.device_put(data), jax.device_put(im_info),
+             jax.device_put(gt))
+    return step, state, sargs, shape
+
+
+def parse_trace(tdir, iters):
+    traces = sorted(glob.glob(os.path.join(
+        tdir, "plugins", "profile", "*", "*.trace.json.gz")))
+    assert traces, "no trace under %s" % tdir
+    with gzip.open(traces[-1]) as f:
+        tr = json.load(f)
+    ev = tr.get("traceEvents", [])
+    tidname = {}
+    for e in ev:
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            tidname[(e["pid"], e.get("tid"))] = e["args"].get("name", "")
+
+    def lane(name):
+        return [e for e in ev if e.get("ph") == "X"
+                and tidname.get((e["pid"], e.get("tid"))) == name]
+
+    mods = lane("XLA Modules")
+    ops = lane("XLA Ops")
+    if not mods:   # CPU backend — no device lanes; tool is chip-only
+        raise SystemExit("no device lane in trace (run on the chip)")
+    wall_ms = sum(e["dur"] for e in mods) / len(mods) / 1e3
+
+    # nesting by interval containment on the single ops lane: an event
+    # whose [ts, ts+dur) contains later events is an envelope (scan/while);
+    # only LEAVES carry real instruction cost exactly once per execution
+    ops.sort(key=lambda e: (e["ts"], -e["dur"]))
+    stack, has_child = [], set()
+    for i, e in enumerate(ops):
+        while stack and (ops[stack[-1]]["ts"] + ops[stack[-1]]["dur"]
+                         <= e["ts"] + 1e-9):
+            stack.pop()
+        if stack:
+            has_child.add(stack[-1])
+        stack.append(i)
+
+    cat = collections.defaultdict(lambda: [0.0, 0.0, 0.0])  # dur, bytes, flops
+    tot = [0.0, 0.0, 0.0]
+    serial_us = 0.0
+    for i, e in enumerate(ops):
+        if i in has_child:
+            continue
+        a = e.get("args", {})
+        b = float(a.get("bytes_accessed", 0) or 0)
+        f = float(a.get("model_flops", 0) or 0)
+        d = e["dur"]
+        c = cat[a.get("hlo_category", "?")]
+        c[0] += d; c[1] += b; c[2] += f
+        tot[0] += d; tot[1] += b; tot[2] += f
+        serial_us += max(b / V5E_HBM_BPS * 1e6, f / V5E_BF16_FLOPS * 1e6)
+    n = float(iters)
+    return dict(
+        wall_ms=wall_ms,
+        leaf_ms=tot[0] / n / 1e3,
+        bytes_gb=tot[1] / n / 1e9,
+        flops_tf=tot[2] / n / 1e12,
+        hbm_ms=tot[1] / n / V5E_HBM_BPS * 1e3,
+        mxu_ms=tot[2] / n / V5E_BF16_FLOPS * 1e3,
+        serial_ms=serial_us / n / 1e3,
+        cats={k: (v[0] / n / 1e3, v[1] / n / 1e9, v[2] / n / 1e12)
+              for k, v in cat.items()},
+    )
+
+
+def run_one(model, batch, image_shape, iters, keep_trace):
+    import jax
+
+    step, state, sargs, shape = build_step(model, batch, image_shape)
+    jstep = jax.jit(step, donate_argnums=(0,))
+    key = jax.random.PRNGKey(0)
+    t0 = time.time()
+    lowered = jstep.lower(state, *sargs, key)
+    comp = lowered.compile()
+    compile_s = time.time() - t0
+    ca = comp.cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
+    naive_gb = float(ca.get("bytes accessed", 0.0)) / 1e9
+    naive_tf = float(ca.get("flops", 0.0)) / 1e12
+
+    state, loss, _ = comp(state, *sargs, key)
+    jax.block_until_ready(loss)
+    # measured wall: chained steps, donated state, scalar fetch (tunnel rules)
+    keys = [jax.random.fold_in(key, i) for i in range(iters)]
+    jax.block_until_ready(keys[-1])
+    t0 = time.perf_counter()
+    for k in keys:
+        state, loss, _ = comp(state, *sargs, k)
+    float(loss)
+    meas_ms = (time.perf_counter() - t0) / iters * 1e3
+
+    tdir = keep_trace or tempfile.mkdtemp(prefix="acct_%s_b%d_" % (model, batch))
+    keys = [jax.random.fold_in(key, 100 + i) for i in range(iters)]
+    jax.block_until_ready(keys[-1])
+    with jax.profiler.trace(tdir):
+        for k in keys:
+            state, loss, _ = comp(state, *sargs, k)
+        float(loss)
+    r = parse_trace(tdir, iters)
+    r.update(model=model, batch=batch, shape=shape, compile_s=compile_s,
+             naive_gb=naive_gb, naive_tf=naive_tf, meas_ms=meas_ms,
+             naive_hbm_ms=naive_gb * 1e9 / V5E_HBM_BPS * 1e3, trace=tdir)
+    return r
+
+
+def report(r):
+    print("\n== %s batch=%d %s (compile %.0fs) ==" %
+          (r["model"], r["batch"], r["shape"], r["compile_s"]))
+    print("measured %.1f ms/step (%.2f img/s) | module wall %.1f ms | "
+          "host/dispatch %.1f ms" %
+          (r["meas_ms"], r["batch"] / r["meas_ms"] * 1e3, r["wall_ms"],
+           r["meas_ms"] - r["wall_ms"]))
+    print("naive module cost analysis: %.1f GB, %.2f TF -> HBM bound %.1f ms "
+          "(while bodies x1, VMEM residents counted)" %
+          (r["naive_gb"], r["naive_tf"], r["naive_hbm_ms"]))
+    print("per-execution leaves: %.1f GB, %.2f TF | leaf-sum %.1f ms "
+          "(%.0f%% of wall -> serial TensorCore, full coverage)" %
+          (r["bytes_gb"], r["flops_tf"], r["leaf_ms"],
+           100.0 * r["leaf_ms"] / r["wall_ms"]))
+    print("corrected bounds: HBM %.1f ms, MXU %.1f ms | per-op serial "
+          "roofline %.1f ms | wall = %.0f%% of serial roofline" %
+          (r["hbm_ms"], r["mxu_ms"], r["serial_ms"],
+           100.0 * r["wall_ms"] / r["serial_ms"]))
+    print("%-24s %8s %8s %9s %8s %9s" %
+          ("category", "ms/step", "GB/step", "GB/s", "TF/step", "bound ms"))
+    for k, (d, b, f) in sorted(r["cats"].items(), key=lambda kv: -kv[1][0]):
+        if d < 0.05:
+            continue
+        bound = max(b * 1e9 / V5E_HBM_BPS, f * 1e12 / V5E_BF16_FLOPS) * 1e3
+        print("%-24s %8.2f %8.2f %9.0f %8.3f %9.2f" %
+              (k, d, b, b / d * 1e3 if d else 0, f, bound))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", default="rfcn", choices=("rfcn", "frcnn"))
+    p.add_argument("--batches", type=int, nargs="+", default=[8])
+    p.add_argument("--image-shape", type=int, nargs=2, default=None)
+    p.add_argument("--iters", type=int, default=6)
+    p.add_argument("--keep-trace", default=None)
+    args = p.parse_args()
+    for b in args.batches:
+        r = run_one(args.model, b, args.image_shape and tuple(args.image_shape),
+                    args.iters, args.keep_trace)
+        report(r)
+
+
+if __name__ == "__main__":
+    main()

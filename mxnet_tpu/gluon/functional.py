@@ -66,7 +66,8 @@ def merge_params(names, aux_names, learn, aux):
 
 
 def make_train_step(net, loss_fn, learning_rate=0.01, momentum=0.0,
-                    compute_dtype=None):
+                    compute_dtype=None, mesh=None, data_axis="dp",
+                    shard_optimizer_states=False):
     """Build a fully-jittable SGD train step for an initialized Block.
 
     → (step, state) where ``state = (param_vals, momentum_vals, aux_vals)``
@@ -81,6 +82,24 @@ def make_train_step(net, loss_fn, learning_rate=0.01, momentum=0.0,
     traffic, native MXU dtype; the reference's fp16 multi-precision mode,
     ``optimizer_op.cc mp_sgd_mom_update``, with bf16's range so no loss
     scaling is needed), loss and BN statistics in fp32.
+
+    **Data-parallel + ZeRO**: pass ``mesh`` (a ``jax.sharding.Mesh`` with a
+    ``data_axis`` axis) and the returned ``step`` comes back **already
+    jitted** (donated state, pinned output shardings, replicated by
+    default) ready for SPMD data parallelism — shard the batch over
+    ``data_axis`` and GSPMD derives the gradient collectives from the loss
+    mean (the reference's KVStore allreduce, ``src/kvstore/comm.h:451``,
+    collapses into the jitted step).  With
+    ``shard_optimizer_states=True`` the returned state additionally has
+    parameters and momentum partitioned over ``data_axis`` (ZeRO/FSDP
+    style: each array's first divisible axis is split; aux/BN stats stay
+    replicated) and the returned ``step`` is **already jitted** with
+    donation + pinned output shardings, so the partition survives every
+    step without hand-written ``device_put`` specs.  GSPMD inserts the
+    forward all-gathers and update reduce-scatters; per-device optimizer
+    bytes drop ~axis-size×, which is what frees HBM for activations at
+    north-star scale (the ``__graft_entry__`` ZeRO phase measures 50 MB vs
+    399 MB at ResNet-101 scale).
     """
     import jax
     import jax.numpy as jnp
@@ -128,4 +147,25 @@ def make_train_step(net, loss_fn, learning_rate=0.01, momentum=0.0,
     learn_vals = [vals[i] for i in learn_idx]
     aux_vals = [vals[i] for i in aux_idx]
     mom_vals = [jnp.zeros_like(v) for v in learn_vals] if momentum else []
-    return step, (learn_vals, mom_vals, aux_vals), (names, learn_idx, aux_idx)
+    state = (learn_vals, mom_vals, aux_vals)
+
+    if shard_optimizer_states and mesh is None:
+        raise ValueError(
+            "shard_optimizer_states=True needs a mesh with a '%s' axis "
+            "(parallel.make_mesh({'%s': n}))" % (data_axis, data_axis))
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel import zero_shard_spec
+
+        repl = NamedSharding(mesh, P())
+        spec = ((lambda v: zero_shard_spec(v, mesh, data_axis))
+                if shard_optimizer_states else (lambda v: repl))
+        state = ([jax.device_put(v, spec(v)) for v in learn_vals],
+                 [jax.device_put(v, spec(v)) for v in mom_vals],
+                 [jax.device_put(v, repl) for v in aux_vals])
+        state_sh = jax.tree_util.tree_map(lambda v: v.sharding, state)
+        step = jax.jit(step, donate_argnums=(0,),
+                       out_shardings=(state_sh, repl))
+
+    return step, state, (names, learn_idx, aux_idx)

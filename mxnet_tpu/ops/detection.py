@@ -42,6 +42,47 @@ def _pair(v):
     return v * 2 if len(v) == 1 else v
 
 
+def _check_grouped_layout(batch_idx, B, Rb, op):
+    """Debug-mode validation of the ``rois_per_image`` layout contract.
+
+    The grouped pooling paths TRUST that roi r belongs to image r // Rb and
+    ignore the batch_idx column (a traced value cannot be asserted inside
+    jit).  Under the synchronous debug engine (``MXNET_ENGINE_TYPE=
+    NaiveEngine`` / ``engine.naive_engine()`` — the reference's debug story,
+    ``docs/faq/env_var.md:52-56``) values are concrete, so the contract IS
+    checkable: a batch_idx column that carries real indices inconsistent
+    with r // Rb raises here instead of silently pooling from the wrong
+    image.  A CONSTANT column (callers that group positionally and leave
+    batch_idx at 0 — valid per the "column is ignored" contract) passes.
+    Zero cost on the fused path — the check short-circuits unless debug
+    mode is on, and a tracer (still possible under ``disable_jit`` inside
+    e.g. ``jax.grad``) skips it.
+    """
+    from .. import engine
+
+    if not engine.is_naive():
+        return
+    try:
+        idx = np.asarray(batch_idx).reshape(B, Rb)
+    except Exception:  # tracer or abstract value — nothing to check
+        return
+    if (idx == idx.reshape(-1)[0]).all():
+        # constant column (e.g. left at 0): the caller grouped positionally
+        # and never filled batch_idx — consistent with the documented
+        # "column is ignored" contract, no evidence of misuse
+        return
+    expect = np.broadcast_to(np.arange(B)[:, None], (B, Rb))
+    if not np.array_equal(idx, expect):
+        bad = int(np.argmax((idx != expect).reshape(-1)))
+        raise ValueError(
+            "%s: rois_per_image=%d promises batch-major grouped rois "
+            "(roi r belongs to image r // %d), but roi %d has batch_idx "
+            "%d, expected %d. Pass rois straight from MultiProposal/"
+            "proposal_target, or drop the rois_per_image hint."
+            % (op, Rb, Rb, bad, int(idx.reshape(-1)[bad]),
+               int(expect.reshape(-1)[bad])))
+
+
 # ---------------------------------------------------------------------------
 # bilinear sampling helpers
 # ---------------------------------------------------------------------------
@@ -98,7 +139,9 @@ def roi_pooling(data, rois, *, pooled_size, spatial_scale, rois_per_image=0):
     showed that gather as a sequential while + ~1.3 GB of feature-map
     copies (~65 ms/step of a 120 ms step); the grouped path is the same
     separable masked-max with zero gathers.  Like the deformable pooling's
-    hint, this TRUSTS the layout and ignores the batch_idx column.
+    hint, this TRUSTS the layout and ignores the batch_idx column; under
+    the synchronous debug engine (``MXNET_ENGINE_TYPE=NaiveEngine``) the
+    contract is validated and misuse raises (``_check_grouped_layout``).
     """
     PH, PW = _pair(pooled_size)
     B, C, H, W = data.shape
@@ -139,6 +182,7 @@ def roi_pooling(data, rois, *, pooled_size, spatial_scale, rois_per_image=0):
     Rb = int(rois_per_image)
     if Rb > 0 and R == B * Rb:
         # grouped path: roi r belongs to image r // Rb — pure indexing
+        _check_grouped_layout(batch_idx, B, Rb, "ROIPooling")
         mh = mask_h.reshape(B, Rb, PH, H)
         mw = mask_w.reshape(B, Rb, PW, W)
         # separable masked max, image axes aligned; XLA fuses select+reduce
@@ -337,7 +381,9 @@ def deformable_psroi_pooling(
     set silently pool from the wrong image (a traced value can't be
     asserted).  Only pass it when the rois come straight from
     MultiProposal/proposal_target or an equivalently grouped source; a
-    value that doesn't divide R falls back to the general path.
+    value that doesn't divide R falls back to the general path.  Under the
+    synchronous debug engine (``MXNET_ENGINE_TYPE=NaiveEngine``) the
+    contract is validated and misuse raises (``_check_grouped_layout``).
     """
     PH = PW = int(pooled_size)
     group = int(group_size)
@@ -423,6 +469,8 @@ def deformable_psroi_pooling(
     spp2 = spp * spp
     Rb = int(rois_per_image)
     grouped = Rb > 0 and R == B * Rb
+    if grouped:
+        _check_grouped_layout(batch_idx, B, Rb, "DeformablePSROIPooling")
     if R * K * PH * PW * spp2 * ch_per_class >= (1 << 16):
         # -- separable one-hot matmul path (TPU hot path) -----------------
         # Per bin (k, ph, pw): accumulate every (roi, sample)'s live-masked

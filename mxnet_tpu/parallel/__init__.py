@@ -25,6 +25,7 @@ from .mesh import (
     named_sharding,
     shard_params,
     local_mesh_devices,
+    zero_shard_spec,
 )
 from .collectives import allreduce, allgather, reduce_scatter, pmean, psum_scatter
 from . import dist
@@ -43,6 +44,7 @@ __all__ = [
     "named_sharding",
     "shard_params",
     "local_mesh_devices",
+    "zero_shard_spec",
     "allreduce",
     "allgather",
     "reduce_scatter",

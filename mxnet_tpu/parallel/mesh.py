@@ -152,3 +152,21 @@ def shard_params(params, mesh=None, rules=None):
     flat, tree = jax.tree_util.tree_flatten_with_path(params)
     out = [place(jax.tree_util.keystr(path), v) for path, v in flat]
     return jax.tree_util.tree_unflatten(tree, out)
+
+
+def zero_shard_spec(v, mesh, axis="dp"):
+    """ZeRO/FSDP partition rule for one array: split the first axis that the
+    ``axis`` mesh dimension divides, replicate otherwise (scalars, biases and
+    BN vectors are noise next to weight matrices).  The single source of
+    truth for optimizer-state sharding — used by
+    ``gluon.functional.make_train_step(shard_optimizer_states=True)`` and
+    the ``__graft_entry__`` ZeRO dryrun phase.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    n = mesh.shape[axis]
+    for ax in range(v.ndim):
+        if v.shape[ax] % n == 0 and v.shape[ax] >= n:
+            return NamedSharding(mesh, PartitionSpec(
+                *([None] * ax + [axis] + [None] * (v.ndim - ax - 1))))
+    return NamedSharding(mesh, PartitionSpec())

@@ -83,3 +83,61 @@ class TestGraftEntry:
         # driver's real dryrun_multichip(8) runs the full ResNet-101 trunk
         monkeypatch.setenv("MXNET_DRYRUN_TINY_DETECTION", "1")
         g.dryrun_multichip(4)
+
+    def test_train_step_zero_sharded(self):
+        """VERDICT r4 item 8: shard_optimizer_states=True partitions params
+        + momentum over the dp mesh axis, returns a jitted step with pinned
+        output shardings, and matches the unsharded step numerically."""
+        import jax
+        from mxnet_tpu import parallel
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rng = np.random.RandomState(1)
+        X = rng.randn(64, 8).astype(np.float32)
+        W = rng.randn(8, 4).astype(np.float32)
+        y = np.argmax(X @ W, axis=1).astype(np.float32)
+
+        n = len(jax.devices())
+        mesh = parallel.make_mesh({"dp": n})
+
+        mx.random.seed(7)
+        ref_net = _small_net()
+        ref_step, ref_state, _ = make_train_step(
+            ref_net, gluon.loss.SoftmaxCrossEntropyLoss(),
+            learning_rate=0.1, momentum=0.9)
+        ref_jstep = jax.jit(ref_step)
+
+        mx.random.seed(7)
+        net = _small_net()
+        step, state, _ = make_train_step(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), learning_rate=0.1,
+            momentum=0.9, mesh=mesh, shard_optimizer_states=True)
+
+        # the partition is real: at least the Dense weights split over dp
+        sharded = [v for v in state[0] + state[1]
+                   if not v.sharding.is_equivalent_to(
+                       NamedSharding(mesh, P()), v.ndim)]
+        assert sharded, "no state array was partitioned"
+        per_dev = sum(int(np.prod(v.sharding.shard_shape(v.shape)))
+                      * v.dtype.itemsize for v in state[0] + state[1])
+        full = sum(int(np.prod(v.shape)) * v.dtype.itemsize
+                   for v in state[0] + state[1])
+        assert per_dev < full * 0.6, (per_dev, full)
+
+        Xs = jax.device_put(X, NamedSharding(mesh, P("dp")))
+        ys = jax.device_put(y, NamedSharding(mesh, P("dp")))
+        key = jax.random.PRNGKey(0)
+        losses = []
+        for i in range(10):
+            k = jax.random.fold_in(key, i)
+            state, loss = step(state, Xs, ys, k)
+            ref_state, ref_loss = ref_jstep(ref_state, X, y, k)
+            np.testing.assert_allclose(float(loss), float(ref_loss),
+                                       rtol=2e-4, atol=2e-5)
+            losses.append(float(loss))
+        # shardings survive the step (out_shardings pinned, donation safe)
+        still = [v for v in state[0] + state[1]
+                 if not v.sharding.is_equivalent_to(
+                     NamedSharding(mesh, P()), v.ndim)]
+        assert len(still) == len(sharded)
+        assert losses[-1] < losses[0] * 0.8, losses
