@@ -73,8 +73,6 @@ def build_step(model, batch, image_shape):
             net, batch, compute_dtype="bfloat16" if on_tpu else None)
         data, im_info, gt = m.synthetic_voc(
             np.random.RandomState(0), batch, shape, classes, net.max_gts)
-    import jax
-
     sargs = (jax.device_put(data), jax.device_put(im_info),
              jax.device_put(gt))
     return step, state, sargs, shape
@@ -100,6 +98,13 @@ def parse_trace(tdir, iters):
     ops = lane("XLA Ops")
     if not mods:   # CPU backend — no device lanes; tool is chip-only
         raise SystemExit("no device lane in trace (run on the chip)")
+    # normalize EVERYTHING by the module executions actually captured —
+    # a dropped/extra launch in the profiler window would otherwise skew
+    # the leaf-sum-vs-wall identity the report certifies
+    if len(mods) != iters:
+        print("note: trace captured %d module executions (requested %d); "
+              "normalizing by %d" % (len(mods), iters, len(mods)))
+    iters = len(mods)
     wall_ms = sum(e["dur"] for e in mods) / len(mods) / 1e3
 
     # nesting by interval containment on the single ops lane: an event
@@ -177,6 +182,11 @@ def run_one(model, batch, image_shape, iters, keep_trace):
             state, loss, _ = comp(state, *sargs, k)
         float(loss)
     r = parse_trace(tdir, iters)
+    if not keep_trace:     # 6-step device traces run to hundreds of MB
+        import shutil
+
+        shutil.rmtree(tdir, ignore_errors=True)
+        tdir = None
     r.update(model=model, batch=batch, shape=shape, compile_s=compile_s,
              naive_gb=naive_gb, naive_tf=naive_tf, meas_ms=meas_ms,
              naive_hbm_ms=naive_gb * 1e9 / V5E_HBM_BPS * 1e3, trace=tdir)
@@ -201,6 +211,8 @@ def report(r):
           "roofline %.1f ms | wall = %.0f%% of serial roofline" %
           (r["hbm_ms"], r["mxu_ms"], r["serial_ms"],
            100.0 * r["wall_ms"] / r["serial_ms"]))
+    if r.get("trace"):
+        print("trace kept at: %s" % r["trace"])
     print("%-24s %8s %8s %9s %8s %9s" %
           ("category", "ms/step", "GB/step", "GB/s", "TF/step", "bound ms"))
     for k, (d, b, f) in sorted(r["cats"].items(), key=lambda kv: -kv[1][0]):

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["quantize_int8_pallas", "dequantize_int8_pallas", "supported",
-           "nms_alive_pallas"]
+           "nms_alive_pallas", "psroi_abuild_pallas"]
 
 _LANE = 128
 # minimum sublane count per dtype (pallas_guide.md tiling constraints)
@@ -289,3 +289,111 @@ def nms_alive_pallas(boxes, valid, ids, *, thresh, plus_one=1.0,
     f = _nms_single(float(thresh), float(plus_one), use_ids, bool(interpret))
     return f(jax.lax.stop_gradient(boxes.astype(jnp.float32)),
              valid, idv)
+
+
+# ---------------------------------------------------------------------------
+# Deformable-PSROI accumulation-matrix build (round-5 north-star kernel)
+# ---------------------------------------------------------------------------
+#
+# The pooling's separable one-hot path builds, per bin, a dense accumulation
+# matrix A[r, h, w] = sum_s yv[r, s, h] * xv[r, s, w] (rank-spp2 outer
+# product; ops/detection.py deformable_psroi_pooling).  XLA lowers that
+# einsum as a convolution whose K=spp2(=16) contraction pads to 128 lanes —
+# the round-5 batch-8 chip trace showed those kernels at ~48 GB/s, ~33
+# ms/step of a 227 ms step (15%), against a ~6 us/bin write-bound floor.
+# Here the contraction runs as one small MXU dot per roi with the block
+# resident in VMEM; measured ~10 us vs ~35-60 us for the einsum at
+# north-star shapes (B=8, Rb=128, spp2=16, 38x64 map).
+
+_ABUILD_RB = 64  # rois per grid step; 64 measured >> 32 (grid overhead)
+
+
+def _abuild_fwd_kernel_factory(rb, out_dtype):
+    def kern(y_ref, x_ref, o_ref):
+        for r in range(rb):
+            # (H, S) @ (S, W) with exact f32 accumulation: A feeds box
+            # scores, bf16 products shift pooled values ~5e-3 (measured;
+            # see the einsum's HIGHEST note in ops/detection.py)
+            o_ref[r] = jnp.dot(
+                y_ref[r].T, x_ref[r], precision=jax.lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32).astype(out_dtype)
+    return kern
+
+
+def _abuild_bwd_kernel_factory(rb):
+    def kern(y_ref, x_ref, g_ref, dy_ref, dx_ref):
+        for r in range(rb):
+            g = g_ref[r].astype(jnp.float32)
+            # d_yv[s, h] = sum_w g[h, w] xv[s, w];  d_xv[s, w] = yv @ g
+            dy_ref[r] = jnp.dot(
+                x_ref[r], g.T, precision=jax.lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32)
+            dx_ref[r] = jnp.dot(
+                y_ref[r], g, precision=jax.lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32)
+    return kern
+
+
+def _abuild_pad(a, n_pad):
+    return a if n_pad == a.shape[0] else jnp.pad(
+        a, ((0, n_pad - a.shape[0]),) + ((0, 0),) * (a.ndim - 1))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def psroi_abuild_pallas(yv, xv, out_dtype, interpret=False):
+    """A[n, h, w] = sum_s yv[n, s, h] * xv[n, s, w] on the MXU via Pallas.
+
+    yv: (N, S, H) f32, xv: (N, S, W) f32 -> (N, H, W) ``out_dtype``; exact
+    f32 accumulation (== the einsum-HIGHEST formulation), differentiable via
+    custom VJP (both directions are the same per-roi small-dot pattern).
+    """
+    return _abuild_impl(yv, xv, out_dtype, interpret)
+
+
+def _abuild_impl(yv, xv, out_dtype, interpret):
+    from jax.experimental import pallas as pl
+
+    N, S, H = yv.shape
+    W = xv.shape[2]
+    rb = min(_ABUILD_RB, N)
+    n_pad = -(-N // rb) * rb
+    out = pl.pallas_call(
+        _abuild_fwd_kernel_factory(rb, out_dtype),
+        out_shape=jax.ShapeDtypeStruct((n_pad, H, W), out_dtype),
+        grid=(n_pad // rb,),
+        in_specs=[pl.BlockSpec((rb, S, H), lambda i: (i, 0, 0)),
+                  pl.BlockSpec((rb, S, W), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((rb, H, W), lambda i: (i, 0, 0)),
+        interpret=interpret,
+    )(_abuild_pad(yv, n_pad), _abuild_pad(xv, n_pad))
+    return out[:N]
+
+
+def _abuild_fwd(yv, xv, out_dtype, interpret):
+    return _abuild_impl(yv, xv, out_dtype, interpret), (yv, xv)
+
+
+def _abuild_bwd(out_dtype, interpret, res, g):
+    from jax.experimental import pallas as pl
+
+    yv, xv = res
+    N, S, H = yv.shape
+    W = xv.shape[2]
+    rb = min(_ABUILD_RB, N)
+    n_pad = -(-N // rb) * rb
+    dy, dx = pl.pallas_call(
+        _abuild_bwd_kernel_factory(rb),
+        out_shape=(jax.ShapeDtypeStruct((n_pad, S, H), jnp.float32),
+                   jax.ShapeDtypeStruct((n_pad, S, W), jnp.float32)),
+        grid=(n_pad // rb,),
+        in_specs=[pl.BlockSpec((rb, S, H), lambda i: (i, 0, 0)),
+                  pl.BlockSpec((rb, S, W), lambda i: (i, 0, 0)),
+                  pl.BlockSpec((rb, H, W), lambda i: (i, 0, 0))],
+        out_specs=(pl.BlockSpec((rb, S, H), lambda i: (i, 0, 0)),
+                   pl.BlockSpec((rb, S, W), lambda i: (i, 0, 0))),
+        interpret=interpret,
+    )(_abuild_pad(yv, n_pad), _abuild_pad(xv, n_pad), _abuild_pad(g, n_pad))
+    return dy[:N], dx[:N]
+
+
+psroi_abuild_pallas.defvjp(_abuild_fwd, _abuild_bwd)

@@ -699,3 +699,34 @@ def test_grouped_roi_hint_misuse_raises_in_debug_mode():
                 rois_per_image=1)
     finally:
         engine.naive_engine(False)
+
+
+def test_psroi_abuild_pallas_matches_einsum():
+    """Round-5 A-build kernel: the Pallas MXU formulation must equal the
+    einsum-HIGHEST formulation (values and grads) — interpret mode here;
+    the chip consistency tier covers the compiled kernel."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas_kernels import psroi_abuild_pallas
+
+    rng = np.random.RandomState(3)
+    N, S, H, W = 70, 16, 13, 21   # N deliberately not a block multiple
+    yv = jnp.asarray(rng.rand(N, S, H).astype(np.float32))
+    xv = jnp.asarray(rng.rand(N, S, W).astype(np.float32))
+
+    ref = jnp.einsum("nsh,nsw->nhw", yv, xv,
+                     precision=jax.lax.Precision.HIGHEST)
+    out = psroi_abuild_pallas(yv, xv, jnp.float32, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+
+    g = jnp.asarray(rng.rand(N, H, W).astype(np.float32))
+    f_ref = lambda y, x: jnp.sum(jnp.einsum(
+        "nsh,nsw->nhw", y, x, precision=jax.lax.Precision.HIGHEST) * g)
+    f_pal = lambda y, x: jnp.sum(psroi_abuild_pallas(y, x, jnp.float32, True) * g)
+    gy_r, gx_r = jax.grad(f_ref, argnums=(0, 1))(yv, xv)
+    gy_p, gx_p = jax.grad(f_pal, argnums=(0, 1))(yv, xv)
+    np.testing.assert_allclose(np.asarray(gy_p), np.asarray(gy_r),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gx_p), np.asarray(gx_r),
+                               rtol=1e-5, atol=1e-6)
