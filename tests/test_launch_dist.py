@@ -287,27 +287,33 @@ WORKER_RECOVERY = textwrap.dedent("""
 """)
 
 
-@pytest.mark.skipif(sys.platform != "linux", reason="local fake cluster uses fork/Gloo")
-def test_dist_recovery_checkpoint_relaunch(tmp_path):
-    """VERDICT round-3 item 8: the documented recovery story executed by CI.
+def _run_crash_recovery_story(tmp_path, worker_src, marker, crash_step,
+                              ckpt_committed, timeout=420):
+    """Shared control/crash/resume harness (reference is_recovery semantics,
+    kvstore_dist.h:52-55, realized as checkpoint+relaunch).
 
-    A 2-rank seeded training job checkpoints every step; rank 1 is killed
-    mid-run and the survivor fails fast (DeadNodeError naming rank 1,
-    matching the reference's dead-node heartbeat, kvstore_dist.h:110-118);
-    the job is then RELAUNCHED from the checkpoint and must produce final
-    parameters identical to an uninterrupted control run — state continuity,
-    the reference's is_recovery semantics (kvstore_dist.h:52-55) realized as
-    checkpoint+relaunch."""
+    Launches ``worker_src`` three times via the local fake cluster: an
+    uninterrupted control run, a run where rank 1 dies at ``crash_step``
+    (the survivor must fail fast NAMING it — dead-node heartbeat,
+    kvstore_dist.h:110-118 — and a durable checkpoint must exist, checked
+    by ``ckpt_committed(prefix)``), and a relaunch that must finish with
+    output identical to the control.  Workers read RECOVERY_MODE /
+    RECOVERY_CKPT and print ``RANK<r><marker> <digest>`` on success,
+    ``RANK<r>_DIED_AT <t> missing=[...]`` on fail-fast."""
     env_base = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     worker = tmp_path / "worker_recovery.py"
-    worker.write_text(WORKER_RECOVERY)
+    worker.write_text(worker_src)
 
-    def launch(mode, ckpt, timeout=420):
+    def launch(mode, ckpt):
         env = dict(env_base, RECOVERY_MODE=mode, RECOVERY_CKPT=str(ckpt))
         return subprocess.run(
             [sys.executable, LAUNCH, "-n", "2", "--launcher", "local",
              sys.executable, str(worker)],
             env=env, capture_output=True, text=True, timeout=timeout)
+
+    def finals(res):
+        return sorted(l.split(marker + " ")[1]
+                      for l in res.stdout.splitlines() if marker in l)
 
     # control: uninterrupted run
     for attempt in range(3):
@@ -315,18 +321,22 @@ def test_dist_recovery_checkpoint_relaunch(tmp_path):
         if res.returncode == 0:
             break
     assert res.returncode == 0, res.stdout + res.stderr
-    control = sorted(l.split("_FINAL ")[1]
-                     for l in res.stdout.splitlines() if "_FINAL" in l)
+    control = finals(res)
     assert len(control) == 2 and control[0] == control[1], res.stdout
 
-    # crash: rank 1 dies at step 5; rank 0 must fail fast naming it
+    # crash: rank 1 dies at crash_step; rank 0 must fail fast naming it.
+    # Retry on ANY other outcome — a saturated host can time a barrier out
+    # spuriously at an earlier step (the Gloo flake the retries exist for),
+    # which must not escape the loop and fail the wrong assert
+    want = "_DIED_AT %d missing=[1]" % crash_step
     for attempt in range(3):
         crash = launch("crash", tmp_path / "job")
         died = [l for l in crash.stdout.splitlines() if "_DIED_AT" in l]
-        if died:
+        if (died and all(want in l for l in died)
+                and ckpt_committed(tmp_path / "job")):
             break
-    assert died and "missing=[1]" in died[0], crash.stdout + crash.stderr
-    assert (tmp_path / "job.step").read_text() == "5", "checkpoint at crash"
+    assert died and all(want in l for l in died), crash.stdout + crash.stderr
+    assert ckpt_committed(tmp_path / "job"), "no durable checkpoint at crash"
 
     # resume: relaunch from the checkpoint; must match the control exactly
     for attempt in range(3):
@@ -334,10 +344,23 @@ def test_dist_recovery_checkpoint_relaunch(tmp_path):
         if res2.returncode == 0:
             break
     assert res2.returncode == 0, res2.stdout + res2.stderr
-    resumed = sorted(l.split("_FINAL ")[1]
-                     for l in res2.stdout.splitlines() if "_FINAL" in l)
+    resumed = finals(res2)
     assert len(resumed) == 2, res2.stdout
     assert resumed == control, (resumed, control)
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="local fake cluster uses fork/Gloo")
+def test_dist_recovery_checkpoint_relaunch(tmp_path):
+    """VERDICT round-3 item 8: the documented recovery story executed by CI.
+
+    A 2-rank seeded training job checkpoints every step; rank 1 is killed
+    mid-run and the survivor fails fast (DeadNodeError naming rank 1);
+    the job is then RELAUNCHED from the checkpoint and must produce final
+    parameters identical to an uninterrupted control run."""
+    _run_crash_recovery_story(
+        tmp_path, WORKER_RECOVERY, "_FINAL", crash_step=5,
+        ckpt_committed=lambda p: p.with_suffix(".step").exists()
+        and p.with_suffix(".step").read_text() == "5")
 
 
 @pytest.mark.skipif(sys.platform != "linux", reason="local fake cluster uses fork/Gloo")
@@ -363,3 +386,112 @@ def test_dist_sync_kvstore_nightly_seven_processes(tmp_path):
     # trainer left identical parameters on every rank
     vals = {l.split("_NIGHTLY ")[1] for l in lines}
     assert len(vals) == 1, vals
+
+
+WORKER_POD_DETECTION = textwrap.dedent("""
+    import os, sys
+    # 4 virtual CPU devices per process -> a 2-process x 4-device global
+    # mesh, the closest this host gets to a multi-host TPU pod slice
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=4")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel
+    from mxnet_tpu.parallel import dist
+    from mxnet_tpu.test_utils import load_module_by_path
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(mx.__file__)))
+    CKPT = os.environ["RECOVERY_CKPT"] + ".ckpts"
+    MODE = os.environ["RECOVERY_MODE"]       # control | crash | resume
+    TOTAL = 6
+    CRASH_AT = 3
+
+    dist.init()
+    import jax
+    r, n = dist.rank(), dist.size()
+    assert n == 2 and len(jax.devices()) == 8, (n, jax.devices())
+
+    m = load_module_by_path(os.path.join(
+        REPO, "examples", "deformable_rfcn", "train_fused.py"), "_pod_rfcn")
+    mx.random.seed(5)                         # identical init on every rank
+    net, shape, classes = m.build_net(False)  # tiny trunk, same graph
+    B = 8
+    step, state = m.make_rfcn_train_step(net, B, learning_rate=1e-3,
+                                         momentum=0.9)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = parallel.make_mesh({"dp": 8})      # spans both processes
+    repl = NamedSharding(mesh, P())
+    bsh = NamedSharding(mesh, P("dp"))
+
+    def globalize(a, sh):
+        a = np.asarray(a)
+        return jax.make_array_from_callback(a.shape, sh, lambda i: a[i])
+
+    state = jax.tree_util.tree_map(lambda v: globalize(v, repl), state)
+
+    # the PRODUCT recovery path: parallel.checkpoint.CheckpointManager
+    # (orbax, step-indexed, atomic commit, every rank participates) — the
+    # subsystem docs/ENV_VARS.md names for checkpoint+relaunch recovery
+    from mxnet_tpu.parallel import checkpoint as ckpt_mod
+    mgr = ckpt_mod.CheckpointManager(CKPT, max_to_keep=3)
+    start = 0
+    if MODE == "resume":
+        start = mgr.latest_step()
+        assert start is not None, "resume with no checkpoint"
+        state = mgr.restore(step=start, like=state)
+
+    jstep = jax.jit(step, donate_argnums=(0,))
+
+    for t in range(start, TOTAL):
+        if MODE == "crash" and r == 1 and t == CRASH_AT:
+            os._exit(1)                       # rank dies mid-training
+        try:
+            dist.barrier("pod_step%d" % t, timeout_ms=12000)
+        except dist.DeadNodeError as e:
+            print("RANK%d_DIED_AT %d missing=%s" % (r, t, e.missing_ranks),
+                  flush=True)
+            import time; time.sleep(2)
+            os._exit(3)
+        # deterministic per-step global batch; every rank builds the same
+        # numpy batch, make_array_from_callback shards it over dp
+        rng = np.random.RandomState(1000 + t)
+        data, info, gt = m.synthetic_coco(rng, B, shape, classes, net.max_gts)
+        state, loss, _parts = jstep(state, globalize(data, bsh),
+                                    globalize(info, bsh), globalize(gt, bsh),
+                                    jax.random.PRNGKey(t))
+        l = float(loss)                       # replicated scalar
+        assert np.isfinite(l), l
+        mgr.save(t + 1, state, force=True)    # collective (all ranks)
+        mgr.wait_until_finished()             # durable before the next step
+    flat, _ = jax.tree_util.tree_flatten(state)
+    digest = float(sum(
+        np.abs(np.asarray(v.addressable_shards[0].data).astype(np.float64)).sum()
+        for v in flat))
+    dist.barrier("pod_done", timeout_ms=60000)
+    print("RANK%d_POD %.6f loss %.6f" % (r, digest, l), flush=True)
+    dist.shutdown()
+""")
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="local fake cluster uses fork/Gloo")
+def test_pod_story_one_program_fused_detection(tmp_path):
+    """VERDICT round-4 item 2: the pod story as ONE program.
+
+    ``tools/launch.py -n 2`` spawns two REAL processes, each with 4 virtual
+    CPU devices; ``jax.distributed`` joins them into one 8-device dp mesh
+    (≡ launcher + tracker roles, SURVEY §3.5) and the FUSED Deformable
+    R-FCN train step (reduced trunk, full graph: trunk + RPN +
+    MultiProposal + deformable PS-ROI heads + 4 losses + momentum SGD)
+    runs across the process boundary with GSPMD-inserted gradient
+    collectives over Gloo.  Mid-run, rank 1 is killed: the survivor fails
+    fast naming it (dead-node check, kvstore_dist.h:110-118), and the job
+    RELAUNCHES from the last durable checkpoint, finishing with parameters
+    identical to an uninterrupted control run (is_recovery ≡
+    checkpoint+relaunch, kvstore_dist.h:52-55), through the product
+    ``parallel.checkpoint.CheckpointManager`` (orbax, atomic commit)."""
+    _run_crash_recovery_story(
+        tmp_path, WORKER_POD_DETECTION, "_POD", crash_step=3,
+        ckpt_committed=lambda p: (p.parent / (p.name + ".ckpts") / "3").exists(),
+        timeout=900)
