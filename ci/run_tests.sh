@@ -28,6 +28,7 @@ NIGHTLY_FILES=(
   tests/test_examples_round3.py
   tests/test_examples_round3b.py
   tests/test_examples_round4.py
+  tests/test_examples_round5.py
   tests/test_tutorials.py
   tests/test_quality_map.py
   tests/test_quality_map_frcnn.py
@@ -78,9 +79,12 @@ case "$tier" in
     # → 0.6802/0.9034/0.9214 — floor 0.54 = worst − ~20% (QUALITY.md §3)
     python examples/quality/eval_ssd_map.py --full --steps 2000 \
       --map-floor 0.54
-    # SSD-512 at the 24564-anchor menu: single-seed 0.8868, floor 0.60
+    # SSD-512 at the 24564-anchor menu (round-5 calibration): seeds 0/1/2
+    # → 0.8868/0.3357/0.4145 — wide from-scratch variance at 512², like
+    # SSD-300's 0.68-0.92; floor 0.26 = worst − ~20% (QUALITY.md §3).  The
+    # gate's target failure (broken MultiBox assignment) scores ~0.001
     python examples/quality/eval_ssd_map.py --full --size 512 --steps 2000 \
-      --map-floor 0.60
+      --map-floor 0.26
     ;;
   all)
     "$SELF" unit

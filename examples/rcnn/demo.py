@@ -1,0 +1,222 @@
+"""Checkpoint → detections: the deployment inference entry for the
+detection family (reference ``example/rcnn/demo.py`` + ``test.py``: load a
+trained checkpoint, build the TEST symbol, forward, decode + NMS, emit
+boxes).
+
+The journey, wired through the deployment surface (``mxnet_tpu.predictor``,
+the reference's ``c_predict_api`` equivalent):
+
+1. a trained parameter file (``--params``, from ``--save-params`` on this
+   script's ``--quick-train`` path or any training entry that calls
+   ``net.save_parameters``) loads into the INFERENCE TWIN — the same net
+   built at the reference TEST proposal config (6000→300,
+   ``rcnn/config.py:95-96``); parameter names/shapes are proposal-count
+   independent, so trained values drop in;
+2. the twin is hybridized and ``export``-ed to the deployment pair
+   (``*-symbol.json`` + ``*-0000.params``, the reference checkpoint
+   format);
+3. ``predictor.create`` loads that pair — symbol JSON in, one fused XLA
+   inference module out — and runs ``set_input → forward → get_output``
+   (≡ MXPredSetInput/MXPredForward/MXPredGetOutput);
+4. raw (rois, cls_prob, bbox_pred) decode to boxes: inverse bbox transform
+   (class-agnostic for R-FCN; class-specific × BBOX_STDS for Faster-RCNN,
+   reference ``rcnn/core/tester.py``) + per-class NMS.
+
+Usage:
+  # one command, checkpoint → detections (tiny CPU nets, CI smoke):
+  python examples/rcnn/demo.py --model rfcn  --quick-train 40
+  python examples/rcnn/demo.py --model frcnn --quick-train 40
+
+  # deployment on an existing checkpoint + your image:
+  python examples/rcnn/demo.py --model frcnn --vgg16 \
+      --params run.params --image image.npy --out dets.npy
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_HERE, "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import load_module_by_path
+
+
+def _modules(model):
+    if model == "rfcn":
+        train = load_module_by_path(
+            os.path.join(_HERE, "..", "deformable_rfcn", "train_fused.py"),
+            "_demo_rfcn_train")
+        ev = load_module_by_path(
+            os.path.join(_HERE, "..", "quality", "eval_rfcn_map.py"),
+            "_demo_rfcn_eval")
+        return train, ev
+    train = load_module_by_path(
+        os.path.join(_HERE, "train_fused.py"), "_demo_frcnn_train")
+    ev = load_module_by_path(
+        os.path.join(_HERE, "..", "quality", "eval_frcnn_map.py"),
+        "_demo_frcnn_eval")
+    return train, ev
+
+
+def _build(model, train_mod, full, test_cfg):
+    """Build the net; ``test_cfg`` selects the inference proposal counts
+    (Faster-RCNN trains at 12000→2000 and infers at the reference TEST
+    config 6000→300; R-FCN's counts are already the test config)."""
+    if model == "rfcn":
+        return train_mod.build_net(full)
+    return train_mod.build_net(
+        full, rpn_pre_nms=6000 if (test_cfg and full) else None,
+        rpn_post_nms=300 if (test_cfg and full) else None)
+
+
+def quick_train(model, train_mod, full, steps, params_out, seed=0):
+    """A short synthetic training run producing a demo checkpoint (the
+    reference demo downloads a released ``final-0000.params``; with zero
+    egress the demo trains its own in-process)."""
+    import jax
+
+    mx.random.seed(seed)
+    rng = np.random.RandomState(seed)
+    net, shape, classes = _build(model, train_mod, full, test_cfg=False)
+    if model == "rfcn":
+        step, state = train_mod.make_rfcn_train_step(net, 1, learning_rate=2e-3)
+        synth = train_mod.synthetic_coco
+    else:
+        step, state = train_mod.make_frcnn_train_step(net, 1, learning_rate=2e-3)
+        synth = train_mod.synthetic_voc
+    jstep = jax.jit(step, donate_argnums=(0,))
+    key = jax.random.PRNGKey(seed)
+    for s in range(steps):
+        data, im_info, gt = synth(rng, 1, shape, classes, net.max_gts)
+        state, loss, _ = jstep(state, data, im_info, gt,
+                               jax.random.fold_in(key, s))
+        if s % max(1, steps // 4) == 0:
+            print("quick-train step %3d  loss %.4f" % (s, float(loss)),
+                  flush=True)
+    # write the trained functional state back into the Block and save the
+    # standard gluon checkpoint (net.save_parameters — SURVEY §5.4)
+    from mxnet_tpu.gluon.functional import functionalize, merge_params
+
+    _, names, _, aux_names = functionalize(net)
+    merged = merge_params(names, aux_names, state[0], state[2])
+    params = dict(net.collect_params().items())
+    for name, val in zip(names, merged):
+        params[name].set_data(nd.NDArray(val))
+    net.save_parameters(params_out)
+    print("checkpoint saved: %s" % params_out, flush=True)
+    return shape, classes
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", choices=("rfcn", "frcnn"), default="rfcn")
+    p.add_argument("--vgg16", action="store_true",
+                   help="full VGG16 Faster-RCNN (chip scale)")
+    p.add_argument("--resnet101", action="store_true",
+                   help="full ResNet-101 R-FCN (chip scale)")
+    p.add_argument("--params", default=None,
+                   help="trained .params checkpoint (net.save_parameters "
+                        "format); required unless --quick-train")
+    p.add_argument("--quick-train", type=int, default=0, metavar="STEPS",
+                   help="train a throwaway synthetic checkpoint first")
+    p.add_argument("--image", default=None,
+                   help=".npy image, (H,W,3) or (3,H,W) float; default: one "
+                        "synthetic scene (objects guaranteed)")
+    p.add_argument("--out", default=None, help="save detections as .npy")
+    p.add_argument("--score-thresh", type=float, default=0.3)
+    p.add_argument("--nms-thresh", type=float, default=0.3)
+    p.add_argument("--export-prefix", default=None,
+                   help="where to write the deployment pair (default: "
+                        "alongside --params)")
+    args = p.parse_args()
+
+    full = args.vgg16 or args.resnet101
+    train_mod, eval_mod = _modules(args.model)
+
+    params_path = args.params
+    if args.quick_train:
+        params_path = params_path or os.path.join(
+            os.getcwd(), "demo_%s.params" % args.model)
+        quick_train(args.model, train_mod, full, args.quick_train, params_path)
+    elif not params_path:
+        p.error("--params is required (or use --quick-train N)")
+
+    # ---- the inference twin at the TEST proposal config -----------------
+    net, shape, classes = _build(args.model, train_mod, full, test_cfg=True)
+    net.load_parameters(params_path)
+
+    # ---- input image ----------------------------------------------------
+    if args.image:
+        img = np.load(args.image).astype(np.float32)
+        if img.ndim != 3:
+            raise SystemExit("--image must be (H,W,3) or (3,H,W), got %s"
+                             % (img.shape,))
+        if img.shape[-1] == 3:
+            img = img.transpose(2, 0, 1)
+        if img.shape[1:] != tuple(shape):
+            raise SystemExit("image is %s, net expects %s — resize first "
+                             "(mx.image.imresize)" % (img.shape[1:], shape))
+        data = img[None]
+        im_info = np.array([[shape[0], shape[1], 1.0]], np.float32)
+    else:
+        rng = np.random.RandomState(99)
+        if args.model == "rfcn":
+            data, im_info, gt = train_mod.synthetic_coco(
+                rng, 1, shape, classes, net.max_gts)
+        else:
+            data, im_info, gt = train_mod.synthetic_voc(
+                rng, 1, shape, classes, net.max_gts)
+        print("synthetic scene with %d gt boxes"
+              % int((gt[0, :, 0] >= 0).sum()), flush=True)
+
+    # ---- export the deployment pair and load it through the predictor ---
+    prefix = args.export_prefix or os.path.splitext(params_path)[0] + "-deploy"
+    net.hybridize()
+    net(nd.array(data), nd.array(im_info))   # build the cached graph
+    net.export(prefix)
+    print("deployment pair: %s-symbol.json + %s-0000.params"
+          % (prefix, prefix), flush=True)
+
+    from mxnet_tpu import predictor
+
+    # exported graph inputs are data0 (image), data1 (im_info) — the gluon
+    # export convention for multi-input blocks
+    pred = predictor.create(
+        prefix + "-symbol.json", prefix + "-0000.params",
+        {"data0": data.shape, "data1": im_info.shape})
+    pred.set_input("data0", data)
+    pred.set_input("data1", im_info)
+    pred.forward()
+    rois = np.asarray(pred.get_output(0), np.float32)
+    cls_prob = np.asarray(pred.get_output(1), np.float32)
+    bbox_pred = np.asarray(pred.get_output(2), np.float32)
+
+    # ---- decode + NMS → boxes ------------------------------------------
+    if args.model == "rfcn":
+        dets = eval_mod.decode_detections(
+            rois, cls_prob, bbox_pred, classes, shape,
+            score_thresh=args.score_thresh, nms_thresh=args.nms_thresh)
+    else:
+        dets = eval_mod.decode_detections(
+            rois, cls_prob, bbox_pred, classes, shape,
+            box_stds=net.box_stds,
+            score_thresh=args.score_thresh, nms_thresh=args.nms_thresh)
+    dets = dets[0]
+    dets = dets[dets[:, 0] >= 0]
+    print("%d detection(s)  [class score x1 y1 x2 y2]:" % len(dets))
+    for d in dets:
+        print("  %3d  %.3f  %7.1f %7.1f %7.1f %7.1f"
+              % (int(d[0]), d[1], d[2], d[3], d[4], d[5]))
+    if args.out:
+        np.save(args.out, dets)
+        print("saved: %s" % args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -24,6 +24,26 @@ from .parameter import Parameter, ParameterDict, DeferredInitializationError
 
 __all__ = ["Block", "HybridBlock", "SymbolBlock"]
 
+# one id per top-level symbolic graph capture (_get_graph); lets a block
+# invoked several times WITHIN one capture (weight sharing — siamese
+# towers) get a distinct per-call name-prefix ordinal, while staying
+# deterministic across captures
+_SYM_CAPTURE = threading.local()
+_SYM_CAPTURE_COUNTER = [0]
+
+
+def _sym_call_prefix(block):
+    """Name prefix for one symbolic invocation of ``block`` (see above)."""
+    cid = getattr(_SYM_CAPTURE, "id", None)
+    if cid is None:
+        return block.prefix  # direct user symbolic call: plain prefix
+    if getattr(block, "_sym_call_cap", None) == cid:
+        block._sym_call_n += 1
+        return "%scall%d_" % (block.prefix, block._sym_call_n)
+    block._sym_call_cap = cid
+    block._sym_call_n = 0
+    return block.prefix
+
 
 class _BlockScope:
     """Name manager for nested blocks (reference block.py:34 _BlockScope)."""
@@ -370,6 +390,7 @@ class HybridBlock(Block):
     def _clear_cached_op(self):
         self._cached_graph = ()
         self._jit_cache = {}
+        self._sym_trace_failed = False
 
     # -- symbolic graph for shape inference / export ------------------------
     def _get_graph(self, *args):
@@ -377,26 +398,36 @@ class HybridBlock(Block):
             from .. import symbol as sym_mod
 
             flat_args, self._in_format = _flatten(args)
-            inputs = [sym_mod.var("data%d" % i) for i in range(len(flat_args))]
+            # single input exports as "data" (the reference gluon export
+            # convention deployment tooling expects); multi-input as dataN
+            inputs = ([sym_mod.var("data")] if len(flat_args) == 1 else
+                      [sym_mod.var("data%d" % i) for i in range(len(flat_args))])
             grouped, _ = _regroup(inputs, self._in_format)
             if not isinstance(grouped, tuple):
                 grouped = (grouped,)
-            with _name_prefix_scope(self.prefix):
+            _SYM_CAPTURE_COUNTER[0] += 1
+            _SYM_CAPTURE.id = _SYM_CAPTURE_COUNTER[0]
+            try:
                 out = self._symbolic_forward(sym_mod, *grouped)
+            finally:
+                _SYM_CAPTURE.id = None
             flat_out, self._out_format = _flatten(out)
             self._cached_graph = inputs, sym_mod.Group(flat_out) if len(flat_out) > 1 else flat_out[0]
         return self._cached_graph
 
     def _symbolic_forward(self, sym_mod, *args):
+        from ..base import Prefix
+
         params = {name: p.var() for name, p in self._reg_params.items()}
-        return self.hybrid_forward(sym_mod, *args, **params)
+        with Prefix(_sym_call_prefix(self)):  # see forward()'s symbol branch
+            return self.hybrid_forward(sym_mod, *args, **params)
 
     def infer_shape(self, *args):
         """Resolve deferred parameter shapes from input shapes (reference
         block.py _deferred_infer_shape → infer_shape)."""
         inputs, out = self._get_graph(*args)
         flat_args, _ = _flatten(args)
-        kwargs = {"data%d" % i: a.shape for i, a in enumerate(flat_args)}
+        kwargs = {v.name: a.shape for v, a in zip(inputs, flat_args)}
         arg_shapes, _, aux_shapes = out.infer_shape(**kwargs)
         sdict = dict(zip(out.list_arguments(), arg_shapes))
         sdict.update(dict(zip(out.list_auxiliary_states(), aux_shapes)))
@@ -425,9 +456,20 @@ class HybridBlock(Block):
 
         if isinstance(x, Symbol):
             from .. import symbol as sym_mod
+            from ..base import Prefix
 
             params = {name: p.var() for name, p in self._reg_params.items()}
-            return self.hybrid_forward(sym_mod, x, *args, **params)
+            # scope op-node names by the block's (absolute) prefix: layers
+            # that name their op explicitly (BatchNorm's name="fwd") would
+            # otherwise collide across instances, and the serializer walks
+            # dedupe by name — a traced graph with two BN layers silently
+            # dropped everything between them (reference gluon gets this
+            # from _BlockScope's NameManager, python/mxnet/name.py).  A
+            # weight-shared block invoked twice in one capture gets a
+            # per-call ordinal (_sym_call_prefix) so auto names stay
+            # unique too.
+            with Prefix(_sym_call_prefix(self)):
+                return self.hybrid_forward(sym_mod, x, *args, **params)
         from .. import ndarray as nd_mod
 
         try:
@@ -475,6 +517,18 @@ class HybridBlock(Block):
         )
         entry = self._jit_cache.get(sig)
         if entry is None:
+            if (not self._cached_graph and not train
+                    and not getattr(self, "_sym_trace_failed", False)):
+                # opportunistically capture the symbolic graph so the
+                # reference journey hybridize() -> forward -> export()
+                # works; some bodies (train-mode target ops, concrete
+                # .shape use) can't trace symbolically — remember the
+                # failure so multi-scale eval doesn't re-trace per shape
+                try:
+                    grouped, _ = _regroup(flat_args, in_fmt)
+                    self._get_graph(*grouped)
+                except Exception:
+                    self._sym_trace_failed = True
             entry = self._build_cached_op(flat_args, in_fmt, params, train)
             self._jit_cache[sig] = entry
         jit_fn, out_fmt_box, mutable = entry
@@ -558,19 +612,6 @@ def _swap_trace_call(params, param_vals, call, key, train):
         _TRACING.active = prev_tracing
         for p, old in swapped:
             p._data = old
-
-
-class _name_prefix_scope:
-    """Best-effort name scoping for symbolic graph capture."""
-
-    def __init__(self, prefix):
-        self.prefix = prefix
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *a):
-        pass
 
 
 class SymbolBlock(HybridBlock):

@@ -264,12 +264,15 @@ class DeformableRFCN(HybridBlock):
         t = self.rpn_conv(c4)
         return self.rpn_cls(t), self.rpn_bbox(t)
 
-    def _proposals(self, F, rpn_cls, rpn_bbox, im_info, batch):
+    def _proposals(self, F, rpn_cls, rpn_bbox, im_info):
         A = self.num_anchors
         Hf, Wf = self.feat_shape
-        score = F.Reshape(rpn_cls, shape=(batch, 2, A * Hf, Wf))
+        # (B,2A,Hf,Wf) -> (B,2,A*Hf,Wf) via reshape specials (0=keep,
+        # -1=infer): batch-size-free, so the inference graph also traces
+        # symbolically (hybridize/export -> Predictor deployment path)
+        score = F.Reshape(rpn_cls, shape=(0, 2, -1, 0))
         prob = F.softmax(score, axis=1)
-        prob = F.Reshape(prob, shape=(batch, 2 * A, Hf, Wf))
+        prob = F.Reshape(prob, shape=(0, 2 * A, Hf, Wf))
         rois = F.contrib.MultiProposal(
             prob, rpn_bbox, im_info,
             rpn_pre_nms_top_n=self.rpn_pre_nms,
@@ -314,15 +317,15 @@ class DeformableRFCN(HybridBlock):
 
     def hybrid_forward(self, F, data, im_info, gt_boxes=None, nz_rpn=None,
                        nz_prop=None):
-        batch = data.shape[0]
         c4, c5 = self._features(F, data)
         rpn_cls, rpn_bbox = self._rpn(F, c4)
-        rois = self._proposals(F, rpn_cls, rpn_bbox, im_info, batch)
+        rois = self._proposals(F, rpn_cls, rpn_bbox, im_info)
         if gt_boxes is None:  # inference
             cls_score, bbox_pred = self._head(F, c5, rois,
                                               rois_per_image=self.rpn_post_nms)
             return rois, F.softmax(cls_score, axis=-1), bbox_pred
 
+        batch = data.shape[0]  # train path runs eager/jit-traced (nd), not symbolic
         Hf, Wf = self.feat_shape
         rpn_label, rpn_bt, rpn_bw = F.contrib.rpn_anchor_target(
             gt_boxes, im_info, nz_rpn,
@@ -466,12 +469,15 @@ class FasterRCNN(HybridBlock):
                               pool_type="max")
         return x
 
-    def _proposals(self, F, rpn_cls, rpn_bbox, im_info, batch):
+    def _proposals(self, F, rpn_cls, rpn_bbox, im_info):
         A = self.num_anchors
         Hf, Wf = self.feat_shape
-        score = F.Reshape(rpn_cls, shape=(batch, 2, A * Hf, Wf))
+        # (B,2A,Hf,Wf) -> (B,2,A*Hf,Wf) via reshape specials (0=keep,
+        # -1=infer): batch-size-free, so the inference graph also traces
+        # symbolically (hybridize/export -> Predictor deployment path)
+        score = F.Reshape(rpn_cls, shape=(0, 2, -1, 0))
         prob = F.softmax(score, axis=1)
-        prob = F.Reshape(prob, shape=(batch, 2 * A, Hf, Wf))
+        prob = F.Reshape(prob, shape=(0, 2 * A, Hf, Wf))
         rois = F.contrib.MultiProposal(
             prob, rpn_bbox, im_info,
             rpn_pre_nms_top_n=self.rpn_pre_nms,
@@ -498,16 +504,16 @@ class FasterRCNN(HybridBlock):
 
     def hybrid_forward(self, F, data, im_info, gt_boxes=None, nz_rpn=None,
                        nz_prop=None):
-        batch = data.shape[0]
         c5 = self._features(F, data)
         t = self.rpn_conv(c5)
         rpn_cls, rpn_bbox = self.rpn_cls(t), self.rpn_bbox(t)
-        rois = self._proposals(F, rpn_cls, rpn_bbox, im_info, batch)
+        rois = self._proposals(F, rpn_cls, rpn_bbox, im_info)
         if gt_boxes is None:  # inference
             cls_score, bbox_pred = self._head(F, c5, rois,
                                               rois_per_image=self.rpn_post_nms)
             return rois, F.softmax(cls_score, axis=-1), bbox_pred
 
+        batch = data.shape[0]  # train path runs eager/jit-traced (nd), not symbolic
         Hf, Wf = self.feat_shape
         rpn_label, rpn_bt, rpn_bw = F.contrib.rpn_anchor_target(
             gt_boxes, im_info, nz_rpn,
