@@ -136,6 +136,10 @@ def main():
                         "alongside --params)")
     args = p.parse_args()
 
+    if args.vgg16 and args.model != "frcnn":
+        p.error("--vgg16 is the Faster-RCNN trunk (use --model frcnn)")
+    if args.resnet101 and args.model != "rfcn":
+        p.error("--resnet101 is the R-FCN trunk (use --model rfcn)")
     full = args.vgg16 or args.resnet101
     train_mod, eval_mod = _modules(args.model)
 

@@ -1,0 +1,79 @@
+"""Companion script for docs/tutorials/sparse.md (reference
+``docs/tutorials/sparse/{csr,row_sparse,train}.md``): CSR / RowSparse
+arrays, sparse dot, LibSVM input, and lazy (sparse) SGD updates."""
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+# --- 1. CSRNDArray: compressed sparse rows -------------------------------
+dense = np.array([[0, 1, 0, 2],
+                  [0, 0, 0, 0],
+                  [3, 0, 0, 0]], np.float32)
+csr = nd.sparse.csr_matrix(dense)
+assert csr.stype == "csr"
+np.testing.assert_allclose(csr.asnumpy(), dense)
+# the three constituent arrays, exactly the reference's layout
+print("csr data=%s indices=%s indptr=%s"
+      % (csr.data.asnumpy().tolist(), csr.indices.asnumpy().tolist(),
+         csr.indptr.asnumpy().tolist()))
+
+# construct from (data, indices, indptr) without densifying
+csr2 = nd.sparse.csr_matrix(
+    (csr.data.asnumpy(), csr.indices.asnumpy(), csr.indptr.asnumpy()),
+    shape=(3, 4))
+np.testing.assert_allclose(csr2.asnumpy(), dense)
+
+# --- 2. sparse dot: the workhorse of sparse linear models ----------------
+w = nd.array(np.arange(8, dtype=np.float32).reshape(4, 2))
+out = nd.sparse.dot(csr, w)
+np.testing.assert_allclose(out.asnumpy(), dense @ w.asnumpy())
+print("sparse dot OK")
+
+# --- 3. RowSparseNDArray: gradients that touch few rows ------------------
+rsp = nd.sparse.row_sparse_array(
+    (np.array([[1., 2.], [3., 4.]], np.float32), np.array([0, 3])),
+    shape=(5, 2))
+assert rsp.stype == "row_sparse"
+full = rsp.asnumpy()
+assert full[0].tolist() == [1, 2] and full[3].tolist() == [3, 4]
+assert (full[[1, 2, 4]] == 0).all()
+
+# retain a row subset (the kvstore row_sparse_pull primitive)
+kept = nd.sparse.retain(rsp, nd.array(np.array([3], np.float32)))
+assert kept.asnumpy()[3].tolist() == [3, 4] and (kept.asnumpy()[0] == 0).all()
+print("row_sparse retain OK")
+
+# --- 4. LibSVM input pipeline --------------------------------------------
+tmp = tempfile.mkdtemp()
+svm = os.path.join(tmp, "train.libsvm")
+with open(svm, "w") as f:
+    f.write("1 0:1.5 3:2.0\n0 1:1.0\n1 2:4.0 3:1.0\n0 0:0.5\n")
+it = mx.io.LibSVMIter(data_libsvm=svm, data_shape=(4,), batch_size=2)
+batches = list(it)
+assert len(batches) == 2
+assert batches[0].data[0].stype == "csr"
+print("LibSVMIter read %d batches of csr data" % len(batches))
+
+# --- 5. lazy sparse SGD: update only the touched rows --------------------
+# (reference optimizer_op.cc sparse sgd_update; lazy_update skips untouched
+# rows entirely — the reason row_sparse gradients exist)
+weight = nd.array(np.ones((5, 2), np.float32))
+opt = mx.optimizer.create("sgd", learning_rate=0.5, lazy_update=True)
+upd = mx.optimizer.get_updater(opt)
+upd(0, rsp, weight)
+wn = weight.asnumpy()
+np.testing.assert_allclose(wn[0], 1 - 0.5 * np.array([1., 2.]))
+np.testing.assert_allclose(wn[3], 1 - 0.5 * np.array([3., 4.]))
+np.testing.assert_allclose(wn[[1, 2, 4]], 1.0)  # untouched rows unchanged
+print("lazy sparse SGD touched only rows [0, 3]")
+
+print("SPARSE TUTORIAL OK")

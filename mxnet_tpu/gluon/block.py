@@ -24,25 +24,22 @@ from .parameter import Parameter, ParameterDict, DeferredInitializationError
 
 __all__ = ["Block", "HybridBlock", "SymbolBlock"]
 
-# one id per top-level symbolic graph capture (_get_graph); lets a block
-# invoked several times WITHIN one capture (weight sharing — siamese
-# towers) get a distinct per-call name-prefix ordinal, while staying
-# deterministic across captures
+# per-capture invocation counts (thread-local, reset by _get_graph): lets
+# a block invoked several times WITHIN one capture (weight sharing —
+# siamese towers) get a distinct per-call name-prefix ordinal, while
+# staying deterministic across captures and safe under concurrent
+# captures of a shared block from several threads
 _SYM_CAPTURE = threading.local()
-_SYM_CAPTURE_COUNTER = [0]
 
 
 def _sym_call_prefix(block):
     """Name prefix for one symbolic invocation of ``block`` (see above)."""
-    cid = getattr(_SYM_CAPTURE, "id", None)
-    if cid is None:
+    counts = getattr(_SYM_CAPTURE, "counts", None)
+    if counts is None:
         return block.prefix  # direct user symbolic call: plain prefix
-    if getattr(block, "_sym_call_cap", None) == cid:
-        block._sym_call_n += 1
-        return "%scall%d_" % (block.prefix, block._sym_call_n)
-    block._sym_call_cap = cid
-    block._sym_call_n = 0
-    return block.prefix
+    n = counts.get(id(block), -1) + 1
+    counts[id(block)] = n
+    return block.prefix if n == 0 else "%scall%d_" % (block.prefix, n)
 
 
 class _BlockScope:
@@ -405,12 +402,11 @@ class HybridBlock(Block):
             grouped, _ = _regroup(inputs, self._in_format)
             if not isinstance(grouped, tuple):
                 grouped = (grouped,)
-            _SYM_CAPTURE_COUNTER[0] += 1
-            _SYM_CAPTURE.id = _SYM_CAPTURE_COUNTER[0]
+            _SYM_CAPTURE.counts = {}
             try:
                 out = self._symbolic_forward(sym_mod, *grouped)
             finally:
-                _SYM_CAPTURE.id = None
+                _SYM_CAPTURE.counts = None
             flat_out, self._out_format = _flatten(out)
             self._cached_graph = inputs, sym_mod.Group(flat_out) if len(flat_out) > 1 else flat_out[0]
         return self._cached_graph
@@ -438,6 +434,12 @@ class HybridBlock(Block):
     def export(self, path, epoch=0):
         """Export symbol json + params (reference block.py export)."""
         if not self._cached_graph:
+            if getattr(self, "_sym_trace_failed", False):
+                raise RuntimeError(
+                    "export unavailable: this block's body could not be "
+                    "traced symbolically (concrete .shape use or "
+                    "train-only ops in hybrid_forward) — the forward ran, "
+                    "but no symbol graph could be captured.")
             raise RuntimeError("Please first call block.hybridize() and then run forward once before calling export.")
         _, out = self._cached_graph
         out.save("%s-symbol.json" % path)

@@ -15,6 +15,12 @@ REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
     ("bucketing.py", "BUCKETING TUTORIAL OK"),
     ("multi_devices.py", "MULTI-DEVICES TUTORIAL OK"),
     ("new_op.py", "NEW-OP TUTORIAL OK"),
+    ("gluon_intro.py", "GLUON-INTRO TUTORIAL OK"),
+    ("perf_tuning.py", "PERF-TUNING TUTORIAL OK"),
+    ("sparse_howto.py", "SPARSE TUTORIAL OK"),
+    ("recordio_pipeline.py", "RECORDIO TUTORIAL OK"),
+    ("int8_workflow.py", "INT8 TUTORIAL OK"),
+    ("profiler_howto.py", "PROFILER TUTORIAL OK"),
 ])
 def test_tutorial_script(script, marker):
     res = subprocess.run(
