@@ -28,6 +28,8 @@ here XLA emits the scatter-add from the gather's transpose automatically).
 """
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -102,8 +104,6 @@ def _abuild(yv, xv, out_dtype):
     ``MXNET_ABUILD_IMPL=pallas`` opts in (future chips / other shapes);
     ``=xla`` pins the einsum.
     """
-    import os
-
     impl = os.environ.get("MXNET_ABUILD_IMPL", "xla")
 
     if impl == "pallas":
@@ -754,11 +754,41 @@ def deformable_convolution(
                               ).astype(f32)                       # (N, cpg)
 
         flat = lambda a: a.reshape(B * DG, N)
-        _, col = jax.lax.scan(
-            lambda _, args: (None, one_bg(args)), None,
-            (flat(y0), flat(y1), flat(x0), flat(x1), flat(ly), flat(lx),
-             flat(lf), feat.reshape(B * DG, H * W, cpg)),
-            unroll=min(B * DG, 16))
+        ftm = feat.reshape(B * DG, H * W, cpg)
+
+        def xla_col():
+            _, col = jax.lax.scan(
+                lambda _, args: (None, one_bg(args)), None,
+                (flat(y0), flat(y1), flat(x0), flat(x1), flat(ly),
+                 flat(lx), flat(lf), ftm),
+                unroll=min(B * DG, 16))
+            return col
+
+        def pallas_col(interpret=False):
+            # fused VMEM-resident A (and dA) — the round-5 kernel: the
+            # XLA path materializes the rank-1 sample matrix in HBM
+            # (~106 MB bf16 fwd + ~213 MB f32 dA per (image, group) at
+            # north-star shapes); keeping both in VMEM measured
+            # fwd+bwd 34.7 -> 21.2 ms standalone, bitwise-equal output
+            # (pallas_kernels.dconv_col_pallas, custom VJP)
+            from .pallas_kernels import dconv_col_pallas
+
+            return dconv_col_pallas(
+                flat(y0), flat(y1), flat(x0), flat(x1), flat(ly),
+                flat(lx), flat(lf), ftm, (H, W), interpret)
+
+        impl = os.environ.get("MXNET_DCONV_IMPL", "auto")
+        if impl == "xla":
+            col = xla_col()
+        elif impl == "pallas":
+            # forced: pallas everywhere; the interpret choice follows the
+            # LOWERING platform (same rule as MXNET_NMS_IMPL)
+            col = jax.lax.platform_dependent(
+                tpu=lambda: pallas_col(False),
+                default=lambda: pallas_col(True))
+        else:
+            col = jax.lax.platform_dependent(tpu=lambda: pallas_col(False),
+                                             default=xla_col)
         col = (col.reshape(B, DG, K2, Ho * Wo, cpg)
                .transpose(0, 1, 4, 2, 3).reshape(B, C, K2, Ho, Wo))
     else:
@@ -855,8 +885,6 @@ def _nms_alive_blocked(boxes, thresh, tile=256, plus_one=1.0, valid=None,
     (the consistency tier) still gets the XLA formulation.
     ``MXNET_NMS_IMPL=xla|pallas`` overrides the auto choice.
     """
-    import os
-
     N = boxes.shape[0]
     if N == 0:
         return jnp.zeros((0,), bool)

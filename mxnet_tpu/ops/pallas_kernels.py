@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["quantize_int8_pallas", "dequantize_int8_pallas", "supported",
-           "nms_alive_pallas", "psroi_abuild_pallas"]
+           "nms_alive_pallas", "psroi_abuild_pallas", "dconv_col_pallas"]
 
 _LANE = 128
 # minimum sublane count per dtype (pallas_guide.md tiling constraints)
@@ -397,3 +397,200 @@ def _abuild_bwd(out_dtype, interpret, res, g):
 
 
 psroi_abuild_pallas.defvjp(_abuild_fwd, _abuild_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Fused deformable-conv sampling matmul (round-5 north-star kernel)
+# ---------------------------------------------------------------------------
+#
+# The deformable conv's one-hot path materializes, per (image, group), a
+# rank-1 sample matrix A[n, h*W+w] = yw[n,h]*xw[n,w] (bf16, ~106 MB at
+# north-star shapes) and feeds it to ``col = A @ feat``; AD then
+# materializes dA in f32 (~213 MB).  The round-5 batch-8 source-line
+# accounting put the whole sampling machinery at ~88 ms of a 227 ms step
+# — nearly all of it A/dA HBM traffic.  This kernel keeps A (and dA, in
+# the backward) entirely in VMEM: the one-hot factors are rebuilt per
+# block from the integer/lerp inputs with lane-iota compares (no gather,
+# no reshape), and the contraction runs as one MXU dot per block.
+#
+# Forward:  col[bg, n, c] = sum_p A[bg, n, p] * ft[bg, p, c]
+#   with A = [(1-ly)(hh==y0) + ly(hh==y1)] * [(1-lx)(ww==x0) + lx(ww==x1)] * lf
+#   where hh = p // W, ww = p % W.
+# Backward (custom VJP): dA = g @ ft^T stays in VMEM; d_ly/d_lx/d_lf are
+#   elementwise-masked row reductions of dA; d_ft accumulates A^T @ g
+#   across row blocks.
+
+_DCONV_NBLK = 128
+
+
+def _dconv_factors(y0, y1, x0, x1, ly, lx, H, W):
+    """One-hot lerp factor planes over the flat p = h*W + w lane axis —
+    pure elementwise compares against lane iotas (no gather/reshape)."""
+    n = y0.shape[0]
+    HW = H * W
+    idx = jax.lax.broadcasted_iota(jnp.int32, (n, HW), 1)
+    hh = idx // W
+    ww = idx - hh * W
+    e0y = (hh == y0[:, None]).astype(jnp.float32)
+    e1y = (hh == y1[:, None]).astype(jnp.float32)
+    e0x = (ww == x0[:, None]).astype(jnp.float32)
+    e1x = (ww == x1[:, None]).astype(jnp.float32)
+    yfac = (1.0 - ly)[:, None] * e0y + ly[:, None] * e1y
+    xfac_nolf = (1.0 - lx)[:, None] * e0x + lx[:, None] * e1x
+    return yfac, xfac_nolf, e0y, e1y, e0x, e1x
+
+
+def _dconv_prec(dot_dtype):
+    # f32 kernels must not silently drop to the MXU's default bf16
+    # multiplies — the XLA formulation pins HIGHEST for f32 (detection.py)
+    # and so does the sibling psroi_abuild kernel; bf16 stays single-pass
+    return (jax.lax.Precision.HIGHEST
+            if jnp.dtype(dot_dtype) == jnp.float32 else None)
+
+
+def _dconv_fwd_kernel_factory(H, W, nblk, dot_dtype):
+    def kern(y0_ref, y1_ref, x0_ref, x1_ref, ly_ref, lx_ref, lf_ref,
+             ft_ref, col_ref):
+        import jax.experimental.pallas as pl
+
+        # factor blocks hold the WHOLE (padded) row per bg (N*4 bytes =
+        # ~87 KB at north-star shapes — Mosaic requires lane-dim blocks be
+        # full or 128-multiples; slicing the current chunk in-kernel keeps
+        # the spec legal and the row resident across the i-grid)
+        off = pl.program_id(1) * nblk
+        sl = lambda ref: ref[0, 0, pl.ds(off, nblk)]
+        yfac, xfac_nolf, *_ = _dconv_factors(
+            sl(y0_ref), sl(y1_ref), sl(x0_ref), sl(x1_ref),
+            sl(ly_ref), sl(lx_ref), H, W)
+        a = yfac * xfac_nolf * sl(lf_ref)[:, None]
+        col_ref[0] = jnp.dot(
+            a.astype(dot_dtype), ft_ref[0], precision=_dconv_prec(dot_dtype),
+            preferred_element_type=jnp.float32).astype(col_ref.dtype)
+    return kern
+
+
+def _dconv_bwd_kernel_factory(H, W, nblk, dot_dtype):
+    def kern(y0_ref, y1_ref, x0_ref, x1_ref, ly_ref, lx_ref, lf_ref,
+             ft_ref, g_ref, dly_ref, dlx_ref, dlf_ref, dft_ref):
+        import jax.experimental.pallas as pl
+
+        off = pl.program_id(1) * nblk
+        sl = lambda ref: ref[0, 0, pl.ds(off, nblk)]
+        yfac, xfac_nolf, e0y, e1y, e0x, e1x = _dconv_factors(
+            sl(y0_ref), sl(y1_ref), sl(x0_ref), sl(x1_ref),
+            sl(ly_ref), sl(lx_ref), H, W)
+        lf = sl(lf_ref)[:, None]
+        g = g_ref[0].astype(dot_dtype)
+        # dA = g @ ft^T — contraction over channels, stays in VMEM
+        da = jax.lax.dot_general(
+            g, ft_ref[0], (((1,), (1,)), ((), ())),
+            precision=_dconv_prec(dot_dtype),
+            preferred_element_type=jnp.float32)
+        dly_ref[0, 0, pl.ds(off, nblk)] = (
+            da * (e1y - e0y) * xfac_nolf * lf).sum(axis=1)
+        dlx_ref[0, 0, pl.ds(off, nblk)] = (
+            da * yfac * (e1x - e0x) * lf).sum(axis=1)
+        dlf_ref[0, 0, pl.ds(off, nblk)] = (da * yfac * xfac_nolf).sum(axis=1)
+        # d_ft += A^T @ g, accumulated across the row-block grid dim
+        a = (yfac * xfac_nolf * lf).astype(dot_dtype)
+        contrib = jax.lax.dot_general(
+            a, g, (((0,), (0,)), ((), ())),
+            precision=_dconv_prec(dot_dtype),
+            preferred_element_type=jnp.float32)
+
+        @pl.when(pl.program_id(1) == 0)
+        def _init():
+            dft_ref[0] = jnp.zeros_like(dft_ref[0])
+
+        dft_ref[0] += contrib
+    return kern
+
+
+def _dconv_pad(a, n_pad, fill=0):
+    if a.shape[1] != n_pad:
+        a = jnp.pad(a, ((0, 0), (0, n_pad - a.shape[1])),
+                    constant_values=fill)
+    # (BG, 1, n_pad): Mosaic block shapes need the last two dims full or
+    # (8, 128)-divisible; a singleton sublane dim satisfies "full"
+    return a[:, None, :]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9))
+def dconv_col_pallas(y0, y1, x0, x1, ly, lx, lf, ft, hw, interpret=False):
+    """col[bg, n, :] = A[bg, n, :] @ ft[bg] with A built in VMEM (above).
+
+    y0..x1: (BG, N) int32; ly/lx/lf: (BG, N) f32; ft: (BG, H*W, C);
+    ``hw`` = (H, W) static.  Returns (BG, N, C) in ft's dtype with f32
+    accumulation (== the XLA path's a.astype(ft.dtype) @ ft contract).
+    """
+    return _dconv_impl(y0, y1, x0, x1, ly, lx, lf, ft, hw, interpret)
+
+
+def _dconv_grid(N):
+    nblk = min(_DCONV_NBLK, N)
+    return nblk, -(-N // nblk) * nblk
+
+
+def _dconv_impl(y0, y1, x0, x1, ly, lx, lf, ft, hw, interpret):
+    from jax.experimental import pallas as pl
+
+    H, W = hw
+    BG, N = y0.shape
+    HW, C = ft.shape[1], ft.shape[2]
+    nblk, n_pad = _dconv_grid(N)
+    ints = [_dconv_pad(a, n_pad) for a in (y0, y1, x0, x1)]
+    # padded rows carry lf=0 => A row = 0 => no effect anywhere
+    flts = [_dconv_pad(a, n_pad) for a in (ly, lx)] + [_dconv_pad(lf, n_pad)]
+    fac_spec = pl.BlockSpec((1, 1, n_pad), lambda bg, i: (bg, 0, 0))
+    out = pl.pallas_call(
+        _dconv_fwd_kernel_factory(H, W, nblk, ft.dtype),
+        out_shape=jax.ShapeDtypeStruct((BG, n_pad, C), ft.dtype),
+        grid=(BG, n_pad // nblk),
+        in_specs=[fac_spec] * 7 + [
+            pl.BlockSpec((1, HW, C), lambda bg, i: (bg, 0, 0))],
+        out_specs=pl.BlockSpec((1, nblk, C), lambda bg, i: (bg, i, 0)),
+        interpret=interpret,
+    )(*ints, *flts, ft)
+    return out[:, :N]
+
+
+def _dconv_fwd(y0, y1, x0, x1, ly, lx, lf, ft, hw, interpret):
+    out = _dconv_impl(y0, y1, x0, x1, ly, lx, lf, ft, hw, interpret)
+    return out, (y0, y1, x0, x1, ly, lx, lf, ft)
+
+
+def _dconv_bwd(hw, interpret, res, g):
+    from jax.experimental import pallas as pl
+
+    y0, y1, x0, x1, ly, lx, lf, ft = res
+    H, W = hw
+    BG, N = y0.shape
+    HW, C = ft.shape[1], ft.shape[2]
+    nblk, n_pad = _dconv_grid(N)
+    ints = [_dconv_pad(a, n_pad) for a in (y0, y1, x0, x1)]
+    flts = [_dconv_pad(a, n_pad) for a in (ly, lx)] + [_dconv_pad(lf, n_pad)]
+    gp = jnp.pad(g, ((0, 0), (0, n_pad - N), (0, 0))) if n_pad != N else g
+    fac_spec = pl.BlockSpec((1, 1, n_pad), lambda bg, i: (bg, 0, 0))
+    dly, dlx, dlf, dft = pl.pallas_call(
+        _dconv_bwd_kernel_factory(H, W, nblk, ft.dtype),
+        out_shape=(jax.ShapeDtypeStruct((BG, 1, n_pad), jnp.float32),
+                   jax.ShapeDtypeStruct((BG, 1, n_pad), jnp.float32),
+                   jax.ShapeDtypeStruct((BG, 1, n_pad), jnp.float32),
+                   jax.ShapeDtypeStruct((BG, HW, C), jnp.float32)),
+        grid=(BG, n_pad // nblk),
+        in_specs=[fac_spec] * 7 + [
+            pl.BlockSpec((1, HW, C), lambda bg, i: (bg, 0, 0)),
+            pl.BlockSpec((1, nblk, C), lambda bg, i: (bg, i, 0))],
+        out_specs=(fac_spec, fac_spec, fac_spec,
+                   pl.BlockSpec((1, HW, C), lambda bg, i: (bg, 0, 0))),
+        interpret=interpret,
+    )(*ints, *flts, ft, gp)
+    import numpy as _np
+
+    f0 = lambda a: _np.zeros(a.shape, jax.dtypes.float0)
+    return (f0(y0), f0(y1), f0(x0), f0(x1),
+            dly[:, 0, :N], dlx[:, 0, :N], dlf[:, 0, :N],
+            dft.astype(ft.dtype))
+
+
+dconv_col_pallas.defvjp(_dconv_fwd, _dconv_bwd)
