@@ -22,6 +22,21 @@ import time
 import numpy as np
 
 
+def _emit(payload):
+    """Print the ONE bench JSON line; with MXNET_TELEMETRY enabled, attach
+    the telemetry block (compile_s, peak_hbm_bytes, data_wait_frac — see
+    docs/OBSERVABILITY.md) and flush the JSONL event log.  The line's schema
+    is linted by ci/check_bench_schema.py."""
+    from mxnet_tpu import telemetry
+
+    if telemetry.enabled():
+        telemetry.sample_memory()
+        payload["telemetry"] = telemetry.summary()
+        telemetry.event("bench_result", **payload)
+        telemetry.flush()
+    print(json.dumps(payload))
+
+
 def main():
     which = os.environ.get("MXNET_BENCH", "rfcn")
     if which == "frcnn":
@@ -57,7 +72,13 @@ def main():
         net, loss_mod.SoftmaxCrossEntropyLoss(), learning_rate=0.05, momentum=0.9,
         compute_dtype=None if dtype == "float32" else dtype,
     )
-    jstep = jax.jit(step, donate_argnums=(0,))
+    from mxnet_tpu import telemetry
+
+    # identity when MXNET_TELEMETRY is off; otherwise counts compiles and
+    # attributes first-call wall time to jit_compile_seconds_total
+    jstep = telemetry.instrument_step(
+        jax.jit(step, donate_argnums=(0,)),
+        name="resnet50_train_step", batch_size=batch)
 
     rng = np.random.RandomState(0)
     x = jax.device_put(rng.randn(batch, 3, image, image).astype(np.float32))
@@ -85,12 +106,12 @@ def main():
 
     imgs_per_sec = batch * iters / best_dt
     baseline = 109.0  # 1x K80, batch 32
-    print(json.dumps({
+    _emit({
         "metric": "resnet50_train_imgs_per_sec",
         "value": round(imgs_per_sec, 2),
         "unit": "img/s",
         "vs_baseline": round(imgs_per_sec / baseline, 3),
-    }))
+    })
 
 
 def main_rfcn():
@@ -113,19 +134,19 @@ def main_rfcn():
         dtype="bfloat16" if on_tpu else None, verbose=False)
     baseline = 3.8  # Deformable R-FCN reference throughput (BASELINE.md)
     if on_tpu:
-        print(json.dumps({
+        _emit({
             "metric": "deformable_rfcn_r101_coco_train_imgs_per_sec",
             "value": round(imgs_per_sec, 2),
             "unit": "img/s",
             "vs_baseline": round(imgs_per_sec / baseline, 3),
-        }))
+        })
     else:  # CPU smoke: tiny toy trunk — never report it as the R-101 number
-        print(json.dumps({
+        _emit({
             "metric": "deformable_rfcn_tiny_cpu_smoke_imgs_per_sec",
             "value": round(imgs_per_sec, 2),
             "unit": "img/s",
             "vs_baseline": None,
-        }))
+        })
 
 
 def main_frcnn():
@@ -146,19 +167,19 @@ def main_frcnn():
     if on_tpu:
         # no published img/s in the reference tree for this recipe (the bar
         # is mAP 70.23, example/rcnn/README.md:38-42) — vs_baseline omitted
-        print(json.dumps({
+        _emit({
             "metric": "faster_rcnn_vgg16_voc_train_imgs_per_sec",
             "value": round(imgs_per_sec, 2),
             "unit": "img/s",
             "vs_baseline": None,
-        }))
+        })
     else:
-        print(json.dumps({
+        _emit({
             "metric": "faster_rcnn_tiny_cpu_smoke_imgs_per_sec",
             "value": round(imgs_per_sec, 2),
             "unit": "img/s",
             "vs_baseline": None,
-        }))
+        })
 
 
 if __name__ == "__main__":

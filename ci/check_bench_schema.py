@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Schema lint for bench.py's JSON line (ISSUE 1 CI satellite).
+
+BENCH_r*.json (the driver's per-round capture) and the live ``python
+bench.py`` output must stay machine-parseable: one JSON object with exactly
+the known keys, including the optional ``telemetry`` block added by
+MXNET_TELEMETRY.  Run from ci/run_tests.sh unit tier::
+
+    python ci/check_bench_schema.py --self-test BENCH_r*.json
+    python bench.py | python ci/check_bench_schema.py -   # lint a live line
+
+Driver captures are validated through their ``parsed`` field; raw files
+containing a bare bench line are validated directly.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+TOP_KEYS = {"metric", "value", "unit", "vs_baseline", "telemetry"}
+TEL_KEYS = {"compile_s", "peak_hbm_bytes", "data_wait_frac"}
+
+
+class SchemaError(ValueError):
+    pass
+
+
+def _num(x):
+    return isinstance(x, (int, float)) and not isinstance(x, bool)
+
+
+def validate_line(obj, where="<line>"):
+    """Validate one bench JSON line dict; raises SchemaError."""
+    if not isinstance(obj, dict):
+        raise SchemaError("%s: bench line must be a JSON object, got %s"
+                          % (where, type(obj).__name__))
+    unknown = set(obj) - TOP_KEYS
+    if unknown:
+        raise SchemaError("%s: unknown top-level keys %s (schema: %s)"
+                          % (where, sorted(unknown), sorted(TOP_KEYS)))
+    for req in ("metric", "value", "unit"):
+        if req not in obj:
+            raise SchemaError("%s: missing required key %r" % (where, req))
+    if not isinstance(obj["metric"], str) or not obj["metric"]:
+        raise SchemaError("%s: 'metric' must be a non-empty string" % where)
+    if not _num(obj["value"]):
+        raise SchemaError("%s: 'value' must be a number" % where)
+    if not isinstance(obj["unit"], str):
+        raise SchemaError("%s: 'unit' must be a string" % where)
+    if "vs_baseline" in obj and obj["vs_baseline"] is not None \
+            and not _num(obj["vs_baseline"]):
+        raise SchemaError("%s: 'vs_baseline' must be a number or null" % where)
+    if "telemetry" in obj:
+        tel = obj["telemetry"]
+        if tel is None:
+            return
+        if not isinstance(tel, dict):
+            raise SchemaError("%s: 'telemetry' must be an object or null"
+                              % where)
+        unknown = set(tel) - TEL_KEYS
+        if unknown:
+            raise SchemaError("%s: unknown telemetry keys %s (schema: %s)"
+                              % (where, sorted(unknown), sorted(TEL_KEYS)))
+        for k in TEL_KEYS:
+            if k not in tel:
+                raise SchemaError("%s: telemetry block missing %r" % (where, k))
+        if not _num(tel["compile_s"]):
+            raise SchemaError("%s: telemetry.compile_s must be a number"
+                              % where)
+        if tel["peak_hbm_bytes"] is not None \
+                and not isinstance(tel["peak_hbm_bytes"], int):
+            raise SchemaError(
+                "%s: telemetry.peak_hbm_bytes must be an int or null" % where)
+        if not _num(tel["data_wait_frac"]) or not 0 <= tel["data_wait_frac"] <= 1:
+            raise SchemaError(
+                "%s: telemetry.data_wait_frac must be a number in [0, 1]"
+                % where)
+
+
+def validate_capture(path):
+    """Validate a BENCH_r*.json driver capture (or a raw bench line file)."""
+    with open(path, encoding="utf-8") as f:
+        obj = json.load(f)
+    if isinstance(obj, dict) and "parsed" in obj:
+        if obj.get("rc", 0) != 0:
+            print("%s: rc=%s capture — skipping parse check" % (path, obj["rc"]))
+            return
+        if obj["parsed"] is None:
+            raise SchemaError("%s: rc=0 capture with no parsed bench line"
+                              % path)
+        validate_line(obj["parsed"], path)
+    else:
+        validate_line(obj, path)
+
+
+def self_test():
+    good = [
+        {"metric": "m", "value": 1.5, "unit": "img/s", "vs_baseline": None},
+        {"metric": "m", "value": 1, "unit": "img/s", "vs_baseline": 2.0,
+         "telemetry": {"compile_s": 3.2, "peak_hbm_bytes": 123,
+                       "data_wait_frac": 0.01}},
+        {"metric": "m", "value": 1, "unit": "img/s",
+         "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0}},
+    ]
+    bad = [
+        {},                                                  # empty
+        {"metric": "m", "value": "fast", "unit": "img/s"},   # value type
+        {"metric": "m", "value": 1, "unit": "img/s", "extra": 1},
+        {"metric": "m", "value": 1, "unit": "img/s",
+         "telemetry": {"compile_s": 1.0}},                   # missing keys
+        {"metric": "m", "value": 1, "unit": "img/s",
+         "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": 1.5,
+                       "data_wait_frac": 0.0}},              # float bytes
+        {"metric": "m", "value": 1, "unit": "img/s",
+         "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 1.7}},              # frac range
+    ]
+    for obj in good:
+        validate_line(obj, "self-test good")
+    for i, obj in enumerate(bad):
+        try:
+            validate_line(obj, "self-test bad[%d]" % i)
+        except SchemaError:
+            continue
+        raise AssertionError("self-test: bad line %d passed: %r" % (i, obj))
+
+
+def main(argv):
+    args = list(argv)
+    if "--self-test" in args:
+        args.remove("--self-test")
+        self_test()
+        print("self-test ok")
+    rc = 0
+    for path in args:
+        try:
+            if path == "-":
+                for n, line in enumerate(sys.stdin, 1):
+                    line = line.strip()
+                    if line.startswith("{"):
+                        validate_line(json.loads(line), "<stdin>:%d" % n)
+            else:
+                validate_capture(path)
+            print("%s: ok" % path)
+        except (SchemaError, json.JSONDecodeError, OSError) as e:
+            print("%s: FAIL: %s" % (path, e), file=sys.stderr)
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -38,6 +38,10 @@ NIGHTLY_FILES=(
 tier="${1:-unit}"
 case "$tier" in
   unit)
+    # bench-line schema lint (ISSUE 1): BENCH_r*.json and the telemetry
+    # block must stay machine-parseable for the driver
+    python ci/check_bench_schema.py --self-test BENCH_r*.json
+    # telemetry unit tests (tests/test_telemetry.py) run as part of tests/
     ignore=()
     for f in "${NIGHTLY_FILES[@]}"; do ignore+=(--ignore "$f"); done
     exec ./dev.sh python -m pytest tests/ -q "${ignore[@]}"

@@ -194,8 +194,11 @@ def run_bench(vgg16, batch=1, iters=10, image_shape=None, classes=None,
     t0 = time.time()
     state, loss, parts = jstep(state, d, i, g, key)
     jax.block_until_ready(loss)
+    compile_s = time.time() - t0
+    # no-op unless MXNET_TELEMETRY is set: feeds bench.py's telemetry block
+    mx.telemetry.note_compile(compile_s, fn="frcnn_fused_step")
     if verbose:
-        print("compile+first step: %.1fs  loss=%.4f" % (time.time() - t0, float(loss)))
+        print("compile+first step: %.1fs  loss=%.4f" % (compile_s, float(loss)))
     best = None
     for w in range(windows):
         keys = [jax.random.fold_in(key, w * 1000 + it) for it in range(iters)]

@@ -49,6 +49,7 @@ def __getattr__(name):
         "executor_manager": ".executor_manager",
         "viz": ".visualization",
         "profiler": ".profiler",
+        "telemetry": ".telemetry",
         "recordio": ".recordio",
         "image": ".image",
         "test_utils": ".test_utils",

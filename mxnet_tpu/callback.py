@@ -50,7 +50,14 @@ def log_train_metric(period, auto_reset=False):
 class Speedometer:
     """Log samples/sec every `frequent` batches (log-format parity with
     reference callback.py:120; timing is tracked as a window mark that is
-    re-established whenever the batch counter rewinds, i.e. a new epoch)."""
+    re-established whenever the batch counter rewinds, i.e. a new epoch).
+
+    With ``MXNET_TELEMETRY`` enabled the rate is also published to the
+    telemetry registry (``speedometer_samples_per_sec`` — one source of
+    truth for throughput) and the log line grows a trailing
+    ``data-wait=N.N%`` field computed from the fit loop's
+    ``data_wait_seconds_total`` counter over the same window.  The reference
+    log format is untouched when telemetry is off."""
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
@@ -58,6 +65,7 @@ class Speedometer:
         self.auto_reset = auto_reset
         self._mark = None       # timestamp opening the current window
         self._prev_count = -1
+        self._prev_wait = 0.0   # data_wait_seconds_total at window open
 
     def __call__(self, param):
         count = param.nbatch
@@ -65,25 +73,53 @@ class Speedometer:
         self._prev_count = count
         if self._mark is None or rewound:
             self._mark = time.time()
+            self._prev_wait = self._wait_total()
             return
         if count % self.frequent:
             return
-        rate = self.frequent * self.batch_size / (time.time() - self._mark)
-        self._emit(param, count, rate)
+        window = time.time() - self._mark
+        rate = self.frequent * self.batch_size / window
+        self._emit(param, count, rate, window)
         self._mark = time.time()
+        self._prev_wait = self._wait_total()
 
-    def _emit(self, param, count, rate):
+    @staticmethod
+    def _wait_total():
+        from . import telemetry
+
+        if not telemetry.enabled():
+            return 0.0
+        return telemetry.registry().total("data_wait_seconds_total")
+
+    def _telemetry_suffix(self, rate, window):
+        """→ ["data-wait=N.N%"] when telemetry is on, else []."""
+        from . import telemetry
+
+        if not telemetry.enabled():
+            return []
+        telemetry.registry().gauge(
+            "speedometer_samples_per_sec", "Speedometer window throughput",
+        ).set(rate)
+        # the counter is process-global across loops, so a second concurrent
+        # fit loop can inflate the delta past the window — clamp to 100%
+        wait = self._wait_total() - self._prev_wait
+        frac = min(max(wait / window, 0.0), 1.0) if window > 0 else 0.0
+        return ["data-wait=%.1f%%" % (100.0 * frac)]
+
+    def _emit(self, param, count, rate, window):
+        extra = self._telemetry_suffix(rate, window)
         metric = param.eval_metric
         if metric is None:
-            logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                         param.epoch, count, rate)
+            logging.info("\t".join(
+                ["Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec"
+                 % (param.epoch, count, rate)] + extra))
             return
         pairs = metric.get_name_value()
         if self.auto_reset:
             metric.reset()
         parts = ["Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec" % (param.epoch, count, rate)]
         parts.extend("%s=%f" % (name, value) for name, value in pairs)
-        logging.info("\t".join(parts))
+        logging.info("\t".join(parts + extra))
 
 
 class ProgressBar:

@@ -167,5 +167,10 @@ def make_train_step(net, loss_fn, learning_rate=0.01, momentum=0.0,
         state_sh = jax.tree_util.tree_map(lambda v: v.sharding, state)
         step = jax.jit(step, donate_argnums=(0,),
                        out_shardings=(state_sh, repl))
+        # telemetry (identity when MXNET_TELEMETRY is off — the jitted step
+        # object comes back untouched): compile count/seconds + step counters
+        from .. import telemetry
+
+        step = telemetry.instrument_step(step, name="gluon_train_step")
 
     return step, state, (names, learn_idx, aux_idx)

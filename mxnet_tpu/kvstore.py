@@ -28,6 +28,7 @@ from __future__ import annotations
 import logging
 import pickle
 
+from . import telemetry as _telemetry
 from .ndarray.ndarray import NDArray
 
 __all__ = ["KVStore", "create"]
@@ -35,6 +36,9 @@ __all__ = ["KVStore", "create"]
 
 def _as_list(x):
     return x if isinstance(x, (list, tuple)) else [x]
+
+
+_nbytes = _telemetry.array_nbytes
 
 
 class KVStore:
@@ -100,6 +104,9 @@ class KVStore:
         for k, vlist in zip(keys, values):
             self._check_init(k)
             merged = self._merge(vlist)
+            if _telemetry.enabled():
+                _telemetry.note_bytes("kvstore_bytes_pushed_total",
+                                      _nbytes(merged), store=self._type)
             if self._compression is not None:
                 merged = self._compress(k, merged)
             if self._is_dist:
@@ -116,6 +123,10 @@ class KVStore:
         for k, olist in zip(keys, outs):
             self._check_init(k)
             src = self._store[k]
+            if _telemetry.enabled():
+                _telemetry.note_bytes("kvstore_bytes_pulled_total",
+                                      _nbytes(src) * len(olist),
+                                      store=self._type)
             for o in olist:
                 o._rebind(src._data)
         return out

@@ -221,25 +221,46 @@ class BaseModule:
             validation_metric = eval_metric
         eval_metric = _as_metric(eval_metric)
 
+        # telemetry probe (None when MXNET_TELEMETRY is off — the loop below
+        # then takes no timestamps beyond the reference's own epoch timer)
+        from .. import telemetry
+
+        probe = telemetry.step_probe("module_fit")
+
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
             nbatch = 0
             data_iter = iter(train_data)
             end_of_batch = False
+            t0 = time.perf_counter() if probe else 0.0
             next_data_batch = next(data_iter)
+            if probe:
+                probe.record_data_wait(time.perf_counter() - t0)
             while not end_of_batch:
                 data_batch = next_data_batch
+                t_batch = time.perf_counter() if probe else 0.0
                 if monitor is not None:
                     monitor.tic()
                 self.forward_backward(data_batch)
                 self.update()
+                wait = 0.0
                 try:
+                    t0 = time.perf_counter() if probe else 0.0
                     next_data_batch = next(data_iter)
+                    if probe:
+                        wait = time.perf_counter() - t0
                     self.prepare(next_data_batch)
                 except StopIteration:
                     end_of_batch = True
+                # the metric read syncs the async dispatch, so the batch wall
+                # time measured around it is honest device+host time
                 self.update_metric(eval_metric, data_batch.label)
+                if probe:
+                    probe.record_data_wait(wait)
+                    probe.record_step(
+                        time.perf_counter() - t_batch - wait,
+                        nsamples=data_batch.data[0].shape[0])
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:
@@ -250,7 +271,12 @@ class BaseModule:
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+                if probe:
+                    probe.record_metric(name, val)
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
+            if probe:
+                probe.epoch_event(epoch, nbatch=nbatch,
+                                  seconds=round(time.time() - tic, 3))
 
             arg_params_, aux_params_ = self.get_params()
             self.set_params(arg_params_, aux_params_)

@@ -17,11 +17,30 @@ def _tree_map(fn, tree):
     return jax.tree_util.tree_map(fn, tree)
 
 
+def _note_bytes(op, tree):
+    """Telemetry bytes-moved counter for one collective call.
+
+    These collectives run inside jit, so this executes while TRACING: the
+    counter measures declared bytes per compiled collective (one sample per
+    trace), not per device execution — the per-step multiplier is the step
+    count, which telemetry already tracks.  No-op when telemetry is off."""
+    from .. import telemetry
+
+    if not telemetry.enabled():
+        return
+    import jax
+
+    n = sum(telemetry.array_nbytes(leaf)
+            for leaf in jax.tree_util.tree_leaves(tree))
+    telemetry.note_bytes("collective_bytes_total", n, op=op)
+
+
 def allreduce(tree, axis_name="dp"):
     """Sum each leaf over ``axis_name``.  ≡ KVStore push+pull of every key
     (reference ``kvstore_dist.h:202,208``) collapsed into one fused collective."""
     import jax
 
+    _note_bytes("allreduce", tree)
     return _tree_map(lambda x: jax.lax.psum(x, axis_name), tree)
 
 
@@ -29,6 +48,7 @@ def pmean(tree, axis_name="dp"):
     """Mean over ``axis_name`` — the gradient-averaging step of dist_sync."""
     import jax
 
+    _note_bytes("pmean", tree)
     return _tree_map(lambda x: jax.lax.pmean(x, axis_name), tree)
 
 
@@ -36,6 +56,7 @@ def allgather(tree, axis_name="dp", axis=0, tiled=True):
     """Gather shards along ``axis`` from every member of ``axis_name``."""
     import jax
 
+    _note_bytes("allgather", tree)
     return _tree_map(
         lambda x: jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled), tree
     )
@@ -46,6 +67,7 @@ def reduce_scatter(tree, axis_name="dp", axis=0):
     allreduce; use with ZeRO-style sharded optimizer states."""
     import jax
 
+    _note_bytes("reduce_scatter", tree)
     return _tree_map(
         lambda x: jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True),
         tree,

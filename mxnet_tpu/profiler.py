@@ -45,6 +45,8 @@ __all__ = [
 
 _lock = threading.Lock()
 _events = []  # chrome trace event dicts
+_meta_events = []  # "ph":"M" metadata — recorded unconditionally (a Domain
+# created before set_state('run') must still name its pid in the dump)
 _config = {
     "filename": "profile.json",
     "profile_all": False,
@@ -71,6 +73,41 @@ def _emit(ev):
         return
     with _lock:
         _events.append(ev)
+
+
+def _emit_meta(ev):
+    """Metadata events carry no timestamp and are state-independent: drop
+    nothing, re-emit all of them in every dumps() (chrome://tracing needs the
+    process_name record even when the domain predates set_state('run'))."""
+    with _lock:
+        _meta_events.append(ev)
+
+
+class _AtomicValue:
+    """Lock-guarded numeric cell — the shared thread-safe read-modify-write
+    primitive behind profiler and telemetry counters (a bare ``self._value +=
+    delta`` races: two threads can read the same base value and lose one
+    increment)."""
+
+    __slots__ = ("_mu", "_v")
+
+    def __init__(self, value=0):
+        self._mu = threading.Lock()
+        self._v = value
+
+    def add(self, delta):
+        with self._mu:
+            self._v += delta
+            return self._v
+
+    def set(self, value):
+        with self._mu:
+            self._v = value
+            return self._v
+
+    def get(self):
+        with self._mu:
+            return self._v
 
 
 def _op_profiling_active():
@@ -168,11 +205,26 @@ def resume(profile_process="worker"):
 
 
 def dumps(reset=False):
-    """Return the chrome-trace JSON string (reference aggregate dumps)."""
+    """Return the chrome-trace JSON string (reference aggregate dumps).
+
+    Metadata events (process names) are re-emitted on every call and survive
+    ``reset`` — they are declarations, not samples.  When the Pallas kernel
+    module is loaded, its traced custom-call cost table rides along as one
+    extra metadata record so ``tools/trace_summary.py`` can restore FLOPs and
+    bytes for custom calls that XLA cost analysis cannot see.
+    """
     with _lock:
-        evs = list(_events)
+        evs = list(_meta_events) + list(_events)
         if reset:
             _events.clear()
+    import sys
+
+    pk = sys.modules.get("mxnet_tpu.ops.pallas_kernels")
+    if pk is not None:
+        costs = pk.traced_costs()
+        if costs:
+            evs.insert(0, {"name": "custom_call_costs", "ph": "M", "pid": 0,
+                           "args": costs})
     return json.dumps({"traceEvents": evs, "displayTimeUnit": "ms"}, indent=1)
 
 
@@ -197,7 +249,7 @@ class Domain:
         self.name = name
         self.pid = Domain._next_pid[0]
         Domain._next_pid[0] += 1
-        _emit(
+        _emit_meta(
             {
                 "name": "process_name",
                 "ph": "M",
@@ -301,12 +353,11 @@ class Counter:
     def __init__(self, domain, name, value=None):
         self.domain = _domain_of(domain)
         self.name = name
-        self._value = 0
+        self._value = _AtomicValue(0)
         if value is not None:
             self.set_value(value)
 
-    def set_value(self, value):
-        self._value = value
+    def _emit_sample(self, value):
         _emit(
             {
                 "name": self.name,
@@ -317,11 +368,16 @@ class Counter:
             }
         )
 
+    def set_value(self, value):
+        self._emit_sample(self._value.set(value))
+
     def increment(self, delta=1):
-        self.set_value(self._value + delta)
+        # add() returns the post-update value, so the emitted sample cannot
+        # observe a concurrent writer's torn intermediate state
+        self._emit_sample(self._value.add(delta))
 
     def decrement(self, delta=1):
-        self.set_value(self._value - delta)
+        self._emit_sample(self._value.add(-delta))
 
     def __iadd__(self, v):
         self.increment(v)

@@ -1,0 +1,34 @@
+"""mxnet_tpu.telemetry — unified runtime-metrics subsystem (ISSUE 1).
+
+One typed registry (Counter / Gauge / Histogram, labeled), pluggable sinks
+(JSONL event log, Prometheus text exposition, chrome-trace profiler bridge,
+TensorBoard), and instrumentation wired into the hot paths: the gluon
+train step and Module fit loop (step wall time, data-wait, samples/s,
+loss), jit compile tracking, per-device HBM gauges, and bytes-moved
+counters in kvstore/collectives.  The Pallas custom-call cost registry
+(``ops/pallas_kernels.py``) plus ``tools/trace_summary.py`` restore
+roofline accounting for kernels XLA cost analysis cannot see.
+
+Everything gates on ``MXNET_TELEMETRY`` — unset/0 means every helper is an
+identity/no-op and the train-step path is byte-identical to a build without
+telemetry.  See docs/OBSERVABILITY.md for the JSONL schema and recipes.
+"""
+from .registry import (Counter, Gauge, Histogram, MetricError, Registry,
+                       DEFAULT_BUCKETS)
+from .sinks import (JsonlSink, PrometheusSink, ProfilerSink, Sink,
+                    TensorBoardSink, iter_scalar_samples, render_prometheus)
+from .instrument import (StepProbe, add_sink, array_nbytes, counter, enabled,
+                         event, flush, gauge, histogram, instrument_step,
+                         interval_s, jsonl_path, note_bytes, note_compile,
+                         registry, sample_memory, step_probe, summary)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricError", "Registry",
+    "DEFAULT_BUCKETS",
+    "Sink", "JsonlSink", "PrometheusSink", "ProfilerSink", "TensorBoardSink",
+    "iter_scalar_samples", "render_prometheus",
+    "StepProbe", "add_sink", "array_nbytes", "counter", "enabled", "event",
+    "flush", "gauge", "histogram", "instrument_step", "interval_s",
+    "jsonl_path", "note_bytes", "note_compile", "registry", "sample_memory",
+    "step_probe", "summary",
+]

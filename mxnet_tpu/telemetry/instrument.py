@@ -1,0 +1,332 @@
+"""Gating, the process-global registry, and hot-path instrumentation.
+
+Everything here is behind ``MXNET_TELEMETRY`` (docs/ENV_VARS.md): with the
+variable unset/0 every helper is an identity/no-op — ``instrument_step``
+returns its argument unchanged, ``step_probe`` returns None, nothing opens a
+file — so the step path carries **zero** added Python when telemetry is off
+(tested in tests/test_telemetry.py).
+
+Instrumented signals (ISSUE 1 tentpole):
+
+- jit compile tracking: ``instrument_step`` wraps an already-jitted step and
+  classifies each call as compile (executable-cache growth — first call or a
+  shape/dtype change) vs steady-state, accumulating ``jit_compiles_total`` /
+  ``jit_compile_seconds_total`` / ``jit_cache_hits_total``.  It deliberately
+  does NOT block on the result: XLA's async dispatch is the engine
+  (docs/ARCHITECTURE.md) and a per-step ``block_until_ready`` would
+  serialize the pipeline it is trying to observe.  True step wall time comes
+  from the fit loop, which already syncs once per batch via the metric read.
+- step/data-wait/samples: ``StepProbe`` used by ``BaseModule.fit``.
+- per-device HBM: ``sample_memory`` via ``device.memory_stats()`` (returns
+  {} on backends that expose none, e.g. CPU — the gauges simply stay empty).
+- declared collective/kvstore traffic: ``note_bytes``.
+"""
+from __future__ import annotations
+
+import atexit
+import functools
+import os
+import threading
+import time
+
+from .registry import Registry
+from .sinks import JsonlSink
+
+__all__ = ["enabled", "jsonl_path", "interval_s", "registry", "add_sink",
+           "counter", "gauge", "histogram", "event", "flush",
+           "instrument_step", "note_compile", "note_bytes", "array_nbytes",
+           "sample_memory", "step_probe", "StepProbe", "summary"]
+
+_FALSY = ("", "0", "false", "no", "off")
+
+_mu = threading.Lock()
+_registry = None
+_atexit_registered = False
+
+
+def enabled():
+    """MXNET_TELEMETRY gate — read per call so tests can flip it; one dict
+    lookup, cheap enough for a per-batch guard."""
+    return os.environ.get("MXNET_TELEMETRY", "0").strip().lower() not in _FALSY
+
+
+def jsonl_path():
+    return os.environ.get("MXNET_TELEMETRY_FILE", "telemetry.jsonl")
+
+
+def interval_s():
+    """Memory-gauge sampling interval (seconds)."""
+    try:
+        return float(os.environ.get("MXNET_TELEMETRY_INTERVAL", "10"))
+    except ValueError:
+        return 10.0
+
+
+def registry():
+    """The process-global Registry (created lazily).  The JSONL sink on
+    ``MXNET_TELEMETRY_FILE`` (plus a final flush at interpreter exit) is
+    attached on the first access that sees telemetry enabled — enabling
+    mid-process after an early disabled touch still wires the log."""
+    global _registry, _atexit_registered
+    with _mu:
+        if _registry is None:
+            _registry = Registry()
+        if enabled() and not any(
+                isinstance(s, JsonlSink) for s in _registry.sinks()):
+            _registry.add_sink(JsonlSink(jsonl_path()))
+            if not _atexit_registered:
+                atexit.register(_exit_flush)
+                _atexit_registered = True
+        return _registry
+
+
+def _exit_flush():
+    with _mu:
+        r = _registry
+    if r is not None:
+        try:
+            r.flush()
+            r.close()
+        except Exception:  # interpreter teardown: never mask the real exit
+            pass
+
+
+def _reset_for_tests():
+    """Drop the global registry so a test can re-wire gating/sinks."""
+    global _registry
+    with _mu:
+        old, _registry = _registry, None
+    if old is not None:
+        old.close()
+
+
+# -- thin proxies on the global registry ------------------------------------
+def counter(name, help="", labelnames=()):
+    return registry().counter(name, help, labelnames)
+
+
+def gauge(name, help="", labelnames=()):
+    return registry().gauge(name, help, labelnames)
+
+
+def histogram(name, help="", labelnames=(), buckets=None):
+    return registry().histogram(name, help, labelnames, buckets)
+
+
+def add_sink(sink):
+    return registry().add_sink(sink)
+
+
+def event(kind, **fields):
+    if not enabled():
+        return None
+    return registry().event(kind, **fields)
+
+
+def flush():
+    if not enabled():
+        return None
+    return registry().flush()
+
+
+# -- jit compile tracking ----------------------------------------------------
+def instrument_step(fn, name="train_step", batch_size=None):
+    """Wrap a JITTED callable with compile/step accounting.
+
+    Identity when telemetry is disabled — callers may wrap unconditionally
+    and the jitted step object (and its timings) are untouched.  Compile
+    detection uses the jit executable cache size when the backend exposes it
+    (``fn._cache_size``), falling back to first-call-is-compile.
+    """
+    if not enabled():
+        return fn
+    r = registry()
+    compiles = r.counter("jit_compiles_total",
+                         "jit executable compilations", ("fn",))
+    compile_s = r.counter("jit_compile_seconds_total",
+                          "wall seconds spent in calls that compiled", ("fn",))
+    hits = r.counter("jit_cache_hits_total",
+                     "steady-state calls (no compilation)", ("fn",))
+    dispatch = r.counter("jit_dispatch_seconds_total",
+                         "wall seconds in steady-state dispatch", ("fn",))
+    steps = r.counter("steps_total", "train-step invocations", ("fn",))
+    samples = r.counter("samples_total", "samples processed", ("fn",))
+    cache_size = getattr(fn, "_cache_size", None)
+    seen = {"calls": 0}
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        before = cache_size() if cache_size is not None else None
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        after = cache_size() if cache_size is not None else None
+        compiled = (after > before) if before is not None else seen["calls"] == 0
+        seen["calls"] += 1
+        if compiled:
+            compiles.inc(fn=name)
+            compile_s.inc(dt, fn=name)
+            r.event("compile", fn=name, seconds=round(dt, 6))
+        else:
+            hits.inc(fn=name)
+            dispatch.inc(dt, fn=name)
+        steps.inc(fn=name)
+        if batch_size:
+            samples.inc(batch_size, fn=name)
+        return out
+
+    wrapped.__wrapped__ = fn
+    # distinct sentinel: jitted fns already carry __wrapped__ themselves
+    wrapped._telemetry_instrumented = fn
+    return wrapped
+
+
+def note_compile(seconds, fn="step"):
+    """Record an externally-timed compile (call sites that already bracket
+    their own compile+first-step timing, e.g. the example fused benches)."""
+    if not enabled():
+        return
+    r = registry()
+    r.counter("jit_compiles_total", "jit executable compilations",
+              ("fn",)).inc(fn=fn)
+    r.counter("jit_compile_seconds_total",
+              "wall seconds spent in calls that compiled",
+              ("fn",)).inc(float(seconds), fn=fn)
+    r.event("compile", fn=fn, seconds=round(float(seconds), 6))
+
+
+def note_bytes(counter_name, nbytes, **labels):
+    """Accumulate a bytes-moved counter (kvstore push/pull, collectives)."""
+    if not enabled() or nbytes <= 0:
+        return
+    registry().counter(counter_name, "bytes moved",
+                       tuple(sorted(labels))).inc(int(nbytes), **labels)
+
+
+def array_nbytes(arr):
+    """Byte size of an NDArray / jax array / tracer / numpy array — the one
+    shared implementation behind the kvstore and collective byte counters."""
+    data = getattr(arr, "_data", arr)
+    nb = getattr(data, "nbytes", None)
+    if nb is not None:
+        return int(nb)
+    import numpy as np
+
+    shape = getattr(data, "shape", ())
+    dtype = getattr(data, "dtype", None)
+    itemsize = np.dtype(dtype).itemsize if dtype is not None else 4
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n * itemsize
+
+
+# -- device memory -----------------------------------------------------------
+def sample_memory(devices=None, record_event=False):
+    """Read ``device.memory_stats()`` into per-device gauges.
+
+    → {"tpu:0": {"bytes_in_use": ..., "peak_bytes_in_use": ...}, ...}; {}
+    when disabled or when no device reports stats (CPU backends return
+    None — the fallback is simply an empty reading, never an error)."""
+    if not enabled():
+        return {}
+    import jax
+
+    r = registry()
+    in_use = r.gauge("device_bytes_in_use", "live HBM bytes", ("device",))
+    peak = r.gauge("device_peak_bytes_in_use", "high-water HBM bytes",
+                   ("device",))
+    out = {}
+    for d in devices if devices is not None else jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        dev = "%s:%d" % (d.platform, d.id)
+        b = int(stats.get("bytes_in_use", 0))
+        p = int(stats.get("peak_bytes_in_use", b))
+        in_use.set(b, device=dev)
+        peak.set(p, device=dev)
+        out[dev] = {"bytes_in_use": b, "peak_bytes_in_use": p}
+    if record_event and out:
+        r.event("memory", devices=out)
+    return out
+
+
+# -- fit-loop probe -----------------------------------------------------------
+class StepProbe:
+    """Per-training-loop handle: step wall time, data-wait, samples/s, loss,
+    interval-limited memory sampling.  Construct via ``step_probe`` (None
+    when disabled, so the loop guards with a single ``if probe:``)."""
+
+    def __init__(self, loop, batch_size=None):
+        self.loop = loop
+        self.batch_size = batch_size
+        r = registry()
+        self._r = r
+        self._step_hist = r.histogram("step_seconds",
+                                      "per-batch wall time", ("loop",))
+        self._wait = r.counter("data_wait_seconds_total",
+                               "wall seconds blocked on the input pipeline",
+                               ("loop",))
+        self._steps = r.counter("steps_total", "train-step invocations",
+                                ("fn",))
+        self._samples = r.counter("samples_total", "samples processed",
+                                  ("fn",))
+        self._rate = r.gauge("samples_per_sec", "recent throughput", ("loop",))
+        self._loss = r.gauge("last_loss", "last recorded training loss",
+                             ("loop",))
+        self._last_mem = 0.0
+
+    def record_data_wait(self, seconds):
+        self._wait.inc(max(0.0, seconds), loop=self.loop)
+
+    def record_step(self, seconds, nsamples=None, loss=None):
+        self._step_hist.observe(seconds, loop=self.loop)
+        self._steps.inc(fn=self.loop)
+        n = nsamples if nsamples is not None else self.batch_size
+        if n:
+            self._samples.inc(n, fn=self.loop)
+            if seconds > 0:
+                self._rate.set(n / seconds, loop=self.loop)
+        if loss is not None:
+            self._loss.set(float(loss), loop=self.loop)
+        self.maybe_sample_memory()
+
+    def record_metric(self, name, value):
+        self._r.gauge("train_metric", "eval_metric value",
+                      ("loop", "name")).set(value, loop=self.loop, name=name)
+
+    def epoch_event(self, epoch, **fields):
+        self._r.event("epoch", loop=self.loop, epoch=epoch, **fields)
+
+    def maybe_sample_memory(self):
+        now = time.monotonic()
+        if now - self._last_mem >= interval_s():
+            self._last_mem = now
+            sample_memory()
+
+
+def step_probe(loop, batch_size=None):
+    return StepProbe(loop, batch_size) if enabled() else None
+
+
+# -- bench summary ------------------------------------------------------------
+def summary():
+    """The bench.py ``telemetry`` block: compile_s, peak_hbm_bytes,
+    data_wait_frac — None when telemetry is disabled."""
+    if not enabled():
+        return None
+    r = registry()
+    compile_s = r.total("jit_compile_seconds_total", 0.0)
+    peak = r.max_value("device_peak_bytes_in_use", None)
+    wait = r.total("data_wait_seconds_total", 0.0)
+    busy = r.hist_sum("step_seconds", 0.0) + r.total(
+        "jit_dispatch_seconds_total", 0.0) + r.total(
+        "jit_compile_seconds_total", 0.0)
+    frac = wait / (wait + busy) if (wait + busy) > 0 else 0.0
+    return {"compile_s": round(compile_s, 3),
+            "peak_hbm_bytes": int(peak) if peak is not None else None,
+            "data_wait_frac": round(frac, 4)}

@@ -1,0 +1,286 @@
+"""Typed runtime-metric registry — Counter / Gauge / Histogram with labels.
+
+The measurement substrate for the whole stack (ISSUE 1 tentpole): hot paths
+record into these types, sinks (``telemetry.sinks``) serialize snapshots to
+JSONL / Prometheus text / the chrome-trace profiler.  Values live behind the
+profiler's ``_AtomicValue`` primitive so concurrent producers (data workers,
+the dist barrier thread, user callbacks) never lose increments.
+
+The registry itself carries no policy: it does not read environment
+variables and never imports jax.  Gating lives in ``telemetry.instrument``;
+a bare ``Registry()`` is always safe to construct (tests do).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..profiler import _AtomicValue
+
+__all__ = ["Counter", "Gauge", "Histogram", "Registry", "MetricError",
+           "DEFAULT_BUCKETS"]
+
+# Prometheus' default duration buckets — right-sized for step/compile seconds
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+class MetricError(ValueError):
+    """Metric misuse: type/label-set mismatch or invalid sample."""
+
+
+class _Metric:
+    typ = None
+
+    def __init__(self, name, help="", labelnames=()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._children = {}  # label-value tuple -> child cell
+        self._mu = threading.Lock()
+
+    def _key(self, labels):
+        if set(labels) != set(self.labelnames):
+            raise MetricError(
+                "%s %r expects labels %s, got %s"
+                % (self.typ, self.name, sorted(self.labelnames), sorted(labels)))
+        return tuple(str(labels[k]) for k in self.labelnames)
+
+    def _child(self, labels):
+        key = self._key(labels)
+        with self._mu:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = self._new_child()
+            return child
+
+    def _items(self):
+        with self._mu:
+            return list(self._children.items())
+
+    def samples(self):
+        """→ list of {"labels": {...}, ...} sample dicts (one per label set)."""
+        out = []
+        for key, child in sorted(self._items()):
+            labels = dict(zip(self.labelnames, key))
+            out.append(self._sample(labels, child))
+        return out
+
+    def snapshot(self):
+        return {"name": self.name, "type": self.typ, "help": self.help,
+                "samples": self.samples()}
+
+
+class Counter(_Metric):
+    """Monotonic accumulator (samples/s numerators, bytes moved, compiles)."""
+
+    typ = "counter"
+
+    def _new_child(self):
+        return _AtomicValue(0.0)
+
+    def inc(self, amount=1.0, **labels):
+        if amount < 0:
+            raise MetricError("counter %r cannot decrease (got %r)"
+                              % (self.name, amount))
+        return self._child(labels).add(amount)
+
+    def value(self, **labels):
+        return self._child(labels).get()
+
+    def _sample(self, labels, child):
+        return {"labels": labels, "value": child.get()}
+
+
+class Gauge(_Metric):
+    """Point-in-time value (bytes_in_use, last loss, samples/s)."""
+
+    typ = "gauge"
+
+    def _new_child(self):
+        return _AtomicValue(0.0)
+
+    def set(self, value, **labels):
+        return self._child(labels).set(float(value))
+
+    def inc(self, amount=1.0, **labels):
+        return self._child(labels).add(amount)
+
+    def dec(self, amount=1.0, **labels):
+        return self._child(labels).add(-amount)
+
+    def value(self, **labels):
+        return self._child(labels).get()
+
+    def _sample(self, labels, child):
+        return {"labels": labels, "value": child.get()}
+
+
+class _HistogramCell:
+    __slots__ = ("_mu", "buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets):
+        self._mu = threading.Lock()
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)  # +1: the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value):
+        value = float(value)
+        i = 0
+        for i, le in enumerate(self.buckets):
+            if value <= le:
+                break
+        else:
+            i = len(self.buckets)
+        with self._mu:
+            self.counts[i] += 1
+            self.sum += value
+            self.count += 1
+
+    def snapshot(self):
+        with self._mu:
+            return {"counts": list(self.counts), "sum": self.sum,
+                    "count": self.count}
+
+
+class Histogram(_Metric):
+    """Bucketed distribution (step seconds, data-wait seconds)."""
+
+    typ = "histogram"
+
+    def __init__(self, name, help="", labelnames=(), buckets=None):
+        super().__init__(name, help, labelnames)
+        self.buckets = tuple(sorted(buckets if buckets is not None
+                                    else DEFAULT_BUCKETS))
+
+    def _new_child(self):
+        return _HistogramCell(self.buckets)
+
+    def observe(self, value, **labels):
+        self._child(labels).observe(value)
+
+    def value(self, **labels):
+        return self._child(labels).snapshot()
+
+    def _sample(self, labels, child):
+        snap = child.snapshot()
+        cum, edges = 0, []
+        for le, n in zip(self.buckets, snap["counts"]):
+            cum += n
+            edges.append([le, cum])
+        edges.append(["+Inf", snap["count"]])
+        return {"labels": labels, "count": snap["count"], "sum": snap["sum"],
+                "buckets": edges}
+
+
+_TYPES = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class Registry:
+    """Named metrics + attached sinks.
+
+    ``counter``/``gauge``/``histogram`` are get-or-create (idempotent across
+    call sites); asking for an existing name with a different type or label
+    set raises ``MetricError`` instead of silently splitting the series.
+    """
+
+    def __init__(self):
+        self._metrics = {}
+        self._mu = threading.Lock()
+        self._sinks = []
+
+    # -- metric accessors ---------------------------------------------------
+    def _get_or_create(self, cls, name, help, labelnames, **kw):
+        with self._mu:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help, labelnames, **kw)
+                return m
+        if not isinstance(m, cls) or m.labelnames != tuple(labelnames):
+            raise MetricError(
+                "metric %r already registered as %s%s; requested %s%s"
+                % (name, m.typ, m.labelnames, cls.typ, tuple(labelnames)))
+        return m
+
+    def counter(self, name, help="", labelnames=()):
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name, help="", labelnames=()):
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(self, name, help="", labelnames=(), buckets=None):
+        h = self._get_or_create(Histogram, name, help, labelnames,
+                                buckets=buckets)
+        if buckets is not None and h.buckets != tuple(sorted(buckets)):
+            raise MetricError(
+                "histogram %r already registered with buckets %s; requested %s"
+                % (name, h.buckets, tuple(sorted(buckets))))
+        return h
+
+    def get(self, name):
+        with self._mu:
+            return self._metrics.get(name)
+
+    # -- aggregate reads (bench summary / Speedometer) ----------------------
+    def total(self, name, default=0.0):
+        """Sum of a counter/gauge across all label sets (0 if absent)."""
+        m = self.get(name)
+        if m is None or m.typ == "histogram":
+            return default
+        return sum(s["value"] for s in m.samples()) or default
+
+    def max_value(self, name, default=None):
+        m = self.get(name)
+        if m is None or m.typ == "histogram":
+            return default
+        vals = [s["value"] for s in m.samples()]
+        return max(vals) if vals else default
+
+    def hist_sum(self, name, default=0.0):
+        m = self.get(name)
+        if m is None or m.typ != "histogram":
+            return default
+        return sum(s["sum"] for s in m.samples()) or default
+
+    # -- sinks / events -----------------------------------------------------
+    def add_sink(self, sink):
+        with self._mu:
+            self._sinks.append(sink)
+        return sink
+
+    def remove_sink(self, sink):
+        with self._mu:
+            if sink in self._sinks:
+                self._sinks.remove(sink)
+
+    def sinks(self):
+        with self._mu:
+            return list(self._sinks)
+
+    def event(self, kind, **fields):
+        """Append one timestamped event to every sink's stream (JSONL line)."""
+        ev = {"ts": round(time.time(), 6), "kind": kind}
+        ev.update(fields)
+        for sink in self.sinks():
+            sink.emit(ev)
+        return ev
+
+    def collect(self):
+        """→ list of metric snapshot dicts (the JSONL "metrics" schema)."""
+        with self._mu:
+            metrics = list(self._metrics.values())
+        return [m.snapshot() for m in metrics]
+
+    def flush(self):
+        """Write a metrics snapshot through every sink and flush them."""
+        snap = self.collect()
+        for sink in self.sinks():
+            sink.write_snapshot(snap)
+            sink.flush()
+        return snap
+
+    def close(self):
+        for sink in self.sinks():
+            sink.close()

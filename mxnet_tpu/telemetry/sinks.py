@@ -1,0 +1,251 @@
+"""Pluggable telemetry sinks.
+
+Every sink implements the same four methods; the registry fans out to all
+attached sinks.  Shipped sinks:
+
+- ``JsonlSink`` — append-only JSONL event log (``MXNET_TELEMETRY_FILE``);
+  the machine-readable schema ``tools/trace_summary.py`` and the bench
+  harness consume (docs/OBSERVABILITY.md documents it).
+- ``PrometheusSink`` — text exposition format
+  (https://prometheus.io/docs/instrumenting/exposition_formats/) written
+  atomically to a file for a node-exporter-style textfile collector.
+- ``ProfilerSink`` — bridges counter/gauge samples into
+  ``mxnet_tpu.profiler`` Counter objects, so telemetry lands as "C" series
+  in the same chrome://tracing dump as user annotations.
+- ``TensorBoardSink`` — scalars via the same SummaryWriter providers
+  ``contrib/tensorboard.py`` uses.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+__all__ = ["Sink", "JsonlSink", "PrometheusSink", "ProfilerSink",
+           "TensorBoardSink", "render_prometheus", "iter_scalar_samples"]
+
+
+def iter_scalar_samples(snapshot):
+    """Flatten a metrics snapshot to ``(key, value)`` scalars: key is
+    ``name`` or ``name{k=v,...}`` with sorted labels; histograms degrade to
+    their running sum.  Shared by the profiler and TensorBoard bridges so
+    both views render the same series the same way."""
+    for m in snapshot:
+        for s in m["samples"]:
+            labels = ",".join("%s=%s" % kv for kv in sorted(s["labels"].items()))
+            key = m["name"] if not labels else "%s{%s}" % (m["name"], labels)
+            yield key, (s["sum"] if m["type"] == "histogram" else s["value"])
+
+
+class Sink:
+    """Interface; methods are no-ops so subclasses override what they need."""
+
+    def emit(self, event):
+        """One timestamped event dict from ``Registry.event``."""
+
+    def write_snapshot(self, snapshot):
+        """Full metrics snapshot (list of metric dicts) from ``flush``."""
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
+def _json_default(obj):
+    # numpy scalars etc.: anything with .item() degrades to a python number
+    item = getattr(obj, "item", None)
+    if callable(item):
+        return item()
+    return str(obj)
+
+
+class JsonlSink(Sink):
+    """One JSON object per line; events as-is, snapshots as kind="metrics".
+
+    Write failures (unwritable path, disk full mid-run) must never kill the
+    training step they instrument: the first OSError is logged once and the
+    sink disables itself."""
+
+    def __init__(self, path):
+        self.path = path
+        self._mu = threading.Lock()
+        self._f = None
+        self._broken = False
+
+    def _file(self):
+        if self._f is None:
+            d = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(d, exist_ok=True)
+            self._f = open(self.path, "a", encoding="utf-8")
+        return self._f
+
+    def _write(self, obj):
+        line = json.dumps(obj, default=_json_default)
+        with self._mu:
+            if self._broken:
+                return
+            try:
+                self._file().write(line + "\n")
+            except OSError as e:
+                self._broken = True
+                import logging
+
+                logging.warning(
+                    "telemetry: cannot write %s (%s) — JSONL sink disabled",
+                    self.path, e)
+
+    def emit(self, event):
+        self._write(event)
+
+    def write_snapshot(self, snapshot):
+        import time
+
+        self._write({"ts": round(time.time(), 6), "kind": "metrics",
+                     "metrics": snapshot})
+
+    def flush(self):
+        with self._mu:
+            if self._f is not None and not self._broken:
+                try:
+                    self._f.flush()
+                except OSError:
+                    self._broken = True
+
+    def close(self):
+        with self._mu:
+            if self._f is not None:
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None
+
+
+def _prom_escape(value):
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _prom_labels(labels, extra=()):
+    pairs = [(k, v) for k, v in sorted(labels.items())] + list(extra)
+    if not pairs:
+        return ""
+    return "{%s}" % ",".join('%s="%s"' % (k, _prom_escape(v))
+                             for k, v in pairs)
+
+
+def _prom_num(v):
+    if v == float("inf"):
+        return "+Inf"
+    return repr(float(v)) if isinstance(v, float) else str(v)
+
+
+def render_prometheus(snapshot):
+    """Metrics snapshot → Prometheus text exposition (version 0.0.4)."""
+    lines = []
+    for m in snapshot:
+        name = m["name"]
+        if m.get("help"):
+            lines.append("# HELP %s %s" % (name, _prom_escape(m["help"])))
+        lines.append("# TYPE %s %s" % (name, m["type"]))
+        for s in m["samples"]:
+            if m["type"] == "histogram":
+                for le, cum in s["buckets"]:
+                    lines.append("%s_bucket%s %s" % (
+                        name, _prom_labels(s["labels"], [("le", le)]), cum))
+                lines.append("%s_sum%s %s" % (name, _prom_labels(s["labels"]),
+                                              _prom_num(s["sum"])))
+                lines.append("%s_count%s %s" % (
+                    name, _prom_labels(s["labels"]), s["count"]))
+            else:
+                lines.append("%s%s %s" % (name, _prom_labels(s["labels"]),
+                                          _prom_num(s["value"])))
+    return "\n".join(lines) + "\n"
+
+
+class PrometheusSink(Sink):
+    """Atomic whole-file exposition rewrite per snapshot (textfile-collector
+    contract: readers never observe a half-written scrape).  Same failure
+    contract as JsonlSink: a write error warns once and disables the sink
+    rather than aborting the run it instruments."""
+
+    def __init__(self, path):
+        self.path = path
+        self._broken = False
+
+    def write_snapshot(self, snapshot):
+        if self._broken:
+            return
+        try:
+            tmp = self.path + ".tmp"
+            d = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(d, exist_ok=True)
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(render_prometheus(snapshot))
+            os.replace(tmp, self.path)
+        except OSError as e:
+            self._broken = True
+            import logging
+
+            logging.warning(
+                "telemetry: cannot write %s (%s) — Prometheus sink disabled",
+                self.path, e)
+
+
+class ProfilerSink(Sink):
+    """Mirror counter/gauge samples into ``mx.profiler`` Counters (one
+    "telemetry" Domain) so chrome-trace dumps carry the series alongside
+    user annotations.  Histograms are mirrored as their running sum."""
+
+    def __init__(self):
+        self._counters = {}
+        self._domain = None
+
+    def _counter(self, key):
+        c = self._counters.get(key)
+        if c is None:
+            from .. import profiler
+
+            if self._domain is None:
+                self._domain = profiler.Domain("telemetry")
+            c = self._counters[key] = profiler.Counter(self._domain, key)
+        return c
+
+    def write_snapshot(self, snapshot):
+        for key, value in iter_scalar_samples(snapshot):
+            self._counter(key).set_value(value)
+
+
+class TensorBoardSink(Sink):
+    """Scalars via a SummaryWriter (same provider probing as
+    ``contrib/tensorboard.py``); ``global_step`` advances per snapshot."""
+
+    def __init__(self, logging_dir=None, writer=None):
+        if writer is None:
+            try:
+                from tensorboard import SummaryWriter  # 2018-era layout
+            except ImportError:
+                try:
+                    from torch.utils.tensorboard import SummaryWriter
+                except ImportError:
+                    raise ImportError(
+                        "TensorBoardSink requires a SummaryWriter provider "
+                        "(`tensorboard` or `torch.utils.tensorboard`), or "
+                        "pass writer= explicitly.")
+            writer = SummaryWriter(logging_dir)
+        self.writer = writer
+        self.step = 0
+
+    def write_snapshot(self, snapshot):
+        self.step += 1
+        for key, value in iter_scalar_samples(snapshot):
+            # "name{k=v}" -> "name/k=v": slashes group series in the TB UI
+            tag = key.replace("{", "/").rstrip("}")
+            self.writer.add_scalar(tag, value, self.step)
+
+    def flush(self):
+        fl = getattr(self.writer, "flush", None)
+        if callable(fl):
+            fl()
