@@ -20,6 +20,15 @@ import sys
 TOP_KEYS = {"metric", "value", "unit", "vs_baseline", "telemetry"}
 TEL_KEYS = {"compile_s", "peak_hbm_bytes", "data_wait_frac"}
 
+# SERVE_BENCH line (tools/loadgen.py, ISSUE 2) — docs/SERVING.md schema
+SERVE_PREFIX = "SERVE_BENCH "
+SERVE_REQ_KEYS = {"mode", "requests", "completed", "shed", "timeouts",
+                  "errors", "shed_rate", "duration_s", "throughput_rps",
+                  "latency_ms_p50", "latency_ms_p99", "compiles"}
+SERVE_OPT_KEYS = {"concurrency", "rate_rps", "batch_fill_mean",
+                  "padding_waste_mean"}
+SERVE_MODES = {"closed", "open"}
+
 
 class SchemaError(ValueError):
     pass
@@ -77,6 +86,47 @@ def validate_line(obj, where="<line>"):
                 % where)
 
 
+def validate_serve_line(obj, where="<line>"):
+    """Validate one SERVE_BENCH JSON dict; raises SchemaError."""
+    if not isinstance(obj, dict):
+        raise SchemaError("%s: SERVE_BENCH must be a JSON object, got %s"
+                          % (where, type(obj).__name__))
+    unknown = set(obj) - SERVE_REQ_KEYS - SERVE_OPT_KEYS
+    if unknown:
+        raise SchemaError("%s: unknown SERVE_BENCH keys %s (schema: %s + "
+                          "optional %s)" % (where, sorted(unknown),
+                                            sorted(SERVE_REQ_KEYS),
+                                            sorted(SERVE_OPT_KEYS)))
+    missing = SERVE_REQ_KEYS - set(obj)
+    if missing:
+        raise SchemaError("%s: SERVE_BENCH missing required keys %s"
+                          % (where, sorted(missing)))
+    if obj["mode"] not in SERVE_MODES:
+        raise SchemaError("%s: mode must be one of %s, got %r"
+                          % (where, sorted(SERVE_MODES), obj["mode"]))
+    for k in ("requests", "completed", "shed", "timeouts", "errors",
+              "compiles"):
+        if not isinstance(obj[k], int) or isinstance(obj[k], bool) \
+                or obj[k] < 0:
+            raise SchemaError("%s: %r must be a non-negative int, got %r"
+                              % (where, k, obj[k]))
+    for k in ("shed_rate", "duration_s", "throughput_rps",
+              "latency_ms_p50", "latency_ms_p99"):
+        if not _num(obj[k]) or obj[k] < 0:
+            raise SchemaError("%s: %r must be a non-negative number, got %r"
+                              % (where, k, obj[k]))
+    if obj["shed_rate"] > 1:
+        raise SchemaError("%s: shed_rate must be in [0, 1]" % where)
+    if obj["latency_ms_p99"] < obj["latency_ms_p50"]:
+        raise SchemaError("%s: p99 latency below p50 — percentiles swapped?"
+                          % where)
+    if obj["completed"] > obj["requests"]:
+        raise SchemaError("%s: completed > requests" % where)
+    for k in ("batch_fill_mean", "padding_waste_mean"):
+        if k in obj and (not _num(obj[k]) or not 0 <= obj[k] <= 1):
+            raise SchemaError("%s: %r must be a number in [0, 1]" % (where, k))
+
+
 def validate_capture(path):
     """Validate a BENCH_r*.json driver capture (or a raw bench line file)."""
     with open(path, encoding="utf-8") as f:
@@ -116,14 +166,39 @@ def self_test():
          "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
                        "data_wait_frac": 1.7}},              # frac range
     ]
+    serve_good = {"mode": "closed", "requests": 10, "completed": 9,
+                  "shed": 1, "timeouts": 0, "errors": 0, "shed_rate": 0.1,
+                  "duration_s": 1.5, "throughput_rps": 6.0,
+                  "latency_ms_p50": 2.0, "latency_ms_p99": 9.5,
+                  "compiles": 3, "concurrency": 4}
+    serve_bad = [
+        {},
+        dict(serve_good, mode="sideways"),           # unknown mode
+        dict(serve_good, shed_rate=1.2),             # rate out of range
+        dict(serve_good, compiles=1.5),              # non-int counter
+        dict(serve_good, latency_ms_p99=1.0),        # p99 < p50
+        dict(serve_good, completed=11),              # completed > requests
+        dict(serve_good, extra=1),                   # unknown key
+        {k: v for k, v in serve_good.items() if k != "throughput_rps"},
+    ]
     for obj in good:
         validate_line(obj, "self-test good")
+    validate_serve_line(serve_good, "self-test serve good")
+    validate_serve_line(dict(serve_good, mode="open", rate_rps=200.0,
+                             batch_fill_mean=0.8), "self-test serve good2")
     for i, obj in enumerate(bad):
         try:
             validate_line(obj, "self-test bad[%d]" % i)
         except SchemaError:
             continue
         raise AssertionError("self-test: bad line %d passed: %r" % (i, obj))
+    for i, obj in enumerate(serve_bad):
+        try:
+            validate_serve_line(obj, "self-test serve bad[%d]" % i)
+        except SchemaError:
+            continue
+        raise AssertionError(
+            "self-test: bad SERVE_BENCH line %d passed: %r" % (i, obj))
 
 
 def main(argv):
@@ -138,7 +213,11 @@ def main(argv):
             if path == "-":
                 for n, line in enumerate(sys.stdin, 1):
                     line = line.strip()
-                    if line.startswith("{"):
+                    if line.startswith(SERVE_PREFIX):
+                        validate_serve_line(
+                            json.loads(line[len(SERVE_PREFIX):]),
+                            "<stdin>:%d" % n)
+                    elif line.startswith("{"):
                         validate_line(json.loads(line), "<stdin>:%d" % n)
             else:
                 validate_capture(path)

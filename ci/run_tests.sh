@@ -41,10 +41,16 @@ case "$tier" in
     # bench-line schema lint (ISSUE 1): BENCH_r*.json and the telemetry
     # block must stay machine-parseable for the driver
     python ci/check_bench_schema.py --self-test BENCH_r*.json
+    # serving smoke (ISSUE 2): tiny-symbol engine on CPU, closed+open load,
+    # SERVE_BENCH lines must parse and pass the schema lint
+    ./dev.sh python tools/loadgen.py --smoke \
+      | python ci/check_bench_schema.py -
     # telemetry unit tests (tests/test_telemetry.py) run as part of tests/
     ignore=()
     for f in "${NIGHTLY_FILES[@]}"; do ignore+=(--ignore "$f"); done
-    exec ./dev.sh python -m pytest tests/ -q "${ignore[@]}"
+    # -m 'not slow': the loadgen smoke above already covers the slow
+    # subprocess serving test end-to-end
+    exec ./dev.sh python -m pytest tests/ -q -m 'not slow' "${ignore[@]}"
     ;;
   nightly)
     exec ./dev.sh python -m pytest "${NIGHTLY_FILES[@]}" -q

@@ -61,6 +61,7 @@ def __getattr__(name):
         "engine": ".engine",
         "rtc": ".rtc",
         "predictor": ".predictor",
+        "serving": ".serving",
         "th": ".torch_bridge",
         "torch_bridge": ".torch_bridge",
     }

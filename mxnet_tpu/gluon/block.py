@@ -402,11 +402,17 @@ class HybridBlock(Block):
             grouped, _ = _regroup(inputs, self._in_format)
             if not isinstance(grouped, tuple):
                 grouped = (grouped,)
+            # save/restore (not clobber) the ambient counts: a reentrant
+            # capture — block A's hybrid_forward triggering B._get_graph
+            # (e.g. an infer_shape inside the body) — must hand A's capture
+            # back its outer per-call ordinals, or A's later shared-block
+            # invocations would restart at call0 and collide (ADVICE round 5)
+            prev_counts = getattr(_SYM_CAPTURE, "counts", None)
             _SYM_CAPTURE.counts = {}
             try:
                 out = self._symbolic_forward(sym_mod, *grouped)
             finally:
-                _SYM_CAPTURE.counts = None
+                _SYM_CAPTURE.counts = prev_counts
             flat_out, self._out_format = _flatten(out)
             self._cached_graph = inputs, sym_mod.Group(flat_out) if len(flat_out) > 1 else flat_out[0]
         return self._cached_graph

@@ -68,10 +68,13 @@ def _check_grouped_layout(batch_idx, B, Rb, op):
         idx = np.asarray(batch_idx).reshape(B, Rb)
     except Exception:  # tracer or abstract value — nothing to check
         return
-    if (idx == idx.reshape(-1)[0]).all():
-        # constant column (e.g. left at 0): the caller grouped positionally
-        # and never filled batch_idx — consistent with the documented
-        # "column is ignored" contract, no evidence of misuse
+    if (idx == 0).all():
+        # all-zeros column: the caller grouped positionally and never
+        # filled batch_idx — consistent with the documented "column is
+        # ignored" contract, no evidence of misuse.  Only the ZERO constant
+        # is exempt: a constant NONZERO column carries real indices (every
+        # roi claims image k) and must agree with r // Rb like any other
+        # filled column (ADVICE round 5)
         return
     expect = np.broadcast_to(np.arange(B)[:, None], (B, Rb))
     if not np.array_equal(idx, expect):
@@ -787,8 +790,17 @@ def deformable_convolution(
                 tpu=lambda: pallas_col(False),
                 default=lambda: pallas_col(True))
         else:
-            col = jax.lax.platform_dependent(tpu=lambda: pallas_col(False),
-                                             default=xla_col)
+            # auto: fused kernel on TPU only when its backward working set
+            # fits VMEM — above the limit (large feature maps) Mosaic would
+            # hard-fail the kernel build, so fall back to the XLA scan
+            # (ADVICE round 5; pallas_kernels.dconv_bwd_vmem_bytes)
+            from .pallas_kernels import dconv_fits_vmem
+
+            if dconv_fits_vmem(H * W, cpg, jnp.dtype(f32).itemsize):
+                col = jax.lax.platform_dependent(
+                    tpu=lambda: pallas_col(False), default=xla_col)
+            else:
+                col = xla_col()
         col = (col.reshape(B, DG, K2, Ho * Wo, cpg)
                .transpose(0, 1, 4, 2, 3).reshape(B, C, K2, Ho, Wo))
     else:

@@ -604,6 +604,42 @@ psroi_abuild_pallas.defvjp(_abuild_fwd, _abuild_bwd)
 
 _DCONV_NBLK = 128
 
+# Mosaic hard-fails when one grid step's working set exceeds VMEM.  The
+# estimate below intentionally OVERCOUNTS (it sums all six factor planes
+# as if simultaneously resident; Mosaic fuses several), so the limit is
+# calibrated against measured shapes rather than the 16 MiB hardware
+# figure: north-star res5 (HW=2432, cpg=512) scores 15.8 MB bf16 /
+# 18.3 MB f32 and compiles+runs (round-5 PERF_NOTES), while conv4-scale
+# maps (HW~9728) score 35+ MB and hard-fail.  24 MB splits them with
+# margin on both sides.
+_DCONV_VMEM_LIMIT = 24 << 20
+
+
+def dconv_bwd_vmem_bytes(HW, C, itemsize, nblk=_DCONV_NBLK):
+    """Estimated per-grid-step VMEM working set of the dconv BACKWARD kernel
+    (the larger of the two passes): dA + the six one-hot/lerp factor planes
+    (f32, (nblk, HW) each), the ft block and the f32 dft accumulator
+    ((HW, C)), and the g block ((nblk, C)).  Drives the auto-branch guard in
+    ``detection.py deformable_convolution`` — above ``_DCONV_VMEM_LIMIT``
+    (override: MXNET_DCONV_VMEM_MB) large feature maps fall back to the XLA
+    scan instead of hard-failing Mosaic compilation (ADVICE round 5)."""
+    return (7 * 4 * nblk * HW          # dA + 6 factor planes, f32
+            + HW * C * (itemsize + 4)  # ft block + f32 dft accumulator
+            + nblk * C * (itemsize + 4))  # g block + col block
+
+
+def dconv_fits_vmem(HW, C, itemsize):
+    """True when the fused dconv kernel's estimated footprint fits VMEM."""
+    import os
+
+    try:
+        limit = int(float(os.environ.get("MXNET_DCONV_VMEM_MB", 0)) * (1 << 20))
+    except ValueError:
+        limit = 0
+    if limit <= 0:
+        limit = _DCONV_VMEM_LIMIT
+    return dconv_bwd_vmem_bytes(HW, C, itemsize) <= limit
+
 
 def _dconv_factors(y0, y1, x0, x1, ly, lx, H, W):
     """One-hot lerp factor planes over the flat p = h*W + w lane axis —

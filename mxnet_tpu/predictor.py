@@ -75,10 +75,20 @@ class Predictor:
                         % (name, avail[:8]))
                 picked.append(internals[avail.index(cand)])
             symbol = sym_mod.Group(picked) if len(picked) > 1 else picked[0]
+        arg_params, aux_params = self._load_params(params)
+        self._init_bound(symbol, dtype, ctx, arg_params, aux_params,
+                         input_shapes)
+
+    def _init_bound(self, symbol, dtype, ctx, arg_params, aux_params,
+                    input_shapes):
+        """Shared init tail for ``__init__`` and ``with_shapes`` — one
+        place that knows every field a bound Predictor carries, so clones
+        can never silently miss a later-added attribute."""
         self._symbol = symbol
         self._dtype = dtype
         self._ctx = ctx
-        self._arg_params, self._aux_params = self._load_params(params)
+        self._arg_params = arg_params
+        self._aux_params = aux_params
         self._input_names = list(input_shapes.keys())
         self._build(dict(input_shapes))
 
@@ -147,6 +157,20 @@ class Predictor:
         if self._outputs is None:
             self.forward()
         return self._outputs[index].asnumpy()
+
+    def with_shapes(self, input_shapes):
+        """A sibling Predictor specialized to ``input_shapes``, sharing this
+        one's symbol and loaded params — the cheap path for holding MANY
+        shape specializations of one checkpoint alive at once (the serving
+        engine's per-bucket predictors).  Unlike ``reshape`` this does not
+        disturb ``self``; unlike re-calling ``Predictor(...)`` it re-parses
+        nothing, and weight device buffers are shared wherever the deploy
+        dtype matches the stored dtype (``NDArray._rebind`` keeps the same
+        jax array), so N buckets cost ~1x the weights in HBM."""
+        clone = object.__new__(Predictor)
+        clone._init_bound(self._symbol, self._dtype, self._ctx,
+                          self._arg_params, self._aux_params, input_shapes)
+        return clone
 
     def reshape(self, input_shapes):
         """Re-specialize to new input shapes (``MXPredReshape``) — a new jit

@@ -1,0 +1,31 @@
+"""mxnet_tpu.serving — online inference over Predictor/Symbol (ISSUE 2).
+
+The deployment story past a single-request ``Predictor``: concurrent
+requests are collected by a dynamic micro-batcher into padded **shape
+buckets** (finite ladder -> finite XLA compile set, precompilable via
+``warmup``), an **admission controller** bounds the queue and sheds
+overload with 503-style errors, and ONE device-loop thread owns all XLA
+execution.  Queue health (latency, fill, padding waste, sheds, compiles)
+flows through ``mxnet_tpu.telemetry`` when ``MXNET_TELEMETRY`` is on.
+
+    from mxnet_tpu import serving
+    eng = serving.Engine(symbol, params, sample_shapes={"data": (8,)},
+                         ladder=serving.BucketLadder((1, 2, 4, 8)))
+    eng.warmup()
+    out = eng.predict({"data": x})        # x: (n, 8)
+
+Load-test with ``tools/loadgen.py``; docs/SERVING.md has the architecture,
+tuning guide, and the SERVE_BENCH schema.
+"""
+from .admission import (AdmissionController, EngineClosed, RequestCancelled,
+                        RequestTimeout, ServerBusy, ServingError)
+from .batcher import MicroBatcher, Request
+from .bucketing import Bucket, BucketLadder, pow2_ladder
+from .engine import Engine
+from .warmup import warmup_engine
+
+__all__ = [
+    "AdmissionController", "Bucket", "BucketLadder", "Engine", "EngineClosed",
+    "MicroBatcher", "Request", "RequestCancelled", "RequestTimeout",
+    "ServerBusy", "ServingError", "pow2_ladder", "warmup_engine",
+]

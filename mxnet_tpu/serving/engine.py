@@ -1,0 +1,484 @@
+"""The online inference Engine — queue -> micro-batcher -> device loop.
+
+Layering (docs/SERVING.md has the picture):
+
+* ``submit()`` (any thread) validates the request, stamps its deadline,
+  and enqueues through the admission gate — overload is shed HERE with
+  ``ServerBusy``, never buffered into unbounded latency;
+* ONE device-loop thread pulls shape-bucketed batches from the
+  ``MicroBatcher``, so XLA execution is never contended (the same
+  single-writer rule the training stack gets from XLA async dispatch —
+  docs/ARCHITECTURE.md); model failures fail that batch's requests and the
+  loop keeps serving;
+* a **compiled-signature cache** maps each ladder bucket to a ``Predictor``
+  specialized via ``Predictor.with_shapes`` (weights are shared device
+  buffers, not copies) — the whole traffic mix compiles exactly
+  ``len(ladder.signatures())`` times, and ``warmup()`` takes those compiles
+  at startup instead of on the first unlucky request;
+* telemetry (``telemetry.serve_probe``) records queue latency, batch fill,
+  padding waste, in-flight/depth gauges, shed/timeout counters and the
+  serve compile counter — all zero-overhead when ``MXNET_TELEMETRY`` is off
+  (the probe is None and every hook is a single ``if``).
+
+Defaults come from ``MXNET_SERVE_*`` (docs/ENV_VARS.md).
+"""
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+
+import numpy as np
+
+from ..predictor import Predictor
+from .admission import AdmissionController, EngineClosed, ServerBusy
+from .batcher import MicroBatcher, Request
+from .bucketing import BucketLadder, _volume
+
+__all__ = ["Engine"]
+
+# Direct-dispatch (oversize) signatures are client-controlled, so their
+# cache must be bounded or a shape-varying stream grows executables without
+# limit; ladder signatures are finite by construction and stay pinned.
+_DIRECT_CACHE_MAX = 8
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return float(default)
+
+
+def _env_ladder():
+    """MXNET_SERVE_BATCH_LADDER, never-crash: any malformed value — non-
+    numeric, zero/negative rungs, empty — falls back to the default."""
+    raw = os.environ.get("MXNET_SERVE_BATCH_LADDER", "1,2,4,8")
+    try:
+        sizes = tuple(int(x) for x in raw.replace(" ", "").split(",") if x)
+    except ValueError:
+        sizes = ()
+    sizes = tuple(s for s in sizes if s > 0)
+    return sizes or (1, 2, 4, 8)
+
+
+class Engine:
+    """Serve a (symbol, params) checkpoint to concurrent callers.
+
+    Parameters
+    ----------
+    symbol, params : as ``Predictor`` (Symbol/json-path, dict/params-path).
+    sample_shapes : dict
+        name -> PER-SAMPLE shape (no batch dim).  Request arrays always
+        carry a leading sample-count dim: ``submit({"data": x})`` with
+        ``x.shape == (n,) + sample_shapes["data"]``.
+    ladder : BucketLadder, optional
+        Defaults to ``BucketLadder(MXNET_SERVE_BATCH_LADDER)`` — batch
+        bucketing only.  Pass ``shape_buckets`` in your own ladder for
+        spatial bucketing (variable-size images etc.; trailing dims are
+        zero-padded up to the bucket, batch rows are sliced back out).
+    max_wait_ms / max_queue / timeout_ms :
+        Partial-batch flush deadline, admission queue bound, default
+        per-request deadline (0 = none).  Env defaults: MXNET_SERVE_*.
+    max_direct_batch : int
+        Sample-count cap for direct-dispatch (oversize) requests, default
+        4x the top bucket.  The device loop is single-threaded, so one
+        arbitrarily large client request would stall every other caller
+        behind its compile + execution — beyond the cap submit() raises
+        ValueError and the client must chunk.
+    start : bool
+        Start the device loop immediately (default).  ``start=False`` lets
+        tests and warmup-first deployments queue/compile before serving.
+    """
+
+    def __init__(self, symbol, params, sample_shapes, ladder=None,
+                 max_wait_ms=None, max_queue=None, timeout_ms=None,
+                 dtype="float32", ctx=None, output_names=None, name="serve",
+                 start=True, max_direct_batch=None):
+        from .. import telemetry
+
+        self.name = name
+        self.sample_shapes = {str(k): tuple(int(d) for d in v)
+                              for k, v in sample_shapes.items()}
+        self.ladder = ladder if ladder is not None else BucketLadder(
+            _env_ladder())
+        if max_wait_ms is None:
+            max_wait_ms = _env_float("MXNET_SERVE_MAX_WAIT_MS", 5.0)
+        if max_queue is None:
+            max_queue = int(_env_float("MXNET_SERVE_MAX_QUEUE", 256))
+        if timeout_ms is None:
+            timeout_ms = _env_float("MXNET_SERVE_TIMEOUT_MS", 0.0)
+        self.max_direct_batch = (int(max_direct_batch)
+                                 if max_direct_batch is not None
+                                 else 4 * self.ladder.max_batch)
+        self.admission = AdmissionController(
+            max_queue=max_queue,
+            default_timeout_s=timeout_ms / 1000.0 if timeout_ms > 0 else None)
+        self._batcher = MicroBatcher(self.ladder, max_wait_s=max_wait_ms / 1000.0,
+                                     on_drop=self._on_drop)
+        # proto predictor: loads/parses symbol+params ONCE; every bucket
+        # specializes off it via with_shapes (shared weight buffers).  It is
+        # seeded into the cache as its own bucket's entry — compile
+        # accounting is by the separate _compiled set (first forward), so
+        # seeding doesn't hide that bucket's one compile.
+        proto_bucket = self.ladder.signatures(self.sample_shapes)[0]
+        self._proto = Predictor(symbol, params, proto_bucket.input_shapes(),
+                                ctx=ctx, output_names=output_names,
+                                dtype=dtype)
+        self._cache = {proto_bucket.key: self._proto}  # ladder sigs, pinned
+        self._direct_cache = collections.OrderedDict()  # one-offs, LRU
+        self._compiled = set()      # signatures past their first forward
+        self._cache_mu = threading.Lock()
+        self._device_mu = threading.Lock()  # device loop + warmup exclusion
+        self._stats_mu = threading.Lock()
+        # "shed" lives on the AdmissionController (stats() merges it in)
+        self._stats = {"submitted": 0, "completed": 0, "failed": 0,
+                       "timeouts": 0, "cancelled": 0,
+                       "direct": 0, "batches": 0, "compiles": 0,
+                       "cache_hits": 0, "in_flight": 0}
+        self._bucket_counts = {}
+        self._probe = telemetry.serve_probe(name)
+        self._thread = None
+        self._closed = False
+        if start:
+            self.start()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        """Start (or restart after ``start=False``) the device loop."""
+        if self._closed:
+            raise EngineClosed("engine is closed")
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, name="mxnet-serve-%s" % self.name,
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def close(self):
+        """Drain-free shutdown: pending requests fail with EngineClosed,
+        the device loop exits after its current batch."""
+        self._closed = True
+        self._batcher.close()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- request path --------------------------------------------------------
+    def submit(self, inputs, timeout=None):
+        """Enqueue one request; returns a future-like ``Request``.
+
+        ``inputs``: dict name -> array with leading sample-count dim n>=1.
+        ``timeout``: seconds until the request is dropped if still queued
+        (overrides the engine default).  Raises ``ServerBusy`` when the
+        queue is at capacity, ``EngineClosed`` after ``close()``.
+        """
+        arrays, n, bucket_shapes, direct = self._classify(inputs)
+        req = Request(arrays, n, bucket_shapes,
+                      deadline=self.admission.deadline(timeout), direct=direct)
+        # stamp stats BEFORE enqueueing (rolled back on rejection): once the
+        # request is in the queue the device loop may complete it instantly,
+        # and decrement-before-increment would publish in_flight = -1
+        with self._stats_mu:
+            self._stats["submitted"] += 1
+            self._stats["in_flight"] += 1
+            if direct:
+                self._stats["direct"] += 1
+        try:
+            self._batcher.put(req, admit=self.admission.check)
+        except Exception as e:
+            with self._stats_mu:
+                self._stats["submitted"] -= 1
+                self._stats["in_flight"] -= 1
+                if direct:
+                    self._stats["direct"] -= 1
+            if self._probe and isinstance(e, ServerBusy):
+                self._probe.record_drop("shed")
+            raise
+        if self._probe:
+            with self._stats_mu:
+                in_flight = self._stats["in_flight"]
+            self._probe.record_submit(self._batcher.depth(), in_flight)
+        return req
+
+    def predict(self, inputs, timeout=None):
+        """Synchronous convenience: submit + wait -> list of output arrays
+        (each sliced to this request's n rows on the batch dim).
+
+        ``timeout`` bounds QUEUE time (the admission deadline): a request
+        still queued at the deadline raises ``RequestTimeout``.  Once
+        dispatched, the wait runs to completion — the result event is
+        always set (success, model error, or drop), so this cannot hang on
+        a live engine, and client-observed outcomes agree with
+        ``stats()`` (a completed request is never double-reported as a
+        timeout).  Deadlines are enforced by the device loop, so a
+        synchronous wait against an engine with no running loop would hang
+        forever — that misuse fails fast here instead (``submit`` stays
+        legal on a stopped engine; callers hold the future and start()
+        later)."""
+        if self._thread is None or not self._thread.is_alive():
+            raise EngineClosed(
+                "engine is not serving (start() not called, or the device "
+                "loop terminated) — a synchronous predict() would never "
+                "complete")
+        return self.submit(inputs, timeout=timeout).result(None)
+
+    def _classify(self, inputs):
+        """Validate one request -> (np arrays, n, padded shape class,
+        direct?).  Oversize (n above the top bucket, or a sample shape no
+        bucket dominates) goes to the direct-dispatch path with its exact
+        shapes as a one-off signature."""
+        names = set(self.sample_shapes)
+        got = {str(k) for k in inputs}
+        if got != names:
+            raise ValueError("inputs %s != declared %s"
+                             % (sorted(got), sorted(names)))
+        arrays, n = {}, None
+        for name, a in inputs.items():
+            a = np.asarray(a.asnumpy() if hasattr(a, "asnumpy") else a)
+            want_rank = len(self.sample_shapes[name]) + 1
+            if a.ndim != want_rank:
+                raise ValueError(
+                    "input %r must carry a leading sample dim: got shape %s "
+                    "for sample shape %s" % (name, a.shape,
+                                             self.sample_shapes[name]))
+            if n is None:
+                n = a.shape[0]
+            elif a.shape[0] != n:
+                raise ValueError("inconsistent sample counts across inputs")
+            arrays[name] = a
+        if n < 1:
+            raise ValueError("request must carry at least one sample")
+        if n > self.max_direct_batch:
+            raise ValueError(
+                "request with %d samples exceeds max_direct_batch=%d "
+                "(one oversize request would stall the single device loop "
+                "for everyone; chunk the request client-side)"
+                % (n, self.max_direct_batch))
+        padded = {}
+        direct = n > self.ladder.max_batch
+        for name, a in arrays.items():
+            p = self.ladder.pad_shape(name, a.shape[1:],
+                                      self.sample_shapes[name])
+            if p is None:
+                direct = True
+                break
+            padded[name] = p
+        if direct:
+            padded = {name: tuple(a.shape[1:])
+                      for name, a in arrays.items()}
+        return arrays, n, padded, direct
+
+    # -- device loop ---------------------------------------------------------
+    def _loop(self):
+        reqs = ()
+        try:
+            while True:
+                item = self._batcher.next_batch()
+                if item is None:
+                    return
+                reqs, bucket = item
+                if not reqs:
+                    continue
+                try:
+                    self._dispatch(reqs, bucket)
+                except Exception as e:  # degrade, never crash the loop
+                    with self._stats_mu:
+                        self._stats["failed"] += len(reqs)
+                        self._stats["in_flight"] -= len(reqs)
+                    for req in reqs:
+                        if not req.done():
+                            req.set_error(e)
+                    if self._probe:
+                        self._probe.record_drop("error", len(reqs))
+                reqs = ()
+        except BaseException as e:
+            # loop is dying (batcher invariant broke, or a BaseException
+            # like SystemExit escaped _dispatch): fail the CURRENT batch —
+            # already popped from the queue, so batcher.close() alone would
+            # leave its waiters blocked forever — then fail the queue
+            undone = [r for r in reqs if not r.done()]
+            with self._stats_mu:
+                self._stats["failed"] += len(undone)
+                self._stats["in_flight"] -= len(undone)
+            for req in undone:
+                req.set_error(EngineClosed(
+                    "device loop terminated: %r" % (e,)))
+            self._closed = True
+            self._batcher.close()
+            raise
+
+    def _dispatch(self, reqs, bucket):
+        # queue wait ends HERE, at dispatch — measured before predictor
+        # build/compile and the forward, so the queue/execute histogram
+        # split stays honest (cold-bucket bind + compile time belongs to
+        # serve_execute_seconds, not to queue latency)
+        queue_waits = [r.queue_seconds for r in reqs]
+        t0 = time.perf_counter()
+        pred, fresh = self._predictor_for(bucket)
+        try:
+            arrays = self._assemble(reqs, bucket)
+            with self._device_mu:
+                outs = pred.forward(**arrays)
+                outs = [o.asnumpy() for o in outs]  # sync: real completion
+        except Exception:
+            self._uncompile(bucket, fresh)
+            raise
+        dt = time.perf_counter() - t0
+        if fresh:
+            self._note_compile(bucket, dt)
+        total = sum(r.n for r in reqs)
+        off = 0
+        for req in reqs:
+            req.set_result([o[off:off + req.n] for o in outs])
+            off += req.n
+        label = self._bucket_label(bucket)
+        with self._stats_mu:
+            self._stats["completed"] += len(reqs)
+            self._stats["in_flight"] -= len(reqs)
+            self._stats["batches"] += 1
+            in_flight = self._stats["in_flight"]
+            self._bucket_counts[label] = self._bucket_counts.get(label, 0) + 1
+        if self._probe:
+            fill = total / float(bucket.batch)
+            self._probe.record_batch(
+                label, fill,
+                self._padding_waste(reqs, bucket), dt, queue_waits,
+                in_flight, self._batcher.depth())
+
+    @staticmethod
+    def _padding_waste(reqs, bucket):
+        """Fraction of padded input elements that carry no request data
+        (batch-slot padding + spatial padding combined)."""
+        real = sum(r.n * _volume(a.shape[1:])
+                   for r in reqs for a in r.inputs.values())
+        padded = sum(bucket.batch * _volume(s) for _, s in bucket.shapes)
+        return 1.0 - real / padded if padded else 0.0
+
+    def _assemble(self, reqs, bucket):
+        arrays = {}
+        for name, bshape in bucket.shapes:
+            out = np.zeros((bucket.batch,) + bshape, np.float32)
+            off = 0
+            for req in reqs:
+                a = req.inputs[name]
+                region = (slice(off, off + req.n),) + tuple(
+                    slice(0, d) for d in a.shape[1:])
+                out[region] = a
+                off += req.n
+            arrays[name] = out
+        return arrays
+
+    # -- signature cache / warmup --------------------------------------------
+    def _predictor_for(self, bucket):
+        """-> (Predictor, fresh).  ``fresh`` marks a signature that has not
+        taken its first forward yet: the forward about to run is the one
+        XLA compile this signature pays (the telemetry compile counter
+        counts exactly these).  Ladder signatures are pinned; direct
+        (oversize, client-shaped) signatures live in a bounded LRU — an
+        evicted one recompiles on return, counted honestly again."""
+        with self._cache_mu:
+            if bucket.direct:
+                pred = self._direct_cache.get(bucket.key)
+                if pred is None:
+                    pred = self._proto.with_shapes(bucket.input_shapes())
+                    self._direct_cache[bucket.key] = pred
+                    while len(self._direct_cache) > _DIRECT_CACHE_MAX:
+                        old, _ = self._direct_cache.popitem(last=False)
+                        self._compiled.discard(old)
+                else:
+                    self._direct_cache.move_to_end(bucket.key)
+            else:
+                pred = self._cache.get(bucket.key)
+                if pred is None:
+                    pred = self._proto.with_shapes(bucket.input_shapes())
+                    self._cache[bucket.key] = pred
+            fresh = bucket.key not in self._compiled
+            if fresh:
+                self._compiled.add(bucket.key)
+        if not fresh:
+            with self._stats_mu:
+                self._stats["cache_hits"] += 1
+        return pred, fresh
+
+    @staticmethod
+    def _bucket_label(bucket):
+        """Metric/stats label.  Direct signatures are client-shaped — per
+        exact-shape labels would grow metric cardinality without bound
+        (exactly the traffic the direct LRU defends against), so they all
+        aggregate under one label."""
+        return "direct" if bucket.direct else repr(bucket)
+
+    def _note_compile(self, bucket, seconds):
+        with self._stats_mu:
+            self._stats["compiles"] += 1
+        if self._probe:
+            self._probe.record_compile(self._bucket_label(bucket), seconds)
+
+    def _uncompile(self, bucket, fresh):
+        """A fresh signature whose first forward FAILED never compiled —
+        un-mark it so the successful retry's real compile is counted (the
+        acceptance counter must track actual XLA compiles)."""
+        if fresh:
+            with self._cache_mu:
+                self._compiled.discard(bucket.key)
+
+    def _warm_bucket(self, bucket):
+        """Compile one bucket by running it on zeros (device-exclusive).
+        ``compile_s`` covers bind + first forward, same as live dispatch."""
+        t0 = time.perf_counter()
+        pred, fresh = self._predictor_for(bucket)
+        try:
+            with self._device_mu:
+                outs = pred.forward(
+                    **{n: np.zeros((bucket.batch,) + s, np.float32)
+                       for n, s in bucket.shapes})
+                for o in outs:
+                    o.asnumpy()
+        except Exception:
+            self._uncompile(bucket, fresh)
+            raise
+        dt = time.perf_counter() - t0
+        if fresh:
+            self._note_compile(bucket, dt)
+        return {"bucket": repr(bucket), "fresh": fresh,
+                "compile_s": round(dt, 4) if fresh else 0.0}
+
+    def warmup(self, buckets=None):
+        """Pre-compile the bucket ladder (see ``serving.warmup`` for the
+        module-level helper and recipe) -> per-bucket report list."""
+        from .warmup import warmup_engine
+
+        return warmup_engine(self, buckets=buckets)
+
+    # -- introspection -------------------------------------------------------
+    def _on_drop(self, req, reason):
+        with self._stats_mu:
+            if reason == "timeout":
+                self._stats["timeouts"] += 1
+            elif reason == "cancelled":
+                self._stats["cancelled"] += 1
+            if reason in ("timeout", "cancelled", "closed"):
+                self._stats["in_flight"] -= 1
+        if self._probe:
+            self._probe.record_drop(reason)
+
+    def stats(self):
+        """Point-in-time engine counters (always available; the telemetry
+        registry carries the same signals as proper metrics when enabled)."""
+        with self._stats_mu:
+            out = dict(self._stats)
+            out["buckets"] = dict(self._bucket_counts)
+        out["shed"] = self.admission.shed_total
+        out["queue_depth"] = self._batcher.depth()
+        with self._cache_mu:
+            out["cache_size"] = len(self._cache) + len(self._direct_cache)
+        out["ladder"] = [repr(b) for b in
+                         self.ladder.signatures(self.sample_shapes)]
+        return out

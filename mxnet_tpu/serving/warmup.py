@@ -1,0 +1,41 @@
+"""Startup warmup — take every bucket's XLA compile before traffic does.
+
+A cold serving engine pays each bucket's compile on the first unlucky
+request that lands in it — seconds of p99 latency handed to a real user.
+The warmup pass runs every ladder signature on zeros at startup instead
+(the TVM lesson from PAPERS.md: specialize ahead of time to a finite shape
+set, then serving is pure cache hits).  After ``warmup_engine`` a
+mixed-shape request stream adds **zero** new compiles (asserted in
+tests/test_serving.py).
+
+Recipe (docs/SERVING.md):
+
+    eng = serving.Engine(sym, params, {"data": (8,)}, start=False)
+    report = eng.warmup()          # compiles len(ladder.signatures()) graphs
+    eng.start()                    # begin serving, all-hot
+
+Warmup respects the device-exclusion lock, so it is also safe on a live
+engine (e.g. after enlarging the ladder) — buckets compile between batches.
+"""
+from __future__ import annotations
+
+__all__ = ["warmup_engine"]
+
+
+def warmup_engine(engine, buckets=None, verbose=False):
+    """Compile ``buckets`` (default: the engine's full ladder signature
+    set) by forwarding zeros through each.  Returns the per-bucket report:
+    ``[{"bucket", "fresh", "compile_s"}, ...]`` — ``fresh=False`` rows were
+    already cached (idempotent; re-running warmup is free)."""
+    if buckets is None:
+        buckets = engine.ladder.signatures(engine.sample_shapes)
+    report = []
+    for bucket in buckets:
+        row = engine._warm_bucket(bucket)
+        report.append(row)
+        if verbose:
+            print("warmup %-28s %s" % (
+                row["bucket"],
+                "compiled in %.3fs" % row["compile_s"] if row["fresh"]
+                else "cached"))
+    return report

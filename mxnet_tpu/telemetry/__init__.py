@@ -17,18 +17,19 @@ from .registry import (Counter, Gauge, Histogram, MetricError, Registry,
                        DEFAULT_BUCKETS)
 from .sinks import (JsonlSink, PrometheusSink, ProfilerSink, Sink,
                     TensorBoardSink, iter_scalar_samples, render_prometheus)
-from .instrument import (StepProbe, add_sink, array_nbytes, counter, enabled,
-                         event, flush, gauge, histogram, instrument_step,
-                         interval_s, jsonl_path, note_bytes, note_compile,
-                         registry, sample_memory, step_probe, summary)
+from .instrument import (ServeProbe, StepProbe, add_sink, array_nbytes,
+                         counter, enabled, event, flush, gauge, histogram,
+                         instrument_step, interval_s, jsonl_path, note_bytes,
+                         note_compile, registry, sample_memory, serve_probe,
+                         step_probe, summary)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricError", "Registry",
     "DEFAULT_BUCKETS",
     "Sink", "JsonlSink", "PrometheusSink", "ProfilerSink", "TensorBoardSink",
     "iter_scalar_samples", "render_prometheus",
-    "StepProbe", "add_sink", "array_nbytes", "counter", "enabled", "event",
-    "flush", "gauge", "histogram", "instrument_step", "interval_s",
-    "jsonl_path", "note_bytes", "note_compile", "registry", "sample_memory",
-    "step_probe", "summary",
+    "ServeProbe", "StepProbe", "add_sink", "array_nbytes", "counter",
+    "enabled", "event", "flush", "gauge", "histogram", "instrument_step",
+    "interval_s", "jsonl_path", "note_bytes", "note_compile", "registry",
+    "sample_memory", "serve_probe", "step_probe", "summary",
 ]

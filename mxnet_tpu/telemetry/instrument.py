@@ -35,7 +35,9 @@ from .sinks import JsonlSink
 __all__ = ["enabled", "jsonl_path", "interval_s", "registry", "add_sink",
            "counter", "gauge", "histogram", "event", "flush",
            "instrument_step", "note_compile", "note_bytes", "array_nbytes",
-           "sample_memory", "step_probe", "StepProbe", "summary"]
+           "sample_memory", "step_probe", "StepProbe", "summary",
+           "serve_probe", "ServeProbe", "SERVE_LATENCY_BUCKETS",
+           "FRACTION_BUCKETS"]
 
 _FALSY = ("", "0", "false", "no", "off")
 
@@ -311,6 +313,87 @@ class StepProbe:
 
 def step_probe(loop, batch_size=None):
     return StepProbe(loop, batch_size) if enabled() else None
+
+
+# -- serving probe ------------------------------------------------------------
+# online-latency buckets: serving p99s live in the 0.5ms..5s range, far
+# below the train-step DEFAULT_BUCKETS' useful resolution
+SERVE_LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                         0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+FRACTION_BUCKETS = (0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0)
+
+
+class ServeProbe:
+    """Per-engine serving metrics (ISSUE 2): queue-latency / batch-fill /
+    padding-waste histograms, in-flight + queue-depth gauges, drop counters
+    (shed / timeout / cancelled / error), and the serve compile counter the
+    acceptance test asserts against.  Construct via ``serve_probe`` — None
+    when telemetry is off, so the engine guards with ``if probe:`` and the
+    serving hot path carries zero added work disabled."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        r = registry()
+        self._r = r
+        self.queue_hist = r.histogram(
+            "serve_queue_seconds", "submit->dispatch wait", ("engine",),
+            SERVE_LATENCY_BUCKETS)
+        self.exec_hist = r.histogram(
+            "serve_execute_seconds", "device forward wall time (synced)",
+            ("engine",), SERVE_LATENCY_BUCKETS)
+        self.fill_hist = r.histogram(
+            "serve_batch_fill", "real samples / bucket capacity", ("engine",),
+            FRACTION_BUCKETS)
+        self.waste_hist = r.histogram(
+            "serve_padding_waste", "padded input elements carrying no data",
+            ("engine",), FRACTION_BUCKETS)
+        self.in_flight = r.gauge(
+            "serve_in_flight", "admitted, not yet completed", ("engine",))
+        self.queue_depth = r.gauge(
+            "serve_queue_depth", "requests waiting in the batcher", ("engine",))
+        self.requests = r.counter(
+            "serve_requests_total", "admitted requests", ("engine",))
+        self.batches = r.counter(
+            "serve_batches_total", "dispatched batches", ("engine", "bucket"))
+        self.drops = r.counter(
+            "serve_dropped_total", "requests dropped before/at dispatch",
+            ("engine", "reason"))
+        self.compiles = r.counter(
+            "serve_compiles_total", "signature-cache misses (one XLA "
+            "compile each)", ("engine", "bucket"))
+        self.compile_s = r.counter(
+            "serve_compile_seconds_total", "wall seconds in compiling "
+            "forwards", ("engine",))
+
+    def record_submit(self, depth, in_flight):
+        self.requests.inc(engine=self.engine)
+        self.queue_depth.set(depth, engine=self.engine)
+        self.in_flight.set(in_flight, engine=self.engine)
+
+    def record_drop(self, reason, n=1):
+        self.drops.inc(n, engine=self.engine, reason=reason)
+
+    def record_batch(self, bucket, fill, waste, exec_s, queue_waits,
+                     in_flight, depth):
+        self.batches.inc(engine=self.engine, bucket=bucket)
+        self.fill_hist.observe(fill, engine=self.engine)
+        self.waste_hist.observe(waste, engine=self.engine)
+        self.exec_hist.observe(exec_s, engine=self.engine)
+        for w in queue_waits:
+            self.queue_hist.observe(w, engine=self.engine)
+        self.in_flight.set(in_flight, engine=self.engine)
+        self.queue_depth.set(depth, engine=self.engine)
+
+    def record_compile(self, bucket, seconds):
+        self.compiles.inc(engine=self.engine, bucket=bucket)
+        self.compile_s.inc(seconds, engine=self.engine)
+        self._r.event("serve_compile", engine=self.engine, bucket=bucket,
+                      seconds=round(seconds, 6))
+
+
+def serve_probe(engine):
+    """ServeProbe for one engine, or None with telemetry disabled."""
+    return ServeProbe(engine) if enabled() else None
 
 
 # -- bench summary ------------------------------------------------------------

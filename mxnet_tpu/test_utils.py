@@ -269,3 +269,24 @@ def load_module_by_path(path, name=None):
         sys.modules.pop(name, None)  # never leave a half-initialized entry
         raise
     return mod
+
+
+def tiny_mlp_checkpoint(in_dim=8, num_hidden=16, num_classes=4, seed=0):
+    """(symbol, params) for the canonical tiny softmax MLP used by the
+    serving tests and ``tools/loadgen.py`` — ONE definition so the Engine
+    fixture and the load generator cannot drift apart.  Params are seeded
+    random NDArrays; no files involved."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=num_hidden, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=num_classes, name="fc2")
+    sym = mx.sym.SoftmaxOutput(fc2, name="softmax")
+    exe = sym.simple_bind(grad_req="null", data=(2, in_dim))
+    rng = np.random.RandomState(seed)
+    params = {n: nd.array(rng.randn(*a.shape).astype(np.float32))
+              for n, a in exe.arg_dict.items()
+              if n not in ("data", "softmax_label")}
+    return sym, params

@@ -26,6 +26,13 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tests excluded from tier-1 (-m 'not slow') and "
+        "the unit CI tier; run explicitly with -m slow")
+
+
 @pytest.fixture(autouse=True)
 def _seed_all(request):
     """Per-test deterministic seeding (reference tests/python/unittest/common.py:97

@@ -463,6 +463,51 @@ def test_deformable_convolution_matmul_path():
                             rtol=2e-2, atol=1e-2, names=(name, "fd"))
 
 
+def test_deformable_convolution_vmem_guard_fallback(monkeypatch):
+    """ADVICE round 5: with the estimated backward footprint over the VMEM
+    budget, the auto branch must take the plain XLA scan directly (no
+    platform_dependent / no Pallas build attempt) and produce identical
+    values.  The guard consult and the path taken are both asserted."""
+    import jax
+
+    from mxnet_tpu.ops import pallas_kernels
+    from mxnet_tpu.ops.registry import get as get_op
+
+    np.random.seed(8)
+    op = get_op("_contrib_DeformableConvolution")
+    B, C, H, W, dg, F = 1, 4, 28, 28, 2, 4  # matmul path (≥ 2^22)
+    data = np.random.randn(B, C, H, W).astype(np.float32)
+    weight = np.random.randn(F, C, 3, 3).astype(np.float32)
+    offset = 0.5 * np.random.randn(B, 2 * dg * 9, H, W).astype(np.float32)
+    kw = dict(kernel=(3, 3), num_filter=F, pad=(1, 1),
+              num_deformable_group=dg, no_bias=True)
+
+    verdicts = []
+    real_fits = pallas_kernels.dconv_fits_vmem
+    monkeypatch.setattr(
+        pallas_kernels, "dconv_fits_vmem",
+        lambda *a: verdicts.append(real_fits(*a)) or verdicts[-1])
+    pd_calls = []
+    real_pd = jax.lax.platform_dependent
+
+    def spy_pd(*a, **k):
+        pd_calls.append(1)
+        return real_pd(*a, **k)
+
+    monkeypatch.setattr(jax.lax, "platform_dependent", spy_pd)
+
+    monkeypatch.delenv("MXNET_DCONV_VMEM_MB", raising=False)
+    base = np.asarray(op.fn(data, offset, weight, None, **kw))
+    assert verdicts == [True] and pd_calls  # fused path considered
+
+    verdicts.clear()
+    pd_calls.clear()
+    monkeypatch.setenv("MXNET_DCONV_VMEM_MB", "0.001")  # force fallback
+    fell_back = np.asarray(op.fn(data, offset, weight, None, **kw))
+    assert verdicts == [False] and not pd_calls  # xla_col taken directly
+    assert_almost_equal(base, fell_back, rtol=1e-6, atol=0)
+
+
 def test_multi_proposal():
     np.random.seed(3)
     B, A, Hf, Wf = 2, 9, 4, 4
@@ -688,10 +733,16 @@ def test_grouped_roi_hint_misuse_raises_in_debug_mode():
         assert_almost_equal(out, exp, rtol=1e-6, atol=0)
         with pytest.raises(ValueError, match="batch-major"):
             nd.ROIPooling(nd.array(data), nd.array(bad), **kw)
-        # a constant (unfilled) batch_idx column is NOT misuse — the
+        # an all-ZEROS (unfilled) batch_idx column is NOT misuse — the
         # documented contract lets positional groupers leave it at 0
         zeroed = good.copy(); zeroed[:, 0] = 0
         nd.ROIPooling(nd.array(data), nd.array(zeroed), **kw).asnumpy()
+        # but only the zero constant is exempt: a constant NONZERO column
+        # carries real indices (every roi claims image 1) inconsistent
+        # with r // Rb, and must raise like any filled column (ADVICE r5)
+        ones = good.copy(); ones[:, 0] = 1
+        with pytest.raises(ValueError, match="batch-major"):
+            nd.ROIPooling(nd.array(data), nd.array(ones), **kw)
         # same contract on the deformable pooling's hint
         drois = np.array([[1, 0, 0, 14, 14], [0, 2, 4, 17, 15]], np.float32)
         with pytest.raises(ValueError, match="batch-major"):

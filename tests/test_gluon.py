@@ -353,3 +353,49 @@ def test_shared_block_symbolic_capture_unique_names():
                           **{k: v.data() for k, v in net.collect_params().items()}})
     got = exe.forward()[0].asnumpy()
     np.testing.assert_allclose(got, eager, rtol=1e-5, atol=1e-6)
+
+
+def test_reentrant_symbolic_capture_keeps_outer_ordinals():
+    """ADVICE round 5: ``_get_graph`` must save/restore the ambient
+    ``_SYM_CAPTURE.counts`` instead of clobbering it to None — a NESTED
+    capture mid-body (here: a sub-block's ``_get_graph`` called from the
+    outer ``hybrid_forward``) would otherwise reset the outer capture's
+    per-call ordinals, so a weight-shared block invoked again AFTER the
+    nested capture collides with its first invocation's node names."""
+    import json
+
+    import numpy as np
+
+    class Outer(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.enc = gluon.nn.Dense(4)
+                self.probe = gluon.nn.Dense(2)
+
+        def hybrid_forward(self, F, a, b):
+            x = self.enc(a)
+            # reentrant capture between the two shared-enc invocations
+            # (e.g. a helper building a side graph for shape inference)
+            self.probe._get_graph(mx.nd.zeros((1, 3)))
+            return x + 2.0 * self.enc(b)
+
+    net = Outer()
+    net.initialize()
+    a = mx.nd.array(np.ones((2, 3), np.float32))
+    b = mx.nd.array(np.full((2, 3), 3.0, np.float32))
+    eager = net(a, b).asnumpy()
+
+    net._cached_graph = ()  # fresh capture (eager ran the nested one too)
+    inputs, out = net._get_graph(a, b)
+    js = json.loads(out.tojson())
+    fc = [n for n in js["nodes"] if n["op"] == "FullyConnected"]
+    assert len(fc) == 2, [n["name"] for n in js["nodes"]]
+    assert len({n["name"] for n in fc}) == 2, fc
+
+    exe = out.bind(None, {inputs[0].name: a, inputs[1].name: b,
+                          **{k: v.data() for k, v in
+                             net.collect_params().items()
+                             if not k.startswith(net.probe.prefix)}})
+    got = exe.forward()[0].asnumpy()
+    np.testing.assert_allclose(got, eager, rtol=1e-5, atol=1e-6)

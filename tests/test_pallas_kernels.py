@@ -137,3 +137,24 @@ def test_dispatch_env_override(monkeypatch):
     monkeypatch.setenv("MXNET_NMS_IMPL", "pallas")
     got = np.asarray(detection._nms_alive_blocked(boxes, 0.6))
     np.testing.assert_array_equal(ref, got)
+
+
+def test_dconv_vmem_guard(monkeypatch):
+    """ADVICE round 5: the fused-dconv auto branch must keep known-good
+    north-star shapes on the kernel but push conv4-scale feature maps
+    (whose backward working set hard-fails Mosaic) to the XLA scan."""
+    from mxnet_tpu.ops.pallas_kernels import (dconv_bwd_vmem_bytes,
+                                              dconv_fits_vmem)
+
+    monkeypatch.delenv("MXNET_DCONV_VMEM_MB", raising=False)
+    # north-star res5: 38x64 map, cpg=512 — measured working, stays fused
+    assert dconv_fits_vmem(38 * 64, 512, 2)
+    assert dconv_fits_vmem(38 * 64, 512, 4)
+    # conv4-scale: 76x128 map — the hard-fail case, falls back
+    assert not dconv_fits_vmem(76 * 128, 512, 2)
+    assert dconv_bwd_vmem_bytes(76 * 128, 512, 2) > (24 << 20)
+    # env override wins in both directions
+    monkeypatch.setenv("MXNET_DCONV_VMEM_MB", "1024")
+    assert dconv_fits_vmem(76 * 128, 512, 2)
+    monkeypatch.setenv("MXNET_DCONV_VMEM_MB", "1")
+    assert not dconv_fits_vmem(38 * 64, 64, 2)

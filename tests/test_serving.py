@@ -1,0 +1,487 @@
+"""Serving subsystem (ISSUE 2): bucket-ladder shape math, micro-batcher
+edge cases (empty deadline flush, oversize direct dispatch, mid-queue
+timeout), admission shedding, cancellation, graceful degradation, the
+compile-per-bucket guarantee (telemetry counter), warmup idempotence, and
+the loadgen SERVE_BENCH line against the schema lint."""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import serving
+from mxnet_tpu.serving import (BucketLadder, Engine, MicroBatcher, Request,
+                               RequestCancelled, RequestTimeout, ServerBusy,
+                               pow2_ladder)
+from mxnet_tpu.telemetry import instrument as tin
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _mlp_engine(**kw):
+    """Tiny MLP (8 -> 16 -> 4 softmax) engine, in-process params — the
+    same ``test_utils.tiny_mlp_checkpoint`` model loadgen drives."""
+    from mxnet_tpu.test_utils import tiny_mlp_checkpoint
+
+    sym, params = tiny_mlp_checkpoint()
+    kw.setdefault("ladder", BucketLadder((1, 2, 4)))
+    kw.setdefault("max_wait_ms", 2.0)
+    kw.setdefault("max_queue", 64)
+    return Engine(sym, params, {"data": (8,)}, **kw), sym, params
+
+
+@pytest.fixture
+def tel_enabled(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    monkeypatch.setenv("MXNET_TELEMETRY_FILE", str(tmp_path / "t.jsonl"))
+    tin._reset_for_tests()
+    yield
+    tin._reset_for_tests()
+
+
+@pytest.fixture
+def tel_disabled(monkeypatch):
+    monkeypatch.delenv("MXNET_TELEMETRY", raising=False)
+    tin._reset_for_tests()
+    yield
+    tin._reset_for_tests()
+
+
+# -- bucket ladder (pure shape math) ------------------------------------------
+class TestBucketing:
+    def test_pow2_ladder(self):
+        assert pow2_ladder(8) == (1, 2, 4, 8)
+        assert pow2_ladder(12) == (1, 2, 4, 8, 12)
+        assert pow2_ladder(1) == (1,)
+        with pytest.raises(ValueError):
+            pow2_ladder(0)
+
+    def test_pad_batch(self):
+        lad = BucketLadder((1, 2, 4, 8))
+        assert lad.pad_batch(1) == 1
+        assert lad.pad_batch(3) == 4
+        assert lad.pad_batch(8) == 8
+        assert lad.pad_batch(9) is None
+        assert lad.max_batch == 8
+
+    def test_pad_shape_exact_class_without_buckets(self):
+        lad = BucketLadder((1, 2))
+        assert lad.pad_shape("data", (8,), (8,)) == (8,)
+        assert lad.pad_shape("data", (9,), (8,)) is None  # no bucket fits
+
+    def test_pad_shape_spatial_buckets(self):
+        lad = BucketLadder((1, 2), shape_buckets={
+            "data": [(3, 32, 32), (3, 64, 64)]})
+        assert lad.pad_shape("data", (3, 20, 32), (3, 32, 32)) == (3, 32, 32)
+        assert lad.pad_shape("data", (3, 33, 10), (3, 32, 32)) == (3, 64, 64)
+        assert lad.pad_shape("data", (3, 65, 65), (3, 32, 32)) is None
+
+    def test_signatures_cartesian(self):
+        lad = BucketLadder((1, 4), shape_buckets={"data": [(16,), (32,)]})
+        sigs = lad.signatures({"data": (16,)})
+        assert len(sigs) == 4
+        assert len(set(sigs)) == 4  # hashable + distinct
+        lad2 = BucketLadder((1, 2, 4))
+        assert len(lad2.signatures({"data": (8,)})) == 3
+
+    def test_mixed_rank_buckets_rejected(self):
+        with pytest.raises(ValueError):
+            BucketLadder((1,), shape_buckets={"d": [(3, 4), (3, 4, 5)]})
+
+
+# -- micro-batcher edge cases -------------------------------------------------
+def _req(n=1, shapes=None, deadline=None, direct=False):
+    shapes = shapes or {"data": (8,)}
+    inputs = {k: np.zeros((n,) + s, np.float32) for k, s in shapes.items()}
+    return Request(inputs, n, shapes, deadline=deadline, direct=direct)
+
+
+class TestMicroBatcher:
+    def test_empty_flush_on_deadline(self):
+        """A deadline wave that expires the whole queue must produce NO
+        batch — the consumer keeps waiting and the next live request goes
+        through (the 'empty flush' edge case)."""
+        drops = []
+        b = MicroBatcher(BucketLadder((1, 2, 4)), max_wait_s=5.0,
+                         on_drop=lambda r, why: drops.append(why))
+        r1 = _req(deadline=time.monotonic() - 0.01)  # already expired
+        r2 = _req(deadline=time.monotonic() - 0.01)
+        b.put(r1)
+        b.put(r2)
+        got = []
+        t = threading.Thread(target=lambda: got.append(b.next_batch()),
+                             daemon=True)
+        t.start()
+        with pytest.raises(RequestTimeout):
+            r1.result(timeout=2)
+        with pytest.raises(RequestTimeout):
+            r2.result(timeout=2)
+        assert drops == ["timeout", "timeout"]
+        assert not got  # no batch was formed from the expired wave
+        live = _req(n=4)  # fills the top bucket -> immediate flush
+        b.put(live)
+        t.join(timeout=5)
+        assert got and got[0] is not None
+        reqs, bucket = got[0]
+        assert reqs == [live] and bucket.batch == 4
+        b.close()
+
+    def test_partial_flush_after_max_wait(self):
+        b = MicroBatcher(BucketLadder((1, 2, 4)), max_wait_s=0.05)
+        r = _req(n=1)
+        b.put(r)
+        t0 = time.monotonic()
+        reqs, bucket = b.next_batch()
+        assert reqs == [r]
+        assert bucket.batch == 1  # padded to the smallest fitting bucket
+        assert 0.03 <= time.monotonic() - t0 < 2.0
+        b.close()
+
+    def test_direct_request_dispatches_alone(self):
+        b = MicroBatcher(BucketLadder((1, 2, 4)), max_wait_s=5.0)
+        big = _req(n=9, direct=True)
+        b.put(big)
+        reqs, bucket = b.next_batch()  # no wait: direct bypasses batching
+        assert reqs == [big] and bucket.direct and bucket.batch == 9
+        b.close()
+
+    def test_unservable_request_rejected_at_put(self):
+        """A non-direct request above the top bucket can never form a
+        batch — put() must reject it instead of letting the consumer spin
+        on an unservable queue head."""
+        b = MicroBatcher(BucketLadder((1, 2, 4)), max_wait_s=0.01)
+        with pytest.raises(ValueError, match="exceeds the top bucket"):
+            b.put(_req(n=9, direct=False))
+        b.close()
+
+    def test_shape_classes_never_mix(self):
+        b = MicroBatcher(BucketLadder((1, 2, 4)), max_wait_s=0.02)
+        ra = _req(shapes={"data": (8,)})
+        rb = _req(shapes={"data": (16,)})
+        b.put(ra)
+        b.put(rb)
+        reqs1, bucket1 = b.next_batch()
+        reqs2, bucket2 = b.next_batch()
+        assert [reqs1, reqs2] == [[ra], [rb]]
+        assert bucket1.sample_shape("data") == (8,)
+        assert bucket2.sample_shape("data") == (16,)
+        b.close()
+
+    def test_no_cross_class_head_of_line_blocking(self):
+        """A full batch of class B must dispatch immediately even when a
+        younger class-A request sits at the queue head with its flush
+        window still open — formation scans every shape class."""
+        b = MicroBatcher(BucketLadder((1, 2, 4)), max_wait_s=5.0)
+        young_head = _req(shapes={"data": (8,)})
+        b.put(young_head)
+        full = [_req(shapes={"data": (16,)}) for _ in range(4)]
+        for r in full:
+            b.put(r)
+        t0 = time.monotonic()
+        reqs, bucket = b.next_batch()
+        assert reqs == full
+        assert bucket.sample_shape("data") == (16,)
+        assert time.monotonic() - t0 < 1.0  # not the head's 5s window
+        b.close()
+
+    def test_cancel_dispatch_race_settles(self):
+        """cancel() and the batcher's dispatch claim settle under the
+        request lock: whichever wins, the other side sees False — cancel()
+        returning True really means the request never runs."""
+        r = _req()
+        assert r.mark_dispatched() is True
+        assert r.cancel() is False          # too late: already claimed
+        assert r.cancelled() is False
+        r2 = _req()
+        assert r2.cancel() is True
+        assert r2.mark_dispatched() is False  # batcher must drop it
+
+    def test_cancel_before_dispatch(self):
+        b = MicroBatcher(BucketLadder((1, 2)), max_wait_s=0.02)
+        r = _req()
+        b.put(r)
+        assert r.cancel() is True
+        live = _req()
+        b.put(live)
+        reqs, _ = b.next_batch()
+        assert reqs == [live]
+        with pytest.raises(RequestCancelled):
+            r.result(timeout=1)
+        b.close()
+
+
+# -- engine ------------------------------------------------------------------
+class TestEngine:
+    def test_predict_matches_predictor_oracle(self, tel_disabled):
+        eng, sym, params = _mlp_engine()
+        with eng:
+            x = np.random.RandomState(1).rand(3, 8).astype(np.float32)
+            out = eng.predict({"data": x})
+            assert out[0].shape == (3, 4)
+            from mxnet_tpu.predictor import Predictor
+
+            ref = Predictor(sym, params, {"data": (3, 8)})
+            expect = ref.forward(data=x)[0].asnumpy()
+            np.testing.assert_allclose(out[0], expect, rtol=1e-5, atol=1e-6)
+            # telemetry off: no probe object, no registry traffic
+            assert eng._probe is None
+
+    def test_mixed_stream_compiles_once_per_bucket(self, tel_enabled):
+        """Acceptance: a mixed-shape stream through the engine triggers
+        exactly ONE XLA compile per configured bucket, asserted via the
+        telemetry serve compile counter."""
+        eng, _, _ = _mlp_engine()
+        with eng:
+            ladder_len = len(eng.ladder.signatures(eng.sample_shapes))
+            assert ladder_len == 3
+            rng = np.random.RandomState(2)
+            for n in (1, 2, 3, 4, 1, 2, 3, 1, 4, 2):
+                out = eng.predict(
+                    {"data": rng.rand(n, 8).astype(np.float32)})
+                assert out[0].shape == (n, 4)
+            c = tin.registry().get("serve_compiles_total")
+            assert c is not None
+            total = sum(s["value"] for s in c.samples())
+            assert total == ladder_len
+            assert eng.stats()["compiles"] == ladder_len
+            assert eng.stats()["cache_hits"] >= 7
+
+    def test_warmup_precompiles_everything(self, tel_enabled):
+        eng, _, _ = _mlp_engine(start=False)
+        report = eng.warmup()
+        assert [r["fresh"] for r in report] == [True, True, True]
+        assert all(r["compile_s"] > 0 for r in report)
+        # idempotent: a second warmup is all cache hits
+        assert all(not r["fresh"] for r in eng.warmup())
+        eng.start()
+        rng = np.random.RandomState(3)
+        for n in (1, 2, 3, 4):
+            eng.predict({"data": rng.rand(n, 8).astype(np.float32)})
+        assert eng.stats()["compiles"] == 3  # stream added ZERO compiles
+        c = tin.registry().get("serve_compiles_total")
+        assert sum(s["value"] for s in c.samples()) == 3
+        eng.close()
+
+    def test_oversize_direct_dispatch(self, tel_disabled):
+        eng, _, _ = _mlp_engine()
+        with eng:
+            x = np.random.RandomState(4).rand(9, 8).astype(np.float32)
+            out = eng.predict({"data": x})  # 9 > top bucket 4
+            assert out[0].shape == (9, 4)
+            s = eng.stats()
+            assert s["direct"] == 1 and s["completed"] == 1
+            assert s["compiles"] == 1  # the one-off exact signature
+            # repeat hits the cached direct signature
+            eng.predict({"data": x})
+            assert eng.stats()["compiles"] == 1
+
+    def test_direct_cache_is_bounded(self, tel_disabled):
+        """Client-controlled oversize signatures must not grow executables
+        without bound: the direct cache is a small LRU, while ladder
+        signatures stay pinned."""
+        from mxnet_tpu.serving.engine import _DIRECT_CACHE_MAX
+
+        eng, _, _ = _mlp_engine()
+        with eng:
+            for n in range(5, 5 + _DIRECT_CACHE_MAX + 4):  # all > top bucket
+                out = eng.predict({"data": np.zeros((n, 8), np.float32)})
+                assert out[0].shape == (n, 4)
+            s = eng.stats()
+            assert s["direct"] == _DIRECT_CACHE_MAX + 4
+            assert s["compiles"] == _DIRECT_CACHE_MAX + 4  # honest count
+            assert s["cache_size"] <= 3 + _DIRECT_CACHE_MAX
+            # an evicted signature recompiles on return, counted again
+            eng.predict({"data": np.zeros((5, 8), np.float32)})
+            assert eng.stats()["compiles"] == _DIRECT_CACHE_MAX + 5
+
+    def test_timeout_mid_queue(self, tel_disabled):
+        """A queued request whose deadline fires before the flush window
+        closes is dropped ON TIME (the batcher wakes at the deadline, not
+        at the 10s flush), and the loop keeps serving."""
+        eng, _, _ = _mlp_engine(max_wait_ms=10000.0)
+        with eng:
+            req = eng.submit({"data": np.zeros((1, 8), np.float32)},
+                             timeout=0.05)
+            t0 = time.monotonic()
+            with pytest.raises(RequestTimeout):
+                req.result(timeout=5)
+            assert time.monotonic() - t0 < 2.0  # not the 10s flush window
+            assert eng.stats()["timeouts"] == 1
+            # a full bucket flushes immediately -> loop demonstrably alive
+            out = eng.predict({"data": np.zeros((4, 8), np.float32)})
+            assert out[0].shape == (4, 4)
+            assert eng.stats()["in_flight"] == 0
+
+    def test_cancel_wakes_batcher_promptly(self, tel_disabled):
+        """cancel() must wake the sleeping batcher so the request is failed
+        (and its queue slot freed) NOW, not at the end of a long flush
+        window."""
+        eng, _, _ = _mlp_engine(max_wait_ms=10000.0)
+        with eng:
+            req = eng.submit({"data": np.zeros((1, 8), np.float32)})
+            assert req.cancel() is True
+            t0 = time.monotonic()
+            with pytest.raises(RequestCancelled):
+                req.result(timeout=5)
+            assert time.monotonic() - t0 < 2.0  # not the 10s flush window
+            s = eng.stats()
+            assert s["cancelled"] == 1 and s["in_flight"] == 0
+            assert s["queue_depth"] == 0  # the slot was released
+
+    def test_admission_shed_and_recovery(self, tel_disabled):
+        eng, _, _ = _mlp_engine(max_queue=2, start=False)
+        r1 = eng.submit({"data": np.zeros((1, 8), np.float32)})
+        r2 = eng.submit({"data": np.zeros((1, 8), np.float32)})
+        with pytest.raises(ServerBusy):
+            eng.submit({"data": np.zeros((1, 8), np.float32)})
+        assert eng.stats()["shed"] == 1
+        eng.start()  # drain: both queued requests complete
+        assert r1.result(timeout=10)[0].shape == (1, 4)
+        assert r2.result(timeout=10)[0].shape == (1, 4)
+        assert eng.stats()["completed"] == 2
+        eng.close()
+
+    def test_model_error_degrades_gracefully(self, tel_disabled):
+        eng, _, _ = _mlp_engine()
+        with eng:
+            eng.predict({"data": np.zeros((1, 8), np.float32)})
+            boom = RuntimeError("injected model failure")
+            orig = eng._assemble
+
+            def bad_assemble(reqs, bucket):
+                eng._assemble = orig
+                raise boom
+
+            eng._assemble = bad_assemble
+            req = eng.submit({"data": np.zeros((1, 8), np.float32)})
+            with pytest.raises(RuntimeError, match="injected"):
+                req.result(timeout=10)
+            assert eng.stats()["failed"] == 1
+            # the device loop survived the failure
+            out = eng.predict({"data": np.zeros((2, 8), np.float32)})
+            assert out[0].shape == (2, 4)
+            assert eng.stats()["in_flight"] == 0
+
+    def test_failed_first_forward_recounts_compile(self, tel_disabled):
+        """A signature whose FIRST forward fails never compiled — the
+        successful retry must pay and count the real compile (the
+        acceptance counter tracks actual XLA compiles)."""
+        eng, _, _ = _mlp_engine()
+        with eng:
+            orig = eng._assemble
+
+            def bad_assemble(reqs, bucket):
+                eng._assemble = orig
+                raise RuntimeError("first-forward failure")
+
+            eng._assemble = bad_assemble
+            req = eng.submit({"data": np.zeros((1, 8), np.float32)})
+            with pytest.raises(RuntimeError, match="first-forward"):
+                req.result(timeout=10)
+            assert eng.stats()["compiles"] == 0  # nothing actually compiled
+            eng.predict({"data": np.zeros((1, 8), np.float32)})
+            assert eng.stats()["compiles"] == 1  # the retry counts it
+
+    def test_predict_requires_running_loop(self, tel_disabled):
+        """Synchronous predict() on an engine with no device loop would
+        hang forever (deadlines are enforced by the loop) — it must fail
+        fast instead; async submit stays legal for warmup-first flows."""
+        eng, _, _ = _mlp_engine(start=False)
+        with pytest.raises(serving.EngineClosed, match="not serving"):
+            eng.predict({"data": np.zeros((1, 8), np.float32)})
+        req = eng.submit({"data": np.zeros((1, 8), np.float32)})
+        eng.start()
+        assert req.result(timeout=10)[0].shape == (1, 4)
+        eng.close()
+
+    def test_closed_engine_rejects_and_fails_pending(self, tel_disabled):
+        eng, _, _ = _mlp_engine(start=False)
+        req = eng.submit({"data": np.zeros((1, 8), np.float32)})
+        eng.close()
+        with pytest.raises(serving.EngineClosed):
+            req.result(timeout=1)
+        with pytest.raises(serving.EngineClosed):
+            eng.submit({"data": np.zeros((1, 8), np.float32)})
+
+    def test_input_validation(self, tel_disabled):
+        eng, _, _ = _mlp_engine()
+        with eng:
+            with pytest.raises(ValueError, match="!= declared"):
+                eng.submit({"bogus": np.zeros((1, 8), np.float32)})
+            with pytest.raises(ValueError, match="leading sample dim"):
+                eng.submit({"data": np.zeros((8,), np.float32)})
+            with pytest.raises(ValueError, match="at least one sample"):
+                eng.submit({"data": np.zeros((0, 8), np.float32)})
+            # one huge request would stall the single device loop for all
+            # callers — beyond 4x the top bucket the client must chunk
+            with pytest.raises(ValueError, match="max_direct_batch"):
+                eng.submit({"data": np.zeros((17, 8), np.float32)})
+
+    def test_telemetry_metrics_populated(self, tel_enabled):
+        eng, _, _ = _mlp_engine()
+        with eng:
+            rng = np.random.RandomState(5)
+            for n in (1, 3, 2):
+                eng.predict({"data": rng.rand(n, 8).astype(np.float32)})
+            r = tin.registry()
+            assert r.total("serve_requests_total") == 3
+            fill = r.get("serve_batch_fill")
+            (s,) = fill.samples()
+            assert s["count"] == eng.stats()["batches"]
+            q = r.get("serve_queue_seconds")
+            assert sum(x["count"] for x in q.samples()) == 3
+            assert r.get("serve_padding_waste") is not None
+
+    def test_spatial_bucketing_pads_and_slices(self, tel_disabled):
+        """Spatial shape buckets: a shorter sample is zero-padded up to its
+        bucket; output rows are sliced back per request (non-batch dims
+        stay at the bucket shape, documented contract)."""
+        data = mx.sym.Variable("data")
+        sym = mx.sym.Activation(data, act_type="relu", name="r")
+        ladder = BucketLadder((1, 2), shape_buckets={"data": [(4,), (8,)]})
+        eng = Engine(sym, {}, {"data": (4,)}, ladder=ladder, max_wait_ms=2.0)
+        with eng:
+            x = np.array([[-1.0, 2.0, -3.0]], np.float32)  # sample shape (3,)
+            out = eng.predict({"data": x})
+            assert out[0].shape == (1, 4)  # padded into the (4,) bucket
+            np.testing.assert_allclose(out[0][0, :3], [0.0, 2.0, 0.0])
+            np.testing.assert_allclose(out[0][0, 3:], 0.0)
+            y = np.ones((1, 7), np.float32)  # -> the (8,) bucket
+            out2 = eng.predict({"data": y})
+            assert out2[0].shape == (1, 8)
+            assert len(eng.ladder.signatures(eng.sample_shapes)) == 4
+
+
+# -- loadgen / SERVE_BENCH ----------------------------------------------------
+@pytest.mark.slow
+def test_loadgen_emits_schema_valid_serve_bench(tmp_path):
+    """Acceptance: tools/loadgen.py against the tiny-symbol engine on CPU
+    emits schema-valid SERVE_BENCH lines with nonzero throughput and p99."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "loadgen.py"),
+         "--smoke", "--duration", "0.4"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.splitlines()
+             if l.startswith("SERVE_BENCH ")]
+    assert len(lines) == 2  # closed + open
+    sys.path.insert(0, os.path.join(REPO, "ci"))
+    try:
+        import check_bench_schema as cbs
+    finally:
+        sys.path.pop(0)
+    import json
+
+    for line in lines:
+        obj = json.loads(line[len("SERVE_BENCH "):])
+        cbs.validate_serve_line(obj, "loadgen")
+        assert obj["throughput_rps"] > 0
+        assert obj["latency_ms_p99"] > 0
+        # compiles is a per-RUN delta: warmup took the ladder's 3, so the
+        # traffic itself must add zero
+        assert obj["compiles"] == 0

@@ -1,0 +1,269 @@
+#!/usr/bin/env python
+"""Load generator for the serving engine (ISSUE 2) — SERVE_BENCH emitter.
+
+Drives a ``mxnet_tpu.serving.Engine`` with synthetic traffic and prints one
+``SERVE_BENCH {json}`` line per run (schema linted by
+``ci/check_bench_schema.py``; docs/SERVING.md documents every field).
+
+Two generator modes, the standard pair:
+
+* **closed loop** (``--mode closed``): ``--concurrency`` workers each
+  submit-and-wait in a tight loop — measures capacity (the system sets the
+  rate; latency stays near service time).
+* **open loop** (``--mode open``): one dispatcher fires requests on a
+  Poisson clock at ``--rate`` req/s regardless of completions — measures
+  behavior under offered load, including queueing delay and shedding
+  (closed-loop load generators famously hide both).
+
+``--mode both`` runs closed then open and emits two lines.  Request sizes
+are drawn from ``--sizes`` (mixed-shape stream exercising the whole bucket
+ladder); ``--smoke`` is the CI preset: tiny MLP, short run, CPU-safe.
+
+Examples::
+
+    python tools/loadgen.py --smoke
+    python tools/loadgen.py --mode both --duration 2 --rate 300 \
+        --batch-ladder 1,2,4,8 --concurrency 8
+    python tools/loadgen.py --symbol m-symbol.json --params m-0000.params \
+        --input data:3,224,224 --mode open --rate 50
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..")))
+
+
+def _tiny_engine(args):
+    """Default workload: the test-suite MLP (8 -> 16 -> 4 softmax) with
+    random params, no checkpoint files needed — the CPU smoke target."""
+    from mxnet_tpu import serving
+    from mxnet_tpu.test_utils import tiny_mlp_checkpoint
+
+    sym, params = tiny_mlp_checkpoint(seed=args.seed)
+    return serving.Engine(
+        sym, params, {"data": (8,)},
+        ladder=serving.BucketLadder(args.ladder),
+        max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
+        name="loadgen", start=True), {"data": (8,)}
+
+
+def _file_engine(args):
+    from mxnet_tpu import serving
+
+    shapes = {}
+    for spec in args.input:
+        name, _, dims = spec.partition(":")
+        shapes[name] = tuple(int(d) for d in dims.split(",") if d)
+    return serving.Engine(
+        args.symbol, args.params, shapes,
+        ladder=serving.BucketLadder(args.ladder),
+        max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
+        name="loadgen", start=True), shapes
+
+
+def _make_request(shapes, sizes, rng):
+    n = rng.choice(sizes)
+    return {name: np.asarray(
+        rng.standard_normal((n,) + tuple(s)), dtype=np.float32)
+        for name, s in shapes.items()}
+
+
+class _Collector:
+    """Thread-safe latency/outcome accumulator."""
+
+    def __init__(self):
+        self.mu = threading.Lock()
+        self.latencies = []
+        self.submitted = 0
+        self.shed = 0
+        self.timeouts = 0
+        self.errors = 0
+        self.in_window = None  # open loop: completions inside the window
+
+    def ok(self, seconds):
+        with self.mu:
+            self.latencies.append(seconds)
+
+    def count(self, field, n=1):
+        with self.mu:
+            setattr(self, field, getattr(self, field) + n)
+
+
+def _run_closed(engine, shapes, args, collector):
+    from mxnet_tpu.serving import RequestTimeout, ServerBusy
+
+    stop = time.monotonic() + args.duration
+
+    def worker(seed):
+        rng = np.random.default_rng(seed)
+        while time.monotonic() < stop:
+            req_inputs = _make_request(shapes, args.sizes, rng)
+            collector.count("submitted")
+            t0 = time.perf_counter()
+            try:
+                engine.predict(req_inputs, timeout=args.timeout_s)
+                collector.ok(time.perf_counter() - t0)
+            except ServerBusy:
+                collector.count("shed")
+            except RequestTimeout:
+                collector.count("timeouts")
+            except Exception:
+                collector.count("errors")
+
+    threads = [threading.Thread(target=worker, args=(args.seed + i,),
+                                daemon=True)
+               for i in range(args.concurrency)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(args.duration + 30)
+    return time.perf_counter() - t_start
+
+
+def _run_open(engine, shapes, args, collector):
+    from mxnet_tpu.serving import RequestTimeout, ServerBusy
+
+    rng = np.random.default_rng(args.seed)
+    jitter = random.Random(args.seed)
+    pending = []
+    stop = time.monotonic() + args.duration
+    t_start = time.perf_counter()
+    next_fire = time.monotonic()
+    while time.monotonic() < stop:
+        now = time.monotonic()
+        if now < next_fire:
+            time.sleep(min(next_fire - now, 0.005))
+            continue
+        # Poisson arrivals: exponential inter-arrival gaps at --rate
+        next_fire += jitter.expovariate(args.rate)
+        collector.count("submitted")
+        try:
+            pending.append(engine.submit(
+                _make_request(shapes, args.sizes, rng),
+                timeout=args.timeout_s))
+        except ServerBusy:
+            collector.count("shed")
+    # throughput window CLOSES here: the post-window drain below must not
+    # deflate throughput_rps (completed/duration) in the overload regime
+    # the open loop exists to measure
+    duration = time.perf_counter() - t_start
+    window_end = time.monotonic()
+    collector.in_window = 0
+    for req in pending:
+        try:
+            req.result(timeout=30)
+            # latency stamped at completion, not at this (late) harvest
+            collector.ok(req.latency_s)
+            if req.t_done <= window_end:
+                collector.in_window += 1
+        except RequestTimeout:
+            collector.count("timeouts")
+        except Exception:
+            collector.count("errors")
+    return duration
+
+
+def run(engine, shapes, args, mode):
+    collector = _Collector()
+    compiles_before = engine.stats()["compiles"]
+    runner = _run_closed if mode == "closed" else _run_open
+    duration = runner(engine, shapes, args, collector)
+    lat = np.asarray(sorted(collector.latencies), np.float64)
+    completed = len(lat)
+    # open loop: rate = completions INSIDE the offered window / window
+    # (late drain completions report their latency but not phantom rate)
+    thr_n = (collector.in_window if collector.in_window is not None
+             else completed)
+    stats = engine.stats()
+    line = {
+        "mode": mode,
+        "requests": collector.submitted,
+        "completed": completed,
+        "shed": collector.shed,
+        "timeouts": collector.timeouts,
+        "errors": collector.errors,
+        "shed_rate": (collector.shed / collector.submitted
+                      if collector.submitted else 0.0),
+        "duration_s": round(duration, 4),
+        "throughput_rps": round(thr_n / duration, 2) if duration else 0.0,
+        "latency_ms_p50": round(float(np.percentile(lat, 50)) * 1e3, 3)
+        if completed else 0.0,
+        "latency_ms_p99": round(float(np.percentile(lat, 99)) * 1e3, 3)
+        if completed else 0.0,
+        # per-RUN delta, not engine-lifetime: a warmed engine reports 0,
+        # and --mode both doesn't leak closed-run compiles into the open line
+        "compiles": stats["compiles"] - compiles_before,
+        "concurrency": args.concurrency if mode == "closed" else None,
+        "rate_rps": args.rate if mode == "open" else None,
+    }
+    line = {k: v for k, v in line.items() if v is not None}
+    print("SERVE_BENCH " + json.dumps(line))
+    return line
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--mode", choices=["closed", "open", "both"],
+                   default="closed")
+    p.add_argument("--duration", type=float, default=2.0,
+                   help="seconds of traffic per mode")
+    p.add_argument("--concurrency", type=int, default=4,
+                   help="closed-loop worker threads")
+    p.add_argument("--rate", type=float, default=200.0,
+                   help="open-loop offered load, req/s")
+    p.add_argument("--sizes", default="1,2,3",
+                   help="request sample counts drawn uniformly (mixed-shape "
+                        "stream)")
+    p.add_argument("--batch-ladder", dest="ladder", default="1,2,4,8")
+    p.add_argument("--max-wait-ms", type=float, default=2.0)
+    p.add_argument("--max-queue", type=int, default=512)
+    p.add_argument("--timeout-s", type=float, default=10.0)
+    p.add_argument("--symbol", help="*-symbol.json (default: built-in MLP)")
+    p.add_argument("--params", help="*.params")
+    p.add_argument("--input", action="append", default=[],
+                   help="name:d1,d2,... per-sample shape (with --symbol)")
+    p.add_argument("--no-warmup", action="store_true",
+                   help="skip the bucket-ladder precompile (measure cold)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--smoke", action="store_true",
+                   help="CI preset: tiny MLP, 0.5s closed + 0.5s open")
+    args = p.parse_args(argv)
+    args.ladder = tuple(int(x) for x in str(args.ladder).split(",") if x)
+    args.sizes = tuple(int(x) for x in str(args.sizes).split(",") if x)
+    if args.symbol and not args.input:
+        p.error("--symbol requires at least one --input name:d1,d2,...")
+    if args.smoke:
+        args.mode = "both"
+        args.duration = min(args.duration, 0.5)
+        args.concurrency = 2
+        args.rate = 100.0
+        args.ladder = (1, 2, 4)
+
+    engine, shapes = (_file_engine(args) if args.symbol
+                      else _tiny_engine(args))
+    try:
+        if not args.no_warmup:
+            engine.warmup()
+        modes = ["closed", "open"] if args.mode == "both" else [args.mode]
+        lines = [run(engine, shapes, args, m) for m in modes]
+    finally:
+        engine.close()
+    # a run with model/engine errors is a FAILED run even if some requests
+    # completed — CI must not read a healthy line from a failing engine
+    return 0 if all(l["completed"] > 0 and l["errors"] == 0
+                    for l in lines) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
