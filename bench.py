@@ -24,9 +24,11 @@ import numpy as np
 
 def _emit(payload):
     """Print the ONE bench JSON line; with MXNET_TELEMETRY enabled, attach
-    the telemetry block (compile_s, peak_hbm_bytes, data_wait_frac — see
-    docs/OBSERVABILITY.md) and flush the JSONL event log.  The line's schema
-    is linted by ci/check_bench_schema.py."""
+    the telemetry block (compile_s, peak_hbm_bytes, data_wait_frac, and —
+    when a Module train loop ran — dispatches_per_step, the ISSUE 3 fused
+    step's regression surface; see docs/OBSERVABILITY.md) and flush the
+    JSONL event log.  The line's schema is linted by
+    ci/check_bench_schema.py."""
     from mxnet_tpu import telemetry
 
     if telemetry.enabled():
@@ -41,6 +43,8 @@ def main():
     which = os.environ.get("MXNET_BENCH", "rfcn")
     if which == "frcnn":
         return main_frcnn()
+    if which == "module":
+        return main_module()
     if which != "resnet50":
         return main_rfcn()
     import jax
@@ -147,6 +151,59 @@ def main_rfcn():
             "unit": "img/s",
             "vs_baseline": None,
         })
+
+
+def main_module():
+    """``MXNET_BENCH=module``: symbolic Module train-step microbench
+    (ISSUE 3 fused executor).  A small MLP driven through the
+    forward_backward/update loop; with MXNET_TELEMETRY=1 the emitted
+    telemetry block carries ``dispatches_per_step`` — 1.0 on the fused path
+    vs 2+P legacy (set MXNET_MODULE_FUSED_STEP=0 to measure the regression
+    surface the fused path removes)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import module as mod_mod
+    from mxnet_tpu.io import DataBatch
+
+    batch = int(os.environ.get("MXNET_BENCH_BATCH", 64))
+    iters = int(os.environ.get("MXNET_BENCH_ITERS", 50))
+    rng = np.random.RandomState(0)
+    X = rng.randn(batch, 128).astype(np.float32)
+    y = rng.randint(0, 10, (batch,)).astype(np.float32)
+
+    data = mx.sym.var("data")
+    h = mx.sym.Activation(
+        mx.sym.FullyConnected(data, name="fc1", num_hidden=256),
+        name="a1", act_type="relu")
+    h = mx.sym.Activation(
+        mx.sym.FullyConnected(h, name="fc2", num_hidden=256),
+        name="a2", act_type="relu")
+    sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, name="fc3", num_hidden=10), name="softmax")
+
+    mod = mod_mod.Module(sym)
+    mod.bind(data_shapes=[("data", (batch, 128))],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9})
+    b = DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(y)])
+    mod.forward_backward(b)
+    mod.update()  # warmup/compile
+    mod.get_outputs()[0].asnumpy()
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        mod.forward_backward(b)
+        mod.update()
+    mod.get_outputs()[0].asnumpy()  # sync the async dispatch chain
+    dt = time.perf_counter() - t0
+    _emit({
+        "metric": "module_mlp_train_samples_per_sec",
+        "value": round(batch * iters / dt, 2),
+        "unit": "samples/s",
+        "vs_baseline": None,
+    })
 
 
 def main_frcnn():

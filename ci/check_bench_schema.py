@@ -18,7 +18,11 @@ import json
 import sys
 
 TOP_KEYS = {"metric", "value", "unit", "vs_baseline", "telemetry"}
-TEL_KEYS = {"compile_s", "peak_hbm_bytes", "data_wait_frac"}
+TEL_REQ_KEYS = {"compile_s", "peak_hbm_bytes", "data_wait_frac"}
+# dispatches_per_step (ISSUE 3 fused Module step) is optional: captures
+# predating the fused-step work carry only the three original keys
+TEL_OPT_KEYS = {"dispatches_per_step"}
+TEL_KEYS = TEL_REQ_KEYS | TEL_OPT_KEYS
 
 # SERVE_BENCH line (tools/loadgen.py, ISSUE 2) — docs/SERVING.md schema
 SERVE_PREFIX = "SERVE_BENCH "
@@ -70,7 +74,7 @@ def validate_line(obj, where="<line>"):
         if unknown:
             raise SchemaError("%s: unknown telemetry keys %s (schema: %s)"
                               % (where, sorted(unknown), sorted(TEL_KEYS)))
-        for k in TEL_KEYS:
+        for k in TEL_REQ_KEYS:
             if k not in tel:
                 raise SchemaError("%s: telemetry block missing %r" % (where, k))
         if not _num(tel["compile_s"]):
@@ -84,6 +88,11 @@ def validate_line(obj, where="<line>"):
             raise SchemaError(
                 "%s: telemetry.data_wait_frac must be a number in [0, 1]"
                 % where)
+        dps = tel.get("dispatches_per_step")
+        if dps is not None and (not _num(dps) or dps < 0):
+            raise SchemaError(
+                "%s: telemetry.dispatches_per_step must be a non-negative "
+                "number or null" % where)
 
 
 def validate_serve_line(obj, where="<line>"):
@@ -152,6 +161,12 @@ def self_test():
         {"metric": "m", "value": 1, "unit": "img/s",
          "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
                        "data_wait_frac": 0.0}},
+        {"metric": "m", "value": 1, "unit": "samples/s",
+         "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0, "dispatches_per_step": 1.0}},
+        {"metric": "m", "value": 1, "unit": "samples/s",
+         "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0, "dispatches_per_step": None}},
     ]
     bad = [
         {},                                                  # empty
@@ -165,6 +180,10 @@ def self_test():
         {"metric": "m", "value": 1, "unit": "img/s",
          "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
                        "data_wait_frac": 1.7}},              # frac range
+        {"metric": "m", "value": 1, "unit": "img/s",
+         "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0,
+                       "dispatches_per_step": -2}},          # negative dps
     ]
     serve_good = {"mode": "closed", "requests": 10, "completed": 9,
                   "shed": 1, "timeouts": 0, "errors": 0, "shed_rate": 0.1,

@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Multi-seed mAP gate (ADVICE round 5 recalibration).
+
+The old chip quality gates compared ONE training run against a
+worst-seed-minus-20% floor; with cross-seed variance as wide as
+0.09..0.38 (R-FCN R-101) or 0.34..0.89 (SSD-512) such a floor only
+catches catastrophic breakage (<=0.03) and would pass a regression that
+halves typical mAP.  This helper instead gates the MEDIAN of n fixed-seed
+runs (== the mean for n=2) against a floor calibrated from the seed-sweep
+mean, which a halved-mAP regression cannot clear.
+
+Used by ci/run_tests.sh's tpu tier::
+
+    python ci/gate_map.py --extract run.log        # print the FINAL mAP
+    python ci/gate_map.py --floor 0.14 0.09 0.27   # gate median(values)
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import statistics
+import sys
+
+# the eval_*_map.py scripts all print:  FINAL <recipe> <name> = <value>  (...)
+# — non-greedy up to the first spaced '=' so the trailing "(steps=3000,
+# eval n=500)" annotations can't shadow the mAP value
+_FINAL_RE = re.compile(r"^FINAL\b.*?\s=\s+([0-9]*\.?[0-9]+)")
+
+
+def extract_map(path):
+    """Last FINAL-line mAP value in a log file (the eval scripts print one)."""
+    value = None
+    with open(path, encoding="utf-8", errors="replace") as f:
+        for line in f:
+            m = _FINAL_RE.match(line.strip())
+            if m:
+                value = float(m.group(1))
+    if value is None:
+        raise SystemExit("%s: no 'FINAL ... = <mAP>' line found" % path)
+    return value
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--extract", metavar="LOG",
+                   help="print the FINAL mAP value parsed from LOG and exit")
+    p.add_argument("--floor", type=float,
+                   help="exit 1 unless median(values) >= FLOOR")
+    p.add_argument("values", nargs="*", type=float,
+                   help="per-seed mAP values to gate")
+    args = p.parse_args(argv)
+
+    if args.extract:
+        print("%.4f" % extract_map(args.extract))
+        return 0
+    if args.floor is None or not args.values:
+        p.error("need either --extract LOG, or --floor F plus values")
+    med = statistics.median(args.values)
+    line = "gate_map: median(%s) = %.4f vs floor %.4f" % (
+        ", ".join("%.4f" % v for v in args.values), med, args.floor)
+    if med < args.floor:
+        print("FAIL: " + line)
+        return 1
+    print("PASS: " + line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

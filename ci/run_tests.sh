@@ -76,30 +76,37 @@ case "$tier" in
       || MXNET_TEST_DEVICE=tpu python -m pytest tests/test_consistency_tpu.py -q
     python bench.py
     MXNET_BENCH=resnet50 python bench.py
-    # detection-quality gate on the chip (VERDICT r2 item 5): full R-101
-    # recipe, on-device synthetic stream, n=500 eval.  Round-5
-    # recalibration with the fused dconv kernel: seeds 0/1/2 →
-    # 0.0900/0.2743/0.3828 — wider true variance than round 4 measured
-    # (any numerical perturbation ≈ a fresh seed draw: the SAME xla
-    # formulation re-ran at 0.1440 after an unrelated einsum reshape, vs
-    # 0.1757 calibrated).  Floor 0.07 = worst − ~20% (QUALITY.md §3);
-    # the gate's target failure (broken sampling/targets) scores ≤0.03
-    python examples/quality/eval_rfcn_map.py --resnet101 --steps 3000 \
-      --live-bn --map-floor 0.07
-    # Faster-RCNN VGG16 chip gate (round 4): seeds 0/1/2 → 0.8085/0.7883/
-    # 0.8113 — floor 0.63 = worst − ~20% (QUALITY.md §3)
-    python examples/quality/eval_frcnn_map.py --vgg16 --steps 3000 \
-      --map-floor 0.63
-    # SSD-300 full-width chip gate (round 4, with lr warmup): seeds 0/1/2
-    # → 0.6802/0.9034/0.9214 — floor 0.54 = worst − ~20% (QUALITY.md §3)
-    python examples/quality/eval_ssd_map.py --full --steps 2000 \
-      --map-floor 0.54
-    # SSD-512 at the 24564-anchor menu (round-5 calibration): seeds 0/1/2
-    # → 0.8868/0.3357/0.4145 — wide from-scratch variance at 512², like
-    # SSD-300's 0.68-0.92; floor 0.26 = worst − ~20% (QUALITY.md §3).  The
-    # gate's target failure (broken MultiBox assignment) scores ~0.001
-    python examples/quality/eval_ssd_map.py --full --size 512 --steps 2000 \
-      --map-floor 0.26
+    # detection-quality gates on the chip (VERDICT r2 item 5, recalibrated
+    # per ADVICE round 5): each recipe now runs at TWO fixed seeds and the
+    # MEDIAN (== mean at n=2) is gated via ci/gate_map.py, replacing the
+    # old single-run worst-seed-minus-20% floors (0.07/0.63/0.54/0.26)
+    # that, over cross-seed variance as wide as 0.09..0.38, only caught
+    # catastrophic (<=0.03) breakage and would pass a halved-mAP
+    # regression.  Floors = mean(seed 0, seed 1 calibration, QUALITY.md §3
+    # round-4/5 sweeps) − ~20%:
+    #   R-FCN R-101  0.0900/0.2743 → mean 0.182 → floor 0.14
+    #   FRCNN VGG16  0.8085/0.7883 → mean 0.798 → floor 0.64
+    #   SSD-300      0.6802/0.9034 → mean 0.792 → floor 0.63
+    #   SSD-512      0.8868/0.3357 → mean 0.611 → floor 0.49
+    run_map_gate() {
+      local floor="$1"; shift
+      local vals=() log
+      for seed in 0 1; do
+        log="$(mktemp)"
+        "$@" --seed "$seed" | tee "$log"
+        vals+=("$(python ci/gate_map.py --extract "$log")")
+        rm -f "$log"
+      done
+      python ci/gate_map.py --floor "$floor" "${vals[@]}"
+    }
+    run_map_gate 0.14 python examples/quality/eval_rfcn_map.py --resnet101 \
+      --steps 3000 --live-bn
+    run_map_gate 0.64 python examples/quality/eval_frcnn_map.py --vgg16 \
+      --steps 3000
+    run_map_gate 0.63 python examples/quality/eval_ssd_map.py --full \
+      --steps 2000
+    run_map_gate 0.49 python examples/quality/eval_ssd_map.py --full \
+      --size 512 --steps 2000
     ;;
   all)
     "$SELF" unit

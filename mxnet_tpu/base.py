@@ -22,7 +22,22 @@ __all__ = [
     "integer_types",
     "NameManager",
     "AttrScope",
+    "env_flag",
 ]
+
+# the one shared falsy-string list for boolean MXNET_* env gates
+# (MXNET_TELEMETRY, MXNET_MODULE_FUSED_STEP, ...): extending it here keeps
+# every gate agreeing on what counts as "off"
+_ENV_FALSY = ("", "0", "false", "no", "off")
+
+
+def env_flag(name, default="0"):
+    """Boolean env gate: False for unset->default in {'', 0, false, no, off}
+    (case/whitespace-insensitive), True otherwise.  Read per call so tests
+    can flip it; one dict lookup, cheap enough for per-batch guards."""
+    import os
+
+    return os.environ.get(name, default).strip().lower() not in _ENV_FALSY
 
 
 class MXNetError(RuntimeError):

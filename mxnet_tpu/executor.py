@@ -41,7 +41,7 @@ class Executor:
         self._monitor = None
         self._monitor_all = False
         self._fwd_cache = {}
-        self._bwd_cache = None
+        self._bwd_cache = {}  # (diff_names, ones_ct, mode) -> jitted bwd
         self._plan = self._make_plan()
 
     # -- array plumbing -----------------------------------------------------
@@ -109,6 +109,10 @@ class Executor:
         plan = self._plan
         aux_names = list(self._aux_names)
         arg_names = list(self._arg_names)
+        # locals only: the returned fn must NOT close over the Executor —
+        # the Module fused stepper keeps it across re-binds, and an
+        # executor reference would pin the old buffers after reshape
+        head_names = list(self._head_names)
 
         def fn(arg_vals, aux_vals, key):
             env = {}
@@ -147,7 +151,7 @@ class Executor:
                     env[nm] = o
                     if monitor is not None:
                         monitor(nm, o)
-            heads = [env[h] for h in self._head_names]
+            heads = [env[h] for h in head_names]
             return heads, [new_aux[n] for n in aux_names]
 
         return fn
@@ -200,15 +204,25 @@ class Executor:
         self.outputs = [_wrap(h) for h in heads]
         self._last_key = key
         self._last_is_train = bool(is_train)
+        if self._last_is_train:
+            # train-step dispatch accounting (ISSUE 3 regression surface):
+            # counted here at the dispatch site so manual loops and
+            # BucketingModule report the same 2+P as Module.forward_backward
+            from . import telemetry
+
+            telemetry.note_dispatch(1, path="legacy")
         if _pt0 is not None:
             # duration = trace+enqueue (async dispatch), same caveat as the
             # eager per-op events; the XLA device timeline is use_xla_trace
             _prof._emit_op("Executor::Forward", _pt0, _prof._now_us() - _pt0)
         return self.outputs
 
-    def backward(self, out_grads=None, is_train=True):
+    def backward(self, out_grads=None, is_train=None):
         """Gradients into grad arrays per grad_req (reference
-        GraphExecutor::Backward; the Gradient pass is jax.vjp here)."""
+        GraphExecutor::Backward; the Gradient pass is jax.vjp here).
+
+        ``is_train=None`` (default) differentiates in the mode the last
+        forward ran in; passing an explicit bool overrides it."""
         import jax
         import jax.numpy as jnp
 
@@ -232,9 +246,17 @@ class Executor:
             if isinstance(out_grads, (NDArray, np.ndarray)):
                 out_grads = [out_grads]
             cts_in = [g._data if isinstance(g, NDArray) else jnp.asarray(g) for g in out_grads]
-        cache_key = (diff_names, ones_ct)
-        if self._bwd_cache is None or self._bwd_cache[0] != cache_key:
-            fn = self._graph_fn(True)
+        # differentiate in the mode the last forward actually ran in — a
+        # backward after forward(is_train=False) must see eval-mode
+        # BatchNorm/Dropout, not a silently re-traced train graph
+        if is_train is None:
+            mode = getattr(self, "_last_is_train", True)
+        else:
+            mode = bool(is_train)
+        cache_key = (diff_names, ones_ct, mode)
+        bwd_fn = self._bwd_cache.get(cache_key)
+        if bwd_fn is None:
+            fn = self._graph_fn(mode)
             arg_names = list(self._arg_names)
             dset = set(diff_names)
             const_names = [n for n in arg_names if n not in dset]
@@ -251,8 +273,7 @@ class Executor:
                 (grads,) = vjp_fn(c)
                 return grads
 
-            self._bwd_cache = (cache_key, jax.jit(bwd))
-        bwd_fn = self._bwd_cache[1]
+            bwd_fn = self._bwd_cache[cache_key] = jax.jit(bwd)
         dset = set(diff_names)
         grads = bwd_fn(
             [self.arg_dict[n]._data for n in diff_names],
@@ -270,6 +291,9 @@ class Executor:
                 tgt._rebind(tgt._data + g)
             else:
                 tgt._rebind(g)
+        from . import telemetry
+
+        telemetry.note_dispatch(1, path="legacy")
         if _pt0 is not None:
             _prof._emit_op("Executor::Backward", _pt0,
                            _prof._now_us() - _pt0)

@@ -103,7 +103,11 @@ class BaseModule:
 
     # -- shared conveniences -------------------------------------------------
     def forward_backward(self, data_batch):
-        """Reference base_module.py:192."""
+        """Reference base_module.py:192 — the legacy two-dispatch step.
+        ``Module`` overrides this with the fused-step staging fast path
+        (module/fused_step.py): when eligible, forward+backward+update run
+        as one donated jit dispatch inside ``update()``; the ``fit`` loop
+        below drives either path identically."""
         self.forward(data_batch, is_train=True)
         self.backward()
 

@@ -1,0 +1,261 @@
+"""Fused train-step executor for the symbolic Module stack (ISSUE 3).
+
+The legacy Module step runs the forward graph TWICE (``Executor.forward``
+dispatches it, ``Executor.backward`` re-traces it inside ``jax.vjp``) and
+then issues a per-parameter storm of tiny eager optimizer dispatches
+(``model._update_params``), with zero buffer donation.  This module collapses
+the whole training step into ONE donated jit dispatch — the whole-graph
+fusion win TVM/Relay demonstrate, and the idiom the gluon path already
+proves in ``gluon.functional.make_train_step``:
+
+    (params, grads_in, opt_state, aux, data, key, lr, wd)
+        -> (new_params, new_opt_state, new_aux, outputs, grads)
+
+- loss heads AND gradients come from a single ``jax.vjp`` pass over the
+  executor's graph function (no duplicated forward);
+- the optimizer update is folded into the same graph through the pure
+  kernels in ``ops.optimizer_ops`` (``fused_update``), with per-parameter
+  lr/wd (schedulers, ``lr_mult``/``wd_mult``) arriving as TRACED vectors so
+  decays cost zero recompiles;
+- BatchNorm aux statistics fold back functionally, exactly like the legacy
+  forward;
+- param / grad / optimizer-state / aux buffers are donated, so steady-state
+  HBM traffic matches an in-place engine;
+- jax.jit caches per shape signature: ``Module.reshape`` costs exactly one
+  retrace, switching back costs none.
+
+``Module.forward_backward`` stages the batch, ``Module.update`` dispatches;
+eligibility and the ``MXNET_MODULE_FUSED_STEP`` escape hatch live here (see
+``fused_ineligible_reason`` and docs/PERF_NOTES.md "Fused Module train
+step").  Fallbacks route through the untouched legacy path and are counted
+in the telemetry registry (``module_fused_fallback_total{reason}``).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import telemetry
+from ..base import env_flag
+from ..ndarray.ndarray import NDArray, _wrap
+
+__all__ = ["FusedStepper", "fused_enabled", "fused_ineligible_reason"]
+
+
+def fused_enabled():
+    """``MXNET_MODULE_FUSED_STEP`` gate (docs/ENV_VARS.md) — default ON."""
+    return env_flag("MXNET_MODULE_FUSED_STEP", default="1")
+
+
+def fused_ineligible_reason(module):
+    """None when the fused path can take this Module's next train step, else
+    a short tag naming why not (doubles as the fallback-counter label).
+
+    The conditions mirror what the fused graph cannot express: a monitor
+    needs un-jitted per-node callbacks, ``grad_req`` mixes ("add"/"null")
+    need the executor's accumulate-into-buffer semantics, kvstore updates
+    leave the device, a mesh feed shards through the legacy forward, and
+    optimizers without a ``fused_step_kind`` carry host-side state.
+    Explicit ``backward(out_grads=...)`` calls never reach here — only
+    ``forward_backward`` stages fused steps, so user-supplied head
+    cotangents always take the legacy path by construction.
+    """
+    if not fused_enabled():
+        return "disabled"
+    if not module.optimizer_initialized:
+        return "no_optimizer"
+    if module._exec is None or module._exec._monitor is not None:
+        return "monitor"
+    if module._mesh is not None:
+        return "mesh"
+    if module._kvstore is not None or module._update_on_kvstore:
+        return "kvstore"
+    if module._updater is None:
+        return "no_optimizer"
+    if module.inputs_need_grad:
+        return "inputs_need_grad"
+    req = module._exec._grad_req
+    for n in module._param_names:
+        if req.get(n, "null") != "write":
+            return "grad_req"
+        if module._exec.grad_dict.get(n) is None:
+            return "grad_req"
+    opt = module._optimizer
+    if opt is None or opt.fused_step_kind() is None:
+        return "optimizer"
+    return None
+
+
+def _hp_signature(opt):
+    """The optimizer hyperparams the fused graph folds in as constants
+    (lr/wd stay live — they enter as traced vectors every step).  The
+    Module rebuilds the stepper when this changes, so mutating e.g.
+    ``rescale_grad`` or ``momentum`` mid-run behaves like the legacy path
+    instead of silently using stale values."""
+    kind = opt.fused_step_kind()
+    sig = (kind, float(opt.rescale_grad),
+           None if opt.clip_gradient is None else float(opt.clip_gradient))
+    if kind == "sgd":
+        sig += (float(opt.momentum),)
+    elif kind == "adam":
+        sig += (float(opt.beta1), float(opt.beta2), float(opt.epsilon))
+    return sig
+
+
+def _state_leaves(state):
+    """Flatten one Updater state slot (None | NDArray | tuple) to a list of
+    jax arrays for the jitted step."""
+    if state is None:
+        return []
+    if isinstance(state, NDArray):
+        return [state._data]
+    return [s._data for s in state]
+
+
+def _commit_state(state, new_leaves):
+    """Write the fused step's returned state leaves back into the Updater's
+    NDArrays (keeps save/load_optimizer_states working unchanged)."""
+    if state is None:
+        assert not new_leaves
+        return
+    if isinstance(state, NDArray):
+        state._rebind(new_leaves[0])
+        return
+    for s, v in zip(state, new_leaves):
+        s._rebind(v)
+
+
+def _build_step_fn(graph_fn, arg_names, diff_names, const_names, kind, hp):
+    """The pure fused step: one vjp over the executor graph + the in-graph
+    optimizer fold.  Closed over only static structure (names, kind, static
+    hyperparams) so one jitted instance survives re-binds of the same
+    symbol and re-traces only on new shape signatures."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.optimizer_ops import fused_update
+
+    def step(diff_vals, grads_in, opt_state, aux_vals, const_vals, key,
+             lr_vec, wd_vec):
+        # grads_in is donated purely so XLA can recycle the standing grad
+        # buffers for the returned gradients
+        del grads_in
+
+        def f(dvals):
+            env = dict(zip(const_names, const_vals))
+            env.update(zip(diff_names, dvals))
+            return graph_fn([env[n] for n in arg_names], aux_vals, key)
+
+        heads, vjp_fn, new_aux = jax.vjp(f, diff_vals, has_aux=True)
+        (grads,) = vjp_fn([jnp.ones_like(h) for h in heads])
+        new_params, new_state = [], []
+        for i, (w, g) in enumerate(zip(diff_vals, grads)):
+            st = tuple(opt_state[i])
+            # like sgd_rule: a parameter updates with momentum iff it HAS a
+            # momentum slot (created when the optimizer's momentum was set),
+            # so mid-run momentum edits behave exactly like the legacy path
+            k = ("sgd_mom" if st else "sgd") if kind == "sgd" else kind
+            new_w, new_st = fused_update(k, w, g, st,
+                                         lr=lr_vec[i], wd=wd_vec[i], **hp)
+            new_params.append(new_w)
+            new_state.append(list(new_st))
+        return new_params, new_state, new_aux, heads, grads
+
+    return step
+
+
+class FusedStepper:
+    """Per-Module fused-step cache: builds the jitted step once (per
+    optimizer configuration) and re-dispatches it for every eligible step;
+    jax.jit's executable cache provides the per-shape-signature caching."""
+
+    def __init__(self, module):
+        import jax
+
+        exec_ = module._exec
+        opt = module._optimizer
+        self._opt = opt
+        self._kind = opt.fused_step_kind()
+        assert self._kind is not None
+        self._hp_sig = _hp_signature(opt)
+        self._arg_names = list(exec_._arg_names)
+        self._aux_names = list(exec_._aux_names)
+        self._diff_names = list(module._param_names)
+        dset = set(self._diff_names)
+        self._const_names = [n for n in self._arg_names if n not in dset]
+        hp = {"rescale_grad": float(opt.rescale_grad),
+              "clip_gradient": (-1.0 if opt.clip_gradient is None
+                                else float(opt.clip_gradient))}
+        if self._kind == "sgd":
+            hp["momentum"] = float(opt.momentum)
+        elif self._kind == "adam":
+            hp.update(beta1=float(opt.beta1), beta2=float(opt.beta2),
+                      epsilon=float(opt.epsilon))
+        fn = _build_step_fn(exec_._graph_fn(True), self._arg_names,
+                            self._diff_names, self._const_names,
+                            self._kind, hp)
+        self._jit = jax.jit(fn, donate_argnums=(0, 1, 2, 3))
+        # compile/steady-state accounting (identity when telemetry is off)
+        self._step = telemetry.instrument_step(self._jit,
+                                               name="module_fused_step")
+
+    def cache_size(self):
+        """Number of compiled executables (one per shape signature)."""
+        size = getattr(self._jit, "_cache_size", None)
+        return size() if size is not None else None
+
+    def stale(self, module):
+        """True when the Module's optimizer (or a folded-in hyperparam)
+        changed since this stepper was built — caller rebuilds."""
+        return (module._optimizer is not self._opt
+                or _hp_signature(module._optimizer) != self._hp_sig)
+
+    def run(self, module):
+        """Dispatch ONE fused step over the feed already staged in the
+        executor's arg buffers, then commit params / optimizer state / aux /
+        outputs / grads.  Consumes exactly one RNG key (like the legacy
+        forward), so seeded runs stay reproducible across paths."""
+        from .. import random as _rnd
+
+        exec_ = module._exec
+        opt = self._opt
+        updater = module._updater
+        diff_vals = [exec_.arg_dict[n]._data for n in self._diff_names]
+        grads_in = [exec_.grad_dict[n]._data for n in self._diff_names]
+        const_vals = [exec_.arg_dict[n]._data for n in self._const_names]
+        aux_vals = [exec_.aux_dict[n]._data for n in self._aux_names]
+        states, leaves = [], []
+        for i, n in enumerate(self._diff_names):
+            if i not in updater.states:
+                updater.states[i] = opt.create_state(i, exec_.arg_dict[n])
+                updater.states_synced[i] = True
+            states.append(updater.states[i])
+            leaves.append(_state_leaves(updater.states[i]))
+        # host-side hyperparam prep, O(P) python and zero dispatches: update
+        # counts first (the legacy Updater order), then read lr/wd through
+        # the optimizer's scheduler/multiplier logic; adam's bias correction
+        # folds into lr so the in-graph kernel stays schedule-free
+        for i in range(len(self._diff_names)):
+            opt._update_count(i)
+        lrs, wds = [], []
+        for i in range(len(self._diff_names)):
+            lr, wd = opt._get_lr(i), opt._get_wd(i)
+            if self._kind == "adam":
+                t = opt._index_update_count[i]
+                lr *= (1.0 - opt.beta2 ** t) ** 0.5 / (1.0 - opt.beta1 ** t)
+            lrs.append(lr)
+            wds.append(wd)
+        key = _rnd.next_key()
+        new_params, new_state, new_aux, heads, grads = self._step(
+            diff_vals, grads_in, leaves, aux_vals, const_vals, key,
+            np.asarray(lrs, np.float32), np.asarray(wds, np.float32))
+        for n, v in zip(self._diff_names, new_params):
+            exec_.arg_dict[n]._rebind(v)
+        for n, g in zip(self._diff_names, grads):
+            exec_.grad_dict[n]._rebind(g)
+        for n, v in zip(self._aux_names, new_aux):
+            exec_.aux_dict[n]._rebind(v)
+        for st, new_leaves in zip(states, new_state):
+            _commit_state(st, new_leaves)
+        exec_.outputs = [_wrap(h) for h in heads]
+        exec_._last_key = key
+        exec_._last_is_train = True

@@ -16,6 +16,7 @@ import logging
 
 from .. import ndarray as nd
 from .. import optimizer as opt_mod
+from .. import telemetry
 from ..io import DataDesc
 from ..model import (
     _create_kvstore,
@@ -77,6 +78,10 @@ class Module(BaseModule):
         self._updater = None
         self._preload_opt_states = None
         self._grad_req = "write"
+        # fused train-step state (ISSUE 3, module/fused_step.py): the cached
+        # stepper and the staged-batch flag forward_backward hands update()
+        self._fused = None
+        self._fused_pending = False
 
     # -- properties ----------------------------------------------------------
     @property
@@ -246,8 +251,11 @@ class Module(BaseModule):
             self.params_initialized = True
 
     def reshape(self, data_shapes, label_shapes=None):
-        """Re-bind for new shapes, keeping params (reference module.py:452)."""
+        """Re-bind for new shapes, keeping params (reference module.py:452).
+        The fused stepper survives re-binds of the same symbol — jax.jit
+        re-traces once per new shape signature and caches it."""
         assert self.binded
+        self._flush_pending()
         params_were_init = self.params_initialized
         self._sync_params_from_exec() if params_were_init else None
         self.bind(data_shapes, label_shapes, self.for_training, self.inputs_need_grad,
@@ -261,6 +269,7 @@ class Module(BaseModule):
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring...")
             return
+        self._fused = None  # stepper folds optimizer hyperparams: rebuild
 
         kv, update_on_kvstore = _create_kvstore(
             kvstore, 1, {n: self._exec.arg_dict[n] for n in self._param_names}
@@ -310,14 +319,15 @@ class Module(BaseModule):
             self._preload_opt_states = None
 
     # -- compute ---------------------------------------------------------------
-    def forward(self, data_batch, is_train=None):
-        assert self.binded and self.params_initialized
-        if is_train is None:
-            is_train = self.for_training
+    def _stage_batch(self, data_batch):
+        """Reshape-on-new-batch-shape (MutableModule semantics) + write the
+        batch feed into the executor's arg buffers.  Shared by ``forward``
+        and the fused ``forward_backward`` staging (module/fused_step.py).
 
-        # MutableModule semantics: reshape on a new batch shape.  Any object
-        # with a .data list is a valid batch (reference module.py duck-types
-        # the same way — example/python-howto/debug_conv.py SimpleData)
+        Any object with a ``.data`` list is a valid batch (reference
+        module.py duck-types the same way —
+        example/python-howto/debug_conv.py SimpleData).
+        """
         provide = getattr(data_batch, "provide_data", None)
         new_descs = _as_descs(provide) if provide else [
             DataDesc(n, a.shape) for n, a in zip(self._data_names, data_batch.data)
@@ -353,15 +363,74 @@ class Module(BaseModule):
                          ("dp",) + (None,) * (len(v.shape) - 1), mesh=self._mesh)
                 for k, v in feed.items()
             }
-        self._exec.forward(is_train=is_train, **feed)
+        for k, v in feed.items():
+            self._exec.arg_dict[k] = v if isinstance(v, nd.NDArray) else nd.array(v)
+
+    def _flush_pending(self):
+        """Materialize a staged fused step through the legacy path — a
+        consumer asked for outputs/grads (or issued another forward) before
+        ``update()`` could dispatch the fused step."""
+        if not self._fused_pending:
+            return
+        self._fused_pending = False
+        telemetry.note_fused_fallback("interleaved")
+        self._exec.forward(is_train=True)
+        self._exec.backward()
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        self._flush_pending()
+        if is_train is None:
+            is_train = self.for_training
+        self._stage_batch(data_batch)
+        self._exec.forward(is_train=is_train)
+
+    def forward_backward(self, data_batch):
+        """Reference base_module.py:192 — plus the ISSUE 3 fused fast path:
+        when eligible the batch is only STAGED here, and forward + backward
+        + optimizer update execute as ONE donated jit dispatch inside
+        ``update()`` (module/fused_step.py; escape hatch
+        ``MXNET_MODULE_FUSED_STEP=0``, fallback conditions in
+        docs/PERF_NOTES.md "Fused Module train step")."""
+        assert self.binded and self.params_initialized
+        self._flush_pending()
+        from .fused_step import fused_ineligible_reason
+
+        reason = fused_ineligible_reason(self)
+        if reason is None:
+            self._stage_batch(data_batch)
+            self._fused_pending = True
+            return
+        # the legacy step's own forward/backward dispatches are counted at
+        # the Executor dispatch sites, the optimizer storm in model.py
+        telemetry.note_fused_fallback(reason)
+        super().forward_backward(data_batch)
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
+        self._flush_pending()
         self._exec.backward(out_grads=out_grads)
 
     def update(self):
-        """Apply one optimizer step (reference module.py:643)."""
+        """Apply one optimizer step (reference module.py:643).
+
+        With a fused step staged by ``forward_backward`` this is the single
+        compiled dispatch of the whole training step; otherwise the legacy
+        kvstore/Updater per-parameter loop runs."""
         assert self.binded and self.params_initialized and self.optimizer_initialized
+        if self._fused_pending:
+            self._fused_pending = False
+            from .fused_step import FusedStepper
+
+            if self._fused is not None and self._fused.stale(self):
+                self._fused = None
+            if self._fused is None:
+                self._fused = FusedStepper(self)
+            self._fused.run(self)
+            telemetry.note_train_step("fused")
+            telemetry.note_dispatch(1, path="fused")
+            return
+        telemetry.note_train_step("legacy")
         param_arrays = [self._exec.arg_dict[n] for n in self._param_names]
         grad_arrays = [self._exec.grad_dict.get(n) for n in self._param_names]
         if self._kvstore and self._update_on_kvstore:
@@ -372,10 +441,12 @@ class Module(BaseModule):
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded
+        self._flush_pending()
         return list(self._exec.outputs)
 
     def get_input_grads(self, merge_multi_context=True):
         assert self.binded and self.inputs_need_grad
+        self._flush_pending()
         return [self._exec.grad_dict[n] for n in self._data_names]
 
     def update_metric(self, eval_metric, labels):
@@ -396,6 +467,7 @@ class Module(BaseModule):
 
     def install_monitor(self, mon):
         assert self.binded
+        self._flush_pending()  # a monitor makes future steps legacy-path
         mon.install(self._exec)
 
     # -- checkpointing ----------------------------------------------------------

@@ -146,6 +146,45 @@ def signsgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0, clip_gradient=
     return weight - lr * (jnp.sign(g) + wd * weight)
 
 
+def fused_update(kind, weight, grad, state, *, lr, wd, rescale_grad=1.0,
+                 clip_gradient=-1.0, momentum=0.0, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8):
+    """Pure ``(w, g, state_tuple) -> (w', state_tuple')`` dispatcher over the
+    registered update kernels — the in-graph half of the Module fused train
+    step (``module/fused_step.py``), where it runs once per parameter inside
+    ONE donated jit alongside forward+vjp.
+
+    ``lr``/``wd`` may be traced scalars; for ``adam`` the caller passes
+    ``lr`` already bias-corrected (``lr * sqrt(1-b2^t)/(1-b1^t)``, the
+    ``optimizer.adam_rule`` schedule) so the kernel runs with identity
+    rescale.  ``state`` matches the optimizer's ``create_state`` order:
+    ``()`` for sgd, ``(mom,)`` for sgd_mom, ``(mean, var)`` for adam.
+    """
+    if kind == "sgd":
+        new_w = sgd_update(weight, grad, lr=lr, wd=wd,
+                           rescale_grad=rescale_grad,
+                           clip_gradient=clip_gradient)
+        return new_w, ()
+    if kind == "sgd_mom":
+        new_w, new_mom = sgd_mom_update(weight, grad, state[0], lr=lr,
+                                        momentum=momentum, wd=wd,
+                                        rescale_grad=rescale_grad,
+                                        clip_gradient=clip_gradient)
+        return new_w, (new_mom,)
+    if kind == "adam":
+        # optimizer.Adam semantics clip BEFORE adding wd (its _preprocess +
+        # adam_rule), while the adam_update kernel clips after — pre-scale
+        # and clip here, then run the kernel with identity prep
+        g = grad * rescale_grad
+        if clip_gradient is not None and clip_gradient >= 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        new_w, new_m, new_v = adam_update(
+            weight, g, state[0], state[1], lr=lr, beta1=beta1, beta2=beta2,
+            epsilon=epsilon, wd=wd, rescale_grad=1.0, clip_gradient=-1.0)
+        return new_w, (new_m, new_v)
+    raise ValueError("unsupported fused optimizer kind %r" % (kind,))
+
+
 @register("signum_update", mutates=("mom",))
 def signum_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0, rescale_grad=1.0,
                   clip_gradient=-1.0, wd_lh=0.0):

@@ -151,6 +151,15 @@ class Optimizer:
     def update(self, index, weight, grad, state):
         raise NotImplementedError
 
+    def fused_step_kind(self):
+        """Kind tag consumed by the Module fused train step
+        (``module/fused_step.py`` + ``ops.optimizer_ops.fused_update``), or
+        None when this optimizer's update cannot be folded into the jitted
+        step graph (stateful host logic, sparse-only rules, multi-precision
+        master-weight tuples) — the Module then routes through the legacy
+        per-parameter Updater path."""
+        return None
+
     def create_state_multi_precision(self, index, weight):
         """f32 master weights for low-precision params (reference :201-249)."""
         import jax.numpy as jnp
@@ -369,6 +378,15 @@ class SGD(Optimizer):
         self.momentum = momentum
         self.lazy_update = lazy_update
 
+    def fused_step_kind(self):
+        # subclasses (LBSGD) override update() with host-side logic the
+        # fused graph can't reproduce — only plain SGD folds in.  One kind
+        # for both momentum modes: like sgd_rule, the fused kernel picks
+        # plain-vs-momentum per parameter from the presence of a state slot
+        if type(self) is not SGD or self.multi_precision:
+            return None
+        return "sgd"
+
     def create_state(self, index, weight):
         if self.momentum == 0.0:
             return None
@@ -514,6 +532,11 @@ class Adam(Optimizer):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
         self.lazy_update = lazy_update
+
+    def fused_step_kind(self):
+        if type(self) is not Adam or self.multi_precision:
+            return None
+        return "adam"
 
     def create_state(self, index, weight):
         return (_zeros_like_nd(weight), _zeros_like_nd(weight))

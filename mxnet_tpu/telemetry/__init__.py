@@ -20,8 +20,9 @@ from .sinks import (JsonlSink, PrometheusSink, ProfilerSink, Sink,
 from .instrument import (ServeProbe, StepProbe, add_sink, array_nbytes,
                          counter, enabled, event, flush, gauge, histogram,
                          instrument_step, interval_s, jsonl_path, note_bytes,
-                         note_compile, registry, sample_memory, serve_probe,
-                         step_probe, summary)
+                         note_compile, note_dispatch, note_fused_fallback,
+                         note_train_step, registry, sample_memory,
+                         serve_probe, step_probe, summary)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricError", "Registry",
@@ -30,6 +31,7 @@ __all__ = [
     "iter_scalar_samples", "render_prometheus",
     "ServeProbe", "StepProbe", "add_sink", "array_nbytes", "counter",
     "enabled", "event", "flush", "gauge", "histogram", "instrument_step",
-    "interval_s", "jsonl_path", "note_bytes", "note_compile", "registry",
+    "interval_s", "jsonl_path", "note_bytes", "note_compile",
+    "note_dispatch", "note_fused_fallback", "note_train_step", "registry",
     "sample_memory", "serve_probe", "step_probe", "summary",
 ]

@@ -29,17 +29,17 @@ import os
 import threading
 import time
 
+from ..base import env_flag
 from .registry import Registry
 from .sinks import JsonlSink
 
 __all__ = ["enabled", "jsonl_path", "interval_s", "registry", "add_sink",
            "counter", "gauge", "histogram", "event", "flush",
            "instrument_step", "note_compile", "note_bytes", "array_nbytes",
+           "note_dispatch", "note_train_step", "note_fused_fallback",
            "sample_memory", "step_probe", "StepProbe", "summary",
            "serve_probe", "ServeProbe", "SERVE_LATENCY_BUCKETS",
            "FRACTION_BUCKETS"]
-
-_FALSY = ("", "0", "false", "no", "off")
 
 _mu = threading.Lock()
 _registry = None
@@ -48,8 +48,9 @@ _atexit_registered = False
 
 def enabled():
     """MXNET_TELEMETRY gate — read per call so tests can flip it; one dict
-    lookup, cheap enough for a per-batch guard."""
-    return os.environ.get("MXNET_TELEMETRY", "0").strip().lower() not in _FALSY
+    lookup, cheap enough for a per-batch guard (base.env_flag, the shared
+    falsy-string rule for all MXNET_* boolean gates)."""
+    return env_flag("MXNET_TELEMETRY")
 
 
 def jsonl_path():
@@ -195,6 +196,37 @@ def note_compile(seconds, fn="step"):
               "wall seconds spent in calls that compiled",
               ("fn",)).inc(float(seconds), fn=fn)
     r.event("compile", fn=fn, seconds=round(float(seconds), 6))
+
+
+# -- train-step dispatch accounting (ISSUE 3 fused Module step) --------------
+def note_dispatch(n=1, path="legacy"):
+    """Count ``n`` compiled device dispatches issued by a train-step path
+    (``path``: "fused" = the one donated Module fused-step jit, "legacy" =
+    executor forward/backward + the per-parameter optimizer storm).  The
+    bench telemetry block derives ``dispatches_per_step`` from this."""
+    if not enabled():
+        return
+    registry().counter("step_dispatches_total",
+                       "compiled dispatches issued by train-step paths",
+                       ("path",)).inc(n, path=path)
+
+
+def note_train_step(path):
+    """Count one Module training step on the given path (fused|legacy)."""
+    if not enabled():
+        return
+    registry().counter("train_steps_total", "module train steps",
+                       ("path",)).inc(path=path)
+
+
+def note_fused_fallback(reason):
+    """Count one forward_backward routed to the legacy path, labeled with
+    the eligibility reason (module/fused_step.fused_ineligible_reason)."""
+    if not enabled():
+        return
+    registry().counter("module_fused_fallback_total",
+                       "train steps that fell back to the legacy path",
+                       ("reason",)).inc(reason=reason)
 
 
 def note_bytes(counter_name, nbytes, **labels):
@@ -399,7 +431,7 @@ def serve_probe(engine):
 # -- bench summary ------------------------------------------------------------
 def summary():
     """The bench.py ``telemetry`` block: compile_s, peak_hbm_bytes,
-    data_wait_frac — None when telemetry is disabled."""
+    data_wait_frac, dispatches_per_step — None when telemetry is disabled."""
     if not enabled():
         return None
     r = registry()
@@ -410,6 +442,13 @@ def summary():
         "jit_dispatch_seconds_total", 0.0) + r.total(
         "jit_compile_seconds_total", 0.0)
     frac = wait / (wait + busy) if (wait + busy) > 0 else 0.0
+    # ISSUE 3 regression surface: fused Module steps dispatch once, legacy
+    # steps 2+P (forward + backward + per-parameter optimizer storm); null
+    # when no note_train_step/note_dispatch producer ran (e.g. gluon-only
+    # benches, whose step is one dispatch by construction)
+    steps = r.total("train_steps_total", 0.0)
+    disp = r.total("step_dispatches_total", 0.0)
     return {"compile_s": round(compile_s, 3),
             "peak_hbm_bytes": int(peak) if peak is not None else None,
-            "data_wait_frac": round(frac, 4)}
+            "data_wait_frac": round(frac, 4),
+            "dispatches_per_step": round(disp / steps, 2) if steps else None}

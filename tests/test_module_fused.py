@@ -1,0 +1,277 @@
+"""Fused Module train step (ISSUE 3, module/fused_step.py).
+
+Coverage demanded by the issue:
+- fused-vs-legacy numerical parity after N steps for sgd, momentum sgd and
+  adam — including BatchNorm aux updates and a Dropout graph (same
+  per-node folded key on both paths);
+- the fallback cases (monitor installed, grad_req mix, kvstore update)
+  still route through the legacy path;
+- acceptance: one training step on the fused path issues exactly ONE
+  compiled device dispatch (jit cache entries + telemetry counters).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import module as mod_mod
+from mxnet_tpu.io import DataBatch
+from mxnet_tpu.module import fused_step
+from mxnet_tpu.telemetry import instrument as tin
+
+STEPS = 5
+BATCH = 8
+
+
+def _sym(bn=True, dropout=True):
+    data = mx.sym.var("data")
+    # no_bias under BN: a bias there has an exactly-zero true gradient, and
+    # adam turns float noise on a zero gradient into arbitrary-signed
+    # +-lr*step drift on ANY two differently-compiled runs — a degenerate
+    # parametrization, not a path difference (docs/PERF_NOTES.md)
+    x = mx.sym.FullyConnected(data, name="fc1", num_hidden=16, no_bias=bn)
+    if bn:
+        x = mx.sym.BatchNorm(x, name="bn1")
+    x = mx.sym.Activation(x, name="relu1", act_type="relu")
+    if dropout:
+        x = mx.sym.Dropout(x, name="drop1", p=0.5)
+    x = mx.sym.FullyConnected(x, name="fc2", num_hidden=4)
+    return mx.sym.SoftmaxOutput(x, name="softmax")
+
+
+def _batches(steps=STEPS, batch=BATCH, dim=8):
+    rng = np.random.RandomState(7)
+    return [
+        DataBatch(data=[mx.nd.array(rng.randn(batch, dim).astype(np.float32))],
+                  label=[mx.nd.array(rng.randint(0, 4, (batch,)).astype(np.float32))])
+        for _ in range(steps)
+    ]
+
+
+def _make_module(sym=None, **kwargs):
+    mod = mod_mod.Module(sym if sym is not None else _sym(), **kwargs)
+    mod.bind(data_shapes=[("data", (BATCH, 8))],
+             label_shapes=[("softmax_label", (BATCH,))])
+    rng = np.random.RandomState(3)
+    shapes = {n: a.shape for n, a in mod._exec.arg_dict.items()}
+    arg = {n: mx.nd.array(rng.randn(*shapes[n]).astype(np.float32) * 0.1)
+           for n in sorted(mod._param_names)}
+    mod.init_params(arg_params=arg)
+    return mod
+
+
+def _train(monkeypatch, fused, optimizer, opt_params, sym=None, steps=STEPS):
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1" if fused else "0")
+    mx.random.seed(11)  # same per-step key sequence on both paths
+    mod = _make_module(sym)
+    mod.init_optimizer(optimizer=optimizer, optimizer_params=dict(opt_params))
+    for b in _batches(steps):
+        mod.forward_backward(b)
+        mod.update()
+    arg_params, aux_params = mod.get_params()
+    return ({n: v.asnumpy() for n, v in arg_params.items()},
+            {n: v.asnumpy() for n, v in aux_params.items()},
+            mod.get_outputs()[0].asnumpy(), mod)
+
+
+@pytest.mark.parametrize("optimizer,opt_params", [
+    ("sgd", {"learning_rate": 0.1}),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.01}),
+], ids=["sgd", "sgd_mom", "adam"])
+def test_fused_legacy_parity(monkeypatch, optimizer, opt_params):
+    """Identical params after N steps — BatchNorm aux and Dropout included
+    (both paths consume one RNG key per step and fold the same per-node
+    crc32 streams, so the masks match)."""
+    arg_f, aux_f, out_f, mod_f = _train(monkeypatch, True, optimizer, opt_params)
+    arg_l, aux_l, out_l, mod_l = _train(monkeypatch, False, optimizer, opt_params)
+    assert mod_f._fused is not None, "fused path never engaged"
+    assert mod_l._fused is None, "legacy run built a fused stepper"
+    for n in arg_f:
+        np.testing.assert_allclose(arg_f[n], arg_l[n], rtol=2e-5, atol=1e-6,
+                                   err_msg="param %s" % n)
+    for n in aux_f:
+        np.testing.assert_allclose(aux_f[n], aux_l[n], rtol=2e-5, atol=1e-6,
+                                   err_msg="aux %s" % n)
+    np.testing.assert_allclose(out_f, out_l, rtol=2e-5, atol=1e-6)
+    # aux actually moved (BatchNorm stats trained, not just preserved)
+    assert any(np.abs(v).max() > 1e-4 for v in aux_f.values())
+
+
+def test_momentum_state_matches_legacy_updater(monkeypatch):
+    """Fused steps maintain the very Updater states save_optimizer_states
+    pickles — switching paths mid-run stays consistent."""
+    _, _, _, mod_f = _train(monkeypatch, True, "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    _, _, _, mod_l = _train(monkeypatch, False, "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    for i in mod_l._updater.states:
+        np.testing.assert_allclose(mod_f._updater.states[i].asnumpy(),
+                                   mod_l._updater.states[i].asnumpy(),
+                                   rtol=2e-5, atol=1e-6)
+    assert mod_f._optimizer.num_update == mod_l._optimizer.num_update
+
+
+# -- fallback routing ---------------------------------------------------------
+def _assert_legacy_step(mod, batch):
+    """forward_backward must execute immediately (legacy), not stage."""
+    mod.forward_backward(batch)
+    assert not mod._fused_pending
+    assert mod._fused is None
+    mod.update()
+    assert mod._fused is None
+
+
+def test_fallback_env_disabled(monkeypatch):
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "0")
+    assert not fused_step.fused_enabled()
+    mod = _make_module()
+    mod.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 0.1})
+    _assert_legacy_step(mod, _batches(1)[0])
+
+
+def test_fallback_monitor(monkeypatch):
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    mod = _make_module()
+    mod.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 0.1})
+    mod.install_monitor(mx.monitor.Monitor(1, stat_func=lambda x: x,
+                                           pattern=".*"))
+    assert fused_step.fused_ineligible_reason(mod) == "monitor"
+    _assert_legacy_step(mod, _batches(1)[0])
+
+
+def test_fallback_grad_req_mix(monkeypatch):
+    """fixed_param_names makes grad_req a write/null mix — legacy path, and
+    the fixed param must stay fixed."""
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    mod = _make_module(_sym(bn=False), fixed_param_names=["fc1_weight"])
+    mod.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 1.0})
+    assert fused_step.fused_ineligible_reason(mod) == "grad_req"
+    before = mod.get_params()[0]["fc1_weight"].asnumpy()
+    _assert_legacy_step(mod, _batches(1)[0])
+    np.testing.assert_allclose(mod.get_params()[0]["fc1_weight"].asnumpy(),
+                               before)
+
+
+def test_fallback_kvstore(monkeypatch):
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    mod = _make_module()
+    mod.init_optimizer(kvstore=mx.kv.create("local"), optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    assert fused_step.fused_ineligible_reason(mod) == "kvstore"
+    w0 = mod.get_params()[0]["fc2_weight"].asnumpy()
+    _assert_legacy_step(mod, _batches(1)[0])
+    assert not np.allclose(mod.get_params()[0]["fc2_weight"].asnumpy(), w0)
+
+
+def test_fallback_unsupported_optimizer(monkeypatch):
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    mod = _make_module()
+    mod.init_optimizer(optimizer="rmsprop",
+                       optimizer_params={"learning_rate": 0.01})
+    assert fused_step.fused_ineligible_reason(mod) == "optimizer"
+    _assert_legacy_step(mod, _batches(1)[0])
+
+
+def test_interleaved_access_flushes_through_legacy(monkeypatch):
+    """get_outputs between forward_backward and update materializes the
+    staged step on the legacy path; the whole step still matches a pure
+    legacy run."""
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    mx.random.seed(11)
+    mod = _make_module()
+    mod.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 0.1})
+    b = _batches(1)[0]
+    mod.forward_backward(b)
+    assert mod._fused_pending
+    out = mod.get_outputs()[0]          # interleaved read: flush
+    assert not mod._fused_pending
+    assert out.shape == (BATCH, 4)
+    mod.update()                        # legacy loop on the flushed grads
+    arg_i = {n: v.asnumpy() for n, v in mod.get_params()[0].items()}
+
+    arg_l, _, out_l, _ = _train(monkeypatch, False, "sgd",
+                                {"learning_rate": 0.1}, steps=1)
+    for n in arg_i:
+        np.testing.assert_allclose(arg_i[n], arg_l[n], rtol=2e-5, atol=1e-6,
+                                   err_msg=n)
+    np.testing.assert_allclose(out.asnumpy(), out_l, rtol=2e-5, atol=1e-6)
+
+
+def test_fit_uses_fused_path(monkeypatch):
+    """The stock fit loop (forward_backward -> update -> update_metric)
+    engages the fused path and still trains to threshold."""
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    from mxnet_tpu.io import NDArrayIter
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(200, 8).astype(np.float32)
+    W = rng.randn(8, 4).astype(np.float32)
+    y = np.argmax(X @ W, axis=1).astype(np.float32)
+    train = NDArrayIter(X, y, batch_size=50, shuffle=True,
+                        label_name="softmax_label")
+    mod = mod_mod.Module(_sym(bn=False, dropout=False))
+    mod.fit(train, optimizer="adam", optimizer_params={"learning_rate": 0.02},
+            num_epoch=10)
+    assert mod._fused is not None, "fit never took the fused path"
+    score = mod.score(NDArrayIter(X, y, batch_size=50,
+                                  label_name="softmax_label"), "acc")[0][1]
+    assert score > 0.8, score
+
+
+# -- acceptance: one dispatch per step, counted ------------------------------
+def test_fused_single_dispatch_per_step(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    monkeypatch.setenv("MXNET_TELEMETRY_FILE", str(tmp_path / "t.jsonl"))
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    tin._reset_for_tests()
+    try:
+        mx.random.seed(11)
+        mod = _make_module()
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1,
+                                             "momentum": 0.9})
+        steps = 6
+        for b in _batches(steps):
+            mod.forward_backward(b)
+            mod.update()
+        r = tin.registry()
+        assert r.get("train_steps_total").value(path="fused") == steps
+        # THE acceptance criterion: one compiled dispatch per fused step
+        assert r.get("step_dispatches_total").value(path="fused") == steps
+        assert r.get("step_dispatches_total").value(path="legacy") == 0
+        # one executable for the one shape signature
+        assert mod._fused.cache_size() == 1
+        assert r.get("jit_compiles_total").value(fn="module_fused_step") == 1
+        assert r.get("jit_cache_hits_total").value(fn="module_fused_step") \
+            == steps - 1
+        assert r.get("module_fused_fallback_total") is None
+        # and the bench summary exposes the ratio
+        assert tin.summary()["dispatches_per_step"] == 1.0
+    finally:
+        tin._reset_for_tests()
+
+
+def test_legacy_dispatch_count_counted(monkeypatch, tmp_path):
+    """Legacy step = 2 (fwd+bwd) + P optimizer dispatches — the storm the
+    fused path removes, kept measurable for bench regression tracking."""
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    monkeypatch.setenv("MXNET_TELEMETRY_FILE", str(tmp_path / "t.jsonl"))
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "0")
+    tin._reset_for_tests()
+    try:
+        mx.random.seed(11)
+        mod = _make_module()
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        for b in _batches(2):
+            mod.forward_backward(b)
+            mod.update()
+        r = tin.registry()
+        nparams = len(mod._param_names)
+        assert r.get("train_steps_total").value(path="legacy") == 2
+        assert r.get("step_dispatches_total").value(path="legacy") \
+            == 2 * (2 + nparams)
+        assert r.get("module_fused_fallback_total").value(reason="disabled") == 2
+        assert tin.summary()["dispatches_per_step"] == 2 + nparams
+    finally:
+        tin._reset_for_tests()

@@ -61,6 +61,27 @@ def test_simple_bind_forward_backward():
     assert_almost_equal(exe.grad_dict["b"], np.array([1, 2, 3], dtype=np.float32))
 
 
+def test_backward_honors_eval_mode_forward():
+    """ISSUE 3 satellite: backward after forward(is_train=False) must
+    differentiate the EVAL-mode graph (Dropout = identity, BatchNorm on
+    moving stats) — the recorded mode keys the backward cache, so flipping
+    modes can't reuse the wrong executable."""
+    data = sym.Variable("data")
+    out = sym.Dropout(data, p=0.5, name="do")
+    exe = out.simple_bind(ctx=mx.cpu(), data=(64,))
+    exe.arg_dict["data"][:] = nd.ones((64,))
+    exe.forward(is_train=False)
+    exe.backward(out_grads=nd.ones((64,)))
+    # eval-mode dropout is identity: grad == 1 everywhere (the old code
+    # hardcoded the train graph and produced a 0/2 mask here)
+    assert_almost_equal(exe.grad_dict["data"], np.ones(64, np.float32))
+    exe.forward(is_train=True)
+    exe.backward(out_grads=nd.ones((64,)))
+    g = exe.grad_dict["data"].asnumpy()
+    assert set(np.round(np.unique(g), 4)) <= {0.0, 2.0}
+    assert 0.0 in g and 2.0 in g  # train-mode mask actually applied
+
+
 def test_executor_mlp_forward():
     np.random.seed(0)
     data = sym.Variable("data")

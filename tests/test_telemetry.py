@@ -294,6 +294,29 @@ class TestGating:
         assert {"jit_compile_seconds_total", "data_wait_seconds_total",
                 "step_seconds", "samples_per_sec", "last_loss"} <= names
 
+    def test_summary_dispatches_per_step(self, tel_enabled):
+        """ISSUE 3 regression surface: dispatches/step ratio from the
+        train-step counters — null with no producer, 1.0 fused, 2+P
+        legacy."""
+        assert tin.summary()["dispatches_per_step"] is None
+        tin.note_train_step("legacy")
+        tin.note_dispatch(2, path="legacy")  # fwd+bwd
+        tin.note_dispatch(4, path="legacy")  # per-param optimizer storm
+        assert tin.summary()["dispatches_per_step"] == 6.0
+        tin.note_train_step("fused")
+        tin.note_dispatch(1, path="fused")
+        assert tin.summary()["dispatches_per_step"] == 3.5
+        tin.note_fused_fallback("monitor")
+        assert tin.registry().get("module_fused_fallback_total") \
+            .value(reason="monitor") == 1
+
+    def test_note_helpers_noop_when_disabled(self, tel_disabled):
+        tin.note_dispatch(3, path="legacy")
+        tin.note_train_step("fused")
+        tin.note_fused_fallback("monitor")
+        tin._reset_for_tests()
+        assert tin.registry().get("step_dispatches_total") is None
+
 
 # -- wiring ------------------------------------------------------------------
 class TestWiring:
