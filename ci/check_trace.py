@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""Well-formedness lint for exported chrome-trace JSON (ISSUE 4 CI satellite).
+
+Validates the invariants the span tracer (``mxnet_tpu/telemetry/tracing.py``)
+promises and downstream tools (Perfetto, ``tools/trace_summary.py``,
+``tools/trace_merge.py``) rely on:
+
+* every non-metadata event carries a finite, non-negative ``ts``; every
+  "X" duration event a finite, non-negative ``dur``;
+* per (pid, tid) track, "X" slices nest strictly (a slice may contain or be
+  disjoint from another, never partially overlap) — the chrome-trace
+  rendering contract the tracer's per-trace lanes exist to satisfy;
+* flow events pair up: every flow id has exactly one "s" and at least one
+  "f", and no "f" precedes its "s" (monotonic handoff order).
+
+Usage::
+
+    python ci/check_trace.py mxtrace.json        # validate a file
+    python ci/check_trace.py --smoke             # end-to-end smoke:
+        # serve a few requests + run a couple of train steps with
+        # MXNET_TRACE=1, export, validate, and assert one request's spans
+        # share a trace id across the submit and device-loop threads
+
+The smoke is the unit-tier acceptance run (ci/run_tests.sh).
+"""
+from __future__ import annotations
+
+import argparse
+import gzip
+import json
+import math
+import sys
+
+_EPS_US = 1e-3  # export rounds ts/dur to 1ns; tolerate that much slop
+
+
+def load_events(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt", encoding="utf-8") as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        return data.get("traceEvents", [])
+    return data
+
+
+def _num(x):
+    return isinstance(x, (int, float)) and not isinstance(x, bool) \
+        and math.isfinite(x)
+
+
+def validate(events):
+    """→ list of problem strings (empty = well-formed)."""
+    if not isinstance(events, list):
+        return ["traceEvents is not a list"]
+    problems = []
+    tracks = {}
+    flows = {}
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            problems.append("event %d: not an object" % i)
+            continue
+        ph = ev.get("ph")
+        if not ph:
+            problems.append("event %d: missing ph" % i)
+            continue
+        if ph == "M":
+            continue  # metadata carries no timestamp
+        ts = ev.get("ts")
+        if not _num(ts) or ts < 0:
+            problems.append("event %d (%s %r): bad ts %r"
+                            % (i, ph, ev.get("name"), ts))
+            continue
+        if ph == "X":
+            dur = ev.get("dur")
+            if not _num(dur) or dur < 0:
+                problems.append("event %d (X %r): bad dur %r"
+                                % (i, ev.get("name"), dur))
+                continue
+            tracks.setdefault((ev.get("pid", 0), ev.get("tid", 0)),
+                              []).append((ts, dur, ev.get("name", "?")))
+        elif ph in ("s", "t", "f"):
+            if "id" not in ev:
+                problems.append("event %d (flow %s): missing id" % (i, ph))
+                continue
+            flows.setdefault(ev["id"], {}).setdefault(ph, []).append(ts)
+    for (pid, tid), slices in sorted(tracks.items()):
+        # sort outer-first at equal start so nesting resolves deterministically
+        slices.sort(key=lambda s: (s[0], -s[1]))
+        open_ends = []  # stack of (end_ts, name)
+        for ts, dur, name in slices:
+            while open_ends and open_ends[-1][0] <= ts + _EPS_US:
+                open_ends.pop()
+            if open_ends and ts + dur > open_ends[-1][0] + _EPS_US:
+                problems.append(
+                    "pid %s tid %s: slice %r [%f..%f] partially overlaps "
+                    "enclosing %r (ends %f) — X events must nest"
+                    % (pid, tid, name, ts, ts + dur, open_ends[-1][1],
+                       open_ends[-1][0]))
+            open_ends.append((ts + dur, name))
+    for fid, d in sorted(flows.items(), key=lambda kv: str(kv[0])):
+        if "s" not in d:
+            problems.append("flow id %r: 'f'/'t' without an 's' start" % fid)
+            continue
+        if len(d["s"]) > 1:
+            problems.append("flow id %r: %d 's' starts (want 1)"
+                            % (fid, len(d["s"])))
+        if "f" not in d:
+            problems.append("flow id %r: 's' without a matching 'f'" % fid)
+        elif min(d["f"]) + _EPS_US < d["s"][0]:
+            problems.append("flow id %r: 'f' at %f precedes 's' at %f"
+                            % (fid, min(d["f"]), d["s"][0]))
+    return problems
+
+
+def _assert_smoke_content(events):
+    """Beyond well-formedness, the smoke asserts the ISSUE 4 acceptance:
+    request spans cross threads under one trace id, and train steps carry
+    step/data_wait spans."""
+    problems = []
+    xs = [ev for ev in events if ev.get("ph") == "X"]
+    by_trace = {}
+    for ev in xs:
+        tr = ev.get("args", {}).get("trace")
+        if tr is not None:
+            by_trace.setdefault(tr, []).append(ev)
+    req_ok = False
+    for tr, evs in by_trace.items():
+        names = {e["name"] for e in evs}
+        tids = {e["tid"] for e in evs}
+        if {"request", "queue", "execute"} <= names and len(tids) >= 2:
+            req_ok = True
+            break
+    if not req_ok:
+        problems.append("no request trace with queue+execute spans across "
+                        ">=2 threads")
+    names = {e["name"] for e in xs}
+    for want in ("step", "data_wait", "forward_backward", "update"):
+        if want not in names:
+            problems.append("no %r span in the traced fit run" % want)
+    flows = [ev for ev in events if ev.get("ph") in ("s", "f")]
+    if not flows:
+        problems.append("no flow events linking the thread handoff")
+    return problems
+
+
+def smoke():
+    """Serve a few requests + run two train steps with MXNET_TRACE=1,
+    export, validate."""
+    import os
+    import tempfile
+
+    os.environ["MXNET_TRACE"] = "1"
+    os.environ["MXNET_TRACE_SAMPLE"] = "1"
+    # invoked as `python ci/check_trace.py`: the script dir is on sys.path,
+    # the repo root is not (same bootstrap as tools/trace_summary.py)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.serving import BucketLadder, Engine
+    from mxnet_tpu.telemetry import tracing
+    from mxnet_tpu.test_utils import tiny_mlp_checkpoint
+
+    sym, params = tiny_mlp_checkpoint()
+    with Engine(sym, params, {"data": (8,)}, ladder=BucketLadder((1, 2)),
+                max_wait_ms=1.0, name="smoke") as eng:
+        for _ in range(4):
+            eng.predict({"data": np.zeros((1, 8), np.float32)})
+
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data, name="fc", num_hidden=4)
+    net = mx.sym.SoftmaxOutput(fc, name="softmax")
+    X = np.random.RandomState(0).randn(16, 8).astype(np.float32)
+    y = np.zeros((16,), np.float32)
+    mod = mx.mod.Module(net)
+    mod.fit(mx.io.NDArrayIter(X, y, batch_size=8), num_epoch=1,
+            optimizer="sgd")
+
+    path = os.path.join(tempfile.mkdtemp(prefix="mxtrace_smoke_"),
+                        "trace.json")
+    tracing.export(path)
+    events = load_events(path)
+    problems = validate(events) + _assert_smoke_content(events)
+    for msg in problems:
+        print("check_trace smoke: %s" % msg, file=sys.stderr)
+    if problems:
+        return 1
+    nspans = sum(1 for ev in events if ev.get("ph") == "X")
+    print("check_trace smoke OK: %d spans, trace well-formed (%s)"
+          % (nspans, path))
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="validate chrome-trace JSON (ts sanity, X nesting, "
+                    "matched flow ids)")
+    p.add_argument("trace", nargs="?", help="trace file (.json or .json.gz)")
+    p.add_argument("--smoke", action="store_true",
+                   help="run the serve+train tracing smoke instead of "
+                        "validating a file")
+    args = p.parse_args(argv)
+    if args.smoke:
+        return smoke()
+    if not args.trace:
+        p.error("need a trace file (or --smoke)")
+    try:
+        events = load_events(args.trace)
+    except (OSError, json.JSONDecodeError) as e:
+        print("check_trace: cannot read %s: %s" % (args.trace, e),
+              file=sys.stderr)
+        return 2
+    problems = validate(events)
+    for msg in problems:
+        print("check_trace: %s" % msg, file=sys.stderr)
+    if problems:
+        return 1
+    print("check_trace OK: %d events" % len(events))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -45,6 +45,10 @@ case "$tier" in
     # SERVE_BENCH lines must parse and pass the schema lint
     ./dev.sh python tools/loadgen.py --smoke \
       | python ci/check_bench_schema.py -
+    # tracing smoke (ISSUE 4): serve a few requests + two train steps with
+    # MXNET_TRACE=1, export, and validate the chrome trace (ts sanity, X
+    # nesting, matched flow ids, cross-thread request trace)
+    ./dev.sh python ci/check_trace.py --smoke
     # telemetry unit tests (tests/test_telemetry.py) run as part of tests/
     ignore=()
     for f in "${NIGHTLY_FILES[@]}"; do ignore+=(--ignore "$f"); done

@@ -30,6 +30,7 @@ import pickle
 
 from . import telemetry as _telemetry
 from .ndarray.ndarray import NDArray
+from .telemetry import tracing as _tracing
 
 __all__ = ["KVStore", "create"]
 
@@ -101,34 +102,38 @@ class KVStore:
         owns overlap; the argument is accepted for API parity.
         """
         keys, values = self._normalize_push(key, value)
-        for k, vlist in zip(keys, values):
-            self._check_init(k)
-            merged = self._merge(vlist)
-            if _telemetry.enabled():
-                _telemetry.note_bytes("kvstore_bytes_pushed_total",
-                                      _nbytes(merged), store=self._type)
-            if self._compression is not None:
-                merged = self._compress(k, merged)
-            if self._is_dist:
-                merged = self._cross_process_sum(merged)
-            if self._updater is not None:
-                self._updater(k, merged, self._store[k])
-            else:
-                self._store[k] = merged
+        # one span per push CALL (not per key): inside a traced train step
+        # the per-parameter storm would otherwise flood the ring
+        with _tracing.span("kv_push", keys=len(keys), store=self._type):
+            for k, vlist in zip(keys, values):
+                self._check_init(k)
+                merged = self._merge(vlist)
+                if _telemetry.enabled():
+                    _telemetry.note_bytes("kvstore_bytes_pushed_total",
+                                          _nbytes(merged), store=self._type)
+                if self._compression is not None:
+                    merged = self._compress(k, merged)
+                if self._is_dist:
+                    merged = self._cross_process_sum(merged)
+                if self._updater is not None:
+                    self._updater(k, merged, self._store[k])
+                else:
+                    self._store[k] = merged
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Copy the stored value into every array of ``out``."""
         assert out is not None, "pull requires out="
         keys, outs = self._normalize_push(key, out)
-        for k, olist in zip(keys, outs):
-            self._check_init(k)
-            src = self._store[k]
-            if _telemetry.enabled():
-                _telemetry.note_bytes("kvstore_bytes_pulled_total",
-                                      _nbytes(src) * len(olist),
-                                      store=self._type)
-            for o in olist:
-                o._rebind(src._data)
+        with _tracing.span("kv_pull", keys=len(keys), store=self._type):
+            for k, olist in zip(keys, outs):
+                self._check_init(k)
+                src = self._store[k]
+                if _telemetry.enabled():
+                    _telemetry.note_bytes("kvstore_bytes_pulled_total",
+                                          _nbytes(src) * len(olist),
+                                          store=self._type)
+                for o in olist:
+                    o._rebind(src._data)
         return out
 
     def pushpull(self, key, value, out=None, priority=0):

@@ -66,38 +66,44 @@ def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names, update_o
 def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
     """Reference ``model.py:145`` — push grads, pull updated weights."""
     from . import telemetry
+    from .telemetry import tracing
 
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list is None or (isinstance(grad_list, list) and grad_list[0] is None):
-            continue
-        name = param_names[index]
-        kvstore.push(name, grad_list, priority=-index)
-        kvstore.pull(name, arg_list, priority=-index)
-        # the per-parameter dispatch storm the fused Module step removes
-        # (ISSUE 3) — counted so bench/telemetry expose dispatches_per_step
-        telemetry.note_dispatch(1, path="legacy")
+    with tracing.span("optimizer_update", path="kvstore",
+                      params=len(param_arrays)):
+        for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+            arg_list, grad_list = pair
+            if grad_list is None or (isinstance(grad_list, list) and grad_list[0] is None):
+                continue
+            name = param_names[index]
+            kvstore.push(name, grad_list, priority=-index)
+            kvstore.pull(name, arg_list, priority=-index)
+            # the per-parameter dispatch storm the fused Module step removes
+            # (ISSUE 3) — counted so bench/telemetry expose dispatches_per_step
+            telemetry.note_dispatch(1, path="legacy")
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None, param_names=None):
     """Reference ``model.py:157+`` — kvstore aggregation + local updater."""
     from . import telemetry
+    from .telemetry import tracing
 
-    for i, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list is None or (isinstance(grad_list, list) and grad_list[0] is None):
-            continue
-        index = i
-        if kvstore:
-            name = param_names[index]
-            kvstore.push(name, grad_list, priority=-index)
-            kvstore.pull(name, grad_list, priority=-index)
-        if not isinstance(arg_list, (list, tuple)):
-            arg_list, grad_list = [arg_list], [grad_list]
-        for k, (w, g) in enumerate(zip(arg_list, grad_list)):
-            # one updater state per device copy (reference uses index*num_device+k)
-            updater(index * num_device + k, g, w)
-            telemetry.note_dispatch(1, path="legacy")
+    with tracing.span("optimizer_update", path="local",
+                      params=len(param_arrays)):
+        for i, pair in enumerate(zip(param_arrays, grad_arrays)):
+            arg_list, grad_list = pair
+            if grad_list is None or (isinstance(grad_list, list) and grad_list[0] is None):
+                continue
+            index = i
+            if kvstore:
+                name = param_names[index]
+                kvstore.push(name, grad_list, priority=-index)
+                kvstore.pull(name, grad_list, priority=-index)
+            if not isinstance(arg_list, (list, tuple)):
+                arg_list, grad_list = [arg_list], [grad_list]
+            for k, (w, g) in enumerate(zip(arg_list, grad_list)):
+                # one updater state per device copy (reference uses index*num_device+k)
+                updater(index * num_device + k, g, w)
+                telemetry.note_dispatch(1, path="legacy")
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):

@@ -13,6 +13,7 @@ import time
 from .. import metric as metric_mod
 from .. import ndarray as nd
 from ..model import BatchEndParam
+from ..telemetry import tracing
 
 __all__ = ["BaseModule"]
 
@@ -246,20 +247,30 @@ class BaseModule:
                 t_batch = time.perf_counter() if probe else 0.0
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                wait = 0.0
-                try:
-                    t0 = time.perf_counter() if probe else 0.0
-                    next_data_batch = next(data_iter)
-                    if probe:
-                        wait = time.perf_counter() - t0
-                    self.prepare(next_data_batch)
-                except StopIteration:
-                    end_of_batch = True
-                # the metric read syncs the async dispatch, so the batch wall
-                # time measured around it is honest device+host time
-                self.update_metric(eval_metric, data_batch.label)
+                # span tracing (MXNET_TRACE): each batch is its own sampled
+                # trace; Module's forward_backward/update spans (and kvstore
+                # push/pull, Predictor dispatch below them) nest under it
+                # via the thread-local current span.  Off ⇒ NULL_SPAN, no
+                # hook beyond the env check — same contract as `probe`.
+                step_sp = tracing.start_trace("step", epoch=epoch,
+                                              step=nbatch)
+                with step_sp:
+                    self.forward_backward(data_batch)
+                    self.update()
+                    wait = 0.0
+                    try:
+                        t0 = time.perf_counter() if probe else 0.0
+                        with tracing.span("data_wait"):
+                            next_data_batch = next(data_iter)
+                        if probe:
+                            wait = time.perf_counter() - t0
+                        self.prepare(next_data_batch)
+                    except StopIteration:
+                        end_of_batch = True
+                    # the metric read syncs the async dispatch, so the batch
+                    # wall time measured around it is honest device+host time
+                    with tracing.span("update_metric"):
+                        self.update_metric(eval_metric, data_batch.label)
                 if probe:
                     probe.record_data_wait(wait)
                     probe.record_step(

@@ -35,7 +35,7 @@ from __future__ import annotations
 import numpy as np
 
 from .. import telemetry
-from ..base import env_flag
+from ..base import MXNetError, env_flag
 from ..ndarray.ndarray import NDArray, _wrap
 
 __all__ = ["FusedStepper", "fused_enabled", "fused_ineligible_reason"]
@@ -124,11 +124,17 @@ def _commit_state(state, new_leaves):
         s._rebind(v)
 
 
-def _build_step_fn(graph_fn, arg_names, diff_names, const_names, kind, hp):
+def _build_step_fn(graph_fn, arg_names, diff_names, const_names, kind, hp,
+                   nancheck=False):
     """The pure fused step: one vjp over the executor graph + the in-graph
     optimizer fold.  Closed over only static structure (names, kind, static
-    hyperparams) so one jitted instance survives re-binds of the same
-    symbol and re-traces only on new shape signatures."""
+    hyperparams, the nancheck flag) so one jitted instance survives re-binds
+    of the same symbol and re-traces only on new shape signatures.
+
+    With ``nancheck`` the step also returns a scalar ``finite`` flag —
+    ``all(isfinite(heads)) & all(isfinite(grads))`` reduced INSIDE the same
+    donated jit, so the check adds no dispatch and no sync (the caller reads
+    the flag one step later, when it has materialized for free)."""
     import jax
     import jax.numpy as jnp
 
@@ -158,7 +164,14 @@ def _build_step_fn(graph_fn, arg_names, diff_names, const_names, kind, hp):
                                          lr=lr_vec[i], wd=wd_vec[i], **hp)
             new_params.append(new_w)
             new_state.append(list(new_st))
-        return new_params, new_state, new_aux, heads, grads
+        if not nancheck:
+            return new_params, new_state, new_aux, heads, grads
+        finite = jnp.bool_(True)
+        for h in heads:
+            finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(h)))
+        for g in grads:
+            finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+        return new_params, new_state, new_aux, heads, grads, finite
 
     return step
 
@@ -190,9 +203,12 @@ class FusedStepper:
         elif self._kind == "adam":
             hp.update(beta1=float(opt.beta1), beta2=float(opt.beta2),
                       epsilon=float(opt.epsilon))
+        self._nancheck = env_flag("MXNET_NANCHECK")
+        self._nsteps = 0
+        self._pending_flag = None  # (finite device scalar, step number)
         fn = _build_step_fn(exec_._graph_fn(True), self._arg_names,
                             self._diff_names, self._const_names,
-                            self._kind, hp)
+                            self._kind, hp, nancheck=self._nancheck)
         self._jit = jax.jit(fn, donate_argnums=(0, 1, 2, 3))
         # compile/steady-state accounting (identity when telemetry is off)
         self._step = telemetry.instrument_step(self._jit,
@@ -204,10 +220,33 @@ class FusedStepper:
         return size() if size is not None else None
 
     def stale(self, module):
-        """True when the Module's optimizer (or a folded-in hyperparam)
+        """True when the Module's optimizer (or a folded-in hyperparam, or
+        the MXNET_NANCHECK gate — it changes the step's output structure)
         changed since this stepper was built — caller rebuilds."""
         return (module._optimizer is not self._opt
-                or _hp_signature(module._optimizer) != self._hp_sig)
+                or _hp_signature(module._optimizer) != self._hp_sig
+                or env_flag("MXNET_NANCHECK") != self._nancheck)
+
+    def check_nonfinite(self):
+        """Raise if the PREVIOUS step's folded isfinite flag tripped.
+
+        The flag is an output of the fused jit, so reading it right after
+        dispatch would add the per-step sync the fold exists to avoid;
+        instead ``run`` checks it just before dispatching the next step, by
+        which point it is long materialized (the next step consumes the
+        previous outputs anyway).  The error therefore surfaces one update()
+        late but NAMES the offending step."""
+        if self._pending_flag is None:
+            return
+        flag, stepno = self._pending_flag
+        self._pending_flag = None
+        if not bool(flag):
+            telemetry.note_nonfinite("fused")
+            raise MXNetError(
+                "MXNET_NANCHECK: non-finite loss/gradient in fused train "
+                "step %d (detected before step %d: the flag is folded into "
+                "the fused dispatch and read one step later to avoid a "
+                "per-step sync)" % (stepno, stepno + 1))
 
     def run(self, module):
         """Dispatch ONE fused step over the feed already staged in the
@@ -245,9 +284,18 @@ class FusedStepper:
             lrs.append(lr)
             wds.append(wd)
         key = _rnd.next_key()
-        new_params, new_state, new_aux, heads, grads = self._step(
+        if self._nancheck:
+            self.check_nonfinite()
+        out = self._step(
             diff_vals, grads_in, leaves, aux_vals, const_vals, key,
             np.asarray(lrs, np.float32), np.asarray(wds, np.float32))
+        if self._nancheck:
+            new_params, new_state, new_aux, heads, grads, finite = out
+            self._nsteps += 1
+            self._pending_flag = (finite, self._nsteps)
+        else:
+            new_params, new_state, new_aux, heads, grads = out
+            self._nsteps += 1
         for n, v in zip(self._diff_names, new_params):
             exec_.arg_dict[n]._rebind(v)
         for n, g in zip(self._diff_names, grads):

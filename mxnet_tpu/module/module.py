@@ -17,7 +17,9 @@ import logging
 from .. import ndarray as nd
 from .. import optimizer as opt_mod
 from .. import telemetry
+from ..base import MXNetError, env_flag
 from ..io import DataDesc
+from ..telemetry import tracing
 from ..model import (
     _create_kvstore,
     _initialize_kvstore,
@@ -82,6 +84,7 @@ class Module(BaseModule):
         # stepper and the staged-batch flag forward_backward hands update()
         self._fused = None
         self._fused_pending = False
+        self._nan_step = 0  # MXNET_NANCHECK legacy-path step counter
 
     # -- properties ----------------------------------------------------------
     @property
@@ -114,6 +117,11 @@ class Module(BaseModule):
     # -- params ---------------------------------------------------------------
     def get_params(self):
         assert self.binded and self.params_initialized
+        if self._fused is not None:
+            # MXNET_NANCHECK reads the fused flag one step late; the natural
+            # sync points (fit's epoch-end get_params, checkpointing) drain
+            # the pending flag so the LAST step of a run is still checked
+            self._fused.check_nonfinite()
         self._sync_params_from_exec()
         return dict(self._arg_params), dict(self._aux_params)
 
@@ -269,6 +277,8 @@ class Module(BaseModule):
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring...")
             return
+        if self._fused is not None:  # drain any unread nancheck flag first
+            self._fused.check_nonfinite()
         self._fused = None  # stepper folds optimizer hyperparams: rebuild
 
         kv, update_on_kvstore = _create_kvstore(
@@ -398,13 +408,15 @@ class Module(BaseModule):
 
         reason = fused_ineligible_reason(self)
         if reason is None:
-            self._stage_batch(data_batch)
+            with tracing.span("forward_backward", path="fused"):
+                self._stage_batch(data_batch)
             self._fused_pending = True
             return
         # the legacy step's own forward/backward dispatches are counted at
         # the Executor dispatch sites, the optimizer storm in model.py
         telemetry.note_fused_fallback(reason)
-        super().forward_backward(data_batch)
+        with tracing.span("forward_backward", path="legacy", reason=reason):
+            super().forward_backward(data_batch)
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
@@ -422,22 +434,54 @@ class Module(BaseModule):
             self._fused_pending = False
             from .fused_step import FusedStepper
 
-            if self._fused is not None and self._fused.stale(self):
-                self._fused = None
-            if self._fused is None:
-                self._fused = FusedStepper(self)
-            self._fused.run(self)
+            with tracing.span("update", path="fused"):
+                if self._fused is not None and self._fused.stale(self):
+                    # don't let a rebuild discard an unread nancheck flag
+                    self._fused.check_nonfinite()
+                    self._fused = None
+                if self._fused is None:
+                    self._fused = FusedStepper(self)
+                self._fused.run(self)
             telemetry.note_train_step("fused")
             telemetry.note_dispatch(1, path="fused")
             return
         telemetry.note_train_step("legacy")
-        param_arrays = [self._exec.arg_dict[n] for n in self._param_names]
-        grad_arrays = [self._exec.grad_dict.get(n) for n in self._param_names]
-        if self._kvstore and self._update_on_kvstore:
-            _update_params_on_kvstore(param_arrays, grad_arrays, self._kvstore, self._param_names)
-        else:
-            _update_params(param_arrays, grad_arrays, self._updater, 1,
-                           kvstore=self._kvstore, param_names=self._param_names)
+        if env_flag("MXNET_NANCHECK"):
+            self._nancheck_legacy()
+        with tracing.span("update", path="legacy"):
+            param_arrays = [self._exec.arg_dict[n] for n in self._param_names]
+            grad_arrays = [self._exec.grad_dict.get(n)
+                           for n in self._param_names]
+            if self._kvstore and self._update_on_kvstore:
+                _update_params_on_kvstore(param_arrays, grad_arrays,
+                                          self._kvstore, self._param_names)
+            else:
+                _update_params(param_arrays, grad_arrays, self._updater, 1,
+                               kvstore=self._kvstore,
+                               param_names=self._param_names)
+
+    def _nancheck_legacy(self):
+        """Opt-in ``MXNET_NANCHECK`` guard for the legacy step: verify the
+        loss heads and parameter gradients are finite BEFORE the optimizer
+        writes them into the weights.  The legacy path already syncs per
+        dispatch, so the device readbacks here cost noise; the fused path
+        folds the same check into its one dispatch (module/fused_step.py)."""
+        import jax.numpy as jnp
+
+        self._nan_step += 1
+        bad = []
+        for name, o in zip(self._output_names, self._exec.outputs):
+            if not bool(jnp.all(jnp.isfinite(o._data))):
+                bad.append("output:%s" % name)
+        for n in self._param_names:
+            g = self._exec.grad_dict.get(n)
+            if g is not None and not bool(jnp.all(jnp.isfinite(g._data))):
+                bad.append("grad:%s" % n)
+        if bad:
+            telemetry.note_nonfinite("legacy")
+            raise MXNetError(
+                "MXNET_NANCHECK: non-finite values at train step %d: %s"
+                % (self._nan_step, ", ".join(bad[:8])))
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded

@@ -27,6 +27,7 @@ import numpy as np
 from . import ndarray as nd
 from . import symbol as sym_mod
 from .executor import Executor
+from .telemetry import tracing
 
 
 def load_ndarray_file(fname):
@@ -144,7 +145,10 @@ class Predictor:
         kwargs, matching ``Executor.forward``."""
         for k, v in kwargs.items():
             self.set_input(k, v)
-        self._outputs = self._exec.forward(is_train=False)
+        # nests under the serving engine's execute span (or any other active
+        # trace); NULL when no sampled trace is live on this thread
+        with tracing.span("predictor_forward"):
+            self._outputs = self._exec.forward(is_train=False)
         return self._outputs
 
     def get_output_shape(self, index=0):

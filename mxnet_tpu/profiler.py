@@ -217,6 +217,12 @@ def dumps(reset=False):
         evs = list(_meta_events) + list(_events)
         if reset:
             _events.clear()
+    # clock anchor (unix time ↔ this dump's trace timebase): lets
+    # tools/trace_merge.py place these events and a telemetry/tracing span
+    # export — or any other clock_sync-carrying trace — on one timeline
+    evs.insert(0, {"name": "clock_sync", "ph": "M", "pid": 0,
+                   "args": {"unix_ts": round(time.time(), 6),
+                            "trace_ts_us": round(_now_us(), 3)}})
     import sys
 
     pk = sys.modules.get("mxnet_tpu.ops.pallas_kernels")

@@ -32,6 +32,7 @@ import time
 import numpy as np
 
 from ..predictor import Predictor
+from ..telemetry import tracing
 from .admission import AdmissionController, EngineClosed, ServerBusy
 from .batcher import MicroBatcher, Request
 from .bucketing import BucketLadder, _volume
@@ -179,9 +180,23 @@ class Engine:
         (overrides the engine default).  Raises ``ServerBusy`` when the
         queue is at capacity, ``EngineClosed`` after ``close()``.
         """
-        arrays, n, bucket_shapes, direct = self._classify(inputs)
+        # span tracing (MXNET_TRACE, telemetry/tracing.py): the request root
+        # lives on a per-trace lane; its context rides on the Request so the
+        # device loop's spans flow-link back here across the thread handoff
+        root = tracing.start_trace("request", lane=True, engine=self.name)
+        try:
+            with tracing.span("classify", parent=root):
+                arrays, n, bucket_shapes, direct = self._classify(inputs)
+        except Exception:
+            root.finish(drop="invalid")
+            raise
         req = Request(arrays, n, bucket_shapes,
                       deadline=self.admission.deadline(timeout), direct=direct)
+        if root:
+            root.set(n=n, direct=int(direct))
+            req._trace_root = root
+            req._trace_ctx = root.context()
+            req._trace_queue = tracing.span("queue", parent=root, lane=True)
         # stamp stats BEFORE enqueueing (rolled back on rejection): once the
         # request is in the queue the device loop may complete it instantly,
         # and decrement-before-increment would publish in_flight = -1
@@ -200,6 +215,10 @@ class Engine:
                     self._stats["direct"] -= 1
             if self._probe and isinstance(e, ServerBusy):
                 self._probe.record_drop("shed")
+            if root:
+                reason = "shed" if isinstance(e, ServerBusy) else "rejected"
+                req._trace_queue.finish(drop=reason)
+                root.finish(drop=reason)
             raise
         if self._probe:
             with self._stats_mu:
@@ -295,6 +314,7 @@ class Engine:
                     for req in reqs:
                         if not req.done():
                             req.set_error(e)
+                        self._finish_trace(req, "error")
                     if self._probe:
                         self._probe.record_drop("error", len(reqs))
                 reqs = ()
@@ -310,6 +330,7 @@ class Engine:
             for req in undone:
                 req.set_error(EngineClosed(
                     "device loop terminated: %r" % (e,)))
+                self._finish_trace(req, "error")
             self._closed = True
             self._batcher.close()
             raise
@@ -320,25 +341,42 @@ class Engine:
         # split stays honest (cold-bucket bind + compile time belongs to
         # serve_execute_seconds, not to queue latency)
         queue_waits = [r.queue_seconds for r in reqs]
-        t0 = time.perf_counter()
-        pred, fresh = self._predictor_for(bucket)
-        try:
-            arrays = self._assemble(reqs, bucket)
-            with self._device_mu:
-                outs = pred.forward(**arrays)
-                outs = [o.asnumpy() for o in outs]  # sync: real completion
-        except Exception:
-            self._uncompile(bucket, fresh)
-            raise
-        dt = time.perf_counter() - t0
-        if fresh:
-            self._note_compile(bucket, dt)
-        total = sum(r.n for r in reqs)
-        off = 0
-        for req in reqs:
-            req.set_result([o[off:off + req.n] for o in outs])
-            off += req.n
         label = self._bucket_label(bucket)
+        # spans: the batch joins the FIRST traced request's trace (one batch
+        # serves many requests but a chrome args dict carries one trace id);
+        # every traced member still gets its queue span closed here and its
+        # request root closed at reply, all sharing their own trace ids
+        traced = [r for r in reqs if getattr(r, "_trace_root", None)]
+        owner = traced[0] if traced else None
+        batch_sp = tracing.span("dispatch",
+                                parent=owner._trace_ctx if owner else None,
+                                bucket=label, requests=len(reqs))
+        for r in traced:
+            r._trace_queue.finish(bucket=label)
+        t0 = time.perf_counter()
+        with batch_sp:
+            pred, fresh = self._predictor_for(bucket)
+            try:
+                with tracing.span("assemble"):
+                    arrays = self._assemble(reqs, bucket)
+                with tracing.span("execute", compile=int(fresh)):
+                    with self._device_mu:
+                        outs = pred.forward(**arrays)
+                        outs = [o.asnumpy() for o in outs]  # sync: completion
+            except Exception:
+                self._uncompile(bucket, fresh)
+                raise
+            dt = time.perf_counter() - t0
+            if fresh:
+                self._note_compile(bucket, dt)
+            total = sum(r.n for r in reqs)
+            with tracing.span("reply"):
+                off = 0
+                for req in reqs:
+                    req.set_result([o[off:off + req.n] for o in outs])
+                    off += req.n
+        for r in traced:
+            r._trace_root.finish()
         with self._stats_mu:
             self._stats["completed"] += len(reqs)
             self._stats["in_flight"] -= len(reqs)
@@ -468,6 +506,22 @@ class Engine:
                 self._stats["in_flight"] -= 1
         if self._probe:
             self._probe.record_drop(reason)
+        self._finish_trace(req, reason)
+
+    @staticmethod
+    def _finish_trace(req, drop=None):
+        """Close a traced request's open spans; the drop reason lands on the
+        span so a reaped 504 is visible as a causal timeline (idempotent —
+        already-closed spans ignore it)."""
+        root = getattr(req, "_trace_root", None)
+        if root is None:
+            return
+        if drop is None:
+            req._trace_queue.finish()
+            root.finish()
+        else:
+            req._trace_queue.finish(drop=drop)
+            root.finish(drop=drop)
 
     def stats(self):
         """Point-in-time engine counters (always available; the telemetry

@@ -13,6 +13,7 @@ Everything gates on ``MXNET_TELEMETRY`` — unset/0 means every helper is an
 identity/no-op and the train-step path is byte-identical to a build without
 telemetry.  See docs/OBSERVABILITY.md for the JSONL schema and recipes.
 """
+from . import tracing
 from .registry import (Counter, Gauge, Histogram, MetricError, Registry,
                        DEFAULT_BUCKETS)
 from .sinks import (JsonlSink, PrometheusSink, ProfilerSink, Sink,
@@ -21,10 +22,11 @@ from .instrument import (ServeProbe, StepProbe, add_sink, array_nbytes,
                          counter, enabled, event, flush, gauge, histogram,
                          instrument_step, interval_s, jsonl_path, note_bytes,
                          note_compile, note_dispatch, note_fused_fallback,
-                         note_train_step, registry, sample_memory,
-                         serve_probe, step_probe, summary)
+                         note_nonfinite, note_train_step, registry,
+                         sample_memory, serve_probe, step_probe, summary)
 
 __all__ = [
+    "tracing",
     "Counter", "Gauge", "Histogram", "MetricError", "Registry",
     "DEFAULT_BUCKETS",
     "Sink", "JsonlSink", "PrometheusSink", "ProfilerSink", "TensorBoardSink",
@@ -32,6 +34,7 @@ __all__ = [
     "ServeProbe", "StepProbe", "add_sink", "array_nbytes", "counter",
     "enabled", "event", "flush", "gauge", "histogram", "instrument_step",
     "interval_s", "jsonl_path", "note_bytes", "note_compile",
-    "note_dispatch", "note_fused_fallback", "note_train_step", "registry",
-    "sample_memory", "serve_probe", "step_probe", "summary",
+    "note_dispatch", "note_fused_fallback", "note_nonfinite",
+    "note_train_step", "registry", "sample_memory", "serve_probe",
+    "step_probe", "summary",
 ]

@@ -37,6 +37,7 @@ __all__ = ["enabled", "jsonl_path", "interval_s", "registry", "add_sink",
            "counter", "gauge", "histogram", "event", "flush",
            "instrument_step", "note_compile", "note_bytes", "array_nbytes",
            "note_dispatch", "note_train_step", "note_fused_fallback",
+           "note_nonfinite",
            "sample_memory", "step_probe", "StepProbe", "summary",
            "serve_probe", "ServeProbe", "SERVE_LATENCY_BUCKETS",
            "FRACTION_BUCKETS"]
@@ -227,6 +228,17 @@ def note_fused_fallback(reason):
     registry().counter("module_fused_fallback_total",
                        "train steps that fell back to the legacy path",
                        ("reason",)).inc(reason=reason)
+
+
+def note_nonfinite(where):
+    """Count one MXNET_NANCHECK trip (``where``: "fused" | "legacy") —
+    recorded just before the check raises, so post-mortem telemetry names
+    the path that produced the non-finite value."""
+    if not enabled():
+        return
+    registry().counter("nonfinite_total",
+                       "non-finite loss/grad detections (MXNET_NANCHECK)",
+                       ("where",)).inc(where=where)
 
 
 def note_bytes(counter_name, nbytes, **labels):

@@ -124,8 +124,17 @@ class JsonlSink(Sink):
 
 
 def _prom_escape(value):
+    """Label-VALUE escaping (exposition format 0.0.4): backslash first, then
+    double-quote, then line feed — any other order double-escapes."""
     return (str(value).replace("\\", "\\\\").replace('"', '\\"')
             .replace("\n", "\\n"))
+
+
+def _prom_escape_help(value):
+    """HELP-text escaping: the format escapes only ``\\`` and line feed in
+    help — escaping quotes there (the old shared escaper did) emits ``\\"``,
+    which the exposition grammar does not define for help lines."""
+    return str(value).replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _prom_labels(labels, extra=()):
@@ -148,7 +157,7 @@ def render_prometheus(snapshot):
     for m in snapshot:
         name = m["name"]
         if m.get("help"):
-            lines.append("# HELP %s %s" % (name, _prom_escape(m["help"])))
+            lines.append("# HELP %s %s" % (name, _prom_escape_help(m["help"])))
         lines.append("# TYPE %s %s" % (name, m["type"]))
         for s in m["samples"]:
             if m["type"] == "histogram":

@@ -275,3 +275,120 @@ def test_legacy_dispatch_count_counted(monkeypatch, tmp_path):
         assert tin.summary()["dispatches_per_step"] == 2 + nparams
     finally:
         tin._reset_for_tests()
+
+
+# -- non-finite sentinel (ISSUE 4 satellite, MXNET_NANCHECK) ------------------
+def _nan_batch():
+    x = np.random.RandomState(5).randn(BATCH, 8).astype(np.float32)
+    x[0, 0] = np.nan
+    from mxnet_tpu.io import DataBatch as DB
+
+    return DB(data=[mx.nd.array(x)],
+              label=[mx.nd.array(np.zeros(BATCH, np.float32))])
+
+
+def _nancheck_module(monkeypatch, fused):
+    monkeypatch.setenv("MXNET_NANCHECK", "1")
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1" if fused else "0")
+    mod = _make_module(_sym(bn=False, dropout=False))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    return mod
+
+
+def test_nancheck_fused_raises_one_step_late(monkeypatch):
+    """The flag is folded into the fused dispatch outputs and read before
+    the NEXT dispatch (no per-step sync) — the raise names the bad step."""
+    from mxnet_tpu.base import MXNetError
+
+    mod = _nancheck_module(monkeypatch, fused=True)
+    mod.forward_backward(_nan_batch())
+    mod.update()  # step 1 dispatches; flag not yet read
+    mod.forward_backward(_batches(1)[0])
+    with pytest.raises(MXNetError, match="step 1"):
+        mod.update()
+    assert mod._fused is not None and mod._fused._nancheck
+
+
+def test_nancheck_legacy_raises_before_update(monkeypatch):
+    from mxnet_tpu.base import MXNetError
+
+    mod = _nancheck_module(monkeypatch, fused=False)
+    before = {n: v.asnumpy() for n, v in mod._exec.arg_dict.items()
+              if n in mod._param_names}
+    mod.forward_backward(_nan_batch())
+    with pytest.raises(MXNetError, match="step 1"):
+        mod.update()
+    # the check fires BEFORE the optimizer writes nan into the weights
+    for n, v in before.items():
+        assert np.isfinite(mod._exec.arg_dict[n].asnumpy()).all(), n
+
+
+def test_nancheck_off_is_inert(monkeypatch):
+    monkeypatch.delenv("MXNET_NANCHECK", raising=False)
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    mod = _make_module(_sym(bn=False, dropout=False))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    for _ in range(2):  # nan flows through silently, as before
+        mod.forward_backward(_nan_batch())
+        mod.update()
+    assert mod._fused is not None and not mod._fused._nancheck
+
+
+def test_nancheck_counter_and_stale_rebuild(monkeypatch, tmp_path):
+    """A trip bumps nonfinite_total{where}; flipping MXNET_NANCHECK mid-run
+    rebuilds the stepper (the flag changes the step's output structure)."""
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    monkeypatch.setenv("MXNET_TELEMETRY_FILE", str(tmp_path / "t.jsonl"))
+    tin._reset_for_tests()
+    try:
+        from mxnet_tpu.base import MXNetError
+
+        mod = _nancheck_module(monkeypatch, fused=False)
+        mod.forward_backward(_nan_batch())
+        with pytest.raises(MXNetError):
+            mod.update()
+        assert tin.registry().get("nonfinite_total").value(where="legacy") == 1
+
+        monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+        monkeypatch.delenv("MXNET_NANCHECK", raising=False)
+        mod2 = _make_module(_sym(bn=False, dropout=False))
+        mod2.init_optimizer(optimizer="sgd",
+                            optimizer_params={"learning_rate": 0.1})
+        mod2.forward_backward(_batches(1)[0])
+        mod2.update()
+        first = mod2._fused
+        assert not first._nancheck
+        monkeypatch.setenv("MXNET_NANCHECK", "1")
+        mod2.forward_backward(_batches(1)[0])
+        mod2.update()
+        assert mod2._fused is not first and mod2._fused._nancheck
+    finally:
+        tin._reset_for_tests()
+
+
+def test_nancheck_last_step_drains_at_get_params(monkeypatch):
+    """The deferred fused flag is checked at Module.get_params() (fit's
+    epoch-end sync) so a run whose FINAL step went non-finite still raises."""
+    from mxnet_tpu.base import MXNetError
+
+    mod = _nancheck_module(monkeypatch, fused=True)
+    mod.forward_backward(_nan_batch())
+    mod.update()  # last step of the "run": flag pending, nothing read yet
+    with pytest.raises(MXNetError, match="step 1"):
+        mod.get_params()
+
+
+def test_nancheck_stale_rebuild_does_not_swallow_flag(monkeypatch):
+    """Swapping the optimizer (stale stepper -> rebuild) must drain the
+    pending flag, not discard it with the old stepper."""
+    from mxnet_tpu.base import MXNetError
+
+    mod = _nancheck_module(monkeypatch, fused=True)
+    mod.forward_backward(_nan_batch())
+    mod.update()
+    with pytest.raises(MXNetError, match="step 1"):
+        mod.init_optimizer(optimizer="adam",
+                           optimizer_params={"learning_rate": 0.01},
+                           force_init=True)

@@ -174,6 +174,30 @@ class TestSinks:
         r.flush()
         assert 'req_total{code="200"} 4.0' in open(path).read()
 
+    def test_prometheus_label_escaping(self):
+        """Exposition-format label values escape backslash, double-quote and
+        line feed — in that order, so nothing double-escapes (ISSUE 4
+        satellite: quotes/backslashes/newlines in label values)."""
+        r = Registry()
+        c = r.counter("esc_total", "", ("path",))
+        c.inc(1, path='C:\\dir "quoted"\nnext')
+        text = render_prometheus(r.collect())
+        assert ('esc_total{path="C:\\\\dir \\"quoted\\"\\nnext"} 1.0'
+                in text)
+        # and the escaped line stays one physical line
+        (line,) = [l for l in text.splitlines() if l.startswith("esc_total{")]
+        assert "\n" not in line
+
+    def test_prometheus_help_escaping(self):
+        """HELP text escapes only backslash and line feed; quotes pass
+        through verbatim (the old shared escaper emitted an undefined \\"
+        sequence there — the escaping fix this test demanded)."""
+        r = Registry()
+        r.counter('q_total', 'says "hi" with \\ and\nnewline')
+        text = render_prometheus(r.collect())
+        assert ('# HELP q_total says "hi" with \\\\ and\\nnewline'
+                in text)
+
     def test_jsonl_unwritable_path_never_raises(self, tmp_path):
         """A bad MXNET_TELEMETRY_FILE must not kill the training step: the
         sink warns once and disables itself."""

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Compare bench captures — the automated reader for the BENCH_r* trajectory.
+
+Each input is either a driver per-round capture (``BENCH_r01.json``: an
+object with a ``parsed`` bench line) or a bare bench line as printed by
+``python bench.py`` and linted by ``ci/check_bench_schema.py``.  The first
+file is the baseline; the tool prints a delta table over the headline
+metric value and the telemetry block (``dispatches_per_step``,
+``compile_s``, ``data_wait_frac``) and exits non-zero when a later capture
+regresses beyond ``--threshold`` percent:
+
+* headline ``value`` (higher is better — img/s, rps) dropping more than the
+  threshold, or
+* ``dispatches_per_step`` (lower is better; the ISSUE 3 regression surface)
+  growing more than the threshold.
+
+Captures whose metric NAME differs from the baseline's are shown for
+context but never gated — the checked-in trajectory mixes workloads
+(resnet50 rounds vs deformable-rfcn rounds), and an img/s delta across
+different models is noise, not signal.
+
+Usage::
+
+    python tools/bench_compare.py BENCH_r04.json BENCH_r05.json
+    python tools/bench_compare.py base.json new.json --threshold 3 --json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_bench(path):
+    """→ normalized row dict from a driver capture or a bare bench line."""
+    with open(path, encoding="utf-8") as f:
+        obj = json.load(f)
+    if not isinstance(obj, dict):
+        raise ValueError("%s: bench capture must be a JSON object" % path)
+    line = obj.get("parsed") if isinstance(obj.get("parsed"), dict) else obj
+    if "metric" not in line or "value" not in line:
+        raise ValueError("%s: no bench line found (need 'metric'/'value', "
+                         "directly or under 'parsed')" % path)
+    tel = line.get("telemetry") or {}
+    return {"file": path, "metric": str(line["metric"]),
+            "value": float(line["value"]), "unit": str(line.get("unit", "")),
+            "dispatches_per_step": tel.get("dispatches_per_step"),
+            "compile_s": tel.get("compile_s"),
+            "data_wait_frac": tel.get("data_wait_frac")}
+
+
+def _pct(new, base):
+    if base in (None, 0) or new is None:
+        return None
+    return 100.0 * (new - base) / base
+
+
+def compare(rows, threshold):
+    """→ (table_rows, regressions).  Baseline = rows[0]; only same-metric
+    rows are gated."""
+    base = rows[0]
+    table, regressions = [], []
+    for r in rows:
+        same = r["metric"] == base["metric"]
+        dv = _pct(r["value"], base["value"]) if same and r is not base else None
+        dd = (_pct(r["dispatches_per_step"], base["dispatches_per_step"])
+              if same and r is not base else None)
+        dc = (_pct(r["compile_s"], base["compile_s"])
+              if same and r is not base else None)
+        table.append(dict(r, same_metric=same, value_delta_pct=dv,
+                          dps_delta_pct=dd, compile_delta_pct=dc))
+        if r is base or not same:
+            continue
+        if dv is not None and dv < -threshold:
+            regressions.append("%s: %s value %.4g -> %.4g (%.1f%% < -%g%%)"
+                               % (r["file"], r["metric"], base["value"],
+                                  r["value"], dv, threshold))
+        if dd is not None and dd > threshold:
+            regressions.append(
+                "%s: dispatches_per_step %.3g -> %.3g (+%.1f%% > %g%%)"
+                % (r["file"], base["dispatches_per_step"],
+                   r["dispatches_per_step"], dd, threshold))
+    return table, regressions
+
+
+def _fmt(v, spec="%.4g", dash="-"):
+    return dash if v is None else spec % v
+
+
+def render_table(table):
+    cols = ["file", "metric", "value", "Δvalue%", "disp/step", "Δdisp%",
+            "compile_s", "Δcompile%", "wait_frac"]
+    out = [cols]
+    for r in table:
+        metric = r["metric"] + ("" if r["same_metric"] else " (≠ baseline)")
+        out.append([r["file"], metric, _fmt(r["value"]),
+                    _fmt(r["value_delta_pct"], "%+.1f"),
+                    _fmt(r["dispatches_per_step"], "%.3g"),
+                    _fmt(r["dps_delta_pct"], "%+.1f"),
+                    _fmt(r["compile_s"], "%.3g"),
+                    _fmt(r["compile_delta_pct"], "%+.1f"),
+                    _fmt(r["data_wait_frac"], "%.3g")])
+    widths = [max(len(row[i]) for row in out) for i in range(len(cols))]
+    lines = []
+    for i, row in enumerate(out):
+        lines.append("  ".join(
+            c.ljust(widths[j]) if j < 2 else c.rjust(widths[j])
+            for j, c in enumerate(row)))
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="delta table + regression gate over BENCH_*.json files")
+    p.add_argument("files", nargs="+",
+                   help="two or more bench captures; the first is baseline")
+    p.add_argument("--threshold", type=float, default=5.0,
+                   help="regression gate, percent (default 5): headline "
+                        "value drop or dispatches_per_step growth beyond "
+                        "this fails")
+    p.add_argument("--json", action="store_true",
+                   help="emit machine-readable JSON instead of the table")
+    args = p.parse_args(argv)
+    if len(args.files) < 2:
+        p.error("need at least two files (baseline + candidates)")
+
+    try:
+        rows = [load_bench(f) for f in args.files]
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print("bench_compare: %s" % e, file=sys.stderr)
+        return 2
+    table, regressions = compare(rows, args.threshold)
+    if args.json:
+        print(json.dumps({"baseline": rows[0]["file"], "rows": table,
+                          "threshold_pct": args.threshold,
+                          "regressions": regressions}, indent=1))
+    else:
+        print(render_table(table))
+        for msg in regressions:
+            print("REGRESSION %s" % msg)
+    if regressions:
+        if not args.json:
+            print("bench_compare: %d regression(s) beyond %.3g%%"
+                  % (len(regressions), args.threshold), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

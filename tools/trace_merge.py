@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Merge host span traces with profiler/XLA traces onto one timeline.
+
+The span tracer (``mxnet_tpu/telemetry/tracing.py``, ``MXNET_TRACE``) and
+``mx.profiler.dump()`` both emit chrome-trace JSON carrying a ``clock_sync``
+metadata record — ``{"unix_ts": <time.time()>, "trace_ts_us": <ts>}`` — that
+anchors the file's (arbitrary-epoch) trace timestamps to the wall clock.
+This tool rebases every input onto unix-epoch microseconds and concatenates
+them, so a request's host spans (queue/assemble/execute), the profiler's
+user annotations, and a TensorBoard trace-viewer export of the XLA device
+timeline land in ONE Perfetto view, still flow-linked and still carrying
+their step/request annotations (``args.trace`` / ``args.step``).
+
+Files without a ``clock_sync`` record (e.g. a raw trace-viewer export) fall
+back to ``--align start`` (shift so its earliest event matches the first
+file's earliest) or an explicit ``--offset-us`` per file.
+
+pids are namespaced per input (file i adds ``i * pid_stride``) and flow/
+async event ids are prefixed with the file index, so two files can never
+alias each other's tracks or arrows.
+
+Usage::
+
+    python tools/trace_merge.py mxtrace.json profile.json -o merged.json
+    python tools/trace_merge.py mxtrace.json tb_export.json --align start
+
+Workflow (docs/OBSERVABILITY.md "Tracing"): run with ``MXNET_TRACE=1`` and
+``mx.profiler`` (or ``use_xla_trace=True`` + a TensorBoard trace-viewer
+export) in the same process, export both, merge here, open in Perfetto.
+"""
+from __future__ import annotations
+
+import argparse
+import gzip
+import json
+import sys
+
+PID_STRIDE = 100000
+
+
+def load_events(path):
+    """Chrome-trace JSON (optionally gzipped; dict or bare array form)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt", encoding="utf-8") as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        return data.get("traceEvents", [])
+    return data
+
+
+def clock_anchor(events):
+    """→ (unix_ts, trace_ts_us) from the clock_sync metadata, or None."""
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "clock_sync":
+            a = ev.get("args") or {}
+            if "unix_ts" in a and "trace_ts_us" in a:
+                return float(a["unix_ts"]), float(a["trace_ts_us"])
+    return None
+
+
+def min_ts(events):
+    ts = [ev["ts"] for ev in events
+          if isinstance(ev.get("ts"), (int, float))]
+    return min(ts) if ts else 0.0
+
+
+def compute_offset(events, align, base_events, explicit_us):
+    """Microseconds to ADD to this file's timestamps.
+
+    clock mode rebases onto unix-epoch us (``unix_ts*1e6 - trace_ts_us``);
+    start mode matches earliest events; an explicit offset always wins."""
+    if explicit_us is not None:
+        return float(explicit_us), "explicit"
+    if align == "clock":
+        anchor = clock_anchor(events)
+        if anchor is not None:
+            unix_ts, ts_us = anchor
+            return unix_ts * 1e6 - ts_us, "clock"
+        if base_events is None:
+            return 0.0, "none (no clock_sync; first file keeps its epoch)"
+        # fall back per-file: align starts against the (already-shifted) base
+        return min_ts(base_events) - min_ts(events), "start (no clock_sync)"
+    if align == "start":
+        if base_events is None:
+            return 0.0, "start (base)"
+        return min_ts(base_events) - min_ts(events), "start"
+    return 0.0, "none"
+
+
+def shift_and_namespace(events, offset_us, index):
+    """Apply the time offset, namespace pids and flow/async ids."""
+    out = []
+    for ev in events:
+        ev = dict(ev)
+        if isinstance(ev.get("ts"), (int, float)):
+            ev["ts"] = ev["ts"] + offset_us
+        if isinstance(ev.get("pid"), int):
+            ev["pid"] = ev["pid"] + index * PID_STRIDE
+        if "id" in ev and ev.get("ph") in ("s", "t", "f", "b", "n", "e"):
+            ev["id"] = "m%d.%s" % (index, ev["id"])
+        out.append(ev)
+    return out
+
+
+def summarize(path, events):
+    xs = [ev for ev in events if ev.get("ph") == "X"]
+    traces = {ev.get("args", {}).get("trace") for ev in xs} - {None}
+    steps = sum(1 for ev in xs if ev.get("name") == "step")
+    reqs = sum(1 for ev in xs if ev.get("name") == "request")
+    span_ms = ((max(ev["ts"] + ev.get("dur", 0) for ev in xs)
+                - min(ev["ts"] for ev in xs)) / 1e3 if xs else 0.0)
+    return ("%s: %d events (%d slices, %.3f ms span), %d traces, "
+            "%d step / %d request roots"
+            % (path, len(events), len(xs), span_ms, len(traces), steps, reqs))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="merge chrome traces (host spans + profiler/XLA) onto "
+                    "one clock")
+    p.add_argument("traces", nargs="+",
+                   help="chrome-trace JSON files (.json or .json.gz); the "
+                        "first defines the output timebase")
+    p.add_argument("-o", "--output", default="merged.json")
+    p.add_argument("--align", choices=("clock", "start", "none"),
+                   default="clock",
+                   help="clock: rebase via each file's clock_sync record "
+                        "(default; falls back to start for files without "
+                        "one); start: align earliest events; none: "
+                        "concatenate untouched")
+    p.add_argument("--offset-us", action="append", type=float, default=[],
+                   metavar="US",
+                   help="explicit per-file offset in microseconds "
+                        "(repeatable, positional: first flag = first file)")
+    args = p.parse_args(argv)
+
+    merged, base = [], None
+    for i, path in enumerate(args.traces):
+        try:
+            events = load_events(path)
+        except (OSError, json.JSONDecodeError) as e:
+            print("trace_merge: cannot read %s: %s" % (path, e),
+                  file=sys.stderr)
+            return 2
+        explicit = args.offset_us[i] if i < len(args.offset_us) else None
+        offset, how = compute_offset(events, args.align, base, explicit)
+        shifted = shift_and_namespace(events, offset, i)
+        print(summarize(path, shifted))
+        print("  offset %+.1f us (%s)" % (offset, how))
+        if base is None:
+            base = shifted
+        merged.extend(shifted)
+
+    with open(args.output, "w", encoding="utf-8") as f:
+        json.dump({"traceEvents": merged, "displayTimeUnit": "ms"}, f,
+                  indent=1)
+    print("wrote %s (%d events from %d traces)"
+          % (args.output, len(merged), len(args.traces)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
