@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""CI smoke for the sharded fused Module train step (ISSUE 5).
+
+Runs a tiny MLP Module over an 8-device dp mesh (the virtual CPU host
+devices ``dev.sh`` forces via ``--xla_force_host_platform_device_count=8``),
+takes two train steps, and asserts the acceptance criteria of the issue:
+
+* the fused_mesh path engaged (no fallback counted),
+* exactly ONE compiled dispatch per step
+  (``step_dispatches_total{path="fused_mesh"} == train steps`` and
+  ``summary()["dispatches_per_step"] == 1``),
+* the loss heads are finite.
+
+Exit 0 on success, 1 with a message on any violation — wired into the unit
+tier of ``ci/run_tests.sh``.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main():
+    # invoked as `python ci/check_mesh_fused.py`: the script dir is on
+    # sys.path, the repo root is not — add it so mxnet_tpu imports
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["MXNET_TELEMETRY"] = "1"
+    os.environ.setdefault("MXNET_TELEMETRY_FILE", "/tmp/mesh_fused_smoke.jsonl")
+    os.environ["MXNET_MODULE_FUSED_STEP"] = "1"
+    os.environ.setdefault("MXNET_FUSED_ZERO", "0")
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import module as mod_mod
+    from mxnet_tpu import parallel
+    from mxnet_tpu.io import DataBatch
+    from mxnet_tpu.telemetry import instrument as tin
+
+    import jax
+
+    ndev = len(jax.devices())
+    if ndev < 8:
+        print("check_mesh_fused: need 8 devices, have %d (run under dev.sh)"
+              % ndev, file=sys.stderr)
+        return 1
+
+    mx.random.seed(0)
+    mesh = parallel.make_mesh({"dp": 8})
+    data = mx.sym.var("data")
+    x = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+    x = mx.sym.Activation(x, name="relu1", act_type="relu")
+    sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(x, name="fc2", num_hidden=4), name="softmax")
+
+    mod = mod_mod.Module(sym, mesh=mesh)
+    mod.bind(data_shapes=[("data", (16, 8))],
+             label_shapes=[("softmax_label", (16,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+
+    rng = np.random.RandomState(0)
+    steps = 2
+    for _ in range(steps):
+        b = DataBatch(
+            data=[mx.nd.array(rng.randn(16, 8).astype(np.float32))],
+            label=[mx.nd.array(rng.randint(0, 4, (16,)).astype(np.float32))])
+        mod.forward_backward(b)
+        mod.update()
+        out = mod.get_outputs()[0].asnumpy()
+        if not np.isfinite(out).all():
+            print("check_mesh_fused: non-finite outputs after a step",
+                  file=sys.stderr)
+            return 1
+
+    r = tin.registry()
+    got_steps = r.get("train_steps_total")
+    got_steps = got_steps.value(path="fused_mesh") if got_steps else 0
+    disp = r.get("step_dispatches_total")
+    disp = disp.value(path="fused_mesh") if disp else 0
+    fallbacks = r.get("module_fused_fallback_total")
+    dps = tin.summary()["dispatches_per_step"]
+
+    ok = True
+    if got_steps != steps:
+        print("check_mesh_fused: expected %d fused_mesh steps, counted %s"
+              % (steps, got_steps), file=sys.stderr)
+        ok = False
+    if disp != steps:
+        print("check_mesh_fused: expected 1 dispatch/step (%d total), "
+              "counted %s" % (steps, disp), file=sys.stderr)
+        ok = False
+    if fallbacks is not None:
+        print("check_mesh_fused: unexpected fallbacks: %s"
+              % (fallbacks.samples(),), file=sys.stderr)
+        ok = False
+    if dps != 1.0:
+        print("check_mesh_fused: dispatches_per_step %s != 1.0" % dps,
+              file=sys.stderr)
+        ok = False
+    if ok:
+        print("check_mesh_fused: OK — %d steps, 1 dispatch/step, finite loss "
+              "(dp=8 mesh)" % steps)
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

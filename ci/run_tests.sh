@@ -49,6 +49,9 @@ case "$tier" in
     # MXNET_TRACE=1, export, and validate the chrome trace (ts sanity, X
     # nesting, matched flow ids, cross-thread request trace)
     ./dev.sh python ci/check_trace.py --smoke
+    # sharded fused step smoke (ISSUE 5): 2 train steps on an 8-host-device
+    # dp mesh must be 1 compiled dispatch each with finite loss
+    ./dev.sh python ci/check_mesh_fused.py
     # telemetry unit tests (tests/test_telemetry.py) run as part of tests/
     ignore=()
     for f in "${NIGHTLY_FILES[@]}"; do ignore+=(--ignore "$f"); done

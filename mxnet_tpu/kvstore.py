@@ -75,6 +75,20 @@ class KVStore:
     def _is_dist(self):
         return "dist" in self._type
 
+    def folds_into_fused_step(self):
+        """True when this store's aggregation is subsumed by the in-step dp
+        psum of the sharded fused Module train step (ISSUE 5,
+        ``module/fused_step.py``): a local-family store whose only job is
+        summing per-device gradient replicas.  A single-process mesh step
+        produces ONE logical gradient already reduced over dp inside the
+        compiled step, so push/pull would be an identity round-trip.  Stores
+        that do real work per push keep the legacy path: dist types
+        (cross-process DCN aggregation), an installed updater/optimizer
+        (the update itself runs in the store), and gradient compression
+        (quantization + error feedback are push-time side effects)."""
+        return (not self._is_dist and self._updater is None
+                and self._compression is None)
+
     # -- core API ----------------------------------------------------------
     def init(self, key, value):
         """Register initial values.  Worker 0's value wins in dist mode

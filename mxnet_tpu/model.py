@@ -25,12 +25,21 @@ BatchEndParam = collections.namedtuple(
 )
 
 
-def _create_kvstore(kvstore, num_device, arg_params):
+def _create_kvstore(kvstore, num_device, arg_params, mesh=None):
     """Resolve a kvstore spec → (kvstore, update_on_kvstore).
 
     Reference ``model.py:77``.  On TPU a single process drives all local
     devices and gradient reduction happens in-step via psum, so a store is
-    only created for explicit instances or dist types.
+    only created for explicit instances or dist types.  ``mesh`` is the
+    Module's device mesh: a local-family *string* spec (``'local'`` /
+    ``'device'`` / ``'nccl'``) under a dp mesh resolves to no store at all —
+    where the reference built a CommDevice reduction tree per key
+    (``comm.h:451``), the sharded fused step's in-step psum (ISSUE 5,
+    ``module/fused_step.py``) already sums gradients over the dp axis inside
+    the compiled step, so an eager push/pull loop would only re-serialize
+    it.  Dist specs still create real stores (cross-process aggregation has
+    no in-step equivalent); explicit ``KVStore`` instances are honored and
+    folded later via ``KVStore.folds_into_fused_step`` when possible.
     """
     from . import kvstore as kv_mod
 
@@ -39,9 +48,18 @@ def _create_kvstore(kvstore, num_device, arg_params):
         kv = None
     elif isinstance(kvstore, kv_mod.KVStore):
         kv = kvstore
+        if mesh is not None and kv.folds_into_fused_step():
+            # explicit local-family store under a dp mesh: keep the store as
+            # the (identity) grad-aggregation layer but let the local
+            # updater own the optimizer, so the fused step can absorb the
+            # whole update (stores running their own updater/optimizer or
+            # compression keep update_on_kvstore=True and the legacy path)
+            update_on_kvstore = False
     elif isinstance(kvstore, str):
-        if num_device == 1 and "dist" not in kvstore:
-            kv = None  # single device: local updater is cheaper (reference behavior)
+        if "dist" not in kvstore and (num_device == 1 or mesh is not None):
+            # single device, or single-process dp mesh: the local updater
+            # plus the in-step psum is cheaper than a store round-trip
+            kv = None
         else:
             kv = kv_mod.create(kvstore)
             if kvstore == "local":

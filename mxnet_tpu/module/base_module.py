@@ -262,9 +262,15 @@ class BaseModule:
                         t0 = time.perf_counter() if probe else 0.0
                         with tracing.span("data_wait"):
                             next_data_batch = next(data_iter)
+                            # prepare() pre-stages batch N+1 — under a mesh
+                            # it issues the sharded device_put now, while
+                            # step N is still in flight (Module.prepare,
+                            # ISSUE 5).  It runs INSIDE the data_wait span
+                            # and probe window so the staging cost it hides
+                            # stays visible in data_wait_frac.
+                            self.prepare(next_data_batch)
                         if probe:
                             wait = time.perf_counter() - t0
-                        self.prepare(next_data_batch)
                     except StopIteration:
                         end_of_batch = True
                     # the metric read syncs the async dispatch, so the batch

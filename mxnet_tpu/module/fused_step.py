@@ -29,6 +29,19 @@ eligibility and the ``MXNET_MODULE_FUSED_STEP`` escape hatch live here (see
 ``fused_ineligible_reason`` and docs/PERF_NOTES.md "Fused Module train
 step").  Fallbacks route through the untouched legacy path and are counted
 in the telemetry registry (``module_fused_fallback_total{reason}``).
+
+**Sharded (mesh) fused step — ISSUE 5.**  A ``mesh=`` Module (dp-sharded
+batch feed, ``parallel.mesh``) used to hard-fall-back to the legacy path;
+now an eligible mesh-fed Module runs the whole multi-chip step as the same
+ONE donated jit, sharding-annotated: the batch enters dp-sharded (staged by
+``Module._stage_batch`` / the prefetch path), params/aux/grads are pinned
+replicated via ``out_shardings``, and GSPMD derives the dp gradient psum
+*inside* the compiled step — the collective overlaps compute on ICI instead
+of serializing at the Python boundary.  Opt-in ``MXNET_FUSED_ZERO=1``
+switches the optimizer state (and the returned grads) to the ZeRO-1 layout
+(``parallel.zero_shard_spec``): GSPMD reduce-scatters grads over dp, each
+device updates its 1/dp state shard, and the updated params allgather back
+to replicated — all in the same XLA module.
 """
 from __future__ import annotations
 
@@ -38,12 +51,21 @@ from .. import telemetry
 from ..base import MXNetError, env_flag
 from ..ndarray.ndarray import NDArray, _wrap
 
-__all__ = ["FusedStepper", "fused_enabled", "fused_ineligible_reason"]
+__all__ = ["FusedStepper", "fused_enabled", "fused_ineligible_reason",
+           "fused_zero_enabled"]
+
+_DP_AXIS = "dp"  # the mesh axis the Module batch feed shards over
 
 
 def fused_enabled():
     """``MXNET_MODULE_FUSED_STEP`` gate (docs/ENV_VARS.md) — default ON."""
     return env_flag("MXNET_MODULE_FUSED_STEP", default="1")
+
+
+def fused_zero_enabled():
+    """``MXNET_FUSED_ZERO`` gate (docs/ENV_VARS.md) — default OFF.  Only
+    consulted on the mesh path: ZeRO-1 sharding of optimizer state over dp."""
+    return env_flag("MXNET_FUSED_ZERO")
 
 
 def fused_ineligible_reason(module):
@@ -52,9 +74,15 @@ def fused_ineligible_reason(module):
 
     The conditions mirror what the fused graph cannot express: a monitor
     needs un-jitted per-node callbacks, ``grad_req`` mixes ("add"/"null")
-    need the executor's accumulate-into-buffer semantics, kvstore updates
-    leave the device, a mesh feed shards through the legacy forward, and
-    optimizers without a ``fused_step_kind`` carry host-side state.
+    need the executor's accumulate-into-buffer semantics, dist kvstores
+    aggregate across processes outside the step, and optimizers without a
+    ``fused_step_kind`` carry host-side state.  A mesh feed is fused when
+    the mesh carries the ``dp`` batch axis (the in-step psum replaces the
+    legacy sharded forward); a local-family kvstore under such a mesh folds
+    into that psum (``KVStore.folds_into_fused_step``) instead of forcing
+    the eager push/pull loop.  Mesh-*unsupported-feature* steps surface the
+    feature's own reason (``monitor``/``grad_req``/``optimizer``/...), not
+    the old blanket ``"mesh"``; a mesh without a dp axis is ``mesh_no_dp``.
     Explicit ``backward(out_grads=...)`` calls never reach here — only
     ``forward_backward`` stages fused steps, so user-supplied head
     cotangents always take the legacy path by construction.
@@ -65,10 +93,17 @@ def fused_ineligible_reason(module):
         return "no_optimizer"
     if module._exec is None or module._exec._monitor is not None:
         return "monitor"
-    if module._mesh is not None:
-        return "mesh"
     if module._kvstore is not None or module._update_on_kvstore:
-        return "kvstore"
+        kv = module._kvstore
+        if kv is not None and kv._is_dist:
+            # cross-process DCN aggregation happens outside the local step
+            return "kvstore_dist"
+        if not (module._mesh is not None and kv is not None
+                and not module._update_on_kvstore
+                and kv.folds_into_fused_step()):
+            return "kvstore"
+        # local-family store under a dp mesh: its per-key aggregation IS the
+        # in-step psum — fused path proceeds, the store stays idle
     if module._updater is None:
         return "no_optimizer"
     if module.inputs_need_grad:
@@ -82,6 +117,8 @@ def fused_ineligible_reason(module):
     opt = module._optimizer
     if opt is None or opt.fused_step_kind() is None:
         return "optimizer"
+    if module._mesh is not None and _DP_AXIS not in module._mesh.axis_names:
+        return "mesh_no_dp"
     return None
 
 
@@ -179,11 +216,16 @@ def _build_step_fn(graph_fn, arg_names, diff_names, const_names, kind, hp,
 class FusedStepper:
     """Per-Module fused-step cache: builds the jitted step once (per
     optimizer configuration) and re-dispatches it for every eligible step;
-    jax.jit's executable cache provides the per-shape-signature caching."""
+    jax.jit's executable cache provides the per-shape-signature caching.
+
+    With a mesh the same jit is built sharding-annotated (``out_shardings``
+    pinned so params/state keep their layout across donated steps, GSPMD
+    inserting the dp collectives); the jit construction is deferred to the
+    first ``run`` because the ZeRO-1 ``out_shardings`` pytree needs the
+    optimizer-state leaf structure, which ``Updater.states`` materializes
+    lazily."""
 
     def __init__(self, module):
-        import jax
-
         exec_ = module._exec
         opt = module._optimizer
         self._opt = opt
@@ -204,12 +246,91 @@ class FusedStepper:
             hp.update(beta1=float(opt.beta1), beta2=float(opt.beta2),
                       epsilon=float(opt.epsilon))
         self._nancheck = env_flag("MXNET_NANCHECK")
+        self._mesh = module._mesh
+        self._zero = self._mesh is not None and fused_zero_enabled()
         self._nsteps = 0
         self._pending_flag = None  # (finite device scalar, step number)
-        fn = _build_step_fn(exec_._graph_fn(True), self._arg_names,
-                            self._diff_names, self._const_names,
-                            self._kind, hp, nancheck=self._nancheck)
-        self._jit = jax.jit(fn, donate_argnums=(0, 1, 2, 3))
+        self._fn = _build_step_fn(exec_._graph_fn(True), self._arg_names,
+                                  self._diff_names, self._const_names,
+                                  self._kind, hp, nancheck=self._nancheck)
+        self._jit = None
+        self._step = None
+        # mesh-path sharding cache, filled on first run (needs the state
+        # leaf structure): (repl, [grad/param spec]*P, [[state leaf spec]])
+        # — static for the stepper's lifetime (param shapes survive
+        # retraces), so run() never rebuilds NamedShardings per step
+        self._shardings = None
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def zero(self):
+        """True when this stepper runs in ZeRO-1 mode (sharded opt state)."""
+        return self._zero
+
+    # -- mesh shardings ------------------------------------------------------
+    def _repl(self):
+        from ..parallel import named_sharding
+
+        return named_sharding(self._mesh)
+
+    def _shard_spec(self, v):
+        """Layout for grads and optimizer-state leaves on the mesh path:
+        ZeRO-1 partitions them over dp (``parallel.zero_shard_spec``), the
+        replicated mode keeps them whole on every device."""
+        if not self._zero:
+            return self._repl()
+        from ..parallel import zero_shard_spec
+
+        return zero_shard_spec(v, self._mesh, _DP_AXIS)
+
+    @staticmethod
+    def _place(v, sharding):
+        """Commit ``v`` to ``sharding`` if it is not already there — a no-op
+        from the second step on (the pinned out_shardings hand back buffers
+        already in layout, so donation recycles them in place)."""
+        from ..parallel import place_committed
+
+        return place_committed(v, sharding)
+
+    def _ensure_jit(self, diff_vals, leaves):
+        """Build the jitted step on first dispatch.  Mesh path: pin
+        ``out_shardings`` (params/aux replicated; grads and state leaves per
+        ``_shard_spec``; heads and the nancheck flag compiler-chosen) so the
+        layout survives every donated step, and declare the GSPMD-derived
+        collectives to telemetry once per build."""
+        import jax
+
+        if self._step is not None:
+            return
+        if self._mesh is None:
+            self._jit = jax.jit(self._fn, donate_argnums=(0, 1, 2, 3))
+        else:
+            from ..parallel import note_derived
+
+            repl, grad_sh, state_sh = self._shardings
+            out_sh = ([repl] * len(diff_vals), state_sh,
+                      [repl] * len(self._aux_names), None, grad_sh)
+            if self._nancheck:
+                out_sh = out_sh + (None,)
+            self._jit = jax.jit(self._fn, donate_argnums=(0, 1, 2, 3),
+                                out_shardings=out_sh)
+            # declared ONCE per stepper build (not per retrace like the
+            # explicit lax collectives — a reshape re-specializes the same
+            # logical collectives, so one declaration per layout is honest)
+            if self._zero:
+                # only leaves zero_shard_spec actually splits ride the
+                # reduce-scatter/allgather; non-divisible leaves stay
+                # replicated and their grads are a plain psum
+                split = [v for v, s in zip(diff_vals, grad_sh) if s != repl]
+                whole = [v for v, s in zip(diff_vals, grad_sh) if s == repl]
+                note_derived("reduce_scatter", split)
+                note_derived("allgather", split)
+                note_derived("psum_grads", whole)
+            else:
+                note_derived("psum_grads", diff_vals)
         # compile/steady-state accounting (identity when telemetry is off)
         self._step = telemetry.instrument_step(self._jit,
                                                name="module_fused_step")
@@ -220,12 +341,15 @@ class FusedStepper:
         return size() if size is not None else None
 
     def stale(self, module):
-        """True when the Module's optimizer (or a folded-in hyperparam, or
-        the MXNET_NANCHECK gate — it changes the step's output structure)
-        changed since this stepper was built — caller rebuilds."""
+        """True when the Module's optimizer (or a folded-in hyperparam, the
+        MXNET_NANCHECK gate — it changes the step's output structure — or
+        the MXNET_FUSED_ZERO gate — it changes the state layout) changed
+        since this stepper was built — caller rebuilds."""
         return (module._optimizer is not self._opt
                 or _hp_signature(module._optimizer) != self._hp_sig
-                or env_flag("MXNET_NANCHECK") != self._nancheck)
+                or env_flag("MXNET_NANCHECK") != self._nancheck
+                or (module._mesh is not None
+                    and fused_zero_enabled() != self._zero))
 
     def check_nonfinite(self):
         """Raise if the PREVIOUS step's folded isfinite flag tripped.
@@ -269,6 +393,26 @@ class FusedStepper:
                 updater.states_synced[i] = True
             states.append(updater.states[i])
             leaves.append(_state_leaves(updater.states[i]))
+        if self._mesh is not None:
+            # commit every donated operand to its pinned layout (params/aux
+            # replicated over the mesh, grads + opt state per _shard_spec —
+            # 1/dp shards in ZeRO-1 mode).  Only the FIRST step actually
+            # moves bytes; afterwards the step's out_shardings return
+            # buffers already in layout and _place is a sharding == check.
+            # The batch feed itself is already dp-sharded by _stage_batch.
+            if self._shardings is None:
+                self._shardings = (
+                    self._repl(),
+                    [self._shard_spec(v) for v in diff_vals],
+                    [[self._shard_spec(v) for v in lv] for lv in leaves])
+            repl, grad_sh, state_sh = self._shardings
+            diff_vals = [self._place(v, repl) for v in diff_vals]
+            aux_vals = [self._place(v, repl) for v in aux_vals]
+            grads_in = [self._place(g, s)
+                        for g, s in zip(grads_in, grad_sh)]
+            leaves = [[self._place(v, s) for v, s in zip(lv, shl)]
+                      for lv, shl in zip(leaves, state_sh)]
+        self._ensure_jit(diff_vals, leaves)
         # host-side hyperparam prep, O(P) python and zero dispatches: update
         # counts first (the legacy Updater order), then read lr/wd through
         # the optimizer's scheduler/multiplier logic; adam's bias correction
@@ -276,11 +420,13 @@ class FusedStepper:
         for i in range(len(self._diff_names)):
             opt._update_count(i)
         lrs, wds = [], []
+        from ..ops.optimizer_ops import adam_bias_corrected_lr
+
         for i in range(len(self._diff_names)):
             lr, wd = opt._get_lr(i), opt._get_wd(i)
             if self._kind == "adam":
-                t = opt._index_update_count[i]
-                lr *= (1.0 - opt.beta2 ** t) ** 0.5 / (1.0 - opt.beta1 ** t)
+                lr = adam_bias_corrected_lr(lr, opt._index_update_count[i],
+                                            opt.beta1, opt.beta2)
             lrs.append(lr)
             wds.append(wd)
         key = _rnd.next_key()

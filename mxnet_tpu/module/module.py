@@ -8,7 +8,11 @@ changes re-bind (re-jit) exactly like the reference's MutableModule.  Data
 parallelism: pass ``mesh=`` (a ``jax.sharding.Mesh`` with a ``dp`` axis) and
 every batch is sharded over it while params stay replicated — the XLA
 equivalent of DataParallelExecutorGroup + kvstore 'device'
-(``executor_group.py:143``, ``comm.h:451``).
+(``executor_group.py:143``, ``comm.h:451``).  An eligible mesh-fed train
+step runs as ONE donated sharding-annotated jit dispatch (vjp + in-step dp
+psum + optimizer, module/fused_step.py ISSUE 5; ``MXNET_FUSED_ZERO=1`` adds
+ZeRO-1 optimizer-state sharding), with the legacy sharded forward kept as
+the fallback for the cases the fused graph cannot express.
 """
 from __future__ import annotations
 
@@ -85,6 +89,10 @@ class Module(BaseModule):
         self._fused = None
         self._fused_pending = False
         self._nan_step = 0  # MXNET_NANCHECK legacy-path step counter
+        # prefetch state (ISSUE 5): (batch_obj, feed) pre-staged by
+        # prepare() so the next batch's (sharded) device_put overlaps the
+        # in-flight step instead of serializing behind it
+        self._prestaged = None
 
     # -- properties ----------------------------------------------------------
     @property
@@ -252,6 +260,7 @@ class Module(BaseModule):
             args=args, args_grad=grads, grad_req=req, aux_states=aux,
         )
         self.binded = True
+        self._prestaged = None  # pre-staged feed targeted the old executor
 
         if shared_module is not None and shared_module.params_initialized:
             self._arg_params = {n: self._exec.arg_dict[n] for n in self._param_names}
@@ -282,7 +291,8 @@ class Module(BaseModule):
         self._fused = None  # stepper folds optimizer hyperparams: rebuild
 
         kv, update_on_kvstore = _create_kvstore(
-            kvstore, 1, {n: self._exec.arg_dict[n] for n in self._param_names}
+            kvstore, 1, {n: self._exec.arg_dict[n] for n in self._param_names},
+            mesh=self._mesh,
         )
         # loss-op backwards emit per-sample gradients; normalize by the
         # global batch like the reference (module.py:497 rescale_grad)
@@ -329,19 +339,50 @@ class Module(BaseModule):
             self._preload_opt_states = None
 
     # -- compute ---------------------------------------------------------------
+    def _batch_descs(self, data_batch):
+        """DataDescs the batch would feed (shape-change detection)."""
+        provide = getattr(data_batch, "provide_data", None)
+        return _as_descs(provide) if provide else [
+            DataDesc(n, a.shape) for n, a in zip(self._data_names, data_batch.data)
+        ]
+
+    def _build_feed(self, data_batch):
+        """{arg name: device-ready NDArray} for a shape-matching batch —
+        under a mesh every array is committed dp-sharded here (the
+        ``device_put`` the prefetch path issues early, ISSUE 5)."""
+        feed = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feed[name] = arr
+        if self._label_shapes and getattr(data_batch, "label", None) is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feed[name] = arr
+        elif self._label_shapes:
+            # predict-mode batch without labels: keep stale label buffers
+            pass
+        if self._mesh is not None:
+            from ..parallel import shard
+
+            return {
+                k: shard(v if isinstance(v, nd.NDArray) else nd.array(v),
+                         ("dp",) + (None,) * (len(v.shape) - 1), mesh=self._mesh)
+                for k, v in feed.items()
+            }
+        return {k: v if isinstance(v, nd.NDArray) else nd.array(v)
+                for k, v in feed.items()}
+
     def _stage_batch(self, data_batch):
         """Reshape-on-new-batch-shape (MutableModule semantics) + write the
         batch feed into the executor's arg buffers.  Shared by ``forward``
         and the fused ``forward_backward`` staging (module/fused_step.py).
+        A feed already pre-staged for this very batch by ``prepare`` is
+        consumed as-is — its device_put was issued while the previous step
+        was still in flight.
 
         Any object with a ``.data`` list is a valid batch (reference
         module.py duck-types the same way —
         example/python-howto/debug_conv.py SimpleData).
         """
-        provide = getattr(data_batch, "provide_data", None)
-        new_descs = _as_descs(provide) if provide else [
-            DataDesc(n, a.shape) for n, a in zip(self._data_names, data_batch.data)
-        ]
+        new_descs = self._batch_descs(data_batch)
         if [d.shape for d in new_descs] != [d.shape for d in self._data_shapes]:
             if getattr(data_batch, "provide_label", None):
                 new_labels = _as_descs(data_batch.provide_label)
@@ -356,25 +397,31 @@ class Module(BaseModule):
                 new_labels = None
             self.reshape(new_descs, new_labels)
 
-        feed = {}
-        for name, arr in zip(self._data_names, data_batch.data):
-            feed[name] = arr
-        if self._label_shapes and getattr(data_batch, "label", None) is not None:
-            for name, arr in zip(self._label_names, data_batch.label):
-                feed[name] = arr
-        elif self._label_shapes:
-            # predict-mode batch without labels: keep stale label buffers
-            pass
-        if self._mesh is not None:
-            from ..parallel import shard
-
-            feed = {
-                k: shard(v if isinstance(v, nd.NDArray) else nd.array(v),
-                         ("dp",) + (None,) * (len(v.shape) - 1), mesh=self._mesh)
-                for k, v in feed.items()
-            }
+        staged = self._prestaged
+        self._prestaged = None
+        if staged is not None and staged[0] is data_batch:
+            feed = staged[1]
+        else:
+            feed = self._build_feed(data_batch)
         for k, v in feed.items():
-            self._exec.arg_dict[k] = v if isinstance(v, nd.NDArray) else nd.array(v)
+            self._exec.arg_dict[k] = v
+
+    def prepare(self, data_batch):
+        """Pre-stage the UPCOMING batch (ISSUE 5): issue its (sharded)
+        host→device transfer now, while the in-flight step still occupies
+        the device, so the copy overlaps compute instead of serializing at
+        the next ``forward_backward``.  The fit loop calls this inside its
+        ``data_wait`` accounting, keeping ``data_wait_frac`` honest about
+        the hidden staging cost.  Batches whose shapes would trigger a
+        reshape are left to ``_stage_batch`` (a mid-flight re-bind would
+        tear down buffers the pending step output reads still need)."""
+        if not (self.binded and self.params_initialized):
+            return
+        descs = self._batch_descs(data_batch)
+        if [d.shape for d in descs] != [d.shape for d in self._data_shapes]:
+            self._prestaged = None
+            return
+        self._prestaged = (data_batch, self._build_feed(data_batch))
 
     def _flush_pending(self):
         """Materialize a staged fused step through the legacy path — a
@@ -408,7 +455,8 @@ class Module(BaseModule):
 
         reason = fused_ineligible_reason(self)
         if reason is None:
-            with tracing.span("forward_backward", path="fused"):
+            path = "fused_mesh" if self._mesh is not None else "fused"
+            with tracing.span("forward_backward", path=path):
                 self._stage_batch(data_batch)
             self._fused_pending = True
             return
@@ -432,9 +480,14 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized and self.optimizer_initialized
         if self._fused_pending:
             self._fused_pending = False
-            from .fused_step import FusedStepper
+            from .fused_step import FusedStepper, fused_zero_enabled
 
-            with tracing.span("update", path="fused"):
+            if self._mesh is not None:
+                span_kw = {"path": "fused_mesh",
+                           "zero": int(fused_zero_enabled())}
+            else:
+                span_kw = {"path": "fused"}
+            with tracing.span("update", **span_kw):
                 if self._fused is not None and self._fused.stale(self):
                     # don't let a rebuild discard an unread nancheck flag
                     self._fused.check_nonfinite()
@@ -442,8 +495,8 @@ class Module(BaseModule):
                 if self._fused is None:
                     self._fused = FusedStepper(self)
                 self._fused.run(self)
-            telemetry.note_train_step("fused")
-            telemetry.note_dispatch(1, path="fused")
+            telemetry.note_train_step(span_kw["path"])
+            telemetry.note_dispatch(1, path=span_kw["path"])
             return
         telemetry.note_train_step("legacy")
         if env_flag("MXNET_NANCHECK"):

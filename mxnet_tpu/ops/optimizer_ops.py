@@ -146,6 +146,15 @@ def signsgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0, clip_gradient=
     return weight - lr * (jnp.sign(g) + wd * weight)
 
 
+def adam_bias_corrected_lr(lr, t, beta1=0.9, beta2=0.999):
+    """Fold adam's step-``t`` bias correction into the learning rate
+    (``lr * sqrt(1-b2^t)/(1-b1^t)``, the ``optimizer.adam_rule`` schedule) so
+    the in-graph :func:`adam_update` kernel stays schedule-free.  Host-side
+    math on Python floats — the fused step passes the result in through its
+    traced per-parameter lr vector, so advancing ``t`` never retraces."""
+    return lr * (1.0 - beta2 ** t) ** 0.5 / (1.0 - beta1 ** t)
+
+
 def fused_update(kind, weight, grad, state, *, lr, wd, rescale_grad=1.0,
                  clip_gradient=-1.0, momentum=0.0, beta1=0.9, beta2=0.999,
                  epsilon=1e-8):
@@ -155,10 +164,16 @@ def fused_update(kind, weight, grad, state, *, lr, wd, rescale_grad=1.0,
     ONE donated jit alongside forward+vjp.
 
     ``lr``/``wd`` may be traced scalars; for ``adam`` the caller passes
-    ``lr`` already bias-corrected (``lr * sqrt(1-b2^t)/(1-b1^t)``, the
-    ``optimizer.adam_rule`` schedule) so the kernel runs with identity
-    rescale.  ``state`` matches the optimizer's ``create_state`` order:
-    ``()`` for sgd, ``(mom,)`` for sgd_mom, ``(mean, var)`` for adam.
+    ``lr`` already bias-corrected (:func:`adam_bias_corrected_lr`) so the
+    kernel runs with identity rescale.  ``state`` matches the optimizer's
+    ``create_state`` order: ``()`` for sgd, ``(mom,)`` for sgd_mom,
+    ``(mean, var)`` for adam.
+
+    Every kernel here is elementwise over (weight, grad, state), so the
+    update is sharding-neutral: under the sharded fused step GSPMD runs it
+    on whatever partition the operands carry — full arrays on the
+    replicated path, per-device 1/dp shards in ZeRO-1 mode (the grads'
+    reduce-scatter and the params' allgather land around it for free).
     """
     if kind == "sgd":
         new_w = sgd_update(weight, grad, lr=lr, wd=wd,

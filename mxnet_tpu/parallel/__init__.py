@@ -25,9 +25,14 @@ from .mesh import (
     named_sharding,
     shard_params,
     local_mesh_devices,
+    place_committed,
     zero_shard_spec,
+    zero1_shardings,
+    zero1_place,
+    zero1_state_bytes,
 )
-from .collectives import allreduce, allgather, reduce_scatter, pmean, psum_scatter
+from .collectives import (allreduce, allgather, reduce_scatter, pmean,
+                          psum_scatter, note_derived)
 from . import dist
 from . import checkpoint
 from .ring import ring_attention, ring_self_attention
@@ -44,12 +49,17 @@ __all__ = [
     "named_sharding",
     "shard_params",
     "local_mesh_devices",
+    "place_committed",
     "zero_shard_spec",
+    "zero1_shardings",
+    "zero1_place",
+    "zero1_state_bytes",
     "allreduce",
     "allgather",
     "reduce_scatter",
     "pmean",
     "psum_scatter",
+    "note_derived",
     "dist",
     "checkpoint",
     "ring_attention",

@@ -8,7 +8,8 @@ reference's ``local`` kvstore degenerates on one device.
 """
 from __future__ import annotations
 
-__all__ = ["allreduce", "pmean", "allgather", "reduce_scatter", "psum_scatter"]
+__all__ = ["allreduce", "pmean", "allgather", "reduce_scatter",
+           "psum_scatter", "note_derived"]
 
 
 def _tree_map(fn, tree):
@@ -33,6 +34,18 @@ def _note_bytes(op, tree):
     n = sum(telemetry.array_nbytes(leaf)
             for leaf in jax.tree_util.tree_leaves(tree))
     telemetry.note_bytes("collective_bytes_total", n, op=op)
+
+
+def note_derived(op, tree):
+    """Record telemetry bytes for a collective GSPMD *derives* from sharding
+    annotations rather than an explicit ``lax`` call site — the sharded
+    fused Module step (``module/fused_step.py``) declares its in-step grad
+    psum / ZeRO reduce-scatter / param allgather here.  Declared once per
+    stepper *build* (one sample per collective layout), a coarser grain
+    than the explicit collectives above (one sample per trace): a reshape
+    retrace re-specializes the same logical collectives, so it is not
+    re-declared."""
+    _note_bytes(op, tree)
 
 
 def allreduce(tree, axis_name="dp"):

@@ -159,8 +159,9 @@ def zero_shard_spec(v, mesh, axis="dp"):
     ``axis`` mesh dimension divides, replicate otherwise (scalars, biases and
     BN vectors are noise next to weight matrices).  The single source of
     truth for optimizer-state sharding — used by
-    ``gluon.functional.make_train_step(shard_optimizer_states=True)`` and
-    the ``__graft_entry__`` ZeRO dryrun phase.
+    ``gluon.functional.make_train_step(shard_optimizer_states=True)``, the
+    Module fused step's ZeRO-1 mode (``module/fused_step.py``,
+    ``MXNET_FUSED_ZERO``) and the ``__graft_entry__`` ZeRO dryrun phase.
     """
     from jax.sharding import NamedSharding, PartitionSpec
 
@@ -170,3 +171,58 @@ def zero_shard_spec(v, mesh, axis="dp"):
             return NamedSharding(mesh, PartitionSpec(
                 *([None] * ax + [axis] + [None] * (v.ndim - ax - 1))))
     return NamedSharding(mesh, PartitionSpec())
+
+
+def place_committed(v, sharding):
+    """Commit ``v`` to ``sharding`` unless it is already there — the
+    idempotent device_put both :func:`zero1_place` and the fused stepper's
+    per-step placement use (steady state reduces to one sharding ==
+    check per array)."""
+    import jax
+
+    if getattr(v, "sharding", None) == sharding:
+        return v
+    return jax.device_put(v, sharding)
+
+
+def zero1_shardings(tree, mesh, axis="dp"):
+    """Pytree of :func:`zero_shard_spec` shardings matching ``tree`` — the
+    ZeRO-1 partition layout for an optimizer-state (or parameter) pytree.
+
+    Pin these as jit ``out_shardings`` and GSPMD derives the reduce-scatter
+    of the gradients feeding the shard update and the allgather of whatever
+    consumes the result, inside the same XLA module (no hand-written
+    collective calls)."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda v: zero_shard_spec(v, mesh, axis),
+                                  tree)
+
+
+def zero1_place(tree, mesh, axis="dp"):
+    """Partition ``tree`` over ``axis`` ZeRO-1 style → (placed, shardings).
+
+    Each leaf is ``device_put`` with its :func:`zero_shard_spec`; the
+    returned shardings pytree is what callers pin as jit ``out_shardings``
+    (donation then recycles the per-device shards every step).  Shared by
+    the Module fused step's ZeRO-1 mode and the ``__graft_entry__`` ZeRO
+    dryrun so both exercise the same partition logic."""
+    import jax
+
+    sh = zero1_shardings(tree, mesh, axis)
+    placed = jax.tree_util.tree_map(place_committed, tree, sh)
+    return placed, sh
+
+
+def zero1_state_bytes(tree):
+    """Per-device bytes actually held for a (possibly sharded) state pytree
+    — the memory side of the ZeRO-1 ledger (docs/PERF_NOTES.md)."""
+    import jax
+    import numpy as np
+
+    total = 0
+    for v in jax.tree_util.tree_leaves(tree):
+        shard_shape = v.sharding.shard_shape(v.shape) if hasattr(
+            v, "sharding") else v.shape
+        total += int(np.prod(shard_shape)) * v.dtype.itemsize
+    return total

@@ -1,0 +1,385 @@
+"""Sharded fused Module train step (ISSUE 5, module/fused_step.py mesh path).
+
+Coverage demanded by the issue:
+- mesh-fused vs legacy-mesh numerical parity after N steps for sgd,
+  momentum sgd and adam — BatchNorm aux fold and per-parameter lr/wd
+  vectors (``lr_mult``/``wd_mult``) included;
+- ZeRO-1 mode (``MXNET_FUSED_ZERO=1``) matches the replicated-state
+  results while each device holds only 1/dp of the optimizer state;
+- acceptance: one compiled dispatch per mesh step
+  (``train_steps_total{path="fused_mesh"}``, ``dispatches_per_step == 1``);
+- fallback reasons distinguish mesh-unsupported-feature tags from the old
+  blanket ``"mesh"``; a local kvstore under a dp mesh folds into the
+  in-step psum;
+- the prefetch path (``Module.prepare``) pre-stages the next batch's
+  sharded feed and ``_stage_batch`` consumes it without re-staging.
+
+Runs on the 8 virtual CPU host devices conftest.py forces via
+``XLA_FLAGS=--xla_force_host_platform_device_count=8``.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import module as mod_mod
+from mxnet_tpu import parallel
+from mxnet_tpu.io import DataBatch
+from mxnet_tpu.module import fused_step
+from mxnet_tpu.telemetry import instrument as tin
+
+STEPS = 4
+BATCH = 16  # divisible by dp=8
+DIM = 8
+DP = 8
+
+
+def _mesh():
+    return parallel.make_mesh({"dp": DP})
+
+
+def _sym(bn=True, dropout=False):
+    data = mx.sym.var("data")
+    # no_bias under BN: see test_module_fused.py / docs/PERF_NOTES.md (a
+    # zero-true-gradient bias drifts under adam on ANY two compilations)
+    x = mx.sym.FullyConnected(data, name="fc1", num_hidden=16, no_bias=bn)
+    if bn:
+        x = mx.sym.BatchNorm(x, name="bn1")
+    x = mx.sym.Activation(x, name="relu1", act_type="relu")
+    if dropout:
+        x = mx.sym.Dropout(x, name="drop1", p=0.5)
+    x = mx.sym.FullyConnected(x, name="fc2", num_hidden=4)
+    return mx.sym.SoftmaxOutput(x, name="softmax")
+
+
+def _batches(steps=STEPS, batch=BATCH, dim=DIM):
+    rng = np.random.RandomState(7)
+    return [
+        DataBatch(data=[mx.nd.array(rng.randn(batch, dim).astype(np.float32))],
+                  label=[mx.nd.array(rng.randint(0, 4, (batch,)).astype(np.float32))])
+        for _ in range(steps)
+    ]
+
+
+def _make_module(sym=None, mesh=None, **kwargs):
+    mod = mod_mod.Module(sym if sym is not None else _sym(),
+                         mesh=mesh if mesh is not None else _mesh(), **kwargs)
+    mod.bind(data_shapes=[("data", (BATCH, DIM))],
+             label_shapes=[("softmax_label", (BATCH,))])
+    rng = np.random.RandomState(3)
+    shapes = {n: a.shape for n, a in mod._exec.arg_dict.items()}
+    arg = {n: mx.nd.array(rng.randn(*shapes[n]).astype(np.float32) * 0.1)
+           for n in sorted(mod._param_names)}
+    mod.init_params(arg_params=arg)
+    return mod
+
+
+def _train(monkeypatch, fused, optimizer, opt_params, sym=None, steps=STEPS,
+           zero=False, lr_mult=None, wd_mult=None):
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1" if fused else "0")
+    monkeypatch.setenv("MXNET_FUSED_ZERO", "1" if zero else "0")
+    mx.random.seed(11)  # same per-step key sequence on both paths
+    mod = _make_module(sym)
+    mod.init_optimizer(optimizer=optimizer, optimizer_params=dict(opt_params))
+    if lr_mult:
+        mod._optimizer.set_lr_mult(lr_mult)
+    if wd_mult:
+        mod._optimizer.set_wd_mult(wd_mult)
+    for b in _batches(steps):
+        mod.forward_backward(b)
+        mod.update()
+    arg_params, aux_params = mod.get_params()
+    return ({n: v.asnumpy() for n, v in arg_params.items()},
+            {n: v.asnumpy() for n, v in aux_params.items()},
+            mod.get_outputs()[0].asnumpy(), mod)
+
+
+def _assert_params_close(a, b, **kw):
+    for n in a:
+        np.testing.assert_allclose(a[n], b[n], rtol=2e-5, atol=1e-6,
+                                   err_msg=n, **kw)
+
+
+@pytest.mark.parametrize("optimizer,opt_params", [
+    ("sgd", {"learning_rate": 0.1}),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.01}),
+], ids=["sgd", "sgd_mom", "adam"])
+def test_mesh_fused_legacy_parity(monkeypatch, optimizer, opt_params):
+    """Identical params/aux/outputs after N steps on the dp mesh — the
+    fused step's in-graph psum + optimizer matches the legacy sharded
+    forward + eager updater loop."""
+    arg_f, aux_f, out_f, mod_f = _train(monkeypatch, True, optimizer, opt_params)
+    arg_l, aux_l, out_l, mod_l = _train(monkeypatch, False, optimizer, opt_params)
+    assert mod_f._fused is not None, "mesh fused path never engaged"
+    assert mod_f._fused.mesh is not None and not mod_f._fused.zero
+    assert mod_l._fused is None, "legacy run built a fused stepper"
+    _assert_params_close(arg_f, arg_l)
+    _assert_params_close(aux_f, aux_l)
+    np.testing.assert_allclose(out_f, out_l, rtol=2e-5, atol=1e-6)
+    # aux actually moved (BatchNorm stats trained under the mesh feed)
+    assert any(np.abs(v).max() > 1e-4 for v in aux_f.values())
+
+
+def test_mesh_fused_per_param_lr_wd(monkeypatch):
+    """Per-parameter lr/wd vectors (lr_mult/wd_mult) flow into the sharded
+    fused step as traced vectors and match the legacy-mesh updater."""
+    mults = dict(lr_mult={"fc1_weight": 0.5},
+                 wd_mult={"fc2_weight": 2.0, "fc2_bias": 0.0})
+    opt_params = {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-3}
+    arg_f, _, _, mod_f = _train(monkeypatch, True, "sgd", opt_params, **mults)
+    arg_l, _, _, _ = _train(monkeypatch, False, "sgd", opt_params, **mults)
+    assert mod_f._fused is not None and mod_f._fused.mesh is not None
+    _assert_params_close(arg_f, arg_l)
+
+
+@pytest.mark.parametrize("optimizer,opt_params", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.01}),
+], ids=["sgd_mom", "adam"])
+def test_zero1_matches_replicated(monkeypatch, optimizer, opt_params):
+    """MXNET_FUSED_ZERO=1: same numbers as the replicated-state mesh run,
+    while every dp-divisible optimizer-state leaf is held as a 1/dp shard
+    per device."""
+    arg_z, aux_z, out_z, mod_z = _train(monkeypatch, True, optimizer,
+                                        opt_params, zero=True)
+    arg_r, aux_r, out_r, _ = _train(monkeypatch, True, optimizer, opt_params)
+    assert mod_z._fused is not None and mod_z._fused.zero
+    _assert_params_close(arg_z, arg_r)
+    _assert_params_close(aux_z, aux_r)
+    np.testing.assert_allclose(out_z, out_r, rtol=2e-5, atol=1e-6)
+
+    # memory ledger: each device holds only its shard of the state
+    sharded_leaves = 0
+    for i, n in enumerate(mod_z._param_names):
+        st = mod_z._updater.states[i]
+        if st is None:
+            continue
+        leaves = [st] if not isinstance(st, (tuple, list)) else list(st)
+        for leaf in leaves:
+            arr = leaf._data
+            shard = arr.sharding.shard_shape(arr.shape)
+            if arr.shape[0] % DP == 0 and arr.shape[0] >= DP:
+                assert int(np.prod(shard)) * DP == int(np.prod(arr.shape)), \
+                    (n, arr.shape, shard)
+                sharded_leaves += 1
+    assert sharded_leaves > 0, "no optimizer-state leaf was actually sharded"
+    total = parallel.zero1_state_bytes(
+        [st._data if not isinstance(st, (tuple, list)) else
+         [leaf._data for leaf in st]
+         for st in mod_z._updater.states.values() if st is not None])
+    full = sum(
+        int(np.prod(leaf.shape)) * 4
+        for st in mod_z._updater.states.values() if st is not None
+        for leaf in ([st] if not isinstance(st, (tuple, list)) else st))
+    assert total < full, (total, full)
+
+
+def test_zero_gate_flip_rebuilds_stepper(monkeypatch):
+    """Flipping MXNET_FUSED_ZERO mid-run rebuilds the stepper (the state
+    layout changes) and training continues consistently."""
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    monkeypatch.setenv("MXNET_FUSED_ZERO", "0")
+    mx.random.seed(11)
+    mod = _make_module()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    b1, b2 = _batches(2)
+    mod.forward_backward(b1)
+    mod.update()
+    first = mod._fused
+    assert first is not None and not first.zero
+    monkeypatch.setenv("MXNET_FUSED_ZERO", "1")
+    mod.forward_backward(b2)
+    mod.update()
+    assert mod._fused is not first and mod._fused.zero
+    for _, v in mod.get_params()[0].items():
+        assert np.isfinite(v.asnumpy()).all()
+
+
+# -- fallback-reason taxonomy -------------------------------------------------
+def test_mesh_without_dp_axis_falls_back(monkeypatch):
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    mod = _make_module(mesh=parallel.make_mesh({"tp": DP}))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    assert fused_step.fused_ineligible_reason(mod) == "mesh_no_dp"
+
+
+def test_mesh_unsupported_feature_reason_not_blanket_mesh(monkeypatch):
+    """A mesh Module with an unfusable optimizer reports the FEATURE reason
+    ("optimizer"), not the old blanket "mesh"."""
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    mod = _make_module()
+    mod.init_optimizer(optimizer="rmsprop",
+                       optimizer_params={"learning_rate": 0.01})
+    assert fused_step.fused_ineligible_reason(mod) == "optimizer"
+
+
+def test_local_kvstore_folds_into_mesh_step(monkeypatch):
+    """kvstore='local' (and a plain local KVStore instance) under a dp mesh
+    folds into the in-step psum: the fused path engages and matches the
+    storeless mesh run."""
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    monkeypatch.setenv("MXNET_FUSED_ZERO", "0")
+    mx.random.seed(11)
+    mod = _make_module()
+    mod.init_optimizer(kvstore=mx.kv.create("local"), optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    assert mod._kvstore is not None
+    assert not mod._update_on_kvstore
+    assert fused_step.fused_ineligible_reason(mod) is None
+    for b in _batches(2):
+        mod.forward_backward(b)
+        mod.update()
+    assert mod._fused is not None and mod._fused.mesh is not None
+    arg_kv = {n: v.asnumpy() for n, v in mod.get_params()[0].items()}
+    arg_ref, _, _, _ = _train(monkeypatch, True, "sgd",
+                              {"learning_rate": 0.1}, steps=2)
+    _assert_params_close(arg_kv, arg_ref)
+
+
+def test_kvstore_with_store_updater_keeps_legacy(monkeypatch):
+    """A store that runs its own updater does real work per push — it must
+    NOT fold, even under a mesh."""
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    kv = mx.kv.create("local")
+    kv.set_updater(lambda k, recv, stored: None)
+    assert not kv.folds_into_fused_step()
+    mod = _make_module()
+    mod.init_optimizer(kvstore=kv, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    assert fused_step.fused_ineligible_reason(mod) == "kvstore"
+
+
+# -- acceptance: one dispatch per mesh step, counted --------------------------
+def test_mesh_fused_single_dispatch_per_step(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    monkeypatch.setenv("MXNET_TELEMETRY_FILE", str(tmp_path / "t.jsonl"))
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    monkeypatch.setenv("MXNET_FUSED_ZERO", "0")
+    tin._reset_for_tests()
+    try:
+        mx.random.seed(11)
+        mod = _make_module()
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1,
+                                             "momentum": 0.9})
+        steps = 5
+        for b in _batches(steps):
+            mod.forward_backward(b)
+            mod.update()
+        r = tin.registry()
+        assert r.get("train_steps_total").value(path="fused_mesh") == steps
+        # THE acceptance criterion: one compiled dispatch per mesh step
+        assert r.get("step_dispatches_total").value(path="fused_mesh") == steps
+        assert r.get("step_dispatches_total").value(path="legacy") == 0
+        assert mod._fused.cache_size() == 1
+        assert r.get("jit_compiles_total").value(fn="module_fused_step") == 1
+        assert r.get("module_fused_fallback_total") is None
+        # summary() covers the mesh path (satellite): 1 dispatch per step
+        assert tin.summary()["dispatches_per_step"] == 1.0
+        # the GSPMD-derived in-step collective is declared to telemetry
+        assert r.get("collective_bytes_total").value(op="psum_grads") > 0
+    finally:
+        tin._reset_for_tests()
+
+
+def test_zero_collectives_declared(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    monkeypatch.setenv("MXNET_TELEMETRY_FILE", str(tmp_path / "t.jsonl"))
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    monkeypatch.setenv("MXNET_FUSED_ZERO", "1")
+    tin._reset_for_tests()
+    try:
+        mx.random.seed(11)
+        mod = _make_module()
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1,
+                                             "momentum": 0.9})
+        b = _batches(1)[0]
+        mod.forward_backward(b)
+        mod.update()
+        r = tin.registry()
+        assert r.get("train_steps_total").value(path="fused_mesh") == 1
+        assert r.get("collective_bytes_total").value(op="reduce_scatter") > 0
+        assert r.get("collective_bytes_total").value(op="allgather") > 0
+    finally:
+        tin._reset_for_tests()
+
+
+# -- prefetch (ISSUE 5 satellite) --------------------------------------------
+def test_prepare_prestages_sharded_feed(monkeypatch):
+    """Module.prepare issues the sharded device_put early; _stage_batch
+    consumes that very feed (no second staging) and the executor ends up
+    holding the pre-staged arrays."""
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    monkeypatch.setenv("MXNET_FUSED_ZERO", "0")
+    mod = _make_module()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    b = _batches(1)[0]
+    mod.prepare(b)
+    assert mod._prestaged is not None and mod._prestaged[0] is b
+    feed = dict(mod._prestaged[1])
+    from jax.sharding import NamedSharding
+
+    for v in feed.values():  # already committed dp-sharded, pre-dispatch
+        assert isinstance(v._data.sharding, NamedSharding)
+    mod.forward_backward(b)
+    assert mod._prestaged is None  # consumed, not rebuilt
+    for k, v in feed.items():
+        assert mod._exec.arg_dict[k] is v
+    mod.update()
+    assert mod._fused is not None
+
+
+def test_prepare_skips_reshaping_batch(monkeypatch):
+    """A batch whose shape differs is left to _stage_batch's reshape path —
+    prepare must not re-bind mid-flight."""
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    mod = _make_module()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    rng = np.random.RandomState(0)
+    small = DataBatch(
+        data=[mx.nd.array(rng.randn(8, DIM).astype(np.float32))],
+        label=[mx.nd.array(rng.randint(0, 4, (8,)).astype(np.float32))])
+    exec_before = mod._exec
+    mod.prepare(small)
+    assert mod._prestaged is None
+    assert mod._exec is exec_before
+    # the reshape then happens at staging time, and the step still runs
+    mod.forward_backward(small)
+    mod.update()
+    assert mod._fused is not None
+
+
+def test_fit_mesh_prefetch_and_counters(monkeypatch, tmp_path):
+    """The stock fit loop on a mesh Module: fused_mesh path engages, one
+    dispatch per step, and prepare() pre-staging is exercised end-to-end."""
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    monkeypatch.setenv("MXNET_TELEMETRY_FILE", str(tmp_path / "t.jsonl"))
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    monkeypatch.setenv("MXNET_FUSED_ZERO", "0")
+    tin._reset_for_tests()
+    try:
+        from mxnet_tpu.io import NDArrayIter
+
+        rng = np.random.RandomState(0)
+        X = rng.randn(96, DIM).astype(np.float32)
+        W = rng.randn(DIM, 4).astype(np.float32)
+        y = np.argmax(X @ W, axis=1).astype(np.float32)
+        train = NDArrayIter(X, y, batch_size=BATCH, shuffle=True,
+                            label_name="softmax_label")
+        mod = mod_mod.Module(_sym(bn=False), mesh=_mesh())
+        mod.fit(train, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+                num_epoch=2)
+        assert mod._fused is not None and mod._fused.mesh is not None
+        r = tin.registry()
+        steps = r.get("train_steps_total").value(path="fused_mesh")
+        assert steps == 12  # 6 batches x 2 epochs
+        assert r.get("step_dispatches_total").value(path="fused_mesh") == steps
+        assert tin.summary()["dispatches_per_step"] == 1.0
+    finally:
+        tin._reset_for_tests()

@@ -122,3 +122,57 @@ def test_fused_module_step_compiles_once_per_shape(monkeypatch, tmp_path):
         assert mod._fused.cache_size() == 2
     finally:
         tin._reset_for_tests()
+
+
+def test_mesh_fused_module_step_compiles_once_per_shape(monkeypatch, tmp_path):
+    """ISSUE 5: the SHARDED fused Module step (mesh path) also compiles
+    exactly once per shape signature, and a reshape to a new batch shape
+    costs exactly one retrace — the sharding annotations must not defeat
+    the executable cache."""
+    from mxnet_tpu import module as mod_mod
+    from mxnet_tpu import parallel
+    from mxnet_tpu.io import DataBatch
+    from mxnet_tpu.telemetry import instrument as tin
+
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    monkeypatch.setenv("MXNET_TELEMETRY_FILE", str(tmp_path / "t.jsonl"))
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    monkeypatch.setenv("MXNET_FUSED_ZERO", "0")
+    tin._reset_for_tests()
+    try:
+        data = mx.sym.var("data")
+        fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=8)
+        s = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(fc1, name="fc2", num_hidden=4),
+            name="softmax")
+        mod = mod_mod.Module(s, mesh=parallel.make_mesh({"dp": 8}))
+        mod.bind(data_shapes=[("data", (16, 8))],
+                 label_shapes=[("softmax_label", (16,))])
+        mod.init_params()
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        rng = np.random.RandomState(0)
+
+        def epoch(batch):
+            for _ in range(3):
+                b = DataBatch(
+                    data=[nd.array(rng.randn(batch, 8).astype(np.float32))],
+                    label=[nd.array(rng.randint(0, 4, (batch,))
+                                    .astype(np.float32))])
+                mod.forward_backward(b)
+                mod.update()
+
+        compiles = lambda: tin.registry().get("jit_compiles_total") \
+            .value(fn="module_fused_step")
+        epoch(16)
+        epoch(16)  # same signature: no growth
+        assert compiles() == 1, compiles()
+        assert mod._fused.cache_size() == 1
+        epoch(8)  # reshape to batch 8 (dp still divides it): ONE recompile
+        assert compiles() == 2, compiles()
+        assert mod._fused.cache_size() == 2
+        epoch(16)  # back: cache hit, still 2
+        assert compiles() == 2, compiles()
+        assert mod._fused.cache_size() == 2
+    finally:
+        tin._reset_for_tests()

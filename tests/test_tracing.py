@@ -416,3 +416,41 @@ class TestExportTools:
         json.dump({"metric": "m_imgs_per_sec", "value": 99.0,
                    "unit": "img/s"}, open(bare, "w"))
         assert bc.main([base, bare, "--threshold", "5"]) == 0
+
+    def test_bench_compare_multichip_gate(self, tmp_path):
+        """MULTICHIP_r*.json captures diff on ok + dryrun phases (ISSUE 5):
+        a capture that lost `ok` or dropped a phase exits non-zero; mixing
+        capture kinds is an error."""
+        bc = _load_tool("tools/bench_compare.py")
+        tail_full = ("dryrun_multichip(8): mesh dp=4 tp=2, loss 2.9 -> 2.0\n"
+                     "dryrun_multichip(8): pp gpipe loss 0.006, sp out, "
+                     "ep moe loss 0.2 — all phases OK\n"
+                     "dryrun_multichip(8): detection dp=8 step loss 5.3 — OK\n"
+                     "dryrun_multichip(8): detection ZeRO-sharded state "
+                     "(params+momentum over dp): 50.0 MB/device vs 399.4 MB "
+                     "replicated, step loss 5.1 — OK\n")
+
+        def capture(path, ok=True, tail=tail_full, skipped=False):
+            json.dump({"n_devices": 8, "rc": 0 if ok else 1, "ok": ok,
+                       "skipped": skipped, "tail": tail}, open(path, "w"))
+            return path
+
+        base = capture(str(tmp_path / "m1.json"))
+        same = capture(str(tmp_path / "m2.json"))
+        broke = capture(str(tmp_path / "m3.json"), ok=False)
+        lost_zero = capture(str(tmp_path / "m4.json"),
+                            tail=tail_full.rsplit("dryrun_multichip(8): "
+                                                  "detection ZeRO", 1)[0])
+        skipped = capture(str(tmp_path / "m5.json"), ok=False, tail="",
+                          skipped=True)
+        assert bc.main([base, same]) == 0
+        assert bc.main([base, broke]) == 1
+        assert bc.main([base, lost_zero]) == 1
+        # driver had no devices that round: reported, never gated
+        assert bc.main([base, skipped]) == 0
+        # growing a phase relative to an older baseline is fine
+        assert bc.main([lost_zero, base]) == 0
+        # mixed kinds refuse loudly
+        bench = str(tmp_path / "bench.json")
+        json.dump({"metric": "m", "value": 1.0}, open(bench, "w"))
+        assert bc.main([base, bench]) == 2

@@ -19,10 +19,20 @@ context but never gated — the checked-in trajectory mixes workloads
 (resnet50 rounds vs deformable-rfcn rounds), and an img/s delta across
 different models is noise, not signal.
 
+MULTICHIP captures (``MULTICHIP_r*.json``: the driver's ``dryrun_multichip``
+record — ``{n_devices, rc, ok, skipped, tail}``) are detected automatically
+and diffed on their own axis: the ``ok`` flag and the set of dryrun
+*phases* the tail reports (dp/tp mesh step, pp+sp+ep phases, detection
+step, detection ZeRO-sharded state).  A capture that lost the ``ok`` flag
+or dropped a phase the baseline had exits non-zero — the multi-chip
+equivalent of a headline-value regression.  Bench and multichip captures
+cannot be mixed in one invocation.
+
 Usage::
 
     python tools/bench_compare.py BENCH_r04.json BENCH_r05.json
     python tools/bench_compare.py base.json new.json --threshold 3 --json
+    python tools/bench_compare.py MULTICHIP_r04.json MULTICHIP_r05.json
 """
 from __future__ import annotations
 
@@ -31,12 +41,8 @@ import json
 import sys
 
 
-def load_bench(path):
-    """→ normalized row dict from a driver capture or a bare bench line."""
-    with open(path, encoding="utf-8") as f:
-        obj = json.load(f)
-    if not isinstance(obj, dict):
-        raise ValueError("%s: bench capture must be a JSON object" % path)
+def load_bench(path, obj):
+    """→ normalized row dict from a parsed driver capture or bare bench line."""
     line = obj.get("parsed") if isinstance(obj.get("parsed"), dict) else obj
     if "metric" not in line or "value" not in line:
         raise ValueError("%s: no bench line found (need 'metric'/'value', "
@@ -47,6 +53,75 @@ def load_bench(path):
             "dispatches_per_step": tel.get("dispatches_per_step"),
             "compile_s": tel.get("compile_s"),
             "data_wait_frac": tel.get("data_wait_frac")}
+
+
+# multichip dryrun phases, as printed by __graft_entry__.dryrun_multichip —
+# (label, marker substring searched in the capture's ``tail``)
+MULTICHIP_PHASES = (
+    ("mesh_step", "mesh dp="),
+    ("pp_sp_ep", "all phases OK"),
+    ("detection", "detection dp="),
+    ("detection_zero", "ZeRO-sharded state"),
+)
+
+
+def _read_capture(path):
+    """Parse one capture file (raises OSError/JSONDecodeError/ValueError so
+    a missing or corrupt file surfaces as ITS error, not as a kind
+    mismatch)."""
+    with open(path, encoding="utf-8") as f:
+        obj = json.load(f)
+    if not isinstance(obj, dict):
+        raise ValueError("%s: capture must be a JSON object" % path)
+    return obj
+
+
+def is_multichip(obj):
+    """True when a parsed capture is a driver MULTICHIP record
+    (``ok``/``tail``) rather than a bench line."""
+    return "ok" in obj and ("tail" in obj or "n_devices" in obj)
+
+
+def load_multichip(path, obj):
+    """→ normalized row for one parsed MULTICHIP_r*.json capture."""
+    if "ok" not in obj:
+        raise ValueError("%s: not a MULTICHIP capture (need 'ok')" % path)
+    tail = str(obj.get("tail") or "")
+    return {"file": path, "ok": bool(obj.get("ok")),
+            "skipped": bool(obj.get("skipped")),
+            "n_devices": obj.get("n_devices"),
+            "phases": {name for name, marker in MULTICHIP_PHASES
+                       if marker in tail}}
+
+
+def compare_multichip(rows):
+    """→ (table_rows, regressions).  Baseline = rows[0]; a later capture
+    regresses when it lost ``ok`` or dropped a phase the baseline ran.
+    Skipped captures (driver had no devices) are shown but never gated."""
+    base = rows[0]
+    table, regressions = [], []
+    for r in rows:
+        missing = sorted(base["phases"] - r["phases"]) if r is not base else []
+        table.append(dict(r, phases=sorted(r["phases"]),
+                          missing_phases=missing))
+        if r is base or r["skipped"]:
+            continue
+        if base["ok"] and not r["ok"]:
+            regressions.append("%s: ok true -> false" % r["file"])
+        if missing:
+            regressions.append("%s: dropped phase(s) %s"
+                               % (r["file"], ", ".join(missing)))
+    return table, regressions
+
+
+def render_multichip_table(table):
+    lines = ["file  ok  skipped  n_devices  phases  missing"]
+    for r in table:
+        lines.append("%s  %s  %s  %s  [%s]  %s" % (
+            r["file"], r["ok"], r["skipped"], r["n_devices"],
+            ",".join(r["phases"]),
+            ",".join(r["missing_phases"]) or "-"))
+    return "\n".join(lines)
 
 
 def _pct(new, base):
@@ -127,7 +202,41 @@ def main(argv=None):
         p.error("need at least two files (baseline + candidates)")
 
     try:
-        rows = [load_bench(f) for f in args.files]
+        objs = [(f, _read_capture(f)) for f in args.files]
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print("bench_compare: %s" % e, file=sys.stderr)
+        return 2
+    kinds = [is_multichip(o) for _, o in objs]
+    if any(kinds) and not all(kinds):
+        print("bench_compare: cannot mix bench and MULTICHIP captures "
+              "in one invocation", file=sys.stderr)
+        return 2
+    try:
+        if all(kinds):
+            rows = [load_multichip(f, o) for f, o in objs]
+            if rows[0]["skipped"] or not rows[0]["ok"]:
+                # a degraded baseline has no phases/ok to gate against —
+                # say so loudly instead of passing everything vacuously
+                print("bench_compare: WARNING baseline %s is %s — "
+                      "multichip gate is vacuous for this pair"
+                      % (rows[0]["file"],
+                         "skipped" if rows[0]["skipped"] else "not ok"),
+                      file=sys.stderr)
+            table, regressions = compare_multichip(rows)
+            if args.json:
+                print(json.dumps({"baseline": rows[0]["file"], "rows": table,
+                                  "regressions": regressions}, indent=1))
+            else:
+                print(render_multichip_table(table))
+                for msg in regressions:
+                    print("REGRESSION %s" % msg)
+            if regressions:
+                if not args.json:
+                    print("bench_compare: %d multichip regression(s)"
+                          % len(regressions), file=sys.stderr)
+                return 1
+            return 0
+        rows = [load_bench(f, o) for f, o in objs]
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print("bench_compare: %s" % e, file=sys.stderr)
         return 2
