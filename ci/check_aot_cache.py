@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""AOT persistent-cache smoke (ISSUE 6 CI satellite) — unit tier.
+
+Runs ``Engine.warmup()`` over a bucket ladder in TWO fresh subprocesses
+against one shared ``MXNET_AOT_CACHE`` directory:
+
+* run 1 (cold): every bucket must be an AOT-cache **miss** (compiled and
+  persisted), paying real XLA compile seconds;
+* run 2 (warm restart): every bucket must be an AOT-cache **hit** with zero
+  misses, zero errors, and — the deterministic heart of the acceptance —
+  ``warmup.aot_compile_s == 0``: the second engine compiled ZERO fresh XLA
+  modules, the whole compile storm became disk reads.  Its warmup
+  wall-clock must also beat run 1's; the model below is deep enough that
+  per-bucket compile (hundreds of ms) dwarfs a restore (tens of ms), but
+  wall-clock on a shared box is still noisy, so the timing comparison alone
+  gets up to two warm re-runs (cache stays populated; best-of compared) —
+  the hit/miss/compile-seconds assertions stay strict on the first warm run.
+
+Subprocesses matter: the cache must survive a real process boundary, and
+``MXNET_AOT_CACHE`` must be in the environment before import (jax latches
+its persistent-cache directory at first compile).
+
+Usage (ci/run_tests.sh unit tier)::
+
+    python ci/check_aot_cache.py            # parent: orchestrates both runs
+    python ci/check_aot_cache.py --child    # one warmup run (internal)
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+LADDER = (1, 2, 4)
+
+
+def child():
+    sys.path.insert(0, os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..")))
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import compile_cache, nd, serving
+
+    # deep enough that one bucket's XLA compile is hundreds of ms — the
+    # quantity the warm restart must drive to zero (a restore is a ~10ms
+    # disk read; tiny_mlp_checkpoint's compile is so small that restore vs
+    # compile wall-clock is a coin flip on a loaded box)
+    x = mx.sym.Variable("data")
+    for i in range(6):
+        x = mx.sym.Activation(
+            mx.sym.FullyConnected(x, num_hidden=64, name="fc%d" % i),
+            act_type="relu", name="relu%d" % i)
+    sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(x, num_hidden=4, name="out"), name="softmax")
+    exe = sym.simple_bind(grad_req="null", data=(2, 8))
+    rng = np.random.RandomState(0)
+    params = {n: nd.array(rng.randn(*a.shape).astype(np.float32) * 0.1)
+              for n, a in exe.arg_dict.items()
+              if n not in ("data", "softmax_label")}
+
+    eng = serving.Engine(sym, params, {"data": (8,)},
+                         ladder=serving.BucketLadder(LADDER), start=False)
+    t0 = time.perf_counter()
+    eng.warmup()
+    warmup_s = time.perf_counter() - t0
+    # one request proves the warmed engine actually serves
+    eng.start()
+    out = eng.predict({"data": np.zeros((2, 8), np.float32)})
+    assert out[0].shape == (2, 4)
+    stats = eng.stats()
+    eng.close()
+    print("AOT_SMOKE " + json.dumps({
+        "warmup_s": round(warmup_s, 4),
+        "warmup": stats["warmup"],
+        "cache": compile_cache.stats(),
+        "compiles": stats["compiles"]}))
+    return 0
+
+
+def main():
+    if "--child" in sys.argv:
+        return child()
+    cache_dir = tempfile.mkdtemp(prefix="mxnet-aot-smoke-")
+    try:
+        return _main(cache_dir)
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+
+def _main(cache_dir):
+    env = dict(os.environ, MXNET_AOT_CACHE=cache_dir)
+
+    def one_run(i):
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            env=env, capture_output=True, text=True, timeout=600)
+        sys.stdout.write(proc.stdout)
+        if proc.returncode != 0:
+            sys.stderr.write(proc.stderr)
+            print("check_aot_cache: FAIL run %d exited %d"
+                  % (i, proc.returncode), file=sys.stderr)
+            return None
+        line = [l for l in proc.stdout.splitlines()
+                if l.startswith("AOT_SMOKE ")]
+        if not line:
+            print("check_aot_cache: FAIL run %d printed no AOT_SMOKE line"
+                  % i, file=sys.stderr)
+            return None
+        return json.loads(line[-1][len("AOT_SMOKE "):])
+
+    cold = one_run(1)
+    warm = one_run(2)
+    if cold is None or warm is None:
+        return 1
+    # the wall-clock beat is load-sensitive (an anomalously fast cold run
+    # can land under a noisy warm restore): best-of up to 3 warm runs for
+    # the TIMING only — the hit/miss/compile-seconds acceptance below
+    # judges the first warm run
+    warm_s = warm["warmup_s"]
+    for i in (3, 4):
+        if warm_s < cold["warmup_s"]:
+            break
+        rerun = one_run(i)
+        if rerun is None:
+            return 1
+        warm_s = min(warm_s, rerun["warmup_s"])
+    n = len(LADDER)
+    failures = []
+    if cold["warmup"]["cache_misses"] != n or cold["warmup"]["cache_hits"]:
+        failures.append("cold run: expected %d misses/0 hits, got %s"
+                        % (n, cold["warmup"]))
+    if cold["warmup"]["aot_compile_s"] <= 0:
+        failures.append("cold run paid no XLA compile seconds: %s"
+                        % cold["warmup"])
+    if warm["warmup"]["cache_hits"] != n or warm["warmup"]["cache_misses"]:
+        failures.append("warm run: expected %d hits/0 misses (zero fresh "
+                        "modules), got %s" % (n, warm["warmup"]))
+    if warm["warmup"]["aot_compile_s"] != 0:
+        failures.append("warm run compiled fresh XLA modules "
+                        "(aot_compile_s=%s)"
+                        % warm["warmup"]["aot_compile_s"])
+    if warm["cache"]["errors"]:
+        failures.append("warm run: %d cache errors" % warm["cache"]["errors"])
+    if warm["compiles"] != 0:
+        failures.append("warm run: stats()['compiles']=%d, restores must "
+                        "not count as compiles" % warm["compiles"])
+    if not warm_s < cold["warmup_s"]:
+        failures.append("warm warmup %.3fs did not beat cold %.3fs"
+                        % (warm_s, cold["warmup_s"]))
+    for msg in failures:
+        print("check_aot_cache: FAIL %s" % msg, file=sys.stderr)
+    if not failures:
+        print("check_aot_cache: ok — cold %.3fs (%d compiles, %.3fs in "
+              "XLA) -> warm %.3fs (all cached, 0 compile seconds, %.1fx "
+              "faster)"
+              % (cold["warmup_s"], n, cold["warmup"]["aot_compile_s"],
+                 warm_s, cold["warmup_s"] / max(warm_s, 1e-9)))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

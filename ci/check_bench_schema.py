@@ -19,9 +19,10 @@ import sys
 
 TOP_KEYS = {"metric", "value", "unit", "vs_baseline", "telemetry"}
 TEL_REQ_KEYS = {"compile_s", "peak_hbm_bytes", "data_wait_frac"}
-# dispatches_per_step (ISSUE 3 fused Module step) is optional: captures
-# predating the fused-step work carry only the three original keys
-TEL_OPT_KEYS = {"dispatches_per_step"}
+# dispatches_per_step (ISSUE 3 fused Module step) and warmup_s (ISSUE 6
+# AOT cache restart surface) are optional: captures predating that work
+# carry only the three original keys
+TEL_OPT_KEYS = {"dispatches_per_step", "warmup_s"}
 TEL_KEYS = TEL_REQ_KEYS | TEL_OPT_KEYS
 
 # SERVE_BENCH line (tools/loadgen.py, ISSUE 2) — docs/SERVING.md schema
@@ -30,7 +31,7 @@ SERVE_REQ_KEYS = {"mode", "requests", "completed", "shed", "timeouts",
                   "errors", "shed_rate", "duration_s", "throughput_rps",
                   "latency_ms_p50", "latency_ms_p99", "compiles"}
 SERVE_OPT_KEYS = {"concurrency", "rate_rps", "batch_fill_mean",
-                  "padding_waste_mean"}
+                  "padding_waste_mean", "first_request_ms", "warmup_s"}
 SERVE_MODES = {"closed", "open"}
 
 
@@ -93,6 +94,11 @@ def validate_line(obj, where="<line>"):
             raise SchemaError(
                 "%s: telemetry.dispatches_per_step must be a non-negative "
                 "number or null" % where)
+        ws = tel.get("warmup_s")
+        if ws is not None and (not _num(ws) or ws < 0):
+            raise SchemaError(
+                "%s: telemetry.warmup_s must be a non-negative number or "
+                "null" % where)
 
 
 def validate_serve_line(obj, where="<line>"):
@@ -134,6 +140,20 @@ def validate_serve_line(obj, where="<line>"):
     for k in ("batch_fill_mean", "padding_waste_mean"):
         if k in obj and (not _num(obj[k]) or not 0 <= obj[k] <= 1):
             raise SchemaError("%s: %r must be a number in [0, 1]" % (where, k))
+    if "warmup_s" in obj and (not _num(obj["warmup_s"]) or obj["warmup_s"] < 0):
+        raise SchemaError("%s: 'warmup_s' must be a non-negative number"
+                          % where)
+    if "first_request_ms" in obj:
+        fr = obj["first_request_ms"]
+        if not isinstance(fr, dict) or not fr:
+            raise SchemaError(
+                "%s: 'first_request_ms' must be a non-empty object of "
+                "size-class -> ms" % where)
+        for k, v in fr.items():
+            if not isinstance(k, str) or not _num(v) or v < 0:
+                raise SchemaError(
+                    "%s: first_request_ms[%r] must map a string size class "
+                    "to a non-negative number" % (where, k))
 
 
 def validate_capture(path):
@@ -167,6 +187,12 @@ def self_test():
         {"metric": "m", "value": 1, "unit": "samples/s",
          "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
                        "data_wait_frac": 0.0, "dispatches_per_step": None}},
+        {"metric": "m", "value": 1, "unit": "samples/s",
+         "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0, "warmup_s": 1.25}},
+        {"metric": "m", "value": 1, "unit": "samples/s",
+         "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0, "warmup_s": None}},
     ]
     bad = [
         {},                                                  # empty
@@ -184,6 +210,9 @@ def self_test():
          "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
                        "data_wait_frac": 0.0,
                        "dispatches_per_step": -2}},          # negative dps
+        {"metric": "m", "value": 1, "unit": "img/s",
+         "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0, "warmup_s": -1}},  # neg warmup
     ]
     serve_good = {"mode": "closed", "requests": 10, "completed": 9,
                   "shed": 1, "timeouts": 0, "errors": 0, "shed_rate": 0.1,
@@ -199,12 +228,19 @@ def self_test():
         dict(serve_good, completed=11),              # completed > requests
         dict(serve_good, extra=1),                   # unknown key
         {k: v for k, v in serve_good.items() if k != "throughput_rps"},
+        dict(serve_good, warmup_s=-0.5),             # negative warmup
+        dict(serve_good, first_request_ms={}),       # empty map
+        dict(serve_good, first_request_ms={"1": -2}),  # negative latency
+        dict(serve_good, first_request_ms=[1.0]),    # wrong type
     ]
     for obj in good:
         validate_line(obj, "self-test good")
     validate_serve_line(serve_good, "self-test serve good")
     validate_serve_line(dict(serve_good, mode="open", rate_rps=200.0,
                              batch_fill_mean=0.8), "self-test serve good2")
+    validate_serve_line(dict(serve_good, warmup_s=0.42,
+                             first_request_ms={"1": 2.5, "4": 3.75}),
+                        "self-test serve good3")
     for i, obj in enumerate(bad):
         try:
             validate_line(obj, "self-test bad[%d]" % i)

@@ -52,6 +52,9 @@ case "$tier" in
     # sharded fused step smoke (ISSUE 5): 2 train steps on an 8-host-device
     # dp mesh must be 1 compiled dispatch each with finite loss
     ./dev.sh python ci/check_mesh_fused.py
+    # AOT cache smoke (ISSUE 6): warmup twice against one cache dir in
+    # subprocesses — second run must be all cache hits and faster
+    ./dev.sh python ci/check_aot_cache.py
     # telemetry unit tests (tests/test_telemetry.py) run as part of tests/
     ignore=()
     for f in "${NIGHTLY_FILES[@]}"; do ignore+=(--ignore "$f"); done

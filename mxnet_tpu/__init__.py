@@ -21,6 +21,17 @@ from . import random
 # seeded lazily to avoid importing jax at package import when unused
 seed = random.seed
 
+# AOT persistent executable cache (compile_cache.py, ISSUE 6): jax's
+# persistent compilation cache latches its directory at the FIRST XLA
+# compile in the process, so MXNET_AOT_CACHE must be applied at import,
+# before anything can compile.  Unset ⇒ no-op, and jax is not imported.
+import os as _os
+
+if _os.environ.get("MXNET_AOT_CACHE", "").strip():
+    from . import compile_cache as _compile_cache
+
+    _compile_cache.activate()
+
 
 def __getattr__(name):
     """Lazy submodule loading keeps `import mxnet_tpu` fast."""
@@ -55,6 +66,7 @@ def __getattr__(name):
         "test_utils": ".test_utils",
         "parallel": ".parallel",
         "executor": ".executor",
+        "compile_cache": ".compile_cache",
         "monitor": ".monitor",
         "visualization": ".visualization",
         "contrib": ".contrib",

@@ -1,0 +1,502 @@
+"""AOT compilation + persistent executable cache (ISSUE 6).
+
+Every process restart used to re-trace and re-compile the whole serving
+bucket ladder and the fused train step from scratch.  This module makes a
+restart a disk read instead of a compile storm — the TVM/Relay ahead-of-time
+deployment story (PAPERS.md 1802.04799 / 1904.08368) mapped onto XLA: the
+workload already specializes to a FINITE signature set (bucket ladder,
+fused-step shape signatures), so the executables can be built once and
+persisted.
+
+Two tiers, both gated on ``MXNET_AOT_CACHE=<dir>`` (unset ⇒ every helper is
+inert and the jit paths are byte-identical to a build without this module):
+
+* **tier 1 — explicit executable cache.**  :class:`CachedFunction` wraps an
+  already-jitted callable.  Per argument-shape signature it splits the AOT
+  pipeline ``jax.jit(fn).lower(*args).compile()`` — so warmup can run the
+  trace/lower stage for many signatures concurrently off the device loop —
+  and persists the finished executable via
+  ``jax.experimental.serialize_executable`` to ``<dir>/exec/<name>-<sha>.jx``.
+  A warm restart deserializes the executable: no trace, no lower, no XLA
+  compile.  Each entry stores an **environment fingerprint** (jax + jaxlib
+  versions, backend kind, device kind/count, mesh descriptor) and the full
+  logical key; any mismatch, truncated file, or deserialize failure is a
+  SILENT miss — counted in ``aot_cache_errors_total{reason}`` — and the
+  entry is recompiled and overwritten, never a crash.
+* **tier 2 — JAX's persistent compilation cache** pointed at ``<dir>/xla``,
+  so jits *outside* the wired hot spots also skip the XLA backend compile
+  on restart (trace + lower still paid).  Its hit/miss events are forwarded
+  into the same counters under ``tier="xla"``.  Best-effort: a jax build
+  without the knobs simply runs tier 1 alone.
+
+**The CPU-backend donation hazard.**  Empirically (jax 0.4.37 / XLA:CPU,
+reproduced under concurrent process load and bisected against controls):
+an executable *restored from a cache* — either tier — and dispatched with
+**donated** arguments intermittently computes a consistently-wrong
+trajectory (a small discrete set of wrong results, load-dependent trial to
+trial), while freshly compiled executables are bit-exact and stable across
+hundreds of trials under the same load.  Serializing every dispatch with
+``block_until_ready`` does NOT close it, so this is not a cross-dispatch
+overlap race — the restored executable itself mishandles its donation
+aliasing.  Non-donated restored executables (the inference path) showed no
+deviation under the same protocol.  Two consequences, both encoded here:
+
+* tier 2 is enabled only on non-CPU backends — it restores executables for
+  *every* jit in the process, including donated ones this module cannot
+  see (e.g. ``gluon.functional.make_train_step``), so on CPU it cannot be
+  made safe selectively.  (On TPU, persistent-cache + donated train steps
+  is the standard production workflow.)
+* ``donated=True`` callables skip tier 1's disk entries on the CPU backend
+  (in-memory AOT lower/compile split only — a CPU restart re-pays the
+  fused-step compile; the serving ladder, non-donated, still restores).
+  On TPU-class backends donated entries restore normally, guarded by the
+  environment fingerprint.
+
+Accounting: process-local :func:`stats` (always available — the Engine's
+``stats()["warmup"]`` block reads it without telemetry) plus
+``aot_cache_{hits,misses}_total{tier}`` / ``aot_cache_errors_total{reason}``
+in the telemetry registry when ``MXNET_TELEMETRY`` is on, and an
+``aot_cache`` attr on the innermost live trace span at prepare time
+(docs/OBSERVABILITY.md).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import threading
+
+__all__ = ["active", "cache_dir", "activate", "stats", "fingerprint",
+           "mesh_descriptor", "CachedFunction"]
+
+_FORMAT = 1  # bump to invalidate every on-disk entry
+
+_mu = threading.Lock()
+_stats = {"hits": 0, "misses": 0, "errors": 0,
+          "xla_hits": 0, "xla_misses": 0}
+_activated_dir = None
+_listener_registered = False
+
+
+def cache_dir():
+    """The ``MXNET_AOT_CACHE`` directory, or None when the cache is off."""
+    d = os.environ.get("MXNET_AOT_CACHE", "").strip()
+    return d or None
+
+
+def active():
+    return cache_dir() is not None
+
+
+def max_bytes():
+    """``MXNET_AOT_CACHE_MAX_MB`` (default 2048) as bytes; <=0 disables
+    eviction."""
+    try:
+        mb = float(os.environ.get("MXNET_AOT_CACHE_MAX_MB", "2048"))
+    except ValueError:
+        mb = 2048.0
+    return int(mb * 1024 * 1024)
+
+
+def stats():
+    """Process-local event counts.  ``hits``/``misses`` are tier-1 (one
+    executable restored from disk / compiled fresh and stored; in-memory
+    signature re-use counts as neither); ``xla_hits``/``xla_misses`` mirror
+    JAX's persistent-compilation-cache events (tier 2 — every XLA backend
+    compile in the process, donated steps included); ``errors`` are
+    rejected tier-1 entries (each one a clean miss + recompile)."""
+    with _mu:
+        return dict(_stats)
+
+
+def _reset_stats_for_tests():
+    with _mu:
+        for k in _stats:
+            _stats[k] = 0
+
+
+def _note(kind, reason=None):
+    with _mu:
+        _stats[kind] += 1
+    from . import telemetry
+
+    telemetry.note_aot_cache(kind, reason)
+    sp = telemetry.tracing.current()
+    if sp is not None:
+        sp.set(aot_cache="error:%s" % reason if kind == "errors"
+               else kind[:-1])
+
+
+def _on_jax_event(name, **kw):
+    """Tier-2 accounting: forward jax's persistent-compilation-cache events
+    into our counters (tier="xla")."""
+    from . import telemetry
+
+    if name == "/jax/compilation_cache/cache_hits":
+        with _mu:
+            _stats["xla_hits"] += 1
+        telemetry.note_aot_cache("hits", tier="xla")
+    elif name == "/jax/compilation_cache/cache_misses":
+        with _mu:
+            _stats["xla_misses"] += 1
+        telemetry.note_aot_cache("misses", tier="xla")
+
+
+def _exec_dir():
+    return os.path.join(cache_dir(), "exec")
+
+
+def _platform_hint():
+    """Best-effort platform guess WITHOUT initializing the jax backend.
+    ``activate()`` runs at ``import mxnet_tpu``, which must stay legal
+    before ``jax.distributed.initialize()`` / late ``jax.config`` updates
+    on multi-host pods — ``jax.default_backend()`` would latch the backend
+    right there.  Reads the *configured* platform list (JAX_PLATFORMS /
+    ``jax_platforms``); when that is unset (auto-detect), probes for local
+    TPU chips the way jax itself does (a PCI sysfs scan, no backend).
+    Returns a platform name, or None for "unknown"."""
+    p = ""
+    try:
+        import jax
+
+        p = jax.config.jax_platforms or ""
+    except Exception:
+        pass
+    p = (p or os.environ.get("JAX_PLATFORMS", "")).split(",")[0]
+    p = p.strip().lower()
+    if p:
+        return p
+    try:
+        from jax._src import hardware_utils
+
+        if hardware_utils.num_available_tpu_chips_and_device_id()[0] > 0:
+            return "tpu"
+    except Exception:
+        pass
+    return None
+
+
+def activate():
+    """Idempotent per-directory setup: create ``<dir>/exec`` and, on
+    non-CPU backends, point JAX's persistent compilation cache (tier 2) at
+    ``<dir>/xla`` with the min-compile-time / min-entry-size floors dropped
+    so even fast compiles persist.  MUST run before the first XLA compile —
+    jax latches the cache directory at first use (mxnet_tpu/__init__.py
+    applies it at import when MXNET_AOT_CACHE is set) — and must itself not
+    trigger backend init, hence :func:`_platform_hint`.  Tier 2 needs a
+    positively known non-CPU platform: on CPU restored executables race
+    donated buffers (module docstring), and "unknown" resolves to CPU
+    whenever no accelerator shows up.  Best-effort on the jax knobs —
+    tier 1 works alone."""
+    global _activated_dir, _listener_registered
+    d = cache_dir()
+    if d is None or d == _activated_dir:
+        return
+    os.makedirs(_exec_dir(), exist_ok=True)
+    try:
+        import jax
+
+        hint = _platform_hint()
+        if hint is not None and hint != "cpu":
+            jax.config.update("jax_compilation_cache_dir",
+                              os.path.join(d, "xla"))
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        if not _listener_registered:
+            from jax._src import monitoring
+
+            monitoring.register_event_listener(_on_jax_event)
+            _listener_registered = True
+    except Exception:
+        pass
+    _activated_dir = d
+
+
+def _cpu_backend():
+    import jax
+
+    return jax.default_backend() == "cpu"
+
+
+def fingerprint(text):
+    """Stable short hash of a graph description (e.g. ``Symbol.tojson()``)."""
+    if isinstance(text, str):
+        text = text.encode("utf-8")
+    return hashlib.sha256(text).hexdigest()[:16]
+
+
+def symbol_fingerprint(symbol):
+    """Cached :func:`fingerprint` of a Symbol's json — computed once per
+    Symbol object (the serving proto shares one Symbol across all buckets)."""
+    fp = getattr(symbol, "_aot_fingerprint", None)
+    if fp is None:
+        fp = fingerprint(symbol.tojson())
+        try:
+            symbol._aot_fingerprint = fp
+        except Exception:
+            pass
+    return fp
+
+
+def _versions():
+    """(jax, jaxlib) version strings — separate so tests can stub a stale
+    build and assert the clean-miss path."""
+    import jax
+    import jaxlib
+
+    return (jax.__version__, jaxlib.__version__)
+
+
+def mesh_descriptor(mesh):
+    """Canonical, comparable description of a ``jax.sharding.Mesh`` (or
+    None): axis names + sizes + device kind layout.  Part of the verified
+    environment fingerprint, NOT the file name — a restart onto a different
+    topology must read the old entry, miss cleanly, and overwrite it."""
+    if mesh is None:
+        return None
+    return {"axes": [str(a) for a in mesh.axis_names],
+            "shape": [int(mesh.devices.shape[i])
+                      for i in range(mesh.devices.ndim)]}
+
+
+def _env_fingerprint(mesh_desc=None):
+    import jax
+
+    jv, jlv = _versions()
+    devs = jax.devices()
+    return {"format": _FORMAT, "jax": jv, "jaxlib": jlv,
+            "backend": jax.default_backend(),
+            "device_kind": str(devs[0].device_kind), "n_devices": len(devs),
+            "mesh": mesh_desc}
+
+
+def _evict():
+    """Drop oldest-mtime entries until the exec dir fits the size budget.
+    Per-entry best-effort: a concurrent writer in a SHARED cache dir may
+    delete/rename files between listdir and stat, and one vanished file must
+    not abort the pass (the budget would silently stop being enforced).
+    In-flight ``*.tmp.<pid>`` spool files are not candidates — evicting one
+    would break that writer's atomic rename."""
+    cap = max_bytes()
+    if cap <= 0:
+        return
+    try:
+        names = os.listdir(_exec_dir())
+    except OSError:
+        return
+    entries = []
+    for fn in names:
+        if not fn.endswith(".jx"):
+            continue
+        p = os.path.join(_exec_dir(), fn)
+        try:
+            st = os.stat(p)
+        except OSError:
+            continue
+        entries.append((st.st_mtime, st.st_size, p))
+    total = sum(e[1] for e in entries)
+    for mtime, size, p in sorted(entries):
+        if total <= cap:
+            break
+        try:
+            os.remove(p)
+        except OSError:
+            continue  # undeletable entry still occupies budget
+        total -= size
+
+
+class CachedFunction:
+    """Drop-in wrapper for a jitted callable with a per-signature AOT
+    executable cache persisted to disk.
+
+    ``key_parts`` is the logical identity of the computation — graph
+    fingerprint, differentiated/constant name split, optimizer kind + folded
+    hyperparams, donation layout, gate flags — everything that changes the
+    compiled program *other than* argument shapes/dtypes (those enter the
+    key from the arguments at prepare time) and the environment (verified
+    inside the entry, see :func:`_env_fingerprint`).
+
+    The three-stage surface mirrors ``jax.stages``:
+
+    * :meth:`lower_prepare` — disk probe, then (on miss) trace + lower.
+      Pure host work: safe to run concurrently for many signatures and off
+      the serving device loop.
+    * :meth:`finalize` — XLA backend compile of a lowered handle + store.
+      The expensive, serialized stage.
+    * :meth:`__call__` — dispatch through the prepared executable,
+      preparing on demand; degrades to the wrapped jit on any executable
+      error (counted), so a cache problem can slow a request but never
+      fail it.
+
+    ``donated=True`` declares that the wrapped jit donates inputs: the disk
+    tier is then disabled on the CPU backend, where restored donated
+    executables compute intermittently-wrong trajectories (the donation
+    hazard, module docstring).  ``persist=False`` disables the disk tier on
+    every backend (in-memory AOT split only)."""
+
+    def __init__(self, jit_fn, key_parts, name="fn", mesh_desc=None,
+                 persist=True, donated=False):
+        activate()
+        self._jit = jit_fn
+        self._name = str(name)
+        self._key = repr(tuple(key_parts))
+        self._mesh_desc = mesh_desc
+        self._donated = bool(donated)
+        self._persist = bool(persist) and not (self._donated and
+                                               _cpu_backend())
+        self._exes = {}
+        self._lock = threading.Lock()
+        self.__wrapped__ = jit_fn
+
+    # instrument_step's compile-vs-steady-state detector reads this; a disk
+    # restore grows it too (an executable was installed either way)
+    def _cache_size(self):
+        return len(self._exes)
+
+    @staticmethod
+    def _sig(args):
+        """In-memory signature key: (treedef, ((shape, dtype), ...)).  The
+        treedef OBJECT is the key component — hashable, and much cheaper
+        than stringifying the whole tree, since this runs per dispatch on
+        the hot path (every fused step / served batch).  :meth:`_sig_str`
+        canonicalizes for the disk paths only."""
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        return (treedef,
+                tuple((tuple(getattr(v, "shape", ())),
+                       str(getattr(v, "dtype", type(v).__name__)))
+                      for v in leaves))
+
+    @staticmethod
+    def _sig_str(sig):
+        """Cross-process-stable string form of a signature, for the entry
+        file name and the verified payload key (treedefs render
+        structurally, so equal trees stringify equally in any process)."""
+        return repr((str(sig[0]), sig[1]))
+
+    def _path(self, sig):
+        h = hashlib.sha256(
+            repr((self._name, self._key,
+                  self._sig_str(sig))).encode("utf-8")).hexdigest()
+        return os.path.join(_exec_dir(), "%s-%s.jx" % (self._name, h[:32]))
+
+    def _try_load(self, sig):
+        """Deserialize one entry, or None on ANY problem (mismatched key or
+        environment → ``key_mismatch``; truncated/corrupt/unreadable →
+        ``deserialize``) — the cache must never turn into a crash."""
+        path = self._path(sig)
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path, "rb") as f:
+                payload = pickle.load(f)
+            if (payload.get("key") != self._key
+                    or payload.get("sig") != self._sig_str(sig)
+                    or payload.get("env") != _env_fingerprint(self._mesh_desc)):
+                _note("errors", "key_mismatch")
+                return None
+            from jax.experimental import serialize_executable
+
+            exe = serialize_executable.deserialize_and_load(
+                payload["blob"], payload["in_tree"], payload["out_tree"])
+            os.utime(path, None)  # LRU signal for _evict
+            return exe
+        except Exception:
+            _note("errors", "deserialize")
+            return None
+
+    def _store(self, sig, compiled):
+        """Persist one compiled executable (atomic rename so a crashed
+        writer can only ever leave a *missing* entry, not a torn one).
+        Best-effort: a backend whose executables don't serialize (counted)
+        still runs from the in-memory cache."""
+        try:
+            from jax.experimental import serialize_executable
+
+            blob, in_tree, out_tree = serialize_executable.serialize(compiled)
+            payload = {"key": self._key, "sig": self._sig_str(sig),
+                       "env": _env_fingerprint(self._mesh_desc),
+                       "blob": blob, "in_tree": in_tree,
+                       "out_tree": out_tree}
+            path = self._path(sig)
+            tmp = "%s.tmp.%d" % (path, os.getpid())
+            with open(tmp, "wb") as f:
+                pickle.dump(payload, f)
+            os.replace(tmp, path)
+            _evict()
+        except Exception:
+            _note("errors", "serialize")
+
+    def lower_prepare(self, *args):
+        """Stage 1: → handle dict.  ``source`` is ``"cached"`` (signature
+        already prepared in this process), ``"disk"`` (restored — counted as
+        a hit; no compile left to pay), or ``"lower"`` (traced + lowered
+        here; :meth:`finalize` owes the compile).  ``lower_s`` is the disk
+        restore or trace+lower wall time."""
+        import time
+
+        sig = self._sig(args)
+        with self._lock:
+            if sig in self._exes:
+                return {"sig": sig, "source": "cached", "lower_s": 0.0}
+        t0 = time.perf_counter()
+        exe = self._try_load(sig) if self._persist else None
+        if exe is not None:
+            with self._lock:
+                self._exes[sig] = exe
+            _note("hits")
+            return {"sig": sig, "source": "disk",
+                    "lower_s": time.perf_counter() - t0}
+        t0 = time.perf_counter()
+        lowered = self._jit.lower(*args)
+        return {"sig": sig, "source": "lower", "lowered": lowered,
+                "lower_s": time.perf_counter() - t0}
+
+    def finalize(self, handle):
+        """Stage 2: compile a ``"lower"`` handle (and persist it — counted
+        as a miss); a ``"cached"``/``"disk"`` handle passes through with
+        ``compile_s`` 0."""
+        import time
+
+        if handle["source"] != "lower":
+            return dict(handle, compile_s=0.0)
+        t0 = time.perf_counter()
+        compiled = handle["lowered"].compile()
+        compile_s = time.perf_counter() - t0
+        with self._lock:
+            self._exes[handle["sig"]] = compiled
+        if self._persist:
+            _note("misses")
+            self._store(handle["sig"], compiled)
+        return {"sig": handle["sig"], "source": "compile",
+                "lower_s": handle["lower_s"], "compile_s": compile_s}
+
+    def prepare(self, *args):
+        """lower_prepare + finalize in one call → the finalize row."""
+        return self.finalize(self.lower_prepare(*args))
+
+    def __call__(self, *args):
+        sig = self._sig(args)
+        exe = self._exes.get(sig)
+        if exe is None:
+            self.prepare(*args)
+            exe = self._exes.get(sig)
+        if exe is None:  # compile failed upstream; let jit raise its error
+            return self._jit(*args)
+        try:
+            return exe(*args)
+        except Exception:
+            # a deserialized executable the runtime won't take (e.g. device
+            # set changed under us): drop it and degrade to the jit path —
+            # slower, never wrong.  NOT with donated args: the failed
+            # executable may already have consumed (aliased/deleted) its
+            # donated buffers, and re-invoking the jit on deleted arrays
+            # would swallow the real error under a confusing second one.
+            _note("errors", "dispatch")
+            with self._lock:
+                self._exes.pop(sig, None)
+            if self._donated:
+                raise
+            return self._jit(*args)

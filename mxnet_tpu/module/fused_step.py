@@ -248,6 +248,25 @@ class FusedStepper:
         self._nancheck = env_flag("MXNET_NANCHECK")
         self._mesh = module._mesh
         self._zero = self._mesh is not None and fused_zero_enabled()
+        # persistent AOT executable cache (compile_cache.py, ISSUE 6): the
+        # logical key is everything folded into the compiled step besides
+        # argument shapes (those join at prepare time) and the environment
+        # (verified inside the cache entry — incl. the mesh descriptor, so
+        # a restart onto a different topology misses cleanly)
+        from .. import compile_cache
+
+        self._aot_key = None
+        if compile_cache.active():
+            # mesh PRESENCE is program identity (out_shardings, in-step
+            # psum); mesh SHAPE lives in the verified environment
+            # fingerprint, so a restart onto a different topology is a
+            # clean miss + recompile rather than a different entry
+            self._aot_key = (
+                "fused_step",
+                compile_cache.symbol_fingerprint(module._symbol),
+                tuple(self._diff_names), tuple(self._const_names),
+                tuple(self._aux_names), self._hp_sig, self._nancheck,
+                self._zero, self._mesh is not None, "donate:0123")
         self._nsteps = 0
         self._pending_flag = None  # (finite device scalar, step number)
         self._fn = _build_step_fn(exec_._graph_fn(True), self._arg_names,
@@ -331,6 +350,18 @@ class FusedStepper:
                 note_derived("psum_grads", whole)
             else:
                 note_derived("psum_grads", diff_vals)
+        if self._aot_key is not None:
+            from .. import compile_cache
+
+            # donated=True: on the CPU backend the disk tier is skipped
+            # entirely — restored donated executables compute wrong
+            # trajectories there (the donation hazard, compile_cache.py
+            # docstring) — so a CPU restart re-pays this compile; TPU-class
+            # backends restore normally.  Cache off ⇒ the plain jit above.
+            self._jit = compile_cache.CachedFunction(
+                self._jit, self._aot_key, name="fused_step",
+                mesh_desc=compile_cache.mesh_descriptor(self._mesh),
+                donated=True)
         # compile/steady-state accounting (identity when telemetry is off)
         self._step = telemetry.instrument_step(self._jit,
                                                name="module_fused_step")

@@ -162,6 +162,28 @@ class Predictor:
             self.forward()
         return self._outputs[index].asnumpy()
 
+    # -- AOT warmup (MXNET_AOT_CACHE, compile_cache.py, ISSUE 6) ------------
+    def aot_lower(self):
+        """Stage 1 of the ahead-of-time compile split: restore this
+        predictor's inference executable from the persistent cache, or
+        trace+lower it for compiling.  Host-only work — the serving warmup
+        runs this for every ladder bucket concurrently.  None when
+        ``MXNET_AOT_CACHE`` is off."""
+        return self._exec.aot_lower(is_train=False)
+
+    def aot_finalize(self, handle):
+        """Stage 2: compile-or-install the executable behind ``forward`` so
+        the first real request dispatches hot.  → finalize row with
+        ``source`` ("cached"/"disk"/"compile"), ``lower_s``, ``compile_s``."""
+        return self._exec.aot_finalize(handle, is_train=False)
+
+    def aot_warm(self):
+        """One-call AOT prepare (lower + compile-or-restore), for
+        deployments that warm a bare Predictor without an Engine.  None when
+        the cache is off."""
+        handle = self.aot_lower()
+        return None if handle is None else self.aot_finalize(handle)
+
     def with_shapes(self, input_shapes):
         """A sibling Predictor specialized to ``input_shapes``, sharing this
         one's symbol and loaded params — the cheap path for holding MANY

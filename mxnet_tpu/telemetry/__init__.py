@@ -20,7 +20,8 @@ from .sinks import (JsonlSink, PrometheusSink, ProfilerSink, Sink,
                     TensorBoardSink, iter_scalar_samples, render_prometheus)
 from .instrument import (ServeProbe, StepProbe, add_sink, array_nbytes,
                          counter, enabled, event, flush, gauge, histogram,
-                         instrument_step, interval_s, jsonl_path, note_bytes,
+                         instrument_step, interval_s, jsonl_path,
+                         note_aot_cache, note_bytes,
                          note_compile, note_dispatch, note_fused_fallback,
                          note_nonfinite, note_train_step, registry,
                          sample_memory, serve_probe, step_probe, summary)
@@ -33,7 +34,7 @@ __all__ = [
     "iter_scalar_samples", "render_prometheus",
     "ServeProbe", "StepProbe", "add_sink", "array_nbytes", "counter",
     "enabled", "event", "flush", "gauge", "histogram", "instrument_step",
-    "interval_s", "jsonl_path", "note_bytes", "note_compile",
+    "interval_s", "jsonl_path", "note_aot_cache", "note_bytes", "note_compile",
     "note_dispatch", "note_fused_fallback", "note_nonfinite",
     "note_train_step", "registry", "sample_memory", "serve_probe",
     "step_probe", "summary",

@@ -35,7 +35,8 @@ from .sinks import JsonlSink
 
 __all__ = ["enabled", "jsonl_path", "interval_s", "registry", "add_sink",
            "counter", "gauge", "histogram", "event", "flush",
-           "instrument_step", "note_compile", "note_bytes", "array_nbytes",
+           "instrument_step", "note_aot_cache", "note_compile", "note_bytes",
+           "array_nbytes",
            "note_dispatch", "note_train_step", "note_fused_fallback",
            "note_nonfinite",
            "sample_memory", "step_probe", "StepProbe", "summary",
@@ -241,6 +242,32 @@ def note_nonfinite(where):
                        ("where",)).inc(where=where)
 
 
+def note_aot_cache(kind, reason=None, tier="exec"):
+    """Count one AOT persistent-cache event (compile_cache.py, ISSUE 6).
+    ``kind``: "hits" | "misses" | "errors"; errors carry a reason label
+    (key_mismatch / deserialize / serialize / dispatch); hits/misses carry
+    ``tier`` — "exec" (serialized whole executables, tier 1) or "xla"
+    (jax's persistent compilation cache, tier 2).  compile_cache keeps its
+    own process-local stats for the no-telemetry path — this is the
+    registry mirror."""
+    if not enabled():
+        return
+    r = registry()
+    if kind == "errors":
+        r.counter("aot_cache_errors_total",
+                  "AOT cache entries rejected (stale key, corrupt file, "
+                  "unusable executable) — each is a clean miss + recompile",
+                  ("reason",)).inc(reason=reason or "unknown")
+    elif kind == "hits":
+        r.counter("aot_cache_hits_total",
+                  "executables/XLA modules restored from the persistent "
+                  "AOT cache", ("tier",)).inc(tier=tier)
+    else:
+        r.counter("aot_cache_misses_total",
+                  "executables/XLA modules compiled fresh (and stored)",
+                  ("tier",)).inc(tier=tier)
+
+
 def note_bytes(counter_name, nbytes, **labels):
     """Accumulate a bytes-moved counter (kvstore push/pull, collectives)."""
     if not enabled() or nbytes <= 0:
@@ -434,6 +461,16 @@ class ServeProbe:
         self._r.event("serve_compile", engine=self.engine, bucket=bucket,
                       seconds=round(seconds, 6))
 
+    def record_warmup(self, buckets, cache_hits, cache_misses, seconds):
+        """One completed warmup pass (serving/warmup.py): wall-clock plus
+        the AOT-cache hit/miss split, so restart health is one event."""
+        self._r.counter("warmup_seconds_total",
+                        "engine warmup wall-clock",
+                        ("engine",)).inc(seconds, engine=self.engine)
+        self._r.event("warmup", engine=self.engine, buckets=buckets,
+                      cache_hits=cache_hits, cache_misses=cache_misses,
+                      seconds=round(seconds, 4))
+
 
 def serve_probe(engine):
     """ServeProbe for one engine, or None with telemetry disabled."""
@@ -460,7 +497,11 @@ def summary():
     # benches, whose step is one dispatch by construction)
     steps = r.total("train_steps_total", 0.0)
     disp = r.total("step_dispatches_total", 0.0)
+    # warmup_s (ISSUE 6 restart benchmark surface): total engine warmup
+    # wall-clock this process paid — null when nothing warmed up
+    warm = r.total("warmup_seconds_total", None)
     return {"compile_s": round(compile_s, 3),
             "peak_hbm_bytes": int(peak) if peak is not None else None,
             "data_wait_frac": round(frac, 4),
-            "dispatches_per_step": round(disp / steps, 2) if steps else None}
+            "dispatches_per_step": round(disp / steps, 2) if steps else None,
+            "warmup_s": round(warm, 3) if warm is not None else None}

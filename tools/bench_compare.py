@@ -52,7 +52,8 @@ def load_bench(path, obj):
             "value": float(line["value"]), "unit": str(line.get("unit", "")),
             "dispatches_per_step": tel.get("dispatches_per_step"),
             "compile_s": tel.get("compile_s"),
-            "data_wait_frac": tel.get("data_wait_frac")}
+            "data_wait_frac": tel.get("data_wait_frac"),
+            "warmup_s": tel.get("warmup_s")}
 
 
 # multichip dryrun phases, as printed by __graft_entry__.dryrun_multichip —
@@ -142,8 +143,14 @@ def compare(rows, threshold):
               if same and r is not base else None)
         dc = (_pct(r["compile_s"], base["compile_s"])
               if same and r is not base else None)
+        # warmup_s (ISSUE 6 restart benchmark): shown + deltaed like
+        # compile_s, not gated — a cold capture against a warm one is a
+        # configuration difference, not a regression
+        dw = (_pct(r["warmup_s"], base["warmup_s"])
+              if same and r is not base else None)
         table.append(dict(r, same_metric=same, value_delta_pct=dv,
-                          dps_delta_pct=dd, compile_delta_pct=dc))
+                          dps_delta_pct=dd, compile_delta_pct=dc,
+                          warmup_delta_pct=dw))
         if r is base or not same:
             continue
         if dv is not None and dv < -threshold:
@@ -164,7 +171,7 @@ def _fmt(v, spec="%.4g", dash="-"):
 
 def render_table(table):
     cols = ["file", "metric", "value", "Δvalue%", "disp/step", "Δdisp%",
-            "compile_s", "Δcompile%", "wait_frac"]
+            "compile_s", "Δcompile%", "warmup_s", "Δwarmup%", "wait_frac"]
     out = [cols]
     for r in table:
         metric = r["metric"] + ("" if r["same_metric"] else " (≠ baseline)")
@@ -174,6 +181,8 @@ def render_table(table):
                     _fmt(r["dps_delta_pct"], "%+.1f"),
                     _fmt(r["compile_s"], "%.3g"),
                     _fmt(r["compile_delta_pct"], "%+.1f"),
+                    _fmt(r["warmup_s"], "%.3g"),
+                    _fmt(r["warmup_delta_pct"], "%+.1f"),
                     _fmt(r["data_wait_frac"], "%.3g")])
     widths = [max(len(row[i]) for row in out) for i in range(len(cols))]
     lines = []

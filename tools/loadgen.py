@@ -174,7 +174,24 @@ def _run_open(engine, shapes, args, collector):
     return duration
 
 
-def run(engine, shapes, args, mode):
+def _first_request_latencies(engine, shapes, sizes):
+    """One serial request per size class, before any load traffic — the
+    first-request latency an operator's health check (or first real user)
+    sees.  After ``--no-warmup`` this measures the COLD path, compiles
+    included — the restart metric the AOT cache (`MXNET_AOT_CACHE`,
+    docs/PERF_NOTES.md "Restart warm") exists to collapse; after warmup it
+    measures the all-hot floor.  → {str(n): ms}."""
+    out = {}
+    for n in sorted(set(sizes)):
+        inputs = {name: np.zeros((n,) + tuple(s), np.float32)
+                  for name, s in shapes.items()}
+        t0 = time.perf_counter()
+        engine.predict(inputs, timeout=60.0)
+        out[str(n)] = round((time.perf_counter() - t0) * 1e3, 3)
+    return out
+
+
+def run(engine, shapes, args, mode, first_request_ms=None, warmup_s=None):
     collector = _Collector()
     compiles_before = engine.stats()["compiles"]
     runner = _run_closed if mode == "closed" else _run_open
@@ -206,6 +223,10 @@ def run(engine, shapes, args, mode):
         "compiles": stats["compiles"] - compiles_before,
         "concurrency": args.concurrency if mode == "closed" else None,
         "rate_rps": args.rate if mode == "open" else None,
+        # restart metrics (ISSUE 6): measured once per engine, repeated on
+        # every mode's line so each SERVE_BENCH stays self-contained
+        "first_request_ms": first_request_ms,
+        "warmup_s": warmup_s,
     }
     line = {k: v for k, v in line.items() if v is not None}
     print("SERVE_BENCH " + json.dumps(line))
@@ -253,10 +274,15 @@ def main(argv=None):
     engine, shapes = (_file_engine(args) if args.symbol
                       else _tiny_engine(args))
     try:
+        warmup_s = None
         if not args.no_warmup:
+            t0 = time.perf_counter()
             engine.warmup()
+            warmup_s = round(time.perf_counter() - t0, 4)
+        first = _first_request_latencies(engine, shapes, args.sizes)
         modes = ["closed", "open"] if args.mode == "both" else [args.mode]
-        lines = [run(engine, shapes, args, m) for m in modes]
+        lines = [run(engine, shapes, args, m, first_request_ms=first,
+                     warmup_s=warmup_s) for m in modes]
     finally:
         engine.close()
     # a run with model/engine errors is a FAILED run even if some requests
