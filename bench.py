@@ -45,6 +45,8 @@ def main():
         return main_frcnn()
     if which == "module":
         return main_module()
+    if which == "predictor":
+        return main_predictor()
     if which != "resnet50":
         return main_rfcn()
     import jax
@@ -200,6 +202,84 @@ def main_module():
     dt = time.perf_counter() - t0
     _emit({
         "metric": "module_mlp_train_samples_per_sec",
+        "value": round(batch * iters / dt, 2),
+        "unit": "samples/s",
+        "vs_baseline": None,
+    })
+
+
+def main_predictor():
+    """``MXNET_BENCH=predictor``: symbolic inference-twin microbench
+    (ISSUE 7 graph passes).  A two-head deploy graph — conv+BN trunk, then
+    a classifier head AND an embedding head, each re-deriving the pooled
+    trunk features through a shared helper (the standard exporter pattern:
+    every head's builder recomputes its own normalize/flatten chain, so
+    the captured graph carries duplicated subexpressions the passes merge;
+    dropout nodes vanish from the eval plan and BatchNorms become affine).
+    Driven through ``Predictor.forward`` — the serving shape the bucket
+    ladder compiles.  With MXNET_TELEMETRY=1 the telemetry block carries
+    ``graph_nodes_pre``/``graph_nodes_post``/``pass_time_s`` and
+    ``compile_s`` (the first forward's trace+compile, via note_compile);
+    run with MXNET_GRAPH_PASSES=0 to measure the unoptimized plan the
+    passes replace (docs/PERF_NOTES.md "Graph passes")."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.predictor import Predictor
+
+    batch = int(os.environ.get("MXNET_BENCH_BATCH", 16))
+    iters = int(os.environ.get("MXNET_BENCH_ITERS", 200))
+    image = 32
+
+    data = mx.sym.var("data")
+    h = data
+    for i, nf in enumerate((16, 32)):
+        h = mx.sym.Convolution(h, name="conv%d" % i, kernel=(3, 3),
+                               num_filter=nf, pad=(1, 1))
+        h = mx.sym.BatchNorm(h, name="bn%d" % i, fix_gamma=False)
+        h = mx.sym.Activation(h, name="act%d" % i, act_type="relu")
+        h = mx.sym.Pooling(h, name="pool%d" % i, kernel=(2, 2),
+                           stride=(2, 2), pool_type="max")
+
+    def pooled_features(trunk):
+        # per-head feature derivation (auto-named: each call captures a
+        # fresh chain — exactly the duplication CSE exists to merge)
+        p = mx.sym.Pooling(trunk, kernel=(1, 1), global_pool=True,
+                           pool_type="avg")
+        return mx.sym.L2Normalization(mx.sym.Flatten(p))
+
+    emb = pooled_features(h)  # embedding head (served for similarity)
+    cls = mx.sym.Dropout(pooled_features(h), p=0.5)
+    prob = mx.sym.softmax(
+        mx.sym.FullyConnected(cls, name="fc2", num_hidden=10), name="prob")
+    sym = mx.sym.Group([prob, emb])
+
+    rng = np.random.RandomState(0)
+    arg_shapes, _, aux_shapes = sym.infer_shape(data=(batch, 3, image, image))
+    params = {}
+    for n, s in zip(sym.list_arguments(), arg_shapes):
+        if n != "data":
+            params["arg:" + n] = mx.nd.array(
+                rng.randn(*s).astype(np.float32) * 0.05)
+    for n, s in zip(sym.list_auxiliary_states(), aux_shapes):
+        params["aux:" + n] = mx.nd.array(
+            np.ones(s, np.float32) if n.endswith("_var")
+            else np.zeros(s, np.float32))
+
+    from mxnet_tpu import telemetry
+
+    pred = Predictor(sym, params, {"data": (batch, 3, image, image)})
+    x = rng.rand(batch, 3, image, image).astype(np.float32)
+    t0 = time.perf_counter()
+    pred.forward(data=x)
+    pred.get_output(0)
+    telemetry.note_compile(time.perf_counter() - t0, fn="predictor_fwd")
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        pred.forward(data=x)
+    pred.get_output(0)  # sync the async dispatch chain
+    dt = time.perf_counter() - t0
+    _emit({
+        "metric": "predictor_cnn_infer_samples_per_sec",
         "value": round(batch * iters / dt, 2),
         "unit": "samples/s",
         "vs_baseline": None,

@@ -19,10 +19,12 @@ import sys
 
 TOP_KEYS = {"metric", "value", "unit", "vs_baseline", "telemetry"}
 TEL_REQ_KEYS = {"compile_s", "peak_hbm_bytes", "data_wait_frac"}
-# dispatches_per_step (ISSUE 3 fused Module step) and warmup_s (ISSUE 6
-# AOT cache restart surface) are optional: captures predating that work
-# carry only the three original keys
-TEL_OPT_KEYS = {"dispatches_per_step", "warmup_s"}
+# dispatches_per_step (ISSUE 3 fused Module step), warmup_s (ISSUE 6 AOT
+# cache restart surface) and the graph-pass keys (ISSUE 7: plan nodes
+# in/out of the pass pipeline + its wall time) are optional: captures
+# predating that work carry only the three original keys
+TEL_OPT_KEYS = {"dispatches_per_step", "warmup_s",
+                "graph_nodes_pre", "graph_nodes_post", "pass_time_s"}
 TEL_KEYS = TEL_REQ_KEYS | TEL_OPT_KEYS
 
 # SERVE_BENCH line (tools/loadgen.py, ISSUE 2) — docs/SERVING.md schema
@@ -99,6 +101,18 @@ def validate_line(obj, where="<line>"):
             raise SchemaError(
                 "%s: telemetry.warmup_s must be a non-negative number or "
                 "null" % where)
+        for k in ("graph_nodes_pre", "graph_nodes_post"):
+            gn = tel.get(k)
+            if gn is not None and (not isinstance(gn, int)
+                                   or isinstance(gn, bool) or gn < 0):
+                raise SchemaError(
+                    "%s: telemetry.%s must be a non-negative int or null"
+                    % (where, k))
+        pt = tel.get("pass_time_s")
+        if pt is not None and (not _num(pt) or pt < 0):
+            raise SchemaError(
+                "%s: telemetry.pass_time_s must be a non-negative number "
+                "or null" % where)
 
 
 def validate_serve_line(obj, where="<line>"):
@@ -193,6 +207,14 @@ def self_test():
         {"metric": "m", "value": 1, "unit": "samples/s",
          "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
                        "data_wait_frac": 0.0, "warmup_s": None}},
+        {"metric": "m", "value": 1, "unit": "samples/s",
+         "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0, "graph_nodes_pre": 34,
+                       "graph_nodes_post": 27, "pass_time_s": 0.002}},
+        {"metric": "m", "value": 1, "unit": "samples/s",
+         "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0, "graph_nodes_pre": None,
+                       "graph_nodes_post": None, "pass_time_s": None}},
     ]
     bad = [
         {},                                                  # empty
@@ -213,6 +235,18 @@ def self_test():
         {"metric": "m", "value": 1, "unit": "img/s",
          "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
                        "data_wait_frac": 0.0, "warmup_s": -1}},  # neg warmup
+        {"metric": "m", "value": 1, "unit": "img/s",
+         "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0,
+                       "graph_nodes_post": 1.5}},        # float node count
+        {"metric": "m", "value": 1, "unit": "img/s",
+         "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0,
+                       "graph_nodes_pre": -3}},          # negative nodes
+        {"metric": "m", "value": 1, "unit": "img/s",
+         "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0,
+                       "pass_time_s": -0.1}},            # negative pass time
     ]
     serve_good = {"mode": "closed", "requests": 10, "completed": 9,
                   "shed": 1, "timeouts": 0, "errors": 0, "shed_rate": 0.1,

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Graph-pass smoke (ISSUE 7 CI satellite) — unit tier.
+
+Builds a symbol whose captured plan carries (a) a duplicated subexpression
+(two auto-named exp->sqrt chains over the same input — the helper-function
+duplication CSE exists for), which after the merge leaves a known-DEAD
+branch for the eliminator to sweep, (b) a constant subgraph (an ``arange``
+feeding an add) for the folder, and (c) an eval-identity Dropout for the
+inference rewrite.  Asserts:
+
+* post-pass node count equals the hand-counted minimum (and the captured
+  count equals the hand-counted raw plan);
+* forward results with passes ON match passes OFF;
+* with ``MXNET_GRAPH_PASSES=0`` the optimized plan IS the raw captured
+  plan (same object — byte-identical lowering) and no stats are recorded.
+
+Run from ci/run_tests.sh unit tier::
+
+    python ci/check_graph_passes.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def build():
+    import mxnet_tpu as mx
+
+    data = mx.sym.var("data")
+    # duplicated subexpression: helper re-derives the same chain per head
+    def norm(x):
+        return mx.sym.sqrt(mx.sym.exp(x))
+
+    out = norm(data) * norm(data)          # 2x (exp, sqrt) + mul  -> 5 raw
+    offset = mx.sym.arange(0, 4)           # constant subgraph     -> +1
+    out = out + offset                     # live consumer         -> +1
+    out = mx.sym.Dropout(out, p=0.5)       # eval-identity         -> +1
+    return out                             # raw plan: 8 nodes
+
+
+# hand count after the pipeline (eval mode):
+#   arange folds to a baked constant            (-1)
+#   CSE merges the second exp->sqrt chain       (redirect)
+#   Dropout deleted (identity at inference)     (-1)
+#   DCE sweeps the orphaned exp+sqrt pair       (-2)
+# leaving: exp, sqrt, mul, add                  = 4 nodes
+RAW_NODES = 8
+MIN_NODES = 4
+
+
+def run(passes, x):
+    os.environ["MXNET_GRAPH_PASSES"] = passes
+    from mxnet_tpu import nd
+
+    exe = build().bind(None, {"data": nd.array(x)})
+    out = exe.forward()[0].asnumpy()
+    plan, heads, const = exe._opt_plan(False)
+    return exe, out, plan, const
+
+
+def main():
+    x = np.random.RandomState(0).rand(2, 4).astype(np.float32)
+
+    exe0, out0, plan0, const0 = run("0", x)
+    assert len(exe0._plan) == RAW_NODES, \
+        "captured %d nodes, hand count says %d" % (len(exe0._plan), RAW_NODES)
+    assert plan0 is exe0._plan and const0 is None, \
+        "passes off must hand the RAW plan to lowering, untouched"
+    assert exe0.pass_stats() == {}, exe0.pass_stats()
+
+    exe1, out1, plan1, const1 = run("1", x)
+    assert len(exe1._plan) == RAW_NODES
+    assert len(plan1) == MIN_NODES, \
+        "post-pass plan has %d nodes, hand count says %d (plan: %s)" % (
+            len(plan1), MIN_NODES, [n.name for n, _ in plan1])
+    assert const1, "arange should have folded into a baked constant"
+    stats = exe1.pass_stats()["eval"]
+    assert (stats["nodes_pre"], stats["nodes_post"]) == (RAW_NODES, MIN_NODES)
+
+    assert np.allclose(out0, out1, atol=1e-6), \
+        "forward parity broke: max delta %g" % np.abs(out0 - out1).max()
+
+    print("check_graph_passes: ok (plan %d -> %d nodes, parity holds, "
+          "passes-off plan untouched)" % (RAW_NODES, MIN_NODES))
+
+
+if __name__ == "__main__":
+    main()

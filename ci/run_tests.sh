@@ -55,6 +55,10 @@ case "$tier" in
     # AOT cache smoke (ISSUE 6): warmup twice against one cache dir in
     # subprocesses — second run must be all cache hits and faster
     ./dev.sh python ci/check_aot_cache.py
+    # graph-pass smoke (ISSUE 7): dead branch + duplicated subexpression +
+    # constant subgraph must reduce to the hand-counted minimum node count
+    # with forward parity against MXNET_GRAPH_PASSES=0
+    ./dev.sh python ci/check_graph_passes.py
     # telemetry unit tests (tests/test_telemetry.py) run as part of tests/
     ignore=()
     for f in "${NIGHTLY_FILES[@]}"; do ignore+=(--ignore "$f"); done

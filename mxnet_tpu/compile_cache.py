@@ -263,12 +263,20 @@ def mesh_descriptor(mesh):
 def _env_fingerprint(mesh_desc=None):
     import jax
 
+    from . import graph_passes
+
     jv, jlv = _versions()
     devs = jax.devices()
+    # "passes": the graph-pass pipeline (ISSUE 7) that shaped every plan
+    # compiled in this configuration — None with MXNET_GRAPH_PASSES=0.
+    # Verified (not just keyed) so an executable persisted under a
+    # different pass configuration, or by a build whose pass versions
+    # changed, can never be restored: it misses cleanly and is recompiled.
     return {"format": _FORMAT, "jax": jv, "jaxlib": jlv,
             "backend": jax.default_backend(),
             "device_kind": str(devs[0].device_kind), "n_devices": len(devs),
-            "mesh": mesh_desc}
+            "mesh": mesh_desc,
+            "passes": graph_passes.pipeline_fingerprint()}
 
 
 def _evict():
@@ -333,14 +341,31 @@ class CachedFunction:
     tier is then disabled on the CPU backend, where restored donated
     executables compute intermittently-wrong trajectories (the donation
     hazard, module docstring).  ``persist=False`` disables the disk tier on
-    every backend (in-memory AOT split only)."""
+    every backend (in-memory AOT split only).
+
+    ``passes_on`` pins whether the wrapped computation was lowered through
+    the graph-pass pipeline (ISSUE 7): when true, the pipeline's
+    (name, version) fingerprint joins the logical key, so pass-optimized
+    and raw plans can never share an entry.  Callers that snapshot the
+    ``MXNET_GRAPH_PASSES`` gate (Executor, FusedStepper) pass their
+    snapshot; the default (None) reads the gate live.  With the gate off
+    nothing is appended — keys stay byte-identical to pre-pass builds."""
 
     def __init__(self, jit_fn, key_parts, name="fn", mesh_desc=None,
-                 persist=True, donated=False):
+                 persist=True, donated=False, passes_on=None):
         activate()
         self._jit = jit_fn
         self._name = str(name)
-        self._key = repr(tuple(key_parts))
+        key_parts = tuple(key_parts)
+        from . import graph_passes
+
+        if passes_on is None:
+            passes_on = graph_passes.enabled()
+        if passes_on:
+            key_parts += (("graph_passes",
+                           "|".join("%s:%d" % nv
+                                    for nv in graph_passes.pipeline())),)
+        self._key = repr(key_parts)
         self._mesh_desc = mesh_desc
         self._donated = bool(donated)
         self._persist = bool(persist) and not (self._donated and

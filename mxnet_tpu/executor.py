@@ -42,6 +42,14 @@ class Executor:
         self._monitor_all = False
         self._fwd_cache = {}
         self._bwd_cache = {}  # (diff_names, ones_ct, mode) -> jitted bwd
+        from . import graph_passes
+
+        # gate snapshot at bind time: one executor never mixes optimized
+        # and raw plans even if MXNET_GRAPH_PASSES flips mid-process (a
+        # re-bind — Module.reshape, Predictor.with_shapes — re-reads it)
+        self._graph_passes = graph_passes.enabled()
+        self._opt_cache = {}   # is_train -> (plan, head_names, const_env)
+        self._pass_stats = {}  # "train"/"eval" -> graph_passes.optimize stats
         self._plan = self._make_plan()
 
     # -- array plumbing -----------------------------------------------------
@@ -75,30 +83,59 @@ class Executor:
     # -- plan ---------------------------------------------------------------
     def _make_plan(self):
         """Static execution plan: topological node list with resolved input
-        slots, random-key folding per stochastic node, aux-update metadata."""
-        nodes = self._symbol._walk()
-        from .symbol.symbol import _sym_out_name
+        slots, random-key folding per stochastic node, aux-update metadata.
+        Capture itself lives in ``graph_passes.capture`` (shared with the
+        standalone node-count surface); the pass pipeline runs lazily per
+        mode in :meth:`_opt_plan`."""
+        from .graph_passes import capture
 
-        plan = []
-        for node in nodes:
-            if node.is_var:
-                continue
-            in_names = [_sym_out_name(i) for i in node.inputs]
-            plan.append((node, in_names))
-        self._head_names = []
-        for node, idx in self._symbol._outputs_of():
-            base = node._base() if node.out_index is not None else node
-            self._head_names.append(_sym_out_name(node) if node.is_var else (
-                "%s_output%d" % (base.name, idx) if base.num_outputs > 1 else "%s_output" % base.name
-            ))
+        plan, self._head_names = capture(self._symbol)
         return plan
+
+    def _opt_plan(self, is_train):
+        """The plan :meth:`_graph_fn` evaluates for ``is_train`` —
+        ``(plan, head_names, const_env)``, where ``const_env`` seeds the
+        evaluation env with pass-baked constants (None when nothing baked).
+
+        With ``MXNET_GRAPH_PASSES`` off (snapshot at bind) this returns the
+        raw captured plan untouched — byte-identical lowering to a build
+        without the pass layer.  Otherwise the registered pipeline
+        (``graph_passes.optimize``) runs once per mode, its result is
+        cached for the executor's lifetime, and node-count/time stats land
+        in :meth:`pass_stats` + the telemetry registry
+        (``graph_nodes_{pre,post}_total`` / ``graph_pass_seconds_total``)."""
+        is_train = bool(is_train)
+        hit = self._opt_cache.get(is_train)
+        if hit is None:
+            if not self._graph_passes:
+                hit = (self._plan, self._head_names, None)
+            else:
+                from . import graph_passes, telemetry
+
+                g, stats = graph_passes.optimize(
+                    self._plan, self._head_names, is_train)
+                self._pass_stats[stats["mode"]] = stats
+                telemetry.note_graph_passes(
+                    stats["nodes_pre"], stats["nodes_post"],
+                    stats["seconds"], mode=stats["mode"])
+                hit = (list(g.entries), list(g.heads),
+                       g.constants or None)
+            self._opt_cache[is_train] = hit
+        return hit
+
+    def pass_stats(self):
+        """Per-mode graph-pass results (``{"train"/"eval": stats}``) for
+        the modes this executor has lowered so far; empty with passes off."""
+        return dict(self._pass_stats)
 
     def _graph_fn(self, is_train, monitor=None):
         """Pure fn (arg_vals, aux_vals, key) -> (head_vals, new_aux_vals).
 
         ``monitor``: optional callback(name, jax_value) invoked per node output
         — only used on the un-jitted path (reference ExecuteMonCallback,
-        graph_executor.cc:1562).
+        graph_executor.cc:1562).  The monitor path always evaluates the RAW
+        captured plan: a debugging hook must see every captured node, not
+        the pass-optimized subset.
         """
         import zlib
 
@@ -106,16 +143,19 @@ class Executor:
 
         from .symbol.symbol import _node_input_names
 
-        plan = self._plan
+        if monitor is not None:
+            plan, heads, const_env = self._plan, self._head_names, None
+        else:
+            plan, heads, const_env = self._opt_plan(is_train)
         aux_names = list(self._aux_names)
         arg_names = list(self._arg_names)
         # locals only: the returned fn must NOT close over the Executor —
         # the Module fused stepper keeps it across re-binds, and an
         # executor reference would pin the old buffers after reshape
-        head_names = list(self._head_names)
+        head_names = list(heads)
 
         def fn(arg_vals, aux_vals, key):
-            env = {}
+            env = dict(const_env) if const_env else {}
             env.update(zip(arg_names, arg_vals))
             env.update(zip(aux_names, aux_vals))
             new_aux = dict(zip(aux_names, aux_vals))
@@ -167,13 +207,16 @@ class Executor:
                 # persistent AOT executable cache (ISSUE 6): per shape
                 # signature the forward restores from MXNET_AOT_CACHE
                 # instead of trace+lower+XLA-compile; gate off ⇒ the plain
-                # jit above, byte-identical to before
+                # jit above, byte-identical to before.  passes_on pins the
+                # bind-time graph-pass snapshot into the logical key, so
+                # this executor's entries always describe the plan it
+                # actually lowered (ISSUE 7).
                 fn = compile_cache.CachedFunction(
                     fn,
                     ("executor_fwd",
                      compile_cache.symbol_fingerprint(self._symbol),
                      bool(is_train)),
-                    name="executor_fwd")
+                    name="executor_fwd", passes_on=self._graph_passes)
             self._fwd_cache[is_train] = fn
         return self._fwd_cache[is_train]
 
@@ -386,8 +429,10 @@ class Executor:
 
     def set_monitor_callback(self, callback, monitor_all=False):
         """Install per-output inspection (reference executor.h:172 monitor).
-        Forward runs un-jitted while a monitor is installed; monitor_all
-        additionally reports node inputs (args/aux — weights included)."""
+        Forward runs un-jitted — and over the RAW captured plan, graph
+        passes bypassed — while a monitor is installed, so the callback
+        sees every captured node; monitor_all additionally reports node
+        inputs (args/aux — weights included)."""
         self._monitor = callback
         self._monitor_all = bool(monitor_all)
 

@@ -1,0 +1,118 @@
+"""Graph-pass layer over the captured Symbol DAG (ISSUE 7) — the Relay move.
+
+The reference framework ran NNVM passes (Gradient / InferShape / PlanMemory)
+over its graph IR before execution; our Symbol -> Executor path used to lower
+pass-free, so XLA traced dead branches, re-traced duplicated subgraphs per
+bucket, and kept inference-time BatchNorms as full normalization ops.  This
+package optimizes the high-level IR *first* (PAPERS.md 1810.00952 /
+1904.08368): ``Executor`` runs the registered pipeline over its execution
+plan before jax ever sees the graph, so both the Predictor bucket ladder and
+the fused train step trace and compile smaller XLA modules — which also
+directly shrinks the cold-compile cost the AOT cache (ISSUE 6) amortizes.
+
+Surface:
+
+* :func:`enabled` — the ``MXNET_GRAPH_PASSES`` gate (default ON; ``0``
+  makes every consumer byte-identical to a build without this package).
+  The Executor snapshots the gate at bind time, so one executor never mixes
+  optimized and raw plans.
+* :func:`register_pass` — decorator adding a pure ``Graph -> Graph``
+  function to the pipeline; registration order IS execution order, and the
+  (name, version) list is the pipeline fingerprint.
+* :func:`optimize` — run the pipeline over a captured plan; returns the
+  optimized :class:`~.ir.Graph` plus per-pass node-count/time stats.
+* :func:`pipeline_fingerprint` — stable string identity of the configured
+  pipeline, or None when the gate is off.  ``compile_cache.CachedFunction``
+  folds it into every logical cache key and the verified environment
+  fingerprint, so toggling passes (or shipping a changed pass version) is a
+  clean AOT-cache miss, never a stale restore.
+* :func:`node_counts` — standalone (symbol -> (pre, post)) counting for
+  printed summaries (``Symbol.debug_str``, ``visualization.print_summary``).
+"""
+from __future__ import annotations
+
+import time
+
+from ..base import env_flag
+
+__all__ = ["enabled", "register_pass", "pipeline", "pipeline_fingerprint",
+           "optimize", "node_counts", "Graph", "PlanNode", "SynthOp",
+           "capture"]
+
+_PASSES = []  # [(name, version, fn)] — registration order is run order
+
+
+def enabled():
+    """``MXNET_GRAPH_PASSES`` gate (docs/ENV_VARS.md) — default ON."""
+    return env_flag("MXNET_GRAPH_PASSES", default="1")
+
+
+def register_pass(name, version=1):
+    """Register a pure ``fn(graph, is_train) -> graph`` pipeline pass.
+    Bump ``version`` on any behavior change: it enters the pipeline
+    fingerprint, invalidating persisted executables built by the old
+    pipeline."""
+    def _reg(fn):
+        _PASSES.append((str(name), int(version), fn))
+        return fn
+    return _reg
+
+
+def pipeline():
+    """The registered (name, version) pipeline, in run order."""
+    return tuple((n, v) for n, v, _ in _PASSES)
+
+
+def pipeline_fingerprint():
+    """Stable identity of the active pipeline for cache keys, or None when
+    the gate is off (so disabled builds produce pre-pass-era keys,
+    byte-identical)."""
+    if not enabled():
+        return None
+    return "|".join("%s:%d" % (n, v) for n, v, _ in _PASSES)
+
+
+def optimize(plan, head_names, is_train):
+    """Run the pipeline over a captured plan.
+
+    -> ``(graph, stats)`` where ``stats`` is::
+
+        {"mode": "train"|"eval", "nodes_pre": int, "nodes_post": int,
+         "seconds": float,
+         "passes": [{"pass", "version", "nodes_in", "nodes_out",
+                     "seconds"}, ...]}
+    """
+    g = Graph(plan, head_names)
+    pre = g.n_nodes
+    rows = []
+    t_all = time.perf_counter()
+    for name, version, fn in _PASSES:
+        t0 = time.perf_counter()
+        n_in = g.n_nodes
+        g = fn(g, bool(is_train))
+        rows.append({"pass": name, "version": version, "nodes_in": n_in,
+                     "nodes_out": g.n_nodes,
+                     "seconds": round(time.perf_counter() - t0, 6)})
+    stats = {"mode": "train" if is_train else "eval",
+             "nodes_pre": pre, "nodes_post": g.n_nodes,
+             "seconds": round(time.perf_counter() - t_all, 6),
+             "passes": rows}
+    return g, stats
+
+
+def node_counts(symbol, is_train=False):
+    """(captured, post-pass) plan node counts for ``symbol`` in the given
+    mode, or None when the gate is off — the cheap introspection surface
+    behind ``Symbol.debug_str`` and ``visualization.print_summary``."""
+    if not enabled():
+        return None
+    plan, heads = capture(symbol)
+    try:
+        g, _ = optimize(plan, heads, is_train)
+    except Exception:
+        return None  # a summary printer must never fail on an odd graph
+    return len(plan), g.n_nodes
+
+
+from .ir import Graph, PlanNode, SynthOp, capture  # noqa: E402
+from . import passes  # noqa: E402,F401  (registers the standard pipeline)

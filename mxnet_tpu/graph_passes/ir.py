@@ -1,0 +1,131 @@
+"""Graph IR for the symbolic pass layer (ISSUE 7).
+
+The unit of optimization is the *execution plan* the Executor already
+evaluates: a topologically ordered list of ``(node, in_names)`` entries plus
+the ordered head names — exactly what ``Executor._make_plan`` produces and
+``Executor._graph_fn`` walks.  :class:`Graph` wraps that plan as an immutable
+value (tuples all the way down) so every pass is a pure function
+``Graph -> Graph`` and the unoptimized plan can never be mutated in place —
+``MXNET_GRAPH_PASSES=0`` must stay byte-identical to a build without this
+package.
+
+Three node flavors appear in a plan:
+
+* captured ``Symbol`` nodes (the common case — everything ``capture`` emits);
+* :class:`PlanNode` — a pass-synthesized replacement node (e.g. the
+  inference BN-into-affine rewrite) carrying a :class:`SynthOp`.  Both
+  duck-type the exact attribute surface ``Executor._graph_fn`` reads
+  (``op.fn``, ``op.attr_names``, ``op.aux``, ``op.aux_update``, ``attrs``,
+  ``name``, ``num_outputs``), so the executor needs no case split;
+* *baked constants* — nodes folded away entirely, their output values moved
+  into ``Graph.constants`` (seeded into the evaluation env before any node
+  runs).
+"""
+from __future__ import annotations
+
+__all__ = ["Graph", "PlanNode", "SynthOp", "capture", "node_out_names"]
+
+
+class SynthOp:
+    """Duck-typed OpDef stand-in for pass-synthesized nodes.
+
+    Carries only what ``Executor._graph_fn`` touches; ``aux_update`` is
+    always None (synthesized nodes never own aux state), so the executor's
+    aux branch — the one place ``node.inputs`` / ``_node_input_names`` are
+    consulted — can never fire on one.
+    """
+
+    __slots__ = ("name", "fn", "attr_names")
+
+    # class-level so every instance agrees with OpDef's surface
+    aux = ()
+    aux_update = None
+    mutates = ()
+    inputs_fn = None
+    variadic = False
+    arg_names = ()
+    defaults = {}
+
+    def __init__(self, name, fn, attr_names=()):
+        self.name = name
+        self.fn = fn
+        self.attr_names = tuple(attr_names)
+
+    def __repr__(self):
+        return "SynthOp(%s)" % self.name
+
+
+class PlanNode:
+    """A pass-synthesized plan node (same attribute surface as a captured
+    Symbol node, minus the graph-structure methods no pass output needs)."""
+
+    __slots__ = ("op", "attrs", "name", "num_outputs", "inputs")
+
+    is_var = False
+
+    def __init__(self, op, attrs, name, num_outputs=1):
+        self.op = op
+        self.attrs = dict(attrs)
+        self.name = name
+        self.num_outputs = num_outputs
+        self.inputs = []
+
+    def __repr__(self):
+        return "PlanNode(%s:%s)" % (self.op.name, self.name)
+
+
+def node_out_names(node):
+    """The env names a plan node's outputs bind to — must mirror
+    ``Executor._graph_fn``'s naming exactly."""
+    if node.num_outputs > 1:
+        return ["%s_output%d" % (node.name, i)
+                for i in range(node.num_outputs)]
+    return ["%s_output" % node.name]
+
+
+def capture(symbol):
+    """Capture a Symbol DAG as ``(plan, head_names)`` — the shared front end
+    of ``Executor._make_plan`` and the standalone :func:`node_counts`
+    surface (``Symbol.debug_str`` / ``visualization.print_summary``).
+
+    ``plan`` is ``[(node, [input_env_name, ...]), ...]`` in topological
+    order (vars excluded — their values enter the env from the bound
+    arg/aux arrays); ``head_names`` lists the env name of every output in
+    ``Symbol.list_outputs()`` order.
+    """
+    from ..symbol.symbol import _sym_out_name
+
+    plan = []
+    for node in symbol._walk():
+        if node.is_var:
+            continue
+        plan.append((node, [_sym_out_name(i) for i in node.inputs]))
+    head_names = []
+    for node, idx in symbol._outputs_of():
+        base = node._base() if node.out_index is not None else node
+        head_names.append(_sym_out_name(node) if node.is_var else (
+            "%s_output%d" % (base.name, idx) if base.num_outputs > 1
+            else "%s_output" % base.name))
+    return plan, head_names
+
+
+class Graph:
+    """Immutable pass-layer value: ``entries`` (topo-ordered
+    ``(node, in_names)`` pairs), ``heads`` (ordered output env names), and
+    ``constants`` (env name -> baked value, seeded before evaluation)."""
+
+    __slots__ = ("entries", "heads", "constants")
+
+    def __init__(self, entries, heads, constants=None):
+        self.entries = tuple((node, tuple(in_names))
+                             for node, in_names in entries)
+        self.heads = tuple(heads)
+        self.constants = dict(constants) if constants else {}
+
+    @property
+    def n_nodes(self):
+        return len(self.entries)
+
+    def __repr__(self):
+        return "Graph(%d nodes, %d heads, %d constants)" % (
+            len(self.entries), len(self.heads), len(self.constants))

@@ -1,0 +1,238 @@
+"""The standard pass pipeline (ISSUE 7) — four passes, registered in the
+order they run:
+
+1. ``constant_fold``    — nodes whose transitive inputs are all
+   attr-constants (zero-tensor-input ops like ``_zeros``/``_arange`` seed
+   the lattice) evaluate ONCE at plan time through the op registry and
+   become baked constants; XLA then sees a literal instead of re-tracing
+   the producing subgraph every bucket/signature.
+2. ``common_subexpr_merge`` — structural hash on (op identity, canonical
+   attrs, resolved input names, output arity); later duplicates redirect
+   their consumers (and heads) onto the first occurrence.  Stochastic nodes
+   are NEVER merged: each folds a distinct PRNG stream keyed by its node
+   name, and deduping them would silently correlate draws.  The duplicate
+   chain itself is left in place for the DCE sweep — redirect-then-sweep
+   keeps this pass a pure rename.
+3. ``inference_rewrite`` — ``is_train=False`` plans only: Dropout (identity
+   in eval mode) is deleted outright, and BatchNorm with frozen moving
+   stats is replaced by a synthesized scale+shift affine node computing the
+   *same expression sequence* as the eval BN branch (bit-identical outputs,
+   none of the train-path machinery traced).
+4. ``dead_node_elim``    — reachability from heads (``get_internals``-style,
+   walked in reverse topological order); train-mode plans additionally root
+   every aux-updating node, since its moving-stat fold is a real side
+   effect even when no head consumes its outputs.  This is the sweep that
+   collects the branches the redirect passes orphaned.
+
+Every pass is a pure ``Graph -> Graph`` function over the immutable IR
+(``ir.Graph``); correctness-critical exclusions are centralized in the
+``_fold_ok`` / ``_cse_ok`` predicates below.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import register_pass
+from .ir import Graph, PlanNode, SynthOp, node_out_names
+
+# never bake a constant bigger than this — folding exists to shrink traced
+# graphs, not to bloat serialized executables with giant literals
+_FOLD_MAX_BYTES = 64 << 20
+
+# op families the passes must not touch: arbitrary user Python (may be
+# impure), and native-backed ops
+_OPAQUE_OPS = ("Custom",)
+
+
+def _opaque(op):
+    return op.name in _OPAQUE_OPS or op.name.startswith("_native")
+
+
+def _fold_ok(node):
+    """A node may be folded iff its value is a pure function of its attrs
+    and inputs in BOTH modes: no PRNG stream (``key``), no train/eval
+    branch (``training``), no aux state, no in-place mutation contract."""
+    op = node.op
+    if "key" in op.attr_names or "training" in op.attr_names:
+        return False
+    if op.aux or op.aux_update is not None or op.mutates:
+        return False
+    return not _opaque(op)
+
+
+def _cse_ok(node, is_train):
+    """A node may be merged with a structural twin iff the two are
+    observationally identical: stochastic ops fold distinct per-name PRNG
+    keys (never equal), and in train mode an aux-updating node's moving-stat
+    fold must run once per NODE, not once per equivalence class."""
+    op = node.op
+    if "key" in op.attr_names or op.mutates:
+        return False
+    if is_train and (op.aux or op.aux_update is not None):
+        return False
+    return not _opaque(op)
+
+
+def _canon(v):
+    if isinstance(v, np.ndarray):
+        return _canon(v.tolist())
+    if isinstance(v, (list, tuple)):
+        return tuple(_canon(e) for e in v)
+    return v
+
+
+def _attr_sig(attrs):
+    """Canonical, order-independent attr signature (raises for attr values
+    without a stable repr — the caller skips such nodes)."""
+    return repr(sorted((k, repr(_canon(v))) for k, v in attrs.items()))
+
+
+def _eval_outs(node, args):
+    """Evaluate one node through the registry exactly as
+    ``Executor._graph_fn`` would (including the hidden-output trim)."""
+    res = node.op.fn(*args, **dict(node.attrs))
+    outs = res if isinstance(res, tuple) else (res,)
+    if len(outs) > 1 and node.num_outputs == 1:
+        outs = outs[:1]
+    return outs
+
+
+@register_pass("constant_fold", version=1)
+def constant_fold(graph, is_train):
+    const = dict(graph.constants)
+    kept = []
+    for node, in_names in graph.entries:
+        if not (_fold_ok(node) and all(n in const for n in in_names)):
+            kept.append((node, in_names))
+            continue
+        try:
+            outs = _eval_outs(node, [const[n] for n in in_names])
+            nbytes = sum(int(getattr(o, "nbytes", _FOLD_MAX_BYTES + 1))
+                         for o in outs)
+        except Exception:
+            kept.append((node, in_names))
+            continue
+        if nbytes > _FOLD_MAX_BYTES or len(outs) < node.num_outputs:
+            kept.append((node, in_names))
+            continue
+        for nm, v in zip(node_out_names(node), outs):
+            const[nm] = v
+    if len(kept) == len(graph.entries):
+        return graph
+    return Graph(kept, graph.heads, const)
+
+
+@register_pass("common_subexpr_merge", version=1)
+def common_subexpr_merge(graph, is_train):
+    rename = {}
+    seen = {}
+    entries = []
+    for node, in_names in graph.entries:
+        in_names = tuple(rename.get(n, n) for n in in_names)
+        entries.append((node, in_names))
+        if not _cse_ok(node, is_train):
+            continue
+        try:
+            sig = (id(node.op), node.num_outputs, in_names,
+                   _attr_sig(node.attrs))
+        except Exception:
+            continue
+        rep = seen.get(sig)
+        if rep is None:
+            seen[sig] = node
+        else:  # later twin: consumers re-point at the representative
+            for mine, theirs in zip(node_out_names(node),
+                                    node_out_names(rep)):
+                rename[mine] = theirs
+    if not rename:
+        return graph
+    return Graph(entries, (rename.get(h, h) for h in graph.heads),
+                 graph.constants)
+
+
+def _bn_affine_fn(data, gamma, beta, moving_mean, moving_var, *,
+                  eps, fix_gamma, axis):
+    """Frozen-stats BatchNorm as a per-channel affine — the eval branch of
+    ``ops.nn.batch_norm`` verbatim (same expression sequence, so outputs
+    are bit-identical), with the train branch and hidden (mean, var)
+    outputs never entering the trace."""
+    import jax.numpy as jnp
+
+    ax = axis % data.ndim
+    bshape = tuple(data.shape[ax] if i == ax else 1
+                   for i in range(data.ndim))
+    mean, var = moving_mean, moving_var
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    scale = (g / jnp.sqrt(var + eps)).astype(data.dtype).reshape(bshape)
+    shift = (beta - mean * g / jnp.sqrt(var + eps)).astype(
+        data.dtype).reshape(bshape)
+    return data * scale + shift
+
+
+_BN_AFFINE_OP = SynthOp("_bn_affine", _bn_affine_fn,
+                        attr_names=("eps", "fix_gamma", "axis"))
+
+
+def _attr_of(node, key):
+    return node.attrs.get(key, node.op.defaults.get(key))
+
+
+@register_pass("inference_rewrite", version=1)
+def inference_rewrite(graph, is_train):
+    if is_train:
+        return graph
+    rename = {}
+    entries = []
+    changed = False
+    for node, in_names in graph.entries:
+        in_names = tuple(rename.get(n, n) for n in in_names)
+        opname = getattr(node.op, "name", "")
+        explicit_train = bool(node.attrs.get("training"))
+        if (opname == "Dropout" and node.num_outputs == 1 and in_names
+                and not explicit_train
+                and _attr_of(node, "mode") != "always"):
+            # eval-mode dropout is the identity: delete the node, re-point
+            # its consumers (and any head) straight at its data input
+            rename["%s_output" % node.name] = in_names[0]
+            changed = True
+            continue
+        if (opname == "BatchNorm" and node.num_outputs == 1
+                and len(in_names) == 5 and not explicit_train
+                and not node.attrs.get("output_mean_var")):
+            new = PlanNode(
+                _BN_AFFINE_OP,
+                {"eps": _attr_of(node, "eps"),
+                 "fix_gamma": _attr_of(node, "fix_gamma"),
+                 "axis": _attr_of(node, "axis")},
+                node.name)  # same name -> same output env name, heads keep
+            entries.append((new, in_names))
+            changed = True
+            continue
+        entries.append((node, in_names))
+    if not changed:
+        return graph
+    return Graph(entries, (rename.get(h, h) for h in graph.heads),
+                 graph.constants)
+
+
+@register_pass("dead_node_elim", version=1)
+def dead_node_elim(graph, is_train):
+    entries = list(graph.entries)
+    needed = set(graph.heads)
+    keep = [False] * len(entries)
+    for i in range(len(entries) - 1, -1, -1):
+        node, in_names = entries[i]
+        live = any(nm in needed for nm in node_out_names(node))
+        if is_train and node.op.aux_update is not None and node.op.aux:
+            live = True  # moving-stat fold is a side effect heads can't see
+        if live:
+            keep[i] = True
+            needed.update(in_names)
+    if all(keep):
+        return graph
+    kept = [e for e, k in zip(entries, keep) if k]
+    used = set(graph.heads)
+    for _, in_names in kept:
+        used.update(in_names)
+    return Graph(kept, graph.heads,
+                 {k: v for k, v in graph.constants.items() if k in used})

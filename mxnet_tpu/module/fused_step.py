@@ -248,6 +248,12 @@ class FusedStepper:
         self._nancheck = env_flag("MXNET_NANCHECK")
         self._mesh = module._mesh
         self._zero = self._mesh is not None and fused_zero_enabled()
+        # the executor's bind-time graph-pass snapshot (ISSUE 7): the
+        # stepper's step fn closes over the (possibly pass-optimized) train
+        # plan, so the snapshot is program identity — it keys the AOT cache
+        # entry and, via stale(), forces a rebuild when a re-bind (reshape)
+        # lands on an executor with a different snapshot
+        self._passes_on = exec_._graph_passes
         # persistent AOT executable cache (compile_cache.py, ISSUE 6): the
         # logical key is everything folded into the compiled step besides
         # argument shapes (those join at prepare time) and the environment
@@ -361,7 +367,7 @@ class FusedStepper:
             self._jit = compile_cache.CachedFunction(
                 self._jit, self._aot_key, name="fused_step",
                 mesh_desc=compile_cache.mesh_descriptor(self._mesh),
-                donated=True)
+                donated=True, passes_on=self._passes_on)
         # compile/steady-state accounting (identity when telemetry is off)
         self._step = telemetry.instrument_step(self._jit,
                                                name="module_fused_step")
@@ -380,7 +386,11 @@ class FusedStepper:
                 or _hp_signature(module._optimizer) != self._hp_sig
                 or env_flag("MXNET_NANCHECK") != self._nancheck
                 or (module._mesh is not None
-                    and fused_zero_enabled() != self._zero))
+                    and fused_zero_enabled() != self._zero)
+                # a re-bind whose executor snapshotted a different
+                # MXNET_GRAPH_PASSES state: the cached step fn closes over
+                # the other plan flavor — rebuild instead of mixing
+                or module._exec._graph_passes != self._passes_on)
 
     def check_nonfinite(self):
         """Raise if the PREVIOUS step's folded isfinite flag tripped.

@@ -184,6 +184,13 @@ class Predictor:
         handle = self.aot_lower()
         return None if handle is None else self.aot_finalize(handle)
 
+    def pass_stats(self):
+        """Graph-pass results for this predictor's lowered plans
+        (``{"eval": {...nodes_pre/nodes_post/seconds...}}`` once the first
+        forward — or AOT lower — has run; empty with ``MXNET_GRAPH_PASSES``
+        off).  The serving warmup report surfaces these per bucket."""
+        return self._exec.pass_stats()
+
     def with_shapes(self, input_shapes):
         """A sibling Predictor specialized to ``input_shapes``, sharing this
         one's symbol and loaded params — the cheap path for holding MANY

@@ -517,12 +517,18 @@ class Engine:
             # and serve_compiles_total count actual compiles only, so a warm
             # restart reports 0 (the restore shows up as warmup cache_hits)
             self._note_compile(bucket, dt)
+        # graph-pass result for this bucket's inference plan (ISSUE 7):
+        # nodes captured vs nodes compiled — None when MXNET_GRAPH_PASSES
+        # is off (the predictor lowered the raw plan)
+        ps = pred.pass_stats().get("eval")
         return {"bucket": repr(bucket), "fresh": fresh,
                 "compile_s": round(dt, 4) if fresh else 0.0,
                 "lower_s": round(lower_s, 4),
                 # pure XLA backend-compile seconds (0 on a disk restore —
                 # wall-clock rows above include bind + zeros forward)
-                "aot_compile_s": round(aot_compile_s, 4), "cache": cache}
+                "aot_compile_s": round(aot_compile_s, 4), "cache": cache,
+                "graph_nodes_pre": ps["nodes_pre"] if ps else None,
+                "graph_nodes_post": ps["nodes_post"] if ps else None}
 
     def _note_warmup(self, report, total_s):
         """Record the warmup pass for ``stats()["warmup"]`` (always on, so

@@ -453,14 +453,27 @@ class Symbol:
 
     def debug_str(self):
         lines = []
+        n_ops = 0
         for node in self._walk():
             if node.is_var:
                 lines.append("Variable:%s" % node.name)
             else:
+                n_ops += 1
                 lines.append(
                     "Op:%s, Name=%s\nInputs:\n\t%s"
                     % (node.op.name, node.name, "\n\t".join(i.name for i in node.inputs))
                 )
+        # summary footer: what was captured vs what actually compiles after
+        # the graph-pass pipeline (ISSUE 7) — the two counts diverge once
+        # passes fold/merge/drop nodes, and a printed summary must say so
+        from ..graph_passes import node_counts
+
+        counts = node_counts(self, is_train=False)
+        if counts is not None and counts[1] != counts[0]:
+            lines.append("Total ops: %d captured, %d after graph passes "
+                         "(eval plan)" % counts)
+        else:
+            lines.append("Total ops: %d captured" % n_ops)
         return "\n".join(lines)
 
 

@@ -23,8 +23,9 @@ from .instrument import (ServeProbe, StepProbe, add_sink, array_nbytes,
                          instrument_step, interval_s, jsonl_path,
                          note_aot_cache, note_bytes,
                          note_compile, note_dispatch, note_fused_fallback,
-                         note_nonfinite, note_train_step, registry,
-                         sample_memory, serve_probe, step_probe, summary)
+                         note_graph_passes, note_nonfinite, note_train_step,
+                         registry, sample_memory, serve_probe, step_probe,
+                         summary)
 
 __all__ = [
     "tracing",
@@ -35,7 +36,7 @@ __all__ = [
     "ServeProbe", "StepProbe", "add_sink", "array_nbytes", "counter",
     "enabled", "event", "flush", "gauge", "histogram", "instrument_step",
     "interval_s", "jsonl_path", "note_aot_cache", "note_bytes", "note_compile",
-    "note_dispatch", "note_fused_fallback", "note_nonfinite",
-    "note_train_step", "registry", "sample_memory", "serve_probe",
-    "step_probe", "summary",
+    "note_dispatch", "note_fused_fallback", "note_graph_passes",
+    "note_nonfinite", "note_train_step", "registry", "sample_memory",
+    "serve_probe", "step_probe", "summary",
 ]

@@ -268,6 +268,28 @@ def note_aot_cache(kind, reason=None, tier="exec"):
                   ("tier",)).inc(tier=tier)
 
 
+def note_graph_passes(nodes_pre, nodes_post, seconds, mode="eval"):
+    """Record one graph-pass pipeline run over an executor plan (ISSUE 7,
+    ``Executor._opt_plan``).  Counters accumulate across executors — the
+    serving ladder runs the pipeline once per bucket — and the bench
+    telemetry block reports the totals as ``graph_nodes_pre`` /
+    ``graph_nodes_post`` / ``pass_time_s``."""
+    if not enabled():
+        return
+    r = registry()
+    r.counter("graph_nodes_pre_total",
+              "captured plan nodes entering the graph-pass pipeline",
+              ("mode",)).inc(int(nodes_pre), mode=mode)
+    r.counter("graph_nodes_post_total",
+              "plan nodes remaining after the graph-pass pipeline",
+              ("mode",)).inc(int(nodes_post), mode=mode)
+    r.counter("graph_pass_seconds_total",
+              "wall seconds spent running graph passes",
+              ("mode",)).inc(float(seconds), mode=mode)
+    r.event("graph_passes", mode=mode, nodes_pre=int(nodes_pre),
+            nodes_post=int(nodes_post), seconds=round(float(seconds), 6))
+
+
 def note_bytes(counter_name, nbytes, **labels):
     """Accumulate a bytes-moved counter (kvstore push/pull, collectives)."""
     if not enabled() or nbytes <= 0:
@@ -500,8 +522,17 @@ def summary():
     # warmup_s (ISSUE 6 restart benchmark surface): total engine warmup
     # wall-clock this process paid — null when nothing warmed up
     warm = r.total("warmup_seconds_total", None)
+    # graph-pass surface (ISSUE 7): plan nodes in/out of the pipeline and
+    # the time it cost, summed over every executor plan this process
+    # lowered — null when no pipeline ran (passes off, or no symbolic bind)
+    gp_pre = r.total("graph_nodes_pre_total", None)
+    gp_post = r.total("graph_nodes_post_total", None)
+    gp_s = r.total("graph_pass_seconds_total", None)
     return {"compile_s": round(compile_s, 3),
             "peak_hbm_bytes": int(peak) if peak is not None else None,
             "data_wait_frac": round(frac, 4),
             "dispatches_per_step": round(disp / steps, 2) if steps else None,
-            "warmup_s": round(warm, 3) if warm is not None else None}
+            "warmup_s": round(warm, 3) if warm is not None else None,
+            "graph_nodes_pre": int(gp_pre) if gp_pre is not None else None,
+            "graph_nodes_post": int(gp_post) if gp_post is not None else None,
+            "pass_time_s": round(gp_s, 4) if gp_s is not None else None}

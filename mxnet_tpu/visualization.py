@@ -94,6 +94,15 @@ def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.
         print("_" * line_length)
 
     print("Total params: %d" % total_params)
+    # raw capture count vs what actually compiles after the graph-pass
+    # pipeline (ISSUE 7) — keeps the printed summary honest about the
+    # inference plan the Predictor/serving twin really lowers
+    from .graph_passes import node_counts
+
+    counts = node_counts(symbol, is_train=False)
+    if counts is not None and counts[1] != counts[0]:
+        print("Total ops: %d captured, %d after graph passes (eval plan)"
+              % counts)
     print("_" * line_length)
     return total_params
 

@@ -1,0 +1,357 @@
+"""Graph-pass layer tests (ISSUE 7, mxnet_tpu/graph_passes/).
+
+The contract under test:
+
+* parity — representative symbols (MLP, conv+BN, multi-output heads,
+  dropout/stochastic nodes, Group graphs) produce identical outputs,
+  gradients, and aux-state updates with passes on vs off, in both modes;
+* the inference rewrites fire (BatchNorm -> affine, Dropout deleted) on
+  eval plans only;
+* stochastic nodes are NEVER deduped — each keeps its own PRNG stream;
+* ``MXNET_GRAPH_PASSES=0`` lowers the raw captured plan untouched and
+  produces pre-pass AOT cache keys, byte-identical;
+* pass results surface in ``pass_stats``, the telemetry summary block, and
+  ``debug_str``.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def _fill_params(exe, seed=1):
+    prng = np.random.RandomState(seed)
+    for n, arr in exe.arg_dict.items():
+        if n != "data" and not n.endswith("_label"):
+            arr[:] = (prng.rand(*arr.shape).astype(np.float32) - 0.5) * 0.2
+
+
+def _run_both(symbol, feeds, monkeypatch, train, grad_wrt=(), seed=7,
+              label=None):
+    """Bind + forward (+ backward) under each gate value.
+    -> {gate: (outputs, grads, aux, exe)} with identical inputs/params/RNG."""
+    results = {}
+    for gate in ("0", "1"):
+        monkeypatch.setenv("MXNET_GRAPH_PASSES", gate)
+        mx.random.seed(seed)
+        shapes = {k: v.shape for k, v in feeds.items()}
+        exe = symbol.simple_bind(grad_req="write" if grad_wrt else "null",
+                                 **shapes)
+        _fill_params(exe)
+        for k, v in feeds.items():
+            exe.arg_dict[k][:] = v
+        if label is not None:
+            exe.arg_dict[label[0]][:] = label[1]
+        outs = [o.asnumpy() for o in exe.forward(is_train=train)]
+        grads = {}
+        if grad_wrt:
+            exe.backward()
+            grads = {n: exe.grad_dict[n].asnumpy() for n in grad_wrt}
+        aux = {k: v.asnumpy() for k, v in exe.aux_dict.items()}
+        results[gate] = (outs, grads, aux, exe)
+    return results
+
+
+def _assert_parity(results, exact=True):
+    o0, g0, a0, _ = results["0"]
+    o1, g1, a1, _ = results["1"]
+    cmp = (np.array_equal if exact
+           else lambda a, b: np.allclose(a, b, rtol=1e-5, atol=1e-6))
+    for i, (x, y) in enumerate(zip(o0, o1)):
+        assert cmp(x, y), "output %d diverged (max %g)" % (
+            i, np.abs(x - y).max())
+    assert g0.keys() == g1.keys()
+    for n in g0:
+        assert cmp(g0[n], g1[n]), "grad %s diverged (max %g)" % (
+            n, np.abs(g0[n] - g1[n]).max())
+    assert a0.keys() == a1.keys()
+    for n in a0:
+        assert cmp(a0[n], a1[n]), "aux %s diverged" % n
+
+
+def _plan_ops(exe, train):
+    plan, _, _ = exe._opt_plan(train)
+    return [n.op.name for n, _ in plan]
+
+
+# -- parity sweep -------------------------------------------------------------
+
+def _mlp():
+    data = sym.var("data")
+    h = sym.Activation(sym.FullyConnected(data, name="fc1", num_hidden=16),
+                       name="a1", act_type="relu")
+    return sym.SoftmaxOutput(
+        sym.FullyConnected(h, name="fc2", num_hidden=4), name="softmax")
+
+
+@pytest.mark.parametrize("train", [False, True])
+def test_mlp_parity(monkeypatch, train):
+    rng = np.random.RandomState(0)
+    feeds = {"data": rng.rand(3, 8).astype(np.float32)}
+    label = ("softmax_label", np.array([0.0, 1.0, 2.0], np.float32))
+    res = _run_both(_mlp(), feeds, monkeypatch, train,
+                    grad_wrt=("fc1_weight", "fc2_bias") if train else (),
+                    label=label)
+    _assert_parity(res, exact=True)
+
+
+def _conv_bn(dropout=False):
+    data = sym.var("data")
+    c = sym.Convolution(data, name="conv", kernel=(3, 3), num_filter=4,
+                        pad=(1, 1))
+    b = sym.BatchNorm(c, name="bn", fix_gamma=False, momentum=0.8)
+    h = sym.Activation(b, name="act", act_type="relu")
+    if dropout:
+        h = sym.Dropout(h, name="drop", p=0.5)
+    f = sym.FullyConnected(sym.Flatten(h, name="fl"), name="fc",
+                           num_hidden=3)
+    return sym.SoftmaxOutput(f, name="softmax")
+
+
+@pytest.mark.parametrize("train", [False, True])
+def test_conv_bn_parity_and_aux(monkeypatch, train):
+    rng = np.random.RandomState(1)
+    feeds = {"data": rng.rand(2, 3, 6, 6).astype(np.float32)}
+    label = ("softmax_label", np.array([0.0, 1.0], np.float32))
+    res = _run_both(_conv_bn(), feeds, monkeypatch, train,
+                    grad_wrt=("conv_weight",) if train else (), label=label)
+    # train mode must update moving_mean/moving_var identically on both
+    # gates (aux compared inside _assert_parity); the inference BN-affine
+    # rewrite is allclose by contract (identical expression sequence makes
+    # it exact in practice, but the contract is the looser one)
+    _assert_parity(res, exact=train)
+    if train:
+        aux = res["1"][2]
+        assert not np.allclose(aux["bn_moving_mean"], 0.0), \
+            "train forward should have updated BN moving stats"
+
+
+def test_bn_affine_rewrite_fires_eval_only(monkeypatch):
+    rng = np.random.RandomState(2)
+    feeds = {"data": rng.rand(2, 3, 6, 6).astype(np.float32)}
+    label = ("softmax_label", np.zeros(2, np.float32))
+    res = _run_both(_conv_bn(dropout=True), feeds, monkeypatch, train=False,
+                    label=label)
+    exe = res["1"][3]
+    eval_ops = _plan_ops(exe, False)
+    assert "_bn_affine" in eval_ops and "BatchNorm" not in eval_ops
+    assert "Dropout" not in eval_ops
+    # the raw captured plan still carries both
+    raw = [n.op.name for n, _ in exe._plan]
+    assert "BatchNorm" in raw and "Dropout" in raw
+    _assert_parity(res, exact=False)
+    # train plan keeps the real BatchNorm (aux updates are a side effect)
+    assert "BatchNorm" in _plan_ops(exe, True)
+    assert "_bn_affine" not in _plan_ops(exe, True)
+
+
+def test_multi_output_heads_group_parity(monkeypatch):
+    data = sym.var("data")
+    sl = sym.SliceChannel(data, name="sl", num_outputs=2, axis=1)
+    a = sym.exp(sl[0], name="e")
+    b = sym.sqrt(sl[1] + 1.0, name="s")
+    g = sym.Group([a, b, sl[1]])
+    rng = np.random.RandomState(3)
+    feeds = {"data": rng.rand(2, 4).astype(np.float32)}
+    res = _run_both(g, feeds, monkeypatch, train=False)
+    _assert_parity(res, exact=True)
+    assert len(res["1"][0]) == 3
+
+
+@pytest.mark.parametrize("train", [False, True])
+def test_dropout_stream_parity(monkeypatch, train):
+    """Dropout masks must be identical with passes on/off (per-node-name
+    PRNG folding survives the pipeline untouched)."""
+    data = sym.var("data")
+    d = sym.Dropout(data, name="d1", p=0.5)
+    out = sym.FullyConnected(d, name="fc", num_hidden=4)
+    rng = np.random.RandomState(4)
+    feeds = {"data": rng.rand(8, 8).astype(np.float32)}
+    res = _run_both(out, feeds, monkeypatch, train,
+                    grad_wrt=("fc_weight",) if train else ())
+    _assert_parity(res, exact=True)
+
+
+# -- individual passes --------------------------------------------------------
+
+def test_cse_merges_and_dce_sweeps(monkeypatch):
+    monkeypatch.setenv("MXNET_GRAPH_PASSES", "1")
+    data = sym.var("data")
+    out = sym.sqrt(sym.exp(data)) * sym.sqrt(sym.exp(data))
+    exe = out.bind(None, {"data": nd.array(np.ones((2, 2), np.float32))})
+    assert len(exe._plan) == 5
+    plan, heads, _ = exe._opt_plan(False)
+    assert len(plan) == 3, [n.name for n, _ in plan]
+    r = exe.forward()[0].asnumpy()
+    assert np.allclose(r, np.e), r
+
+
+def test_cse_never_merges_stochastic(monkeypatch):
+    """Two structurally identical Dropout nodes fold DISTINCT PRNG keys —
+    the pass layer must keep both, and their masks must differ."""
+    monkeypatch.setenv("MXNET_GRAPH_PASSES", "1")
+    data = sym.var("data")
+    d1 = sym.Dropout(data, name="da", p=0.5)
+    d2 = sym.Dropout(data, name="db", p=0.5)
+    g = sym.Group([d1, d2])
+    exe = g.bind(None, {"data": nd.array(np.ones((64, 64), np.float32))})
+    mx.random.seed(0)
+    o1, o2 = [o.asnumpy() for o in exe.forward(is_train=True)]
+    ops = _plan_ops(exe, True)
+    assert ops.count("Dropout") == 2
+    assert not np.array_equal(o1, o2), \
+        "stochastic nodes got merged: identical dropout masks"
+
+
+def test_constant_fold_bakes_zero_input_subgraph(monkeypatch):
+    monkeypatch.setenv("MXNET_GRAPH_PASSES", "1")
+    data = sym.var("data")
+    const = sym.arange(0, 4, name="ar") + sym.ones((4,), name="on")
+    out = data + const
+    exe = out.bind(None, {"data": nd.array(np.zeros((2, 4), np.float32))})
+    plan, _, const_env = exe._opt_plan(False)
+    # arange, ones, and their add all fold; only the data add remains
+    assert len(plan) == 1 and const_env
+    r = exe.forward()[0].asnumpy()
+    assert np.allclose(r, np.arange(4, dtype=np.float32) + 1.0)
+
+
+def test_dead_aux_node_kept_in_train_mode(monkeypatch):
+    """An aux-updating node must survive DCE in train plans even when no
+    head consumes it: its moving-stat fold is a real side effect."""
+    from mxnet_tpu import graph_passes
+
+    monkeypatch.setenv("MXNET_GRAPH_PASSES", "1")
+    data = sym.var("data")
+    b = sym.BatchNorm(sym.Convolution(data, name="conv", kernel=(1, 1),
+                                      num_filter=2), name="bn")
+    plan, heads = graph_passes.capture(b)
+    # simulate a dead BN by pointing heads elsewhere (conv output)
+    g, _ = graph_passes.optimize(plan, ["conv_output"], is_train=True)
+    assert any(n.op.name == "BatchNorm" for n, _ in g.entries)
+    g, _ = graph_passes.optimize(plan, ["conv_output"], is_train=False)
+    assert not any(n.op.name == "BatchNorm" for n, _ in g.entries)
+
+
+# -- gate / cache-key / surfaces ---------------------------------------------
+
+def test_gate_off_raw_plan_and_prepass_cache_key(monkeypatch):
+    from mxnet_tpu import compile_cache, graph_passes
+
+    monkeypatch.setenv("MXNET_GRAPH_PASSES", "0")
+    out = _mlp()
+    exe = out.simple_bind(data=(2, 8))
+    plan, heads, const_env = exe._opt_plan(False)
+    assert plan is exe._plan and heads is exe._head_names \
+        and const_env is None
+    assert exe.pass_stats() == {}
+    # pre-pass-era logical key, byte for byte
+    monkeypatch.setenv("MXNET_AOT_CACHE", "")
+    assert graph_passes.pipeline_fingerprint() is None
+    key_parts = ("executor_fwd", "abc", False)
+    f = compile_cache.CachedFunction(lambda x: x, key_parts,
+                                     name="executor_fwd")
+    assert f._key == repr(key_parts)
+
+
+def test_gate_on_key_carries_pipeline_fingerprint(monkeypatch):
+    from mxnet_tpu import compile_cache, graph_passes
+
+    monkeypatch.setenv("MXNET_GRAPH_PASSES", "1")
+    monkeypatch.setenv("MXNET_AOT_CACHE", "")
+    fp = graph_passes.pipeline_fingerprint()
+    assert fp and "common_subexpr_merge:1" in fp
+    f = compile_cache.CachedFunction(lambda x: x, ("executor_fwd", "abc"),
+                                     name="executor_fwd")
+    assert f._key == repr((("executor_fwd", "abc")
+                           + (("graph_passes", fp),)))
+    # explicit snapshot wins over the live gate
+    monkeypatch.setenv("MXNET_GRAPH_PASSES", "0")
+    f2 = compile_cache.CachedFunction(lambda x: x, ("k",), passes_on=True)
+    assert "graph_passes" in f2._key
+
+
+def test_env_fingerprint_carries_pipeline(monkeypatch):
+    from mxnet_tpu import compile_cache, graph_passes
+
+    monkeypatch.setenv("MXNET_GRAPH_PASSES", "1")
+    env = compile_cache._env_fingerprint()
+    assert env["passes"] == graph_passes.pipeline_fingerprint()
+    monkeypatch.setenv("MXNET_GRAPH_PASSES", "0")
+    assert compile_cache._env_fingerprint()["passes"] is None
+
+
+def test_telemetry_summary_graph_keys(monkeypatch, tmp_path):
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.telemetry import instrument as tin
+
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    monkeypatch.setenv("MXNET_TELEMETRY_FILE", str(tmp_path / "t.jsonl"))
+    monkeypatch.setenv("MXNET_GRAPH_PASSES", "1")
+    tin._reset_for_tests()
+    try:
+        s = telemetry.summary()
+        assert s["graph_nodes_pre"] is None and s["pass_time_s"] is None
+        exe = _conv_bn(dropout=True).simple_bind(data=(2, 3, 6, 6))
+        exe.forward(is_train=False)
+        s = telemetry.summary()
+        assert s["graph_nodes_pre"] == 7
+        assert s["graph_nodes_post"] == 6  # dropout left the eval plan
+        assert s["pass_time_s"] >= 0
+    finally:
+        tin._reset_for_tests()
+
+
+def test_debug_str_and_print_summary_report_counts(monkeypatch, capsys):
+    monkeypatch.setenv("MXNET_GRAPH_PASSES", "1")
+    data = sym.var("data")
+    out = sym.Dropout(sym.exp(data, name="e"), name="d", p=0.5)
+    s = out.debug_str()
+    assert "Total ops: 2 captured, 1 after graph passes (eval plan)" in s
+    from mxnet_tpu import visualization
+
+    visualization.print_summary(out, shape={"data": (2, 4)})
+    printed = capsys.readouterr().out
+    assert "2 captured, 1 after graph passes" in printed
+    monkeypatch.setenv("MXNET_GRAPH_PASSES", "0")
+    assert "after graph passes" not in out.debug_str()
+    assert "Total ops: 2 captured" in out.debug_str()
+
+
+def test_monitor_sees_raw_plan(monkeypatch):
+    """The monitor debug path reports every captured node even when the
+    compiled path lowers the optimized plan."""
+    monkeypatch.setenv("MXNET_GRAPH_PASSES", "1")
+    data = sym.var("data")
+    out = sym.Dropout(sym.exp(data, name="e"), name="d", p=0.5)
+    exe = out.bind(None, {"data": nd.array(np.ones((2, 2), np.float32))})
+    seen = []
+    exe.set_monitor_callback(lambda name, arr: seen.append(name))
+    exe.forward(is_train=False)
+    assert "d_output" in seen and "e_output" in seen
+
+
+def test_predictor_pass_stats_and_reshape(monkeypatch):
+    monkeypatch.setenv("MXNET_GRAPH_PASSES", "1")
+    from mxnet_tpu.predictor import Predictor
+
+    s = _conv_bn(dropout=True)
+    arg_shapes, _, aux_shapes = s.infer_shape(data=(2, 3, 6, 6),
+                                              softmax_label=(2,))
+    rng = np.random.RandomState(5)
+    params = {}
+    for n, sh in zip(s.list_arguments(), arg_shapes):
+        if n not in ("data", "softmax_label"):
+            params[n] = nd.array(rng.rand(*sh).astype(np.float32) * 0.1)
+    for n, sh in zip(s.list_auxiliary_states(), aux_shapes):
+        params["aux:" + n] = nd.array(
+            np.ones(sh, np.float32) if n.endswith("_var")
+            else np.zeros(sh, np.float32))
+    pred = Predictor(s, params, {"data": (2, 3, 6, 6),
+                                 "softmax_label": (2,)})
+    assert pred.pass_stats() == {}  # nothing lowered yet
+    pred.forward(data=rng.rand(2, 3, 6, 6).astype(np.float32),
+                 softmax_label=np.zeros(2, np.float32))
+    st = pred.pass_stats()["eval"]
+    assert st["nodes_post"] == st["nodes_pre"] - 1  # dropout dropped

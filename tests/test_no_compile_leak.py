@@ -124,6 +124,42 @@ def test_fused_module_step_compiles_once_per_shape(monkeypatch, tmp_path):
         tin._reset_for_tests()
 
 
+@pytest.mark.parametrize("passes", ["0", "1"])
+def test_graph_passes_add_zero_retraces(monkeypatch, passes):
+    """ISSUE 7: the pass pipeline runs once per (executor, mode) and its
+    result is cached, so repeated forwards/backwards retrace exactly as
+    often as the pre-pass executor did — once per mode, per shape."""
+    monkeypatch.setenv("MXNET_GRAPH_PASSES", passes)
+    data = mx.sym.var("data")
+    h = mx.sym.Dropout(
+        mx.sym.Activation(mx.sym.FullyConnected(data, name="fc1",
+                                                num_hidden=8),
+                          name="a1", act_type="relu"), name="dr", p=0.5)
+    s = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, name="fc2", num_hidden=4), name="softmax")
+    exe = s.simple_bind(data=(4, 8), grad_req="write")
+    x = np.random.RandomState(0).rand(4, 8).astype(np.float32)
+    exe.arg_dict["data"][:] = x
+    for train in (False, True):
+        for _ in range(4):
+            exe.forward(is_train=train)
+            if train:
+                exe.backward()
+    # one jitted executable per mode, one backward jit per cache key —
+    # identical to the pre-pass counts (the jit wrapper caches per shape
+    # signature; the optimized plan is a stable per-mode object)
+    assert exe._fwd_cache[False]._cache_size() == 1
+    assert exe._fwd_cache[True]._cache_size() == 1
+    assert len(exe._bwd_cache) == 1
+    for fn in exe._bwd_cache.values():
+        assert fn._cache_size() == 1
+    # the pipeline itself ran at most once per mode
+    if passes == "1":
+        assert set(exe.pass_stats()) == {"train", "eval"}
+    else:
+        assert exe.pass_stats() == {}
+
+
 def test_mesh_fused_module_step_compiles_once_per_shape(monkeypatch, tmp_path):
     """ISSUE 5: the SHARDED fused Module step (mesh path) also compiles
     exactly once per shape signature, and a reshape to a new batch shape

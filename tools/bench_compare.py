@@ -53,7 +53,9 @@ def load_bench(path, obj):
             "dispatches_per_step": tel.get("dispatches_per_step"),
             "compile_s": tel.get("compile_s"),
             "data_wait_frac": tel.get("data_wait_frac"),
-            "warmup_s": tel.get("warmup_s")}
+            "warmup_s": tel.get("warmup_s"),
+            "graph_nodes_pre": tel.get("graph_nodes_pre"),
+            "graph_nodes_post": tel.get("graph_nodes_post")}
 
 
 # multichip dryrun phases, as printed by __graft_entry__.dryrun_multichip —
@@ -148,9 +150,14 @@ def compare(rows, threshold):
         # configuration difference, not a regression
         dw = (_pct(r["warmup_s"], base["warmup_s"])
               if same and r is not base else None)
+        # graph-pass node counts (ISSUE 7): displayed, never gated — a
+        # capture with passes off (or predating them) against one with
+        # passes on is a configuration difference
+        dn = (_pct(r["graph_nodes_post"], base["graph_nodes_post"])
+              if same and r is not base else None)
         table.append(dict(r, same_metric=same, value_delta_pct=dv,
                           dps_delta_pct=dd, compile_delta_pct=dc,
-                          warmup_delta_pct=dw))
+                          warmup_delta_pct=dw, nodes_delta_pct=dn))
         if r is base or not same:
             continue
         if dv is not None and dv < -threshold:
@@ -169,9 +176,18 @@ def _fmt(v, spec="%.4g", dash="-"):
     return dash if v is None else spec % v
 
 
+def _fmt_nodes(r):
+    if r["graph_nodes_post"] is None:
+        return "-"
+    if r["graph_nodes_pre"] is None:
+        return "%d" % r["graph_nodes_post"]
+    return "%d→%d" % (r["graph_nodes_pre"], r["graph_nodes_post"])
+
+
 def render_table(table):
     cols = ["file", "metric", "value", "Δvalue%", "disp/step", "Δdisp%",
-            "compile_s", "Δcompile%", "warmup_s", "Δwarmup%", "wait_frac"]
+            "compile_s", "Δcompile%", "warmup_s", "Δwarmup%", "nodes",
+            "Δnodes%", "wait_frac"]
     out = [cols]
     for r in table:
         metric = r["metric"] + ("" if r["same_metric"] else " (≠ baseline)")
@@ -183,6 +199,8 @@ def render_table(table):
                     _fmt(r["compile_delta_pct"], "%+.1f"),
                     _fmt(r["warmup_s"], "%.3g"),
                     _fmt(r["warmup_delta_pct"], "%+.1f"),
+                    _fmt_nodes(r),
+                    _fmt(r["nodes_delta_pct"], "%+.1f"),
                     _fmt(r["data_wait_frac"], "%.3g")])
     widths = [max(len(row[i]) for row in out) for i in range(len(cols))]
     lines = []
