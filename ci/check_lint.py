@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""mxlint CI gate (ISSUE 8) — unit tier.
+
+Two directions, both must hold or this exits nonzero:
+
+1. **The repo is clean against its baseline**: ``tools/mxlint.py`` over
+   ``mxnet_tpu/`` with the committed ``ci/mxlint_baseline.txt`` must exit 0
+   — a new finding means either fix the code or add a baseline entry WITH a
+   justification (docs/ANALYSIS.md has the workflow).
+2. **The lint actually bites**: a seeded hazard file (one deliberate
+   instance of every rule) must make mxlint exit nonzero and name each
+   expected rule — guarding against the lint rotting into a rubber stamp.
+
+Run from ci/run_tests.sh unit tier::
+
+    python ci/check_lint.py
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MXLINT = os.path.join(REPO, "tools", "mxlint.py")
+
+# one deliberate instance of every rule; qualnames kept distinct so each
+# finding is attributable in the failure message
+SEEDED = '''\
+import time
+import numpy as np
+import jax
+
+
+@jax.jit
+def np_hazard(x):
+    return np.log(x)            # np-in-traced
+
+
+@jax.jit
+def coerce_hazard(x):
+    return float(x) + 1.0       # scalar-coerce-in-traced
+
+
+@jax.jit
+def branch_hazard(x):
+    if x:                       # branch-on-traced-param
+        return x
+    return -x
+
+
+@jax.jit
+def time_hazard(x):
+    return x + time.time()      # time-in-traced
+
+
+def swallow():
+    try:
+        return 1
+    except:                     # bare-except
+        return 0
+
+
+def build_step(fn):
+    return jax.jit(fn, donate_argnums=(0,))   # donated-jit-unkeyed
+'''
+
+EXPECT = ("np-in-traced", "scalar-coerce-in-traced", "branch-on-traced-param",
+          "time-in-traced", "bare-except", "donated-jit-unkeyed")
+
+
+def run(*args):
+    p = subprocess.run([sys.executable, MXLINT] + list(args),
+                       capture_output=True, text=True, cwd=REPO)
+    return p.returncode, p.stdout + p.stderr
+
+
+def main():
+    # 1. repo vs committed baseline
+    rc, out = run()
+    if rc != 0:
+        print(out)
+        print("check_lint: FAIL — mxlint found new hazards in mxnet_tpu/ "
+              "(fix them or baseline with a justification)")
+        return 1
+
+    # 2. seeded hazards must trip every rule
+    with tempfile.TemporaryDirectory() as td:
+        seeded = os.path.join(td, "seeded_hazards.py")
+        with open(seeded, "w") as fh:
+            fh.write(SEEDED)
+        rc, out = run(seeded, "--no-baseline")
+    if rc == 0:
+        print(out)
+        print("check_lint: FAIL — mxlint exited 0 on a file of seeded "
+              "hazards (the lint is not detecting anything)")
+        return 1
+    missing = [rule for rule in EXPECT if "[%s]" % rule not in out]
+    if missing:
+        print(out)
+        print("check_lint: FAIL — seeded hazards not detected: %s"
+              % ", ".join(missing))
+        return 1
+
+    print("check_lint: ok (repo clean vs baseline; all %d seeded rules "
+          "trip)" % len(EXPECT))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,6 +59,14 @@ case "$tier" in
     # constant subgraph must reduce to the hand-counted minimum node count
     # with forward parity against MXNET_GRAPH_PASSES=0
     ./dev.sh python ci/check_graph_passes.py
+    # source lint (ISSUE 8): mxlint over mxnet_tpu/ must be clean against
+    # the committed baseline, and a file of seeded hazards must trip every
+    # rule (new findings = nonzero exit; docs/ANALYSIS.md)
+    ./dev.sh python ci/check_lint.py
+    # lock-discipline smoke (ISSUE 8): concurrent serving burst under
+    # MXNET_LOCKCHECK=1 must record zero violations on the real engine,
+    # and the seeded inversion/unguarded-mutation must both be detected
+    ./dev.sh python ci/check_lockcheck.py
     # telemetry unit tests (tests/test_telemetry.py) run as part of tests/
     ignore=()
     for f in "${NIGHTLY_FILES[@]}"; do ignore+=(--ignore "$f"); done

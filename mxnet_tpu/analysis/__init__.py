@@ -1,0 +1,151 @@
+"""mxnet_tpu.analysis — static analysis & contract checking (ISSUE 8).
+
+Three cooperating layers, all off by default, each catching a bug class this
+repo has previously found only by stress-bisection:
+
+1. **Graph-IR analyzers** (``graph_analyzers.py``) — pure functions over the
+   ``graph_passes.ir.Graph`` execution plan, run through the analyzer
+   manager below (a mirror of the pass manager: registration order is run
+   order, (name, version) identity).  They check the *contracts the pass
+   pipeline must preserve*: distinct PRNG streams per stochastic node, no
+   live stochastic node in an eval plan, no shape/dtype drift between the
+   captured and the optimized plan (via ``jax.eval_shape`` — abstract, no
+   compile), no silently dead inputs/aux.  Surfaced as
+   ``Executor.check()`` / ``Predictor.check()`` (always available) and as
+   per-bucket warning counts in serving warmup report rows (gated on
+   ``MXNET_GRAPH_ANALYZERS``).
+2. **JAX-hazard source lint** (``source_lint.py``, CLI ``tools/mxlint.py``)
+   — AST lint over the codebase itself for host-sync/retrace hazards inside
+   traced functions, with a committed baseline so justified sites are
+   suppressed explicitly.
+3. **Lock-discipline checker** (``lockcheck.py``, ``MXNET_LOCKCHECK=1``) —
+   wraps the serving engine's mutexes, detects lock-order inversions and
+   unguarded mutation of lock-owned state, reports via
+   ``lockcheck_violations_total{kind}`` and raises under pytest.
+
+Relay/TVM ship their IRs with validity checks at every lowering layer
+(PAPERS.md 1810.00952, 1802.04799); this package is that layer for ours.
+"""
+from __future__ import annotations
+
+from ..base import env_flag
+from .diagnostics import Diagnostic, ERROR, INFO, WARNING, worst_severity
+
+__all__ = ["Diagnostic", "ERROR", "WARNING", "INFO", "worst_severity",
+           "enabled", "register_analyzer", "analyzer_pipeline", "analyze",
+           "GraphContext", "check_executor"]
+
+_ANALYZERS = []  # [(name, version, fn)] — registration order is run order
+
+
+def enabled():
+    """``MXNET_GRAPH_ANALYZERS`` gate (docs/ENV_VARS.md) — default OFF.
+
+    Gates only the *automatic* surfaces (serving warmup report rows); an
+    explicit ``Executor.check()`` / ``Predictor.check()`` call always runs,
+    calling it being opt-in by definition."""
+    return env_flag("MXNET_GRAPH_ANALYZERS")
+
+
+def register_analyzer(name, version=1):
+    """Register a pure ``fn(ctx) -> iterable[Diagnostic]`` graph analyzer.
+    Mirrors ``graph_passes.register_pass``: registration order is run order
+    and (name, version) is the analyzer's identity in reports."""
+    def _reg(fn):
+        _ANALYZERS.append((str(name), int(version), fn))
+        return fn
+    return _reg
+
+
+def analyzer_pipeline():
+    """The registered (name, version) analyzer list, in run order."""
+    return tuple((n, v) for n, v, _ in _ANALYZERS)
+
+
+def analyze(ctx):
+    """Run every registered analyzer over ``ctx`` -> sorted [Diagnostic]
+    (most severe first).  An analyzer that raises contributes one INFO
+    diagnostic instead of failing the whole check — ``check()`` must be
+    safe to call on any graph."""
+    out = []
+    for name, version, fn in _ANALYZERS:
+        try:
+            diags = list(fn(ctx))
+        except Exception as e:
+            diags = [Diagnostic("analyzer-failed", INFO,
+                                "analyzer %s:%d did not complete: %r"
+                                % (name, version, e))]
+        for d in diags:
+            if d.analyzer is None:
+                d.analyzer = name
+        out.extend(diags)
+    out.sort(key=Diagnostic._sort_key)
+    return out
+
+
+class GraphContext:
+    """Everything a graph analyzer may consult.
+
+    ``graph``     the plan the executor actually lowers (pass-optimized when
+                  ``MXNET_GRAPH_PASSES`` is on, raw otherwise);
+    ``raw``       the captured pre-pass plan (drift checks compare the two);
+    ``is_train``  the plan's mode;
+    ``arg_names`` / ``aux_names``  bound argument/aux order, or None when
+                  the context carries no executor;
+    ``arg_avals`` / ``aux_avals``  name -> ``jax.ShapeDtypeStruct`` for the
+                  bound arrays, or None when shapes are unknown — analyzers
+                  needing abstract evaluation skip silently without them.
+    """
+
+    __slots__ = ("graph", "raw", "is_train", "arg_names", "aux_names",
+                 "arg_avals", "aux_avals")
+
+    def __init__(self, graph, raw=None, is_train=False, arg_names=None,
+                 aux_names=None, arg_avals=None, aux_avals=None):
+        self.graph = graph
+        self.raw = raw if raw is not None else graph
+        self.is_train = bool(is_train)
+        self.arg_names = list(arg_names) if arg_names is not None else None
+        self.aux_names = list(aux_names) if aux_names is not None else None
+        self.arg_avals = arg_avals
+        self.aux_avals = aux_avals
+
+
+def _avals_of(dicts, names):
+    """name -> ShapeDtypeStruct for bound NDArrays; None if any is missing
+    (the shape analyzer then skips — never guesses)."""
+    import jax
+
+    out = {}
+    for n in names:
+        arr = dicts.get(n)
+        if arr is None:
+            return None
+        data = getattr(arr, "_data", arr)
+        out[n] = jax.ShapeDtypeStruct(tuple(data.shape), data.dtype)
+    return out
+
+
+def check_executor(exe, is_train=False):
+    """Build a :class:`GraphContext` from a bound Executor and run the
+    registered analyzers over the plan it lowers for ``is_train`` — the
+    implementation behind ``Executor.check()``/``Predictor.check()``."""
+    from ..graph_passes import Graph
+
+    plan, heads, const_env = exe._opt_plan(is_train)
+    # hand over the raw plan only when the pass pipeline actually produced
+    # a different one (gate off ⇒ _opt_plan returns exe._plan itself):
+    # the drift check can never fire on an identical plan, and skipping it
+    # halves the abstract-walk cost of check() on the off path
+    raw = None if plan is exe._plan else Graph(exe._plan, exe._head_names)
+    ctx = GraphContext(
+        Graph(plan, heads, const_env),
+        raw=raw,
+        is_train=is_train,
+        arg_names=exe._arg_names, aux_names=exe._aux_names,
+        arg_avals=_avals_of(exe.arg_dict, exe._arg_names),
+        aux_avals=_avals_of(exe.aux_dict, exe._aux_names))
+    return analyze(ctx)
+
+
+from . import graph_analyzers  # noqa: E402,F401  (registers the analyzers)

@@ -1,0 +1,196 @@
+"""Graph-IR analyzers (ISSUE 8, layer 1) — pure ``ctx -> [Diagnostic]``
+checks over the execution-plan IR, registered in run order:
+
+1. ``prng_safety``   — every stochastic node must fold a *distinct* PRNG
+   stream.  ``Executor._graph_fn`` keys each stream by the node's NAME
+   (``fold_in(key, crc32(name))``), so two stochastic nodes sharing a name
+   (or an identical explicit ``key`` attr) silently draw correlated — the
+   exact hazard ``common_subexpr_merge`` must never introduce.  Also flags
+   a stochastic node that stays LIVE in an eval plan (samples at inference:
+   ``mode="always"`` dropout, rrelu, ``random_*`` sources) — legitimate for
+   MC-dropout, surprising everywhere else, so a warning, not an error.
+2. ``shape_dtype``   — abstract walk of the plan via ``jax.eval_shape`` (no
+   compile, no device work): flags float64 node outputs whose inputs were
+   all narrower (silent x64 promotion inflates memory 2x and breaks TPU
+   lowering), and any head whose shape/dtype DRIFTED between the captured
+   plan and the pass-optimized plan — the invariant every registered pass
+   must preserve.  Skips silently when the context carries no avals.
+3. ``dead_code``     — arguments and aux states no surviving plan node
+   consumes: dead weight being staged to device every forward, usually a
+   sign the graph author kept a head they meant to drop.
+
+Analyzers never mutate the Graph and never raise through ``analyze`` — a
+failing analyzer degrades to one INFO diagnostic (manager contract).
+"""
+from __future__ import annotations
+
+import zlib
+
+from ..graph_passes.ir import node_call_attrs, node_out_names
+from . import register_analyzer
+from .diagnostics import Diagnostic, ERROR, WARNING
+
+__all__ = ["prng_safety", "shape_dtype", "dead_code"]
+
+
+def _attr_of(node, key):
+    defaults = getattr(node.op, "defaults", {}) or {}
+    return node.attrs.get(key, defaults.get(key))
+
+
+def _stochastic(node):
+    return "key" in getattr(node.op, "attr_names", ())
+
+
+def _eval_live(node):
+    """Does this stochastic node actually DRAW in an eval plan?  Dropout is
+    the identity at eval unless forced (``mode="always"`` / an explicit
+    ``training`` attr); rrelu and the ``random_*`` sources sample whenever
+    they get a key — which ``_graph_fn`` always folds in."""
+    opname = getattr(node.op, "name", "")
+    if opname == "Dropout":
+        return bool(node.attrs.get("training")) \
+            or _attr_of(node, "mode") == "always"
+    if opname == "LeakyReLU":
+        return _attr_of(node, "act_type") == "rrelu"
+    return True
+
+
+@register_analyzer("prng_safety", version=1)
+def prng_safety(ctx):
+    """Shared-stream + eval-plan-stochastic checks over the lowered plan."""
+    streams = {}  # stream id -> [node name]
+    diags = []
+    for node, _ in ctx.graph.entries:
+        if not _stochastic(node):
+            continue
+        if "key" in node.attrs:
+            sid = ("explicit", repr(node.attrs["key"]))
+        else:
+            sid = ("name", zlib.crc32(node.name.encode()))
+        streams.setdefault(sid, []).append(node.name)
+        if not ctx.is_train and _eval_live(node):
+            diags.append(Diagnostic(
+                "prng-eval-stochastic", WARNING,
+                "stochastic node %r (%s) samples in an EVAL plan — "
+                "inference outputs are nondeterministic (intended only for "
+                "MC-dropout-style deployments)"
+                % (node.name, getattr(node.op, "name", "?")),
+                where=node.name))
+    for (kind, _), names in streams.items():
+        if len(names) > 1:
+            diags.append(Diagnostic(
+                "prng-shared-stream", ERROR,
+                "stochastic nodes %s fold the SAME PRNG stream (%s) — "
+                "their draws are identical, silently correlating what "
+                "should be independent randomness"
+                % (sorted(names),
+                   "shared explicit key attr" if kind == "explicit"
+                   else "same node name, same fold_in"),
+                where=",".join(sorted(set(names)))))
+    return diags
+
+
+def _abstract_walk(graph, ctx, record=None):
+    """``jax.eval_shape`` the plan exactly as ``Executor._graph_fn`` would
+    evaluate it (same attr fill-in for ``key``/``training``, same
+    hidden-output trim, aux updates skipped — heads don't consume them)
+    -> [head ShapeDtypeStruct].  ``record(name, shape, dtype)`` observes
+    every node output during the abstract trace."""
+    import jax
+    import numpy as np
+
+    arg_avals = [ctx.arg_avals[n] for n in ctx.arg_names]
+    aux_avals = [ctx.aux_avals[n] for n in ctx.aux_names]
+    entries, heads = graph.entries, graph.heads
+    consts = graph.constants
+
+    def f(arg_vals, aux_vals, key):
+        env = dict(consts) if consts else {}
+        env.update(zip(ctx.arg_names, arg_vals))
+        env.update(zip(ctx.aux_names, aux_vals))
+        for node, in_names in entries:
+            attrs = node_call_attrs(node, key, ctx.is_train)
+            res = node.op.fn(*[env[n] for n in in_names], **attrs)
+            outs = res if isinstance(res, tuple) else (res,)
+            if len(outs) > 1 and node.num_outputs == 1:
+                outs = outs[:1]
+            for nm, o in zip(node_out_names(node), outs):
+                env[nm] = o
+                if record is not None:
+                    # shape/dtype of an abstract tracer are concrete
+                    record(node, nm, tuple(o.shape), o.dtype,
+                           [env[n] for n in in_names])
+        return [env[h] for h in heads]
+
+    return jax.eval_shape(f, arg_avals, aux_avals,
+                          jax.ShapeDtypeStruct((2,), np.uint32))
+
+
+@register_analyzer("shape_dtype", version=1)
+def shape_dtype(ctx):
+    """f64-promotion + raw-vs-optimized head drift, via jax.eval_shape."""
+    import numpy as np
+
+    if not (ctx.arg_names is not None and ctx.arg_avals is not None and
+            ctx.aux_avals is not None):
+        return []
+    diags = []
+    f64 = np.dtype("float64")
+
+    def record(node, nm, shape, dtype, in_vals):
+        if dtype == f64 and not any(
+                getattr(v, "dtype", None) == f64 for v in in_vals):
+            diags.append(Diagnostic(
+                "f64-promotion", WARNING,
+                "node %r (%s) output %s promotes to float64 with no "
+                "float64 input — a silent x64 upcast (check python-scalar "
+                "attrs / np constants in the op)"
+                % (node.name, getattr(node.op, "name", "?"), nm),
+                where=nm))
+
+    opt_heads = _abstract_walk(ctx.graph, ctx, record=record)
+    if ctx.raw is not ctx.graph:
+        raw_heads = _abstract_walk(ctx.raw, ctx)
+        if len(raw_heads) != len(opt_heads):
+            diags.append(Diagnostic(
+                "pass-drift", ERROR,
+                "head COUNT drifted across the pass pipeline: captured %d "
+                "-> optimized %d — a registered pass dropped or invented "
+                "an output" % (len(raw_heads), len(opt_heads)),
+                where="heads"))
+        for i, (r, o) in enumerate(zip(raw_heads, opt_heads)):
+            if tuple(r.shape) != tuple(o.shape) or r.dtype != o.dtype:
+                diags.append(Diagnostic(
+                    "pass-drift", ERROR,
+                    "head %d drifted across the pass pipeline: captured "
+                    "%s%s -> optimized %s%s — a registered pass broke the "
+                    "plan contract"
+                    % (i, r.dtype, tuple(r.shape), o.dtype, tuple(o.shape)),
+                    where="head%d" % i))
+    return diags
+
+
+@register_analyzer("dead_code", version=1)
+def dead_code(ctx):
+    """Unused-input / dead-aux detection over the plan actually lowered."""
+    if ctx.arg_names is None:
+        return []
+    used = set(ctx.graph.heads)
+    for _, in_names in ctx.graph.entries:
+        used.update(in_names)
+    diags = []
+    for n in ctx.arg_names:
+        if n not in used:
+            diags.append(Diagnostic(
+                "unused-input", WARNING,
+                "argument %r is consumed by no node in the %s plan — it is "
+                "staged to device every forward for nothing"
+                % (n, "train" if ctx.is_train else "eval"), where=n))
+    for n in ctx.aux_names or ():
+        if n not in used:
+            diags.append(Diagnostic(
+                "dead-aux", WARNING,
+                "aux state %r is consumed by no node in the %s plan"
+                % (n, "train" if ctx.is_train else "eval"), where=n))
+    return diags

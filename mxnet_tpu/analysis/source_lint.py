@@ -1,0 +1,538 @@
+"""JAX-hazard source lint (ISSUE 8, layer 2) — AST checks over the codebase.
+
+The graph analyzers check plans; this module checks the *source that builds
+them*.  The bug class is host/trace confusion: code that runs fine eagerly
+but, inside a jitted function, either crashes at trace time, silently
+constant-folds a value that should be traced, or forces a device sync per
+step.  Every rule anchors to a hazard this repo has actually paid for
+(PERF_NOTES' host-sync hunts, the PR 6 donation/cache-key corruption).
+
+**Traced-region detection** — a function is considered traced when any of:
+
+* decorated ``@jax.jit`` / ``@pjit`` / ``@partial(jax.jit, ...)``;
+* decorated ``@register("Op")`` (the op registry: every registered op body
+  is traced by ``Executor._graph_fn``);
+* its name is passed to a trace consumer anywhere in the module
+  (``jax.jit(fn)``, ``jax.vjp(f, ...)``, ``lax.scan(step, ...)``,
+  ``pl.pallas_call(kernel, ...)``, ``jax.eval_shape``, ``vmap``/``grad``/
+  ``remat``/``cond``/``while_loop``/``fori_loop``/``shard_map`` ...);
+* it is nested inside a traced function (closures a jitted fn calls);
+* its ``def`` line carries a ``# mxlint: traced`` marker (for functions
+  handed to a tracer from another module, e.g. ``Executor._graph_fn``'s
+  inner ``fn``).
+
+This is a *heuristic* (module-local name resolution, no data flow), so every
+rule is suppressible: a trailing ``# mxlint: ignore[code]`` comment kills
+one line, and the committed baseline (``ci/mxlint_baseline.txt``) carries
+the justified legacy sites — existing findings are suppressed *explicitly*,
+never silently (the TVM/Relay discipline of PAPERS.md applied to lint).
+
+Rules
+-----
+``bare-except``             ``except:`` swallows KeyboardInterrupt/SystemExit
+                            and every bug (anywhere, not just traced code).
+``np-in-traced``            ``np.*(...)`` call inside traced code whose
+                            arguments reference a traced (positional)
+                            parameter: numpy executes at trace time on the
+                            host — a sync or TracerError.  Host math on
+                            *statics* (shapes, attrs: ``np.ceil(h/stride)``)
+                            is idiomatic and exempt, as are ``np.float32`` /
+                            ``np.pi`` attribute reads and params reached
+                            only through ``.shape``/``.ndim``/``.dtype``/
+                            ``.size``/``len()`` (static under trace).
+``scalar-coerce-in-traced`` ``float(x)`` / ``int(x)`` / ``bool(x)`` on a
+                            traced parameter (same static exemptions), or
+                            ``.item()`` / ``.tolist()`` / ``.asnumpy()``
+                            anywhere in traced code — a concretization
+                            error or a blocking device round-trip.
+``branch-on-traced-param``  ``if``/``while`` whose test reads a *positional*
+                            parameter of a traced function by bare name —
+                            Python control flow on a tracer (the repo
+                            convention keeps static attrs keyword-only, so
+                            positional params are the traced values).  ``is
+                            None`` checks are static and exempt.
+``time-in-traced``          ``time.*()`` inside traced code: evaluates once
+                            at trace time and bakes the timestamp into the
+                            executable.
+``donated-jit-unkeyed``     ``jax.jit(..., donate_argnums=...)`` in a scope
+                            that never mentions ``compile_cache`` /
+                            ``CachedFunction``: a donated executable the
+                            AOT cache layer cannot see — exactly the shape
+                            of the PR 6 XLA:CPU donated-restore corruption
+                            (an unwired donated jit has no key carrying its
+                            donation layout, so nothing can invalidate it).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .diagnostics import Diagnostic, WARNING
+
+__all__ = ["LintFinding", "lint_source", "lint_paths", "load_baseline",
+           "split_baseline", "format_baseline_line", "RULES"]
+
+RULES = ("bare-except", "np-in-traced", "scalar-coerce-in-traced",
+         "branch-on-traced-param", "time-in-traced", "donated-jit-unkeyed")
+
+# callables whose function-valued arguments get traced
+_TRACE_CONSUMERS = frozenset({
+    "jit", "pjit", "vjp", "jvp", "grad", "value_and_grad", "vmap", "pmap",
+    "remat", "checkpoint", "eval_shape", "pallas_call", "scan",
+    "while_loop", "fori_loop", "cond", "switch", "shard_map",
+    "custom_vjp", "custom_jvp", "linear_transpose", "associative_scan",
+})
+# callables whose function-valued arguments run on the HOST by contract —
+# a def handed to one of these is a host region even when nested inside
+# traced code (jax.pure_callback bodies are the custom-op escape hatch)
+_HOST_CONSUMERS = frozenset({"pure_callback", "io_callback", "callback"})
+_JIT_NAMES = frozenset({"jit", "pjit"})
+_COERCERS = frozenset({"float", "int", "bool", "complex"})
+_SYNC_METHODS = frozenset({"item", "tolist", "asnumpy"})
+# np.* helpers that only read metadata (delegate to .ndim/.shape/dtype
+# protocols) — never convert, so safe on a tracer
+_NP_META = frozenset({"ndim", "shape", "size", "dtype", "result_type",
+                      "promote_types", "broadcast_shapes", "iinfo", "finfo"})
+
+_IGNORE_RE = re.compile(r"#\s*mxlint:\s*ignore(?:\[([a-z0-9_,\- ]+)\])?")
+_TRACED_RE = re.compile(r"#\s*mxlint:\s*traced\b")
+
+
+class LintFinding(Diagnostic):
+    """A source-lint Diagnostic anchored to a file location, carrying the
+    stable fingerprint the baseline mechanism keys on (path + enclosing
+    qualname + rule + normalized source line — line NUMBERS are excluded on
+    purpose, so unrelated edits above a justified site don't churn the
+    baseline)."""
+
+    __slots__ = ("path", "line", "col", "fingerprint", "_qualname")
+
+    def __init__(self, code, severity, message, path, line, col, qualname):
+        super().__init__(code, severity, message,
+                         where="%s:%d" % (path, line), analyzer="source_lint")
+        self.path = path
+        self.line = line
+        self.col = col
+        self.fingerprint = None  # filled by lint_source after dedup
+        self._qualname = qualname  # fingerprint component
+
+    def __str__(self):
+        return "%s:%d:%d: %s [%s] %s" % (self.path, self.line, self.col + 1,
+                                         self.severity, self.code,
+                                         self.message)
+
+
+def _root_name(expr):
+    """Terminal base Name of a Name/Attribute chain (``np.linalg.inv`` ->
+    ``np``), or None."""
+    while isinstance(expr, ast.Attribute):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def _call_name(func):
+    """The identifier a call is made through (``jax.jit`` -> ``jit``)."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _is_jitlike(expr):
+    return _call_name(expr) in _JIT_NAMES and isinstance(
+        expr, (ast.Name, ast.Attribute))
+
+
+class _Linter:
+    def __init__(self, tree, lines, path):
+        self.tree = tree
+        self.lines = lines
+        self.path = path
+        self.findings = []
+        self.np_aliases = set()
+        self.time_aliases = set()
+        self.traced_seeds = set()   # names handed to a trace consumer
+        self.host_seeds = set()     # names handed to a host callback
+        self._collect_module_facts()
+
+    # -- pass 1: imports + names that flow into tracers ----------------------
+    def _collect_module_facts(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name in ("numpy", "numpy.ma"):
+                        self.np_aliases.add(a.asname or "numpy")
+                    elif a.name == "time":
+                        self.time_aliases.add(a.asname or "time")
+            elif isinstance(node, ast.Call):
+                cname = _call_name(node.func)
+                seeds = (self.traced_seeds
+                         if cname in _TRACE_CONSUMERS else
+                         self.host_seeds if cname in _HOST_CONSUMERS
+                         else None)
+                if seeds is not None:
+                    for arg in node.args:
+                        if isinstance(arg, ast.Name):
+                            seeds.add(arg.id)
+                        elif isinstance(arg, ast.Attribute):
+                            seeds.add(arg.attr)
+
+    # -- suppression ---------------------------------------------------------
+    def _suppressed(self, line_no, code):
+        try:
+            text = self.lines[line_no - 1]
+        except IndexError:
+            return False
+        m = _IGNORE_RE.search(text)
+        if not m:
+            return False
+        codes = m.group(1)
+        if codes is None:
+            return True
+        return code in {c.strip() for c in codes.split(",")}
+
+    def _emit(self, code, node, message, qualname):
+        line = getattr(node, "lineno", 1)
+        # a multi-line construct (e.g. a jit call spanning lines) accepts
+        # the ignore comment on ANY of its physical lines — trailing
+        # comments naturally land on the closing-paren line
+        end = getattr(node, "end_lineno", None) or line
+        if any(self._suppressed(ln, code) for ln in range(line, end + 1)):
+            return
+        self.findings.append(LintFinding(
+            code, WARNING, message, self.path, line,
+            getattr(node, "col_offset", 0), qualname))
+
+    # -- traced-ness ---------------------------------------------------------
+    def _def_is_traced(self, fd):
+        for dec in fd.decorator_list:
+            if _is_jitlike(dec):
+                return True
+            if isinstance(dec, ast.Call):
+                cname = _call_name(dec.func)
+                if _is_jitlike(dec.func):
+                    return True
+                if cname == "partial" and dec.args \
+                        and _is_jitlike(dec.args[0]):
+                    return True
+                if cname in ("register", "register_op"):
+                    return True  # op registry: body runs under _graph_fn
+        if fd.name in self.traced_seeds:
+            return True
+        try:
+            return bool(_TRACED_RE.search(self.lines[fd.lineno - 1]))
+        except IndexError:
+            return False
+
+    # -- pass 2: walk with (qualname, traced, positional params) context -----
+    def run(self):
+        self._walk(self.tree.body, "<module>", False, frozenset())
+        self._check_module_donated_jits()
+        return self.findings
+
+    def _walk(self, body, qual, traced, params, scope_seg=None):
+        for node in body:
+            self._visit(node, qual, traced, params, scope_seg)
+
+    def _visit(self, node, qual, traced, params, scope_seg=None):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a def handed to a host callback is host code even inside a
+            # traced region (custom-op pure_callback bodies)
+            if node.name in self.host_seeds:
+                sub_traced = False
+            else:
+                sub_traced = traced or self._def_is_traced(node)
+            sub_qual = node.name if qual == "<module>" \
+                else "%s.%s" % (qual, node.name)
+            pos = [a.arg for a in node.args.posonlyargs + node.args.args
+                   if a.arg not in ("self", "cls")]
+            # the donation rule's "is the key wired?" scope: the nearest
+            # TOP-LEVEL enclosing def's source (covers all nested lines,
+            # so outer-scope CachedFunction wiring suppresses inner defs)
+            seg = scope_seg if scope_seg is not None else "\n".join(
+                self.lines[node.lineno - 1:node.end_lineno])
+            self._walk(node.body, sub_qual, sub_traced, frozenset(pos), seg)
+            for dec in node.decorator_list:
+                self._scan_expr(dec, qual, traced, params)
+            self._check_donated_jit_in(node, sub_qual, seg)
+            return
+        if isinstance(node, ast.ClassDef):
+            self._walk(node.body, "%s.%s" % (qual, node.name)
+                       if qual != "<module>" else node.name, traced, params,
+                       scope_seg)
+            return
+        if isinstance(node, ast.ExceptHandler):
+            if node.type is None:
+                self._emit(
+                    "bare-except", node,
+                    "bare 'except:' swallows KeyboardInterrupt/SystemExit "
+                    "and every bug — catch Exception (or narrower)", qual)
+            self._walk(node.body, qual, traced, params, scope_seg)
+            return
+        if traced and isinstance(node, (ast.If, ast.While)):
+            offender = self._traced_name_in_test(node.test, params)
+            if offender:
+                self._emit(
+                    "branch-on-traced-param", node,
+                    "%s on traced parameter %r — Python control flow "
+                    "cannot see a tracer's value (use lax.cond/jnp.where, "
+                    "or make the argument a static keyword-only attr)"
+                    % ("if" if isinstance(node, ast.If) else "while",
+                       offender), qual)
+        # expressions anywhere inside this statement
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.ExceptHandler)):
+                self._visit(child, qual, traced, params, scope_seg)
+            elif isinstance(child, ast.expr):
+                self._scan_expr(child, qual, traced, params)
+            else:
+                self._visit(child, qual, traced, params, scope_seg)
+
+    def _traced_name_in_test(self, test, params):
+        """First positional-param bare Name the test's truthiness depends
+        on, or None.  ``x is None`` / ``x is not None`` comparisons are
+        static under trace and exempt."""
+        if isinstance(test, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+            return None
+        if isinstance(test, ast.BoolOp):
+            for v in test.values:
+                hit = self._traced_name_in_test(v, params)
+                if hit:
+                    return hit
+            return None
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self._traced_name_in_test(test.operand, params)
+        if isinstance(test, ast.Compare):
+            for operand in [test.left] + list(test.comparators):
+                if isinstance(operand, ast.Name) and operand.id in params:
+                    return operand.id
+            return None
+        if isinstance(test, ast.Name) and test.id in params:
+            return test.id
+        return None
+
+    @staticmethod
+    def _refs_traced_param(exprs, params):
+        """Does any of ``exprs`` read a positional (traced) param by value?
+        Reads reaching the param only through static accessors —
+        ``x.shape`` / ``x.ndim`` / ``x.dtype`` / ``x.size`` / ``len(x)`` —
+        are static under trace and don't count.  First offending name or
+        None.  (No dataflow: a traced value laundered through a local is
+        missed — precision over recall; the baseline covers what slips.)"""
+        static_attrs = {"shape", "ndim", "dtype", "size"}
+        exempt = set()
+        names = []
+        for e in exprs:
+            for node in ast.walk(e):
+                if isinstance(node, ast.Attribute) \
+                        and node.attr in static_attrs \
+                        and isinstance(node.value, ast.Name):
+                    exempt.add(id(node.value))
+                elif isinstance(node, ast.Call) \
+                        and _call_name(node.func) == "len":
+                    for a in node.args:
+                        if isinstance(a, ast.Name):
+                            exempt.add(id(a))
+                elif isinstance(node, ast.Name) and node.id in params:
+                    names.append(node)
+        for n in names:
+            if id(n) not in exempt:
+                return n.id
+        return None
+
+    def _scan_expr(self, expr, qual, traced, params):
+        if not traced:
+            return
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            cname = _call_name(func)
+            root = _root_name(func) if isinstance(func, ast.Attribute) \
+                else None
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            if root in self.np_aliases and cname not in _NP_META:
+                hit = self._refs_traced_param(args, params)
+                if hit:
+                    self._emit(
+                        "np-in-traced", node,
+                        "numpy call '%s.%s(...)' on traced parameter %r "
+                        "runs on the host at trace time — a device sync or "
+                        "TracerError (use jnp, or hoist out of the traced "
+                        "function)" % (root, cname, hit), qual)
+            elif root in self.time_aliases:
+                self._emit(
+                    "time-in-traced", node,
+                    "'%s.%s()' inside traced code evaluates ONCE at trace "
+                    "time — the executable replays a frozen timestamp"
+                    % (root, cname), qual)
+            elif isinstance(func, ast.Attribute) and cname in _SYNC_METHODS:
+                self._emit(
+                    "scalar-coerce-in-traced", node,
+                    ".%s() inside traced code is a concretization error on "
+                    "a tracer (and a blocking device round-trip on an "
+                    "array)" % cname, qual)
+            elif isinstance(func, ast.Name) and cname in _COERCERS \
+                    and node.args:
+                hit = self._refs_traced_param(node.args, params)
+                if hit:
+                    self._emit(
+                        "scalar-coerce-in-traced", node,
+                        "%s(...) on traced parameter %r concretizes the "
+                        "value — TracerError under jit" % (cname, hit),
+                        qual)
+
+    @staticmethod
+    def _walk_shallow(root):
+        """``ast.walk`` that does NOT descend into nested function defs —
+        each def's body belongs to that def's own visit."""
+        stack = list(ast.iter_child_nodes(root))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    _DONATED_MSG = (
+        "jax.jit(donate_argnums=...) with no compile_cache/"
+        "CachedFunction wiring in scope: the donated executable "
+        "carries no cache key reflecting its donation layout "
+        "(the PR 6 donated-restore corruption shape) — wrap it "
+        "in compile_cache.CachedFunction or baseline with a "
+        "justification")
+
+    def _check_donated_jit_in(self, fd, qual, seg):
+        """Donation rule — each jit call is attributed to its INNERMOST
+        enclosing def exactly once (the shallow walk leaves nested defs to
+        their own visit); ``seg`` is the nearest top-level enclosing def's
+        source, so the 'is the key wired?' question sees outer-scope
+        wrapping too."""
+        keyed = "compile_cache" in seg or "CachedFunction" in seg
+        if keyed:
+            return
+        for node in self._walk_shallow(fd):
+            if isinstance(node, ast.Call) and _is_jitlike(node.func) \
+                    and any(kw.arg == "donate_argnums"
+                            for kw in node.keywords):
+                self._emit("donated-jit-unkeyed", node, self._DONATED_MSG,
+                           qual)
+
+    def _check_module_donated_jits(self):
+        """Module/class-scope donated jits (``run = jax.jit(step,
+        donate_argnums=(0,))`` at import time) — the PR 6 shape outside any
+        def.  Module scope IS the file, so wiring anywhere in it counts as
+        keyed."""
+        src = "\n".join(self.lines)
+        if "compile_cache" in src or "CachedFunction" in src:
+            return
+        for node in self._walk_shallow(self.tree):
+            if isinstance(node, ast.Call) and _is_jitlike(node.func) \
+                    and any(kw.arg == "donate_argnums"
+                            for kw in node.keywords):
+                self._emit("donated-jit-unkeyed", node, self._DONATED_MSG,
+                           "<module>")
+
+
+def _fingerprint(findings):
+    """Fill ``fingerprint`` on every finding: path::qualname::rule::
+    normalized-source-line, de-duplicated with a ::N occurrence suffix.
+    Line-number free, so edits elsewhere in the file don't invalidate a
+    committed baseline entry."""
+    seen = {}
+    for f in findings:
+        base = "%s::%s::%s" % (f.path, f._qualname, f.code)
+        n = seen.get(base, 0)
+        seen[base] = n + 1
+        f.fingerprint = base + ("::%d" % n if n else "")
+    return findings
+
+
+def lint_source(src, path="<string>", lines=None):
+    """Lint one module's source -> [LintFinding] in file order (with
+    fingerprints filled).  ``path`` is the fingerprint/display path."""
+    tree = ast.parse(src, filename=path)
+    if lines is None:
+        lines = src.splitlines()
+    linter = _Linter(tree, lines, path)
+    findings = linter.run()
+    findings.sort(key=lambda f: (f.line, f.col, f.code))
+    # normalized source line enters the fingerprint here (linter kept the
+    # lines around): whitespace-collapsed, so reformatting alone is stable
+    for f in findings:
+        f._qualname = "%s@%s" % (
+            f._qualname,
+            re.sub(r"\s+", " ", lines[f.line - 1].strip())
+            if 0 < f.line <= len(lines) else "")
+    return _fingerprint(findings)
+
+
+def lint_paths(paths, root=None):
+    """Lint every ``*.py`` under ``paths`` (files or directories) ->
+    [LintFinding].  Fingerprint paths are made relative to ``root`` (posix
+    separators) so baselines are machine-independent."""
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__" and
+                               not d.startswith(".")]
+                files.extend(os.path.join(dirpath, f)
+                             for f in sorted(filenames) if f.endswith(".py"))
+        else:
+            files.append(p)
+    out = []
+    for fp in sorted(files):
+        rel = os.path.relpath(fp, root) if root else fp
+        rel = rel.replace(os.sep, "/")
+        try:
+            with open(fp, "r", encoding="utf-8") as fh:
+                src = fh.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        try:
+            out.extend(lint_source(src, path=rel))
+        except SyntaxError as e:
+            out.extend(_fingerprint([LintFinding(
+                "syntax-error", WARNING,
+                "file does not parse (%s); lint skipped" % e,
+                rel, 1, 0, "<module>@")]))
+    return out
+
+
+# -- baseline ----------------------------------------------------------------
+
+def format_baseline_line(finding, justification=""):
+    just = "  # %s" % justification if justification else ""
+    return finding.fingerprint + just
+
+
+def load_baseline(path):
+    """Baseline file -> set of fingerprints.  One fingerprint per line;
+    ``#``-to-EOL is a justification comment; blank lines ignored."""
+    fps = set()
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.split("  #", 1)[0].strip()
+                if line and not line.startswith("#"):
+                    fps.add(line)
+    except OSError:
+        pass
+    return fps
+
+
+def split_baseline(findings, baseline):
+    """-> (new, suppressed, stale): findings not in / in the baseline, and
+    baseline fingerprints matching nothing (candidates for deletion —
+    reported, never auto-pruned)."""
+    new = [f for f in findings if f.fingerprint not in baseline]
+    suppressed = [f for f in findings if f.fingerprint in baseline]
+    live = {f.fingerprint for f in findings}
+    stale = sorted(baseline - live)
+    return new, suppressed, stale

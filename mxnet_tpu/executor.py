@@ -128,6 +128,18 @@ class Executor:
         the modes this executor has lowered so far; empty with passes off."""
         return dict(self._pass_stats)
 
+    def check(self, is_train=False):
+        """Run the registered graph-IR analyzers (``mxnet_tpu.analysis``,
+        ISSUE 8) over the plan this executor lowers for ``is_train`` ->
+        sorted ``[Diagnostic]`` (most severe first; empty = clean).  Static
+        contract checking only — PRNG-stream safety, abstract shape/dtype
+        walk, dead inputs/aux — no device work and no compile.  Calling it
+        is the opt-in; the ``MXNET_GRAPH_ANALYZERS`` gate only controls the
+        automatic serving-warmup surface."""
+        from . import analysis
+
+        return analysis.check_executor(self, bool(is_train))
+
     def _graph_fn(self, is_train, monitor=None):
         """Pure fn (arg_vals, aux_vals, key) -> (head_vals, new_aux_vals).
 
@@ -137,10 +149,7 @@ class Executor:
         captured plan: a debugging hook must see every captured node, not
         the pass-optimized subset.
         """
-        import zlib
-
-        import jax
-
+        from .graph_passes.ir import node_call_attrs
         from .symbol.symbol import _node_input_names
 
         if monitor is not None:
@@ -154,19 +163,13 @@ class Executor:
         # executor reference would pin the old buffers after reshape
         head_names = list(heads)
 
-        def fn(arg_vals, aux_vals, key):
+        def fn(arg_vals, aux_vals, key):  # mxlint: traced
             env = dict(const_env) if const_env else {}
             env.update(zip(arg_names, arg_vals))
             env.update(zip(aux_names, aux_vals))
             new_aux = dict(zip(aux_names, aux_vals))
             for node, in_names in plan:
-                attrs = dict(node.attrs)
-                if "key" in node.op.attr_names and "key" not in attrs:
-                    # stable per-node stream: crc32 is process-independent
-                    # (PYTHONHASHSEED-proof), keeping seeded runs reproducible
-                    attrs["key"] = jax.random.fold_in(key, zlib.crc32(node.name.encode()))
-                if "training" in node.op.attr_names and "training" not in attrs:
-                    attrs["training"] = is_train
+                attrs = node_call_attrs(node, key, is_train)
                 args = [env[n] for n in in_names]
                 res = node.op.fn(*args, **attrs)
                 outs = res if isinstance(res, tuple) else (res,)

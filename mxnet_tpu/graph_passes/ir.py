@@ -23,7 +23,28 @@ Three node flavors appear in a plan:
 """
 from __future__ import annotations
 
-__all__ = ["Graph", "PlanNode", "SynthOp", "capture", "node_out_names"]
+__all__ = ["Graph", "PlanNode", "SynthOp", "capture", "node_out_names",
+           "node_call_attrs"]
+
+
+def node_call_attrs(node, key, is_train):
+    """The attr dict a plan node's ``op.fn`` is called with — the ONE
+    implementation of the per-node PRNG-stream fold and ``training``
+    fill-in, shared by ``Executor._graph_fn`` (real evaluation) and
+    ``analysis._abstract_walk`` (``jax.eval_shape``), so the abstract walk
+    can never drift from what actually lowers."""
+    import zlib
+
+    import jax
+
+    attrs = dict(node.attrs)
+    if "key" in node.op.attr_names and "key" not in attrs:
+        # stable per-node stream: crc32 is process-independent
+        # (PYTHONHASHSEED-proof), keeping seeded runs reproducible
+        attrs["key"] = jax.random.fold_in(key, zlib.crc32(node.name.encode()))
+    if "training" in node.op.attr_names and "training" not in attrs:
+        attrs["training"] = is_train
+    return attrs
 
 
 class SynthOp:

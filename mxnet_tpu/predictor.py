@@ -191,6 +191,14 @@ class Predictor:
         off).  The serving warmup report surfaces these per bucket."""
         return self._exec.pass_stats()
 
+    def check(self):
+        """Graph-IR analyzer diagnostics (``mxnet_tpu.analysis``, ISSUE 8)
+        for this predictor's eval plan -> sorted ``[Diagnostic]``.  Static
+        (abstract shapes only, nothing compiles or runs); the serving
+        warmup surfaces the per-bucket count when
+        ``MXNET_GRAPH_ANALYZERS=1``."""
+        return self._exec.check(is_train=False)
+
     def with_shapes(self, input_shapes):
         """A sibling Predictor specialized to ``input_shapes``, sharing this
         one's symbol and loaded params — the cheap path for holding MANY

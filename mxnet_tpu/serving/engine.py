@@ -31,6 +31,7 @@ import time
 
 import numpy as np
 
+from ..base import env_flag
 from ..predictor import Predictor
 from ..telemetry import tracing
 from .admission import AdmissionController, EngineClosed, ServerBusy
@@ -143,6 +144,15 @@ class Engine:
         self._warmup = None  # last warmup pass summary (stats() block)
         self._thread = None
         self._closed = False
+        # lock-discipline checking (ISSUE 8, MXNET_LOCKCHECK=1): swap the
+        # three mutexes for order-recording CheckedLocks and wrap their
+        # owned containers.  Off path = this one env_flag read; the
+        # analysis package is never imported and the locks above stay
+        # vanilla threading.Lock (tests/test_analysis.py asserts).
+        if env_flag("MXNET_LOCKCHECK"):
+            from ..analysis import lockcheck
+
+            lockcheck.instrument_engine(self)
         if start:
             self.start()
 
@@ -521,6 +531,13 @@ class Engine:
         # nodes captured vs nodes compiled — None when MXNET_GRAPH_PASSES
         # is off (the predictor lowered the raw plan)
         ps = pred.pass_stats().get("eval")
+        # graph-IR analyzer diagnostics over the same plan (ISSUE 8): the
+        # count only — ``pred.check()`` returns the full list on demand;
+        # None when MXNET_GRAPH_ANALYZERS is off (check is never invoked
+        # and the analysis package is never imported — the off path is
+        # this one env read)
+        checked = len(pred.check()) \
+            if env_flag("MXNET_GRAPH_ANALYZERS") else None
         return {"bucket": repr(bucket), "fresh": fresh,
                 "compile_s": round(dt, 4) if fresh else 0.0,
                 "lower_s": round(lower_s, 4),
@@ -528,7 +545,8 @@ class Engine:
                 # wall-clock rows above include bind + zeros forward)
                 "aot_compile_s": round(aot_compile_s, 4), "cache": cache,
                 "graph_nodes_pre": ps["nodes_pre"] if ps else None,
-                "graph_nodes_post": ps["nodes_post"] if ps else None}
+                "graph_nodes_post": ps["nodes_post"] if ps else None,
+                "check_warnings": checked}
 
     def _note_warmup(self, report, total_s):
         """Record the warmup pass for ``stats()["warmup"]`` (always on, so
@@ -536,6 +554,9 @@ class Engine:
         registry/event stream (when enabled)."""
         hits = sum(1 for r in report if r.get("cache") == "hit")
         misses = sum(1 for r in report if r.get("cache") == "miss")
+        checked = [r.get("check_warnings") for r in report]
+        n_diags = (sum(v for v in checked if v is not None)
+                   if any(v is not None for v in checked) else None)
         with self._stats_mu:
             self._warmup = {
                 "buckets": len(report),
@@ -547,6 +568,9 @@ class Engine:
                 # warm restart drives to 0.0 (ci/check_aot_cache.py asserts)
                 "aot_compile_s": round(sum(r.get("aot_compile_s", 0.0)
                                            for r in report), 4),
+                # graph-IR analyzer diagnostics across all warmed buckets
+                # (ISSUE 8) — None when MXNET_GRAPH_ANALYZERS is off
+                "check_warnings": n_diags,
                 "total_s": round(total_s, 4)}
         if self._probe:
             self._probe.record_warmup(len(report), hits, misses, total_s)

@@ -41,12 +41,15 @@ def warmup_engine(engine, buckets=None, verbose=False, max_workers=None):
     """Compile ``buckets`` (default: the engine's full ladder signature
     set) by forwarding zeros through each.  Returns the per-bucket report:
     ``[{"bucket", "fresh", "compile_s", "lower_s", "cache",
-    "graph_nodes_pre", "graph_nodes_post"}, ...]`` — ``fresh=False`` rows
-    were already live in this process (idempotent; re-running warmup is
-    free); ``cache`` is ``"hit"``/``"miss"`` against the persistent AOT
-    cache, or None when ``MXNET_AOT_CACHE`` is off; the ``graph_nodes_*``
-    pair is the bucket plan's node count before/after the graph-pass
-    pipeline (ISSUE 7; None with ``MXNET_GRAPH_PASSES=0``).
+    "graph_nodes_pre", "graph_nodes_post", "check_warnings"}, ...]`` —
+    ``fresh=False`` rows were already live in this process (idempotent;
+    re-running warmup is free); ``cache`` is ``"hit"``/``"miss"`` against
+    the persistent AOT cache, or None when ``MXNET_AOT_CACHE`` is off; the
+    ``graph_nodes_*`` pair is the bucket plan's node count before/after the
+    graph-pass pipeline (ISSUE 7; None with ``MXNET_GRAPH_PASSES=0``);
+    ``check_warnings`` counts this bucket's graph-IR analyzer diagnostics
+    (``Predictor.check()``, ISSUE 8; None with ``MXNET_GRAPH_ANALYZERS``
+    off).
     The pass is also summarized in ``engine.stats()["warmup"]``."""
     from .. import compile_cache
 
@@ -88,6 +91,8 @@ def warmup_engine(engine, buckets=None, verbose=False, max_workers=None):
                     and row["graph_nodes_post"] != row["graph_nodes_pre"]:
                 state += "  [graph %d->%d nodes]" % (
                     row["graph_nodes_pre"], row["graph_nodes_post"])
+            if row.get("check_warnings"):
+                state += "  [check: %d diagnostics]" % row["check_warnings"]
             print("warmup %-28s %s" % (row["bucket"], state))
     engine._note_warmup(report, time.perf_counter() - t0)
     return report

@@ -702,10 +702,17 @@ def _infer_graph(sym, known_shapes, known_dtypes):
         if not have_all:
             continue
         attrs = dict(node.attrs)
-        if "key" in node.op.attr_names and "key" not in attrs:
-            attrs["key"] = jax.ShapeDtypeStruct((2,), jnp.uint32)
         try:
-            res = jax.eval_shape(lambda *a: node.op.fn(*a, **attrs), *in_recs)
+            if "key" in node.op.attr_names and "key" not in attrs:
+                # the key must enter eval_shape as an ARGUMENT (becoming an
+                # abstract tracer) — closing the spec over the lambda hands
+                # jax.random a raw ShapeDtypeStruct, which only ops that
+                # sample at eval ever noticed (mode="always" Dropout, rrelu)
+                res = jax.eval_shape(
+                    lambda key, *a: node.op.fn(*a, key=key, **attrs),
+                    jax.ShapeDtypeStruct((2,), jnp.uint32), *in_recs)
+            else:
+                res = jax.eval_shape(lambda *a: node.op.fn(*a, **attrs), *in_recs)
         except Exception as e:
             raise MXNetError(
                 "shape inference failed at op %s(%s): %s" % (node.op.name, node.name, e)

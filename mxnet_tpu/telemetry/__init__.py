@@ -23,7 +23,8 @@ from .instrument import (ServeProbe, StepProbe, add_sink, array_nbytes,
                          instrument_step, interval_s, jsonl_path,
                          note_aot_cache, note_bytes,
                          note_compile, note_dispatch, note_fused_fallback,
-                         note_graph_passes, note_nonfinite, note_train_step,
+                         note_graph_passes, note_lockcheck_violation,
+                         note_nonfinite, note_train_step,
                          registry, sample_memory, serve_probe, step_probe,
                          summary)
 
@@ -37,6 +38,7 @@ __all__ = [
     "enabled", "event", "flush", "gauge", "histogram", "instrument_step",
     "interval_s", "jsonl_path", "note_aot_cache", "note_bytes", "note_compile",
     "note_dispatch", "note_fused_fallback", "note_graph_passes",
-    "note_nonfinite", "note_train_step", "registry", "sample_memory",
+    "note_lockcheck_violation", "note_nonfinite", "note_train_step",
+    "registry", "sample_memory",
     "serve_probe", "step_probe", "summary",
 ]

@@ -242,6 +242,20 @@ def note_nonfinite(where):
                        ("where",)).inc(where=where)
 
 
+def note_lockcheck_violation(kind):
+    """Count one MXNET_LOCKCHECK finding (analysis/lockcheck.py, ISSUE 8).
+    ``kind``: "inversion" | "reentry" | "unguarded-mutation" |
+    "bad-release" — the
+    violation itself is also kept on ``analysis.lockcheck.violations()``
+    (and raises under pytest), so this counter is the production-canary
+    surface, not the only record."""
+    if not enabled():
+        return
+    registry().counter("lockcheck_violations_total",
+                       "lock-discipline violations (MXNET_LOCKCHECK)",
+                       ("kind",)).inc(kind=kind)
+
+
 def note_aot_cache(kind, reason=None, tier="exec"):
     """Count one AOT persistent-cache event (compile_cache.py, ISSUE 6).
     ``kind``: "hits" | "misses" | "errors"; errors carry a reason label

@@ -1,0 +1,517 @@
+"""Static-analysis suite tests (ISSUE 8): graph-IR analyzers, the mxlint
+source lint, and the lock-discipline checker — seeded violations must trip,
+clean code must not, and every gate's off path must be zero-overhead."""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import analysis
+from mxnet_tpu.analysis import lockcheck, source_lint
+from mxnet_tpu.analysis.diagnostics import (Diagnostic, ERROR, INFO, WARNING,
+                                            worst_severity)
+from mxnet_tpu.predictor import Predictor
+from mxnet_tpu.serving import BucketLadder, Engine
+from mxnet_tpu.telemetry import instrument as tin
+from mxnet_tpu.test_utils import tiny_mlp_checkpoint
+
+
+@pytest.fixture
+def lc_state():
+    """Fresh lockcheck global state (order graph + violations) per test."""
+    lockcheck.reset()
+    yield
+    lockcheck.reset()
+
+
+@pytest.fixture
+def tel_disabled(monkeypatch):
+    monkeypatch.delenv("MXNET_TELEMETRY", raising=False)
+    tin._reset_for_tests()
+    yield
+    tin._reset_for_tests()
+
+
+def _bind(sym, **arrays):
+    return sym.bind(None, {k: nd.array(v) for k, v in arrays.items()})
+
+
+# -- diagnostics --------------------------------------------------------------
+class TestDiagnostics:
+    def test_severity_validation_and_order(self):
+        with pytest.raises(ValueError):
+            Diagnostic("x", "fatal", "nope")
+        assert worst_severity([]) is None
+        diags = [Diagnostic("a", INFO, "i"), Diagnostic("b", ERROR, "e"),
+                 Diagnostic("c", WARNING, "w")]
+        assert worst_severity(diags) == ERROR
+        diags.sort(key=Diagnostic._sort_key)
+        assert [d.severity for d in diags] == [ERROR, WARNING, INFO]
+
+    def test_str_carries_where(self):
+        d = Diagnostic("prng-shared-stream", ERROR, "msg", where="d1,d2")
+        assert "prng-shared-stream" in str(d) and "[d1,d2]" in str(d)
+
+
+# -- graph-IR analyzers -------------------------------------------------------
+class TestGraphAnalyzers:
+    def test_key_reusing_dropouts_trip_prng_analyzer(self):
+        """ISSUE 8 seeded violation: two dropouts folding the SAME explicit
+        key draw identical masks — must be an ERROR."""
+        x = mx.sym.var("data")
+        k = np.zeros(2, np.uint32)
+        d1 = mx.sym.Dropout(x, p=0.5, key=k, name="d1")
+        d2 = mx.sym.Dropout(x, p=0.5, key=k, name="d2")
+        exe = _bind(d1 + d2, data=np.ones((2, 4), np.float32))
+        diags = exe.check(is_train=True)
+        shared = [d for d in diags if d.code == "prng-shared-stream"]
+        assert len(shared) == 1 and shared[0].severity == ERROR
+        assert "d1" in shared[0].message and "d2" in shared[0].message
+        # sorted most-severe first: the ERROR leads
+        assert diags[0].code == "prng-shared-stream"
+
+    def test_distinct_dropouts_are_clean(self):
+        x = mx.sym.var("data")
+        out = mx.sym.Dropout(x, p=0.5, name="a") \
+            + mx.sym.Dropout(x, p=0.5, name="b")
+        exe = _bind(out, data=np.ones((2, 4), np.float32))
+        assert [d for d in exe.check(is_train=True)
+                if d.code.startswith("prng")] == []
+
+    def test_stochastic_node_in_eval_plan_warns(self):
+        """ISSUE 8 seeded violation: a mode="always" dropout survives the
+        inference rewrite and samples at inference — warned, not errored
+        (MC-dropout is legitimate)."""
+        x = mx.sym.var("data")
+        exe = _bind(mx.sym.Dropout(x, p=0.5, mode="always"),
+                    data=np.ones((2, 4), np.float32))
+        diags = exe.check(is_train=False)
+        assert [d.code for d in diags] == ["prng-eval-stochastic"]
+        assert diags[0].severity == WARNING
+        # the same dropout in TRAIN mode is normal — no warning
+        assert [d for d in exe.check(is_train=True)
+                if d.code == "prng-eval-stochastic"] == []
+
+    def test_clean_mlp_predictor_checks_clean(self):
+        sym, params = tiny_mlp_checkpoint()
+        pred = Predictor(sym, params, {"data": (2, 8)})
+        assert pred.check() == []
+
+    def test_dead_code_analyzer_flags_unconsumed_bindings(self):
+        from mxnet_tpu.analysis.graph_analyzers import dead_code
+        from mxnet_tpu.graph_passes import Graph
+        from mxnet_tpu.graph_passes.ir import PlanNode, SynthOp
+
+        node = PlanNode(SynthOp("exp", lambda x: x), {}, "n0")
+        g = Graph([(node, ("a",))], ["n0_output"])
+        ctx = analysis.GraphContext(g, arg_names=["a", "b"],
+                                    aux_names=["bn_mean"])
+        codes = sorted(d.code for d in dead_code(ctx))
+        assert codes == ["dead-aux", "unused-input"]
+
+    def test_pass_drift_detected_between_raw_and_optimized(self):
+        """A (synthetic) pass that changes a head's shape must be flagged
+        as breaking the plan contract."""
+        import jax
+        import jax.numpy as jnp
+        from mxnet_tpu.graph_passes import Graph
+        from mxnet_tpu.graph_passes.ir import PlanNode, SynthOp
+
+        raw = Graph([(PlanNode(SynthOp("exp", jnp.exp), {}, "n"), ("a",))],
+                    ["n_output"])
+        bad = Graph([(PlanNode(SynthOp("sum", jnp.sum), {}, "n"), ("a",))],
+                    ["n_output"])  # scalar head: shape drifted
+        ctx = analysis.GraphContext(
+            bad, raw=raw, arg_names=["a"], aux_names=[],
+            arg_avals={"a": jax.ShapeDtypeStruct((3,), np.float32)},
+            aux_avals={})
+        drift = [d for d in analysis.analyze(ctx) if d.code == "pass-drift"]
+        assert len(drift) == 1 and drift[0].severity == ERROR
+        # a pass that DROPS a head entirely must also be flagged (zip alone
+        # would truncate silently)
+        node = PlanNode(SynthOp("exp", jnp.exp), {}, "n")
+        two_heads = Graph([(node, ("a",))], ["n_output", "n_output"])
+        ctx2 = analysis.GraphContext(
+            Graph([(node, ("a",))], ["n_output"]), raw=two_heads,
+            arg_names=["a"], aux_names=[],
+            arg_avals={"a": jax.ShapeDtypeStruct((3,), np.float32)},
+            aux_avals={})
+        drops = [d for d in analysis.analyze(ctx2) if d.code == "pass-drift"]
+        assert len(drops) == 1 and "COUNT" in drops[0].message
+
+    def test_failing_analyzer_degrades_to_info(self, monkeypatch):
+        def boom(ctx):
+            raise RuntimeError("kaboom")
+        monkeypatch.setattr(analysis, "_ANALYZERS",
+                            [("boom", 1, boom)] + analysis._ANALYZERS)
+        x = mx.sym.var("data")
+        exe = _bind(mx.sym.exp(x), data=np.ones((2,), np.float32))
+        diags = exe.check()
+        failed = [d for d in diags if d.code == "analyzer-failed"]
+        assert len(failed) == 1 and failed[0].severity == INFO
+        assert "kaboom" in failed[0].message
+
+    def test_analyzer_pipeline_registered_in_order(self):
+        names = [n for n, _ in analysis.analyzer_pipeline()]
+        assert names == ["prng_safety", "shape_dtype", "dead_code"]
+
+
+# -- source lint --------------------------------------------------------------
+class TestSourceLint:
+    def _codes(self, src):
+        return [f.code for f in source_lint.lint_source(src)]
+
+    def test_np_call_on_traced_param_flagged(self):
+        src = ("import numpy as np\nimport jax\n\n"
+               "@jax.jit\ndef f(x):\n    return np.log(x)\n")
+        assert self._codes(src) == ["np-in-traced"]
+
+    def test_np_on_statics_is_exempt(self):
+        src = ("import numpy as np\nimport jax\n\n"
+               "@jax.jit\ndef f(x):\n"
+               "    n = np.prod(x.shape)\n"          # .shape is static
+               "    m = np.ceil(len(x) / 2)\n"       # len() is static
+               "    return x * n * m\n")
+        assert self._codes(src) == []
+
+    def test_scalar_coerce_and_sync_methods(self):
+        src = ("import jax\n\n@jax.jit\ndef f(x):\n"
+               "    a = float(x)\n    b = x.item()\n    return a + b\n")
+        assert sorted(self._codes(src)) == ["scalar-coerce-in-traced"] * 2
+
+    def test_branch_on_traced_param(self):
+        src = ("import jax\n\n@jax.jit\ndef f(x, y):\n"
+               "    if y is None:\n        return x\n"   # static: exempt
+               "    if x > 0:\n        return x\n"       # traced: flagged
+               "    return -x\n")
+        assert self._codes(src) == ["branch-on-traced-param"]
+
+    def test_time_and_bare_except(self):
+        src = ("import time\nimport jax\n\n@jax.jit\ndef f(x):\n"
+               "    return x + time.time()\n\n"
+               "def g():\n    try:\n        return 1\n"
+               "    except:\n        return 0\n")
+        assert sorted(self._codes(src)) == ["bare-except", "time-in-traced"]
+
+    def test_donated_jit_needs_cache_wiring(self):
+        bare = ("import jax\n\ndef build(fn):\n"
+                "    return jax.jit(fn, donate_argnums=(0,))\n")
+        wired = ("import jax\nfrom mxnet_tpu import compile_cache\n\n"
+                 "def build(fn):\n"
+                 "    step = jax.jit(fn, donate_argnums=(0,))\n"
+                 "    return compile_cache.CachedFunction(step, 'k')\n")
+        assert self._codes(bare) == ["donated-jit-unkeyed"]
+        assert self._codes(wired) == []
+
+    def test_module_scope_donated_jit_flagged(self):
+        """The PR 6 shape at import time — no enclosing def at all."""
+        src = ("import jax\n\ndef step(x):\n    return x\n\n"
+               "run = jax.jit(step, donate_argnums=(0,))\n")
+        findings = source_lint.lint_source(src, path="m.py")
+        assert [f.code for f in findings] == ["donated-jit-unkeyed"]
+        assert "<module>" in findings[0].fingerprint
+
+    def test_nested_donated_jit_once_and_outer_wiring_suppresses(self):
+        nested = ("import jax\n\ndef outer(fn):\n"
+                  "    def inner():\n"
+                  "        return jax.jit(fn, donate_argnums=(0,))\n"
+                  "    return inner\n")
+        findings = source_lint.lint_source(nested, path="m.py")
+        # exactly ONE finding, attributed to the innermost def
+        assert [f.code for f in findings] == ["donated-jit-unkeyed"]
+        assert "outer.inner" in findings[0].fingerprint
+        wired = ("import jax\nfrom mxnet_tpu import compile_cache\n\n"
+                 "def outer(fn):\n"
+                 "    def inner():\n"
+                 "        return jax.jit(fn, donate_argnums=(0,))\n"
+                 "    return compile_cache.CachedFunction(inner(), 'k')\n")
+        # wiring in the enclosing scope suppresses the inner finding
+        assert source_lint.lint_source(wired) == []
+
+    def test_untraced_function_not_linted(self):
+        src = ("import numpy as np\n\ndef f(x):\n"
+               "    return float(np.log(x))\n")  # eager host code: fine
+        assert self._codes(src) == []
+
+    def test_fn_passed_to_tracer_is_traced(self):
+        src = ("import jax\nimport numpy as np\n\n"
+               "def step(x):\n    return np.log(x)\n\n"
+               "run = jax.jit(step)\n")
+        assert self._codes(src) == ["np-in-traced"]
+
+    def test_host_callback_body_is_exempt(self):
+        src = ("import jax\nimport numpy as np\n\n"
+               "@jax.jit\ndef f(x):\n"
+               "    def host(v):\n        return np.log(v)\n"
+               "    return jax.pure_callback(host, x, x)\n")
+        assert self._codes(src) == []
+
+    def test_inline_ignore_suppresses_one_line(self):
+        src = ("import jax\n\n@jax.jit\ndef f(x):\n"
+               "    a = float(x)  # mxlint: ignore[scalar-coerce-in-traced]\n"
+               "    b = int(x)\n    return a + b\n")
+        findings = source_lint.lint_source(src)
+        assert len(findings) == 1 and findings[0].line == 6  # the int(x)
+
+    def test_ignore_on_any_line_of_multiline_construct(self):
+        """A jit call spanning lines accepts the ignore comment where
+        trailing comments naturally go — the closing-paren line."""
+        src = ("import jax\n\ndef build(fn):\n"
+               "    return jax.jit(fn,\n"
+               "                   donate_argnums=(0,),"
+               "  # mxlint: ignore[donated-jit-unkeyed]\n"
+               "                   )\n")
+        assert source_lint.lint_source(src) == []
+
+    def test_fingerprints_survive_edits_above(self):
+        """The baseline keys on path::qualname@line-text::rule — inserting
+        lines above a justified site must not churn its fingerprint."""
+        body = ("@jax.jit\ndef f(x):\n    return float(x)\n")
+        a = source_lint.lint_source("import jax\n\n" + body, path="m.py")
+        b = source_lint.lint_source(
+            "import jax\n\n\n# comment\nX = 1\n\n" + body, path="m.py")
+        assert a[0].fingerprint == b[0].fingerprint
+        assert a[0].line != b[0].line
+
+    def test_split_baseline(self, tmp_path):
+        src = ("import jax\n\n@jax.jit\ndef f(x):\n"
+               "    return float(x) + int(x)\n")
+        findings = source_lint.lint_source(src, path="m.py")
+        assert len(findings) == 2
+        bl = tmp_path / "baseline.txt"
+        bl.write_text("# header\n%s  # justified\nm.py::gone::rule\n"
+                      % findings[0].fingerprint)
+        new, suppressed, stale = source_lint.split_baseline(
+            findings, source_lint.load_baseline(str(bl)))
+        assert new == [findings[1]]
+        assert suppressed == [findings[0]]
+        assert stale == ["m.py::gone::rule"]
+
+    def test_repo_is_clean_against_committed_baseline(self):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        findings = source_lint.lint_paths(
+            [os.path.join(repo, "mxnet_tpu")], root=repo)
+        baseline = source_lint.load_baseline(
+            os.path.join(repo, "ci", "mxlint_baseline.txt"))
+        new = [f for f in findings if f.fingerprint not in baseline]
+        assert not new, "new lint findings (fix or baseline with a " \
+            "justification):\n%s" % "\n".join(str(f) for f in new)
+
+
+# -- lock-discipline checker --------------------------------------------------
+class TestLockcheck:
+    def test_seeded_inversion_raises_under_pytest(self, lc_state):
+        """ISSUE 8 seeded violation: A->B observed, then B->A must trip the
+        inversion detector (and raise, since we run under pytest)."""
+        a = lockcheck.CheckedLock("A")
+        b = lockcheck.CheckedLock("B")
+        with a:
+            with b:
+                pass
+        with b:
+            with pytest.raises(lockcheck.LockDisciplineError,
+                               match="inversion"):
+                with a:
+                    pass
+        assert [d.code for d in lockcheck.violations()] == ["lock-inversion"]
+
+    def test_three_lock_cycle_detected(self, lc_state):
+        """A->B, B->C, C->A deadlocks three threads with no direct reverse
+        edge — the detector must catch N-lock cycles, not just pairs."""
+        a = lockcheck.CheckedLock("A")
+        b = lockcheck.CheckedLock("B")
+        c = lockcheck.CheckedLock("C")
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        with c:
+            with pytest.raises(lockcheck.LockDisciplineError,
+                               match="inversion"):
+                with a:
+                    pass
+
+    def test_consistent_order_is_clean(self, lc_state):
+        a = lockcheck.CheckedLock("A")
+        b = lockcheck.CheckedLock("B")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert lockcheck.violations() == []
+
+    def test_trylock_is_exempt_from_order_graph(self, lc_state):
+        """The deadlock-avoidance idiom (trylock, back off on failure)
+        cannot deadlock — it must not poison the global order graph."""
+        a = lockcheck.CheckedLock("A")
+        b = lockcheck.CheckedLock("B")
+        with a:
+            with b:
+                pass
+        with b:
+            assert a.acquire(blocking=False)  # trylock: no B->A edge
+            a.release()
+        with a:  # the blocking A->B order is still the only one recorded
+            with b:
+                pass
+        assert lockcheck.violations() == []
+
+    def test_cross_thread_release_detected(self, lc_state):
+        a = lockcheck.CheckedLock("A")
+        a.acquire()
+        caught = []
+
+        def stray_release():
+            try:
+                a.release()
+            except lockcheck.LockDisciplineError as e:
+                caught.append(e)
+
+        t = threading.Thread(target=stray_release)
+        t.start()
+        t.join()
+        assert len(caught) == 1 and "does not hold" in str(caught[0])
+        assert [d.code for d in lockcheck.violations()] \
+            == ["lock-bad-release"]
+        assert a.held()  # ownership survived the stray release attempt
+        a.release()
+
+    def test_reentry_detected(self, lc_state):
+        a = lockcheck.CheckedLock("A")
+        with a:
+            with pytest.raises(lockcheck.LockDisciplineError,
+                               match="re-acquires"):
+                a.acquire()
+
+    def test_reentry_raises_even_outside_pytest(self, lc_state,
+                                                monkeypatch):
+        """Canary mode records-and-continues for every kind EXCEPT reentry:
+        continuing there would block forever on the non-reentrant lock."""
+        monkeypatch.delenv("PYTEST_CURRENT_TEST", raising=False)
+        a = lockcheck.CheckedLock("A")
+        with a:
+            with pytest.raises(lockcheck.LockDisciplineError):
+                a.acquire()
+
+    def test_unguarded_mutation_detected(self, lc_state):
+        mu = lockcheck.CheckedLock("mu")
+        d = lockcheck.guard({"k": 1}, mu, "_stats")
+        with mu:
+            d["k"] = 2          # guarded: fine
+            d.update(j=3)
+        assert d["k"] == 2 and len(d) == 2 and "j" in d
+        assert dict(d) == {"k": 2, "j": 3}  # mapping protocol intact
+        with pytest.raises(lockcheck.LockDisciplineError,
+                           match="unguarded"):
+            d["k"] = 3
+        with pytest.raises(lockcheck.LockDisciplineError):
+            d.pop("j")
+
+    def test_field_reassignment_detected(self, lc_state):
+        class Box:
+            pass
+        box = Box()
+        box.mu = lockcheck.CheckedLock("mu")
+        box.data = None
+        lockcheck.instrument_fields(box, {"data": "mu"})
+        assert isinstance(box, Box)  # subclass swap keeps isinstance
+        with box.mu:
+            box.data = {"ok": 1}    # held: fine
+        with pytest.raises(lockcheck.LockDisciplineError,
+                           match="reassigned"):
+            box.data = {}
+
+    def test_engine_burst_under_lockcheck_is_clean(self, lc_state,
+                                                   tel_disabled,
+                                                   monkeypatch):
+        """The real engine's documented discipline holds: a concurrent
+        burst under MXNET_LOCKCHECK=1 records zero violations (any
+        violation would raise out of the engine thread's _report under
+        pytest and surface as a failed request below)."""
+        monkeypatch.setenv("MXNET_LOCKCHECK", "1")
+        sym, params = tiny_mlp_checkpoint()
+        with Engine(sym, params, {"data": (8,)},
+                    ladder=BucketLadder((1, 2))) as eng:
+            assert isinstance(eng._cache_mu, lockcheck.CheckedLock)
+            errors = []
+
+            def client():
+                try:
+                    for _ in range(5):
+                        r = eng.submit(
+                            {"data": np.zeros((1, 8), np.float32)})
+                        r.result(30.0)
+                except Exception as e:
+                    errors.append(e)
+
+            threads = [threading.Thread(target=client) for _ in range(3)]
+            for t in threads:
+                t.start()
+            eng.stats()
+            for t in threads:
+                t.join()
+            stats = eng.stats()
+        assert not errors
+        assert stats["completed"] == 15
+        assert lockcheck.violations() == []
+
+    def test_violation_counts_into_telemetry(self, lc_state, monkeypatch,
+                                             tmp_path):
+        monkeypatch.setenv("MXNET_TELEMETRY", "1")
+        monkeypatch.setenv("MXNET_TELEMETRY_FILE",
+                           str(tmp_path / "t.jsonl"))
+        tin._reset_for_tests()
+        try:
+            mu = lockcheck.CheckedLock("mu")
+            d = lockcheck.guard({}, mu, "_f")
+            with pytest.raises(lockcheck.LockDisciplineError):
+                d["x"] = 1
+            c = tin.registry().get("lockcheck_violations_total")
+            assert c is not None
+            assert c.value(kind="unguarded-mutation") == 1
+        finally:
+            tin._reset_for_tests()
+
+
+# -- off-path guards (style of test_noop_guard_tracing) -----------------------
+class TestOffPathsAreFree:
+    def test_lockcheck_off_is_plain_locks(self, monkeypatch, tel_disabled):
+        """MXNET_LOCKCHECK unset: the engine's mutexes stay vanilla
+        threading.Lock, the containers stay builtin dict/set, and the
+        analysis package never wraps anything — byte-identical behavior."""
+        monkeypatch.delenv("MXNET_LOCKCHECK", raising=False)
+        sym, params = tiny_mlp_checkpoint()
+        with Engine(sym, params, {"data": (8,)},
+                    ladder=BucketLadder((1, 2)), start=False) as eng:
+            lock_t = type(threading.Lock())
+            assert type(eng._cache_mu) is lock_t
+            assert type(eng._device_mu) is lock_t
+            assert type(eng._stats_mu) is lock_t
+            assert type(eng._stats) is dict
+            assert type(eng._compiled) is set
+            assert type(eng).__name__ == "Engine"  # no subclass swap
+
+    def test_analyzers_off_warmup_rows_carry_none(self, monkeypatch,
+                                                  tel_disabled):
+        monkeypatch.delenv("MXNET_GRAPH_ANALYZERS", raising=False)
+        sym, params = tiny_mlp_checkpoint()
+        with Engine(sym, params, {"data": (8,)},
+                    ladder=BucketLadder((1,)), start=False) as eng:
+            report = eng.warmup()
+            assert all(r["check_warnings"] is None for r in report)
+            assert eng.stats()["warmup"]["check_warnings"] is None
+
+    def test_analyzers_on_warmup_rows_count(self, monkeypatch,
+                                            tel_disabled):
+        monkeypatch.setenv("MXNET_GRAPH_ANALYZERS", "1")
+        sym, params = tiny_mlp_checkpoint()
+        with Engine(sym, params, {"data": (8,)},
+                    ladder=BucketLadder((1,)), start=False) as eng:
+            report = eng.warmup()
+            assert all(r["check_warnings"] == 0 for r in report)
+            assert eng.stats()["warmup"]["check_warnings"] == 0

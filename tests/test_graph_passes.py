@@ -146,6 +146,27 @@ def test_bn_affine_rewrite_fires_eval_only(monkeypatch):
     assert "_bn_affine" not in _plan_ops(exe, True)
 
 
+def test_eval_plan_stochastic_survivor_trips_analyzer(monkeypatch):
+    """ISSUE 8 satellite: a mode="always" Dropout survives the inference
+    rewrite (by design — MC-dropout), and the graph-IR analyzer flags
+    exactly that survivor in the eval plan; the plain Dropout next to it is
+    deleted and stays silent."""
+    monkeypatch.setenv("MXNET_GRAPH_PASSES", "1")
+    data = sym.var("data")
+    out = sym.Dropout(sym.Dropout(data, name="plain", p=0.5),
+                      name="mc", p=0.5, mode="always")
+    exe = out.bind(None, {"data": nd.array(np.ones((2, 4), np.float32))})
+    assert _plan_ops(exe, False) == ["Dropout"]  # only the forced one
+    diags = exe.check(is_train=False)
+    assert [(d.code, d.where) for d in diags] \
+        == [("prng-eval-stochastic", "mc")]
+    # the analyzer sees the plan the passes actually produce: with the
+    # forced dropout removed the eval plan is clean
+    clean = sym.Dropout(data, name="plain2", p=0.5).bind(
+        None, {"data": nd.array(np.ones((2, 4), np.float32))})
+    assert clean.check(is_train=False) == []
+
+
 def test_multi_output_heads_group_parity(monkeypatch):
     data = sym.var("data")
     sl = sym.SliceChannel(data, name="sl", num_outputs=2, axis=1)

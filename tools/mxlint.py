@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""mxlint — JAX-hazard source lint CLI (ISSUE 8; docs/ANALYSIS.md).
+
+Runs ``mxnet_tpu.analysis.source_lint`` over the codebase and diffs the
+findings against the committed baseline:
+
+    python tools/mxlint.py                      # lint mxnet_tpu/ vs baseline
+    python tools/mxlint.py path/to/file.py      # lint specific paths
+    python tools/mxlint.py --no-baseline        # raw findings, no suppression
+    python tools/mxlint.py --write-baseline     # accept current findings
+    python tools/mxlint.py --list-rules         # rule table
+
+Exit status: 0 = no findings outside the baseline, 1 = new findings (each
+printed with its fingerprint, ready to fix or baseline WITH a
+justification), 2 = usage error.  Stale baseline entries (matching nothing)
+are reported but never fail the run and never auto-pruned — deleting a
+justified suppression is a reviewed change, not a side effect.
+
+CI runs this via ``ci/check_lint.py`` in the unit tier.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+DEFAULT_BASELINE = os.path.join(_REPO, "ci", "mxlint_baseline.txt")
+
+
+def _write_baseline(findings, path):
+    """Rewrite the baseline as the current finding set, preserving the
+    justification comment of every fingerprint already listed; new entries
+    get a TODO the reviewer must replace."""
+    just = {}
+    if os.path.exists(path):
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                if "  #" in line and not line.lstrip().startswith("#"):
+                    fp, comment = line.split("  #", 1)
+                    just[fp.strip()] = comment.strip()
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write("# mxlint baseline — justified legacy findings "
+                 "(docs/ANALYSIS.md).\n#\n"
+                 "# One fingerprint per line; '  # ...' is the "
+                 "justification (required).\n"
+                 "# Regenerate with: python tools/mxlint.py "
+                 "--write-baseline\n\n")
+        for f in findings:
+            fh.write("%s  # %s\n" % (
+                f.fingerprint,
+                just.get(f.fingerprint, "TODO: justify or fix")))
+
+
+def main(argv=None):
+    from mxnet_tpu.analysis import source_lint
+
+    ap = argparse.ArgumentParser(prog="mxlint", description=__doc__)
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: mxnet_tpu/)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, suppress nothing")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept the current finding set into --baseline")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in source_lint.RULES:
+            print(r)
+        return 0
+
+    paths = args.paths or [os.path.join(_REPO, "mxnet_tpu")]
+    findings = source_lint.lint_paths(paths, root=_REPO)
+
+    if args.write_baseline:
+        _write_baseline(findings, args.baseline)
+        print("mxlint: wrote %d entr%s to %s" % (
+            len(findings), "y" if len(findings) == 1 else "ies",
+            os.path.relpath(args.baseline, _REPO)))
+        return 0
+
+    baseline = set() if args.no_baseline \
+        else source_lint.load_baseline(args.baseline)
+    new, suppressed, stale = source_lint.split_baseline(findings, baseline)
+
+    for f in new:
+        print(f)
+        print("    fingerprint: %s" % f.fingerprint)
+    for fp in stale:
+        print("mxlint: stale baseline entry (matches nothing — consider "
+              "removing): %s" % fp)
+    print("mxlint: %d finding%s (%d baselined, %d new)" % (
+        len(findings), "" if len(findings) == 1 else "s",
+        len(suppressed), len(new)))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
