@@ -20,11 +20,14 @@ import sys
 TOP_KEYS = {"metric", "value", "unit", "vs_baseline", "telemetry"}
 TEL_REQ_KEYS = {"compile_s", "peak_hbm_bytes", "data_wait_frac"}
 # dispatches_per_step (ISSUE 3 fused Module step), warmup_s (ISSUE 6 AOT
-# cache restart surface) and the graph-pass keys (ISSUE 7: plan nodes
-# in/out of the pass pipeline + its wall time) are optional: captures
-# predating that work carry only the three original keys
+# cache restart surface), the graph-pass keys (ISSUE 7: plan nodes in/out
+# of the pass pipeline + its wall time) and autotune_trials (ISSUE 9:
+# candidate configs measured — 0/null in steady state, when the winner
+# store answers) are optional: captures predating that work carry only
+# the three original keys
 TEL_OPT_KEYS = {"dispatches_per_step", "warmup_s",
-                "graph_nodes_pre", "graph_nodes_post", "pass_time_s"}
+                "graph_nodes_pre", "graph_nodes_post", "pass_time_s",
+                "autotune_trials"}
 TEL_KEYS = TEL_REQ_KEYS | TEL_OPT_KEYS
 
 # SERVE_BENCH line (tools/loadgen.py, ISSUE 2) — docs/SERVING.md schema
@@ -39,6 +42,57 @@ SERVE_MODES = {"closed", "open"}
 
 class SchemaError(ValueError):
     pass
+
+
+# loadgen request-trace record (tools/loadgen.py --save-trace, ISSUE 9) —
+# the offline input the bucket-ladder tuner replays (autotune/ladder.py)
+TRACE_KEYS = {"t", "n", "shapes", "class"}
+
+
+def validate_trace_line(obj, where="<line>"):
+    """Validate one --save-trace JSONL record; raises SchemaError."""
+    if not isinstance(obj, dict):
+        raise SchemaError("%s: trace record must be a JSON object, got %s"
+                          % (where, type(obj).__name__))
+    if set(obj) != TRACE_KEYS:
+        raise SchemaError("%s: trace record keys %s != %s"
+                          % (where, sorted(obj), sorted(TRACE_KEYS)))
+    if not _num(obj["t"]) or obj["t"] < 0:
+        raise SchemaError("%s: 't' must be a non-negative number (seconds "
+                          "since run start)" % where)
+    if not isinstance(obj["n"], int) or isinstance(obj["n"], bool) \
+            or obj["n"] < 1:
+        raise SchemaError("%s: 'n' must be a positive int sample count"
+                          % where)
+    shp = obj["shapes"]
+    if not isinstance(shp, dict) or not shp:
+        raise SchemaError("%s: 'shapes' must be a non-empty object of "
+                          "input -> per-sample dims" % where)
+    for name, dims in shp.items():
+        if not isinstance(name, str) or not isinstance(dims, list) or any(
+                not isinstance(d, int) or isinstance(d, bool) or d < 0
+                for d in dims):
+            raise SchemaError(
+                "%s: shapes[%r] must be a list of non-negative int dims"
+                % (where, name))
+    if not isinstance(obj["class"], str) or not obj["class"]:
+        raise SchemaError("%s: 'class' must be a non-empty string" % where)
+
+
+def validate_trace_file(path):
+    """Validate every line of a --save-trace JSONL file; empty = error
+    (an empty trace replays to nothing — the tuner would crash later)."""
+    n = 0
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            validate_trace_line(json.loads(line), "%s:%d" % (path, i))
+            n += 1
+    if not n:
+        raise SchemaError("%s: empty trace file" % path)
+    return n
 
 
 def _num(x):
@@ -112,6 +166,12 @@ def validate_line(obj, where="<line>"):
         if pt is not None and (not _num(pt) or pt < 0):
             raise SchemaError(
                 "%s: telemetry.pass_time_s must be a non-negative number "
+                "or null" % where)
+        at = tel.get("autotune_trials")
+        if at is not None and (not isinstance(at, int)
+                               or isinstance(at, bool) or at < 0):
+            raise SchemaError(
+                "%s: telemetry.autotune_trials must be a non-negative int "
                 "or null" % where)
 
 
@@ -215,6 +275,12 @@ def self_test():
          "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
                        "data_wait_frac": 0.0, "graph_nodes_pre": None,
                        "graph_nodes_post": None, "pass_time_s": None}},
+        {"metric": "m", "value": 1, "unit": "samples/s",
+         "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0, "autotune_trials": 15}},
+        {"metric": "m", "value": 1, "unit": "samples/s",
+         "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0, "autotune_trials": None}},
     ]
     bad = [
         {},                                                  # empty
@@ -247,6 +313,10 @@ def self_test():
          "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
                        "data_wait_frac": 0.0,
                        "pass_time_s": -0.1}},            # negative pass time
+        {"metric": "m", "value": 1, "unit": "img/s",
+         "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0,
+                       "autotune_trials": 1.5}},         # float trial count
     ]
     serve_good = {"mode": "closed", "requests": 10, "completed": 9,
                   "shed": 1, "timeouts": 0, "errors": 0, "shed_rate": 0.1,
@@ -288,6 +358,28 @@ def self_test():
             continue
         raise AssertionError(
             "self-test: bad SERVE_BENCH line %d passed: %r" % (i, obj))
+    trace_good = {"t": 0.125, "n": 3, "shapes": {"data": [8]},
+                  "class": "open"}
+    validate_trace_line(trace_good, "self-test trace good")
+    validate_trace_line({"t": 0, "n": 1, "shapes": {"data": []},
+                         "class": "closed"}, "self-test trace good2")
+    trace_bad = [
+        {},
+        dict(trace_good, t=-1.0),                    # negative arrival
+        dict(trace_good, n=0),                       # empty request
+        dict(trace_good, n=2.5),                     # non-int count
+        dict(trace_good, shapes={}),                 # no inputs
+        dict(trace_good, shapes={"data": [8.5]}),    # float dim
+        {k: v for k, v in trace_good.items() if k != "class"},
+        dict(trace_good, extra=1),                   # unknown key
+    ]
+    for i, obj in enumerate(trace_bad):
+        try:
+            validate_trace_line(obj, "self-test trace bad[%d]" % i)
+        except SchemaError:
+            continue
+        raise AssertionError(
+            "self-test: bad trace record %d passed: %r" % (i, obj))
 
 
 def main(argv):
@@ -296,9 +388,16 @@ def main(argv):
         args.remove("--self-test")
         self_test()
         print("self-test ok")
+    trace_mode = "--trace" in args
+    if trace_mode:
+        args.remove("--trace")
     rc = 0
     for path in args:
         try:
+            if trace_mode:
+                n = validate_trace_file(path)
+                print("%s: ok (%d trace records)" % (path, n))
+                continue
             if path == "-":
                 for n, line in enumerate(sys.stdin, 1):
                     line = line.strip()

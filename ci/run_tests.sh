@@ -59,6 +59,10 @@ case "$tier" in
     # constant subgraph must reduce to the hand-counted minimum node count
     # with forward parity against MXNET_GRAPH_PASSES=0
     ./dev.sh python ci/check_graph_passes.py
+    # autotuning smoke (ISSUE 9): loadgen-recorded trace lints, the ladder
+    # proposal beats the default on that trace, and a second autotune.py
+    # run against the warm winner store performs zero new measurements
+    ./dev.sh python ci/check_autotune.py
     # source lint (ISSUE 8): mxlint over mxnet_tpu/ must be clean against
     # the committed baseline, and a file of seeded hazards must trip every
     # rule (new findings = nonzero exit; docs/ANALYSIS.md)

@@ -55,6 +55,7 @@ def __getattr__(name):
         "model": ".model",
         "name": ".name",
         "attribute": ".attribute",
+        "autotune": ".autotune",
         "operator": ".operator",
         "rnn": ".rnn",
         "executor_manager": ".executor_manager",

@@ -333,7 +333,7 @@ def instrument_engine(engine):
     mutex      owns
     ========== =========================================
     _cache_mu  _cache, _direct_cache, _compiled
-    _stats_mu  _stats, _bucket_counts, _warmup
+    _stats_mu  _stats, _bucket_stats, _warmup
     _device_mu device-exclusive sections (no container)
     ========== =========================================
     """
@@ -346,8 +346,8 @@ def instrument_engine(engine):
                                  "_direct_cache")
     engine._compiled = guard(engine._compiled, engine._cache_mu, "_compiled")
     engine._stats = guard(engine._stats, engine._stats_mu, "_stats")
-    engine._bucket_counts = guard(engine._bucket_counts, engine._stats_mu,
-                                  "_bucket_counts")
+    engine._bucket_stats = guard(engine._bucket_stats, engine._stats_mu,
+                                 "_bucket_stats")
     # last: the subclass swap must not flag the guard() assignments above
     instrument_fields(engine, {"_warmup": "_stats_mu"})
     return engine

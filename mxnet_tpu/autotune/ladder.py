@@ -1,0 +1,212 @@
+"""Serving bucket-ladder tuner — replay a recorded traffic trace (ISSUE 9).
+
+``tools/loadgen.py --save-trace`` dumps one JSONL record per submitted
+request: ``{"t": arrival seconds, "n": samples, "shapes": {input:
+per-sample dims}, "class": generator class}``.  This module replays that
+trace through the micro-batcher's coalescing rules (same shape class, a
+batch closes when its oldest member has waited ``max_wait`` or the top
+rung is full) against a CANDIDATE ladder, and scores the ladder with
+
+    objective = padding inflation x compile count
+      padding inflation = padded elements dispatched / real elements >= 1
+      compile count     = ladder rungs + distinct oversize (direct) sigs
+
+— the two costs a TPU serving ladder trades (SURVEY §7.3: every rung is
+an XLA executable; every padded row is wasted HBM+FLOPs).  The proposer
+greedily grows a rung set from the replayed batch-total distribution and
+returns the hand-configured default whenever search cannot strictly beat
+it (never-worse, like the kernel searcher).
+
+Pure host math: no jax, no threads — the Engine adopts a proposed ladder
+at construction via the winner store (``autotune.tuned_ladder``).
+"""
+from __future__ import annotations
+
+import json
+
+__all__ = ["load_trace", "objective", "propose", "ladder_sig",
+           "trace_sample_shapes", "LADDER_KERNEL"]
+
+LADDER_KERNEL = "bucket_ladder"  # the winner-store "kernel" name
+
+_REQUIRED = ("t", "n", "shapes", "class")
+
+
+def load_trace(path):
+    """Read + validate a request-trace JSONL → time-sorted record list.
+    Raises ValueError on a malformed line (CI lints the same schema via
+    ``ci/check_bench_schema.py --trace``)."""
+    recs = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for i, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError as e:
+                raise ValueError("%s:%d: not JSON: %s" % (path, i, e))
+            missing = [k for k in _REQUIRED if k not in obj]
+            if missing or not isinstance(obj.get("shapes"), dict) \
+                    or not isinstance(obj.get("n"), int) or obj["n"] < 1:
+                raise ValueError("%s:%d: bad trace record %r" % (path, i, obj))
+            recs.append(obj)
+    if not recs:
+        raise ValueError("%s: empty trace" % path)
+    recs.sort(key=lambda r: float(r["t"]))
+    return recs
+
+
+def ladder_sig(sample_shapes):
+    """Store shape-signature for a serving stream: the declared per-sample
+    shapes, canonically ordered (the Engine computes the same sig from its
+    ``sample_shapes`` when adopting a tuned ladder)."""
+    return ";".join(
+        "%s:%s" % (n, "x".join(str(int(d)) for d in s) or "scalar")
+        for n, s in sorted(dict(sample_shapes).items()))
+
+
+def trace_sample_shapes(recs):
+    """Per-input elementwise-max sample shape over the trace — the base
+    shape class the recorded stream was generated against."""
+    out = {}
+    for r in recs:
+        for name, dims in r["shapes"].items():
+            dims = tuple(int(d) for d in dims)
+            prev = out.get(name)
+            out[name] = dims if prev is None else tuple(
+                max(a, b) for a, b in zip(prev, dims))
+    return out
+
+
+def _vol(dims):
+    v = 1
+    for d in dims:
+        v *= int(d)
+    return v
+
+
+def _sample_elems(rec):
+    """Real elements one sample of this request carries, summed over
+    inputs (scalars count 1 so a shapeless stream still scores)."""
+    return sum(max(1, _vol(d)) for d in rec["shapes"].values()) or 1
+
+
+def replay(recs, batch_sizes, max_wait_s=0.005):
+    """Coalesce the trace against a candidate ladder.
+
+    → ``{"real", "padded", "batches", "direct_sigs", "rungs_used",
+    "totals"}``: real/padded element totals over every dispatched batch,
+    the batch count, the set of distinct oversize one-off signatures (each
+    its own compile, exactly like the Engine's direct path), the rungs
+    that actually dispatched, and every closed batch's (shape class,
+    total n) — the empirical coalesced-size distribution ``propose`` grows
+    rungs from, emitted HERE so the proposer and the scorer can never
+    apply different coalescing rules.
+    """
+    sizes = sorted({int(b) for b in batch_sizes})
+    if not sizes or sizes[0] < 1:
+        raise ValueError("batch_sizes must be positive ints, got %r"
+                         % (batch_sizes,))
+    top = sizes[-1]
+    real = padded = batches = 0
+    direct_sigs = set()
+    rungs_used = set()
+    totals = []
+    open_batches = {}  # shape class -> [t0, total_n, real_elems, max_elems]
+
+    def close(cls, b):
+        nonlocal real, padded, batches
+        _, total_n, relems, melems = b
+        rung = next(s for s in sizes if s >= total_n)
+        rungs_used.add(rung)
+        real += relems
+        padded += rung * melems
+        batches += 1
+        totals.append((cls, total_n))
+
+    for rec in recs:
+        cls = tuple(sorted((n, tuple(int(d) for d in s))
+                           for n, s in rec["shapes"].items()))
+        n, t = int(rec["n"]), float(rec["t"])
+        elems = _sample_elems(rec)
+        if n > top:
+            # oversize: exact-shape one-off dispatch, no padding
+            real += n * elems
+            padded += n * elems
+            direct_sigs.add((cls, n))
+            continue
+        b = open_batches.get(cls)
+        if b is not None and (t - b[0] > max_wait_s or b[1] + n > top):
+            close(cls, b)
+            b = None
+        if b is None:
+            open_batches[cls] = [t, n, n * elems, elems]
+        else:
+            b[1] += n
+            b[2] += n * elems
+            b[3] = max(b[3], elems)
+    for cls, b in open_batches.items():
+        close(cls, b)
+    return {"real": real, "padded": padded, "batches": batches,
+            "direct_sigs": direct_sigs, "rungs_used": sorted(rungs_used),
+            "totals": totals}
+
+
+def objective(batch_sizes, recs, max_wait_s=0.005):
+    """padding inflation x compile count for one candidate ladder on one
+    trace — lower is better; 1 x len(ladder) is the floor."""
+    r = replay(recs, batch_sizes, max_wait_s=max_wait_s)
+    inflation = r["padded"] / r["real"] if r["real"] else 1.0
+    compiles = len(set(int(b) for b in batch_sizes)) + len(r["direct_sigs"])
+    return inflation * compiles
+
+
+def propose(recs, default=(1, 2, 4, 8), max_rungs=4, max_wait_s=0.005):
+    """Greedy rung-set search over the replayed batch-total distribution.
+
+    → ``(ladder tuple, report)``.  Candidates are the batch totals an
+    unconstrained replay (single top-rung ladder) actually forms, so every
+    proposed rung is a size real coalesced traffic produced.  Start from
+    the covering top rung, greedily add the rung with the largest
+    objective drop, stop at ``max_rungs`` or when nothing improves — then
+    keep the DEFAULT unless the proposal is strictly better on this trace.
+    """
+    default = tuple(sorted({int(b) for b in default}))
+    max_n = max(int(r["n"]) for r in recs)
+    cover = max(max_n, default[-1])
+    totals = {t for b, t in _batch_totals(recs, cover, max_wait_s)}
+    cand = sorted(totals | {cover})
+    ladder = [cand[-1]]
+    best = objective(ladder, recs, max_wait_s)
+    while len(ladder) < max_rungs:
+        gains = []
+        for r in cand:
+            if r in ladder:
+                continue
+            o = objective(ladder + [r], recs, max_wait_s)
+            if o < best:
+                gains.append((o, r))
+        if not gains:
+            break
+        best, rung = min(gains)
+        ladder.append(rung)
+    tuned = tuple(sorted(ladder))
+    obj_default = objective(default, recs, max_wait_s)
+    obj_tuned = objective(tuned, recs, max_wait_s)
+    report = {"requests": len(recs), "candidates": cand,
+              "objective_default": obj_default, "objective_tuned": obj_tuned,
+              "default": default}
+    if obj_tuned < obj_default:
+        return tuned, report
+    # never worse: the hand-configured ladder stays on a tie or loss
+    report["objective_tuned"] = obj_default
+    return default, report
+
+
+def _batch_totals(recs, top, max_wait_s):
+    """(shape class, total n) of every batch a single-rung ``top`` ladder
+    replay forms — the same ``replay`` loop that scores candidates, so the
+    proposer's candidate rungs always come from batches the scorer forms
+    (oversize direct dispatches are excluded by replay itself)."""
+    return replay(recs, [top], max_wait_s=max_wait_s)["totals"]

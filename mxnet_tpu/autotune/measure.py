@@ -1,0 +1,68 @@
+"""On-device candidate measurement with warmup/repeat discipline (ISSUE 9).
+
+One trial = build the candidate (a FRESH jitted callable traced under a
+``store.override`` pin, so the pinned config shapes that trace and jit's
+signature cache can never hand back another candidate's executable), run
+``warmup`` untimed calls to absorb compile + first-dispatch noise, then
+time ``repeat`` synced calls and keep the **median** (robust against a
+co-tenant stealing one sample; means are not).  Every trial is counted —
+process-locally via :func:`measurements` (the warm-store acceptance
+asserts a second search performs ZERO of these) and in
+``autotune_trials_total{kernel}`` when telemetry is on.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from . import store
+
+__all__ = ["time_callable", "measure_candidate", "measurements"]
+
+_mu = threading.Lock()
+_count = [0]
+
+
+def measurements():
+    """Trials measured by this process since import (or the last reset)."""
+    with _mu:
+        return _count[0]
+
+
+def _reset_stats_for_tests():
+    with _mu:
+        _count[0] = 0
+
+
+def _block(x):
+    import jax
+
+    return jax.block_until_ready(x)
+
+
+def time_callable(fn, args=(), warmup=2, repeat=5):
+    """Median synced wall-seconds of ``fn(*args)`` over ``repeat`` timed
+    calls after ``warmup`` untimed ones."""
+    for _ in range(max(0, int(warmup))):
+        _block(fn(*args))
+    times = []
+    for _ in range(max(1, int(repeat))):
+        t0 = time.perf_counter()
+        _block(fn(*args))
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return float(times[len(times) // 2])
+
+
+def measure_candidate(kernel, config, build, args=(), warmup=2, repeat=5):
+    """One counted trial: pin ``config`` for ``kernel``, ``build()`` the
+    candidate callable under the pin, time it.  → median seconds."""
+    with store.override(kernel, config):
+        fn = build()
+        seconds = time_callable(fn, args, warmup=warmup, repeat=repeat)
+    with _mu:
+        _count[0] += 1
+    from .. import telemetry
+
+    telemetry.note_autotune_trial(kernel, seconds)
+    return seconds

@@ -1,0 +1,74 @@
+"""Grid + greedy config search (ISSUE 9).
+
+Even simple measured search over a declared space beats expert constants
+(PAPERS.md 1805.08166) — and for the space sizes our kernels declare
+(tens of configs) an exhaustive grid IS the right searcher.  When the
+constrained grid exceeds ``max_trials``, greedy coordinate descent from
+the default explores one parameter at a time instead.
+
+The never-worse contract: the DEFAULT config is measured first and a
+candidate replaces it only on a strictly lower time — on a tie the
+hand-tuned default stays, so adopting a search result can never regress
+the shipped behavior (acceptance-tested).
+"""
+from __future__ import annotations
+
+import itertools
+
+__all__ = ["search"]
+
+
+def search(space, measure, ctx=None, max_trials=64):
+    """Search ``space`` with ``measure(config) -> seconds``.
+
+    → ``(best_config, results)`` where results is the trial list
+    (``{"config", "seconds"}`` in measurement order, default first).
+    """
+    ctx = dict(ctx or {})
+    # enumerate only one config past max_trials: enough to decide
+    # grid-vs-greedy without materializing a huge constrained product
+    configs = list(itertools.islice(space.iter_configs(**ctx),
+                                    max_trials + 1))
+    results = []
+    tried = set()
+    best = {"config": None, "seconds": None}
+
+    def key(cfg):
+        return tuple(sorted(cfg.items()))
+
+    def trial(cfg):
+        if key(cfg) in tried:
+            return None
+        tried.add(key(cfg))
+        seconds = measure(dict(cfg))
+        results.append({"config": dict(cfg), "seconds": seconds})
+        # strict <: the default (measured first) wins every tie
+        if best["seconds"] is None or seconds < best["seconds"]:
+            best["config"], best["seconds"] = dict(cfg), seconds
+        return seconds
+
+    trial(configs[0])  # the default, always
+    if len(configs) <= max_trials:
+        for cfg in configs[1:]:
+            if len(tried) >= max_trials:
+                break
+            trial(cfg)
+    else:
+        # greedy coordinate descent from the default: sweep one param at a
+        # time against the current best, repeat until a full sweep holds
+        improved = True
+        while improved and len(tried) < max_trials:
+            improved = False
+            for name in sorted(space.params):
+                for choice in space.params[name]:
+                    if len(tried) >= max_trials:
+                        break
+                    cand = dict(best["config"])
+                    cand[name] = choice
+                    if key(cand) in tried or not space.admits(cand, **ctx):
+                        continue
+                    before = best["seconds"]
+                    trial(cand)
+                    if best["seconds"] < before:
+                        improved = True
+    return best["config"], results

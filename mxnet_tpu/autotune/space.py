@@ -1,0 +1,140 @@
+"""Tuning-space declarations (ISSUE 9).
+
+A :class:`TuningSpace` is a kernel's statement of what is tunable: named
+parameters with finite choice lists, the hand-tuned **default** config (the
+shipped behavior, always config #0 — the searcher measures it first and a
+candidate must beat it STRICTLY to replace it), and an optional constraint
+predicate over (config, shape context) that prunes configs the hardware
+would reject — the declared-space half of the "Learning to Optimize Tensor
+Programs" loop (PAPERS.md 1805.08166), with the grid/greedy searcher in
+``search.py`` standing in for the learned cost model.
+
+Registered spaces (this module, at import):
+
+* ``dconv_col_pallas`` — the row-block size ``nblk`` of the fused
+  deformable-conv sampling kernel (`ops/pallas_kernels.py`), constrained by
+  the same ``dconv_bwd_vmem_bytes`` VMEM guard that drives the
+  pallas-vs-XLA auto branch: a candidate whose backward working set would
+  hard-fail Mosaic is never measured.
+"""
+from __future__ import annotations
+
+import itertools
+
+__all__ = ["TuningSpace", "register_space", "get_space", "spaces",
+           "dconv_shape_sig"]
+
+_SPACES = {}
+
+
+class TuningSpace:
+    """Declared config space of one kernel.
+
+    Parameters
+    ----------
+    name : str
+        Kernel name — the store/lookup key component.
+    params : dict
+        ``param name -> sequence of choices`` (finite, order preserved).
+    default : dict
+        The hand-tuned config; must pick one choice per param.  Always
+        admitted (it is the shipped behavior) even where the constraint
+        would prune it.
+    constraint : callable, optional
+        ``constraint(config, **ctx) -> bool``; ``ctx`` is the shape
+        context handed to :meth:`configs` (e.g. N/HW/C/itemsize for
+        dconv).  False prunes the candidate.
+    """
+
+    def __init__(self, name, params, default, constraint=None):
+        self.name = str(name)
+        self.params = {str(k): tuple(v) for k, v in params.items()}
+        for k, v in self.params.items():
+            if not v:
+                raise ValueError("empty choice list for %r.%s" % (name, k))
+        self.default = dict(default)
+        if set(self.default) != set(self.params):
+            raise ValueError(
+                "default config keys %s != params %s"
+                % (sorted(self.default), sorted(self.params)))
+        self.constraint = constraint
+
+    def admits(self, config, **ctx):
+        """Constraint check; the default config is always admitted."""
+        if config == self.default:
+            return True
+        if self.constraint is None:
+            return True
+        return bool(self.constraint(config, **ctx))
+
+    def iter_configs(self, **ctx):
+        """Constraint-filtered grid as a lazy generator, DEFAULT FIRST
+        (the searcher's never-worse guarantee hangs on measuring it).
+        Lazy so the searcher can count just past ``max_trials`` to pick
+        grid-vs-greedy without materializing a huge product."""
+        names = sorted(self.params)
+        yield dict(self.default)
+        for combo in itertools.product(*(self.params[n] for n in names)):
+            cfg = dict(zip(names, combo))
+            if cfg != self.default and self.admits(cfg, **ctx):
+                yield cfg
+
+    def configs(self, **ctx):
+        """Constraint-filtered full grid as a list (see iter_configs)."""
+        return list(self.iter_configs(**ctx))
+
+    def __repr__(self):
+        return "TuningSpace(%s: %s)" % (
+            self.name, ", ".join("%s in %s" % kv
+                                 for kv in sorted(self.params.items())))
+
+
+def register_space(space):
+    """Register (or replace) a kernel's declared space."""
+    _SPACES[space.name] = space
+    return space
+
+
+def get_space(name):
+    sp = _SPACES.get(str(name))
+    if sp is None:
+        raise KeyError("no tuning space registered for %r (have: %s)"
+                       % (name, sorted(_SPACES)))
+    return sp
+
+
+def spaces():
+    """name -> TuningSpace for every registered kernel."""
+    return dict(_SPACES)
+
+
+# -- dconv_col_pallas ---------------------------------------------------------
+def dconv_shape_sig(N, HW, C, itemsize):
+    """Shape signature of one dconv_col_pallas problem — the store key
+    component.  BG is excluded: the grid iterates it, so the per-step
+    working set (what ``nblk`` trades against) does not depend on it."""
+    return "N%d-HW%d-C%d-i%d" % (int(N), int(HW), int(C), int(itemsize))
+
+
+def _dconv_constraint(config, N=None, HW=None, C=None, itemsize=4, **_):
+    """A candidate block size must keep the BACKWARD working set (the
+    larger pass) inside the same VMEM budget the auto branch enforces —
+    ``pallas_kernels.dconv_fits_vmem`` with the candidate's EFFECTIVE
+    ``nblk`` (the dispatch site caps at N, so admission must judge the
+    block size that would actually run, not the uncapped declaration)."""
+    from ..ops.pallas_kernels import dconv_fits_vmem
+
+    if HW is None or C is None:
+        return True
+    nblk = int(config["nblk"])
+    if N is not None:
+        nblk = min(nblk, int(N))
+    return dconv_fits_vmem(int(HW), int(C), int(itemsize), nblk=nblk)
+
+
+register_space(TuningSpace(
+    "dconv_col_pallas",
+    # multiples of the f32 sublane tile; 128 is the shipped _DCONV_NBLK
+    params={"nblk": (32, 64, 128, 256, 512)},
+    default={"nblk": 128},
+    constraint=_dconv_constraint))

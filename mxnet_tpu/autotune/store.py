@@ -1,0 +1,260 @@
+"""Persistent autotuning winner store (ISSUE 9).
+
+One JSON file (``MXNET_AUTOTUNE_CACHE``, default
+``~/.cache/mxnet_tpu/autotune.json``) holding the measured-best config per
+**(device kind, kernel, shape signature)** — the key triple the searcher
+measures under and the dispatch sites look up at trace time.  The file is
+a cache, never a source of truth: every entry carries a verified
+environment fingerprint (store format version, jax + jaxlib versions,
+backend), and any mismatch — a restart onto a different jax build, a
+different backend, a truncated or garbage file — is a **silent miss**
+(counted, never a crash) that the next search overwrites.  Same contract
+as ``compile_cache.py``'s executable entries, minus the mesh descriptor
+(tuned block shapes are per-device, not per-topology).
+
+Everything gates on ``MXNET_AUTOTUNE``: unset means :func:`lookup` returns
+None without touching the filesystem and the wired dispatch sites never
+import this module — the off path is byte-identical to a build without the
+autotuner (tested in tests/test_autotune.py).
+
+Accounting: process-local :func:`stats` (hits / misses / errors) plus
+``autotune_cache_{hits,misses}_total{kernel}`` in the telemetry registry
+when ``MXNET_TELEMETRY`` is on.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+
+from ..base import env_flag
+
+__all__ = ["enabled", "store_path", "lookup", "record", "entries", "clear",
+           "stats", "override", "config_for", "entry_key"]
+
+_FORMAT = 1  # bump to invalidate every persisted winner
+
+_mu = threading.Lock()
+_stats = {"hits": 0, "misses": 0, "errors": 0}
+_loaded = None   # (path, mtime_ns, size) -> parsed payload memo
+_tls = threading.local()
+
+
+def enabled():
+    """``MXNET_AUTOTUNE`` gate (base.env_flag falsy-string rule)."""
+    return env_flag("MXNET_AUTOTUNE")
+
+
+def store_path():
+    """The winner-store file (``MXNET_AUTOTUNE_CACHE``)."""
+    p = os.environ.get("MXNET_AUTOTUNE_CACHE", "").strip()
+    return p or os.path.expanduser(
+        os.path.join("~", ".cache", "mxnet_tpu", "autotune.json"))
+
+
+def state_digest():
+    """Short digest of the store's PROGRAM-SHAPING content: the sorted
+    (key, config) pairs, nothing else.  ``compile_cache._env_fingerprint``
+    folds this in under ``MXNET_AUTOTUNE``: adopted winners shape traced
+    programs (e.g. the dconv block grid), so an executable persisted under
+    one winner set must never restore under another — a re-search that
+    CHANGES a winner, or toggling the gate, is a clean AOT-cache miss.
+    Scores/timing meta are excluded deliberately: a ``--force`` re-search
+    that lands on the same configs must keep the executable cache warm."""
+    import hashlib
+
+    ent = _read(store_path())
+    payload = json.dumps(
+        sorted((k, v.get("config")) for k, v in ent.items()
+               if isinstance(v, dict)),
+        sort_keys=True, default=str)
+    return hashlib.sha1(payload.encode("utf-8")).hexdigest()[:16]
+
+
+def stats():
+    """Process-local lookup counts: ``hits`` (winner returned), ``misses``
+    (no entry / store absent), ``errors`` (entry present but rejected —
+    stale fingerprint or unreadable file; each one also a miss)."""
+    with _mu:
+        return dict(_stats)
+
+
+def _reset_stats_for_tests():
+    global _loaded
+    with _mu:
+        for k in _stats:
+            _stats[k] = 0
+        _loaded = None
+
+
+def _note(kind, kernel="?"):
+    with _mu:
+        _stats[kind] += 1
+    if kind in ("hits", "misses"):
+        from .. import telemetry
+
+        telemetry.note_autotune_cache(kind, kernel)
+
+
+def _versions():
+    """(jax, jaxlib) versions — separate so tests can stub a stale build
+    and assert the clean-miss path (mirrors compile_cache._versions)."""
+    import jax
+    import jaxlib
+
+    return (jax.__version__, jaxlib.__version__)
+
+
+def _device_kind():
+    """Key component: tuned configs are per device generation (a v5e
+    winner is meaningless on a v4 or on CPU).  Separate for test stubs."""
+    import jax
+
+    return str(jax.devices()[0].device_kind)
+
+
+def _env_fingerprint():
+    import jax
+
+    jv, jlv = _versions()
+    return {"format": _FORMAT, "jax": jv, "jaxlib": jlv,
+            "backend": jax.default_backend()}
+
+
+def entry_key(kernel, sig, device_kind=None):
+    """Canonical store key: ``<device kind>|<kernel>|<shape signature>``."""
+    dk = device_kind if device_kind is not None else _device_kind()
+    return "%s|%s|%s" % (dk, str(kernel), str(sig))
+
+
+def _read(path):
+    """Parse the store file → entries dict, or {} on ANY problem (missing,
+    truncated, garbage, wrong shape) — the store must never crash a run.
+    A rejected unreadable file counts one error (once per file state)."""
+    global _loaded
+    try:
+        st = os.stat(path)
+    except OSError:
+        return {}
+    tag = (path, st.st_mtime_ns, st.st_size)
+    with _mu:
+        if _loaded is not None and _loaded[0] == tag:
+            return _loaded[1]
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            payload = json.load(fh)
+        entries = payload.get("entries")
+        if not isinstance(entries, dict):
+            raise ValueError("no entries object")
+    except Exception:
+        with _mu:
+            _stats["errors"] += 1
+        entries = {}
+    with _mu:
+        _loaded = (tag, entries)
+    return entries
+
+
+def lookup(kernel, sig):
+    """→ the persisted winner config dict for (current device kind,
+    ``kernel``, ``sig``), or None.  A present entry whose environment
+    fingerprint mismatches (different jax/jaxlib build, backend, or store
+    format) is rejected silently — counted as an error + miss — so the
+    caller re-searches and overwrites; never a crash, never a stale
+    winner."""
+    if not enabled():
+        return None
+    ent = _read(store_path()).get(entry_key(kernel, sig))
+    if not isinstance(ent, dict):
+        _note("misses", kernel)
+        return None
+    if ent.get("env") != _env_fingerprint() \
+            or not isinstance(ent.get("config"), dict):
+        with _mu:
+            _stats["errors"] += 1
+        _note("misses", kernel)
+        return None
+    _note("hits", kernel)
+    return dict(ent["config"])
+
+
+def record(kernel, sig, config, score=None, meta=None):
+    """Persist one winner (atomic tmp + rename; read-modify-write keeps the
+    other kernels' entries).  A corrupt existing file is discarded rather
+    than crashing the writer.  Returns the entry key."""
+    if not enabled():
+        return None
+    path = store_path()
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    key = entry_key(kernel, sig)
+    entries = dict(_read(path))
+    entries[key] = {"config": dict(config), "env": _env_fingerprint(),
+                    "score": score, "meta": meta or {}}
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump({"format": _FORMAT, "entries": entries}, fh, indent=1,
+                  sort_keys=True)
+    os.replace(tmp, path)
+    global _loaded
+    with _mu:
+        _loaded = None
+    return key
+
+
+def entries():
+    """→ {key: entry} snapshot of the store file (no fingerprint check —
+    this is the CLI ``show`` surface, which prints stale entries too)."""
+    return dict(_read(store_path()))
+
+
+def clear(kernel=None):
+    """Drop every entry (or only ``kernel``'s, any device kind / sig).
+    Returns the number removed; missing store is 0, not an error."""
+    path = store_path()
+    ent = dict(_read(path))
+    if kernel is None:
+        removed, ent = len(ent), {}
+    else:
+        keep = {k: v for k, v in ent.items()
+                if k.split("|", 2)[1:2] != [str(kernel)]}
+        removed, ent = len(ent) - len(keep), keep
+    if removed:
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump({"format": _FORMAT, "entries": ent}, fh, indent=1,
+                      sort_keys=True)
+        os.replace(tmp, path)
+        global _loaded
+        with _mu:
+            _loaded = None
+    return removed
+
+
+# -- in-process config overrides ---------------------------------------------
+@contextlib.contextmanager
+def override(kernel, config):
+    """Thread-local config pin: inside the block, :func:`config_for` returns
+    ``config`` for ``kernel`` without reading the store.  The measurer uses
+    this to trace each CANDIDATE through the real dispatch site (a fresh
+    ``jax.jit`` per candidate, so the pinned config shapes that trace)."""
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append((str(kernel), dict(config)))
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+def config_for(kernel, sig):
+    """The dispatch-site lookup: innermost :func:`override` pin first, then
+    the persistent store (when ``MXNET_AUTOTUNE`` is on).  None = use the
+    hand-tuned default."""
+    for name, cfg in reversed(getattr(_tls, "stack", ()) or ()):
+        if name == kernel:
+            return dict(cfg)
+    return lookup(kernel, sig)

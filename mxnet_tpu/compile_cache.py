@@ -272,11 +272,25 @@ def _env_fingerprint(mesh_desc=None):
     # Verified (not just keyed) so an executable persisted under a
     # different pass configuration, or by a build whose pass versions
     # changed, can never be restored: it misses cleanly and is recompiled.
-    return {"format": _FORMAT, "jax": jv, "jaxlib": jlv,
-            "backend": jax.default_backend(),
-            "device_kind": str(devs[0].device_kind), "n_devices": len(devs),
-            "mesh": mesh_desc,
-            "passes": graph_passes.pipeline_fingerprint()}
+    fp = {"format": _FORMAT, "jax": jv, "jaxlib": jlv,
+          "backend": jax.default_backend(),
+          "device_kind": str(devs[0].device_kind), "n_devices": len(devs),
+          "mesh": mesh_desc,
+          "passes": graph_passes.pipeline_fingerprint()}
+    # "autotune" (ISSUE 9): adopted winners shape traced programs (the
+    # dconv block grid reads the store at trace time), so the store state
+    # digest joins the verified fingerprint while the gate is on — a
+    # re-search that changes winners, or toggling MXNET_AUTOTUNE, is a
+    # clean miss in BOTH directions.  Key absent with the gate off keeps
+    # pre-autotune fingerprints (and their cached executables) byte-
+    # identical, per the off-path contract.
+    from .base import env_flag
+
+    if env_flag("MXNET_AUTOTUNE"):
+        from .autotune import store as _at_store
+
+        fp["autotune"] = _at_store.state_digest()
+    return fp
 
 
 def _evict():

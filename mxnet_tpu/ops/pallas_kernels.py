@@ -628,8 +628,10 @@ def dconv_bwd_vmem_bytes(HW, C, itemsize, nblk=_DCONV_NBLK):
             + nblk * C * (itemsize + 4))  # g block + col block
 
 
-def dconv_fits_vmem(HW, C, itemsize):
-    """True when the fused dconv kernel's estimated footprint fits VMEM."""
+def dconv_fits_vmem(HW, C, itemsize, nblk=_DCONV_NBLK):
+    """True when the fused dconv kernel's estimated footprint fits VMEM.
+    ``nblk`` lets the autotuner (ISSUE 9) constrain CANDIDATE block sizes
+    with the same budget the auto branch enforces for the default."""
     import os
 
     try:
@@ -638,7 +640,7 @@ def dconv_fits_vmem(HW, C, itemsize):
         limit = 0
     if limit <= 0:
         limit = _DCONV_VMEM_LIMIT
-    return dconv_bwd_vmem_bytes(HW, C, itemsize) <= limit
+    return dconv_bwd_vmem_bytes(HW, C, itemsize, nblk=nblk) <= limit
 
 
 def _dconv_factors(y0, y1, x0, x1, ly, lx, H, W):
@@ -744,8 +746,38 @@ def dconv_col_pallas(y0, y1, x0, x1, ly, lx, lf, ft, hw, interpret=False):
     return _dconv_impl(y0, y1, x0, x1, ly, lx, lf, ft, hw, interpret)
 
 
-def _dconv_grid(N):
-    nblk = min(_DCONV_NBLK, N)
+def _dconv_grid(N, HW=None, C=None, itemsize=4):
+    """Row-block size + padded row count for one dconv problem.
+
+    The hand-tuned default is ``_DCONV_NBLK``; with ``MXNET_AUTOTUNE`` set
+    a persisted winner for this (device kind, shape signature) — searched
+    by ``tools/autotune.py`` over the declared space under the same VMEM
+    guard — overrides it.  Runs at TRACE time only (shapes are concrete
+    inside jit tracing), so the lookup costs nothing per dispatch; with
+    the gate unset this is one env read and behavior is byte-identical
+    to the constant (tested in tests/test_autotune.py)."""
+    nblk = _DCONV_NBLK
+    from ..base import env_flag
+
+    if env_flag("MXNET_AUTOTUNE") and HW is not None and C is not None:
+        from .. import autotune
+
+        cfg = autotune.config_for(
+            "dconv_col_pallas",
+            autotune.dconv_shape_sig(N, HW, C, itemsize))
+        if cfg:
+            try:
+                adopted = max(8, int(cfg["nblk"]))
+            except (KeyError, TypeError, ValueError):
+                adopted = None  # malformed winner: keep the default
+            # re-validate against the CURRENT VMEM budget: a winner searched
+            # under a larger MXNET_DCONV_VMEM_MB must not hard-fail Mosaic
+            # here — the guard that admitted it at search time re-decides at
+            # adoption time, and the hand-tuned default stays otherwise
+            if adopted is not None and dconv_fits_vmem(
+                    HW, C, itemsize, nblk=min(adopted, N)):
+                nblk = adopted
+    nblk = min(nblk, N)
     return nblk, -(-N // nblk) * nblk
 
 
@@ -759,7 +791,7 @@ def _dconv_impl(y0, y1, x0, x1, ly, lx, lf, ft, hw, interpret):
         "dconv_col_pallas_fwd",
         cost_dconv_col_fwd(BG, N, HW, C, jnp.dtype(ft.dtype).itemsize),
         ft.shape)
-    nblk, n_pad = _dconv_grid(N)
+    nblk, n_pad = _dconv_grid(N, HW, C, jnp.dtype(ft.dtype).itemsize)
     ints = [_dconv_pad(a, n_pad) for a in (y0, y1, x0, x1)]
     # padded rows carry lf=0 => A row = 0 => no effect anywhere
     flts = [_dconv_pad(a, n_pad) for a in (ly, lx)] + [_dconv_pad(lf, n_pad)]
@@ -792,7 +824,7 @@ def _dconv_bwd(hw, interpret, res, g):
         "dconv_col_pallas_bwd",
         cost_dconv_col_bwd(BG, N, HW, C, jnp.dtype(ft.dtype).itemsize),
         ft.shape)
-    nblk, n_pad = _dconv_grid(N)
+    nblk, n_pad = _dconv_grid(N, HW, C, jnp.dtype(ft.dtype).itemsize)
     ints = [_dconv_pad(a, n_pad) for a in (y0, y1, x0, x1)]
     flts = [_dconv_pad(a, n_pad) for a in (ly, lx)] + [_dconv_pad(lf, n_pad)]
     gp = jnp.pad(g, ((0, 0), (0, n_pad - N), (0, 0))) if n_pad != N else g

@@ -103,8 +103,21 @@ class Engine:
         self.name = name
         self.sample_shapes = {str(k): tuple(int(d) for d in v)
                               for k, v in sample_shapes.items()}
-        self.ladder = ladder if ladder is not None else BucketLadder(
-            _env_ladder())
+        if ladder is None:
+            # tuned ladder adoption (ISSUE 9): under MXNET_AUTOTUNE, rungs
+            # proposed by the trace-replay tuner (tools/autotune.py search
+            # --trace) and persisted for this stream's declared sample
+            # shapes replace the env/default ladder.  An explicit ladder=
+            # argument always wins; gate unset = this one env read and the
+            # autotune package is never imported (off path tested).
+            tuned = None
+            if env_flag("MXNET_AUTOTUNE"):
+                from .. import autotune
+
+                tuned = autotune.tuned_ladder(self.sample_shapes)
+            ladder = BucketLadder(tuned if tuned is not None
+                                  else _env_ladder())
+        self.ladder = ladder
         if max_wait_ms is None:
             max_wait_ms = _env_float("MXNET_SERVE_MAX_WAIT_MS", 5.0)
         if max_queue is None:
@@ -139,7 +152,11 @@ class Engine:
                        "timeouts": 0, "cancelled": 0,
                        "direct": 0, "batches": 0, "compiles": 0,
                        "cache_hits": 0, "in_flight": 0}
-        self._bucket_counts = {}
+        # per-bucket dispatch accounting: label -> [batches, requests,
+        # padding_waste_sum] (stats()["bucket_stats"] derives means); kept
+        # directly on the engine so the ladder tuner and operators read
+        # per-bucket hit counts + padding waste without telemetry scraping
+        self._bucket_stats = {}
         self._probe = telemetry.serve_probe(name)
         self._warmup = None  # last warmup pass summary (stats() block)
         self._thread = None
@@ -381,6 +398,7 @@ class Engine:
             if fresh:
                 self._note_compile(bucket, dt)
             total = sum(r.n for r in reqs)
+            waste = self._padding_waste(reqs, bucket)
             with tracing.span("reply"):
                 off = 0
                 for req in reqs:
@@ -393,12 +411,16 @@ class Engine:
             self._stats["in_flight"] -= len(reqs)
             self._stats["batches"] += 1
             in_flight = self._stats["in_flight"]
-            self._bucket_counts[label] = self._bucket_counts.get(label, 0) + 1
+            ent = self._bucket_stats.get(label)
+            if ent is None:
+                ent = self._bucket_stats[label] = [0, 0, 0.0]
+            ent[0] += 1
+            ent[1] += len(reqs)
+            ent[2] += waste
         if self._probe:
             fill = total / float(bucket.batch)
             self._probe.record_batch(
-                label, fill,
-                self._padding_waste(reqs, bucket), dt, queue_waits,
+                label, fill, waste, dt, queue_waits,
                 in_flight, self._batcher.depth())
 
     @staticmethod
@@ -615,7 +637,15 @@ class Engine:
         registry carries the same signals as proper metrics when enabled)."""
         with self._stats_mu:
             out = dict(self._stats)
-            out["buckets"] = dict(self._bucket_counts)
+            # buckets: label -> batch count (the long-standing surface);
+            # bucket_stats: the tuner/operator view (ISSUE 9) — per-bucket
+            # request hit counts and mean padding waste, no telemetry
+            # scraping required
+            out["buckets"] = {k: v[0] for k, v in self._bucket_stats.items()}
+            out["bucket_stats"] = {
+                k: {"batches": v[0], "requests": v[1],
+                    "padding_waste": round(v[2] / v[0], 4) if v[0] else 0.0}
+                for k, v in self._bucket_stats.items()}
             out["warmup"] = dict(self._warmup) if self._warmup else None
         out["shed"] = self.admission.shed_total
         out["queue_depth"] = self._batcher.depth()

@@ -35,7 +35,8 @@ from .sinks import JsonlSink
 
 __all__ = ["enabled", "jsonl_path", "interval_s", "registry", "add_sink",
            "counter", "gauge", "histogram", "event", "flush",
-           "instrument_step", "note_aot_cache", "note_compile", "note_bytes",
+           "instrument_step", "note_aot_cache", "note_autotune_cache",
+           "note_autotune_trial", "note_compile", "note_bytes",
            "array_nbytes",
            "note_dispatch", "note_train_step", "note_fused_fallback",
            "note_nonfinite",
@@ -280,6 +281,37 @@ def note_aot_cache(kind, reason=None, tier="exec"):
         r.counter("aot_cache_misses_total",
                   "executables/XLA modules compiled fresh (and stored)",
                   ("tier",)).inc(tier=tier)
+
+
+def note_autotune_trial(kernel, seconds=None):
+    """Count one measured autotuning trial (autotune/measure.py, ISSUE 9):
+    a candidate config built fresh and timed on-device.  A healthy warm
+    winner store keeps this at zero across restarts — the persistence
+    acceptance test asserts exactly that."""
+    if not enabled():
+        return
+    r = registry()
+    r.counter("autotune_trials_total",
+              "autotune candidate configs measured on-device",
+              ("kernel",)).inc(kernel=str(kernel))
+    r.event("autotune_trial", kernel=str(kernel),
+            seconds=None if seconds is None else round(float(seconds), 6))
+
+
+def note_autotune_cache(kind, kernel="?"):
+    """Count one winner-store lookup (autotune/store.py): ``kind`` is
+    "hits" (persisted winner adopted — a search that did NOT run) or
+    "misses" (no usable entry: absent, or rejected on a stale env
+    fingerprint — the caller falls back to the hand-tuned default or
+    re-searches)."""
+    if not enabled():
+        return
+    name = ("autotune_cache_hits_total" if kind == "hits"
+            else "autotune_cache_misses_total")
+    help_ = ("winner-store lookups that returned a persisted config"
+             if kind == "hits"
+             else "winner-store lookups with no usable entry")
+    registry().counter(name, help_, ("kernel",)).inc(kernel=str(kernel))
 
 
 def note_graph_passes(nodes_pre, nodes_post, seconds, mode="eval"):
@@ -542,6 +574,9 @@ def summary():
     gp_pre = r.total("graph_nodes_pre_total", None)
     gp_post = r.total("graph_nodes_post_total", None)
     gp_s = r.total("graph_pass_seconds_total", None)
+    # autotune surface (ISSUE 9): candidate configs measured this process —
+    # null when no search ran (steady state: the winner store answers)
+    at_trials = r.total("autotune_trials_total", None)
     return {"compile_s": round(compile_s, 3),
             "peak_hbm_bytes": int(peak) if peak is not None else None,
             "data_wait_frac": round(frac, 4),
@@ -549,4 +584,6 @@ def summary():
             "warmup_s": round(warm, 3) if warm is not None else None,
             "graph_nodes_pre": int(gp_pre) if gp_pre is not None else None,
             "graph_nodes_post": int(gp_post) if gp_post is not None else None,
-            "pass_time_s": round(gp_s, 4) if gp_s is not None else None}
+            "pass_time_s": round(gp_s, 4) if gp_s is not None else None,
+            "autotune_trials": int(at_trials) if at_trials is not None
+            else None}

@@ -79,9 +79,12 @@ def _make_request(shapes, sizes, rng):
 
 
 class _Collector:
-    """Thread-safe latency/outcome accumulator."""
+    """Thread-safe latency/outcome accumulator; optionally records the
+    request trace (``--save-trace``, ISSUE 9): one (arrival time, size,
+    shapes, class) record per submission attempt, the offline input the
+    bucket-ladder tuner replays (``mxnet_tpu.autotune.ladder``)."""
 
-    def __init__(self):
+    def __init__(self, trace_log=None, t_origin=None):
         self.mu = threading.Lock()
         self.latencies = []
         self.submitted = 0
@@ -89,6 +92,8 @@ class _Collector:
         self.timeouts = 0
         self.errors = 0
         self.in_window = None  # open loop: completions inside the window
+        self.trace_log = trace_log
+        self.t_origin = t_origin
 
     def ok(self, seconds):
         with self.mu:
@@ -97,6 +102,20 @@ class _Collector:
     def count(self, field, n=1):
         with self.mu:
             setattr(self, field, getattr(self, field) + n)
+
+    def trace(self, inputs, klass):
+        """Record one request's trace line (no-op without --save-trace).
+        ``t`` is seconds since the FIRST mode's start — one clock across a
+        --mode both run, so replay ordering stays meaningful."""
+        if self.trace_log is None:
+            return
+        n = next(iter(inputs.values())).shape[0]
+        rec = {"t": round(time.monotonic() - self.t_origin, 6), "n": int(n),
+               "shapes": {name: list(a.shape[1:])
+                          for name, a in inputs.items()},
+               "class": klass}
+        with self.mu:
+            self.trace_log.append(rec)
 
 
 def _run_closed(engine, shapes, args, collector):
@@ -109,6 +128,7 @@ def _run_closed(engine, shapes, args, collector):
         while time.monotonic() < stop:
             req_inputs = _make_request(shapes, args.sizes, rng)
             collector.count("submitted")
+            collector.trace(req_inputs, "closed")
             t0 = time.perf_counter()
             try:
                 engine.predict(req_inputs, timeout=args.timeout_s)
@@ -148,10 +168,10 @@ def _run_open(engine, shapes, args, collector):
         # Poisson arrivals: exponential inter-arrival gaps at --rate
         next_fire += jitter.expovariate(args.rate)
         collector.count("submitted")
+        req_inputs = _make_request(shapes, args.sizes, rng)
+        collector.trace(req_inputs, "open")
         try:
-            pending.append(engine.submit(
-                _make_request(shapes, args.sizes, rng),
-                timeout=args.timeout_s))
+            pending.append(engine.submit(req_inputs, timeout=args.timeout_s))
         except ServerBusy:
             collector.count("shed")
     # throughput window CLOSES here: the post-window drain below must not
@@ -191,8 +211,9 @@ def _first_request_latencies(engine, shapes, sizes):
     return out
 
 
-def run(engine, shapes, args, mode, first_request_ms=None, warmup_s=None):
-    collector = _Collector()
+def run(engine, shapes, args, mode, first_request_ms=None, warmup_s=None,
+        trace_log=None, t_origin=None):
+    collector = _Collector(trace_log=trace_log, t_origin=t_origin)
     compiles_before = engine.stats()["compiles"]
     runner = _run_closed if mode == "closed" else _run_open
     duration = runner(engine, shapes, args, collector)
@@ -256,6 +277,12 @@ def main(argv=None):
                    help="name:d1,d2,... per-sample shape (with --symbol)")
     p.add_argument("--no-warmup", action="store_true",
                    help="skip the bucket-ladder precompile (measure cold)")
+    p.add_argument("--save-trace", metavar="PATH",
+                   help="dump one JSONL record per submitted request "
+                        "({t, n, shapes, class}) — the offline traffic "
+                        "trace the bucket-ladder tuner replays "
+                        "(tools/autotune.py search --trace; schema linted "
+                        "by ci/check_bench_schema.py --trace)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--smoke", action="store_true",
                    help="CI preset: tiny MLP, 0.5s closed + 0.5s open")
@@ -281,8 +308,17 @@ def main(argv=None):
             warmup_s = round(time.perf_counter() - t0, 4)
         first = _first_request_latencies(engine, shapes, args.sizes)
         modes = ["closed", "open"] if args.mode == "both" else [args.mode]
+        trace_log = [] if args.save_trace else None
+        t_origin = time.monotonic()
         lines = [run(engine, shapes, args, m, first_request_ms=first,
-                     warmup_s=warmup_s) for m in modes]
+                     warmup_s=warmup_s, trace_log=trace_log,
+                     t_origin=t_origin) for m in modes]
+        if args.save_trace:
+            with open(args.save_trace, "w", encoding="utf-8") as fh:
+                for rec in sorted(trace_log, key=lambda r: r["t"]):
+                    fh.write(json.dumps(rec) + "\n")
+            print("loadgen: wrote %d trace records to %s"
+                  % (len(trace_log), args.save_trace), file=sys.stderr)
     finally:
         engine.close()
     # a run with model/engine errors is a FAILED run even if some requests

@@ -66,6 +66,12 @@ def main(argv=None):
                     help="report every finding, suppress nothing")
     ap.add_argument("--write-baseline", action="store_true",
                     help="accept the current finding set into --baseline")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="rewrite --baseline dropping STALE entries "
+                         "(fingerprints matching no current finding); "
+                         "kept entries and their justifications are "
+                         "untouched — the reviewed alternative to "
+                         "hand-editing the file")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -81,6 +87,39 @@ def main(argv=None):
         _write_baseline(findings, args.baseline)
         print("mxlint: wrote %d entr%s to %s" % (
             len(findings), "y" if len(findings) == 1 else "ies",
+            os.path.relpath(args.baseline, _REPO)))
+        return 0
+
+    if args.prune_baseline:
+        if args.paths and args.baseline == DEFAULT_BASELINE:
+            # stale = "matches no current finding": a partial lint makes
+            # every out-of-scope entry in the SHARED repo baseline look
+            # stale, and pruning would destroy its justifications — prune
+            # the default baseline only from a full default-root lint
+            # (an explicit --baseline scoped to these paths is fine)
+            print("mxlint: refusing --prune-baseline of the repo "
+                  "baseline from a partial lint (explicit paths given); "
+                  "run without path arguments, or point --baseline at a "
+                  "file scoped to them", file=sys.stderr)
+            return 2
+        baseline = source_lint.load_baseline(args.baseline)
+        _, _, stale = source_lint.split_baseline(findings, baseline)
+        if not stale:
+            print("mxlint: baseline has no stale entries")
+            return 0
+        # drop only the stale fingerprint lines; headers, comments and
+        # every live entry (justification included) pass through verbatim
+        stale = set(stale)
+        with open(args.baseline, "r", encoding="utf-8") as fh:
+            lines = fh.readlines()
+        kept = [ln for ln in lines
+                if ln.split("  #", 1)[0].strip() not in stale]
+        with open(args.baseline, "w", encoding="utf-8") as fh:
+            fh.writelines(kept)
+        for fp in sorted(stale):
+            print("mxlint: pruned stale entry %s" % fp)
+        print("mxlint: pruned %d stale entr%s from %s" % (
+            len(stale), "y" if len(stale) == 1 else "ies",
             os.path.relpath(args.baseline, _REPO)))
         return 0
 
