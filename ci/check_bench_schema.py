@@ -21,13 +21,15 @@ TOP_KEYS = {"metric", "value", "unit", "vs_baseline", "telemetry"}
 TEL_REQ_KEYS = {"compile_s", "peak_hbm_bytes", "data_wait_frac"}
 # dispatches_per_step (ISSUE 3 fused Module step), warmup_s (ISSUE 6 AOT
 # cache restart surface), the graph-pass keys (ISSUE 7: plan nodes in/out
-# of the pass pipeline + its wall time) and autotune_trials (ISSUE 9:
+# of the pass pipeline + its wall time), autotune_trials (ISSUE 9:
 # candidate configs measured — 0/null in steady state, when the winner
-# store answers) are optional: captures predating that work carry only
-# the three original keys
+# store answers) and the serve latency quantiles (ISSUE 10: submit->reply
+# p50/p99 from the serve_latency_seconds histogram — null when no serving
+# ran) are optional: captures predating that work carry only the three
+# original keys
 TEL_OPT_KEYS = {"dispatches_per_step", "warmup_s",
                 "graph_nodes_pre", "graph_nodes_post", "pass_time_s",
-                "autotune_trials"}
+                "autotune_trials", "serve_p50_ms", "serve_p99_ms"}
 TEL_KEYS = TEL_REQ_KEYS | TEL_OPT_KEYS
 
 # SERVE_BENCH line (tools/loadgen.py, ISSUE 2) — docs/SERVING.md schema
@@ -36,7 +38,10 @@ SERVE_REQ_KEYS = {"mode", "requests", "completed", "shed", "timeouts",
                   "errors", "shed_rate", "duration_s", "throughput_rps",
                   "latency_ms_p50", "latency_ms_p99", "compiles"}
 SERVE_OPT_KEYS = {"concurrency", "rate_rps", "batch_fill_mean",
-                  "padding_waste_mean", "first_request_ms", "warmup_s"}
+                  "padding_waste_mean", "first_request_ms", "warmup_s",
+                  # ISSUE 10 live-ops surface: per-size-class percentiles
+                  # + goodput under a --slo-ms target
+                  "latency_by_class", "goodput_rps", "slo_ms"}
 SERVE_MODES = {"closed", "open"}
 
 
@@ -173,6 +178,18 @@ def validate_line(obj, where="<line>"):
             raise SchemaError(
                 "%s: telemetry.autotune_trials must be a non-negative int "
                 "or null" % where)
+        for k in ("serve_p50_ms", "serve_p99_ms"):
+            sv = tel.get(k)
+            if sv is not None and (not _num(sv) or sv < 0):
+                raise SchemaError(
+                    "%s: telemetry.%s must be a non-negative number or "
+                    "null" % (where, k))
+        if tel.get("serve_p50_ms") is not None \
+                and tel.get("serve_p99_ms") is not None \
+                and tel["serve_p99_ms"] < tel["serve_p50_ms"]:
+            raise SchemaError(
+                "%s: telemetry serve p99 below p50 — percentiles swapped?"
+                % where)
 
 
 def validate_serve_line(obj, where="<line>"):
@@ -228,6 +245,39 @@ def validate_serve_line(obj, where="<line>"):
                 raise SchemaError(
                     "%s: first_request_ms[%r] must map a string size class "
                     "to a non-negative number" % (where, k))
+    if "goodput_rps" in obj and (not _num(obj["goodput_rps"])
+                                 or obj["goodput_rps"] < 0):
+        raise SchemaError("%s: 'goodput_rps' must be a non-negative number"
+                          % where)
+    if "slo_ms" in obj and (not _num(obj["slo_ms"]) or obj["slo_ms"] <= 0):
+        raise SchemaError("%s: 'slo_ms' must be a positive number (omit "
+                          "the key when no target was set)" % where)
+    if "latency_by_class" in obj:
+        bc = obj["latency_by_class"]
+        if not isinstance(bc, dict) or not bc:
+            raise SchemaError(
+                "%s: 'latency_by_class' must be a non-empty object of "
+                "size-class -> {p50_ms, p99_ms, n}" % where)
+        for k, v in bc.items():
+            if not isinstance(k, str) or not isinstance(v, dict) \
+                    or set(v) != {"p50_ms", "p99_ms", "n"}:
+                raise SchemaError(
+                    "%s: latency_by_class[%r] must be an object with "
+                    "exactly {p50_ms, p99_ms, n}" % (where, k))
+            if not isinstance(v["n"], int) or isinstance(v["n"], bool) \
+                    or v["n"] < 1:
+                raise SchemaError(
+                    "%s: latency_by_class[%r].n must be a positive int"
+                    % (where, k))
+            for pk in ("p50_ms", "p99_ms"):
+                if not _num(v[pk]) or v[pk] < 0:
+                    raise SchemaError(
+                        "%s: latency_by_class[%r].%s must be a "
+                        "non-negative number" % (where, k, pk))
+            if v["p99_ms"] < v["p50_ms"]:
+                raise SchemaError(
+                    "%s: latency_by_class[%r] p99 below p50 — percentiles "
+                    "swapped?" % (where, k))
 
 
 def validate_capture(path):
@@ -281,6 +331,14 @@ def self_test():
         {"metric": "m", "value": 1, "unit": "samples/s",
          "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
                        "data_wait_frac": 0.0, "autotune_trials": None}},
+        {"metric": "m", "value": 1, "unit": "samples/s",
+         "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0, "serve_p50_ms": 2.5,
+                       "serve_p99_ms": 11.0}},
+        {"metric": "m", "value": 1, "unit": "samples/s",
+         "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0, "serve_p50_ms": None,
+                       "serve_p99_ms": None}},
     ]
     bad = [
         {},                                                  # empty
@@ -317,6 +375,14 @@ def self_test():
          "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
                        "data_wait_frac": 0.0,
                        "autotune_trials": 1.5}},         # float trial count
+        {"metric": "m", "value": 1, "unit": "img/s",
+         "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0,
+                       "serve_p50_ms": -1.0}},           # negative latency
+        {"metric": "m", "value": 1, "unit": "img/s",
+         "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0, "serve_p50_ms": 9.0,
+                       "serve_p99_ms": 3.0}},            # p99 < p50
     ]
     serve_good = {"mode": "closed", "requests": 10, "completed": 9,
                   "shed": 1, "timeouts": 0, "errors": 0, "shed_rate": 0.1,
@@ -336,6 +402,15 @@ def self_test():
         dict(serve_good, first_request_ms={}),       # empty map
         dict(serve_good, first_request_ms={"1": -2}),  # negative latency
         dict(serve_good, first_request_ms=[1.0]),    # wrong type
+        dict(serve_good, goodput_rps=-1.0),          # negative goodput
+        dict(serve_good, slo_ms=0),                  # zero target
+        dict(serve_good, latency_by_class={}),       # empty class map
+        dict(serve_good, latency_by_class={          # missing n
+            "1": {"p50_ms": 1.0, "p99_ms": 2.0}}),
+        dict(serve_good, latency_by_class={          # p99 < p50
+            "1": {"p50_ms": 5.0, "p99_ms": 2.0, "n": 3}}),
+        dict(serve_good, latency_by_class={          # zero count
+            "1": {"p50_ms": 1.0, "p99_ms": 2.0, "n": 0}}),
     ]
     for obj in good:
         validate_line(obj, "self-test good")
@@ -345,6 +420,11 @@ def self_test():
     validate_serve_line(dict(serve_good, warmup_s=0.42,
                              first_request_ms={"1": 2.5, "4": 3.75}),
                         "self-test serve good3")
+    validate_serve_line(dict(serve_good, goodput_rps=5.5, slo_ms=50.0,
+                             latency_by_class={
+                                 "1": {"p50_ms": 1.5, "p99_ms": 8.0, "n": 40},
+                                 "4": {"p50_ms": 2.5, "p99_ms": 9.0, "n": 7}}),
+                        "self-test serve good4")
     for i, obj in enumerate(bad):
         try:
             validate_line(obj, "self-test bad[%d]" % i)

@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""Live ops-plane smoke (ISSUE 10) — unit tier.
+
+Starts a real Engine with ``MXNET_OPS_PORT=0`` (ephemeral port), drives it
+with ``tools/loadgen.py``'s closed loop (the SAME run the offline
+percentile comes from), then asserts the live surfaces:
+
+1. ``/metrics`` parses as Prometheus text exposition and contains
+   ``serve_requests_total`` (the registry and the endpoint share one
+   formatter — a scrape must agree with the PrometheusSink);
+2. ``/statusz`` JSON round-trips and carries the engine's stats + SLO +
+   warmup + bucket_stats blocks;
+3. the streaming P99 in ``/statusz`` agrees with loadgen's offline
+   ``latency_ms_p99`` (``np.percentile`` over client-observed latencies,
+   same run) within the estimator's documented relative error bound
+   (``slo.RELATIVE_ERROR``) plus a small absolute cushion for the
+   client-vs-engine measurement point (the client stamps after its
+   ``Event.wait`` wake, the engine at ``set_result``);
+4. ``/healthz`` flips 200 → 503 when the device loop is frozen (held
+   behind the device mutex with a request pending) and recovers to 200
+   after release.
+
+Run from ci/run_tests.sh unit tier::
+
+    ./dev.sh python ci/check_ops_server.py
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# gates BEFORE any mxnet_tpu import: ephemeral ops port, a generous-window
+# aggregate SLO objective ("*" — loadgen labels requests by size class, so
+# the all-classes estimator is the one comparable to loadgen's overall
+# percentile; the window must cover the whole run),
+# telemetry for /metrics content, and a fast heartbeat-staleness threshold
+# so the frozen-loop assertion doesn't stall CI
+os.environ["MXNET_OPS_PORT"] = "0"
+os.environ["MXNET_SLO"] = "*:p99:1000:600"
+os.environ["MXNET_TELEMETRY"] = "1"
+os.environ.setdefault("MXNET_TELEMETRY_FILE", "/tmp/check_ops_server.jsonl")
+os.environ["MXNET_OPS_STALE_S"] = "1.0"
+
+import numpy as np  # noqa: E402
+
+from mxnet_tpu import serving  # noqa: E402
+from mxnet_tpu.telemetry import ops_server, slo  # noqa: E402
+from mxnet_tpu.test_utils import tiny_mlp_checkpoint  # noqa: E402
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+# absolute cushion (ms) on top of the estimator's relative bound: the
+# client measures submit→wake, the engine submit→set_result; the wake hop
+# plus scheduler jitter on a loaded CI box lands inside this
+CLIENT_CUSHION_MS = 10.0
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [^ ]+$")
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d%s" % (port, path), timeout=10) as r:
+            return r.status, r.read().decode("utf-8")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8")
+
+
+def parse_prometheus(text):
+    """Minimal exposition-format check → set of metric sample names.
+    Every non-comment, non-blank line must be ``name[{labels}] value``."""
+    names = set()
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line.strip() or line.startswith("#"):
+            continue
+        if not _PROM_LINE.match(line):
+            raise AssertionError(
+                "metrics line %d is not Prometheus text format: %r"
+                % (i, line))
+        names.add(line.split("{", 1)[0].split(" ", 1)[0])
+    return names
+
+
+def main():
+    import mxnet_tpu.test_utils as tu
+
+    sys.path.insert(0, TOOLS)
+    loadgen = tu.load_module_by_path(os.path.join(TOOLS, "loadgen.py"))
+
+    sym, params = tiny_mlp_checkpoint()
+    engine = serving.Engine(sym, params, {"data": (8,)},
+                            ladder=serving.BucketLadder((1, 2, 4)),
+                            max_wait_ms=2.0, max_queue=256,
+                            name="opscheck", start=True)
+    port = ops_server.port()
+    assert port, "ops server did not start under MXNET_OPS_PORT=0"
+    print("check_ops_server: ops server on 127.0.0.1:%d" % port)
+    try:
+        engine.warmup()
+
+        # -- drive the engine through loadgen's own closed loop ------------
+        args = argparse.Namespace(duration=1.0, concurrency=2,
+                                  sizes=(1, 2), timeout_s=30.0, rate=0.0,
+                                  seed=0, slo_ms=0.0)
+        line = loadgen.run(engine, {"data": (8,)}, args, "closed")
+        assert line["completed"] > 20 and line["errors"] == 0, \
+            "loadgen run unhealthy: %r" % (line,)
+
+        # -- 1: /metrics parses + carries the serving counters -------------
+        code, body = _get(port, "/metrics")
+        assert code == 200, "/metrics -> %d" % code
+        names = parse_prometheus(body)
+        for want in ("serve_requests_total", "serve_latency_seconds_count"):
+            assert want in names, \
+                "/metrics missing %s (got %d series)" % (want, len(names))
+        print("check_ops_server: /metrics ok (%d sample names)" % len(names))
+
+        # -- 2: /statusz round-trips with the stats blocks ------------------
+        code, body = _get(port, "/statusz")
+        assert code == 200, "/statusz -> %d" % code
+        status = json.loads(body)
+        assert json.loads(json.dumps(status)) == status
+        st = status["engines"]["opscheck"]
+        for key in ("slo", "warmup", "bucket_stats", "heartbeat_age_s"):
+            assert st.get(key) is not None, "/statusz missing %r" % key
+        assert status["health"]["ok"] is True
+
+        # -- 3: streaming P99 vs loadgen's offline percentile ---------------
+        obj = st["slo"]["objectives"][0]
+        assert obj["class"] == "*" and obj["window_n"] > 0
+        # the per-size-class estimators must have split the same traffic
+        assert set(st["slo"]["classes"]) == {"1", "2"}, st["slo"]["classes"]
+        live_p99 = obj["value_ms"]
+        offline_p99 = line["latency_ms_p99"]
+        tol = slo.RELATIVE_ERROR * offline_p99 + CLIENT_CUSHION_MS
+        print("check_ops_server: streaming p99 %.3f ms vs offline %.3f ms "
+              "(tol %.3f)" % (live_p99, offline_p99, tol))
+        assert abs(live_p99 - offline_p99) <= tol, \
+            "streaming p99 %.3f disagrees with offline %.3f beyond %.3f" \
+            % (live_p99, offline_p99, tol)
+
+        # -- 4: /healthz flips 200 -> 503 on a frozen device loop -----------
+        code, _ = _get(port, "/healthz")
+        assert code == 200, "/healthz -> %d on a healthy engine" % code
+        engine._device_mu.acquire()  # freeze: dispatch blocks right here
+        try:
+            frozen = engine.submit({"data": np.zeros((1, 8), np.float32)})
+            deadline = time.monotonic() + 10.0
+            code = 200
+            while time.monotonic() < deadline:
+                code, body = _get(port, "/healthz")
+                if code == 503:
+                    break
+                time.sleep(0.2)
+            assert code == 503, \
+                "/healthz stayed %d with the device loop frozen" % code
+            detail = json.loads(body)
+            eng = detail["engines"][0]
+            assert not eng["ok"] and eng["heartbeat_age_s"] is not None
+            print("check_ops_server: frozen loop -> 503 "
+                  "(heartbeat_age_s=%.3f)" % eng["heartbeat_age_s"])
+        finally:
+            engine._device_mu.release()
+        frozen.result(timeout=30)
+        deadline = time.monotonic() + 10.0
+        code = 503
+        while time.monotonic() < deadline:
+            code, _ = _get(port, "/healthz")
+            if code == 200:
+                break
+            time.sleep(0.2)
+        assert code == 200, "/healthz did not recover after release"
+        print("check_ops_server: recovered -> 200")
+    finally:
+        engine.close()
+        ops_server.stop()
+    print("check_ops_server: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -63,6 +63,12 @@ case "$tier" in
     # proposal beats the default on that trace, and a second autotune.py
     # run against the warm winner store performs zero new measurements
     ./dev.sh python ci/check_autotune.py
+    # live ops plane smoke (ISSUE 10): Engine under MXNET_OPS_PORT=0 —
+    # /metrics must parse as Prometheus text and carry the serving
+    # counters, /healthz must flip 200->503 when the device loop is
+    # frozen, /statusz JSON must round-trip, and the streaming SLO p99
+    # must agree with loadgen's offline percentile on the same run
+    ./dev.sh python ci/check_ops_server.py
     # source lint (ISSUE 8): mxlint over mxnet_tpu/ must be clean against
     # the committed baseline, and a file of seeded hazards must trip every
     # rule (new findings = nonzero exit; docs/ANALYSIS.md)

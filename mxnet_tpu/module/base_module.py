@@ -231,6 +231,12 @@ class BaseModule:
         from .. import telemetry
 
         probe = telemetry.step_probe("module_fit")
+        # live ops plane (ISSUE 10): /metrics-scrapeable training jobs
+        # (MXNET_OPS_PORT) and per-step flight-recorder events
+        # (MXNET_FLIGHTREC_DIR).  Both gates unset = two env reads here
+        # and an unchanged loop below (frec is None, tested).
+        telemetry.ops_server.maybe_start()
+        frec = telemetry.flightrec.recorder()
 
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
@@ -244,7 +250,8 @@ class BaseModule:
                 probe.record_data_wait(time.perf_counter() - t0)
             while not end_of_batch:
                 data_batch = next_data_batch
-                t_batch = time.perf_counter() if probe else 0.0
+                t_batch = (time.perf_counter()
+                           if probe or frec is not None else 0.0)
                 if monitor is not None:
                     monitor.tic()
                 # span tracing (MXNET_TRACE): each batch is its own sampled
@@ -282,6 +289,11 @@ class BaseModule:
                     probe.record_step(
                         time.perf_counter() - t_batch - wait,
                         nsamples=data_batch.data[0].shape[0])
+                if frec is not None:
+                    # step event (data wait included): the training-side
+                    # timeline for a post-mortem dump
+                    frec.record("step", dur_s=time.perf_counter() - t_batch,
+                                epoch=epoch, step=nbatch)
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:

@@ -14,7 +14,12 @@ def pvary(x, axis_names):
 
     if hasattr(jax.lax, "pcast"):  # jax ≥ 0.9
         return jax.lax.pcast(x, tuple(axis_names), to="varying")
-    return jax.lax.pvary(x, tuple(axis_names))  # pragma: no cover
+    if hasattr(jax.lax, "pvary"):  # the varying-types era before pcast
+        return jax.lax.pvary(x, tuple(axis_names))  # pragma: no cover
+    # jax ≤ 0.4.x: shard_map has no varying-type annotations — values are
+    # implicitly device-varying inside the manual region, identity is the
+    # correct (and only) marking
+    return x
 
 
 __all__ = ["shard_map", "pvary"]

@@ -35,6 +35,11 @@ __all__ = ["Request", "MicroBatcher"]
 
 _PENDING, _DONE, _CANCELLED = "pending", "done", "cancelled"
 
+# bounded idle wait: an empty-queue consumer re-checks (and heartbeats, when
+# the Engine installed on_tick) at least this often instead of blocking
+# forever — the /healthz liveness contract (telemetry/ops_server.py)
+_IDLE_WAKE_S = 0.25
+
 
 class Request:
     """One in-flight inference request + its result future.
@@ -141,12 +146,19 @@ class Request:
 
 
 class MicroBatcher:
-    """Bounded FIFO of Requests + the batch-formation algorithm."""
+    """Bounded FIFO of Requests + the batch-formation algorithm.
 
-    def __init__(self, ladder, max_wait_s=0.005, on_drop=None):
+    ``on_tick`` (optional) is called at the top of every consumer wait
+    cycle — the Engine's device-loop heartbeat hook (ISSUE 10).  The idle
+    wait is bounded by ``_IDLE_WAKE_S`` so a healthy loop with an empty
+    queue still ticks; the wake itself is a no-op re-check.
+    """
+
+    def __init__(self, ladder, max_wait_s=0.005, on_drop=None, on_tick=None):
         self.ladder = ladder
         self.max_wait_s = float(max_wait_s)
         self.on_drop = on_drop or (lambda req, reason: None)
+        self.on_tick = on_tick
         self._queue = []
         self._cond = threading.Condition()
         self._closed = False
@@ -261,11 +273,13 @@ class MicroBatcher:
         batcher is closed and drained.  Single consumer."""
         with self._cond:
             while True:
+                if self.on_tick is not None:
+                    self.on_tick()
                 self._reap()
                 if not self._queue:
                     if self._closed:
                         return None
-                    self._cond.wait()
+                    self._cond.wait(_IDLE_WAKE_S)
                     continue
                 now = time.monotonic()
                 take, shapes, direct, earliest = self._formable(now)

@@ -33,7 +33,7 @@ import numpy as np
 
 from ..base import env_flag
 from ..predictor import Predictor
-from ..telemetry import tracing
+from ..telemetry import flightrec, ops_server, slo, tracing
 from .admission import AdmissionController, EngineClosed, ServerBusy
 from .batcher import MicroBatcher, Request
 from .bucketing import BucketLadder, _volume
@@ -131,7 +131,8 @@ class Engine:
             max_queue=max_queue,
             default_timeout_s=timeout_ms / 1000.0 if timeout_ms > 0 else None)
         self._batcher = MicroBatcher(self.ladder, max_wait_s=max_wait_ms / 1000.0,
-                                     on_drop=self._on_drop)
+                                     on_drop=self._on_drop,
+                                     on_tick=self._beat)
         # proto predictor: loads/parses symbol+params ONCE; every bucket
         # specializes off it via with_shapes (shared weight buffers).  It is
         # seeded into the cache as its own bucket's entry — compile
@@ -161,6 +162,19 @@ class Engine:
         self._warmup = None  # last warmup pass summary (stats() block)
         self._thread = None
         self._closed = False
+        # live ops plane (ISSUE 10) — each piece gates on its own env var;
+        # all unset costs three env reads HERE and nothing on the request
+        # path (every hook below is a single `is None` check, tested):
+        # - _heartbeat: monotonic stamp the device loop writes each wait/
+        #   dispatch cycle (single writer, read lock-free by /healthz)
+        # - _slo: streaming latency objectives fed from the reply path
+        # - _flightrec: bounded event ring dumped on failure
+        self._heartbeat = None
+        self._slo = slo.monitor_from_env()
+        self._flightrec = flightrec.recorder()
+        if self._slo is not None:
+            self._slo.on_breach = self._on_slo_breach
+        ops_server.maybe_register(self)
         # lock-discipline checking (ISSUE 8, MXNET_LOCKCHECK=1): swap the
         # three mutexes for order-recording CheckedLocks and wrap their
         # owned containers.  Off path = this one env_flag read; the
@@ -192,6 +206,34 @@ class Engine:
         self._batcher.close()
         if self._thread is not None:
             self._thread.join(timeout=30.0)
+        ops_server.unregister(self)
+
+    def _beat(self):
+        """Device-loop heartbeat — called from the batcher's wait cycle and
+        around dispatch.  Plain monotonic store, single writer (the loop),
+        read lock-free by ``ops_server.engine_health`` (GIL-atomic)."""
+        self._heartbeat = time.monotonic()
+
+    def _on_slo_breach(self, objective, value_s):
+        """SLO ok→breach edge (``slo.SLOMonitor.on_breach``, fired outside
+        the monitor lock): mirror into telemetry and trip the flight
+        recorder.  The dump (throttled file I/O) runs on a one-shot helper
+        thread — the device loop is already missing its latency target at
+        this moment and must not also pay a disk write."""
+        from .. import telemetry
+
+        telemetry.note_slo_breach(objective.klass, objective.percentile,
+                                  value_s * 1e3, objective.target_s * 1e3)
+        if self._flightrec is not None:
+            self._flightrec.record("slo_breach", engine=self.name,
+                                   objective=objective.key(),
+                                   value_ms=round(value_s * 1e3, 3))
+            threading.Thread(
+                target=self._flightrec.dump, args=("slo_breach",),
+                kwargs={"auto": True, "engine": self.name,
+                        "objective": objective.key(),
+                        "value_ms": round(value_s * 1e3, 3)},
+                name="mxnet-flightrec-dump", daemon=True).start()
 
     def __enter__(self):
         return self
@@ -200,13 +242,16 @@ class Engine:
         self.close()
 
     # -- request path --------------------------------------------------------
-    def submit(self, inputs, timeout=None):
+    def submit(self, inputs, timeout=None, klass=None):
         """Enqueue one request; returns a future-like ``Request``.
 
         ``inputs``: dict name -> array with leading sample-count dim n>=1.
         ``timeout``: seconds until the request is dropped if still queued
-        (overrides the engine default).  Raises ``ServerBusy`` when the
-        queue is at capacity, ``EngineClosed`` after ``close()``.
+        (overrides the engine default).  ``klass``: request class for SLO
+        accounting (``MXNET_SLO`` objectives; None ⇒ "default" — classes
+        change nothing about scheduling in this PR, they only label the
+        latency signal).  Raises ``ServerBusy`` when the queue is at
+        capacity, ``EngineClosed`` after ``close()``.
         """
         # span tracing (MXNET_TRACE, telemetry/tracing.py): the request root
         # lives on a per-trace lane; its context rides on the Request so the
@@ -220,6 +265,11 @@ class Engine:
             raise
         req = Request(arrays, n, bucket_shapes,
                       deadline=self.admission.deadline(timeout), direct=direct)
+        req.klass = klass
+        if self._flightrec is not None:
+            self._flightrec.record("submit", engine=self.name, n=n,
+                                   direct=int(direct),
+                                   klass=klass or "default")
         if root:
             root.set(n=n, direct=int(direct))
             req._trace_root = root
@@ -243,6 +293,13 @@ class Engine:
                     self._stats["direct"] -= 1
             if self._probe and isinstance(e, ServerBusy):
                 self._probe.record_drop("shed")
+            if isinstance(e, ServerBusy):
+                if self._slo is not None:
+                    self._slo.record_drop(klass)
+                if self._flightrec is not None:
+                    self._flightrec.record("drop", engine=self.name,
+                                           reason="shed",
+                                           klass=klass or "default")
             if root:
                 reason = "shed" if isinstance(e, ServerBusy) else "rejected"
                 req._trace_queue.finish(drop=reason)
@@ -254,7 +311,7 @@ class Engine:
             self._probe.record_submit(self._batcher.depth(), in_flight)
         return req
 
-    def predict(self, inputs, timeout=None):
+    def predict(self, inputs, timeout=None, klass=None):
         """Synchronous convenience: submit + wait -> list of output arrays
         (each sliced to this request's n rows on the batch dim).
 
@@ -274,7 +331,8 @@ class Engine:
                 "engine is not serving (start() not called, or the device "
                 "loop terminated) — a synchronous predict() would never "
                 "complete")
-        return self.submit(inputs, timeout=timeout).result(None)
+        return self.submit(inputs, timeout=timeout,
+                           klass=klass).result(None)
 
     def _classify(self, inputs):
         """Validate one request -> (np arrays, n, padded shape class,
@@ -326,10 +384,12 @@ class Engine:
     def _loop(self):
         reqs = ()
         try:
+            self._beat()  # first heartbeat: the loop is live
             while True:
                 item = self._batcher.next_batch()
                 if item is None:
                     return
+                self._beat()
                 reqs, bucket = item
                 if not reqs:
                     continue
@@ -345,6 +405,20 @@ class Engine:
                         self._finish_trace(req, "error")
                     if self._probe:
                         self._probe.record_drop("error", len(reqs))
+                    if self._slo is not None:
+                        for req in reqs:
+                            self._slo.record_drop(
+                                getattr(req, "klass", None))
+                    if self._flightrec is not None:
+                        # the black-box moment: a batch died under load —
+                        # record the failure, then dump the recent past
+                        self._flightrec.record("batch_error",
+                                               engine=self.name,
+                                               error=repr(e),
+                                               requests=len(reqs))
+                        self._flightrec.dump("batch_error", auto=True,
+                                             engine=self.name,
+                                             error=repr(e))
                 reqs = ()
         except BaseException as e:
             # loop is dying (batcher invariant broke, or a BaseException
@@ -406,6 +480,21 @@ class Engine:
                     off += req.n
         for r in traced:
             r._trace_root.finish()
+        self._beat()
+        # per-request submit->reply latency: the SLO monitor's feed, the
+        # flight-recorder lifecycle record, and the telemetry latency
+        # histogram (all `is None`-gated — nothing here when the gates are
+        # off beyond building the plain-list latencies for the probe)
+        latencies = [r.latency_s for r in reqs]
+        if self._slo is not None:
+            for r, lat in zip(reqs, latencies):
+                self._slo.record(lat, getattr(r, "klass", None))
+        if self._flightrec is not None:
+            for r, lat in zip(reqs, latencies):
+                self._flightrec.record(
+                    "serve", dur_s=lat, engine=self.name, n=r.n,
+                    bucket=label, klass=getattr(r, "klass", None)
+                    or "default")
         with self._stats_mu:
             self._stats["completed"] += len(reqs)
             self._stats["in_flight"] -= len(reqs)
@@ -421,7 +510,7 @@ class Engine:
             fill = total / float(bucket.batch)
             self._probe.record_batch(
                 label, fill, waste, dt, queue_waits,
-                in_flight, self._batcher.depth())
+                in_flight, self._batcher.depth(), latencies=latencies)
 
     @staticmethod
     def _padding_waste(reqs, bucket):
@@ -615,6 +704,16 @@ class Engine:
                 self._stats["in_flight"] -= 1
         if self._probe:
             self._probe.record_drop(reason)
+        # SLO accounting: timeouts/closed are violations the server owns;
+        # a client cancel is the client's choice (nginx's 499 stance) and
+        # does not burn the error budget.  Sheds are counted at submit.
+        if self._slo is not None and reason in ("timeout", "closed"):
+            self._slo.record_drop(getattr(req, "klass", None))
+        if self._flightrec is not None:
+            self._flightrec.record("drop", engine=self.name, reason=reason,
+                                   n=req.n,
+                                   klass=getattr(req, "klass", None)
+                                   or "default")
         self._finish_trace(req, reason)
 
     @staticmethod
@@ -653,4 +752,13 @@ class Engine:
             out["cache_size"] = len(self._cache) + len(self._direct_cache)
         out["ladder"] = [repr(b) for b in
                          self.ladder.signatures(self.sample_shapes)]
+        # live ops plane (ISSUE 10): the streaming SLO block (None when
+        # MXNET_SLO is off — the monitor never exists) and the device-loop
+        # heartbeat age (None until the loop first ticks).  Both read
+        # outside _stats_mu: the monitor has its own lock, the heartbeat
+        # is a single-writer float.
+        out["slo"] = self._slo.status() if self._slo is not None else None
+        hb = self._heartbeat
+        out["heartbeat_age_s"] = (round(max(0.0, time.monotonic() - hb), 3)
+                                  if hb is not None else None)
         return out

@@ -94,5 +94,15 @@ def warmup_engine(engine, buckets=None, verbose=False, max_workers=None):
             if row.get("check_warnings"):
                 state += "  [check: %d diagnostics]" % row["check_warnings"]
             print("warmup %-28s %s" % (row["bucket"], state))
-    engine._note_warmup(report, time.perf_counter() - t0)
+    total_s = time.perf_counter() - t0
+    engine._note_warmup(report, total_s)
+    # flight recorder (ISSUE 10): a warmup pass is a lifecycle landmark —
+    # a post-mortem dump should show whether the failing traffic hit a
+    # warmed or a cold ladder (one `is None` check when the gate is off)
+    if engine._flightrec is not None:
+        engine._flightrec.record(
+            "warmup", dur_s=total_s, engine=engine.name,
+            buckets=len(report),
+            fresh=sum(1 for r in report if r["fresh"]),
+            cache_hits=sum(1 for r in report if r.get("cache") == "hit"))
     return report

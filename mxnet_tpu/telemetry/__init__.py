@@ -16,6 +16,7 @@ telemetry.  See docs/OBSERVABILITY.md for the JSONL schema and recipes.
 from . import tracing
 from .registry import (Counter, Gauge, Histogram, MetricError, Registry,
                        DEFAULT_BUCKETS)
+from . import flightrec, ops_server, slo  # live ops plane (ISSUE 10)
 from .sinks import (JsonlSink, PrometheusSink, ProfilerSink, Sink,
                     TensorBoardSink, iter_scalar_samples, render_prometheus)
 from .instrument import (ServeProbe, StepProbe, add_sink, array_nbytes,
@@ -25,12 +26,12 @@ from .instrument import (ServeProbe, StepProbe, add_sink, array_nbytes,
                          note_autotune_trial, note_bytes,
                          note_compile, note_dispatch, note_fused_fallback,
                          note_graph_passes, note_lockcheck_violation,
-                         note_nonfinite, note_train_step,
+                         note_nonfinite, note_slo_breach, note_train_step,
                          registry, sample_memory, serve_probe, step_probe,
                          summary)
 
 __all__ = [
-    "tracing",
+    "tracing", "flightrec", "ops_server", "slo",
     "Counter", "Gauge", "Histogram", "MetricError", "Registry",
     "DEFAULT_BUCKETS",
     "Sink", "JsonlSink", "PrometheusSink", "ProfilerSink", "TensorBoardSink",
@@ -40,7 +41,8 @@ __all__ = [
     "interval_s", "jsonl_path", "note_aot_cache", "note_autotune_cache",
     "note_autotune_trial", "note_bytes", "note_compile",
     "note_dispatch", "note_fused_fallback", "note_graph_passes",
-    "note_lockcheck_violation", "note_nonfinite", "note_train_step",
+    "note_lockcheck_violation", "note_nonfinite", "note_slo_breach",
+    "note_train_step",
     "registry", "sample_memory",
     "serve_probe", "step_probe", "summary",
 ]

@@ -1,0 +1,213 @@
+"""Crash-dump flight recorder — the black box for failures under load
+(ISSUE 10).
+
+An always-cheap bounded ring of recent request-lifecycle and step events:
+one dict append per event, fixed memory (``deque(maxlen=...)``), no file
+I/O until something goes wrong.  On a trigger — a batch model error, an
+SLO breach (``telemetry/slo.py`` ``on_breach``), an explicit :meth:`dump`,
+or ``SIGUSR2`` — the ring is written to ``$MXNET_FLIGHTREC_DIR`` as
+Chrome-trace JSON: events reuse the tracing span record shape
+(``telemetry/tracing.py`` export — ``ph:"X"`` with ``ts``/``dur`` in the
+shared ``mx.profiler`` perf_counter microsecond timebase, ``ph:"i"`` for
+instants), so a dump opens directly in Perfetto and ``tools/trace_merge.py``
+can align it with a live trace via the embedded ``clock_sync``.
+
+Unlike tracing (sampled, opt-in, exported at exit), the recorder keeps
+only the recent past and writes only on failure — it is the thing you read
+*after* the 3 a.m. page, for the bugs that only reproduce under load.
+
+Gating: :func:`recorder` returns None when ``MXNET_FLIGHTREC_DIR`` is
+unset — call sites keep one ``is None`` check (the PR 1/4 zero-overhead
+contract, tested).  Automatic dumps (error/breach triggers) are throttled
+to one per :data:`MIN_AUTO_DUMP_S` so a sustained breach cannot storm the
+disk; explicit ``dump()`` and SIGUSR2 always write.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from ..profiler import _now_us  # shared host timebase with tracing/profiler
+
+__all__ = ["enabled", "flightrec_dir", "FlightRecorder", "recorder",
+           "record", "dump", "RING_CAP", "MIN_AUTO_DUMP_S"]
+
+RING_CAP = 4096          # events kept; oldest evicted
+MIN_AUTO_DUMP_S = 30.0   # throttle for error/breach-triggered dumps
+_PID = 0                 # chrome-trace process id (matches tracing export)
+
+
+def enabled():
+    return bool(os.environ.get("MXNET_FLIGHTREC_DIR", "").strip())
+
+
+def flightrec_dir():
+    return os.environ.get("MXNET_FLIGHTREC_DIR", "").strip()
+
+
+class FlightRecorder:
+    """One bounded event ring + the dump writer.
+
+    ``record`` is the hot-path call: build one small dict, append to a
+    ``deque`` (GIL-atomic) — no lock, no I/O, no time syscall beyond the
+    shared ``_now_us``.  ``dump`` snapshots the ring and writes atomically
+    (tmp + rename); write failures warn once and disable dumping rather
+    than failing the serving path that triggered them (the JsonlSink
+    contract)."""
+
+    def __init__(self, directory, cap=RING_CAP, min_auto_dump_s=None):
+        import collections
+
+        self.directory = directory
+        self._ring = collections.deque(maxlen=cap)
+        self._dump_mu = threading.Lock()
+        self._min_auto_s = (MIN_AUTO_DUMP_S if min_auto_dump_s is None
+                            else float(min_auto_dump_s))
+        self._last_auto = {}  # reason -> monotonic of last auto dump
+        self._seq = 0
+        self._broken = False
+
+    # -- hot path ------------------------------------------------------------
+    def record(self, name, dur_s=None, **args):
+        """Append one event.  ``dur_s`` set ⇒ a completed span that ENDED
+        now (``ts`` is backdated so the slice renders where the work ran);
+        None ⇒ an instant event."""
+        now = _now_us()
+        if dur_s is not None:
+            ev = {"name": name, "cat": "flightrec", "ph": "X",
+                  "ts": round(now - dur_s * 1e6, 3),
+                  "dur": round(dur_s * 1e6, 3), "pid": _PID,
+                  "tid": threading.get_ident() % 1_000_000, "args": args}
+        else:
+            ev = {"name": name, "cat": "flightrec", "ph": "i", "s": "t",
+                  "ts": round(now, 3), "pid": _PID,
+                  "tid": threading.get_ident() % 1_000_000, "args": args}
+        self._ring.append(ev)  # deque append is atomic under the GIL
+
+    # -- dump ----------------------------------------------------------------
+    def dump(self, reason="explicit", auto=False, **meta):
+        """Write the ring → the dump path, or None (throttled auto dump,
+        empty ring, or a previously failed directory).  The auto throttle
+        is per REASON: a sustained SLO breach must not starve the dump for
+        a later batch error."""
+        with self._dump_mu:
+            if self._broken:
+                return None
+            now = time.monotonic()
+            last = self._last_auto.get(reason)
+            if auto and last is not None \
+                    and now - last < self._min_auto_s:
+                return None
+            evs = list(self._ring)
+            if not evs:
+                return None
+            if auto:
+                self._last_auto[reason] = now
+            self._seq += 1
+            seq = self._seq
+        payload = {
+            "traceEvents": [
+                {"name": "process_name", "ph": "M", "pid": _PID,
+                 "args": {"name": "mxnet_tpu flight recorder"}},
+                {"name": "clock_sync", "ph": "M", "pid": _PID,
+                 "args": {"unix_ts": round(time.time(), 6),
+                          "trace_ts_us": round(_now_us(), 3)}},
+            ] + evs,
+            "displayTimeUnit": "ms",
+            "flightrec": dict(meta, reason=str(reason), pid=os.getpid(),
+                              unix_ts=round(time.time(), 6),
+                              events=len(evs)),
+        }
+        path = os.path.join(
+            self.directory,
+            "flightrec-%d-%03d-%s.json" % (os.getpid(), seq,
+                                           str(reason).replace("/", "_")))
+        try:
+            os.makedirs(self.directory, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(payload, f, default=str)
+            os.replace(tmp, path)
+        except OSError as e:
+            with self._dump_mu:
+                self._broken = True
+            import logging
+
+            logging.warning("flightrec: cannot write %s (%s) — recorder "
+                            "dumps disabled", path, e)
+            return None
+        return path
+
+    def __len__(self):
+        return len(self._ring)
+
+
+# -- process-global recorder (mirrors instrument.registry) --------------------
+_mu = threading.Lock()
+_recorder = None
+_signal_armed = False
+
+
+def recorder():
+    """The process recorder, or None when ``MXNET_FLIGHTREC_DIR`` is unset
+    — the caller's one-check gate.  One recorder per process: serving and
+    the fit loop share a single timeline, which is the point of a black
+    box.  The SIGUSR2 dump hook is armed on first creation (main thread
+    only; elsewhere the explicit ``dump()`` surfaces remain)."""
+    global _recorder, _signal_armed
+    if not enabled():
+        return None
+    with _mu:
+        if _recorder is None or _recorder.directory != flightrec_dir():
+            _recorder = FlightRecorder(flightrec_dir())
+        if not _signal_armed:
+            try:
+                import signal
+
+                signal.signal(signal.SIGUSR2, _on_sigusr2)
+                _signal_armed = True
+            except (ValueError, OSError, AttributeError):
+                # not the main thread, or no SIGUSR2 on this platform
+                _signal_armed = True
+        return _recorder
+
+
+def _on_sigusr2(signum, frame):
+    # NEVER dump from the signal frame: the interrupted main thread may be
+    # holding _mu or the recorder's _dump_mu mid-call (both non-reentrant),
+    # and file I/O inside a handler is unsafe anyway — hand the work to a
+    # one-shot thread and return immediately
+    threading.Thread(target=_signal_dump, name="mxnet-flightrec-sigusr2",
+                     daemon=True).start()
+
+
+def _signal_dump():
+    with _mu:
+        r = _recorder
+    if r is not None:
+        r.dump("sigusr2")
+
+
+def _reset_for_tests():
+    global _recorder, _signal_armed
+    with _mu:
+        _recorder = None
+        _signal_armed = False
+
+
+def record(name, dur_s=None, **args):
+    """Module-level convenience: record when enabled, else no-op (one env
+    read — for call sites that don't hold a recorder handle)."""
+    r = recorder()
+    if r is not None:
+        r.record(name, dur_s=dur_s, **args)
+
+
+def dump(reason="explicit", **meta):
+    """Module-level explicit dump → path or None."""
+    r = recorder()
+    if r is None:
+        return None
+    return r.dump(reason, **meta)

@@ -39,7 +39,7 @@ __all__ = ["enabled", "jsonl_path", "interval_s", "registry", "add_sink",
            "note_autotune_trial", "note_compile", "note_bytes",
            "array_nbytes",
            "note_dispatch", "note_train_step", "note_fused_fallback",
-           "note_nonfinite",
+           "note_nonfinite", "note_slo_breach",
            "sample_memory", "step_probe", "StepProbe", "summary",
            "serve_probe", "ServeProbe", "SERVE_LATENCY_BUCKETS",
            "FRACTION_BUCKETS"]
@@ -314,6 +314,23 @@ def note_autotune_cache(kind, kernel="?"):
     registry().counter(name, help_, ("kernel",)).inc(kernel=str(kernel))
 
 
+def note_slo_breach(klass, percentile, value_ms, target_ms):
+    """Count one SLO ok→breach edge (telemetry/slo.py, ISSUE 10) and emit
+    the event — the registry mirror of ``Engine.stats()["slo"]``, which
+    stays authoritative (and on) without telemetry."""
+    if not enabled():
+        return
+    r = registry()
+    r.counter("slo_breaches_total",
+              "SLO objective ok->breach transitions",
+              ("class", "percentile")).inc(
+        **{"class": str(klass), "percentile": "p%g" % percentile})
+    r.event("slo_breach", **{"class": str(klass),
+                             "percentile": float(percentile),
+                             "value_ms": round(float(value_ms), 3),
+                             "target_ms": round(float(target_ms), 3)})
+
+
 def note_graph_passes(nodes_pre, nodes_post, seconds, mode="eval"):
     """Record one graph-pass pipeline run over an executor plan (ISSUE 7,
     ``Executor._opt_plan``).  Counters accumulate across executors — the
@@ -477,6 +494,11 @@ class ServeProbe:
         self.queue_hist = r.histogram(
             "serve_queue_seconds", "submit->dispatch wait", ("engine",),
             SERVE_LATENCY_BUCKETS)
+        # end-to-end request latency (submit->reply) — the SLO surface's
+        # registry mirror; summary()'s serve_p50_ms/serve_p99_ms read it
+        self.latency_hist = r.histogram(
+            "serve_latency_seconds", "submit->reply request latency",
+            ("engine",), SERVE_LATENCY_BUCKETS)
         self.exec_hist = r.histogram(
             "serve_execute_seconds", "device forward wall time (synced)",
             ("engine",), SERVE_LATENCY_BUCKETS)
@@ -513,13 +535,16 @@ class ServeProbe:
         self.drops.inc(n, engine=self.engine, reason=reason)
 
     def record_batch(self, bucket, fill, waste, exec_s, queue_waits,
-                     in_flight, depth):
+                     in_flight, depth, latencies=()):
         self.batches.inc(engine=self.engine, bucket=bucket)
         self.fill_hist.observe(fill, engine=self.engine)
         self.waste_hist.observe(waste, engine=self.engine)
         self.exec_hist.observe(exec_s, engine=self.engine)
         for w in queue_waits:
             self.queue_hist.observe(w, engine=self.engine)
+        for lat in latencies:
+            if lat is not None:
+                self.latency_hist.observe(lat, engine=self.engine)
         self.in_flight.set(in_flight, engine=self.engine)
         self.queue_depth.set(depth, engine=self.engine)
 
@@ -577,6 +602,10 @@ def summary():
     # autotune surface (ISSUE 9): candidate configs measured this process —
     # null when no search ran (steady state: the winner store answers)
     at_trials = r.total("autotune_trials_total", None)
+    # serving latency surface (ISSUE 10): submit->reply quantiles from the
+    # serve_latency_seconds histogram — null when no serving ran
+    sp50 = r.hist_quantile("serve_latency_seconds", 0.50, None)
+    sp99 = r.hist_quantile("serve_latency_seconds", 0.99, None)
     return {"compile_s": round(compile_s, 3),
             "peak_hbm_bytes": int(peak) if peak is not None else None,
             "data_wait_frac": round(frac, 4),
@@ -586,4 +615,8 @@ def summary():
             "graph_nodes_post": int(gp_post) if gp_post is not None else None,
             "pass_time_s": round(gp_s, 4) if gp_s is not None else None,
             "autotune_trials": int(at_trials) if at_trials is not None
+            else None,
+            "serve_p50_ms": round(sp50 * 1e3, 3) if sp50 is not None
+            else None,
+            "serve_p99_ms": round(sp99 * 1e3, 3) if sp99 is not None
             else None}

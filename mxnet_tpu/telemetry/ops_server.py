@@ -1,0 +1,294 @@
+"""Live ops HTTP endpoints — /metrics, /healthz, /statusz (ISSUE 10).
+
+One stdlib ``http.server`` on one daemon thread, gated on
+``MXNET_OPS_PORT`` (``0`` = ephemeral — the bound port comes back from
+:func:`port`).  Nothing to install, nothing running when the gate is
+unset: :func:`maybe_register` is the Engine/fit-loop entry point and is a
+single env read on the off path (the PR 1/4 zero-overhead contract).
+
+Endpoints:
+
+* ``/metrics``  — the telemetry registry in Prometheus text exposition
+  format, rendered by the SAME :func:`telemetry.sinks.render_prometheus`
+  the ``PrometheusSink`` textfile collector uses (one formatter, two
+  transports — a scrape and the sink can never disagree).
+* ``/healthz``  — 200/503 from real liveness signals: every registered
+  engine's device-loop **heartbeat** (written each loop iteration; the
+  batcher's idle wait is bounded so a healthy-idle loop still beats),
+  loop-thread aliveness, and queue depth vs capacity.  Stale threshold:
+  ``MXNET_OPS_STALE_S`` (default 5 s; a legitimate forward longer than
+  this will flap health — raise the threshold for huge direct batches).
+* ``/statusz``  — JSON: per-engine ``Engine.stats()`` (SLO + warmup +
+  bucket_stats blocks included), health detail, and process metadata.
+
+Engines self-register at construction and unregister at ``close()``;
+registration holds only a weak reference, so a dropped engine never stays
+on the health page (or in memory) because an HTTP server saw it once.
+Handler errors return 500 and never kill the server thread; a failed bind
+warns once and disables the server rather than failing the Engine that
+tried to start it (the sink failure contract).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import weakref
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+__all__ = ["enabled", "configured_port", "stale_s", "maybe_start",
+           "maybe_register", "register", "unregister", "port", "active",
+           "stop"]
+
+_mu = threading.Lock()
+_server = None
+_thread = None
+_engines = []   # weakref.ref list, pruned on read
+_failed = False
+
+
+_warned_bad_port = False
+
+
+def configured_port():
+    """``MXNET_OPS_PORT`` → int port (0 = ephemeral) or None when unset or
+    malformed.  A malformed value warns ONCE and disables the endpoints —
+    the operator must learn monitoring is off before the incident, but the
+    Engine constructing must never crash over it."""
+    global _warned_bad_port
+    raw = os.environ.get("MXNET_OPS_PORT", "").strip()
+    if not raw:
+        return None
+    try:
+        p = int(raw)
+    except ValueError:
+        p = None
+    if p is None or not 0 <= p < 65536:
+        if not _warned_bad_port:
+            _warned_bad_port = True
+            import logging
+
+            logging.warning("ops_server: MXNET_OPS_PORT=%r is not a valid "
+                            "port — ops endpoints disabled", raw)
+        return None
+    return p
+
+
+def enabled():
+    return configured_port() is not None
+
+
+def stale_s():
+    """Heartbeat staleness threshold (seconds) for /healthz."""
+    try:
+        v = float(os.environ.get("MXNET_OPS_STALE_S", "5"))
+    except ValueError:
+        return 5.0
+    return v if v > 0 else 5.0
+
+
+def _host():
+    # loopback by default: metrics/status leak operational detail; opt
+    # into other interfaces explicitly (MXNET_OPS_HOST=0.0.0.0)
+    return os.environ.get("MXNET_OPS_HOST", "127.0.0.1").strip() \
+        or "127.0.0.1"
+
+
+# -- registration -------------------------------------------------------------
+def _live_engines():
+    with _mu:
+        live, out = [], []
+        for ref in _engines:
+            e = ref()
+            if e is not None:
+                live.append(ref)
+                out.append(e)
+        _engines[:] = live
+        return out
+
+
+def register(engine):
+    """Track an engine for /healthz + /statusz (weakly)."""
+    with _mu:
+        if not any(ref() is engine for ref in _engines):
+            _engines.append(weakref.ref(engine))
+
+
+def unregister(engine):
+    with _mu:
+        _engines[:] = [ref for ref in _engines
+                       if ref() is not None and ref() is not engine]
+
+
+def maybe_start():
+    """Start the server when ``MXNET_OPS_PORT`` is set (idempotent);
+    return the bound port or None.  The off path is one env read."""
+    p = configured_port()
+    if p is None:
+        return None
+    return _start(p)
+
+
+def maybe_register(engine):
+    """Engine entry point: start-if-gated, then register.  One env read
+    when the gate is unset."""
+    p = maybe_start()
+    if p is None:
+        return None
+    register(engine)
+    return p
+
+
+def port():
+    """The actually-bound port (resolves MXNET_OPS_PORT=0), or None."""
+    with _mu:
+        return None if _server is None else _server.server_address[1]
+
+
+def active():
+    with _mu:
+        return _server is not None
+
+
+def _start(p):
+    global _server, _thread, _failed
+    with _mu:
+        if _server is not None:
+            return _server.server_address[1]
+        if _failed:
+            return None
+        try:
+            srv = ThreadingHTTPServer((_host(), p), _Handler)
+            srv.daemon_threads = True
+        except OSError as e:
+            _failed = True
+            import logging
+
+            logging.warning("ops_server: cannot bind %s:%s (%s) — ops "
+                            "endpoints disabled", _host(), p, e)
+            return None
+        _server = srv
+        _thread = threading.Thread(target=srv.serve_forever,
+                                   name="mxnet-ops-server", daemon=True)
+        _thread.start()
+        return srv.server_address[1]
+
+
+def stop():
+    """Shut the server down and forget registrations (tests; production
+    servers live for the process)."""
+    global _server, _thread, _failed
+    with _mu:
+        srv, th = _server, _thread
+        _server = _thread = None
+        _engines[:] = []
+        _failed = False
+    if srv is not None:
+        srv.shutdown()
+        srv.server_close()
+    if th is not None:
+        th.join(timeout=5.0)
+
+
+# -- health -------------------------------------------------------------------
+def engine_health(engine, now=None, threshold=None):
+    """One engine's liveness verdict (also callable without the server —
+    tests and embedders use it directly).
+
+    ok ⇔ device-loop thread alive ∧ heartbeat younger than the stale
+    threshold ∧ queue below capacity.  An engine built with ``start=False``
+    (or already closed) reports not-ok: /healthz is a *readiness* check —
+    "can a request submitted now make progress"."""
+    now = time.monotonic() if now is None else now
+    thread = getattr(engine, "_thread", None)
+    alive = (thread is not None and thread.is_alive()
+             and not getattr(engine, "_closed", False))
+    hb = getattr(engine, "_heartbeat", None)
+    age = None if hb is None else max(0.0, now - hb)
+    limit = stale_s() if threshold is None else threshold
+    depth = engine._batcher.depth()
+    max_queue = engine.admission.max_queue
+    saturated = depth >= max_queue
+    with engine._stats_mu:
+        warmed = engine._warmup is not None
+    ok = alive and age is not None and age <= limit and not saturated
+    return {"engine": engine.name, "ok": ok, "loop_alive": alive,
+            "heartbeat_age_s": None if age is None else round(age, 3),
+            "stale_after_s": limit, "queue_depth": depth,
+            "max_queue": max_queue, "saturated": saturated,
+            "warmed": warmed}
+
+
+def _health():
+    engines = _live_engines()
+    checks = [engine_health(e) for e in engines]
+    ok = all(c["ok"] for c in checks)  # no engines ⇒ process-alive 200
+    return ok, {"ok": ok, "engines": checks}
+
+
+def _statusz():
+    from . import instrument
+
+    engines = {}
+    for e in _live_engines():
+        label = e.name
+        i = 1
+        while label in engines:
+            i += 1
+            label = "%s#%d" % (e.name, i)
+        try:
+            engines[label] = e.stats()
+        except Exception as ex:
+            engines[label] = {"error": repr(ex)}
+    ok, health = _health()
+    return {"pid": os.getpid(), "unix_ts": round(time.time(), 6),
+            "telemetry_enabled": instrument.enabled(),
+            "health": health, "engines": engines}
+
+
+# -- handler ------------------------------------------------------------------
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "mxnet-ops/1"
+
+    def log_message(self, fmt, *args):  # no stderr chatter per scrape
+        pass
+
+    def _send(self, code, body, ctype):
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                from .instrument import registry
+                from .sinks import render_prometheus
+
+                self._send(200, render_prometheus(registry().collect()),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/healthz":
+                ok, detail = _health()
+                self._send(200 if ok else 503,
+                           json.dumps(detail, default=str) + "\n",
+                           "application/json")
+            elif path == "/statusz":
+                self._send(200, json.dumps(_statusz(), default=str) + "\n",
+                           "application/json")
+            else:
+                self._send(404, json.dumps(
+                    {"error": "unknown path %r" % path,
+                     "endpoints": ["/metrics", "/healthz", "/statusz"]})
+                    + "\n", "application/json")
+        except BrokenPipeError:
+            pass  # client went away mid-write
+        except Exception as e:
+            try:
+                self._send(500, json.dumps({"error": repr(e)}) + "\n",
+                           "application/json")
+            except OSError:
+                pass

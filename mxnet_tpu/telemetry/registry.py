@@ -244,6 +244,37 @@ class Registry:
             return default
         return sum(s["sum"] for s in m.samples()) or default
 
+    def hist_quantile(self, name, q, default=None):
+        """Estimated q-quantile of a histogram, merged across all label
+        sets (``histogram_quantile``-style linear interpolation inside the
+        rank's bucket; a rank landing in the +Inf bucket clamps to the top
+        finite edge).  ``default`` when the metric is absent/empty — the
+        bench ``summary()`` serve-latency keys read this."""
+        m = self.get(name)
+        if m is None or m.typ != "histogram":
+            return default
+        samples = m.samples()
+        edges = m.buckets
+        counts = [0] * (len(edges) + 1)
+        total = 0
+        for s in samples:
+            prev = 0
+            for i, (_, cum) in enumerate(s["buckets"]):
+                counts[i] += cum - prev
+                prev = cum
+            total += s["count"]
+        if total == 0:
+            return default
+        rank = min(max(float(q), 0.0), 1.0) * total
+        cum, lo = 0, 0.0
+        for i, le in enumerate(edges):
+            if counts[i] and cum + counts[i] >= rank:
+                frac = (rank - cum) / counts[i]
+                return lo + (le - lo) * min(max(frac, 0.0), 1.0)
+            cum += counts[i]
+            lo = le
+        return edges[-1] if edges else default
+
     # -- sinks / events -----------------------------------------------------
     def add_sink(self, sink):
         with self._mu:

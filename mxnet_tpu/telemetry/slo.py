@@ -1,0 +1,514 @@
+"""Streaming SLO monitor — in-process latency objectives (ISSUE 10).
+
+Every latency surface this repo had before was post-hoc: loadgen computes
+``np.percentile`` over a finished run, the JSONL log is read after the
+fact.  An operator watching a LIVE engine needs the P99 *now*, from inside
+the serving process, at O(1) memory — that signal is the prerequisite for
+SLO-driven shedding (ROADMAP item 1; this PR builds the signal, the
+default policy is unchanged).
+
+Three pieces:
+
+* :class:`WindowedQuantile` — the streaming estimator.  A sliding window
+  of fixed-size **log-bucketed sub-histograms** (``NSUB`` sub-windows of
+  ``window_s / NSUB`` seconds each; expired sub-histograms are dropped on
+  rotation, so memory is a constant ``(NSUB+1) × NBUCKETS`` ints no matter
+  how long the process runs).  Sub-histograms are mergeable by vector
+  addition — per-class estimators merge into the ``"*"`` aggregate for
+  free.  Quantiles come back as the geometric midpoint of the rank's
+  bucket, so the **documented relative error bound is
+  ``RELATIVE_ERROR = sqrt(GAMMA) - 1`` (~4.9 %)** for values inside
+  [``MIN_LATENCY_S``, ``MAX_LATENCY_S``] (outside, the estimate clamps to
+  the range edge).  The window a query covers is ``window_s`` up to
+  ``window_s + window_s/NSUB`` (the partial current sub-window is always
+  included) — standard for sub-histogram sliding windows.
+* :class:`SLOObjective` — one declared contract: request class, percentile,
+  target, window.  Parsed from ``MXNET_SLO``
+  (``class:pNN:target_ms[:window_s]``, comma-separated; a bare truthy
+  value like ``1`` declares the default ``*:p99:100:60``).  Malformed
+  items are skipped, never a crash (the ``_env_ladder`` contract).
+* :class:`SLOMonitor` — fed one ``record(latency_s, klass)`` per completed
+  request from the Engine reply path (and ``record_drop`` per shed/timeout/
+  error).  Tracks per-class windowed quantiles (completed requests only),
+  per-objective cumulative goodput, error-budget **burn rate** (window
+  bad-fraction / allowed bad-fraction — burn > 1 means the budget is being
+  spent faster than the objective affords), and breach edges (ok→breach
+  transitions, throttled to one evaluation per second so the reply path
+  never pays a quantile walk per request; callbacks fire outside the
+  monitor lock).  Drops enter each matching objective's window as
+  *infinite latencies* — an outage with zero completions still breaches
+  (the reported value clamps to ``MAX_LATENCY_S``) — while the per-class
+  quantile blocks stay completed-only.  ``on_breach`` is the
+  flight-recorder hook (``telemetry/flightrec.py``).
+
+Gating: everything is reached through :func:`monitor_from_env`, which
+returns None when ``MXNET_SLO`` is unset/falsy — the Engine then keeps a
+single ``is None`` check on the reply path (the PR 1/4 zero-overhead
+contract; tested in tests/test_ops_plane.py).
+"""
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+
+__all__ = ["GAMMA", "MIN_LATENCY_S", "MAX_LATENCY_S", "RELATIVE_ERROR",
+           "WindowedQuantile", "SLOObjective", "SLOMonitor",
+           "parse_objectives", "monitor_from_env"]
+
+# log-bucket geometry: edges[i] = MIN * GAMMA**i.  gamma=1.1 over
+# 0.1 ms .. 120 s is ~147 buckets; a sub-histogram is one int list.
+GAMMA = 1.1
+MIN_LATENCY_S = 1e-4
+MAX_LATENCY_S = 120.0
+_LOG_GAMMA = math.log(GAMMA)
+NBUCKETS = int(math.ceil(math.log(MAX_LATENCY_S / MIN_LATENCY_S) / _LOG_GAMMA))
+# documented estimator bound: a value is reported as its bucket's geometric
+# midpoint, at most sqrt(gamma) away from the truth in either direction
+RELATIVE_ERROR = math.sqrt(GAMMA) - 1.0
+
+NSUB = 6  # sub-windows per sliding window
+
+
+def _bucket_index(value):
+    """value (seconds) -> bucket index in [0, NBUCKETS+1]: 0 is the
+    underflow bucket (< MIN_LATENCY_S), NBUCKETS+1 the overflow bucket."""
+    if value < MIN_LATENCY_S:
+        return 0
+    if value >= MAX_LATENCY_S:
+        return NBUCKETS + 1
+    return 1 + min(NBUCKETS - 1,
+                   int(math.log(value / MIN_LATENCY_S) / _LOG_GAMMA))
+
+
+def _bucket_value(index):
+    """Bucket index -> representative latency (seconds).  Interior buckets
+    report their geometric midpoint (the RELATIVE_ERROR bound); the
+    underflow/overflow buckets clamp to the range edge."""
+    if index <= 0:
+        return MIN_LATENCY_S
+    if index >= NBUCKETS + 1:
+        return MAX_LATENCY_S
+    return MIN_LATENCY_S * GAMMA ** (index - 1) * math.sqrt(GAMMA)
+
+
+class WindowedQuantile:
+    """Sliding-window streaming quantiles over log-spaced buckets.
+
+    O(1) memory (at most ``NSUB+1`` fixed-size count vectors), O(1)
+    ``observe``, O(NBUCKETS) ``quantile``.  Not internally locked — the
+    :class:`SLOMonitor` serializes access; standalone users must too.
+    ``now`` parameters exist so tests can drive a synthetic clock.
+    """
+
+    __slots__ = ("window_s", "_sub_s", "_subs")
+
+    def __init__(self, window_s=60.0):
+        self.window_s = float(window_s)
+        self._sub_s = self.window_s / NSUB
+        self._subs = []  # [(epoch, counts)] oldest-first, <= NSUB+1 live
+
+    def _rotate(self, now):
+        epoch = int(now / self._sub_s)
+        floor = epoch - NSUB  # keep the partial current + NSUB past
+        self._subs = [(e, c) for e, c in self._subs if e >= floor]
+        return epoch
+
+    def observe(self, value, now=None):
+        now = time.monotonic() if now is None else now
+        epoch = self._rotate(now)
+        if not self._subs or self._subs[-1][0] != epoch:
+            self._subs.append((epoch, [0] * (NBUCKETS + 2)))
+        self._subs[-1][1][_bucket_index(float(value))] += 1
+
+    def _merged(self, now):
+        self._rotate(now)
+        counts = [0] * (NBUCKETS + 2)
+        for _, c in self._subs:
+            for i, n in enumerate(c):
+                if n:
+                    counts[i] += n
+        return counts
+
+    def merge_into(self, counts, now=None):
+        """Add this window's live counts into ``counts`` (the mergeable
+        half of the estimator: class histograms sum into aggregates)."""
+        now = time.monotonic() if now is None else now
+        for i, n in enumerate(self._merged(now)):
+            if n:
+                counts[i] += n
+        return counts
+
+    def count(self, now=None):
+        now = time.monotonic() if now is None else now
+        return sum(self._merged(now))
+
+    def quantile(self, q, now=None):
+        """q in [0,1] -> estimated latency seconds, or None on an empty
+        window."""
+        now = time.monotonic() if now is None else now
+        return quantile_of_counts(self._merged(now), q)
+
+
+def quantile_of_counts(counts, q):
+    """Shared rank walk over one (possibly merged) count vector."""
+    total = sum(counts)
+    if total == 0:
+        return None
+    rank = max(1, int(math.ceil(min(max(q, 0.0), 1.0) * total)))
+    cum = 0
+    for i, n in enumerate(counts):
+        cum += n
+        if cum >= rank:
+            return _bucket_value(i)
+    return _bucket_value(NBUCKETS + 1)
+
+
+def value_at_rank(counts, rank):
+    """Latency at the given 1-based rank of a count vector (None when the
+    rank exceeds the population)."""
+    cum = 0
+    for i, n in enumerate(counts):
+        cum += n
+        if cum >= rank:
+            return _bucket_value(i)
+    return None
+
+
+class _WindowCounter:
+    """Sliding-window event counter — the same epoch-ring rotation as
+    :class:`WindowedQuantile`, counting drops (requests that never
+    completed) so breach/burn detection stays live during an outage that
+    produces no latency samples at all."""
+
+    __slots__ = ("window_s", "_sub_s", "_subs")
+
+    def __init__(self, window_s):
+        self.window_s = float(window_s)
+        self._sub_s = self.window_s / NSUB
+        self._subs = []  # [[epoch, count]] oldest-first
+
+    def _rotate(self, now):
+        epoch = int(now / self._sub_s)
+        floor = epoch - NSUB
+        self._subs = [s for s in self._subs if s[0] >= floor]
+        return epoch
+
+    def inc(self, now):
+        epoch = self._rotate(now)
+        if not self._subs or self._subs[-1][0] != epoch:
+            self._subs.append([epoch, 0])
+        self._subs[-1][1] += 1
+
+    def count(self, now):
+        self._rotate(now)
+        return sum(s[1] for s in self._subs)
+
+
+def good_fraction(counts, target_s):
+    """Fraction of a count vector at or below ``target_s`` (bucket-
+    quantized: a bucket counts as good when its representative midpoint
+    meets the target)."""
+    total = sum(counts)
+    if total == 0:
+        return None
+    good = sum(n for i, n in enumerate(counts)
+               if n and _bucket_value(i) <= target_s)
+    return good / total
+
+
+class SLOObjective:
+    """One declared latency contract for a request class."""
+
+    __slots__ = ("klass", "percentile", "target_s", "window_s")
+
+    def __init__(self, klass, percentile, target_ms, window_s=60.0):
+        if not 0 < percentile < 100:
+            raise ValueError("percentile must be in (0, 100), got %r"
+                             % (percentile,))
+        if target_ms <= 0 or window_s <= 0:
+            raise ValueError("target_ms and window_s must be positive")
+        self.klass = str(klass)
+        self.percentile = float(percentile)
+        self.target_s = float(target_ms) / 1e3
+        self.window_s = float(window_s)
+
+    @property
+    def budget_frac(self):
+        """Allowed bad fraction (the error budget): 1 - p/100."""
+        return 1.0 - self.percentile / 100.0
+
+    def key(self):
+        return "%s:p%g:%gms" % (self.klass, self.percentile,
+                                self.target_s * 1e3)
+
+    def __repr__(self):
+        return "SLOObjective(%s:p%g:%gms:%gs)" % (
+            self.klass, self.percentile, self.target_s * 1e3, self.window_s)
+
+
+DEFAULT_OBJECTIVE = ("*", 99.0, 100.0, 60.0)
+
+_FALSY = {"", "0", "false", "no", "off"}
+
+
+def parse_objectives(spec):
+    """``MXNET_SLO`` string -> list of SLOObjective (empty = disabled).
+
+    Format: comma-separated ``class:pNN:target_ms[:window_s]`` items; a
+    bare truthy value (``1``/``on``) declares the default ``*:p99:100:60``.
+    Malformed items are skipped — a typo degrades that objective, never
+    crashes the engine (same contract as ``_env_ladder``); all-malformed
+    falls back to the default objective (the variable was clearly meant to
+    enable monitoring).
+    """
+    spec = (spec or "").strip()
+    if spec.lower() in _FALSY:
+        return []
+    out = []
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        parts = item.split(":")
+        if len(parts) < 3:
+            continue
+        try:
+            klass = parts[0] or "*"
+            p = parts[1].strip().lower()
+            percentile = float(p[1:] if p.startswith("p") else p)
+            target_ms = float(parts[2])
+            window_s = float(parts[3]) if len(parts) > 3 else 60.0
+            out.append(SLOObjective(klass, percentile, target_ms, window_s))
+        except (ValueError, IndexError):
+            continue
+    if not out:
+        return [SLOObjective(*DEFAULT_OBJECTIVE)]
+    return out
+
+
+# breach evaluation throttle: the reply path must never pay a quantile
+# walk per request; one evaluation per second is plenty for paging
+_CHECK_INTERVAL_S = 1.0
+
+
+class SLOMonitor:
+    """Per-class windowed latency estimators + per-objective accounting.
+
+    Thread-safe (one internal lock; the Engine reply path and the ops
+    server's status reads both come through here).  ``on_breach(objective,
+    value_s)`` fires once per ok→breach edge (debounced per objective) —
+    the flight-recorder dump hook.
+    """
+
+    def __init__(self, objectives, default_window_s=60.0):
+        self.objectives = list(objectives)
+        self._mu = threading.Lock()
+        self._default_window_s = float(default_window_s)
+        # (klass, window_s) -> WindowedQuantile; one estimator serves every
+        # objective sharing that class+window, plus a default-window
+        # estimator per observed class for the status "classes" block
+        self._est = {}
+        for o in self.objectives:
+            self._est.setdefault((o.klass, o.window_s),
+                                 WindowedQuantile(o.window_s))
+        # windowed drop counters alongside each objective estimator: a
+        # total outage produces no latency samples, so breach/burn must
+        # have their own in-window drop signal (drops enter evaluation as
+        # infinite latencies)
+        self._drops = {key: _WindowCounter(key[1]) for key in self._est}
+        # objective key -> [good, bad] cumulative (drops count as bad)
+        self._counts = {o.key(): [0, 0] for o in self.objectives}
+        self._breached = {o.key(): False for o in self.objectives}
+        self._breaches = {o.key(): 0 for o in self.objectives}
+        self._last_check = 0.0
+        self.on_breach = None
+
+    # -- feed ----------------------------------------------------------------
+    def _matches(self, obj_klass, klass):
+        return obj_klass == "*" or obj_klass == klass
+
+    def record(self, latency_s, klass=None, now=None):
+        """One completed request."""
+        if latency_s is None:
+            return
+        klass = klass or "default"
+        now = time.monotonic() if now is None else now
+        with self._mu:
+            key = (klass, self._default_window_s)
+            est = self._est.get(key)
+            if est is None:
+                # class names are caller-controlled: bound the estimator
+                # map like the direct-dispatch LRU bounds its signatures —
+                # overflow classes lump into "other" instead of growing
+                # memory without limit
+                if len(self._est) >= 128:
+                    key = ("other", self._default_window_s)
+                    est = self._est.get(key)
+                if est is None:
+                    est = self._est[key] = WindowedQuantile(
+                        self._default_window_s)
+            est.observe(latency_s, now)
+            for (k, w), e in self._est.items():
+                if e is not est and self._matches(k, klass):
+                    e.observe(latency_s, now)
+            for o in self.objectives:
+                if self._matches(o.klass, klass):
+                    good = latency_s <= o.target_s
+                    self._counts[o.key()][0 if good else 1] += 1
+            fired = self._maybe_check(now)
+        self._fire(fired)
+
+    def record_drop(self, klass=None, now=None):
+        """One request that never completed (shed/timeout/error): an SLO
+        violation for every matching objective.  No latency sample enters
+        the per-class quantile blocks (those stay over completed
+        requests), but the drop DOES enter each matching objective's
+        window as an infinite latency — a total outage with zero
+        completions must still breach and burn."""
+        klass = klass or "default"
+        now = time.monotonic() if now is None else now
+        with self._mu:
+            for o in self.objectives:
+                if self._matches(o.klass, klass):
+                    self._counts[o.key()][1] += 1
+                    self._drops[(o.klass, o.window_s)].inc(now)
+            fired = self._maybe_check(now)
+        self._fire(fired)
+
+    def _fire(self, fired):
+        """Invoke breach callbacks OUTSIDE the monitor lock: the hook does
+        real work (telemetry, a flight-recorder dump) and must not stall
+        every concurrent record/status call behind it."""
+        if not fired:
+            return
+        cb = self.on_breach
+        if cb is None:
+            return
+        for o, value in fired:
+            try:
+                cb(o, value)
+            except Exception:
+                pass  # a broken hook must never fail the reply path
+
+    # -- evaluation ----------------------------------------------------------
+    def _window_counts(self, klass, window_s, now):
+        """Count vector for one objective's scope (lock held).  Each
+        objective owns an estimator keyed (class, window) that ``record``
+        feeds through ``_matches`` — the ``"*"`` estimator already sees
+        every class, so no cross-estimator merge (and no double count) is
+        needed here."""
+        est = self._est.get((klass, window_s))
+        return est._merged(now) if est is not None else [0] * (NBUCKETS + 2)
+
+    def _evaluate(self, o, now):
+        """→ (value_s|None, met|None, window_n, window_drops,
+        window_good_frac) — lock held.  Drops evaluate as latencies above
+        any target: when the objective's rank lands in the drop mass the
+        reported value clamps to MAX_LATENCY_S and the objective is
+        breached, so an outage with zero completions still pages."""
+        counts = self._window_counts(o.klass, o.window_s, now)
+        n = sum(counts)
+        drops = self._drops[(o.klass, o.window_s)].count(now)
+        total = n + drops
+        if total == 0:
+            return None, None, 0, 0, None
+        rank = max(1, int(math.ceil(o.percentile / 100.0 * total)))
+        if rank > n:  # the percentile falls among the never-completed
+            value = MAX_LATENCY_S
+        else:
+            value = value_at_rank(counts, rank)
+        gf = good_fraction(counts, o.target_s)
+        overall_good = (gf or 0.0) * n / total
+        return value, value <= o.target_s, n, drops, overall_good
+
+    def _maybe_check(self, now):
+        """Breach-edge detection, throttled (lock held) → the list of
+        (objective, value) edges for the caller to fire outside the
+        lock."""
+        if now - self._last_check < _CHECK_INTERVAL_S:
+            return ()
+        self._last_check = now
+        fired = []
+        for o in self.objectives:
+            value, met, n, drops, _ = self._evaluate(o, now)
+            if met is None:
+                continue
+            key = o.key()
+            if not met and not self._breached[key]:
+                self._breached[key] = True
+                self._breaches[key] += 1
+                fired.append((o, value))
+            elif met:
+                self._breached[key] = False
+        return fired
+
+    # -- surfaces ------------------------------------------------------------
+    def status(self, now=None):
+        """The ``Engine.stats()["slo"]`` / ``/statusz`` block.  Status
+        reads also run the (throttled) breach-edge check: an outage whose
+        drops all land inside one throttle window and then go quiet would
+        otherwise never fire — the scrape becomes the heartbeat that
+        advances detection when traffic has stopped."""
+        now = time.monotonic() if now is None else now
+        with self._mu:
+            fired = self._maybe_check(now)
+            objectives = []
+            for o in self.objectives:
+                value, met, n, drops, win_good = self._evaluate(o, now)
+                good, bad = self._counts[o.key()]
+                total = good + bad
+                objectives.append({
+                    "class": o.klass,
+                    "percentile": o.percentile,
+                    "target_ms": round(o.target_s * 1e3, 3),
+                    "window_s": o.window_s,
+                    # clamps to 120000.0 (MAX_LATENCY_S) when the rank
+                    # lands among in-window drops — read as "≥"
+                    "value_ms": (round(value * 1e3, 3)
+                                 if value is not None else None),
+                    "met": met,
+                    "window_n": n,
+                    "window_drops": drops,
+                    "budget_frac": round(o.budget_frac, 6),
+                    # burn rate: window bad-fraction (slow completions AND
+                    # drops) over the allowed bad-fraction; 1.0 = spending
+                    # budget exactly as fast as the objective affords,
+                    # >1 = on the way to a breach
+                    "burn_rate": (round((1.0 - win_good) / o.budget_frac, 3)
+                                  if win_good is not None else None),
+                    "good": good, "bad": bad,
+                    "goodput": round(good / total, 6) if total else None,
+                    "breaches": self._breaches[o.key()],
+                })
+            classes = {}
+            for (k, w), e in self._est.items():
+                if w != self._default_window_s or k == "*":
+                    continue
+                counts = e._merged(now)
+                n = sum(counts)
+                if not n:
+                    continue
+                classes[k] = {
+                    "n": n,
+                    "p50_ms": round(
+                        quantile_of_counts(counts, 0.50) * 1e3, 3),
+                    "p95_ms": round(
+                        quantile_of_counts(counts, 0.95) * 1e3, 3),
+                    "p99_ms": round(
+                        quantile_of_counts(counts, 0.99) * 1e3, 3),
+                }
+            block = {"objectives": objectives, "classes": classes,
+                     "relative_error": round(RELATIVE_ERROR, 4)}
+        self._fire(fired)
+        return block
+
+
+def monitor_from_env():
+    """SLOMonitor from ``MXNET_SLO``, or None when unset/falsy — the
+    Engine's one-check gate (byte-identical off path, tested)."""
+    objectives = parse_objectives(os.environ.get("MXNET_SLO", ""))
+    if not objectives:
+        return None
+    return SLOMonitor(objectives)

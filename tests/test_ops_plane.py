@@ -1,0 +1,557 @@
+"""Live ops plane (ISSUE 10): streaming-quantile accuracy vs np.percentile,
+window rotation, SLO burn/goodput accounting and breach edges, the
+flight-recorder ring bound + dump-on-error + off-path no-op, ops-server
+endpoint semantics (incl. 503 on a stalled heartbeat), the loadgen
+per-class/goodput keys, and the byte-identical all-gates-off contract."""
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.serving import BucketLadder, Engine
+from mxnet_tpu.telemetry import flightrec, ops_server, slo
+from mxnet_tpu.telemetry import instrument as tin
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _mlp_engine(**kw):
+    from mxnet_tpu.test_utils import tiny_mlp_checkpoint
+
+    sym, params = tiny_mlp_checkpoint()
+    kw.setdefault("ladder", BucketLadder((1, 2, 4)))
+    kw.setdefault("max_wait_ms", 2.0)
+    kw.setdefault("max_queue", 64)
+    kw.setdefault("name", "opsplane")
+    return Engine(sym, params, {"data": (8,)}, **kw)
+
+
+@pytest.fixture
+def ops_off(monkeypatch):
+    """All three ISSUE 10 gates unset (the byte-identical off path)."""
+    for var in ("MXNET_OPS_PORT", "MXNET_SLO", "MXNET_FLIGHTREC_DIR"):
+        monkeypatch.delenv(var, raising=False)
+    flightrec._reset_for_tests()
+    yield
+    flightrec._reset_for_tests()
+
+
+@pytest.fixture
+def ops_on(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXNET_OPS_PORT", "0")
+    monkeypatch.setenv("MXNET_SLO", "*:p99:500:600")
+    monkeypatch.setenv("MXNET_FLIGHTREC_DIR", str(tmp_path / "frec"))
+    monkeypatch.setenv("MXNET_OPS_STALE_S", "1.0")
+    flightrec._reset_for_tests()
+    ops_server.stop()
+    yield tmp_path
+    ops_server.stop()
+    flightrec._reset_for_tests()
+
+
+# -- streaming quantile estimator ---------------------------------------------
+class TestWindowedQuantile:
+    def _check_accuracy(self, samples, quantiles=(0.5, 0.95, 0.99)):
+        est = slo.WindowedQuantile(window_s=3600.0)
+        for v in samples:
+            est.observe(v, now=0.0)
+        for q in quantiles:
+            truth = float(np.percentile(samples, q * 100))
+            got = est.quantile(q, now=0.0)
+            # documented bound (geometric-midpoint bucket quantization)
+            # plus a pinch for the rank-definition difference vs numpy's
+            # linear interpolation
+            tol = slo.RELATIVE_ERROR * truth + 2.0 / len(samples) * truth
+            assert abs(got - truth) <= tol, \
+                "q=%g: est %.6f vs true %.6f (tol %.6f)" % (q, got, truth,
+                                                            tol)
+
+    def test_uniform(self):
+        rng = np.random.default_rng(0)
+        self._check_accuracy(rng.uniform(0.002, 0.080, size=8000))
+
+    def test_lognormal(self):
+        rng = np.random.default_rng(1)
+        # ~2-50 ms body with a heavy tail — the serving latency shape
+        self._check_accuracy(np.exp(rng.normal(np.log(0.008), 0.6,
+                                               size=8000)))
+
+    def test_bimodal(self):
+        rng = np.random.default_rng(2)
+        # cache-hit vs compile-path mix; quantiles chosen inside the modes
+        # (an interpolating estimator is unspecified inside the gap)
+        lo = rng.uniform(0.001, 0.002, size=7000)
+        hi = rng.uniform(0.100, 0.120, size=3000)
+        samples = np.concatenate([lo, hi])
+        rng.shuffle(samples)
+        self._check_accuracy(samples, quantiles=(0.5, 0.99))
+
+    def test_out_of_range_clamps(self):
+        est = slo.WindowedQuantile(window_s=60.0)
+        est.observe(1e-9, now=0.0)
+        assert est.quantile(0.5, now=0.0) == slo.MIN_LATENCY_S
+        est2 = slo.WindowedQuantile(window_s=60.0)
+        est2.observe(1e6, now=0.0)
+        assert est2.quantile(0.5, now=0.0) == slo.MAX_LATENCY_S
+
+    def test_window_rotation(self):
+        est = slo.WindowedQuantile(window_s=12.0)  # sub-window = 2 s
+        for _ in range(100):
+            est.observe(0.001, now=0.0)
+        assert est.count(now=0.0) == 100
+        # fully past the window (+ the partial-subwindow slack): expired
+        assert est.count(now=20.0) == 0
+        assert est.quantile(0.99, now=20.0) is None
+        # old fast samples rotate out, new slow samples dominate (t=0
+        # samples live in sub-window epoch 0, dropped once the query epoch
+        # passes NSUB — at t=15 with 2 s sub-windows they are gone)
+        for _ in range(100):
+            est.observe(0.001, now=0.0)
+        for _ in range(50):
+            est.observe(0.100, now=15.0)
+        p50 = est.quantile(0.50, now=15.0)
+        assert abs(p50 - 0.100) <= slo.RELATIVE_ERROR * 0.100
+        # memory bound: never more than NSUB+1 live sub-histograms
+        for t in range(200):
+            est.observe(0.005, now=float(t))
+        assert len(est._subs) <= slo.NSUB + 1
+
+    def test_mergeable(self):
+        a, b = slo.WindowedQuantile(60.0), slo.WindowedQuantile(60.0)
+        for v in (0.002, 0.004, 0.006):
+            a.observe(v, now=0.0)
+        for v in (0.100, 0.120):
+            b.observe(v, now=0.0)
+        counts = [0] * (slo.NBUCKETS + 2)
+        a.merge_into(counts, now=0.0)
+        b.merge_into(counts, now=0.0)
+        assert sum(counts) == 5
+        p99 = slo.quantile_of_counts(counts, 0.99)
+        assert abs(p99 - 0.120) <= slo.RELATIVE_ERROR * 0.120
+
+    def test_empty(self):
+        est = slo.WindowedQuantile(60.0)
+        assert est.quantile(0.99) is None
+        assert slo.quantile_of_counts([0] * (slo.NBUCKETS + 2), 0.5) is None
+
+
+# -- objectives / parsing -----------------------------------------------------
+class TestSLOParse:
+    def test_spec(self):
+        objs = slo.parse_objectives("default:p99:50,interactive:p95:10:30")
+        assert len(objs) == 2
+        assert objs[0].klass == "default" and objs[0].percentile == 99.0
+        assert objs[0].target_s == 0.05 and objs[0].window_s == 60.0
+        assert objs[1].klass == "interactive" and objs[1].window_s == 30.0
+
+    def test_bare_truthy_is_default(self):
+        (obj,) = slo.parse_objectives("1")
+        assert (obj.klass, obj.percentile) == ("*", 99.0)
+
+    def test_falsy_disables(self):
+        assert slo.parse_objectives("") == []
+        assert slo.parse_objectives("0") == []
+        assert slo.parse_objectives("off") == []
+
+    def test_malformed_items_skipped(self):
+        objs = slo.parse_objectives("a:p99:50,garbage:entry,b:pXX:nope:1")
+        assert [o.klass for o in objs] == ["a"]
+        # all-malformed but clearly meant to enable: default objective
+        (obj,) = slo.parse_objectives("garbage:entry:")
+        assert obj.klass == "*"
+
+    def test_monitor_from_env(self, monkeypatch):
+        monkeypatch.delenv("MXNET_SLO", raising=False)
+        assert slo.monitor_from_env() is None
+        monkeypatch.setenv("MXNET_SLO", "0")
+        assert slo.monitor_from_env() is None
+        monkeypatch.setenv("MXNET_SLO", "default:p99:50")
+        assert slo.monitor_from_env() is not None
+
+
+# -- monitor accounting -------------------------------------------------------
+class TestSLOMonitor:
+    def test_burn_and_goodput(self):
+        mon = slo.SLOMonitor([slo.SLOObjective("*", 90.0, 10.0, 60.0)])
+        for _ in range(80):
+            mon.record(0.005, "a", now=1.0)
+        for _ in range(20):
+            mon.record(0.050, "a", now=1.0)
+        (obj,) = mon.status(now=1.0)["objectives"]
+        assert obj["good"] == 80 and obj["bad"] == 20
+        assert obj["goodput"] == pytest.approx(0.8)
+        assert obj["budget_frac"] == pytest.approx(0.1)
+        # window bad fraction 0.2 over a 0.1 budget: burning 2x
+        assert obj["burn_rate"] == pytest.approx(2.0, rel=0.05)
+        assert obj["met"] is False  # p90 ~50 ms > 10 ms target
+
+    def test_breach_edges_and_callback(self):
+        mon = slo.SLOMonitor([slo.SLOObjective("*", 50.0, 10.0, 6.0)])
+        fired = []
+        mon.on_breach = lambda o, v: fired.append((o.key(), v))
+        for i in range(50):
+            mon.record(0.050, now=0.0 + i * 0.001)
+        mon.record(0.050, now=2.0)  # past the check throttle: evaluates
+        (obj,) = mon.status(now=2.0)["objectives"]
+        assert obj["breaches"] == 1 and len(fired) == 1
+        # stays breached: no second edge
+        mon.record(0.050, now=4.0)
+        assert mon.status(now=4.0)["objectives"][0]["breaches"] == 1
+        # recovery (old samples rotate out), then a new breach is an edge
+        for i in range(200):
+            mon.record(0.001, now=20.0 + i * 0.01)
+        assert mon.status(now=23.0)["objectives"][0]["met"] is True
+        for i in range(400):
+            mon.record(0.050, now=40.0 + i * 0.01)
+        assert mon.status(now=45.0)["objectives"][0]["breaches"] == 2
+
+    def test_drops_evaluate_as_infinite_latencies(self):
+        mon = slo.SLOMonitor([slo.SLOObjective("*", 99.0, 10.0, 60.0)])
+        mon.record(0.001, now=0.0)
+        for _ in range(9):
+            mon.record_drop(now=0.0)
+        (obj,) = mon.status(now=0.0)["objectives"]
+        assert obj["good"] == 1 and obj["bad"] == 9
+        assert obj["window_n"] == 1 and obj["window_drops"] == 9
+        # p99's rank lands among the drops: value clamps to the range top
+        # and the objective is breached; burn reflects the 90% bad window
+        assert obj["value_ms"] == slo.MAX_LATENCY_S * 1e3
+        assert obj["met"] is False
+        assert obj["burn_rate"] == pytest.approx(90.0, rel=0.01)
+        # the per-class quantile block stays over completed requests only
+        assert mon.status(now=0.0)["classes"]["default"]["n"] == 1
+
+    def test_outage_with_zero_completions_breaches(self):
+        mon = slo.SLOMonitor([slo.SLOObjective("*", 99.0, 10.0, 6.0)])
+        fired = []
+        mon.on_breach = lambda o, v: fired.append(v)
+        for i in range(20):
+            mon.record_drop(now=5.0 + i * 0.001)
+        mon.record_drop(now=7.0)  # past the check throttle
+        (obj,) = mon.status(now=7.0)["objectives"]
+        assert obj["window_n"] == 0 and obj["window_drops"] == 21
+        assert obj["met"] is False and obj["breaches"] == 1
+        assert fired == [slo.MAX_LATENCY_S]
+
+    def test_class_scoping(self):
+        mon = slo.SLOMonitor([slo.SLOObjective("a", 50.0, 10.0, 60.0)])
+        mon.record(0.050, "b", now=0.0)
+        (obj,) = mon.status(now=0.0)["objectives"]
+        assert obj["window_n"] == 0 and obj["good"] + obj["bad"] == 0
+        mon.record(0.050, "a", now=0.0)
+        (obj,) = mon.status(now=0.0)["objectives"]
+        assert obj["window_n"] == 1 and obj["bad"] == 1
+        assert set(mon.status(now=0.0)["classes"]) == {"a", "b"}
+
+
+# -- flight recorder ----------------------------------------------------------
+class TestFlightRecorder:
+    def test_ring_bound_and_dump(self, tmp_path):
+        rec = flightrec.FlightRecorder(str(tmp_path), cap=16,
+                                       min_auto_dump_s=0.0)
+        for i in range(100):
+            rec.record("step", dur_s=0.001, step=i)
+        assert len(rec) == 16
+        path = rec.dump("unit", extra_field="x")
+        assert path and os.path.exists(path)
+        data = json.loads(open(path).read())
+        evs = [e for e in data["traceEvents"] if e.get("cat") == "flightrec"]
+        assert len(evs) == 16
+        # oldest evicted: the surviving events are the LAST 16
+        assert [e["args"]["step"] for e in evs] == list(range(84, 100))
+        assert data["flightrec"]["reason"] == "unit"
+        assert data["flightrec"]["extra_field"] == "x"
+        # span record shape: X events with the shared us timebase
+        assert all(e["ph"] == "X" and "dur" in e for e in evs)
+        assert any(e.get("name") == "clock_sync"
+                   for e in data["traceEvents"])
+
+    def test_auto_dump_throttle(self, tmp_path):
+        rec = flightrec.FlightRecorder(str(tmp_path), min_auto_dump_s=3600)
+        rec.record("x")
+        assert rec.dump("err", auto=True) is not None
+        assert rec.dump("err", auto=True) is None   # throttled
+        assert rec.dump("explicit") is not None     # explicit always writes
+
+    def test_empty_ring_no_dump(self, tmp_path):
+        rec = flightrec.FlightRecorder(str(tmp_path))
+        assert rec.dump("nothing") is None
+
+    def test_off_path_noop(self, ops_off):
+        assert flightrec.recorder() is None
+        assert flightrec.dump("x") is None
+        flightrec.record("x")  # no-op, no error
+
+    def test_dump_on_batch_error(self, ops_on, monkeypatch):
+        d = str(ops_on / "frec")
+        eng = _mlp_engine()
+        try:
+            # warm first: a cold-compile first request can breach the
+            # fixture's 500 ms objective, and this test wants exactly one
+            # batch_error dump in the directory
+            eng.warmup()
+            eng.predict({"data": np.zeros((1, 8), np.float32)})
+
+            def boom(bucket):
+                raise RuntimeError("seeded model failure")
+
+            monkeypatch.setattr(eng, "_predictor_for", boom)
+            with pytest.raises(RuntimeError):
+                eng.predict({"data": np.zeros((1, 8), np.float32)},
+                            timeout=10.0)
+            # the client unblocks at set_error; the loop writes the dump
+            # just after — poll briefly
+            deadline = time.monotonic() + 5.0
+            dumps = []
+            while time.monotonic() < deadline and not dumps:
+                dumps = [f for f in os.listdir(d)
+                         if f.startswith("flightrec-")
+                         and "batch_error" in f] if os.path.isdir(d) else []
+                if not dumps:
+                    time.sleep(0.05)
+            assert len(dumps) == 1
+            data = json.loads(open(os.path.join(d, dumps[0])).read())
+            names = [e["name"] for e in data["traceEvents"]]
+            assert "batch_error" in names and "serve" in names \
+                and "submit" in names
+            assert data["flightrec"]["reason"] == "batch_error"
+        finally:
+            eng.close()
+
+
+# -- ops server ---------------------------------------------------------------
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d%s" % (port, path), timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+class TestOpsServer:
+    def test_endpoints(self, ops_on):
+        eng = _mlp_engine()
+        try:
+            port = ops_server.port()
+            assert port and ops_server.active()
+            eng.warmup()
+            for _ in range(5):
+                eng.predict({"data": np.zeros((2, 8), np.float32)})
+            code, body = _get(port, "/healthz")
+            assert code == 200 and json.loads(body)["ok"] is True
+            code, body = _get(port, "/statusz")
+            assert code == 200
+            st = json.loads(body)["engines"]["opsplane"]
+            assert st["completed"] == 5 and st["warmup"] is not None
+            assert st["slo"]["objectives"][0]["window_n"] == 5
+            code, body = _get(port, "/metrics")
+            assert code == 200  # telemetry off: renders (possibly empty)
+            code, _ = _get(port, "/nope")
+            assert code == 404
+        finally:
+            eng.close()
+
+    def test_healthz_flips_on_stalled_heartbeat(self, ops_on):
+        eng = _mlp_engine()
+        try:
+            port = ops_server.port()
+            eng.predict({"data": np.zeros((1, 8), np.float32)})
+            assert _get(port, "/healthz")[0] == 200
+            eng._device_mu.acquire()
+            try:
+                frozen = eng.submit({"data": np.zeros((1, 8), np.float32)})
+                deadline = time.monotonic() + 10.0
+                code = 200
+                while time.monotonic() < deadline and code != 503:
+                    time.sleep(0.2)
+                    code, _ = _get(port, "/healthz")
+                assert code == 503
+            finally:
+                eng._device_mu.release()
+            frozen.result(timeout=30)
+            deadline = time.monotonic() + 10.0
+            code = 503
+            while time.monotonic() < deadline and code != 200:
+                time.sleep(0.2)
+                code, _ = _get(port, "/healthz")
+            assert code == 200
+        finally:
+            eng.close()
+
+    def test_unregister_on_close(self, ops_on):
+        eng = _mlp_engine()
+        port = ops_server.port()
+        eng.close()
+        # a closed engine is off the health page — never a permanent 503
+        code, body = _get(port, "/healthz")
+        assert code == 200 and json.loads(body)["engines"] == []
+
+    def test_engine_health_readiness(self, ops_on):
+        eng = _mlp_engine(start=False)
+        try:
+            h = ops_server.engine_health(eng)
+            assert h["ok"] is False and h["loop_alive"] is False
+            eng.start()
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline \
+                    and not ops_server.engine_health(eng)["ok"]:
+                time.sleep(0.05)
+            assert ops_server.engine_health(eng)["ok"] is True
+        finally:
+            eng.close()
+
+    def test_malformed_port_disabled(self, monkeypatch):
+        monkeypatch.setenv("MXNET_OPS_PORT", "not-a-port")
+        assert ops_server.configured_port() is None
+        assert ops_server.maybe_start() is None
+
+
+# -- engine off-path contract -------------------------------------------------
+class TestOffPath:
+    def test_all_gates_off_engine_is_noop(self, ops_off):
+        eng = _mlp_engine()
+        try:
+            assert eng._slo is None and eng._flightrec is None
+            assert not ops_server.active()
+            out = eng.predict({"data": np.ones((2, 8), np.float32)})
+            assert out[0].shape[0] == 2
+            st = eng.stats()
+            assert st["slo"] is None
+            # the heartbeat is engine-owned liveness state (like _stats),
+            # maintained regardless of gates — /healthz just reads it
+            assert st["heartbeat_age_s"] is not None
+        finally:
+            eng.close()
+
+    def test_fit_loop_off_path(self, ops_off, monkeypatch):
+        # flightrec off in fit: recorder() None and no ring anywhere
+        import mxnet_tpu as mx
+
+        x = np.random.rand(16, 8).astype(np.float32)
+        y = np.random.randint(0, 4, (16,)).astype(np.float32)
+        it = mx.io.NDArrayIter(x, y, batch_size=8)
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        mod = mx.mod.Module(net, label_names=("softmax_label",))
+        mod.fit(it, num_epoch=1, batch_end_callback=None)
+        assert flightrec._recorder is None
+
+    def test_fit_loop_records_steps(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("MXNET_FLIGHTREC_DIR", str(tmp_path))
+        monkeypatch.delenv("MXNET_OPS_PORT", raising=False)
+        flightrec._reset_for_tests()
+        import mxnet_tpu as mx
+
+        x = np.random.rand(16, 8).astype(np.float32)
+        y = np.random.randint(0, 4, (16,)).astype(np.float32)
+        it = mx.io.NDArrayIter(x, y, batch_size=8)
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        mod = mx.mod.Module(net, label_names=("softmax_label",))
+        mod.fit(it, num_epoch=1)
+        rec = flightrec.recorder()
+        assert rec is not None and len(rec) == 2  # 2 batches = 2 steps
+        path = rec.dump("test")
+        evs = json.loads(open(path).read())["traceEvents"]
+        steps = [e for e in evs if e["name"] == "step"]
+        assert [e["args"]["step"] for e in steps] == [0, 1]
+        flightrec._reset_for_tests()
+
+
+# -- telemetry summary / loadgen surfaces -------------------------------------
+class TestSummaryServeKeys:
+    def test_null_without_serving(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("MXNET_TELEMETRY", "1")
+        monkeypatch.setenv("MXNET_TELEMETRY_FILE", str(tmp_path / "t.jsonl"))
+        tin._reset_for_tests()
+        try:
+            s = tin.summary()
+            assert s["serve_p50_ms"] is None and s["serve_p99_ms"] is None
+        finally:
+            tin._reset_for_tests()
+
+    def test_populated_by_serving(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("MXNET_TELEMETRY", "1")
+        monkeypatch.setenv("MXNET_TELEMETRY_FILE", str(tmp_path / "t.jsonl"))
+        for var in ("MXNET_OPS_PORT", "MXNET_SLO", "MXNET_FLIGHTREC_DIR"):
+            monkeypatch.delenv(var, raising=False)
+        tin._reset_for_tests()
+        try:
+            eng = _mlp_engine()
+            try:
+                for _ in range(10):
+                    eng.predict({"data": np.zeros((1, 8), np.float32)})
+            finally:
+                eng.close()
+            s = tin.summary()
+            assert s["serve_p50_ms"] is not None
+            assert s["serve_p99_ms"] >= s["serve_p50_ms"] > 0
+        finally:
+            tin._reset_for_tests()
+
+    def test_hist_quantile(self):
+        from mxnet_tpu.telemetry import Registry
+
+        r = Registry()
+        h = r.histogram("lat", "", ("k",), buckets=(0.01, 0.1, 1.0))
+        assert r.hist_quantile("lat", 0.5) is None
+        for _ in range(90):
+            h.observe(0.005, k="a")
+        for _ in range(10):
+            h.observe(0.5, k="b")   # merged across label sets
+        assert r.hist_quantile("lat", 0.5) <= 0.01
+        assert 0.1 <= r.hist_quantile("lat", 0.99) <= 1.0
+        assert r.hist_quantile("absent", 0.5, default=-1) == -1
+
+
+class TestLoadgenSurface:
+    def _loadgen(self):
+        from mxnet_tpu.test_utils import load_module_by_path
+
+        return load_module_by_path(os.path.join(REPO, "tools", "loadgen.py"))
+
+    def test_per_class_and_goodput(self, ops_off):
+        import argparse
+
+        loadgen = self._loadgen()
+        eng = _mlp_engine(name="loadgen")
+        try:
+            eng.warmup()
+            args = argparse.Namespace(duration=0.4, concurrency=2,
+                                      sizes=(1, 2), timeout_s=10.0,
+                                      rate=0.0, seed=0, slo_ms=0.001)
+            line = loadgen.run(eng, {"data": (8,)}, args, "closed")
+        finally:
+            eng.close()
+        # schema-lints (the new keys included)
+        from mxnet_tpu.test_utils import load_module_by_path
+
+        cbs = load_module_by_path(
+            os.path.join(REPO, "ci", "check_bench_schema.py"))
+        cbs.validate_serve_line(line, "test")
+        assert set(line["latency_by_class"]) == {"1", "2"}
+        for v in line["latency_by_class"].values():
+            assert v["n"] > 0 and v["p99_ms"] >= v["p50_ms"]
+        # an impossible 0.001 ms target: nothing qualifies as goodput
+        assert line["slo_ms"] == 0.001
+        assert line["goodput_rps"] == 0.0 and line["throughput_rps"] > 0
+
+    def test_slo_class_reaches_engine(self, monkeypatch):
+        monkeypatch.setenv("MXNET_SLO", "1:p99:500:600")
+        eng = _mlp_engine(name="klass")
+        try:
+            eng.predict({"data": np.zeros((1, 8), np.float32)}, klass="1")
+            eng.predict({"data": np.zeros((2, 8), np.float32)}, klass="2")
+            st = eng.stats()["slo"]
+            assert set(st["classes"]) == {"1", "2"}
+            (obj,) = st["objectives"]
+            assert obj["class"] == "1" and obj["window_n"] == 1
+        finally:
+            eng.close()

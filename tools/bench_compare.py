@@ -85,6 +85,83 @@ def is_multichip(obj):
     return "ok" in obj and ("tail" in obj or "n_devices" in obj)
 
 
+def is_serve(obj):
+    """True when a parsed capture is a SERVE_BENCH line (tools/loadgen.py)
+    saved as JSON — bare or under ``parsed``."""
+    line = obj.get("parsed") if isinstance(obj.get("parsed"), dict) else obj
+    return "mode" in line and "latency_ms_p99" in line
+
+
+def load_serve(path, obj):
+    """→ normalized row for one SERVE_BENCH capture."""
+    line = obj.get("parsed") if isinstance(obj.get("parsed"), dict) else obj
+    for req in ("mode", "latency_ms_p99"):
+        if req not in line:
+            raise ValueError("%s: not a SERVE_BENCH capture (missing %r)"
+                             % (path, req))
+    return {"file": path, "mode": str(line["mode"]),
+            "throughput_rps": line.get("throughput_rps"),
+            "goodput_rps": line.get("goodput_rps"),
+            "latency_ms_p50": line.get("latency_ms_p50"),
+            "latency_ms_p99": float(line["latency_ms_p99"]),
+            "shed_rate": line.get("shed_rate")}
+
+
+def compare_serve(rows, threshold, gate_p99=False):
+    """→ (table_rows, regressions).  Baseline = rows[0]; only same-MODE
+    rows are compared (a closed-loop capture against an open-loop one is a
+    configuration difference, like a metric-name mismatch on the bench
+    axis).  All deltas are shown; only ``--gate-p99`` makes p99 growth
+    beyond the threshold a regression (ISSUE 10, mirroring
+    ``--gate-warmup``): latency tails are noisy across hosts, so the gate
+    is opt-in for pipelines whose runs share a machine + load shape."""
+    base = rows[0]
+    table, regressions = [], []
+    for r in rows:
+        same = r["mode"] == base["mode"]
+        dt = (_pct(r["throughput_rps"], base["throughput_rps"])
+              if same and r is not base else None)
+        d50 = (_pct(r["latency_ms_p50"], base["latency_ms_p50"])
+               if same and r is not base else None)
+        d99 = (_pct(r["latency_ms_p99"], base["latency_ms_p99"])
+               if same and r is not base else None)
+        table.append(dict(r, same_mode=same, thr_delta_pct=dt,
+                          p50_delta_pct=d50, p99_delta_pct=d99))
+        if r is base or not same:
+            continue
+        if gate_p99 and d99 is not None and d99 > threshold:
+            regressions.append(
+                "%s: latency_ms_p99 %.4g -> %.4g (+%.1f%% > %g%%, "
+                "--gate-p99)" % (r["file"], base["latency_ms_p99"],
+                                 r["latency_ms_p99"], d99, threshold))
+    return table, regressions
+
+
+def render_serve_table(table):
+    cols = ["file", "mode", "rps", "Δrps%", "goodput", "p50_ms", "Δp50%",
+            "p99_ms", "Δp99%", "shed"]
+    out = [cols]
+    for r in table:
+        mode = r["mode"] + ("" if r["same_mode"] else " (≠ baseline)")
+        out.append([r["file"], mode, _fmt(r["throughput_rps"], "%.4g"),
+                    _fmt(r["thr_delta_pct"], "%+.1f"),
+                    _fmt(r["goodput_rps"], "%.4g"),
+                    _fmt(r["latency_ms_p50"], "%.4g"),
+                    _fmt(r["p50_delta_pct"], "%+.1f"),
+                    _fmt(r["latency_ms_p99"], "%.4g"),
+                    _fmt(r["p99_delta_pct"], "%+.1f"),
+                    _fmt(r["shed_rate"], "%.3g")])
+    widths = [max(len(row[i]) for row in out) for i in range(len(cols))]
+    lines = []
+    for i, row in enumerate(out):
+        lines.append("  ".join(
+            c.ljust(widths[j]) if j < 2 else c.rjust(widths[j])
+            for j, c in enumerate(row)))
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
 def load_multichip(path, obj):
     """→ normalized row for one parsed MULTICHIP_r*.json capture."""
     if "ok" not in obj:
@@ -237,6 +314,12 @@ def main(argv=None):
                         "(off by default: cold-vs-warm captures are a "
                         "configuration difference, not a regression — "
                         "opt in when both runs share a cache setup)")
+    p.add_argument("--gate-p99", action="store_true",
+                   help="fail on SERVE_BENCH latency_ms_p99 growth beyond "
+                        "--threshold (off by default: latency tails are "
+                        "noisy across hosts — opt in when runs share a "
+                        "machine and load shape; requires SERVE_BENCH "
+                        "captures)")
     args = p.parse_args(argv)
     if len(args.files) < 2:
         p.error("need at least two files (baseline + candidates)")
@@ -247,10 +330,38 @@ def main(argv=None):
         print("bench_compare: %s" % e, file=sys.stderr)
         return 2
     kinds = [is_multichip(o) for _, o in objs]
-    if any(kinds) and not all(kinds):
-        print("bench_compare: cannot mix bench and MULTICHIP captures "
-              "in one invocation", file=sys.stderr)
+    serve_kinds = [is_serve(o) for _, o in objs]
+    if (any(kinds) and not all(kinds)) or (any(serve_kinds)
+                                           and not all(serve_kinds)):
+        print("bench_compare: cannot mix bench / MULTICHIP / SERVE_BENCH "
+              "captures in one invocation", file=sys.stderr)
         return 2
+    if args.gate_p99 and not all(serve_kinds):
+        print("bench_compare: --gate-p99 applies to SERVE_BENCH captures "
+              "(a bench line has no latency_ms_p99)", file=sys.stderr)
+        return 2
+    if all(serve_kinds):
+        try:
+            srows = [load_serve(f, o) for f, o in objs]
+        except (ValueError,) as e:
+            print("bench_compare: %s" % e, file=sys.stderr)
+            return 2
+        table, regressions = compare_serve(srows, args.threshold,
+                                           gate_p99=args.gate_p99)
+        if args.json:
+            print(json.dumps({"baseline": srows[0]["file"], "rows": table,
+                              "threshold_pct": args.threshold,
+                              "regressions": regressions}, indent=1))
+        else:
+            print(render_serve_table(table))
+            for msg in regressions:
+                print("REGRESSION %s" % msg)
+        if regressions:
+            if not args.json:
+                print("bench_compare: %d serve regression(s) beyond %.3g%%"
+                      % (len(regressions), args.threshold), file=sys.stderr)
+            return 1
+        return 0
     try:
         if all(kinds):
             rows = [load_multichip(f, o) for f, o in objs]

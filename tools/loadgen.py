@@ -84,9 +84,12 @@ class _Collector:
     shapes, class) record per submission attempt, the offline input the
     bucket-ladder tuner replays (``mxnet_tpu.autotune.ladder``)."""
 
-    def __init__(self, trace_log=None, t_origin=None):
+    def __init__(self, trace_log=None, t_origin=None, slo_ms=0.0):
         self.mu = threading.Lock()
         self.latencies = []
+        self.by_class = {}     # size class (str(n)) -> [latencies]
+        self.good = 0          # completions meeting --slo-ms (all, if 0)
+        self.slo_ms = float(slo_ms or 0.0)
         self.submitted = 0
         self.shed = 0
         self.timeouts = 0
@@ -95,9 +98,19 @@ class _Collector:
         self.trace_log = trace_log
         self.t_origin = t_origin
 
-    def ok(self, seconds):
+    def ok(self, seconds, klass=None, in_window=True):
+        """One completion.  ``klass`` buckets the per-class percentiles
+        (ISSUE 10 / ROADMAP item 1: per-class P50/P99 + goodput);
+        ``in_window`` gates goodput in the open loop (late-drain
+        completions report latency but not phantom goodput, same rule as
+        throughput)."""
         with self.mu:
             self.latencies.append(seconds)
+            if klass is not None:
+                self.by_class.setdefault(str(klass), []).append(seconds)
+            if in_window and (self.slo_ms <= 0
+                              or seconds * 1e3 <= self.slo_ms):
+                self.good += 1
 
     def count(self, field, n=1):
         with self.mu:
@@ -127,12 +140,14 @@ def _run_closed(engine, shapes, args, collector):
         rng = np.random.default_rng(seed)
         while time.monotonic() < stop:
             req_inputs = _make_request(shapes, args.sizes, rng)
+            n = next(iter(req_inputs.values())).shape[0]
             collector.count("submitted")
             collector.trace(req_inputs, "closed")
             t0 = time.perf_counter()
             try:
-                engine.predict(req_inputs, timeout=args.timeout_s)
-                collector.ok(time.perf_counter() - t0)
+                engine.predict(req_inputs, timeout=args.timeout_s,
+                               klass=str(n))
+                collector.ok(time.perf_counter() - t0, klass=n)
             except ServerBusy:
                 collector.count("shed")
             except RequestTimeout:
@@ -169,9 +184,11 @@ def _run_open(engine, shapes, args, collector):
         next_fire += jitter.expovariate(args.rate)
         collector.count("submitted")
         req_inputs = _make_request(shapes, args.sizes, rng)
+        n = next(iter(req_inputs.values())).shape[0]
         collector.trace(req_inputs, "open")
         try:
-            pending.append(engine.submit(req_inputs, timeout=args.timeout_s))
+            pending.append((engine.submit(req_inputs, timeout=args.timeout_s,
+                                          klass=str(n)), n))
         except ServerBusy:
             collector.count("shed")
     # throughput window CLOSES here: the post-window drain below must not
@@ -180,12 +197,13 @@ def _run_open(engine, shapes, args, collector):
     duration = time.perf_counter() - t_start
     window_end = time.monotonic()
     collector.in_window = 0
-    for req in pending:
+    for req, n in pending:
         try:
             req.result(timeout=30)
             # latency stamped at completion, not at this (late) harvest
-            collector.ok(req.latency_s)
-            if req.t_done <= window_end:
+            in_window = req.t_done <= window_end
+            collector.ok(req.latency_s, klass=n, in_window=in_window)
+            if in_window:
                 collector.in_window += 1
         except RequestTimeout:
             collector.count("timeouts")
@@ -213,7 +231,8 @@ def _first_request_latencies(engine, shapes, sizes):
 
 def run(engine, shapes, args, mode, first_request_ms=None, warmup_s=None,
         trace_log=None, t_origin=None):
-    collector = _Collector(trace_log=trace_log, t_origin=t_origin)
+    collector = _Collector(trace_log=trace_log, t_origin=t_origin,
+                           slo_ms=getattr(args, "slo_ms", 0.0))
     compiles_before = engine.stats()["compiles"]
     runner = _run_closed if mode == "closed" else _run_open
     duration = runner(engine, shapes, args, collector)
@@ -223,6 +242,13 @@ def run(engine, shapes, args, mode, first_request_ms=None, warmup_s=None,
     # (late drain completions report their latency but not phantom rate)
     thr_n = (collector.in_window if collector.in_window is not None
              else completed)
+    # per-class percentiles (ROADMAP item 1, ISSUE 10): class = request
+    # sample count — the size-mix axis the bucket ladder serves
+    by_class = {
+        k: {"p50_ms": round(float(np.percentile(v, 50)) * 1e3, 3),
+            "p99_ms": round(float(np.percentile(v, 99)) * 1e3, 3),
+            "n": len(v)}
+        for k, v in sorted(collector.by_class.items()) if v}
     stats = engine.stats()
     line = {
         "mode": mode,
@@ -248,6 +274,15 @@ def run(engine, shapes, args, mode, first_request_ms=None, warmup_s=None,
         # every mode's line so each SERVE_BENCH stays self-contained
         "first_request_ms": first_request_ms,
         "warmup_s": warmup_s,
+        # ops-plane surface (ISSUE 10): per-size-class percentiles plus
+        # goodput — completions per second that met --slo-ms (all
+        # completions when no target is set, making goodput == useful
+        # throughput; under overload the gap vs throughput_rps is the
+        # work the server did too late to matter)
+        "latency_by_class": by_class or None,
+        "goodput_rps": round(collector.good / duration, 2)
+        if duration else 0.0,
+        "slo_ms": collector.slo_ms if collector.slo_ms > 0 else None,
     }
     line = {k: v for k, v in line.items() if v is not None}
     print("SERVE_BENCH " + json.dumps(line))
@@ -271,6 +306,10 @@ def main(argv=None):
     p.add_argument("--max-wait-ms", type=float, default=2.0)
     p.add_argument("--max-queue", type=int, default=512)
     p.add_argument("--timeout-s", type=float, default=10.0)
+    p.add_argument("--slo-ms", type=float, default=0.0,
+                   help="latency target for goodput accounting: "
+                        "completions slower than this don't count toward "
+                        "goodput_rps (0 = every completion counts)")
     p.add_argument("--symbol", help="*-symbol.json (default: built-in MLP)")
     p.add_argument("--params", help="*.params")
     p.add_argument("--input", action="append", default=[],
