@@ -222,51 +222,22 @@ def main_predictor():
     ``compile_s`` (the first forward's trace+compile, via note_compile);
     run with MXNET_GRAPH_PASSES=0 to measure the unoptimized plan the
     passes replace (docs/PERF_NOTES.md "Graph passes")."""
-    import mxnet_tpu as mx
     from mxnet_tpu.predictor import Predictor
+    from mxnet_tpu.test_utils import deploy_twin_checkpoint
 
     batch = int(os.environ.get("MXNET_BENCH_BATCH", 16))
     iters = int(os.environ.get("MXNET_BENCH_ITERS", 200))
     image = 32
 
-    data = mx.sym.var("data")
-    h = data
-    for i, nf in enumerate((16, 32)):
-        h = mx.sym.Convolution(h, name="conv%d" % i, kernel=(3, 3),
-                               num_filter=nf, pad=(1, 1))
-        h = mx.sym.BatchNorm(h, name="bn%d" % i, fix_gamma=False)
-        h = mx.sym.Activation(h, name="act%d" % i, act_type="relu")
-        h = mx.sym.Pooling(h, name="pool%d" % i, kernel=(2, 2),
-                           stride=(2, 2), pool_type="max")
-
-    def pooled_features(trunk):
-        # per-head feature derivation (auto-named: each call captures a
-        # fresh chain — exactly the duplication CSE exists to merge)
-        p = mx.sym.Pooling(trunk, kernel=(1, 1), global_pool=True,
-                           pool_type="avg")
-        return mx.sym.L2Normalization(mx.sym.Flatten(p))
-
-    emb = pooled_features(h)  # embedding head (served for similarity)
-    cls = mx.sym.Dropout(pooled_features(h), p=0.5)
-    prob = mx.sym.softmax(
-        mx.sym.FullyConnected(cls, name="fc2", num_hidden=10), name="prob")
-    sym = mx.sym.Group([prob, emb])
-
+    # the two-head deploy graph lives in test_utils so the numerics CI
+    # (ci/check_numerics.py, ISSUE 11) gates the exact topology benched here
+    sym, params, input_shapes = deploy_twin_checkpoint(batch=batch,
+                                                       image=image)
     rng = np.random.RandomState(0)
-    arg_shapes, _, aux_shapes = sym.infer_shape(data=(batch, 3, image, image))
-    params = {}
-    for n, s in zip(sym.list_arguments(), arg_shapes):
-        if n != "data":
-            params["arg:" + n] = mx.nd.array(
-                rng.randn(*s).astype(np.float32) * 0.05)
-    for n, s in zip(sym.list_auxiliary_states(), aux_shapes):
-        params["aux:" + n] = mx.nd.array(
-            np.ones(s, np.float32) if n.endswith("_var")
-            else np.zeros(s, np.float32))
 
     from mxnet_tpu import telemetry
 
-    pred = Predictor(sym, params, {"data": (batch, 3, image, image)})
+    pred = Predictor(sym, params, input_shapes)
     x = rng.rand(batch, 3, image, image).astype(np.float32)
     t0 = time.perf_counter()
     pred.forward(data=x)

@@ -27,9 +27,13 @@ TEL_REQ_KEYS = {"compile_s", "peak_hbm_bytes", "data_wait_frac"}
 # p50/p99 from the serve_latency_seconds histogram — null when no serving
 # ran) are optional: captures predating that work carry only the three
 # original keys
+# analysis_findings (ISSUE 11): graph-IR analyzer diagnostics the manager
+# recorded this process — null when nothing was recorded (no
+# check()/warmup analysis ran, or everything analyzed was clean)
 TEL_OPT_KEYS = {"dispatches_per_step", "warmup_s",
                 "graph_nodes_pre", "graph_nodes_post", "pass_time_s",
-                "autotune_trials", "serve_p50_ms", "serve_p99_ms"}
+                "autotune_trials", "serve_p50_ms", "serve_p99_ms",
+                "analysis_findings"}
 TEL_KEYS = TEL_REQ_KEYS | TEL_OPT_KEYS
 
 # SERVE_BENCH line (tools/loadgen.py, ISSUE 2) — docs/SERVING.md schema

@@ -64,10 +64,22 @@ def swallow():
 
 def build_step(fn):
     return jax.jit(fn, donate_argnums=(0,))   # donated-jit-unkeyed
+
+
+@jax.jit
+def literal_hazard(x):
+    return x + 1e-5             # mixed-dtype-literal (1 + 1e-5 == 1 in bf16)
+
+
+@jax.jit
+def downcast_hazard(x):
+    import jax.numpy as jnp
+    return x.astype(jnp.bfloat16)  # implicit-downcast
 '''
 
 EXPECT = ("np-in-traced", "scalar-coerce-in-traced", "branch-on-traced-param",
-          "time-in-traced", "bare-except", "donated-jit-unkeyed")
+          "time-in-traced", "bare-except", "donated-jit-unkeyed",
+          "mixed-dtype-literal", "implicit-downcast")
 
 
 def run(*args):

@@ -73,6 +73,12 @@ case "$tier" in
     # the committed baseline, and a file of seeded hazards must trip every
     # rule (new findings = nonzero exit; docs/ANALYSIS.md)
     ./dev.sh python ci/check_lint.py
+    # numerics smoke (ISSUE 11): seeded precision hazards (bf16-accumulated
+    # reduction, mixed-dtype binop, softmax fed an unbounded bf16 range,
+    # non-bf16-exact float literal) must ALL trip, and the deploy-twin
+    # predictor's cast plan must match the acceptance shape (majority
+    # bf16_safe, reductions fp32_accum, unbounded exp/log fp32_only)
+    ./dev.sh python ci/check_numerics.py
     # lock-discipline smoke (ISSUE 8): concurrent serving burst under
     # MXNET_LOCKCHECK=1 must record zero violations on the real engine,
     # and the seeded inversion/unguarded-mutation must both be detected

@@ -10,7 +10,11 @@ repo has previously found only by stress-bisection:
    pipeline must preserve*: distinct PRNG streams per stochastic node, no
    live stochastic node in an eval plan, no shape/dtype drift between the
    captured and the optimized plan (via ``jax.eval_shape`` — abstract, no
-   compile), no silently dead inputs/aux.  Surfaced as
+   compile), no silently dead inputs/aux — and, since ISSUE 11, the
+   precision-flow hazards (``numerics.py``: silent downcasts, mixed-dtype
+   promotions, f64 creep, low-precision accumulation) behind the
+   ``bf16_safe | fp32_accum | fp32_only`` cast-plan verdicts ROADMAP item
+   3's bf16 pass will consume (``Executor.precision_plan()``).  Surfaced as
    ``Executor.check()`` / ``Predictor.check()`` (always available) and as
    per-bucket warning counts in serving warmup report rows (gated on
    ``MXNET_GRAPH_ANALYZERS``).
@@ -33,7 +37,7 @@ from .diagnostics import Diagnostic, ERROR, INFO, WARNING, worst_severity
 
 __all__ = ["Diagnostic", "ERROR", "WARNING", "INFO", "worst_severity",
            "enabled", "register_analyzer", "analyzer_pipeline", "analyze",
-           "GraphContext", "check_executor"]
+           "GraphContext", "check_executor", "precision_plan_executor"]
 
 _ANALYZERS = []  # [(name, version, fn)] — registration order is run order
 
@@ -66,7 +70,12 @@ def analyze(ctx):
     """Run every registered analyzer over ``ctx`` -> sorted [Diagnostic]
     (most severe first).  An analyzer that raises contributes one INFO
     diagnostic instead of failing the whole check — ``check()`` must be
-    safe to call on any graph."""
+    safe to call on any graph.  Every finding (all analyzers, the degraded
+    INFO included) is counted into ``analysis_findings_total{analyzer,
+    severity}`` when telemetry is on (ISSUE 11 satellite; the off path is
+    one gate check inside ``note_analysis_finding``)."""
+    from ..telemetry import note_analysis_finding
+
     out = []
     for name, version, fn in _ANALYZERS:
         try:
@@ -78,6 +87,11 @@ def analyze(ctx):
         for d in diags:
             if d.analyzer is None:
                 d.analyzer = name
+        counts = {}
+        for d in diags:
+            counts[d.severity] = counts.get(d.severity, 0) + 1
+        for severity, n in counts.items():
+            note_analysis_finding(name, severity, n)
         out.extend(diags)
     out.sort(key=Diagnostic._sort_key)
     return out
@@ -98,10 +112,14 @@ class GraphContext:
     """
 
     __slots__ = ("graph", "raw", "is_train", "arg_names", "aux_names",
-                 "arg_avals", "aux_avals")
+                 "arg_avals", "aux_avals", "_numerics_flow")
 
     def __init__(self, graph, raw=None, is_train=False, arg_names=None,
                  aux_names=None, arg_avals=None, aux_avals=None):
+        # per-context memo for numerics._flow (rows + diags come from ONE
+        # abstract walk; analyze() and precision_plan() on the same ctx
+        # share it — the serving warmup path relies on this)
+        self._numerics_flow = None
         self.graph = graph
         self.raw = raw if raw is not None else graph
         self.is_train = bool(is_train)
@@ -109,6 +127,15 @@ class GraphContext:
         self.aux_names = list(aux_names) if aux_names is not None else None
         self.arg_avals = arg_avals
         self.aux_avals = aux_avals
+
+    @property
+    def has_avals(self):
+        """Can the abstract-walk analyzers run?  The ONE definition of
+        "bound": names plus both aval maps present — shape_dtype, the
+        numerics analyzer, and ``precision_plan`` all key off this, so the
+        ``analyzer-skipped`` contract cannot drift between them."""
+        return (self.arg_names is not None and self.arg_avals is not None
+                and self.aux_avals is not None)
 
 
 def _avals_of(dicts, names):
@@ -126,10 +153,10 @@ def _avals_of(dicts, names):
     return out
 
 
-def check_executor(exe, is_train=False):
-    """Build a :class:`GraphContext` from a bound Executor and run the
-    registered analyzers over the plan it lowers for ``is_train`` — the
-    implementation behind ``Executor.check()``/``Predictor.check()``."""
+def executor_context(exe, is_train=False):
+    """Build a :class:`GraphContext` over the plan a bound Executor lowers
+    for ``is_train`` — shared by :func:`check_executor` and
+    :func:`precision_plan_executor`."""
     from ..graph_passes import Graph
 
     plan, heads, const_env = exe._opt_plan(is_train)
@@ -138,14 +165,29 @@ def check_executor(exe, is_train=False):
     # the drift check can never fire on an identical plan, and skipping it
     # halves the abstract-walk cost of check() on the off path
     raw = None if plan is exe._plan else Graph(exe._plan, exe._head_names)
-    ctx = GraphContext(
+    return GraphContext(
         Graph(plan, heads, const_env),
         raw=raw,
         is_train=is_train,
         arg_names=exe._arg_names, aux_names=exe._aux_names,
         arg_avals=_avals_of(exe.arg_dict, exe._arg_names),
         aux_avals=_avals_of(exe.aux_dict, exe._aux_names))
-    return analyze(ctx)
+
+
+def check_executor(exe, is_train=False):
+    """Run the registered analyzers over a bound Executor's plan — the
+    implementation behind ``Executor.check()``/``Predictor.check()``."""
+    return analyze(executor_context(exe, is_train))
+
+
+def precision_plan_executor(exe, is_train=False):
+    """The :class:`numerics.CastPlan` for a bound Executor's plan — the
+    implementation behind ``Executor.precision_plan()`` /
+    ``Predictor.precision_plan()`` (ISSUE 11)."""
+    from . import numerics as _numerics
+
+    return _numerics.precision_plan(executor_context(exe, is_train))
 
 
 from . import graph_analyzers  # noqa: E402,F401  (registers the analyzers)
+from . import numerics  # noqa: E402,F401  (registers the numerics analyzer)

@@ -14,10 +14,17 @@ checks over the execution-plan IR, registered in run order:
    all narrower (silent x64 promotion inflates memory 2x and breaks TPU
    lowering), and any head whose shape/dtype DRIFTED between the captured
    plan and the pass-optimized plan — the invariant every registered pass
-   must preserve.  Skips silently when the context carries no avals.
+   must preserve.  A context without bound avals degrades to one INFO
+   (``analyzer-skipped``) so the skip is visible in ``check()`` output and
+   warmup rows, never silent (ISSUE 11).
 3. ``dead_code``     — arguments and aux states no surviving plan node
    consumes: dead weight being staged to device every forward, usually a
    sign the graph author kept a head they meant to drop.
+4. ``numerics``      — dtype-flow + numeric-sensitivity analysis
+   (``numerics.py``): silent downcasts, mixed-dtype promotions, f64 creep
+   with the originating node named, low-precision accumulation, and the
+   per-node ``bf16_safe | fp32_accum | fp32_only`` cast-plan verdicts
+   (ISSUE 11).
 
 Analyzers never mutate the Graph and never raise through ``analyze`` — a
 failing analyzer degrades to one INFO diagnostic (manager contract).
@@ -26,16 +33,24 @@ from __future__ import annotations
 
 import zlib
 
-from ..graph_passes.ir import node_call_attrs, node_out_names
+from ..graph_passes.ir import node_attr, node_call_attrs, node_out_names
 from . import register_analyzer
-from .diagnostics import Diagnostic, ERROR, WARNING
+from .diagnostics import Diagnostic, ERROR, INFO, WARNING
 
-__all__ = ["prng_safety", "shape_dtype", "dead_code"]
+__all__ = ["prng_safety", "shape_dtype", "dead_code", "skipped_no_avals"]
 
 
-def _attr_of(node, key):
-    defaults = getattr(node.op, "defaults", {}) or {}
-    return node.attrs.get(key, defaults.get(key))
+def skipped_no_avals(analyzer):
+    """The one ``analyzer-skipped`` INFO shape (ISSUE 11 satellite): a
+    context without bound avals used to skip the abstract-walk analyzers
+    SILENTLY — now the skip is a visible diagnostic, so a warmup row (or a
+    ``check()`` caller) can tell "clean" apart from "never looked"."""
+    return Diagnostic(
+        "analyzer-skipped", INFO,
+        "%s skipped: context carries no bound avals (shapes/dtypes "
+        "unknown) — bind arrays, or build the GraphContext with "
+        "arg_avals/aux_avals, to run the abstract walk" % analyzer,
+        analyzer=analyzer)
 
 
 def _stochastic(node):
@@ -50,9 +65,9 @@ def _eval_live(node):
     opname = getattr(node.op, "name", "")
     if opname == "Dropout":
         return bool(node.attrs.get("training")) \
-            or _attr_of(node, "mode") == "always"
+            or node_attr(node, "mode") == "always"
     if opname == "LeakyReLU":
-        return _attr_of(node, "act_type") == "rrelu"
+        return node_attr(node, "act_type") == "rrelu"
     return True
 
 
@@ -95,8 +110,10 @@ def _abstract_walk(graph, ctx, record=None):
     """``jax.eval_shape`` the plan exactly as ``Executor._graph_fn`` would
     evaluate it (same attr fill-in for ``key``/``training``, same
     hidden-output trim, aux updates skipped — heads don't consume them)
-    -> [head ShapeDtypeStruct].  ``record(name, shape, dtype)`` observes
-    every node output during the abstract trace."""
+    -> [head ShapeDtypeStruct].  ``record(node, out_name, shape, dtype,
+    in_vals, in_names)`` observes every node output during the abstract
+    trace (``in_names`` are the env names feeding the node — the numerics
+    analyzer keys its interval environment on them)."""
     import jax
     import numpy as np
 
@@ -120,7 +137,7 @@ def _abstract_walk(graph, ctx, record=None):
                 if record is not None:
                     # shape/dtype of an abstract tracer are concrete
                     record(node, nm, tuple(o.shape), o.dtype,
-                           [env[n] for n in in_names])
+                           [env[n] for n in in_names], in_names)
         return [env[h] for h in heads]
 
     return jax.eval_shape(f, arg_avals, aux_avals,
@@ -132,13 +149,12 @@ def shape_dtype(ctx):
     """f64-promotion + raw-vs-optimized head drift, via jax.eval_shape."""
     import numpy as np
 
-    if not (ctx.arg_names is not None and ctx.arg_avals is not None and
-            ctx.aux_avals is not None):
-        return []
+    if not ctx.has_avals:
+        return [skipped_no_avals("shape_dtype")]
     diags = []
     f64 = np.dtype("float64")
 
-    def record(node, nm, shape, dtype, in_vals):
+    def record(node, nm, shape, dtype, in_vals, in_names):
         if dtype == f64 and not any(
                 getattr(v, "dtype", None) == f64 for v in in_vals):
             diags.append(Diagnostic(

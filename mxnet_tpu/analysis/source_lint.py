@@ -61,12 +61,32 @@ Rules
                             of the PR 6 XLA:CPU donated-restore corruption
                             (an unwired donated jit has no key carrying its
                             donation layout, so nothing can invalidate it).
+``mixed-dtype-literal``     a Python float literal combined with a traced
+                            parameter where the literal is NOT exactly
+                            representable in bfloat16 (ISSUE 11): under
+                            jax's weak typing the op computes in the
+                            array's dtype, so a bf16 twin silently rounds
+                            the constant the author wrote (``x + 1e-5`` is
+                            the identity in bf16).  Exact literals (0.5,
+                            2.0, 127.0 ...) are exempt — hoist the rest
+                            into an explicit fp32 constant or a static
+                            attr, or justify with an ignore.
+``implicit-downcast``       ``.astype(...)``/``.view(...)`` to a narrow
+                            dtype (bfloat16/float16/float8*/int8/uint8)
+                            inside traced code with no ``# mxlint:
+                            ignore[implicit-downcast]`` justification:
+                            precision is dropped mid-graph where the
+                            numerics analyzer can see it but a reviewer
+                            cannot — every deliberate narrowing must carry
+                            its reasoning (ISSUE 11; quantization op
+                            bodies are the baselined legitimate sites).
 """
 from __future__ import annotations
 
 import ast
 import os
 import re
+import struct
 
 from .diagnostics import Diagnostic, WARNING
 
@@ -74,7 +94,8 @@ __all__ = ["LintFinding", "lint_source", "lint_paths", "load_baseline",
            "split_baseline", "format_baseline_line", "RULES"]
 
 RULES = ("bare-except", "np-in-traced", "scalar-coerce-in-traced",
-         "branch-on-traced-param", "time-in-traced", "donated-jit-unkeyed")
+         "branch-on-traced-param", "time-in-traced", "donated-jit-unkeyed",
+         "mixed-dtype-literal", "implicit-downcast")
 
 # callables whose function-valued arguments get traced
 _TRACE_CONSUMERS = frozenset({
@@ -97,6 +118,42 @@ _NP_META = frozenset({"ndim", "shape", "size", "dtype", "result_type",
 
 _IGNORE_RE = re.compile(r"#\s*mxlint:\s*ignore(?:\[([a-z0-9_,\- ]+)\])?")
 _TRACED_RE = re.compile(r"#\s*mxlint:\s*traced\b")
+
+# narrow-dtype tokens the implicit-downcast rule recognizes as targets of
+# .astype()/.view() — 16 bits or fewer of float, or sub-f32 integer quant
+_NARROW_DTYPES = frozenset({
+    "bfloat16", "float16", "half", "int8", "uint8",
+    "float8_e4m3fn", "float8_e5m2", "float8_e4m3", "float8_e5m2fnuz",
+    "float8_e4m3fnuz",
+})
+
+
+def _bf16_exact(value):
+    """Is a Python float exactly representable in bfloat16?  bf16 values
+    are precisely the float32 values whose low 16 mantissa bits are zero,
+    so: exact in f32 AND truncatable without loss."""
+    try:
+        as_f32 = struct.unpack("<f", struct.pack("<f", value))[0]
+    except (OverflowError, struct.error):
+        return False
+    if as_f32 != value:
+        return False
+    bits = struct.unpack("<I", struct.pack("<f", value))[0]
+    return (bits & 0xFFFF) == 0
+
+
+def _dtype_token(arg):
+    """The dtype a ``.astype(X)``/``.view(X)`` call names, as a bare token
+    (``jnp.bfloat16`` -> ``bfloat16``, ``"float16"`` -> ``float16``), or
+    None when the argument is dynamic (a variable — not statically
+    narrow)."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value.strip().lower()
+    if isinstance(arg, ast.Attribute):
+        return arg.attr
+    if isinstance(arg, ast.Name):
+        return arg.id
+    return None
 
 
 class LintFinding(Diagnostic):
@@ -344,10 +401,46 @@ class _Linter:
                 return n.id
         return None
 
+    def _float_literal_of(self, operand):
+        """The float value of a literal BinOp operand (unary minus
+        unwrapped), or None when the operand is not a float literal."""
+        if isinstance(operand, ast.UnaryOp) \
+                and isinstance(operand.op, (ast.USub, ast.UAdd)):
+            operand = operand.operand
+        if isinstance(operand, ast.Constant) \
+                and type(operand.value) is float:
+            return operand.value
+        return None
+
+    def _check_mixed_literal(self, node, qual, params):
+        """mixed-dtype-literal: a non-bf16-exact float literal as a direct
+        BinOp operand against an expression reading a traced param —
+        checked per BinOp so nested arithmetic attributes each literal to
+        its own operation."""
+        for lit_side, other in ((node.left, node.right),
+                                (node.right, node.left)):
+            v = self._float_literal_of(lit_side)
+            if v is None or _bf16_exact(v):
+                continue
+            hit = self._refs_traced_param([other], params)
+            if hit:
+                self._emit(
+                    "mixed-dtype-literal", node,
+                    "float literal %r combines with traced parameter %r "
+                    "but is not exactly representable in bfloat16 — a "
+                    "bf16 twin silently rounds it (1 + 1e-5 IS 1 in "
+                    "bf16); hoist it into an explicit fp32 constant, a "
+                    "static attr, or justify with an ignore" % (v, hit),
+                    qual)
+                return  # one finding per BinOp is enough
+
     def _scan_expr(self, expr, qual, traced, params):
         if not traced:
             return
         for node in ast.walk(expr):
+            if isinstance(node, ast.BinOp):
+                self._check_mixed_literal(node, qual, params)
+                continue
             if not isinstance(node, ast.Call):
                 continue
             func = node.func
@@ -370,6 +463,18 @@ class _Linter:
                     "'%s.%s()' inside traced code evaluates ONCE at trace "
                     "time — the executable replays a frozen timestamp"
                     % (root, cname), qual)
+            elif isinstance(func, ast.Attribute) \
+                    and cname in ("astype", "view") and node.args:
+                token = _dtype_token(node.args[0])
+                if token in _NARROW_DTYPES:
+                    self._emit(
+                        "implicit-downcast", node,
+                        ".%s(%s) narrows precision inside traced code — "
+                        "deliberate quantization/bf16 sites must say why "
+                        "(# mxlint: ignore[implicit-downcast] with a "
+                        "reason, or a baselined justification); anything "
+                        "else belongs to the future cast pass, not inline "
+                        "code" % (cname, token), qual)
             elif isinstance(func, ast.Attribute) and cname in _SYNC_METHODS:
                 self._emit(
                     "scalar-coerce-in-traced", node,

@@ -260,6 +260,15 @@ def mesh_descriptor(mesh):
                       for i in range(mesh.devices.ndim)]}
 
 
+def _numerics_contract():
+    """``analysis.numerics.contract_fingerprint()`` — version identity of
+    the cast-plan contract (ISSUE 11), imported lazily so the cache layer
+    never pays the analysis package on processes that import neither."""
+    from .analysis import numerics
+
+    return numerics.contract_fingerprint()
+
+
 def _env_fingerprint(mesh_desc=None):
     import jax
 
@@ -276,7 +285,17 @@ def _env_fingerprint(mesh_desc=None):
           "backend": jax.default_backend(),
           "device_kind": str(devs[0].device_kind), "n_devices": len(devs),
           "mesh": mesh_desc,
-          "passes": graph_passes.pipeline_fingerprint()}
+          "passes": graph_passes.pipeline_fingerprint(),
+          # "numerics" (ISSUE 11): the cast-plan contract versions
+          # (sensitivity registry + numerics analyzer).  A given plan's
+          # CastPlan fingerprint moves only when the plan moves (already
+          # keyed via symbol + pass fingerprints) or when these versions
+          # bump — so verifying the versions here is exactly "fold the
+          # cast-plan fingerprint into the key path": once the bf16 pass
+          # rewrites plans from CastPlans, an executable built under an
+          # older numerics contract misses cleanly instead of restoring
+          # stale numerics.
+          "numerics": _numerics_contract()}
     # "autotune" (ISSUE 9): adopted winners shape traced programs (the
     # dconv block grid reads the store at trace time), so the store state
     # digest joins the verified fingerprint while the gate is on — a

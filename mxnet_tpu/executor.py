@@ -140,6 +140,21 @@ class Executor:
 
         return analysis.check_executor(self, bool(is_train))
 
+    def precision_plan(self, is_train=False):
+        """The fingerprinted cast-plan artifact (ISSUE 11) for the plan
+        this executor lowers for ``is_train`` — one ``bf16_safe |
+        fp32_accum | fp32_only`` verdict per plan node, from the numerics
+        analyzer's dtype-flow + interval + sensitivity analysis
+        (``analysis.numerics``; docs/ANALYSIS.md has the verdict table).
+        This is the exact contract the ROADMAP item 3 bf16-cast pass
+        consumes; its ``fingerprint()`` changes when and only when the
+        plan or the sensitivity/analyzer registry versions change.
+        Static (``jax.eval_shape``) — no compile, no device work; raises
+        ``ValueError`` on an executor with unbound inputs."""
+        from . import analysis
+
+        return analysis.precision_plan_executor(self, bool(is_train))
+
     def _graph_fn(self, is_train, monitor=None):
         """Pure fn (arg_vals, aux_vals, key) -> (head_vals, new_aux_vals).
 

@@ -24,7 +24,17 @@ Three node flavors appear in a plan:
 from __future__ import annotations
 
 __all__ = ["Graph", "PlanNode", "SynthOp", "capture", "node_out_names",
-           "node_call_attrs"]
+           "node_call_attrs", "node_attr", "REDUCE", "EXP_RANGE",
+           "CANCELLATION", "NEUTRAL", "SENSITIVITY_VERSION",
+           "op_sensitivity"]
+
+
+def node_attr(node, key, default=None):
+    """A plan node's attr with the op's default filled in — the ONE
+    attrs-with-defaults resolution, shared by :func:`op_sensitivity`, the
+    graph analyzers, and the numerics interval transfer functions."""
+    defaults = getattr(node.op, "defaults", {}) or {}
+    return node.attrs.get(key, defaults.get(key, default))
 
 
 def node_call_attrs(node, key, is_train):
@@ -45,6 +55,88 @@ def node_call_attrs(node, key, is_train):
     if "training" in node.op.attr_names and "training" not in attrs:
         attrs["training"] = is_train
     return attrs
+
+
+# -- numeric-sensitivity registry (ISSUE 11) ---------------------------------
+#
+# Colocated with ``node_call_attrs`` ON PURPOSE: both describe how a plan
+# node actually evaluates, and the numerics analyzer
+# (``analysis/numerics.py``) consults this table while walking plans the
+# exact way ``Executor._graph_fn`` does — keeping the table next to the one
+# evaluation contract means a new op (or a pass-synthesized SynthOp) gets
+# its sensitivity class reviewed in the same file that defines how it runs,
+# so the two can't drift apart in separate modules.
+#
+# Classes (the cast-plan verdict ladder builds on these):
+#
+#   REDUCE        accumulation over many elements (sum/mean/dot/conv/
+#                 BN-stats): bf16 inputs are fine, but the ACCUMULATOR must
+#                 stay fp32 — bf16's 8 mantissa bits lose one part in 256
+#                 per add, and a 10^4-element reduction drifts visibly.
+#   EXP_RANGE     exp/log-family range hazard: exp overflows/saturates
+#                 outside a narrow input band and log amplifies error near
+#                 0 — safe in low precision ONLY when interval analysis
+#                 bounds the input.
+#   CANCELLATION  subtraction of near-equal quantities (variance chains,
+#                 normalization stats): catastrophic cancellation — keep
+#                 fp32 regardless of input bounds.
+#   NEUTRAL       element-local, monotone, or data-movement ops: safe to
+#                 drop to bf16 whenever their inputs are.
+#
+# Bump SENSITIVITY_VERSION on ANY table/classification change: it enters
+# every cast-plan fingerprint and the AOT-cache environment fingerprint
+# (compile_cache._env_fingerprint), so executables compiled under an older
+# classification miss cleanly instead of restoring stale numerics.
+
+REDUCE = "reduce"
+EXP_RANGE = "exp_range"
+CANCELLATION = "cancellation"
+NEUTRAL = "neutral"
+
+SENSITIVITY_VERSION = 1
+
+_OP_SENSITIVITY = {
+    # accumulating reductions + matmul/conv contractions
+    "sum": REDUCE, "mean": REDUCE, "prod": REDUCE, "nansum": REDUCE,
+    "nanprod": REDUCE, "add_n": REDUCE, "norm": REDUCE,
+    "_square_sum": REDUCE, "dot": REDUCE, "batch_dot": REDUCE,
+    "FullyConnected": REDUCE, "Convolution": REDUCE, "Deconvolution": REDUCE,
+    "Correlation": REDUCE, "L2Normalization": REDUCE,
+    "softmax_cross_entropy": REDUCE, "_linalg_gemm": REDUCE,
+    "_linalg_gemm2": REDUCE, "_linalg_syrk": REDUCE,
+    "_linalg_sumlogdiag": REDUCE, "khatri_rao": REDUCE,
+    # exp/log-family range hazards
+    "exp": EXP_RANGE, "expm1": EXP_RANGE, "log": EXP_RANGE,
+    "log1p": EXP_RANGE, "log2": EXP_RANGE, "log10": EXP_RANGE,
+    "softmax": EXP_RANGE, "log_softmax": EXP_RANGE, "softmin": EXP_RANGE,
+    "SoftmaxActivation": EXP_RANGE, "SoftmaxOutput": EXP_RANGE,
+    "gamma": EXP_RANGE, "gammaln": EXP_RANGE, "sinh": EXP_RANGE,
+    "cosh": EXP_RANGE, "_power": EXP_RANGE, "broadcast_power": EXP_RANGE,
+    "_rpower_scalar": EXP_RANGE,
+    # catastrophic-cancellation chains (normalization statistics)
+    "moments": CANCELLATION, "BatchNorm": CANCELLATION,
+    "LayerNorm": CANCELLATION, "InstanceNorm": CANCELLATION,
+    "LRN": CANCELLATION,
+}
+
+
+def op_sensitivity(node):
+    """Sensitivity class of a plan node (captured Symbol node or
+    pass-synthesized :class:`PlanNode`), resolving the attr-dependent
+    cases: avg/global Pooling accumulates (max/min pooling only compares),
+    Activation dispatches on ``act_type``.  Unknown ops default NEUTRAL —
+    the cast-plan consumer treats only the listed classes specially, and a
+    wrong NEUTRAL shows up as a diagnostics gap, not a crash."""
+    opname = getattr(node.op, "name", "")
+    if opname == "Pooling":
+        pool = node_attr(node, "pool_type", "max")
+        return REDUCE if pool in ("avg", "sum", "lp") else NEUTRAL
+    if opname == "Activation":
+        act = node.attrs.get("act_type")
+        if act in ("softrelu",):  # log(1+exp(x))
+            return EXP_RANGE
+        return NEUTRAL
+    return _OP_SENSITIVITY.get(opname, NEUTRAL)
 
 
 class SynthOp:

@@ -199,6 +199,15 @@ class Predictor:
         ``MXNET_GRAPH_ANALYZERS=1``."""
         return self._exec.check(is_train=False)
 
+    def precision_plan(self):
+        """The cast-plan artifact (``analysis.numerics.CastPlan``, ISSUE
+        11) for this predictor's eval plan: per-node ``bf16_safe |
+        fp32_accum | fp32_only`` verdicts + a fingerprint — what the
+        deployment-tier bf16 pass (ROADMAP item 3) will consume to build
+        this predictor's mixed-precision twin.  Serving warmup surfaces
+        the verdict counts per bucket when ``MXNET_GRAPH_ANALYZERS=1``."""
+        return self._exec.precision_plan(is_train=False)
+
     def with_shapes(self, input_shapes):
         """A sibling Predictor specialized to ``input_shapes``, sharing this
         one's symbol and loaded params — the cheap path for holding MANY

@@ -646,9 +646,26 @@ class Engine:
         # count only — ``pred.check()`` returns the full list on demand;
         # None when MXNET_GRAPH_ANALYZERS is off (check is never invoked
         # and the analysis package is never imported — the off path is
-        # this one env read)
-        checked = len(pred.check()) \
-            if env_flag("MXNET_GRAPH_ANALYZERS") else None
+        # this one env read).  Under the same gate the bucket's cast-plan
+        # verdict histogram rides along (ISSUE 11): how much of this plan
+        # the bf16 twin tier could drop to low precision.
+        if env_flag("MXNET_GRAPH_ANALYZERS"):
+            from .. import analysis
+            from ..analysis import numerics as _numerics
+
+            # one GraphContext for both surfaces: analyze() memoizes the
+            # numerics abstract walk on the ctx, so the cast-plan read
+            # below reuses it instead of walking the plan a second time
+            ctx = analysis.executor_context(pred._exec, is_train=False)
+            checked = len(analysis.analyze(ctx))
+            try:
+                verdicts = _numerics.precision_plan(ctx).counts()
+            except Exception:
+                # same degradation stance as the analyzers: a plan the
+                # numerics walk cannot handle must not fail warmup
+                verdicts = None
+        else:
+            checked = verdicts = None
         return {"bucket": repr(bucket), "fresh": fresh,
                 "compile_s": round(dt, 4) if fresh else 0.0,
                 "lower_s": round(lower_s, 4),
@@ -657,7 +674,8 @@ class Engine:
                 "aot_compile_s": round(aot_compile_s, 4), "cache": cache,
                 "graph_nodes_pre": ps["nodes_pre"] if ps else None,
                 "graph_nodes_post": ps["nodes_post"] if ps else None,
-                "check_warnings": checked}
+                "check_warnings": checked,
+                "precision_verdicts": verdicts}
 
     def _note_warmup(self, report, total_s):
         """Record the warmup pass for ``stats()["warmup"]`` (always on, so
@@ -668,6 +686,16 @@ class Engine:
         checked = [r.get("check_warnings") for r in report]
         n_diags = (sum(v for v in checked if v is not None)
                    if any(v is not None for v in checked) else None)
+        # cast-plan verdicts summed across buckets (ISSUE 11) — None when
+        # the analyzer gate is off (no row carried a histogram)
+        vrows = [r.get("precision_verdicts") for r in report]
+        vrows = [v for v in vrows if v]
+        verdicts = None
+        if vrows:
+            verdicts = {}
+            for v in vrows:
+                for k, n in v.items():
+                    verdicts[k] = verdicts.get(k, 0) + n
         with self._stats_mu:
             self._warmup = {
                 "buckets": len(report),
@@ -682,6 +710,9 @@ class Engine:
                 # graph-IR analyzer diagnostics across all warmed buckets
                 # (ISSUE 8) — None when MXNET_GRAPH_ANALYZERS is off
                 "check_warnings": n_diags,
+                # cast-plan verdict histogram across all warmed buckets
+                # (ISSUE 11) — same gate, same None-when-off contract
+                "precision_verdicts": verdicts,
                 "total_s": round(total_s, 4)}
         if self._probe:
             self._probe.record_warmup(len(report), hits, misses, total_s)

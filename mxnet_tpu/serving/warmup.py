@@ -49,7 +49,9 @@ def warmup_engine(engine, buckets=None, verbose=False, max_workers=None):
     graph-pass pipeline (ISSUE 7; None with ``MXNET_GRAPH_PASSES=0``);
     ``check_warnings`` counts this bucket's graph-IR analyzer diagnostics
     (``Predictor.check()``, ISSUE 8; None with ``MXNET_GRAPH_ANALYZERS``
-    off).
+    off) and ``precision_verdicts`` is the bucket plan's cast-plan verdict
+    histogram (``Predictor.precision_plan().counts()``, ISSUE 11; same
+    gate, None when off).
     The pass is also summarized in ``engine.stats()["warmup"]``."""
     from .. import compile_cache
 
@@ -93,6 +95,12 @@ def warmup_engine(engine, buckets=None, verbose=False, max_workers=None):
                     row["graph_nodes_pre"], row["graph_nodes_post"])
             if row.get("check_warnings"):
                 state += "  [check: %d diagnostics]" % row["check_warnings"]
+            if row.get("precision_verdicts"):
+                v = row["precision_verdicts"]
+                state += "  [cast-plan: %d bf16_safe / %d fp32_accum / " \
+                    "%d fp32_only]" % (v.get("bf16_safe", 0),
+                                       v.get("fp32_accum", 0),
+                                       v.get("fp32_only", 0))
             print("warmup %-28s %s" % (row["bucket"], state))
     total_s = time.perf_counter() - t0
     engine._note_warmup(report, total_s)

@@ -22,7 +22,8 @@ from .sinks import (JsonlSink, PrometheusSink, ProfilerSink, Sink,
 from .instrument import (ServeProbe, StepProbe, add_sink, array_nbytes,
                          counter, enabled, event, flush, gauge, histogram,
                          instrument_step, interval_s, jsonl_path,
-                         note_aot_cache, note_autotune_cache,
+                         note_analysis_finding, note_aot_cache,
+                         note_autotune_cache,
                          note_autotune_trial, note_bytes,
                          note_compile, note_dispatch, note_fused_fallback,
                          note_graph_passes, note_lockcheck_violation,
@@ -38,7 +39,8 @@ __all__ = [
     "iter_scalar_samples", "render_prometheus",
     "ServeProbe", "StepProbe", "add_sink", "array_nbytes", "counter",
     "enabled", "event", "flush", "gauge", "histogram", "instrument_step",
-    "interval_s", "jsonl_path", "note_aot_cache", "note_autotune_cache",
+    "interval_s", "jsonl_path", "note_analysis_finding", "note_aot_cache",
+    "note_autotune_cache",
     "note_autotune_trial", "note_bytes", "note_compile",
     "note_dispatch", "note_fused_fallback", "note_graph_passes",
     "note_lockcheck_violation", "note_nonfinite", "note_slo_breach",

@@ -257,6 +257,22 @@ def note_lockcheck_violation(kind):
                        ("kind",)).inc(kind=kind)
 
 
+def note_analysis_finding(analyzer, severity, n=1):
+    """Count ``n`` static-analysis diagnostics from one analyzer at one
+    severity (fed by ``analysis.analyze`` for EVERY registered analyzer —
+    numerics included — ISSUE 11).  The full Diagnostic list stays on the
+    ``check()`` return value / warmup rows without telemetry; this counter
+    is the production-canary surface: a warmed fleet alerting on a nonzero
+    ``severity="error"`` rate caught a plan-contract break in the field."""
+    if not enabled() or not n:
+        return
+    registry().counter("analysis_findings_total",
+                       "graph-IR analyzer diagnostics recorded by the "
+                       "analysis manager",
+                       ("analyzer", "severity")).inc(
+                           int(n), analyzer=analyzer, severity=severity)
+
+
 def note_aot_cache(kind, reason=None, tier="exec"):
     """Count one AOT persistent-cache event (compile_cache.py, ISSUE 6).
     ``kind``: "hits" | "misses" | "errors"; errors carry a reason label
@@ -606,6 +622,11 @@ def summary():
     # serve_latency_seconds histogram — null when no serving ran
     sp50 = r.hist_quantile("serve_latency_seconds", 0.50, None)
     sp99 = r.hist_quantile("serve_latency_seconds", 0.99, None)
+    # static-analysis surface (ISSUE 11): diagnostics the analyzer manager
+    # recorded this process (all analyzers, all severities) — null when
+    # nothing was recorded (no check()/warmup ran, or it all came back
+    # clean: counters only materialize on the first increment)
+    findings = r.total("analysis_findings_total", None)
     return {"compile_s": round(compile_s, 3),
             "peak_hbm_bytes": int(peak) if peak is not None else None,
             "data_wait_frac": round(frac, 4),
@@ -619,4 +640,6 @@ def summary():
             "serve_p50_ms": round(sp50 * 1e3, 3) if sp50 is not None
             else None,
             "serve_p99_ms": round(sp99 * 1e3, 3) if sp99 is not None
+            else None,
+            "analysis_findings": int(findings) if findings is not None
             else None}

@@ -290,3 +290,53 @@ def tiny_mlp_checkpoint(in_dim=8, num_hidden=16, num_classes=4, seed=0):
               for n, a in exe.arg_dict.items()
               if n not in ("data", "softmax_label")}
     return sym, params
+
+
+def deploy_twin_checkpoint(batch=16, image=32, seed=0):
+    """(symbol, params, input_shapes) for the two-head deploy-twin graph —
+    the ``MXNET_BENCH=predictor`` benchmark topology (conv+BN trunk, then a
+    classifier head AND an embedding head, each re-deriving the pooled
+    trunk features through a shared helper, so the captured graph carries
+    the duplicated subexpressions CSE merges and the eval-dead dropout the
+    inference rewrite drops).  ONE definition shared by ``bench.py``,
+    ``ci/check_numerics.py`` and the numerics tests, so the acceptance
+    surface and the benchmark can never drift apart (ISSUE 11)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    data = mx.sym.var("data")
+    h = data
+    for i, nf in enumerate((16, 32)):
+        h = mx.sym.Convolution(h, name="conv%d" % i, kernel=(3, 3),
+                               num_filter=nf, pad=(1, 1))
+        h = mx.sym.BatchNorm(h, name="bn%d" % i, fix_gamma=False)
+        h = mx.sym.Activation(h, name="act%d" % i, act_type="relu")
+        h = mx.sym.Pooling(h, name="pool%d" % i, kernel=(2, 2),
+                           stride=(2, 2), pool_type="max")
+
+    def pooled_features(trunk):
+        # per-head feature derivation (auto-named: each call captures a
+        # fresh chain — exactly the duplication CSE exists to merge)
+        p = mx.sym.Pooling(trunk, kernel=(1, 1), global_pool=True,
+                           pool_type="avg")
+        return mx.sym.L2Normalization(mx.sym.Flatten(p))
+
+    emb = pooled_features(h)  # embedding head (served for similarity)
+    cls = mx.sym.Dropout(pooled_features(h), p=0.5)
+    prob = mx.sym.softmax(
+        mx.sym.FullyConnected(cls, name="fc2", num_hidden=10), name="prob")
+    sym = mx.sym.Group([prob, emb])
+
+    rng = np.random.RandomState(seed)
+    input_shapes = {"data": (batch, 3, image, image)}
+    arg_shapes, _, aux_shapes = sym.infer_shape(**input_shapes)
+    params = {}
+    for n, s in zip(sym.list_arguments(), arg_shapes):
+        if n != "data":
+            params["arg:" + n] = nd.array(
+                rng.randn(*s).astype(np.float32) * 0.05)
+    for n, s in zip(sym.list_auxiliary_states(), aux_shapes):
+        params["aux:" + n] = nd.array(
+            np.ones(s, np.float32) if n.endswith("_var")
+            else np.zeros(s, np.float32))
+    return sym, params, input_shapes

@@ -156,7 +156,8 @@ class TestGraphAnalyzers:
 
     def test_analyzer_pipeline_registered_in_order(self):
         names = [n for n, _ in analysis.analyzer_pipeline()]
-        assert names == ["prng_safety", "shape_dtype", "dead_code"]
+        assert names == ["prng_safety", "shape_dtype", "dead_code",
+                         "numerics"]
 
 
 # -- source lint --------------------------------------------------------------

@@ -1,0 +1,509 @@
+"""Precision-flow analyzer tests (ISSUE 11): sensitivity registry, interval
+analysis, dtype-flow diagnostics, cast-plan verdicts + fingerprints, the
+serving/telemetry surfaces, and the two new mxlint rules."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import analysis
+from mxnet_tpu.analysis import source_lint
+from mxnet_tpu.analysis.diagnostics import INFO, WARNING
+from mxnet_tpu.analysis.numerics import (BF16_SAFE, FP32_ACCUM, FP32_ONLY,
+                                         CastPlan, contract_fingerprint)
+from mxnet_tpu.graph_passes.ir import (CANCELLATION, EXP_RANGE, NEUTRAL,
+                                       REDUCE, op_sensitivity)
+from mxnet_tpu.predictor import Predictor
+from mxnet_tpu.serving import BucketLadder, Engine
+from mxnet_tpu.telemetry import instrument as tin
+from mxnet_tpu.test_utils import deploy_twin_checkpoint, tiny_mlp_checkpoint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def tel_disabled(monkeypatch):
+    monkeypatch.delenv("MXNET_TELEMETRY", raising=False)
+    tin._reset_for_tests()
+    yield
+    tin._reset_for_tests()
+
+
+def _bind(sym, **arrays):
+    return sym.bind(None, {k: nd.array(v) for k, v in arrays.items()})
+
+
+def _bf16(a):
+    import jax.numpy as jnp
+
+    return np.asarray(a).astype(jnp.bfloat16)
+
+
+def _codes(diags):
+    return [d.code for d in diags]
+
+
+# -- sensitivity registry -----------------------------------------------------
+class TestSensitivityRegistry:
+    def _node(self, opname, attrs=None):
+        from mxnet_tpu.graph_passes.ir import PlanNode, SynthOp
+
+        return PlanNode(SynthOp(opname, lambda *a, **k: a[0]),
+                        attrs or {}, "n")
+
+    def test_core_classes(self):
+        assert op_sensitivity(self._node("sum")) == REDUCE
+        assert op_sensitivity(self._node("Convolution")) == REDUCE
+        assert op_sensitivity(self._node("FullyConnected")) == REDUCE
+        assert op_sensitivity(self._node("softmax")) == EXP_RANGE
+        assert op_sensitivity(self._node("exp")) == EXP_RANGE
+        assert op_sensitivity(self._node("BatchNorm")) == CANCELLATION
+        assert op_sensitivity(self._node("moments")) == CANCELLATION
+        assert op_sensitivity(self._node("relu")) == NEUTRAL
+        assert op_sensitivity(self._node("no_such_op")) == NEUTRAL
+
+    def test_attr_dependent_pooling_and_activation(self):
+        assert op_sensitivity(
+            self._node("Pooling", {"pool_type": "avg"})) == REDUCE
+        assert op_sensitivity(
+            self._node("Pooling", {"pool_type": "max"})) == NEUTRAL
+        # default pool_type (max) via the op's defaults
+        assert op_sensitivity(self._node("Pooling")) == NEUTRAL
+        assert op_sensitivity(
+            self._node("Activation", {"act_type": "softrelu"})) == EXP_RANGE
+        assert op_sensitivity(
+            self._node("Activation", {"act_type": "relu"})) == NEUTRAL
+
+
+# -- dtype-flow diagnostics ---------------------------------------------------
+class TestNumericsDiagnostics:
+    def test_bf16_reduction_trips_low_precision_accum(self):
+        x = mx.sym.var("data")
+        exe = _bind(mx.sym.sum(x), data=_bf16(np.ones((8, 8))))
+        diags = [d for d in exe.check() if d.code == "low-precision-accum"]
+        assert len(diags) == 1 and diags[0].severity == WARNING
+        assert "sum" in diags[0].message
+
+    def test_fp32_reduction_is_clean(self):
+        x = mx.sym.var("data")
+        exe = _bind(mx.sym.sum(x), data=np.ones((8, 8), np.float32))
+        assert exe.check() == []
+
+    def test_mxu_contraction_bf16_not_diagnosed_but_fp32_accum(self):
+        """dot/conv/FC accumulate fp32 in MXU hardware: a bf16 input is no
+        diagnostic — the verdict still demands fp32 accumulation."""
+        sym, params, shapes = deploy_twin_checkpoint(batch=2, image=16)
+        pred = Predictor(sym, params, shapes, dtype="bfloat16")
+        codes = _codes(pred.check())
+        assert "low-precision-accum" in codes  # avg-pool / L2Norm DO warn
+        plan = pred.precision_plan()
+        conv = [r for r in plan.rows if r["op"] == "Convolution"]
+        assert conv and all(r["verdict"] == FP32_ACCUM for r in conv)
+
+    def test_mixed_dtype_binop_flagged(self):
+        a, b = mx.sym.var("a"), mx.sym.var("b")
+        exe = _bind(mx.sym.broadcast_add(a, b),
+                    a=_bf16(np.ones((2, 2))),
+                    b=np.ones((2, 2), np.float32))
+        diags = [d for d in exe.check() if d.code == "mixed-dtype-binop"]
+        assert len(diags) == 1
+        assert "bfloat16" in diags[0].message
+        assert "float32" in diags[0].message
+
+    def test_softmax_unbounded_bf16_flagged_and_fp32_only(self):
+        x = mx.sym.var("data")
+        exe = _bind(mx.sym.softmax(x), data=_bf16(np.ones((2, 8))))
+        assert "exp-unbounded-lowp" in _codes(exe.check())
+        assert exe.precision_plan().rows[0]["verdict"] == FP32_ONLY
+
+    def test_softmax_bounded_by_sigmoid_is_safe(self):
+        """Interval analysis seeds sigmoid's [0, 1] output range, so the
+        downstream softmax needs no fp32 protection."""
+        x = mx.sym.var("data")
+        exe = _bind(mx.sym.softmax(mx.sym.sigmoid(x)),
+                    data=_bf16(np.ones((2, 8))))
+        assert exe.check() == []
+        rows = {r["op"]: r["verdict"] for r in exe.precision_plan().rows}
+        assert rows["softmax"] == BF16_SAFE
+
+    def test_lp_and_sum_pooling_escape_the_input_hull(self):
+        """lp/sum pooling output exceeds the input interval (window sums),
+        so a downstream exp must NOT inherit a bounded range from them."""
+        x = mx.sym.var("data")
+        for pt in ("lp", "sum"):
+            sym = mx.sym.exp(mx.sym.Pooling(
+                mx.sym.sigmoid(x), kernel=(2, 2), pool_type=pt, p_value=1))
+            exe = _bind(sym, data=_bf16(np.ones((1, 1, 4, 4))))
+            rows = {r["op"]: r["verdict"]
+                    for r in exe.precision_plan().rows}
+            assert rows["exp"] == FP32_ONLY, pt
+        # max pooling preserves the hull: same graph is safe
+        sym = mx.sym.exp(mx.sym.Pooling(
+            mx.sym.sigmoid(x), kernel=(2, 2), pool_type="max"))
+        exe = _bind(sym, data=_bf16(np.ones((1, 1, 4, 4))))
+        rows = {r["op"]: r["verdict"] for r in exe.precision_plan().rows}
+        assert rows["exp"] == BF16_SAFE
+
+    def test_joint_power_never_bf16_safe(self):
+        """x**y blows up from the JOINT base/exponent ranges (base near 0,
+        negative exponent) — per-input bands prove nothing."""
+        a, b = mx.sym.var("a"), mx.sym.var("b")
+        sym = mx.sym.broadcast_power(mx.sym.sigmoid(a),
+                                     mx.sym.clip(b, a_min=-8.0, a_max=8.0))
+        exe = _bind(sym, a=_bf16(np.ones((2, 2))), b=_bf16(np.ones((2, 2))))
+        rows = {r["op"]: r["verdict"] for r in exe.precision_plan().rows}
+        assert rows["broadcast_power"] == FP32_ONLY
+
+    def test_f64_input_cast_away_is_not_creep(self):
+        """An f64 input immediately consumed by an explicit downcast never
+        taints anything — no zero-downstream creep noise (the promotion
+        itself stays shape_dtype's f64-promotion territory)."""
+        code = (
+            "import numpy as np, jax\n"
+            "import jax.numpy as jnp\n"
+            "from mxnet_tpu import analysis\n"
+            "from mxnet_tpu.graph_passes import Graph\n"
+            "from mxnet_tpu.graph_passes.ir import PlanNode, SynthOp\n"
+            "cast = PlanNode(SynthOp('cast',\n"
+            "    lambda x: x.astype(jnp.float32)), {}, 'c')\n"  # mxlint: ignore[implicit-downcast]
+            "g = Graph([(cast, ('a',))], ['c_output'])\n"
+            "ctx = analysis.GraphContext(g, arg_names=['a'], aux_names=[],\n"
+            "    arg_avals={'a': jax.ShapeDtypeStruct((3,), np.float64)},\n"
+            "    aux_avals={})\n"
+            "creep = [d for d in analysis.analyze(ctx)\n"
+            "         if d.code == 'f64-creep']\n"
+            "assert creep == [], creep\n"
+            "print('NO_CREEP_OK')\n")
+        env = dict(os.environ, JAX_ENABLE_X64="1", JAX_PLATFORMS="cpu")
+        p = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                           capture_output=True, text=True, timeout=120)
+        assert p.returncode == 0, p.stdout + p.stderr
+        assert "NO_CREEP_OK" in p.stdout
+
+    def test_clip_bounds_feed_the_interval_analysis(self):
+        x = mx.sym.var("data")
+        clipped = mx.sym.clip(x, a_min=-5.0, a_max=5.0)
+        exe = _bind(mx.sym.exp(clipped), data=_bf16(np.ones((4,))))
+        assert exe.check() == []
+        rows = {r["op"]: r["verdict"] for r in exe.precision_plan().rows}
+        assert rows["exp"] == BF16_SAFE
+        # without the clip the same exp is fp32_only
+        exe2 = _bind(mx.sym.exp(x), data=_bf16(np.ones((4,))))
+        rows2 = {r["op"]: r["verdict"] for r in exe2.precision_plan().rows}
+        assert rows2["exp"] == FP32_ONLY
+
+    def test_f64_creep_names_origin_in_x64_subprocess(self):
+        """float64 only exists under JAX_ENABLE_X64, so the creep test runs
+        in a subprocess with the flag on; the diagnostic must name the
+        originating input and the downstream reach."""
+        code = (
+            "import numpy as np, jax\n"
+            "import jax.numpy as jnp\n"
+            "from mxnet_tpu import analysis\n"
+            "from mxnet_tpu.graph_passes import Graph\n"
+            "from mxnet_tpu.graph_passes.ir import PlanNode, SynthOp\n"
+            "sq = PlanNode(SynthOp('sqrt', jnp.sqrt), {}, 's')\n"
+            "ex = PlanNode(SynthOp('exp', jnp.exp), {}, 'e')\n"
+            "g = Graph([(sq, ('a',)), (ex, ('s_output',))], ['e_output'])\n"
+            "ctx = analysis.GraphContext(g, arg_names=['a'], aux_names=[],\n"
+            "    arg_avals={'a': jax.ShapeDtypeStruct((3,), np.float64)},\n"
+            "    aux_avals={})\n"
+            "diags = [d for d in analysis.analyze(ctx)\n"
+            "         if d.code == 'f64-creep']\n"
+            "assert len(diags) == 1, diags\n"
+            "msg = diags[0].message\n"
+            "assert \"input 'a'\" in msg and '2 downstream' in msg, msg\n"
+            "print('F64_CREEP_OK')\n")
+        env = dict(os.environ, JAX_ENABLE_X64="1", JAX_PLATFORMS="cpu")
+        p = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                           capture_output=True, text=True, timeout=120)
+        assert p.returncode == 0, p.stdout + p.stderr
+        assert "F64_CREEP_OK" in p.stdout
+
+    def test_silent_downcast_flagged_but_explicit_cast_exempt(self):
+        import jax.numpy as jnp
+        from mxnet_tpu.graph_passes import Graph
+        from mxnet_tpu.graph_passes.ir import PlanNode, SynthOp
+        import jax
+
+        def narrowing(xv):
+            return xv.astype(jnp.bfloat16)  # mxlint: ignore[implicit-downcast] (the seeded hazard under test)
+
+        shady = PlanNode(SynthOp("my_fused_op", narrowing), {}, "n")
+        g = Graph([(shady, ("a",))], ["n_output"])
+        ctx = analysis.GraphContext(
+            g, arg_names=["a"], aux_names=[],
+            arg_avals={"a": jax.ShapeDtypeStruct((3,), np.float32)},
+            aux_avals={})
+        assert "silent-downcast" in _codes(analysis.analyze(ctx))
+        # the SAME narrowing through the explicit cast op is exempt: the
+        # graph says what it does
+        x = mx.sym.var("data")
+        exe = _bind(mx.sym.cast(x, dtype="float16"),
+                    data=np.ones((2, 2), np.float32))
+        assert [d for d in exe.check()
+                if d.code == "silent-downcast"] == []
+
+
+# -- the cast plan ------------------------------------------------------------
+class TestCastPlan:
+    def test_deploy_twin_acceptance_shape(self):
+        """The ISSUE 11 acceptance criterion, verbatim: majority bf16_safe,
+        every reduction/BN-stat fp32_accum, every unbounded exp/log
+        fp32_only."""
+        sym, params, shapes = deploy_twin_checkpoint(batch=4, image=16)
+        plan = Predictor(sym, params, shapes).precision_plan()
+        counts = plan.counts()
+        assert counts[BF16_SAFE] * 2 > len(plan.rows)
+        for r in plan.rows:
+            if r["sensitivity"] in (REDUCE, CANCELLATION):
+                assert r["verdict"] == FP32_ACCUM, r
+            if r["sensitivity"] == EXP_RANGE:
+                assert r["verdict"] == FP32_ONLY, r  # fed raw FC logits
+
+    def test_fingerprint_stable_and_plan_sensitive(self):
+        sym, params, shapes = deploy_twin_checkpoint(batch=4, image=16)
+        fp1 = Predictor(sym, params, shapes).precision_plan().fingerprint()
+        fp2 = Predictor(sym, params, shapes).precision_plan().fingerprint()
+        assert fp1 == fp2
+        sym2, params2 = tiny_mlp_checkpoint()
+        fp3 = Predictor(sym2, params2,
+                        {"data": (2, 8)}).precision_plan().fingerprint()
+        assert fp3 != fp1
+
+    def test_fingerprint_moves_with_registry_version(self):
+        rows = [{"node": "n", "op": "sum", "sensitivity": REDUCE,
+                 "verdict": FP32_ACCUM, "dtype": "float32"}]
+        a = CastPlan("eval", rows).fingerprint()
+        b = CastPlan("eval", rows, versions=(999, 1)).fingerprint()
+        c = CastPlan("eval", rows, versions=(999, 1)).fingerprint()
+        assert a != b
+        assert b == c  # same versions + rows -> same identity
+
+    def test_executor_train_vs_eval_plans(self):
+        x = mx.sym.var("data")
+        sym = mx.sym.Dropout(mx.sym.sum(x), p=0.5)
+        exe = _bind(sym, data=np.ones((4, 4), np.float32))
+        ev = exe.precision_plan(is_train=False)
+        tr = exe.precision_plan(is_train=True)
+        assert ev.mode == "eval" and tr.mode == "train"
+        assert {r["op"] for r in tr.rows} >= {"sum", "Dropout"}
+
+    def test_to_dict_round_trips_counts(self):
+        sym, params = tiny_mlp_checkpoint()
+        plan = Predictor(sym, params, {"data": (2, 8)}).precision_plan()
+        d = plan.to_dict()
+        assert d["counts"] == plan.counts()
+        assert d["fingerprint"] == plan.fingerprint()
+        assert len(d["rows"]) == len(plan.rows)
+
+    def test_unbound_executor_raises(self):
+        x = mx.sym.var("data")
+        exe = mx.sym.exp(x).bind(None, {})
+        with pytest.raises(ValueError, match="bound shapes"):
+            exe.precision_plan()
+
+    def test_contract_fingerprint_in_aot_env(self):
+        from mxnet_tpu import compile_cache
+
+        fp = compile_cache._env_fingerprint()
+        assert fp["numerics"] == contract_fingerprint()
+        assert "sensitivity:" in fp["numerics"]
+
+
+# -- analyzer-skipped + degradation (ISSUE 11 satellites) ---------------------
+class TestManagerContracts:
+    def test_missing_avals_reports_skip_not_silence(self):
+        from mxnet_tpu.graph_passes import Graph
+        from mxnet_tpu.graph_passes.ir import PlanNode, SynthOp
+
+        node = PlanNode(SynthOp("exp", lambda x: x), {}, "n0")
+        g = Graph([(node, ("a",))], ["n0_output"])
+        ctx = analysis.GraphContext(g, arg_names=["a"], aux_names=[])
+        diags = analysis.analyze(ctx)
+        skipped = [d for d in diags if d.code == "analyzer-skipped"]
+        assert sorted(d.analyzer for d in skipped) == ["numerics",
+                                                       "shape_dtype"]
+        assert all(d.severity == INFO for d in skipped)
+
+    def test_raising_analyzer_degrades_and_rest_still_run(self, monkeypatch):
+        """Satellite: one INFO for the failed analyzer, every later
+        analyzer still contributes findings (a seeded bf16 reduction proves
+        numerics ran after the crash)."""
+        def boom(ctx):
+            raise RuntimeError("kaboom")
+        monkeypatch.setattr(analysis, "_ANALYZERS",
+                            [("boom", 1, boom)] + analysis._ANALYZERS)
+        x = mx.sym.var("data")
+        exe = _bind(mx.sym.sum(x), data=_bf16(np.ones((4, 4))))
+        diags = exe.check()
+        failed = [d for d in diags if d.code == "analyzer-failed"]
+        assert len(failed) == 1 and failed[0].severity == INFO
+        assert "kaboom" in failed[0].message
+        # the analyzers AFTER the crash still ran
+        assert "low-precision-accum" in _codes(diags)
+
+    def test_raising_analyzer_degrades_in_warmup_path(self, monkeypatch,
+                                                      tel_disabled):
+        """Satellite: the MXNET_GRAPH_ANALYZERS=1 warmup surface counts the
+        degraded INFO instead of crashing the warmup pass."""
+        monkeypatch.setenv("MXNET_GRAPH_ANALYZERS", "1")
+
+        def boom(ctx):
+            raise RuntimeError("kaboom")
+        monkeypatch.setattr(analysis, "_ANALYZERS",
+                            [("boom", 1, boom)] + analysis._ANALYZERS)
+        sym, params = tiny_mlp_checkpoint()
+        with Engine(sym, params, {"data": (8,)},
+                    ladder=BucketLadder((1,)), start=False) as eng:
+            report = eng.warmup()
+            assert all(r["check_warnings"] == 1 for r in report)  # the INFO
+            assert eng.stats()["warmup"]["check_warnings"] == len(report)
+
+
+# -- serving + telemetry surfaces --------------------------------------------
+class TestSurfaces:
+    def test_warmup_rows_carry_verdict_histogram(self, monkeypatch,
+                                                 tel_disabled):
+        monkeypatch.setenv("MXNET_GRAPH_ANALYZERS", "1")
+        sym, params = tiny_mlp_checkpoint()
+        with Engine(sym, params, {"data": (8,)},
+                    ladder=BucketLadder((1, 2)), start=False) as eng:
+            report = eng.warmup()
+            for r in report:
+                v = r["precision_verdicts"]
+                assert set(v) == {BF16_SAFE, FP32_ACCUM, FP32_ONLY}
+                assert v[FP32_ACCUM] == 2  # fc1, fc2
+            agg = eng.stats()["warmup"]["precision_verdicts"]
+            assert agg[FP32_ACCUM] == 2 * len(report)
+
+    def test_warmup_rows_verdicts_none_when_gate_off(self, monkeypatch,
+                                                     tel_disabled):
+        monkeypatch.delenv("MXNET_GRAPH_ANALYZERS", raising=False)
+        sym, params = tiny_mlp_checkpoint()
+        with Engine(sym, params, {"data": (8,)},
+                    ladder=BucketLadder((1,)), start=False) as eng:
+            report = eng.warmup()
+            assert all(r["precision_verdicts"] is None for r in report)
+            assert eng.stats()["warmup"]["precision_verdicts"] is None
+
+    def test_shared_context_walks_the_plan_once(self, monkeypatch):
+        """analyze() + precision_plan() on one GraphContext share one
+        abstract walk via the _flow memo (the warmup path's cost model)."""
+        from mxnet_tpu.analysis import graph_analyzers, numerics
+
+        calls = {"n": 0}
+        real = graph_analyzers._abstract_walk
+
+        def counting(graph, ctx, record=None):
+            if record is not None:
+                calls["n"] += 1
+            return real(graph, ctx, record)
+
+        monkeypatch.setattr(numerics, "_abstract_walk", counting,
+                            raising=False)
+        # numerics imports the walk inside _flow, so patch at the source
+        monkeypatch.setattr(graph_analyzers, "_abstract_walk", counting)
+        sym, params = tiny_mlp_checkpoint()
+        pred = Predictor(sym, params, {"data": (2, 8)})
+        ctx = analysis.executor_context(pred._exec, is_train=False)
+        analysis.analyze(ctx)
+        after_check = calls["n"]
+        numerics.precision_plan(ctx)
+        # shape_dtype walks once, numerics walks once; the plan read adds 0
+        assert calls["n"] == after_check == 2
+
+    def test_analysis_findings_counter_and_summary(self, monkeypatch,
+                                                   tmp_path):
+        monkeypatch.setenv("MXNET_TELEMETRY", "1")
+        monkeypatch.setenv("MXNET_TELEMETRY_FILE", str(tmp_path / "t.jsonl"))
+        tin._reset_for_tests()
+        try:
+            x = mx.sym.var("data")
+            exe = _bind(mx.sym.sum(x), data=_bf16(np.ones((4, 4))))
+            exe.check()
+            c = tin.registry().get("analysis_findings_total")
+            assert c is not None
+            assert c.value(analyzer="numerics", severity="warning") == 1
+            assert tin.summary()["analysis_findings"] == 1
+        finally:
+            tin._reset_for_tests()
+
+    def test_no_counter_and_null_summary_key_without_findings(
+            self, monkeypatch, tmp_path):
+        monkeypatch.setenv("MXNET_TELEMETRY", "1")
+        monkeypatch.setenv("MXNET_TELEMETRY_FILE", str(tmp_path / "t.jsonl"))
+        tin._reset_for_tests()
+        try:
+            assert tin.summary()["analysis_findings"] is None
+        finally:
+            tin._reset_for_tests()
+
+
+# -- the two new mxlint rules -------------------------------------------------
+class TestNumericsLintRules:
+    def _codes(self, src):
+        return [f.code for f in source_lint.lint_source(src)]
+
+    def test_inexact_literal_on_traced_param_flagged(self):
+        src = ("import jax\n\n@jax.jit\ndef f(x):\n"
+               "    return x + 1e-5\n")
+        assert self._codes(src) == ["mixed-dtype-literal"]
+
+    def test_bf16_exact_literals_are_exempt(self):
+        src = ("import jax\n\n@jax.jit\ndef f(x):\n"
+               "    return x * 0.5 + 2.0 - 127.0\n")
+        assert self._codes(src) == []
+
+    def test_literal_against_untraced_value_exempt(self):
+        src = ("import jax\n\n@jax.jit\ndef f(x, *, eps=1e-5):\n"
+               "    scale = 3.0 * 1.1\n"   # no traced param involved
+               "    return x * scale\n")
+        assert self._codes(src) == []
+
+    def test_negative_literal_unwrapped(self):
+        src = ("import jax\n\n@jax.jit\ndef f(x):\n"
+               "    return x - -1e-5\n")
+        assert self._codes(src) == ["mixed-dtype-literal"]
+
+    def test_astype_narrow_in_traced_flagged(self):
+        src = ("import jax\nimport jax.numpy as jnp\n\n"
+               "@jax.jit\ndef f(x):\n"
+               "    return x.astype(jnp.bfloat16)\n")
+        assert self._codes(src) == ["implicit-downcast"]
+
+    def test_astype_string_and_view_forms(self):
+        src = ("import jax\n\n@jax.jit\ndef f(x):\n"
+               "    a = x.astype('float16')\n"
+               "    b = x.view('int8')\n"
+               "    return a, b\n")
+        assert self._codes(src) == ["implicit-downcast"] * 2
+
+    def test_widening_astype_and_host_code_exempt(self):
+        src = ("import jax\nimport jax.numpy as jnp\nimport numpy as np\n\n"
+               "@jax.jit\ndef f(x):\n"
+               "    return x.astype(jnp.float32)\n\n"
+               "def host(img):\n"
+               "    return img.astype(np.uint8)\n")
+        assert self._codes(src) == []
+
+    def test_ignore_comment_suppresses_downcast(self):
+        src = ("import jax\nimport jax.numpy as jnp\n\n"
+               "@jax.jit\ndef f(x):\n"
+               "    return x.astype(jnp.int8)"
+               "  # mxlint: ignore[implicit-downcast]\n")
+        assert self._codes(src) == []
+
+    def test_repo_is_clean_with_new_rules(self):
+        findings = source_lint.lint_paths(
+            [os.path.join(REPO, "mxnet_tpu")], root=REPO)
+        baseline = source_lint.load_baseline(
+            os.path.join(REPO, "ci", "mxlint_baseline.txt"))
+        new = [f for f in findings
+               if f.code in ("mixed-dtype-literal", "implicit-downcast")
+               and f.fingerprint not in baseline]
+        assert not new, "\n".join(str(f) for f in new)
