@@ -26,8 +26,9 @@ def _emit(payload):
     """Print the ONE bench JSON line; with MXNET_TELEMETRY enabled, attach
     the telemetry block (compile_s, peak_hbm_bytes, data_wait_frac, and —
     when a Module train loop ran — dispatches_per_step, the ISSUE 3 fused
-    step's regression surface; see docs/OBSERVABILITY.md) and flush the
-    JSONL event log.  The line's schema is linted by
+    step's regression surface, plus trainhealth_drain_s, the ISSUE 12
+    health plane's whole host-side overhead; see docs/OBSERVABILITY.md)
+    and flush the JSONL event log.  The line's schema is linted by
     ci/check_bench_schema.py."""
     from mxnet_tpu import telemetry
 
@@ -190,14 +191,26 @@ def main_module():
                        optimizer_params={"learning_rate": 0.05,
                                          "momentum": 0.9})
     b = DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(y)])
+    # trainhealth plane (ISSUE 12): with MXNET_TRAINHEALTH=1 this bench
+    # drains like the fit loop would, so the emitted telemetry block's
+    # trainhealth_drain_s measures the plane's whole per-step overhead
+    # inside the timed loop (None when the gate is off)
+    from mxnet_tpu import telemetry
+
+    health = telemetry.trainhealth.plane()
     mod.forward_backward(b)
     mod.update()  # warmup/compile
     mod.get_outputs()[0].asnumpy()
+    if health is not None:
+        health.drain(mod, step=0)
 
     t0 = time.perf_counter()
-    for _ in range(iters):
+    for i in range(iters):
         mod.forward_backward(b)
         mod.update()
+        if health is not None:
+            mod.get_outputs()[0].asnumpy()  # the fit loop's metric sync
+            health.drain(mod, step=i + 1)
     mod.get_outputs()[0].asnumpy()  # sync the async dispatch chain
     dt = time.perf_counter() - t0
     _emit({

@@ -30,10 +30,13 @@ TEL_REQ_KEYS = {"compile_s", "peak_hbm_bytes", "data_wait_frac"}
 # analysis_findings (ISSUE 11): graph-IR analyzer diagnostics the manager
 # recorded this process — null when nothing was recorded (no
 # check()/warmup analysis ran, or everything analyzed was clean)
+# trainhealth_drain_s (ISSUE 12): host seconds the training-health plane's
+# per-step drain cost — THE health-overhead number (the in-graph stat
+# reductions ride the fused dispatch for free); null when no drain ran
 TEL_OPT_KEYS = {"dispatches_per_step", "warmup_s",
                 "graph_nodes_pre", "graph_nodes_post", "pass_time_s",
                 "autotune_trials", "serve_p50_ms", "serve_p99_ms",
-                "analysis_findings"}
+                "analysis_findings", "trainhealth_drain_s"}
 TEL_KEYS = TEL_REQ_KEYS | TEL_OPT_KEYS
 
 # SERVE_BENCH line (tools/loadgen.py, ISSUE 2) — docs/SERVING.md schema
@@ -182,7 +185,7 @@ def validate_line(obj, where="<line>"):
             raise SchemaError(
                 "%s: telemetry.autotune_trials must be a non-negative int "
                 "or null" % where)
-        for k in ("serve_p50_ms", "serve_p99_ms"):
+        for k in ("serve_p50_ms", "serve_p99_ms", "trainhealth_drain_s"):
             sv = tel.get(k)
             if sv is not None and (not _num(sv) or sv < 0):
                 raise SchemaError(
@@ -343,6 +346,14 @@ def self_test():
          "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
                        "data_wait_frac": 0.0, "serve_p50_ms": None,
                        "serve_p99_ms": None}},
+        {"metric": "m", "value": 1, "unit": "samples/s",
+         "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0,
+                       "trainhealth_drain_s": 0.0213}},
+        {"metric": "m", "value": 1, "unit": "samples/s",
+         "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0,
+                       "trainhealth_drain_s": None}},
     ]
     bad = [
         {},                                                  # empty
@@ -387,6 +398,14 @@ def self_test():
          "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
                        "data_wait_frac": 0.0, "serve_p50_ms": 9.0,
                        "serve_p99_ms": 3.0}},            # p99 < p50
+        {"metric": "m", "value": 1, "unit": "img/s",
+         "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0,
+                       "trainhealth_drain_s": -0.5}},    # negative drain
+        {"metric": "m", "value": 1, "unit": "img/s",
+         "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0,
+                       "trainhealth_drain_s": True}},    # bool drain
     ]
     serve_good = {"mode": "closed", "requests": 10, "completed": 9,
                   "shed": 1, "timeouts": 0, "errors": 0, "shed_rate": 0.1,

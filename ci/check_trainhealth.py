@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""CI smoke for the training health plane (ISSUE 12).
+
+Three phases, exit 0 only when all pass — wired into the unit tier of
+``ci/run_tests.sh``:
+
+1. **Off path clean.**  With ``MXNET_TRAINHEALTH`` unset, a fused train
+   step carries no health state (no stats staged, no plane, no
+   ``trainhealth_*`` metrics) and its AOT key carries no trainhealth
+   marker — the gate-off step is byte-identical to a build without the
+   feature.  No flight-recorder dump may appear.
+2. **Seeded divergence trips the tripwire.**  With the gate on, a NaN-fed
+   step's drained row must carry a non-finite census blaming a verdict
+   class, the ``precision_verdict_violations_total`` counter must fire for
+   a blessed class, and the flight recorder must emit a ``trainhealth``
+   dump artifact naming the first offending parameter group.
+3. **Healthy steps report real numbers.**  Grad/param norms positive and
+   finite, the drained global grad norm matching a numpy recomputation
+   from the executor's own grad buffers.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import shutil
+import sys
+
+FREC_DIR = "/tmp/trainhealth_smoke_frec"
+
+
+def _module(mx, mod_mod, batch=8):
+    import numpy as np
+
+    data = mx.sym.var("data")
+    x = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+    x = mx.sym.Activation(x, name="relu1", act_type="relu")
+    sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(x, name="fc2", num_hidden=4), name="softmax")
+    mod = mod_mod.Module(sym)
+    mod.bind(data_shapes=[("data", (batch, 8))],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    rng = np.random.RandomState(0)
+    return mod, rng
+
+
+def _batch(mx, rng, batch=8, nan=False):
+    import numpy as np
+
+    from mxnet_tpu.io import DataBatch
+
+    x = rng.randn(batch, 8).astype(np.float32)
+    if nan:
+        x[0, 0] = np.nan
+    return DataBatch(
+        data=[mx.nd.array(x)],
+        label=[mx.nd.array(rng.randint(0, 4, (batch,)).astype(np.float32))])
+
+
+def main():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["MXNET_TELEMETRY"] = "1"
+    os.environ.setdefault("MXNET_TELEMETRY_FILE",
+                          "/tmp/trainhealth_smoke.jsonl")
+    os.environ["MXNET_MODULE_FUSED_STEP"] = "1"
+    os.environ.pop("MXNET_TRAINHEALTH", None)
+    os.environ["MXNET_FLIGHTREC_DIR"] = FREC_DIR
+    shutil.rmtree(FREC_DIR, ignore_errors=True)
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import module as mod_mod
+    from mxnet_tpu.telemetry import instrument as tin
+    from mxnet_tpu.telemetry import trainhealth
+
+    # -- phase 1: off path ---------------------------------------------------
+    mod, rng = _module(mx, mod_mod)
+    for _ in range(2):
+        mod.forward_backward(_batch(mx, rng))
+        mod.update()
+    ok = True
+    if mod._fused is None or mod._fused._health_groups is not None \
+            or mod._fused._last_health is not None:
+        print("check_trainhealth: OFF path staged health state",
+              file=sys.stderr)
+        ok = False
+    if trainhealth.plane() is not None or mod.trainer_stats() is not None:
+        print("check_trainhealth: OFF path materialized the plane",
+              file=sys.stderr)
+        ok = False
+    if mod._fused is not None and mod._fused._aot_key is not None \
+            and "trainhealth" in mod._fused._aot_key:
+        print("check_trainhealth: OFF path AOT key carries the "
+              "trainhealth marker", file=sys.stderr)
+        ok = False
+    if tin.registry().get("trainhealth_global_grad_norm") is not None:
+        print("check_trainhealth: OFF path fed the registry",
+              file=sys.stderr)
+        ok = False
+    if glob.glob(os.path.join(FREC_DIR, "flightrec-*")):
+        print("check_trainhealth: OFF path wrote a flightrec dump",
+              file=sys.stderr)
+        ok = False
+
+    # -- phase 2 + 3: gate on ------------------------------------------------
+    os.environ["MXNET_TRAINHEALTH"] = "1"
+    mod, rng = _module(mx, mod_mod)
+    mod.forward_backward(_batch(mx, rng))
+    mod.update()
+    plane = trainhealth.plane()
+    row = plane.drain(mod, epoch=0, step=0)
+    if row is None or row["nonfinite_groups"]:
+        print("check_trainhealth: healthy step drained %r" % (row,),
+              file=sys.stderr)
+        return 1
+    # recompute the global grad norm from the executor's grad buffers
+    tot = 0.0
+    for n in mod._param_names:
+        g = mod._exec.grad_dict[n].asnumpy().astype(np.float64)
+        tot += float((g ** 2).sum())
+    if not np.isclose(np.sqrt(tot), row["global_grad_norm"], rtol=1e-4):
+        print("check_trainhealth: global_grad_norm %.6f != numpy %.6f"
+              % (row["global_grad_norm"], np.sqrt(tot)), file=sys.stderr)
+        ok = False
+    for g, s in row["groups"].items():
+        if not (np.isfinite(s["grad_norm"]) and s["param_norm"] > 0
+                and np.isfinite(s["update_ratio"])):
+            print("check_trainhealth: implausible stats for group %r: %r"
+                  % (g, s), file=sys.stderr)
+            ok = False
+
+    # seeded divergence
+    mod.forward_backward(_batch(mx, rng, nan=True))
+    mod.update()
+    row = plane.drain(mod, epoch=0, step=1)
+    if not row["nonfinite_groups"] or not row["nonfinite_census"]:
+        print("check_trainhealth: NaN step produced no census: %r"
+              % (row,), file=sys.stderr)
+        return 1
+    blamed = set(row["nonfinite_census"])
+    verdicts = {s["verdict"] for s in row["groups"].values()}
+    if not blamed <= (verdicts | {"unknown"}):
+        print("check_trainhealth: census classes %s not drawn from the "
+              "plan's verdicts %s" % (blamed, verdicts), file=sys.stderr)
+        ok = False
+    pvv = tin.registry().get("precision_verdict_violations_total")
+    if pvv is None or not any(s["value"] > 0 for s in pvv.samples()):
+        print("check_trainhealth: blessed-class violation counter did not "
+              "fire", file=sys.stderr)
+        ok = False
+    dumps = glob.glob(os.path.join(FREC_DIR, "flightrec-*-trainhealth.json"))
+    if not dumps:
+        print("check_trainhealth: divergence produced no flightrec dump",
+              file=sys.stderr)
+        return 1
+    meta = json.load(open(dumps[0]))["flightrec"]
+    if meta.get("group") not in row["groups"]:
+        print("check_trainhealth: dump names unknown group %r"
+              % meta.get("group"), file=sys.stderr)
+        ok = False
+    if not meta.get("health_rows"):
+        print("check_trainhealth: dump carries no health rows",
+              file=sys.stderr)
+        ok = False
+
+    if ok:
+        print("check_trainhealth: OK — off path clean, census blamed %s, "
+              "dump %s names group %r"
+              % (sorted(blamed), os.path.basename(dumps[0]),
+                 meta.get("group")))
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -83,6 +83,11 @@ case "$tier" in
     # MXNET_LOCKCHECK=1 must record zero violations on the real engine,
     # and the seeded inversion/unguarded-mutation must both be detected
     ./dev.sh python ci/check_lockcheck.py
+    # training-health smoke (ISSUE 12): gate off = no staged stats, no
+    # plane, no key marker, no dump; a seeded NaN divergence must trip the
+    # verdict-class census + blessed-class violation counter and emit a
+    # flightrec dump artifact naming the offending parameter group
+    ./dev.sh python ci/check_trainhealth.py
     # telemetry unit tests (tests/test_telemetry.py) run as part of tests/
     ignore=()
     for f in "${NIGHTLY_FILES[@]}"; do ignore+=(--ignore "$f"); done

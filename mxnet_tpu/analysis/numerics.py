@@ -55,7 +55,8 @@ from . import register_analyzer
 from .diagnostics import Diagnostic, WARNING
 
 __all__ = ["numerics", "precision_plan", "CastPlan", "NUMERICS_VERSION",
-           "contract_fingerprint", "BF16_SAFE", "FP32_ACCUM", "FP32_ONLY"]
+           "contract_fingerprint", "param_verdict_classes",
+           "BF16_SAFE", "FP32_ACCUM", "FP32_ONLY"]
 
 # Bump on any change to verdict policy, diagnostics, or interval transfer
 # functions — enters every CastPlan fingerprint and (via
@@ -615,6 +616,42 @@ def precision_plan(ctx):
             " — bind arrays before asking for a cast plan")
     rows, _ = _flow(ctx, ctx.graph)
     return CastPlan("train" if ctx.is_train else "eval", rows)
+
+
+_VERDICT_RANK = {BF16_SAFE: 0, FP32_ACCUM: 1, FP32_ONLY: 2}
+
+
+def param_verdict_classes(ctx):
+    """{bound arg/aux name -> verdict class} for every input the plan
+    consumes — the ISSUE 12 runtime export: each parameter takes the most
+    conservative verdict (``fp32_only`` > ``fp32_accum`` > ``bf16_safe``)
+    among the nodes that read it, so the trainhealth plane can bucket a
+    runtime non-finite gradient by the class the static analyzer assigned
+    to the parameter's compute.  Names never consumed by a classified node
+    (dead inputs, pass-folded consumers) are simply absent — the caller
+    reports them as "unknown", never as blessed.  Shares :func:`_flow`'s
+    per-context memo with the analyzer and ``precision_plan`` (one
+    abstract walk for all three); raises ``ValueError`` without bound
+    avals, exactly like ``precision_plan``."""
+    if not ctx.has_avals:
+        raise ValueError(
+            "param_verdict_classes needs bound shapes/dtypes "
+            "(arg_avals/aux_avals) — bind arrays before asking for "
+            "verdict classes")
+    rows, _ = _flow(ctx, ctx.graph)
+    by_node = {r["node"]: r["verdict"] for r in rows}
+    bound = set(ctx.arg_names or ()) | set(ctx.aux_names or ())
+    out = {}
+    for node, in_names in ctx.graph.entries:
+        v = by_node.get(node.name)
+        if v is None:
+            continue
+        for n in in_names:
+            if n in bound:
+                cur = out.get(n)
+                if cur is None or _VERDICT_RANK[v] > _VERDICT_RANK[cur]:
+                    out[n] = v
+    return out
 
 
 def contract_fingerprint():

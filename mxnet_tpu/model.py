@@ -202,6 +202,13 @@ class FeedForward:
         assert self._module is not None, "call fit first"
         return self._module.predict(X, num_batch=num_batch).asnumpy()
 
+    def trainer_stats(self):
+        """The process's last trainhealth row (ISSUE 12; the plane is
+        process-global — see ``Module.trainer_stats``); None before fit,
+        or with MXNET_TRAINHEALTH off."""
+        return self._module.trainer_stats() if self._module is not None \
+            else None
+
     def save(self, prefix, epoch=None):
         save_checkpoint(prefix, epoch if epoch is not None else (self.num_epoch or 0),
                         self.symbol, self.arg_params or {}, self.aux_params or {})

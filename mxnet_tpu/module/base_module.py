@@ -237,6 +237,11 @@ class BaseModule:
         # and an unchanged loop below (frec is None, tested).
         telemetry.ops_server.maybe_start()
         frec = telemetry.flightrec.recorder()
+        # training health plane (ISSUE 12, MXNET_TRAINHEALTH): drains the
+        # fused step's in-graph stats pytree once per batch — after the
+        # metric read has already synced the dispatch, so the drain adds
+        # no device round trip.  Gate unset = one env read here, None.
+        health = telemetry.trainhealth.plane()
 
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
@@ -294,6 +299,8 @@ class BaseModule:
                     # timeline for a post-mortem dump
                     frec.record("step", dur_s=time.perf_counter() - t_batch,
                                 epoch=epoch, step=nbatch)
+                if health is not None:
+                    health.drain(self, epoch=epoch, step=nbatch)
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:

@@ -162,16 +162,25 @@ def _commit_state(state, new_leaves):
 
 
 def _build_step_fn(graph_fn, arg_names, diff_names, const_names, kind, hp,
-                   nancheck=False):
+                   nancheck=False, health_groups=None):
     """The pure fused step: one vjp over the executor graph + the in-graph
     optimizer fold.  Closed over only static structure (names, kind, static
-    hyperparams, the nancheck flag) so one jitted instance survives re-binds
-    of the same symbol and re-traces only on new shape signatures.
+    hyperparams, the nancheck/health flags) so one jitted instance survives
+    re-binds of the same symbol and re-traces only on new shape signatures.
 
     With ``nancheck`` the step also returns a scalar ``finite`` flag —
     ``all(isfinite(heads)) & all(isfinite(grads))`` reduced INSIDE the same
     donated jit, so the check adds no dispatch and no sync (the caller reads
-    the flag one step later, when it has materialized for free)."""
+    the flag one step later, when it has materialized for free).
+
+    With ``health_groups`` (ISSUE 12, ``MXNET_TRAINHEALTH`` or an in-graph
+    monitor) the step additionally returns the trainhealth stats pytree —
+    global/per-group grad norms, param norms, update-to-weight ratios and
+    per-group non-finite flags, reduced by
+    ``telemetry.trainhealth.compute_step_stats`` inside the same donated
+    jit: observing the step costs zero extra dispatches.  Both extras
+    append to the output tuple (finite flag first), so the gate-off output
+    structure stays byte-identical to a build without either feature."""
     import jax
     import jax.numpy as jnp
 
@@ -201,14 +210,20 @@ def _build_step_fn(graph_fn, arg_names, diff_names, const_names, kind, hp,
                                          lr=lr_vec[i], wd=wd_vec[i], **hp)
             new_params.append(new_w)
             new_state.append(list(new_st))
-        if not nancheck:
-            return new_params, new_state, new_aux, heads, grads
-        finite = jnp.bool_(True)
-        for h in heads:
-            finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(h)))
-        for g in grads:
-            finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
-        return new_params, new_state, new_aux, heads, grads, finite
+        out = (new_params, new_state, new_aux, heads, grads)
+        if nancheck:
+            finite = jnp.bool_(True)
+            for h in heads:
+                finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(h)))
+            for g in grads:
+                finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+            out = out + (finite,)
+        if health_groups is not None:
+            from ..telemetry import trainhealth
+
+            out = out + (trainhealth.compute_step_stats(
+                heads, grads, diff_vals, new_params, health_groups),)
+        return out
 
     return step
 
@@ -246,6 +261,24 @@ class FusedStepper:
             hp.update(beta1=float(opt.beta1), beta2=float(opt.beta2),
                       epsilon=float(opt.epsilon))
         self._nancheck = env_flag("MXNET_NANCHECK")
+        # trainhealth (ISSUE 12): in-graph stats ride the same donated jit
+        # when the env gate is on OR a pattern-filtered Monitor is routed
+        # onto the fused step (Module.install_monitor).  Both flip the
+        # output structure, so both are stepper identity (stale() rebuilds
+        # on a change) and the AOT key gains a marker — the gate-off key
+        # stays byte-identical to a build without the feature.
+        from ..telemetry import trainhealth
+
+        self._health_env = trainhealth.enabled()
+        self._monitor_attached = \
+            getattr(module, "_stat_monitor", None) is not None
+        self._health_groups = None
+        self._health_verdicts = None
+        if self._health_env or self._monitor_attached:
+            self._health_groups = trainhealth.param_groups(self._diff_names)
+            self._health_verdicts = trainhealth.group_verdict_classes(
+                module, self._diff_names, self._health_groups)
+        self._last_health = None  # (step number, device stats pytree)
         self._mesh = module._mesh
         self._zero = self._mesh is not None and fused_zero_enabled()
         # the executor's bind-time graph-pass snapshot (ISSUE 7): the
@@ -273,11 +306,16 @@ class FusedStepper:
                 tuple(self._diff_names), tuple(self._const_names),
                 tuple(self._aux_names), self._hp_sig, self._nancheck,
                 self._zero, self._mesh is not None, "donate:0123")
+            if self._health_groups is not None:
+                # appended (not an always-present flag) so gate-off keys
+                # stay byte-identical to pre-trainhealth entries
+                self._aot_key = self._aot_key + ("trainhealth",)
         self._nsteps = 0
         self._pending_flag = None  # (finite device scalar, step number)
         self._fn = _build_step_fn(exec_._graph_fn(True), self._arg_names,
                                   self._diff_names, self._const_names,
-                                  self._kind, hp, nancheck=self._nancheck)
+                                  self._kind, hp, nancheck=self._nancheck,
+                                  health_groups=self._health_groups)
         self._jit = None
         self._step = None
         # mesh-path sharding cache, filled on first run (needs the state
@@ -340,6 +378,8 @@ class FusedStepper:
                       [repl] * len(self._aux_names), None, grad_sh)
             if self._nancheck:
                 out_sh = out_sh + (None,)
+            if self._health_groups is not None:
+                out_sh = out_sh + (None,)  # stats pytree: compiler-chosen
             self._jit = jax.jit(self._fn, donate_argnums=(0, 1, 2, 3),
                                 out_shardings=out_sh)
             # declared ONCE per stepper build (not per retrace like the
@@ -379,12 +419,18 @@ class FusedStepper:
 
     def stale(self, module):
         """True when the Module's optimizer (or a folded-in hyperparam, the
-        MXNET_NANCHECK gate — it changes the step's output structure — or
-        the MXNET_FUSED_ZERO gate — it changes the state layout) changed
-        since this stepper was built — caller rebuilds."""
+        MXNET_NANCHECK gate — it changes the step's output structure — the
+        MXNET_TRAINHEALTH gate / in-graph monitor attachment — same reason
+        — or the MXNET_FUSED_ZERO gate — it changes the state layout)
+        changed since this stepper was built — caller rebuilds."""
+        from ..telemetry import trainhealth
+
         return (module._optimizer is not self._opt
                 or _hp_signature(module._optimizer) != self._hp_sig
                 or env_flag("MXNET_NANCHECK") != self._nancheck
+                or trainhealth.enabled() != self._health_env
+                or (getattr(module, "_stat_monitor", None) is not None)
+                != self._monitor_attached
                 or (module._mesh is not None
                     and fused_zero_enabled() != self._zero)
                 # a re-bind whose executor snapshotted a different
@@ -407,11 +453,45 @@ class FusedStepper:
         self._pending_flag = None
         if not bool(flag):
             telemetry.note_nonfinite("fused")
+            # black box first (ISSUE 12 satellite): the raise below ends
+            # the run, so the flight recorder dumps NOW — step timeline
+            # plus the last trainhealth rows, when either plane is live
+            telemetry.trainhealth.note_nonfinite_trip("fused", stepno)
             raise MXNetError(
                 "MXNET_NANCHECK: non-finite loss/gradient in fused train "
                 "step %d (detected before step %d: the flag is folded into "
                 "the fused dispatch and read one step later to avoid a "
                 "per-step sync)" % (stepno, stepno + 1))
+
+    # -- trainhealth surfaces (ISSUE 12) -------------------------------------
+    def pop_health(self):
+        """(step number, device stats pytree) of the last dispatched step,
+        or None — consumed by ``telemetry.trainhealth.HealthPlane.drain``
+        (one drain per step; a second pop returns None)."""
+        h, self._last_health = self._last_health, None
+        return h
+
+    def feed_monitor(self, mon):
+        """Feed an activated in-graph :class:`~mxnet_tpu.monitor.Monitor`
+        the last step's stats as ``(name, value)`` rows —
+        ``<group>:grad_norm`` / ``:param_norm`` / ``:update_ratio`` plus
+        ``global:grad_norm`` and ``loss`` — pattern-filtered by the
+        monitor itself.  Reads device scalars (a sync), but only on
+        monitor-activated interval batches."""
+        h = self._last_health
+        if h is None or self._health_groups is None:
+            return
+        _stepno, stats = h
+        gn = np.asarray(stats["grad_norm"])
+        pn = np.asarray(stats["param_norm"])
+        ur = np.asarray(stats["update_ratio"])
+        for i, (group, _idxs) in enumerate(self._health_groups):
+            mon.observe("%s:grad_norm" % group, gn[i])
+            mon.observe("%s:param_norm" % group, pn[i])
+            mon.observe("%s:update_ratio" % group, ur[i])
+        mon.observe("global:grad_norm",
+                    np.asarray(stats["global_grad_norm"]))
+        mon.observe("loss", np.asarray(stats["loss"]))
 
     def run(self, module):
         """Dispatch ONE fused step over the feed already staged in the
@@ -476,13 +556,16 @@ class FusedStepper:
         out = self._step(
             diff_vals, grads_in, leaves, aux_vals, const_vals, key,
             np.asarray(lrs, np.float32), np.asarray(wds, np.float32))
+        new_params, new_state, new_aux, heads, grads = out[:5]
+        extra = list(out[5:])
+        self._nsteps += 1
         if self._nancheck:
-            new_params, new_state, new_aux, heads, grads, finite = out
-            self._nsteps += 1
-            self._pending_flag = (finite, self._nsteps)
-        else:
-            new_params, new_state, new_aux, heads, grads = out
-            self._nsteps += 1
+            self._pending_flag = (extra.pop(0), self._nsteps)
+        if self._health_groups is not None:
+            # device arrays, NOT read here (that would add the per-step
+            # sync the in-graph fold avoids): the fit loop drains them
+            # after its metric read has already synced this dispatch
+            self._last_health = (self._nsteps, extra.pop(0))
         for n, v in zip(self._diff_names, new_params):
             exec_.arg_dict[n]._rebind(v)
         for n, g in zip(self._diff_names, grads):

@@ -88,6 +88,10 @@ class Module(BaseModule):
         # stepper and the staged-batch flag forward_backward hands update()
         self._fused = None
         self._fused_pending = False
+        # in-graph monitor (ISSUE 12): a pattern-filtered Monitor routed
+        # onto the fused step's trainhealth stats instead of the un-jitted
+        # executor callback (install_monitor decides the route)
+        self._stat_monitor = None
         self._nan_step = 0  # MXNET_NANCHECK legacy-path step counter
         # prefetch state (ISSUE 5): (batch_obj, feed) pre-staged by
         # prepare() so the next batch's (sharded) device_put overlaps the
@@ -460,6 +464,14 @@ class Module(BaseModule):
                 self._stage_batch(data_batch)
             self._fused_pending = True
             return
+        if self._stat_monitor is not None and self._exec._monitor is None:
+            # the fused path can't take this Module's steps, so the
+            # in-graph monitor route would observe NOTHING — fall back to
+            # the pre-ISSUE-12 un-jitted executor callback (full node
+            # observation at legacy speed; sticky, like a monitor always
+            # was before the in-graph route existed)
+            mon, self._stat_monitor = self._stat_monitor, None
+            mon.install(self._exec)
         # the legacy step's own forward/backward dispatches are counted at
         # the Executor dispatch sites, the optimizer storm in model.py
         telemetry.note_fused_fallback(reason)
@@ -495,6 +507,11 @@ class Module(BaseModule):
                 if self._fused is None:
                     self._fused = FusedStepper(self)
                 self._fused.run(self)
+            if self._stat_monitor is not None \
+                    and getattr(self._stat_monitor, "activated", False):
+                # in-graph monitor route (install_monitor): feed this
+                # step's stats rows, pattern-filtered by the monitor
+                self._fused.feed_monitor(self._stat_monitor)
             telemetry.note_train_step(span_kw["path"])
             telemetry.note_dispatch(1, path=span_kw["path"])
             return
@@ -532,6 +549,8 @@ class Module(BaseModule):
                 bad.append("grad:%s" % n)
         if bad:
             telemetry.note_nonfinite("legacy")
+            telemetry.trainhealth.note_nonfinite_trip(
+                "legacy", self._nan_step, detail=", ".join(bad[:8]))
             raise MXNetError(
                 "MXNET_NANCHECK: non-finite values at train step %d: %s"
                 % (self._nan_step, ", ".join(bad[:8])))
@@ -549,6 +568,18 @@ class Module(BaseModule):
     def update_metric(self, eval_metric, labels):
         eval_metric.update(labels, self.get_outputs())
 
+    def trainer_stats(self):
+        """The PROCESS's last drained trainhealth row (host floats:
+        global/per-group grad norms, update ratios, non-finite census) or
+        None — ``MXNET_TRAINHEALTH`` off, or nothing drained yet.  The
+        health plane is one per process, like the flight recorder: with
+        several Modules training in one process this returns whichever
+        drained last.  The same block is mirrored on the ops server's
+        ``/statusz`` (docs/OBSERVABILITY.md "Training health")."""
+        from ..telemetry import trainhealth
+
+        return trainhealth.trainer_stats()
+
     def get_states(self, merge_multi_context=True):
         assert self.binded
         return [self._exec.arg_dict[n] for n in self._state_names]
@@ -563,9 +594,38 @@ class Module(BaseModule):
                 self._exec.arg_dict[n][:] = value
 
     def install_monitor(self, mon):
+        """Attach a :class:`~mxnet_tpu.monitor.Monitor` (ISSUE 12 routing).
+
+        ``monitor_all=False`` (default) rides the **fused step**: the
+        monitor observes the in-graph trainhealth stats — per-group
+        grad/param norms and update ratios, pattern-filtered by its regex
+        — and training keeps its one-donated-dispatch step.
+        ``monitor_all=True`` is the escape hatch: the executor's un-jitted
+        per-node callback (every node output + inputs), which forces the
+        legacy path — full observability at legacy speed (the reference
+        semantics, and the only route that sees intermediate tensors).
+        A monitor is never silently blind: one whose pattern matches NO
+        in-graph stat row (it targets tensor names like ``fc1_weight``)
+        takes the un-jitted route directly, and a Module whose steps turn
+        out fused-INELIGIBLE for another reason (optimizer, grad_req,
+        kvstore, ...) re-routes at its first legacy ``forward_backward``."""
         assert self.binded
-        self._flush_pending()  # a monitor makes future steps legacy-path
-        mon.install(self._exec)
+        from ..telemetry import trainhealth
+        from .fused_step import fused_enabled
+
+        matcher = getattr(mon, "re_prog", None)
+        matches_stats = matcher is None or any(
+            matcher.match(n)
+            for n in trainhealth.monitor_row_names(self._param_names))
+        if getattr(mon, "monitor_all", False) or not fused_enabled() \
+                or not matches_stats:
+            self._flush_pending()  # a monitor makes future steps legacy
+            self._stat_monitor = None
+            mon.install(self._exec)
+            return
+        # in-graph route: the stepper rebuilds with health stats on its
+        # next update() (stale() keys on monitor attachment)
+        self._stat_monitor = mon
 
     # -- checkpointing ----------------------------------------------------------
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):

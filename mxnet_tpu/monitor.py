@@ -1,10 +1,21 @@
 """Monitor — per-tensor training statistics (reference ``python/mxnet/monitor.py:33``).
 
-Hooks the executor's monitor callback (reference
-``include/mxnet/executor.h:172``, ``GraphExecutor::ExecuteMonCallback``
-graph_executor.cc:1562; here ``Executor.set_monitor_callback``, which runs
-forward un-jitted so every node output is observable) and collects a chosen
-statistic over outputs whose names match a regex.
+Two observation routes (ISSUE 12):
+
+* **In-graph (default on a fused-step Module).**  ``Module.install_monitor``
+  with ``monitor_all=False`` keeps the one-donated-dispatch fused step and
+  feeds the monitor the trainhealth stats computed *inside* the jit —
+  ``<group>:grad_norm`` / ``:param_norm`` / ``:update_ratio`` rows plus
+  ``global:grad_norm`` and ``loss``, pattern-filtered by the monitor's
+  regex.  Before this route, installing a monitor silently forced the whole
+  training run onto the legacy un-jitted path.
+* **Un-jitted executor callback (``monitor_all=True``, the escape hatch).**
+  Hooks the executor's monitor callback (reference
+  ``include/mxnet/executor.h:172``, ``GraphExecutor::ExecuteMonCallback``
+  graph_executor.cc:1562; here ``Executor.set_monitor_callback``, which runs
+  forward un-jitted so EVERY node output — and with ``monitor_all`` every
+  node input — is observable).  Forces the legacy path: full observability
+  at legacy speed.
 
 Typical use::
 
@@ -57,11 +68,20 @@ class Monitor:
         self.queue.append((self.step, name, self.stat_func(arr)))
 
     def install(self, exe, monitor_all=None):
-        """Attach to an executor (reference Monitor.install)."""
+        """Attach to an executor (reference Monitor.install) — the
+        un-jitted per-node route; ``Module.install_monitor`` prefers the
+        in-graph route for fused-step modules (module docstring)."""
         if monitor_all is None:
             monitor_all = self.monitor_all
         exe.set_monitor_callback(self._stat_helper, monitor_all)
         self.exes.append(exe)
+
+    def observe(self, name, value):
+        """Feed one (name, value) row from outside an executor callback —
+        the in-graph route (``FusedStepper.feed_monitor``) delivers the
+        fused step's trainhealth stats here.  Same interval/pattern/stat
+        discipline as the executor callback."""
+        self._stat_helper(name, value)
 
     def tic(self):
         """Start collecting for this batch if the interval has elapsed."""

@@ -96,6 +96,38 @@ def is_coordinator():
     return rank() == 0
 
 
+def kv_prefix_ranks(client, prefix, size):
+    """{rank: value string} for every ``<prefix><rank>`` key published in
+    the coordination-service KV store — ONE ``key_value_dir_get`` (carried
+    by every jaxlib 0.4+ client), falling back to per-rank
+    ``key_value_try_get`` (which only newer clients have; the pinned
+    0.4.37 does NOT — discovered in ISSUE 12, where the try_get-only scan
+    made the dead-node check misreport every rank as dead).  The ONE
+    implementation behind both :func:`barrier`'s arrival marks and the
+    trainhealth heartbeat exchange; every failure degrades to
+    absent-key."""
+    out = {}
+    try:
+        pairs = client.key_value_dir_get(prefix)
+    except Exception:
+        pairs = None
+    if pairs is not None:
+        for k, v in pairs:
+            try:
+                out[int(str(k).rsplit("/", 1)[-1])] = str(v)
+            except ValueError:
+                pass
+        return out
+    for r in range(size):
+        try:
+            v = client.key_value_try_get(prefix + str(r))
+        except Exception:
+            v = None
+        if v:
+            out[r] = str(v)
+    return out
+
+
 _barrier_seq = 0
 
 
@@ -142,14 +174,13 @@ def barrier(name="mxnet_barrier", timeout_ms=None):
     try:
         client.wait_at_barrier("%s_%d" % (name, _barrier_seq), int(timeout_ms))
     except Exception as exc:
-        missing = []
-        for r in range(jax.process_count()):
-            try:
-                v = client.key_value_try_get("%s/%d" % (mark, r))
-            except Exception:
-                v = None
-            if not v:
-                missing.append(r)
+        # who never arrived?  One shared KV prefix scan over the
+        # arrival marks (kv_prefix_ranks — the ISSUE 12 fix: the old
+        # try_get-only loop misreported EVERY rank as dead on clients
+        # without that method, e.g. the pinned jaxlib 0.4.37)
+        arrived = kv_prefix_ranks(client, mark + "/", jax.process_count())
+        missing = [r for r in range(jax.process_count())
+                   if r not in arrived]
         if missing:
             raise DeadNodeError(name, missing, timeout_ms) from exc
         raise

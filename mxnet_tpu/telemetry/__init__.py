@@ -17,6 +17,7 @@ from . import tracing
 from .registry import (Counter, Gauge, Histogram, MetricError, Registry,
                        DEFAULT_BUCKETS)
 from . import flightrec, ops_server, slo  # live ops plane (ISSUE 10)
+from . import trainhealth  # training health plane (ISSUE 12)
 from .sinks import (JsonlSink, PrometheusSink, ProfilerSink, Sink,
                     TensorBoardSink, iter_scalar_samples, render_prometheus)
 from .instrument import (ServeProbe, StepProbe, add_sink, array_nbytes,
@@ -32,7 +33,7 @@ from .instrument import (ServeProbe, StepProbe, add_sink, array_nbytes,
                          summary)
 
 __all__ = [
-    "tracing", "flightrec", "ops_server", "slo",
+    "tracing", "flightrec", "ops_server", "slo", "trainhealth",
     "Counter", "Gauge", "Histogram", "MetricError", "Registry",
     "DEFAULT_BUCKETS",
     "Sink", "JsonlSink", "PrometheusSink", "ProfilerSink", "TensorBoardSink",

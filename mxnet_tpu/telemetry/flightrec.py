@@ -4,8 +4,11 @@
 An always-cheap bounded ring of recent request-lifecycle and step events:
 one dict append per event, fixed memory (``deque(maxlen=...)``), no file
 I/O until something goes wrong.  On a trigger — a batch model error, an
-SLO breach (``telemetry/slo.py`` ``on_breach``), an explicit :meth:`dump`,
-or ``SIGUSR2`` — the ring is written to ``$MXNET_FLIGHTREC_DIR`` as
+SLO breach (``telemetry/slo.py`` ``on_breach``), a training divergence
+(``telemetry/trainhealth.py``: a non-finite parameter group, or an
+``MXNET_NANCHECK`` trip about to raise — ISSUE 12), an explicit
+:meth:`dump`, or ``SIGUSR2`` — the ring is written to
+``$MXNET_FLIGHTREC_DIR`` as
 Chrome-trace JSON: events reuse the tracing span record shape
 (``telemetry/tracing.py`` export — ``ph:"X"`` with ``ts``/``dur`` in the
 shared ``mx.profiler`` perf_counter microsecond timebase, ``ph:"i"`` for
@@ -41,6 +44,23 @@ _PID = 0                 # chrome-trace process id (matches tracing export)
 
 def enabled():
     return bool(os.environ.get("MXNET_FLIGHTREC_DIR", "").strip())
+
+
+def _process_rank():
+    """jax.distributed rank for multi-process runs, else None.  Consulted
+    only at dump time (rare) and only when jax is already loaded — a
+    process that never touched jax must not initialize a backend to write
+    a crash dump."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return None
+    try:
+        import jax
+
+        return jax.process_index() if jax.process_count() > 1 else None
+    except Exception:
+        return None
 
 
 def flightrec_dir():
@@ -107,18 +127,28 @@ class FlightRecorder:
                 self._last_auto[reason] = now
             self._seq += 1
             seq = self._seq
+        rank = _process_rank()
+        pname = "mxnet_tpu flight recorder" if rank is None \
+            else "mxnet_tpu flight recorder (rank %d)" % rank
+        clock_args = {"unix_ts": round(time.time(), 6),
+                      "trace_ts_us": round(_now_us(), 3)}
+        if rank is not None:
+            # rank rides the clock_sync args so tools/trace_merge.py can
+            # merge per-rank dumps onto rank-labeled tracks (ISSUE 12)
+            clock_args["rank"] = rank
         payload = {
             "traceEvents": [
                 {"name": "process_name", "ph": "M", "pid": _PID,
-                 "args": {"name": "mxnet_tpu flight recorder"}},
+                 "args": {"name": pname}},
                 {"name": "clock_sync", "ph": "M", "pid": _PID,
-                 "args": {"unix_ts": round(time.time(), 6),
-                          "trace_ts_us": round(_now_us(), 3)}},
+                 "args": clock_args},
             ] + evs,
             "displayTimeUnit": "ms",
             "flightrec": dict(meta, reason=str(reason), pid=os.getpid(),
                               unix_ts=round(time.time(), 6),
-                              events=len(evs)),
+                              events=len(evs),
+                              **({"rank": rank} if rank is not None
+                                 else {})),
         }
         path = os.path.join(
             self.directory,

@@ -622,6 +622,11 @@ def summary():
     # serve_latency_seconds histogram — null when no serving ran
     sp50 = r.hist_quantile("serve_latency_seconds", 0.50, None)
     sp99 = r.hist_quantile("serve_latency_seconds", 0.99, None)
+    # trainhealth surface (ISSUE 12): host seconds the health plane's
+    # per-step drain cost this process — THE health-overhead number (the
+    # in-graph reductions themselves ride the fused dispatch for free);
+    # null when no drain ran (gate off, or no fused training)
+    th_s = r.total("trainhealth_drain_seconds_total", None)
     # static-analysis surface (ISSUE 11): diagnostics the analyzer manager
     # recorded this process (all analyzers, all severities) — null when
     # nothing was recorded (no check()/warmup ran, or it all came back
@@ -642,4 +647,6 @@ def summary():
             "serve_p99_ms": round(sp99 * 1e3, 3) if sp99 is not None
             else None,
             "analysis_findings": int(findings) if findings is not None
+            else None,
+            "trainhealth_drain_s": round(th_s, 4) if th_s is not None
             else None}

@@ -19,7 +19,9 @@ Endpoints:
   ``MXNET_OPS_STALE_S`` (default 5 s; a legitimate forward longer than
   this will flap health — raise the threshold for huge direct batches).
 * ``/statusz``  — JSON: per-engine ``Engine.stats()`` (SLO + warmup +
-  bucket_stats blocks included), health detail, and process metadata.
+  bucket_stats blocks included), health detail, the training-health block
+  (``trainhealth.status()`` — last drained row + per-rank heartbeats,
+  None when ``MXNET_TRAINHEALTH`` is off), and process metadata.
 
 Engines self-register at construction and unregister at ``close()``;
 registration holds only a weak reference, so a dropped engine never stays
@@ -228,7 +230,7 @@ def _health():
 
 
 def _statusz():
-    from . import instrument
+    from . import instrument, trainhealth
 
     engines = {}
     for e in _live_engines():
@@ -242,9 +244,15 @@ def _statusz():
         except Exception as ex:
             engines[label] = {"error": repr(ex)}
     ok, health = _health()
+    try:
+        # trainer_stats() mirror (ISSUE 12): last health row + per-rank
+        # heartbeat view; None when MXNET_TRAINHEALTH is off
+        th = trainhealth.status()
+    except Exception as ex:
+        th = {"error": repr(ex)}
     return {"pid": os.getpid(), "unix_ts": round(time.time(), 6),
             "telemetry_enabled": instrument.enabled(),
-            "health": health, "engines": engines}
+            "health": health, "engines": engines, "trainhealth": th}
 
 
 # -- handler ------------------------------------------------------------------

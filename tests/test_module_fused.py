@@ -129,12 +129,16 @@ def test_fallback_env_disabled(monkeypatch):
     _assert_legacy_step(mod, _batches(1)[0])
 
 
-def test_fallback_monitor(monkeypatch):
+def test_fallback_monitor_all(monkeypatch):
+    """monitor_all=True is the un-jitted escape hatch (ISSUE 12): the
+    executor callback observes every node, forcing the legacy path.  A
+    default pattern-filtered Monitor now rides the fused step instead
+    (tests/test_trainhealth.py::test_monitor_rides_fused_step)."""
     monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
     mod = _make_module()
     mod.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 0.1})
     mod.install_monitor(mx.monitor.Monitor(1, stat_func=lambda x: x,
-                                           pattern=".*"))
+                                           pattern=".*", monitor_all=True))
     assert fused_step.fused_ineligible_reason(mod) == "monitor"
     _assert_legacy_step(mod, _batches(1)[0])
 

@@ -64,3 +64,147 @@ def test_diagnose_runs():
     assert res.returncode == 0, res.stderr[-500:]
     assert "Framework Info" in res.stdout
     assert "jax" in res.stdout
+
+
+# -- per-rank trace merging (ISSUE 12) ---------------------------------------
+def _rank_trace(tmp_path, rank, name, via):
+    """A tiny chrome trace carrying its rank via clock_sync args, event
+    args, or only the filename."""
+    import json
+
+    events = [{"name": "clock_sync", "ph": "M", "pid": 0,
+               "args": {"unix_ts": 1000.0 + rank, "trace_ts_us": 0.0}},
+              {"name": name, "ph": "X", "ts": 10.0, "dur": 5.0, "pid": 0,
+               "tid": 1, "args": {}}]
+    if via == "clock_sync":
+        events[0]["args"]["rank"] = rank
+        fname = "trace-%s.json" % name
+    elif via == "args":
+        events[1]["args"]["rank"] = rank
+        fname = "trace-%s.json" % name
+    else:  # filename only
+        fname = "trace-rank%d-%s.json" % (rank, name)
+    path = tmp_path / fname
+    path.write_text(json.dumps({"traceEvents": events}))
+    return str(path)
+
+
+def test_trace_merge_merges_on_rank_label(tmp_path):
+    """Per-rank files land on rank-labeled pid namespaces: two files of
+    the SAME rank share one track group, different ranks get their own,
+    and every non-meta event gains the queryable args.rank."""
+    import json
+
+    tm = _load("trace_merge.py")
+    out = str(tmp_path / "merged.json")
+    f0a = _rank_trace(tmp_path, 0, "step_a", "clock_sync")
+    f0b = _rank_trace(tmp_path, 0, "step_b", "args")
+    f1 = _rank_trace(tmp_path, 1, "step_c", "filename")
+    assert tm.main([f0a, f0b, f1, "-o", out]) == 0
+    merged = json.load(open(out))["traceEvents"]
+    slices = {ev["name"]: ev for ev in merged if ev.get("ph") == "X"}
+    # same rank -> same pid namespace; different rank -> different
+    assert slices["step_a"]["pid"] == slices["step_b"]["pid"]
+    assert slices["step_c"]["pid"] != slices["step_a"]["pid"]
+    assert slices["step_a"]["args"]["rank"] == 0
+    assert slices["step_c"]["args"]["rank"] == 1
+    labels = {ev["pid"]: ev["args"]["name"] for ev in merged
+              if ev.get("ph") == "M" and ev.get("name") == "process_name"}
+    assert labels[slices["step_a"]["pid"]] == "rank 0"
+    assert labels[slices["step_c"]["pid"]] == "rank 1"
+
+
+def test_trace_merge_mixed_rank_file_keeps_own_namespace(tmp_path):
+    """A file carrying SEVERAL event ranks (e.g. a previous merge output
+    fed back in) has no single file rank — it must keep its own pid
+    namespace instead of collapsing every rank into the first one."""
+    import json
+
+    tm = _load("trace_merge.py")
+    events = [{"name": "a", "ph": "X", "ts": 1.0, "dur": 1.0, "pid": 0,
+               "tid": 1, "args": {"rank": 0}},
+              {"name": "b", "ph": "X", "ts": 2.0, "dur": 1.0, "pid": 1,
+               "tid": 1, "args": {"rank": 1}}]
+    mixed = tmp_path / "remerged.json"
+    mixed.write_text(json.dumps({"traceEvents": events}))
+    assert tm.file_rank(str(mixed), events) is None
+    # mixed clock_sync records (two flightrec dumps merged) are equally
+    # rank-less — the first clock_sync must not claim the file
+    syncs = [{"name": "clock_sync", "ph": "M", "pid": 0,
+              "args": {"unix_ts": 1.0, "trace_ts_us": 0.0, "rank": r}}
+             for r in (0, 1)]
+    assert tm.file_rank("remerged2.json", syncs + events) is None
+    f1 = _rank_trace(tmp_path, 1, "step_c", "clock_sync")
+    out = str(tmp_path / "m.json")
+    assert tm.main([str(mixed), f1, "-o", out]) == 0
+    merged = json.load(open(out))["traceEvents"]
+    by_name = {ev["name"]: ev for ev in merged if ev.get("ph") == "X"}
+    # the mixed file's ranks keep their original (namespaced) pids and
+    # were NOT folded into rank 1's track group
+    assert by_name["a"]["args"]["rank"] == 0
+    assert by_name["b"]["args"]["rank"] == 1
+    assert by_name["step_c"]["pid"] not in (by_name["a"]["pid"],
+                                            by_name["b"]["pid"])
+
+
+def test_trace_merge_labels_every_pid_track(tmp_path):
+    """Profiler-style dumps use one pid per domain — the rank label must
+    land on EVERY pid track the file contributes, without overriding an
+    embedded process_name."""
+    import json
+
+    tm = _load("trace_merge.py")
+    events = [{"name": "process_name", "ph": "M", "pid": 2,
+               "args": {"name": "my domain"}},
+              {"name": "a", "ph": "X", "ts": 1.0, "dur": 1.0, "pid": 0,
+               "tid": 1, "args": {}},
+              {"name": "b", "ph": "X", "ts": 2.0, "dur": 1.0, "pid": 2,
+               "tid": 1, "args": {}}]
+    f = tmp_path / "trace-rank3-prof.json"
+    f.write_text(json.dumps({"traceEvents": events}))
+    out = str(tmp_path / "m.json")
+    assert tm.main([str(f), "-o", out]) == 0
+    merged = json.load(open(out))["traceEvents"]
+    labels = {}
+    for ev in merged:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            labels.setdefault(ev["pid"], ev["args"]["name"])
+    by_name = {ev["name"]: ev for ev in merged if ev.get("ph") == "X"}
+    assert labels[by_name["a"]["pid"]] == "rank 3"
+    assert labels[by_name["b"]["pid"]] == "my domain"  # not overridden
+
+
+def test_trace_merge_explicit_rank_flag(tmp_path):
+    import json
+
+    tm = _load("trace_merge.py")
+    out = str(tmp_path / "merged.json")
+    # file with a stale EMBEDDED per-event rank: --rank must override it
+    # everywhere — track label and event args agree
+    f = _rank_trace(tmp_path, 0, "step_x", "args")
+    assert tm.main([f, "-o", out, "--rank", "3"]) == 0
+    merged = json.load(open(out))["traceEvents"]
+    sl = [ev for ev in merged if ev.get("ph") == "X"][0]
+    assert sl["args"]["rank"] == 3
+    labels = [ev["args"]["name"] for ev in merged
+              if ev.get("ph") == "M" and ev.get("name") == "process_name"]
+    assert "rank 3" in labels
+
+
+def test_trace_summary_accepts_per_rank_files(tmp_path, capsys):
+    ts = _load("trace_summary.py")
+    f0 = _rank_trace(tmp_path, 0, "op_shared", "clock_sync")
+    f1 = _rank_trace(tmp_path, 1, "op_shared", "filename")
+    # merged accounting: one row with both ranks' calls
+    assert ts.main([f0, f1]) == 0
+    out = capsys.readouterr().out
+    assert "ranks 0,1 over 2 file(s)" in out
+    import re
+
+    row = [l for l in out.splitlines() if l.startswith("op_shared")]
+    assert row and re.search(r"\s2\s", row[0]), row  # 2 calls merged
+    # --per-rank keeps them apart
+    assert ts.main([f0, f1, "--per-rank"]) == 0
+    out = capsys.readouterr().out
+    assert any(l.startswith("r0/op_shared") for l in out.splitlines())
+    assert any(l.startswith("r1/op_shared") for l in out.splitlines())

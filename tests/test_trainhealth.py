@@ -1,0 +1,489 @@
+"""Training health plane (ISSUE 12, telemetry/trainhealth.py).
+
+Coverage demanded by the issue:
+- no-op guard: gate off ⇒ no staged stats, no plane, fused jit key and
+  output structure byte-identical (the key gains a marker ONLY when on);
+- healthy steps report real numbers: the drained global grad norm matches
+  a numpy recomputation from the executor's own grad buffers, per-group
+  norms/ratios are finite and positive — on the single-device AND the
+  mesh fused step;
+- a seeded-NaN divergence produces a census blaming the right verdict
+  class, fires ``precision_verdict_violations_total`` for blessed classes,
+  and dumps the flight recorder naming the first non-finite group;
+- ``MXNET_NANCHECK`` trips also dump (the satellite wiring);
+- ``Monitor`` routes onto the in-graph stats on a fused Module
+  (pattern-filtered), with ``monitor_all=True`` as the un-jitted legacy
+  escape hatch;
+- a 2-process launch (slow tier) shows rank-tagged samples and a live
+  straggler gauge on rank 0.
+"""
+import glob
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import module as mod_mod
+from mxnet_tpu.io import DataBatch
+from mxnet_tpu.module import fused_step
+from mxnet_tpu.telemetry import flightrec, trainhealth
+from mxnet_tpu.telemetry import instrument as tin
+
+BATCH = 8
+DIM = 8
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+LAUNCH = os.path.join(REPO, "tools", "launch.py")
+
+
+@pytest.fixture
+def th_env(monkeypatch, tmp_path):
+    """MXNET_TRAINHEALTH + telemetry on, fresh global state, cleanup."""
+    monkeypatch.setenv("MXNET_TRAINHEALTH", "1")
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    monkeypatch.setenv("MXNET_TELEMETRY_FILE", str(tmp_path / "t.jsonl"))
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    tin._reset_for_tests()
+    trainhealth._reset_for_tests()
+    flightrec._reset_for_tests()
+    yield tmp_path
+    tin._reset_for_tests()
+    trainhealth._reset_for_tests()
+    flightrec._reset_for_tests()
+
+
+def _sym():
+    data = mx.sym.var("data")
+    x = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+    x = mx.sym.Activation(x, name="relu1", act_type="relu")
+    return mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(x, name="fc2", num_hidden=4), name="softmax")
+
+
+def _module(batch=BATCH, mesh=None):
+    mod = mod_mod.Module(_sym(), mesh=mesh)
+    mod.bind(data_shapes=[("data", (batch, DIM))],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    return mod
+
+
+def _batch(rng, batch=BATCH, nan=False):
+    x = rng.randn(batch, DIM).astype(np.float32)
+    if nan:
+        x[0, 0] = np.nan
+    return DataBatch(
+        data=[mx.nd.array(x)],
+        label=[mx.nd.array(rng.randint(0, 4, (batch,)).astype(np.float32))])
+
+
+def _step(mod, rng, nan=False, batch=BATCH):
+    mod.forward_backward(_batch(rng, batch=batch, nan=nan))
+    mod.update()
+
+
+# -- no-op guard --------------------------------------------------------------
+def test_noop_guard_trainhealth(monkeypatch, tmp_path):
+    """Gate off: no stats staged, no plane, no registry series — and the
+    AOT key is byte-identical to pre-trainhealth entries (the marker is
+    APPENDED only when on, never a present-but-false flag)."""
+    monkeypatch.delenv("MXNET_TRAINHEALTH", raising=False)
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    monkeypatch.setenv("MXNET_AOT_CACHE", str(tmp_path / "aot"))
+    trainhealth._reset_for_tests()
+    rng = np.random.RandomState(0)
+    mod = _module()
+    _step(mod, rng)
+    assert mod._fused is not None
+    assert mod._fused._health_groups is None
+    assert mod._fused._last_health is None
+    assert mod._fused.pop_health() is None
+    assert trainhealth.plane() is None
+    assert trainhealth.status() is None
+    assert mod.trainer_stats() is None
+    key_off = mod._fused._aot_key
+    assert key_off is not None and "trainhealth" not in key_off
+    # flip the gate: the stepper is stale, rebuilds, and the key gains
+    # exactly the appended marker
+    monkeypatch.setenv("MXNET_TRAINHEALTH", "1")
+    assert mod._fused.stale(mod)
+    _step(mod, rng)
+    key_on = mod._fused._aot_key
+    assert key_on == key_off + ("trainhealth",)
+    assert mod._fused._health_groups is not None
+    trainhealth._reset_for_tests()
+
+
+# -- healthy-step stats -------------------------------------------------------
+def test_stats_match_executor_grads(th_env):
+    rng = np.random.RandomState(0)
+    mod = _module()
+    for i in range(2):
+        _step(mod, rng)
+        row = trainhealth.plane().drain(mod, epoch=0, step=i)
+    assert row is not None and row["step"] == 2
+    assert row["heads_finite"] and not row["nonfinite_groups"]
+    # the drained global grad norm equals numpy over the executor's own
+    # grad buffers (same dispatch, same values)
+    tot, per_group = 0.0, {}
+    for n in mod._param_names:
+        g = mod._exec.grad_dict[n].asnumpy().astype(np.float64)
+        sq = float((g ** 2).sum())
+        tot += sq
+        group = n.rsplit("_", 1)[0]
+        per_group[group] = per_group.get(group, 0.0) + sq
+    assert np.isclose(row["global_grad_norm"], np.sqrt(tot), rtol=1e-4)
+    assert set(row["groups"]) == set(per_group)
+    for g, s in row["groups"].items():
+        assert np.isclose(s["grad_norm"], np.sqrt(per_group[g]), rtol=1e-4)
+        assert s["param_norm"] > 0 and np.isfinite(s["update_ratio"])
+        # FC-consumed params carry the PR 11 REDUCE verdict
+        assert s["verdict"] == "fp32_accum"
+    # a second drain of the same step returns nothing (stats are popped)
+    assert trainhealth.plane().drain(mod) is None
+    assert mod.trainer_stats()["step"] == 2
+
+
+@pytest.mark.skipif(
+    os.environ.get("MXNET_TEST_DEVICE", "").startswith(("tpu", "gpu")),
+    reason="virtual 8-dev mesh is a CPU-tier fixture")
+def test_stats_on_mesh_fused_step(th_env):
+    from mxnet_tpu import parallel
+
+    rng = np.random.RandomState(0)
+    mesh = parallel.make_mesh({"dp": 8})
+    mod = _module(batch=16, mesh=mesh)
+    for i in range(2):
+        _step(mod, rng, batch=16)
+        row = trainhealth.plane().drain(mod, step=i)
+    assert mod._fused is not None and mod._fused.mesh is not None
+    assert row is not None and row["global_grad_norm"] > 0
+    assert row["heads_finite"] and not row["nonfinite_groups"]
+    assert set(row["groups"]) == {"fc1", "fc2"}
+
+
+# -- divergence: census, violations, dump -------------------------------------
+def test_census_blames_verdict_class(th_env):
+    rng = np.random.RandomState(0)
+    mod = _module()
+    _step(mod, rng)
+    trainhealth.plane().drain(mod, step=0)
+    _step(mod, rng, nan=True)
+    row = trainhealth.plane().drain(mod, step=1)
+    assert row["nonfinite_groups"], "NaN step flagged no group"
+    # the census buckets exactly the non-finite groups by THEIR verdicts
+    expect = {}
+    for g in row["nonfinite_groups"]:
+        v = row["groups"][g]["verdict"]
+        expect[v] = expect.get(v, 0) + 1
+    assert row["nonfinite_census"] == expect
+    # FC params are fp32_accum (blessed) — the contradiction counter fires
+    r = tin.registry()
+    pvv = r.get("precision_verdict_violations_total")
+    assert pvv is not None
+    assert pvv.value(verdict="fp32_accum", rank="0") >= 1
+    assert r.get("trainhealth_nonfinite_total").value(
+        verdict="fp32_accum", rank="0") >= 1
+
+
+def test_divergence_dumps_flightrec(th_env, monkeypatch, tmp_path):
+    monkeypatch.setenv("MXNET_FLIGHTREC_DIR", str(tmp_path / "frec"))
+    flightrec._reset_for_tests()
+    rng = np.random.RandomState(0)
+    mod = _module()
+    _step(mod, rng)
+    trainhealth.plane().drain(mod, step=0)
+    _step(mod, rng, nan=True)
+    row = trainhealth.plane().drain(mod, step=1)
+    dumps = glob.glob(str(tmp_path / "frec" / "flightrec-*-trainhealth.json"))
+    assert dumps, "divergence wrote no dump"
+    raw = open(dumps[0]).read()
+    # STRICT JSON even though the payload describes non-finite values:
+    # python's encoder would emit bare NaN/Infinity tokens that Perfetto's
+    # JSON.parse import rejects — _safe() nulls them instead
+    payload = json.loads(raw, parse_constant=lambda c: pytest.fail(
+        "dump carries non-strict JSON token %r" % c))
+    meta = payload["flightrec"]
+    # the dump NAMES the first offending group and carries health rows
+    assert meta["group"] == row["nonfinite_groups"][0]
+    assert meta["verdict"] == row["groups"][meta["group"]]["verdict"]
+    assert len(meta["health_rows"]) >= 2  # the healthy row rode along
+    assert any(ev.get("name") == "trainhealth"
+               for ev in payload["traceEvents"])
+
+
+def test_nancheck_trip_dumps_flightrec(th_env, monkeypatch, tmp_path):
+    """The MXNET_NANCHECK raise is preceded by a flight-recorder dump
+    carrying the recent health rows (ISSUE 12 satellite)."""
+    monkeypatch.setenv("MXNET_NANCHECK", "1")
+    monkeypatch.setenv("MXNET_FLIGHTREC_DIR", str(tmp_path / "frec"))
+    flightrec._reset_for_tests()
+    rng = np.random.RandomState(0)
+    mod = _module()
+    _step(mod, rng)
+    trainhealth.plane().drain(mod, step=0)
+    _step(mod, rng, nan=True)
+    trainhealth.plane().drain(mod, step=1)
+    with pytest.raises(mx.base.MXNetError, match="MXNET_NANCHECK"):
+        _step(mod, rng)  # the folded flag is read one step later
+    dumps = glob.glob(str(tmp_path / "frec" / "flightrec-*-nancheck.json"))
+    assert dumps, "nancheck trip wrote no dump"
+    meta = json.load(open(dumps[0]))["flightrec"]
+    assert meta["where"] == "fused"
+    assert meta["health_rows"], "dump carries no health rows"
+
+
+# -- registry / JSONL / statusz surfaces --------------------------------------
+def test_rank_labels_and_jsonl(th_env):
+    rng = np.random.RandomState(0)
+    mod = _module()
+    _step(mod, rng)
+    trainhealth.plane().drain(mod, epoch=0, step=0)
+    r = tin.registry()
+    # every trainhealth sample carries the rank label (0 single-process)
+    for name in ("trainhealth_global_grad_norm", "trainhealth_loss"):
+        samples = r.get(name).samples()
+        assert samples and all(s["labels"]["rank"] == "0" for s in samples)
+    assert r.get("trainhealth_group_grad_norm").value(
+        group="fc1", rank="0") > 0
+    tin.flush()
+    lines = [json.loads(l) for l in
+             open(tin.jsonl_path(), encoding="utf-8")]
+    th_lines = [l for l in lines if l.get("kind") == "trainhealth"]
+    assert th_lines and all(l["rank"] == 0 for l in th_lines)
+    assert "groups" in th_lines[-1] and "fc1" in th_lines[-1]["groups"]
+
+
+def test_statusz_mirrors_trainer_stats(th_env):
+    from mxnet_tpu.telemetry import ops_server
+
+    rng = np.random.RandomState(0)
+    mod = _module()
+    _step(mod, rng)
+    trainhealth.plane().drain(mod, step=0)
+    block = ops_server._statusz()["trainhealth"]
+    assert block is not None
+    assert block["last"]["step"] == mod.trainer_stats()["step"]
+    assert block["rows"] == 1 and block["trips"] == 0
+
+
+# -- Monitor routing (ISSUE 12 satellite) -------------------------------------
+def test_monitor_rides_fused_step(monkeypatch):
+    """A default (pattern-filtered) Monitor no longer forces the legacy
+    path: it observes the in-graph stats and training stays fused."""
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    monkeypatch.delenv("MXNET_TRAINHEALTH", raising=False)
+    rng = np.random.RandomState(0)
+    mod = _module()
+    mon = mx.monitor.Monitor(1, stat_func=lambda x: float(x),
+                             pattern=".*grad_norm")
+    mod.install_monitor(mon)
+    assert mod._stat_monitor is mon
+    assert fused_step.fused_ineligible_reason(mod) is None
+    mon.tic()
+    _step(mod, rng)
+    assert mod._fused is not None, "monitor forced the legacy path"
+    assert mod._fused._health_groups is not None
+    rows = mon.toc()
+    names = [k for _n, k, _v in rows]
+    assert "fc1:grad_norm" in names and "global:grad_norm" in names
+    # the pattern filtered out non-matching stats
+    assert not any("param_norm" in n or n == "loss" for n in names)
+
+
+def test_monitor_all_is_the_unjitted_escape_hatch(monkeypatch):
+    """monitor_all=True keeps the reference semantics: un-jitted executor
+    callback observing every node, legacy path."""
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    rng = np.random.RandomState(0)
+    mod = _module()
+    mon = mx.monitor.Monitor(1, stat_func=lambda x: np.abs(x).mean(),
+                             monitor_all=True)
+    mod.install_monitor(mon)
+    assert mod._stat_monitor is None
+    assert fused_step.fused_ineligible_reason(mod) == "monitor"
+    mon.tic()
+    mod.forward_backward(_batch(rng))
+    assert not mod._fused_pending  # legacy: executed immediately
+    mod.update()
+    rows = mon.toc()
+    # the un-jitted route sees actual NODE outputs (and inputs)
+    assert any("fc1_output" in k for _n, k, _v in rows)
+
+
+def test_monitor_tensor_pattern_takes_unjitted_route(monkeypatch):
+    """A monitor whose pattern targets TENSOR names (matches no in-graph
+    stat row) must keep the pre-ISSUE-12 un-jitted route instead of going
+    silently blind on the fused step."""
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    rng = np.random.RandomState(0)
+    mod = _module()
+    mon = mx.monitor.Monitor(1, stat_func=lambda x: np.abs(x).mean(),
+                             pattern="fc1_weight")
+    mod.install_monitor(mon)
+    # routed straight to the executor: the pattern can only match tensors
+    assert mod._stat_monitor is None and mod._exec._monitor is not None
+    assert fused_step.fused_ineligible_reason(mod) == "monitor"
+    mon.tic()
+    mod.forward_backward(_batch(rng))
+    mod.update()
+    rows = mon.toc()
+    assert rows == [], rows  # outputs-only callback; weights need _all
+    mon2 = mx.monitor.Monitor(1, stat_func=lambda x: np.abs(x).mean(),
+                              pattern="fc1_weight", monitor_all=True)
+    mod2 = _module()
+    mod2.install_monitor(mon2)
+    mon2.tic()
+    mod2.forward_backward(_batch(rng))
+    mod2.update()
+    assert any(k == "fc1_weight" for _n, k, _v in mon2.toc())
+
+
+def test_monitor_on_fused_ineligible_module_falls_back(monkeypatch):
+    """A Module whose steps are fused-ineligible for another reason
+    (unsupported optimizer) must not leave a default monitor blind: the
+    first legacy forward_backward re-routes it onto the un-jitted
+    executor callback (the pre-ISSUE-12 behavior)."""
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    rng = np.random.RandomState(0)
+    mod = mod_mod.Module(_sym())
+    mod.bind(data_shapes=[("data", (BATCH, DIM))],
+             label_shapes=[("softmax_label", (BATCH,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="rmsprop",
+                       optimizer_params={"learning_rate": 0.01})
+    assert fused_step.fused_ineligible_reason(mod) == "optimizer"
+    mon = mx.monitor.Monitor(1, stat_func=lambda x: np.abs(x).mean())
+    mod.install_monitor(mon)
+    assert mod._stat_monitor is mon  # in-graph route chosen at install
+    mon.tic()
+    _step(mod, rng)
+    # re-routed: executor callback installed, in-graph handle cleared
+    assert mod._stat_monitor is None and mod._exec._monitor is not None
+    rows = mon.toc()
+    assert any("fc1_output" in k for _n, k, _v in rows), rows
+
+
+def test_monitor_detach_unstales(monkeypatch):
+    """Attaching the in-graph monitor rebuilds the stepper (output
+    structure changed); gate-off + no monitor rebuilds back."""
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    monkeypatch.delenv("MXNET_TRAINHEALTH", raising=False)
+    rng = np.random.RandomState(0)
+    mod = _module()
+    _step(mod, rng)
+    first = mod._fused
+    assert first._health_groups is None
+    mod.install_monitor(mx.monitor.Monitor(1))
+    assert first.stale(mod)
+    _step(mod, rng)
+    assert mod._fused is not first
+    assert mod._fused._health_groups is not None
+
+
+# -- 2-process pod telemetry (slow tier) --------------------------------------
+WORKER_RANKS = textwrap.dedent("""
+    import os, json, time
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["MXNET_TRAINHEALTH"] = "1"
+    os.environ["MXNET_TRAINHEALTH_HB_S"] = "0"  # publish every drain
+    os.environ["MXNET_TELEMETRY"] = "1"
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import module as mod_mod
+    from mxnet_tpu.io import DataBatch
+    from mxnet_tpu.parallel import dist
+    from mxnet_tpu.telemetry import trainhealth, instrument as tin
+
+    dist.init()
+    r, n = dist.rank(), dist.size()
+    os.environ["MXNET_TELEMETRY_FILE"] = os.environ["TH_DIR"] + \\
+        "/telemetry-rank%d.jsonl" % r
+
+    data = mx.sym.var("data")
+    x = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+    sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(x, name="fc2", num_hidden=4), name="softmax")
+    mod = mod_mod.Module(sym)
+    mod.bind(data_shapes=[("data", (8, 8))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    rng = np.random.RandomState(r)
+
+    def step(i):
+        b = DataBatch(
+            data=[mx.nd.array(rng.randn(8, 8).astype(np.float32))],
+            label=[mx.nd.array(rng.randint(0, 4, (8,)).astype(np.float32))])
+        mod.forward_backward(b)
+        mod.update()
+        return trainhealth.plane().drain(mod, epoch=0, step=i)
+
+    # both ranks run 3 steps; rank 1 then STOPS (the straggler) while
+    # rank 0 runs 2 more and reads the lag off the heartbeat exchange
+    for i in range(3):
+        row = step(i)
+        assert row["rank"] == r, row
+    dist.barrier("th_phase1", timeout_ms=60000)
+    if r == 0:
+        for i in range(3, 5):
+            row = step(i)
+        status = trainhealth.plane().status()
+        print("RANK0_STATUS %s" % json.dumps(status["ranks"]), flush=True)
+        reg = tin.registry()
+        lag = reg.get("rank_step_lag_steps")
+        print("RANK0_LAG %s" % json.dumps(lag.samples()), flush=True)
+    tin.flush()
+    dist.barrier("th_done", timeout_ms=60000)
+    print("RANK%d_ROWS %d" % (r, len(trainhealth.plane().rows())), flush=True)
+    dist.shutdown()
+""")
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(sys.platform != "linux",
+                    reason="local fake cluster uses fork/Gloo")
+def test_two_process_rank_labels_and_straggler(tmp_path):
+    """The acceptance pod check: a seeded 2-process launch (the
+    test_launch_dist.py machinery) shows rank-tagged samples/JSONL on both
+    ranks and a live straggler gauge on rank 0 (rank 1 trails by 2)."""
+    worker = tmp_path / "worker_th.py"
+    worker.write_text(WORKER_RANKS)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               TH_DIR=str(tmp_path))
+    env.pop("MXNET_TELEMETRY_FILE", None)
+    for attempt in range(3):
+        res = subprocess.run(
+            [sys.executable, LAUNCH, "-n", "2", "--launcher", "local",
+             sys.executable, str(worker)],
+            env=env, capture_output=True, text=True, timeout=420)
+        if res.returncode == 0:
+            break
+    assert res.returncode == 0, res.stdout + res.stderr
+    out = res.stdout
+    status_line = [l for l in out.splitlines() if "RANK0_STATUS" in l]
+    assert status_line, out
+    ranks = json.loads(status_line[0].split("RANK0_STATUS ")[1])
+    # rank 0 at step 5, rank 1 parked at 3 → lag 2 (heartbeat may land a
+    # drain late under load; accept >= 1)
+    assert ranks["1"]["lag_steps"] is not None \
+        and ranks["1"]["lag_steps"] >= 1, ranks
+    assert ranks["0"]["lag_steps"] == 0, ranks
+    lag_line = [l for l in out.splitlines() if "RANK0_LAG" in l]
+    samples = json.loads(lag_line[0].split("RANK0_LAG ")[1])
+    by_rank = {s["labels"]["rank"]: s["value"] for s in samples}
+    assert by_rank.get("1", 0) >= 1, samples
+    # per-rank JSONL files carry their own rank field
+    for r in (0, 1):
+        lines = [json.loads(l) for l in
+                 open(tmp_path / ("telemetry-rank%d.jsonl" % r))]
+        th = [l for l in lines if l.get("kind") == "trainhealth"]
+        assert th and all(l["rank"] == r for l in th), (r, len(th))

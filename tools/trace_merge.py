@@ -19,10 +19,21 @@ pids are namespaced per input (file i adds ``i * pid_stride``) and flow/
 async event ids are prefixed with the file index, so two files can never
 alias each other's tracks or arrows.
 
+**Per-rank merging (ISSUE 12).**  A pod run leaves one trace/flight-dump
+per process; each carries its ``rank`` — in the ``clock_sync`` metadata
+args (flight-recorder dumps), in per-event ``args.rank`` (trainhealth
+records), or simply in the filename (``...rank1...``).  Files that
+resolve the SAME rank merge onto one shared pid namespace with a
+``process_name`` track labeled ``rank N``, so N ranks produce one
+timeline with one track group per rank instead of one per file.
+``--rank R`` (repeatable, positional like ``--offset-us``) overrides
+detection per file.
+
 Usage::
 
     python tools/trace_merge.py mxtrace.json profile.json -o merged.json
     python tools/trace_merge.py mxtrace.json tb_export.json --align start
+    python tools/trace_merge.py rank0/flightrec-*.json rank1/flightrec-*.json
 
 Workflow (docs/OBSERVABILITY.md "Tracing"): run with ``MXNET_TRACE=1`` and
 ``mx.profiler`` (or ``use_xla_trace=True`` + a TensorBoard trace-viewer
@@ -33,6 +44,8 @@ from __future__ import annotations
 import argparse
 import gzip
 import json
+import os
+import re
 import sys
 
 PID_STRIDE = 100000
@@ -56,6 +69,48 @@ def clock_anchor(events):
             if "unix_ts" in a and "trace_ts_us" in a:
                 return float(a["unix_ts"]), float(a["trace_ts_us"])
     return None
+
+
+def file_rank(path, events, explicit=None):
+    """The rank this file belongs to, or None for single-process traces.
+
+    Precedence: an explicit ``--rank`` flag, a ``rank`` in the
+    ``clock_sync`` metadata args (flight-recorder dumps embed it),
+    event-level ``args.rank`` (trainhealth records) — but only when every
+    ranked event AGREES (a file carrying several ranks, e.g. a previous
+    trace_merge output fed back in, has no single file rank and keeps its
+    own namespace) — then a ``rank<N>``/``rank_<N>``/``rank-<N>`` token in
+    the file name."""
+    if explicit is not None:
+        return int(explicit)
+
+    def unanimous(ranks):
+        """One agreed rank, None when absent, None when MIXED — a file
+        carrying several ranks (a previous merge output) must never be
+        collapsed into the first one."""
+        if len(ranks) == 1:
+            return ranks.pop()
+        return None
+
+    sync_ranks, arg_ranks = set(), set()
+    for ev in events:
+        a = ev.get("args") or {}
+        if "rank" not in a:
+            continue
+        try:
+            rank = int(a["rank"])
+        except (TypeError, ValueError):
+            continue
+        if ev.get("ph") == "M" and ev.get("name") == "clock_sync":
+            sync_ranks.add(rank)
+        else:
+            arg_ranks.add(rank)
+    if sync_ranks:
+        return unanimous(sync_ranks)
+    if arg_ranks:
+        return unanimous(arg_ranks)
+    m = re.search(r"rank[-_]?(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else None
 
 
 def min_ts(events):
@@ -87,17 +142,34 @@ def compute_offset(events, align, base_events, explicit_us):
     return 0.0, "none"
 
 
-def shift_and_namespace(events, offset_us, index):
-    """Apply the time offset, namespace pids and flow/async ids."""
+def shift_and_namespace(events, offset_us, index, namespace=None, rank=None,
+                        force_rank=False):
+    """Apply the time offset, namespace pids and flow/async ids.
+
+    ``namespace`` is the pid-namespace slot (defaults to the file index;
+    files resolving the same rank share one so a pod run merges onto one
+    track group per rank); flow/async ids stay prefixed per FILE so two
+    same-rank files can never alias each other's arrows.  With ``rank``
+    set, every event's args gain the rank label (queryable in Perfetto);
+    ``force_rank`` (an explicit ``--rank`` flag) OVERWRITES embedded
+    args.rank values so the track label and the event labels agree."""
+    ns = index if namespace is None else namespace
     out = []
     for ev in events:
         ev = dict(ev)
         if isinstance(ev.get("ts"), (int, float)):
             ev["ts"] = ev["ts"] + offset_us
         if isinstance(ev.get("pid"), int):
-            ev["pid"] = ev["pid"] + index * PID_STRIDE
+            ev["pid"] = ev["pid"] + ns * PID_STRIDE
         if "id" in ev and ev.get("ph") in ("s", "t", "f", "b", "n", "e"):
             ev["id"] = "m%d.%s" % (index, ev["id"])
+        if rank is not None and ev.get("ph") != "M":
+            args = dict(ev.get("args") or {})
+            if force_rank:
+                args["rank"] = rank
+            else:
+                args.setdefault("rank", rank)
+            ev["args"] = args
         out.append(ev)
     return out
 
@@ -132,9 +204,16 @@ def main(argv=None):
                    metavar="US",
                    help="explicit per-file offset in microseconds "
                         "(repeatable, positional: first flag = first file)")
+    p.add_argument("--rank", action="append", type=int, default=[],
+                   metavar="R",
+                   help="explicit per-file rank (repeatable, positional) — "
+                        "overrides clock_sync/args/filename detection; "
+                        "same-rank files share one rank-labeled track group")
     args = p.parse_args(argv)
 
     merged, base = [], None
+    namespaces = {}  # ("rank", r) | ("file", i) -> pid-namespace slot
+    labeled = set()  # shifted pids already carrying a process_name
     for i, path in enumerate(args.traces):
         try:
             events = load_events(path)
@@ -143,13 +222,36 @@ def main(argv=None):
                   file=sys.stderr)
             return 2
         explicit = args.offset_us[i] if i < len(args.offset_us) else None
+        explicit_rank = args.rank[i] if i < len(args.rank) else None
+        rank = file_rank(path, events, explicit_rank)
+        key = ("rank", rank) if rank is not None else ("file", i)
+        ns = namespaces.setdefault(key, len(namespaces))
         offset, how = compute_offset(events, args.align, base, explicit)
-        shifted = shift_and_namespace(events, offset, i)
+        shifted = shift_and_namespace(events, offset, i, namespace=ns,
+                                      rank=rank,
+                                      force_rank=explicit_rank is not None)
         print(summarize(path, shifted))
-        print("  offset %+.1f us (%s)" % (offset, how))
+        print("  offset %+.1f us (%s)%s"
+              % (offset, how,
+                 "" if rank is None else ", rank %d" % rank))
         if base is None:
             base = shifted
         merged.extend(shifted)
+        if rank is not None:
+            # label every pid TRACK the file contributed (profiler dumps
+            # use one pid per domain, not just pid 0) — but never
+            # override a track's own embedded process_name, which for
+            # flightrec dumps already carries the rank
+            labeled |= {ev.get("pid") for ev in shifted
+                        if ev.get("ph") == "M"
+                        and ev.get("name") == "process_name"}
+            for pid in sorted({ev.get("pid") for ev in shifted
+                               if isinstance(ev.get("pid"), int)}
+                              - labeled):
+                labeled.add(pid)
+                merged.append({"name": "process_name", "ph": "M",
+                               "pid": pid,
+                               "args": {"name": "rank %d" % rank}})
 
     with open(args.output, "w", encoding="utf-8") as f:
         json.dump({"traceEvents": merged, "displayTimeUnit": "ms"}, f,

@@ -27,6 +27,15 @@ Usage::
     python tools/trace_summary.py profile.json --costs telemetry.jsonl \
         --peak-flops 197e12 --peak-bw 819e9 --top 20
     python tools/trace_summary.py profile.json --json   # machine-readable
+    python tools/trace_summary.py rank0.json rank1.json --per-rank
+
+**Per-rank inputs (ISSUE 12).**  A pod run produces one trace/flight dump
+per process; pass them all — each file's rank is detected like
+``tools/trace_merge.py`` does (``clock_sync`` args, per-event
+``args.rank``, or a ``rank<N>`` filename token) and the op table merges
+every rank's events into one accounting.  ``--per-rank`` keeps the ranks
+apart instead (rows prefixed ``r<k>/``), which is how a straggler shows
+up as one rank's ops running long.
 
 Roofline: intensity = flops/bytes (declared), attainable = min(peak_flops,
 intensity * peak_bw); %roof compares achieved FLOP/s (or B/s for zero-flop
@@ -51,13 +60,30 @@ def load_trace(path):
     return data  # bare event-array form is also legal chrome-trace
 
 
-def aggregate_ops(events):
-    """"X" duration events → {name: {"calls", "total_us"}}."""
-    ops = {}
+def trace_rank(path, events):
+    """The rank a per-rank trace belongs to, or None — THE
+    ``trace_merge.file_rank`` detection (one implementation, one pod
+    workflow: clock_sync args, unanimous event args.rank, filename
+    token)."""
+    import os
+
+    try:
+        import trace_merge
+    except ImportError:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import trace_merge
+    return trace_merge.file_rank(path, events)
+
+
+def aggregate_ops(events, ops=None, prefix=""):
+    """"X" duration events → {name: {"calls", "total_us"}} — pass ``ops``
+    to accumulate several (per-rank) files into one table; ``prefix``
+    keys rows per rank for --per-rank mode."""
+    ops = {} if ops is None else ops
     for ev in events:
         if ev.get("ph") != "X" or "dur" not in ev:
             continue
-        ent = ops.setdefault(ev.get("name", "?"),
+        ent = ops.setdefault(prefix + ev.get("name", "?"),
                              {"calls": 0, "total_us": 0.0})
         ent["calls"] += 1
         ent["total_us"] += float(ev["dur"])
@@ -243,7 +269,12 @@ def render_table(rows, top=0):
 def main(argv=None):
     p = argparse.ArgumentParser(
         description="per-op device-time + roofline table from a trace dump")
-    p.add_argument("trace", help="chrome-trace JSON (.json or .json.gz)")
+    p.add_argument("trace", nargs="+",
+                   help="chrome-trace JSON (.json or .json.gz); several "
+                        "per-rank files merge into one table")
+    p.add_argument("--per-rank", action="store_true",
+                   help="keep per-rank files apart (rows prefixed r<k>/) "
+                        "instead of merging the ranks' events")
     p.add_argument("--costs", action="append", default=[],
                    help="cost table: telemetry JSONL or {name: {flops, "
                         "bytes_accessed}} JSON (repeatable)")
@@ -263,14 +294,20 @@ def main(argv=None):
                    help="emit machine-readable JSON instead of the table")
     args = p.parse_args(argv)
 
-    try:
-        events = load_trace(args.trace)
-    except (OSError, json.JSONDecodeError) as e:
-        print("trace_summary: cannot read %s: %s" % (args.trace, e),
-              file=sys.stderr)
-        return 2
-    ops = aggregate_ops(events)
-    costs = costs_from_trace(events)
+    ops, costs, ranks = {}, {}, []
+    for path in args.trace:
+        try:
+            events = load_trace(path)
+        except (OSError, json.JSONDecodeError) as e:
+            print("trace_summary: cannot read %s: %s" % (path, e),
+                  file=sys.stderr)
+            return 2
+        rank = trace_rank(path, events)
+        ranks.append(rank)
+        prefix = ("r%d/" % rank) if args.per_rank and rank is not None \
+            else ""
+        aggregate_ops(events, ops=ops, prefix=prefix)
+        costs.update(costs_from_trace(events))
     for path in args.costs:
         costs.update(costs_from_file(path))
     if args.live_registry:
@@ -289,14 +326,19 @@ def main(argv=None):
     if args.json:
         print(json.dumps({"rows": rows, "xla_totals": xla_totals,
                           "peak_flops": args.peak_flops,
-                          "peak_bw": args.peak_bw}, indent=1))
+                          "peak_bw": args.peak_bw,
+                          "ranks": ranks}, indent=1))
         return 0
 
     total_ms = sum(r["total_ms"] or 0.0 for r in rows)
     print(render_table(rows, args.top))
-    print("\n%d ops, %.3f ms total traced time; %d registered custom call(s)"
+    seen = sorted({r for r in ranks if r is not None})
+    print("\n%d ops, %.3f ms total traced time; %d registered custom "
+          "call(s)%s"
           % (sum(1 for r in rows if r["total_ms"] is not None), total_ms,
-             len(costs)))
+             len(costs),
+             "" if not seen else "; ranks %s over %d file(s)"
+             % (",".join(map(str, seen)), len(args.trace))))
     if xla_totals and xla_totals["flops"] is not None:
         reg_fl = sum(r["flops"] or 0 for r in rows)
         print("XLA cost analysis: %.3f GFLOP module total; registered custom "
