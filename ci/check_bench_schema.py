@@ -33,10 +33,15 @@ TEL_REQ_KEYS = {"compile_s", "peak_hbm_bytes", "data_wait_frac"}
 # trainhealth_drain_s (ISSUE 12): host seconds the training-health plane's
 # per-step drain cost — THE health-overhead number (the in-graph stat
 # reductions ride the fused dispatch for free); null when no drain ran
+# xla_flops / xla_peak_bytes (ISSUE 13 compile plane): XLA-measured module
+# flops summed (and peak executable bytes maxed) over every executable the
+# process built — null when MXNET_COSTPLANE is off or the backend cannot
+# report (the partial-row contract)
 TEL_OPT_KEYS = {"dispatches_per_step", "warmup_s",
                 "graph_nodes_pre", "graph_nodes_post", "pass_time_s",
                 "autotune_trials", "serve_p50_ms", "serve_p99_ms",
-                "analysis_findings", "trainhealth_drain_s"}
+                "analysis_findings", "trainhealth_drain_s",
+                "xla_flops", "xla_peak_bytes"}
 TEL_KEYS = TEL_REQ_KEYS | TEL_OPT_KEYS
 
 # SERVE_BENCH line (tools/loadgen.py, ISSUE 2) — docs/SERVING.md schema
@@ -191,6 +196,13 @@ def validate_line(obj, where="<line>"):
                 raise SchemaError(
                     "%s: telemetry.%s must be a non-negative number or "
                     "null" % (where, k))
+        for k in ("xla_flops", "xla_peak_bytes"):
+            xv = tel.get(k)
+            if xv is not None and (not isinstance(xv, int)
+                                   or isinstance(xv, bool) or xv < 0):
+                raise SchemaError(
+                    "%s: telemetry.%s must be a non-negative int or null"
+                    % (where, k))
         if tel.get("serve_p50_ms") is not None \
                 and tel.get("serve_p99_ms") is not None \
                 and tel["serve_p99_ms"] < tel["serve_p50_ms"]:
@@ -354,6 +366,14 @@ def self_test():
          "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
                        "data_wait_frac": 0.0,
                        "trainhealth_drain_s": None}},
+        {"metric": "m", "value": 1, "unit": "samples/s",
+         "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0, "xla_flops": 528383,
+                       "xla_peak_bytes": 32788}},
+        {"metric": "m", "value": 1, "unit": "samples/s",
+         "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0, "xla_flops": None,
+                       "xla_peak_bytes": None}},
     ]
     bad = [
         {},                                                  # empty
@@ -406,6 +426,14 @@ def self_test():
          "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
                        "data_wait_frac": 0.0,
                        "trainhealth_drain_s": True}},    # bool drain
+        {"metric": "m", "value": 1, "unit": "img/s",
+         "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0,
+                       "xla_flops": 1.5}},               # float flops
+        {"metric": "m", "value": 1, "unit": "img/s",
+         "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0,
+                       "xla_peak_bytes": -8}},           # negative peak
     ]
     serve_good = {"mode": "closed", "requests": 10, "completed": 9,
                   "shed": 1, "timeouts": 0, "errors": 0, "shed_rate": 0.1,

@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""CI smoke for the compile plane (ISSUE 13).
+
+Three phases, exit 0 only when all pass — wired into the unit tier of
+``ci/run_tests.sh``:
+
+1. **Off path clean.**  With ``MXNET_COSTPLANE`` unset, forwards and
+   fused train steps record no rows, write no ledger, and the AOT-cache
+   logical key for a given computation is byte-identical to the gate-on
+   key (the gate must never move executable-cache identity).
+2. **Every compile site produces rows.**  Gate on, the two-head deploy
+   twin (``test_utils.deploy_twin_checkpoint``) served through an Engine
+   warmup plus a fused Module train step must yield ledger rows from the
+   ``executor_fwd`` site (one per warmed bucket, carrying real CPU-XLA
+   flops/peak numbers), the ``fused_step`` site, and — with
+   ``MXNET_AOT_CACHE`` set — the CachedFunction finalize hook (same site
+   labels, rows recorded at the one place XLA actually compiled).
+3. **Seeded regression gates.**  A baseline ledger seeded with HALVED
+   flops against the real current ledger makes
+   ``tools/bench_compare.py --gate-cost`` exit nonzero, and the identical
+   pair passes silently.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for p in (repo, os.path.join(repo, "tools")):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["MXNET_MODULE_FUSED_STEP"] = "1"
+    os.environ.pop("MXNET_COSTPLANE", None)
+    os.environ.pop("MXNET_COST_LEDGER", None)
+    ledger = "/tmp/costplane_smoke_ledger.jsonl"
+    aot_dir = "/tmp/costplane_smoke_aot"
+    for path in (ledger, ledger + ".base"):
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+    # a previous run's executables would restore from disk and record no
+    # rows (a restore builds nothing) — every run starts cold
+    import shutil
+
+    shutil.rmtree(aot_dir, ignore_errors=True)
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import compile_cache, serving
+    from mxnet_tpu import module as mod_mod
+    from mxnet_tpu.io import DataBatch
+    from mxnet_tpu.telemetry import costplane
+    from mxnet_tpu.test_utils import deploy_twin_checkpoint
+
+    ok = True
+
+    def fail(msg):
+        nonlocal ok
+        ok = False
+        print("FAIL: %s" % msg)
+
+    def train_module(batch=6):
+        data = mx.sym.var("data")
+        sym = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(
+                mx.sym.FullyConnected(data, name="fc1", num_hidden=8),
+                name="fc2", num_hidden=4), name="softmax")
+        mod = mod_mod.Module(sym)
+        mod.bind(data_shapes=[("data", (batch, 8))],
+                 label_shapes=[("softmax_label", (batch,))])
+        mod.init_params()
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        rng = np.random.RandomState(0)
+        for _ in range(2):
+            b = DataBatch(
+                data=[mx.nd.array(rng.randn(batch, 8).astype(np.float32))],
+                label=[mx.nd.array(rng.randint(0, 4, (batch,))
+                                   .astype(np.float32))])
+            mod.forward_backward(b)
+            mod.update()
+        return mod
+
+    # -- phase 1: off path ----------------------------------------------------
+    train_module()
+    sym, params, input_shapes = deploy_twin_checkpoint(batch=2, image=16)
+    pred = mx.predictor.Predictor(sym, params, input_shapes)
+    pred.forward(data=np.zeros(input_shapes["data"], np.float32))
+    if costplane.row_count() != 0:
+        fail("gate off recorded %d rows" % costplane.row_count())
+    if os.path.exists(ledger):
+        fail("gate off wrote a ledger")
+    # AOT key identity across the gate
+    import jax
+
+    os.environ["MXNET_AOT_CACHE"] = aot_dir
+    jfn = jax.jit(lambda x: x + 1)
+    key_off = compile_cache.CachedFunction(jfn, ("smoke", 1), name="s")._key
+    os.environ["MXNET_COSTPLANE"] = "1"
+    key_on = compile_cache.CachedFunction(jfn, ("smoke", 1), name="s")._key
+    if key_off != key_on:
+        fail("AOT logical key moved with the gate: %r vs %r"
+             % (key_off, key_on))
+    print("phase 1 ok: off path clean, AOT keys gate-invariant")
+
+    # -- phase 2: rows at every compile site ----------------------------------
+    os.environ["MXNET_COST_LEDGER"] = ledger
+    costplane._reset_for_tests()
+    # fused train step (goes through CachedFunction: MXNET_AOT_CACHE is on,
+    # donated ⇒ in-memory AOT split on CPU, finalize hook records)
+    train_module()
+    # deploy twin through the serving plane: warmup compiles every bucket
+    eng = serving.Engine(sym, params, {"data": input_shapes["data"][1:]},
+                         start=False, name="cp_smoke")
+    try:
+        report = eng.warmup()
+    finally:
+        eng.close()
+    sites = {r["site"] for r in costplane.rows()}
+    for want in ("fused_step", "executor_fwd"):
+        if want not in sites:
+            fail("no compile row from site %r (got %s)" % (want,
+                                                           sorted(sites)))
+    fresh = [r for r in report if r["fresh"]]
+    if not fresh or any(r.get("xla_flops") in (None, 0) for r in fresh):
+        fail("warmup report rows missing xla_flops: %r"
+             % [(r["bucket"], r.get("xla_flops")) for r in report])
+    if any(r.get("xla_peak_bytes") in (None, 0) for r in fresh):
+        fail("warmup report rows missing xla_peak_bytes")
+    st = costplane.status()
+    if st["rows"] < 1 + len(fresh):
+        fail("expected >= %d rows, got %d" % (1 + len(fresh), st["rows"]))
+    if not os.path.exists(ledger):
+        fail("gate on wrote no ledger")
+    else:
+        led = costplane.load_ledger(ledger)
+        nulls = [k for k, r in led.items() if r.get("flops") is None]
+        if nulls:
+            fail("CPU XLA rows with null flops (degradation misfired): %s"
+                 % nulls)
+        print("phase 2 ok: %d rows over sites %s, %d ledger keys"
+              % (st["rows"], sorted(st["by_site"]), len(led)))
+
+    # -- phase 3: seeded regression gates -------------------------------------
+    import bench_compare
+
+    base = ledger + ".base"
+    with open(ledger) as f, open(base, "w") as out:
+        for line in f:
+            row = json.loads(line)
+            row["flops"] = row["flops"] // 2  # the seeded regression:
+            out.write(json.dumps(row) + "\n")  # current = 2x baseline flops
+    rc_same = bench_compare.main([ledger, ledger, "--gate-cost"])
+    if rc_same != 0:
+        fail("identical ledgers gated nonzero (%d)" % rc_same)
+    rc_gate = bench_compare.main([base, ledger, "--gate-cost"])
+    if rc_gate == 0:
+        fail("halved-flops baseline not caught by --gate-cost")
+    rc_ungated = bench_compare.main([base, ledger])
+    if rc_ungated != 0:
+        fail("ungated ledger diff must only display (got rc %d)"
+             % rc_ungated)
+    if ok:
+        print("phase 3 ok: --gate-cost trips on the seeded regression "
+              "(rc %d) and passes identical ledgers" % rc_gate)
+
+    print("check_costplane: %s" % ("OK" if ok else "FAILED"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

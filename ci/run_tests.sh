@@ -83,6 +83,12 @@ case "$tier" in
     # MXNET_LOCKCHECK=1 must record zero violations on the real engine,
     # and the seeded inversion/unguarded-mutation must both be detected
     ./dev.sh python ci/check_lockcheck.py
+    # compile plane smoke (ISSUE 13): gate off = no rows, no ledger,
+    # AOT-cache keys gate-invariant; gate on = the deploy twin yields
+    # ledger rows at every compile site with real CPU-XLA flops/peak
+    # numbers, and a seeded halved-flops baseline ledger makes
+    # bench_compare --gate-cost exit nonzero while identical ledgers pass
+    ./dev.sh python ci/check_costplane.py
     # training-health smoke (ISSUE 12): gate off = no staged stats, no
     # plane, no key marker, no dump; a seeded NaN divergence must trip the
     # verdict-class census + blessed-class violation counter and emit a

@@ -8,11 +8,20 @@ chip's roofline, not just the 2018 GPU bar.
 
 Usage (on the chip, ambient axon env, from /root/repo):
     python examples/quality/rfcn_roofline.py --batches 1 2 4
+    python examples/quality/rfcn_roofline.py --batches 1 --ledger rfcn.jsonl
 
 Prints, per batch size: cost-analysis flops/bytes, the implied MXU/HBM
 time bounds (v5e: ~197 bf16 TFLOP/s, ~819 GB/s HBM), measured ms/step and
 img/s.  Tunnel rules apply: chained steps with donated state, scalar-only
 fetch (docs/PERF_NOTES.md "Tunnel-measurement note").
+
+``--ledger`` records each batch size's executable into a compile-plane
+cost ledger (ISSUE 13; it enables ``MXNET_COSTPLANE`` for this process),
+so the roofline workflow no longer hand-saves ``cost_analysis()`` JSON:
+``tools/trace_summary.py profile.json --ledger rfcn.jsonl`` merges the
+measured module totals, and ``tools/bench_compare.py old.jsonl new.jsonl
+--gate-cost`` turns a flop/peak regression between two builds into a CI
+failure (docs/tutorials/performance.md).
 """
 from __future__ import annotations
 
@@ -33,7 +42,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "..", "deformable_rfcn"))
 
 
-def analyze(batch, image_shape, iters, windows, dtype="bfloat16"):
+def analyze(batch, image_shape, iters, windows, dtype="bfloat16",
+            ledger=False):
     import jax
 
     import mxnet_tpu as mx
@@ -55,6 +65,16 @@ def analyze(batch, image_shape, iters, windows, dtype="bfloat16"):
     lowered = jstep.lower(state, d, i, g, key)
     comp = lowered.compile()
     compile_s = time.time() - t0
+    if ledger:
+        # compile-plane row (ISSUE 13): the same extraction the library's
+        # compile sites use, keyed stably by batch/shape/dtype so two
+        # builds' ledgers diff row-for-row in bench_compare --gate-cost
+        from mxnet_tpu.telemetry import costplane
+
+        costplane.record_compile(
+            "rfcn_train_step",
+            ("rfcn_train_step", tuple(image_shape), dtype),
+            "batch%d" % batch, comp, compile_s)
     ca = comp.cost_analysis()
     ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
     flops = float(ca.get("flops", 0.0))
@@ -95,12 +115,22 @@ def main():
     p.add_argument("--image-shape", type=int, nargs=2, default=[608, 1024])
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--windows", type=int, default=3)
+    p.add_argument("--ledger", default=None,
+                   help="record each executable into this compile-plane "
+                        "cost ledger (sets MXNET_COSTPLANE/MXNET_COST_"
+                        "LEDGER for this process; read it back with "
+                        "trace_summary --ledger / bench_compare "
+                        "--gate-cost)")
     args = p.parse_args()
+    if args.ledger:
+        os.environ["MXNET_COSTPLANE"] = "1"
+        os.environ["MXNET_COST_LEDGER"] = args.ledger
 
     rows = []
     for b in args.batches:
         try:
-            r = analyze(b, tuple(args.image_shape), args.iters, args.windows)
+            r = analyze(b, tuple(args.image_shape), args.iters, args.windows,
+                        ledger=bool(args.ledger))
         except Exception as exc:  # OOM at larger batches is a finding, not a crash
             print("batch %d FAILED: %r" % (b, exc))
             continue

@@ -17,10 +17,17 @@ import time
 
 from . import store
 
-__all__ = ["time_callable", "measure_candidate", "measurements"]
+__all__ = ["time_callable", "measure_candidate", "measurements",
+           "features_for", "trial_features"]
 
 _mu = threading.Lock()
 _count = [0]
+# (kernel, canonical config) -> measured cost features (compile plane,
+# ISSUE 13): the per-candidate feature vector the learned cost model
+# (ROADMAP item 4) trains on — flops / bytes / peak from the candidate's
+# compiled executable.  Populated only under MXNET_COSTPLANE; empty (and
+# never touched) otherwise.
+_features = {}
 
 
 def measurements():
@@ -29,9 +36,30 @@ def measurements():
         return _count[0]
 
 
+def _feature_key(kernel, config):
+    return (str(kernel), tuple(sorted((str(k), str(v))
+                                      for k, v in config.items())))
+
+
+def features_for(kernel, config):
+    """Measured cost features recorded for one (kernel, config) trial this
+    process, or None (gate off, candidate unreportable, or never
+    measured)."""
+    with _mu:
+        f = _features.get(_feature_key(kernel, config))
+        return dict(f) if f else None
+
+
+def trial_features():
+    """Snapshot of every trial's recorded features this process."""
+    with _mu:
+        return {k: dict(v) for k, v in _features.items()}
+
+
 def _reset_stats_for_tests():
     with _mu:
         _count[0] = 0
+        _features.clear()
 
 
 def _block(x):
@@ -56,9 +84,23 @@ def time_callable(fn, args=(), warmup=2, repeat=5):
 
 def measure_candidate(kernel, config, build, args=(), warmup=2, repeat=5):
     """One counted trial: pin ``config`` for ``kernel``, ``build()`` the
-    candidate callable under the pin, time it.  → median seconds."""
+    candidate callable under the pin, time it.  → median seconds.
+
+    Under ``MXNET_COSTPLANE`` (ISSUE 13) the trial additionally records
+    the candidate's measured cost features (XLA flops/bytes/peak from an
+    AOT compile of the built callable, inside the same config pin) on
+    :func:`features_for` — the training set for the learned cost model.
+    The extra compile is absorbed by the warmup calls; gate off = one env
+    read, no extra work (tested)."""
     with store.override(kernel, config):
         fn = build()
+        from ..telemetry import costplane
+
+        if costplane.enabled():
+            feats = costplane.candidate_features(fn, args)
+            if feats is not None:
+                with _mu:
+                    _features[_feature_key(kernel, config)] = feats
         seconds = time_callable(fn, args, warmup=warmup, repeat=repeat)
     with _mu:
         _count[0] += 1

@@ -507,10 +507,22 @@ class CachedFunction:
             _note("hits")
             return {"sig": sig, "source": "disk",
                     "lower_s": time.perf_counter() - t0}
+        from .telemetry import costplane
+
+        # compile plane (ISSUE 13): bracket the trace with a Pallas cost-
+        # registry snapshot so finalize can attribute declared kernel costs
+        # to THIS executable's row.  Warmup lowers many buckets in a thread
+        # pool — overlapping brackets mark each other dirty and their
+        # declared/drift surfaces degrade to empty rather than attributing
+        # another executable's kernels.  Gate off = one env read, no token.
+        tc0 = costplane.open_trace_bracket()
         t0 = time.perf_counter()
-        lowered = self._jit.lower(*args)
+        try:
+            lowered = self._jit.lower(*args)
+        finally:
+            costplane.close_trace_bracket(tc0)
         return {"sig": sig, "source": "lower", "lowered": lowered,
-                "lower_s": time.perf_counter() - t0}
+                "lower_s": time.perf_counter() - t0, "tc0": tc0}
 
     def finalize(self, handle):
         """Stage 2: compile a ``"lower"`` handle (and persist it — counted
@@ -523,6 +535,14 @@ class CachedFunction:
         t0 = time.perf_counter()
         compiled = handle["lowered"].compile()
         compile_s = time.perf_counter() - t0
+        from .telemetry import costplane
+
+        if costplane.enabled():
+            # compile plane (ISSUE 13): one ledger row per executable XLA
+            # built here — disk restores record nothing (XLA built nothing)
+            costplane.record_compile(self._name, self._key,
+                                     self._sig_str(handle["sig"]), compiled,
+                                     compile_s, tc0=handle.get("tc0"))
         with self._lock:
             self._exes[handle["sig"]] = compiled
         if self._persist:

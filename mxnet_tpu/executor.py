@@ -177,6 +177,23 @@ class Executor:
         # the Module fused stepper keeps it across re-binds, and an
         # executor reference would pin the old buffers after reshape
         head_names = list(heads)
+        # compile plane (ISSUE 13): under MXNET_COSTPLANE each node's ops
+        # trace inside jax.named_scope(node.name), so profiler traces and
+        # HLO metadata attribute device time back to symbolic node names.
+        # Snapshot at build: the scope is pure trace-time metadata (the
+        # jaxpr is unchanged, zero retraces — tested), and with the gate
+        # off the eval loop below is byte-identical to a scopeless build.
+        from .telemetry import costplane
+
+        if costplane.enabled():
+            import jax as _jax
+
+            def run_node(node, args, attrs):
+                with _jax.named_scope(node.name):
+                    return node.op.fn(*args, **attrs)
+        else:
+            def run_node(node, args, attrs):
+                return node.op.fn(*args, **attrs)
 
         def fn(arg_vals, aux_vals, key):  # mxlint: traced
             env = dict(const_env) if const_env else {}
@@ -186,7 +203,7 @@ class Executor:
             for node, in_names in plan:
                 attrs = node_call_attrs(node, key, is_train)
                 args = [env[n] for n in in_names]
-                res = node.op.fn(*args, **attrs)
+                res = run_node(node, args, attrs)
                 outs = res if isinstance(res, tuple) else (res,)
                 if is_train and node.op.aux_update is not None:
                     by_arg = dict(zip(_node_input_names(node), node.inputs))
@@ -235,6 +252,20 @@ class Executor:
                      compile_cache.symbol_fingerprint(self._symbol),
                      bool(is_train)),
                     name="executor_fwd", passes_on=self._graph_passes)
+            else:
+                from .telemetry import costplane
+
+                if costplane.enabled():
+                    # compile plane (ISSUE 13): without the AOT cache the
+                    # forward is a plain jit whose compiles XLA pays
+                    # invisibly — the instrumented split records one
+                    # ledger row per shape signature.  Gate off keeps the
+                    # plain jit (one env read).
+                    fn = costplane.instrument_jit(
+                        fn, "executor_fwd",
+                        ("executor_fwd",
+                         compile_cache.symbol_fingerprint(self._symbol),
+                         bool(is_train), self._graph_passes))
             self._fwd_cache[is_train] = fn
         return self._fwd_cache[is_train]
 

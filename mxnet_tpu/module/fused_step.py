@@ -310,6 +310,9 @@ class FusedStepper:
                 # appended (not an always-present flag) so gate-off keys
                 # stay byte-identical to pre-trainhealth entries
                 self._aot_key = self._aot_key + ("trainhealth",)
+        # symbol kept for the compile plane's logical row key (ISSUE 13) —
+        # a Symbol, not an executor: no buffer pinning across re-binds
+        self._symbol_ref = module._symbol
         self._nsteps = 0
         self._pending_flag = None  # (finite device scalar, step number)
         self._fn = _build_step_fn(exec_._graph_fn(True), self._arg_names,
@@ -408,6 +411,25 @@ class FusedStepper:
                 self._jit, self._aot_key, name="fused_step",
                 mesh_desc=compile_cache.mesh_descriptor(self._mesh),
                 donated=True, passes_on=self._passes_on)
+        else:
+            from ..telemetry import costplane
+
+            if costplane.enabled():
+                # compile plane (ISSUE 13): without the AOT cache the
+                # donated train-step jit still records one ledger row per
+                # shape signature.  donated=True: a dispatch failure
+                # re-raises instead of re-invoking the jit on consumed
+                # buffers (compile_cache's donation stance).
+                from .. import compile_cache
+
+                self._jit = costplane.instrument_jit(
+                    self._jit, "fused_step",
+                    ("fused_step",
+                     compile_cache.symbol_fingerprint(self._symbol_ref),
+                     tuple(self._diff_names), self._hp_sig, self._nancheck,
+                     self._zero, self._mesh is not None, self._passes_on,
+                     self._health_groups is not None),
+                    donated=True)
         # compile/steady-state accounting (identity when telemetry is off)
         self._step = telemetry.instrument_step(self._jit,
                                                name="module_fused_step")

@@ -33,7 +33,7 @@ import numpy as np
 
 from ..base import env_flag
 from ..predictor import Predictor
-from ..telemetry import flightrec, ops_server, slo, tracing
+from ..telemetry import costplane, flightrec, ops_server, slo, tracing
 from .admission import AdmissionController, EngineClosed, ServerBusy
 from .batcher import MicroBatcher, Request
 from .bucketing import BucketLadder, _volume
@@ -614,8 +614,17 @@ class Engine:
         cache = None
         lower_s = 0.0
         aot_compile_s = 0.0
+        cp0 = None
         try:
             with self._device_mu:
+                # compile plane (ISSUE 13): bracket this bucket's compile
+                # with the monotonic row counter INSIDE the device mutex —
+                # the window covers exactly this bucket's finalize + first
+                # forward, and the read below additionally pins rows to
+                # this predictor's executable identity, so a concurrent
+                # compile elsewhere in the process cannot be mis-attributed
+                if costplane.enabled():
+                    cp0 = costplane.row_count()
                 if handle is not None:
                     info = pred.aot_finalize(handle)
                     # "cached" = already live in this process (a re-warmup):
@@ -629,6 +638,17 @@ class Engine:
                        for n, s in bucket.shapes})
                 for o in outs:
                     o.asnumpy()
+                crows = ()
+                if cp0 is not None:
+                    # still under _device_mu: rows since cp0 that carry
+                    # THIS predictor executable's logical key are this
+                    # bucket's compile (a concurrent train-thread compile
+                    # has a different key and is filtered out)
+                    fwd = pred._exec._fwd_cache.get(False)
+                    want = getattr(fwd, "_key", None)
+                    crows = [r for r in costplane.rows_since(
+                                 cp0, site="executor_fwd")
+                             if want is None or r["logical_key"] == want]
         except Exception:
             self._uncompile(bucket, fresh)
             raise
@@ -666,6 +686,13 @@ class Engine:
                 verdicts = None
         else:
             checked = verdicts = None
+        # the compile-plane row this warm produced (captured above, inside
+        # the mutex + keyed to this executable; a warm restart / re-warm
+        # records nothing and the columns stay None)
+        xla_flops = xla_peak = None
+        if cp0 is not None and crows:
+            xla_flops = crows[-1]["flops"]
+            xla_peak = crows[-1]["peak_bytes"]
         return {"bucket": repr(bucket), "fresh": fresh,
                 "compile_s": round(dt, 4) if fresh else 0.0,
                 "lower_s": round(lower_s, 4),
@@ -675,7 +702,11 @@ class Engine:
                 "graph_nodes_pre": ps["nodes_pre"] if ps else None,
                 "graph_nodes_post": ps["nodes_post"] if ps else None,
                 "check_warnings": checked,
-                "precision_verdicts": verdicts}
+                "precision_verdicts": verdicts,
+                # XLA-measured cost of this bucket's executable (ISSUE 13;
+                # None with MXNET_COSTPLANE off, on a cache hit, or when
+                # the backend reports nothing — the partial-row contract)
+                "xla_flops": xla_flops, "xla_peak_bytes": xla_peak}
 
     def _note_warmup(self, report, total_s):
         """Record the warmup pass for ``stats()["warmup"]`` (always on, so
@@ -696,6 +727,13 @@ class Engine:
             for v in vrows:
                 for k, n in v.items():
                     verdicts[k] = verdicts.get(k, 0) + n
+        # XLA-measured cost across the warmed ladder (ISSUE 13): flops sum
+        # + peak max over buckets whose warm produced a compile-plane row —
+        # None when no row carried the number (gate off / all cache hits)
+        wfl = [r.get("xla_flops") for r in report
+               if r.get("xla_flops") is not None]
+        wpk = [r.get("xla_peak_bytes") for r in report
+               if r.get("xla_peak_bytes") is not None]
         with self._stats_mu:
             self._warmup = {
                 "buckets": len(report),
@@ -713,6 +751,8 @@ class Engine:
                 # cast-plan verdict histogram across all warmed buckets
                 # (ISSUE 11) — same gate, same None-when-off contract
                 "precision_verdicts": verdicts,
+                "xla_flops": sum(wfl) if wfl else None,
+                "xla_peak_bytes": max(wpk) if wpk else None,
                 "total_s": round(total_s, 4)}
         if self._probe:
             self._probe.record_warmup(len(report), hits, misses, total_s)
@@ -789,6 +829,12 @@ class Engine:
         # outside _stats_mu: the monitor has its own lock, the heartbeat
         # is a single-writer float.
         out["slo"] = self._slo.status() if self._slo is not None else None
+        # compile plane (ISSUE 13): what XLA built in this process — row
+        # counts per site, flop/peak aggregates, degradation/drift counts
+        # (process-global like flightrec; None when MXNET_COSTPLANE is off
+        # — the off path is this one env read)
+        out["costplane"] = costplane.status() if costplane.enabled() \
+            else None
         hb = self._heartbeat
         out["heartbeat_age_s"] = (round(max(0.0, time.monotonic() - hb), 3)
                                   if hb is not None else None)

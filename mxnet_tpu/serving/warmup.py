@@ -51,7 +51,10 @@ def warmup_engine(engine, buckets=None, verbose=False, max_workers=None):
     (``Predictor.check()``, ISSUE 8; None with ``MXNET_GRAPH_ANALYZERS``
     off) and ``precision_verdicts`` is the bucket plan's cast-plan verdict
     histogram (``Predictor.precision_plan().counts()``, ISSUE 11; same
-    gate, None when off).
+    gate, None when off); ``xla_flops`` / ``xla_peak_bytes`` are the
+    XLA-measured cost of the executable this bucket's warm built
+    (compile plane, ISSUE 13; None with ``MXNET_COSTPLANE`` off, on a
+    cache hit, or when the backend reports nothing).
     The pass is also summarized in ``engine.stats()["warmup"]``."""
     from .. import compile_cache
 
@@ -95,6 +98,11 @@ def warmup_engine(engine, buckets=None, verbose=False, max_workers=None):
                     row["graph_nodes_pre"], row["graph_nodes_post"])
             if row.get("check_warnings"):
                 state += "  [check: %d diagnostics]" % row["check_warnings"]
+            if row.get("xla_flops") is not None:
+                state += "  [xla %.3f GFLOP%s]" % (
+                    row["xla_flops"] / 1e9,
+                    "" if row.get("xla_peak_bytes") is None
+                    else ", peak %.1f MB" % (row["xla_peak_bytes"] / 1e6))
             if row.get("precision_verdicts"):
                 v = row["precision_verdicts"]
                 state += "  [cast-plan: %d bf16_safe / %d fp32_accum / " \

@@ -18,6 +18,7 @@ from .registry import (Counter, Gauge, Histogram, MetricError, Registry,
                        DEFAULT_BUCKETS)
 from . import flightrec, ops_server, slo  # live ops plane (ISSUE 10)
 from . import trainhealth  # training health plane (ISSUE 12)
+from . import costplane  # compile plane (ISSUE 13)
 from .sinks import (JsonlSink, PrometheusSink, ProfilerSink, Sink,
                     TensorBoardSink, iter_scalar_samples, render_prometheus)
 from .instrument import (ServeProbe, StepProbe, add_sink, array_nbytes,
@@ -33,7 +34,7 @@ from .instrument import (ServeProbe, StepProbe, add_sink, array_nbytes,
                          summary)
 
 __all__ = [
-    "tracing", "flightrec", "ops_server", "slo", "trainhealth",
+    "tracing", "flightrec", "ops_server", "slo", "trainhealth", "costplane",
     "Counter", "Gauge", "Histogram", "MetricError", "Registry",
     "DEFAULT_BUCKETS",
     "Sink", "JsonlSink", "PrometheusSink", "ProfilerSink", "TensorBoardSink",

@@ -627,6 +627,15 @@ def summary():
     # in-graph reductions themselves ride the fused dispatch for free);
     # null when no drain ran (gate off, or no fused training)
     th_s = r.total("trainhealth_drain_seconds_total", None)
+    # compile plane surface (ISSUE 13): XLA-measured module flops (summed
+    # over every executable this process built) and peak executable bytes
+    # (maxed) — null when MXNET_COSTPLANE is off, no compile happened, or
+    # the backend reported nothing (the partial-row contract)
+    from . import costplane
+
+    cp = costplane.totals() if costplane.enabled() else {}
+    xla_fl = cp.get("flops")
+    xla_pk = cp.get("peak_bytes")
     # static-analysis surface (ISSUE 11): diagnostics the analyzer manager
     # recorded this process (all analyzers, all severities) — null when
     # nothing was recorded (no check()/warmup ran, or it all came back
@@ -649,4 +658,6 @@ def summary():
             "analysis_findings": int(findings) if findings is not None
             else None,
             "trainhealth_drain_s": round(th_s, 4) if th_s is not None
-            else None}
+            else None,
+            "xla_flops": int(xla_fl) if xla_fl is not None else None,
+            "xla_peak_bytes": int(xla_pk) if xla_pk is not None else None}

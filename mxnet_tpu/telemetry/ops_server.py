@@ -230,7 +230,7 @@ def _health():
 
 
 def _statusz():
-    from . import instrument, trainhealth
+    from . import costplane, instrument, trainhealth
 
     engines = {}
     for e in _live_engines():
@@ -250,9 +250,16 @@ def _statusz():
         th = trainhealth.status()
     except Exception as ex:
         th = {"error": repr(ex)}
+    try:
+        # compile plane (ISSUE 13): what XLA built in this process — None
+        # when MXNET_COSTPLANE is off (the plane never recorded)
+        cp = costplane.status() if costplane.enabled() else None
+    except Exception as ex:
+        cp = {"error": repr(ex)}
     return {"pid": os.getpid(), "unix_ts": round(time.time(), 6),
             "telemetry_enabled": instrument.enabled(),
-            "health": health, "engines": engines, "trainhealth": th}
+            "health": health, "engines": engines, "trainhealth": th,
+            "costplane": cp}
 
 
 # -- handler ------------------------------------------------------------------

@@ -124,6 +124,57 @@ def test_fused_module_step_compiles_once_per_shape(monkeypatch, tmp_path):
         tin._reset_for_tests()
 
 
+def test_costplane_scopes_add_zero_retraces(monkeypatch, tmp_path):
+    """ISSUE 13: MXNET_COSTPLANE wraps every plan node in jax.named_scope
+    (HLO attribution) and routes plain-jit sites through the AOT split —
+    neither may change retrace behavior: the fused step still compiles
+    exactly once per shape signature, a reshape costs exactly one row."""
+    from mxnet_tpu import module as mod_mod
+    from mxnet_tpu.io import DataBatch
+    from mxnet_tpu.telemetry import costplane
+
+    monkeypatch.setenv("MXNET_COSTPLANE", "1")
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    costplane._reset_for_tests()
+    try:
+        data = mx.sym.var("data")
+        fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=8)
+        s = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(fc1, name="fc2", num_hidden=4),
+            name="softmax")
+        mod = mod_mod.Module(s)
+        mod.bind(data_shapes=[("data", (6, 8))],
+                 label_shapes=[("softmax_label", (6,))])
+        mod.init_params()
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        rng = np.random.RandomState(0)
+
+        def epoch(batch):
+            for _ in range(3):
+                b = DataBatch(
+                    data=[nd.array(rng.randn(batch, 8).astype(np.float32))],
+                    label=[nd.array(rng.randint(0, 4, (batch,))
+                                    .astype(np.float32))])
+                mod.forward_backward(b)
+                mod.update()
+
+        fused_rows = lambda: sum(1 for r in costplane.rows()
+                                 if r["site"] == "fused_step")
+        epoch(6)
+        epoch(6)  # second epoch, same signature: no new executable
+        assert fused_rows() == 1, costplane.rows()
+        assert mod._fused.cache_size() == 1
+        epoch(4)  # reshape to batch 4: exactly one new executable
+        assert fused_rows() == 2
+        assert mod._fused.cache_size() == 2
+        epoch(6)  # back to the first signature: cache hit, still 2
+        assert fused_rows() == 2
+        assert mod._fused.cache_size() == 2
+    finally:
+        costplane._reset_for_tests()
+
+
 @pytest.mark.parametrize("passes", ["0", "1"])
 def test_graph_passes_add_zero_retraces(monkeypatch, passes):
     """ISSUE 7: the pass pipeline runs once per (executor, mode) and its

@@ -123,6 +123,21 @@ def _search_dconv(args):
     meta = {"default_s": default_s, "best_s": best_s,
             "trials": len(results), "backend": jax.default_backend(),
             "interpret": interpret, "bg": BG}
+    # compile plane (ISSUE 13): under MXNET_COSTPLANE every trial carried
+    # measured XLA cost features — persist them with the winner (the
+    # learned cost model's training rows, ROADMAP item 4).  Gate off ⇒
+    # features_for returns None and the meta stays byte-identical, so
+    # readers without the gate never see the keys.
+    trial_costs = []
+    for r in results:
+        feats = autotune.measure.features_for(kernel, r["config"])
+        if feats is not None:
+            trial_costs.append(dict(config=r["config"],
+                                    seconds=round(r["seconds"], 6),
+                                    cost=feats))
+    if trial_costs:
+        meta["cost"] = autotune.measure.features_for(kernel, best)
+        meta["trial_costs"] = trial_costs
     autotune.record(kernel, sig, best, score=best_s, meta=meta)
     for r in results:
         print("  %-24s %.6f s%s" % (r["config"], r["seconds"],

@@ -19,6 +19,16 @@ context but never gated — the checked-in trajectory mixes workloads
 (resnet50 rounds vs deformable-rfcn rounds), and an img/s delta across
 different models is noise, not signal.
 
+Compile-plane **cost ledgers** (``MXNET_COST_LEDGER`` JSONL files of
+``kind: "compile"`` rows, ISSUE 13) are detected automatically and diffed
+per row key (site + logical key + shape signature — stable across runs):
+Δflops / Δpeak-bytes / Δcompile-seconds for keys both ledgers share, plus
+added/removed keys for context.  All deltas are shown; only ``--gate-cost``
+turns flops or peak-bytes growth beyond ``--threshold`` into a nonzero
+exit — a graph-pass or autotune change that silently doubles what XLA
+builds fails CI the way pass-drift already fails plan-shape changes.
+Identical ledgers compare silent and exit 0.
+
 MULTICHIP captures (``MULTICHIP_r*.json``: the driver's ``dryrun_multichip``
 record — ``{n_devices, rc, ok, skipped, tail}``) are detected automatically
 and diffed on their own axis: the ``ok`` flag and the set of dryrun
@@ -33,6 +43,8 @@ Usage::
     python tools/bench_compare.py BENCH_r04.json BENCH_r05.json
     python tools/bench_compare.py base.json new.json --threshold 3 --json
     python tools/bench_compare.py MULTICHIP_r04.json MULTICHIP_r05.json
+    python tools/bench_compare.py base_ledger.jsonl new_ledger.jsonl \
+        --gate-cost
 """
 from __future__ import annotations
 
@@ -68,15 +80,75 @@ MULTICHIP_PHASES = (
 )
 
 
+def _parse_ledger_text(text):
+    """Parse JSONL text as a compile-cost ledger → {key: row} (LAST row
+    per key wins — a key recompiled during one run supersedes earlier
+    rows), or None when the lines are not compile rows."""
+    rows = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            return None
+        if not isinstance(row, dict) or row.get("kind") != "compile" \
+                or "key" not in row:
+            return None
+        rows[row["key"]] = row
+    return rows or None
+
+
+def load_ledger_file(path):
+    """→ {key: row} for one ledger file, {} when it holds no compile rows.
+    The standalone-tool twin of ``telemetry.costplane.load_ledger`` —
+    tools must parse ledgers without importing the library (and jax);
+    ``trace_summary`` imports THIS one so the tools share a single
+    definition of "valid ledger row".  Unlike :func:`_parse_ledger_text`
+    (the strict file-TYPE detector), this reader skips unparseable lines —
+    a line torn by a crashed writer must not zero out the whole file."""
+    rows = {}
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(row, dict) and row.get("kind") == "compile" \
+                    and "key" in row:
+                rows[row["key"]] = row
+    return rows
+
+
 def _read_capture(path):
     """Parse one capture file (raises OSError/JSONDecodeError/ValueError so
     a missing or corrupt file surfaces as ITS error, not as a kind
-    mismatch)."""
+    mismatch).  A cost-ledger JSONL file (several JSON lines, each a
+    ``kind: "compile"`` row) parses to ``{"_ledger": {key: row}}``."""
     with open(path, encoding="utf-8") as f:
-        obj = json.load(f)
+        text = f.read()
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError:
+        rows = _parse_ledger_text(text)
+        if rows is None:
+            raise
+        return {"_ledger": rows}
+    if isinstance(obj, dict) and obj.get("kind") == "compile" \
+            and "key" in obj:
+        return {"_ledger": {obj["key"]: obj}}  # single-row ledger
     if not isinstance(obj, dict):
         raise ValueError("%s: capture must be a JSON object" % path)
     return obj
+
+
+def is_ledger(obj):
+    """True when a parsed capture is a compile-cost ledger (ISSUE 13)."""
+    return "_ledger" in obj
 
 
 def is_multichip(obj):
@@ -151,6 +223,87 @@ def render_serve_table(table):
                     _fmt(r["latency_ms_p99"], "%.4g"),
                     _fmt(r["p99_delta_pct"], "%+.1f"),
                     _fmt(r["shed_rate"], "%.3g")])
+    widths = [max(len(row[i]) for row in out) for i in range(len(cols))]
+    lines = []
+    for i, row in enumerate(out):
+        lines.append("  ".join(
+            c.ljust(widths[j]) if j < 2 else c.rjust(widths[j])
+            for j, c in enumerate(row)))
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def compare_cost(ledgers, threshold, gate_cost=False):
+    """→ (table_rows, regressions) for N parsed ledgers; baseline =
+    ledgers[0] = (path, {key: row}).  Per shared key: Δflops / Δpeak_bytes
+    / Δcompile_s percent (null-safe — a key whose backend reported nothing
+    on either side is shown, never gated).  Keys only in the baseline
+    (removed) or only in a candidate (added) are listed for context.
+    ``--gate-cost`` makes flops or peak-bytes growth beyond the threshold
+    a regression; identical ledgers produce empty regressions."""
+    base_file, base = ledgers[0]
+    table, regressions = [], []
+    for path, rows in ledgers[1:]:
+        shared = sorted(set(base) & set(rows))
+        for key in shared:
+            b, r = base[key], rows[key]
+            dfl = _pct(r.get("flops"), b.get("flops"))
+            dpk = _pct(r.get("peak_bytes"), b.get("peak_bytes"))
+            dcs = _pct(r.get("compile_s"), b.get("compile_s"))
+            table.append({"file": path, "key": key, "site": r.get("site"),
+                          "flops": r.get("flops"), "flops_delta_pct": dfl,
+                          "peak_bytes": r.get("peak_bytes"),
+                          "peak_delta_pct": dpk,
+                          "compile_s": r.get("compile_s"),
+                          "compile_delta_pct": dcs})
+            if not gate_cost:
+                continue
+            if dfl is not None and dfl > threshold:
+                regressions.append(
+                    "%s: %s flops %.4g -> %.4g (+%.1f%% > %g%%, "
+                    "--gate-cost)" % (path, key, b["flops"], r["flops"],
+                                      dfl, threshold))
+            if dpk is not None and dpk > threshold:
+                regressions.append(
+                    "%s: %s peak_bytes %.4g -> %.4g (+%.1f%% > %g%%, "
+                    "--gate-cost)" % (path, key, b["peak_bytes"],
+                                      r["peak_bytes"], dpk, threshold))
+        added = sorted(set(rows) - set(base))
+        removed = sorted(set(base) - set(rows))
+        for key in added:
+            table.append({"file": path, "key": key,
+                          "site": rows[key].get("site"), "note": "added",
+                          "flops": rows[key].get("flops"),
+                          "flops_delta_pct": None,
+                          "peak_bytes": rows[key].get("peak_bytes"),
+                          "peak_delta_pct": None,
+                          "compile_s": rows[key].get("compile_s"),
+                          "compile_delta_pct": None})
+        for key in removed:
+            table.append({"file": path, "key": key,
+                          "site": base[key].get("site"), "note": "removed",
+                          "flops": None, "flops_delta_pct": None,
+                          "peak_bytes": None, "peak_delta_pct": None,
+                          "compile_s": None, "compile_delta_pct": None})
+    return table, regressions
+
+
+def render_cost_table(table):
+    cols = ["key", "site", "GFLOP", "Δflops%", "peak_MB", "Δpeak%",
+            "compile_s", "Δcompile%", "note"]
+    out = [cols]
+    for r in table:
+        out.append([r["key"][:44], str(r.get("site") or "-"),
+                    _fmt(None if r["flops"] is None
+                         else r["flops"] / 1e9, "%.4f"),
+                    _fmt(r["flops_delta_pct"], "%+.1f"),
+                    _fmt(None if r["peak_bytes"] is None
+                         else r["peak_bytes"] / 1e6, "%.3f"),
+                    _fmt(r["peak_delta_pct"], "%+.1f"),
+                    _fmt(r["compile_s"], "%.3g"),
+                    _fmt(r["compile_delta_pct"], "%+.1f"),
+                    r.get("note") or "-"])
     widths = [max(len(row[i]) for row in out) for i in range(len(cols))]
     lines = []
     for i, row in enumerate(out):
@@ -320,6 +473,11 @@ def main(argv=None):
                         "noisy across hosts — opt in when runs share a "
                         "machine and load shape; requires SERVE_BENCH "
                         "captures)")
+    p.add_argument("--gate-cost", action="store_true",
+                   help="fail on compile-plane ledger flops or peak-bytes "
+                        "growth beyond --threshold (off by default: shown-"
+                        "only deltas; requires MXNET_COST_LEDGER JSONL "
+                        "captures — ISSUE 13)")
     args = p.parse_args(argv)
     if len(args.files) < 2:
         p.error("need at least two files (baseline + candidates)")
@@ -331,15 +489,39 @@ def main(argv=None):
         return 2
     kinds = [is_multichip(o) for _, o in objs]
     serve_kinds = [is_serve(o) for _, o in objs]
+    ledger_kinds = [is_ledger(o) for _, o in objs]
     if (any(kinds) and not all(kinds)) or (any(serve_kinds)
-                                           and not all(serve_kinds)):
-        print("bench_compare: cannot mix bench / MULTICHIP / SERVE_BENCH "
-              "captures in one invocation", file=sys.stderr)
+                                           and not all(serve_kinds)) \
+            or (any(ledger_kinds) and not all(ledger_kinds)):
+        print("bench_compare: cannot mix bench / MULTICHIP / SERVE_BENCH / "
+              "cost-ledger captures in one invocation", file=sys.stderr)
         return 2
     if args.gate_p99 and not all(serve_kinds):
         print("bench_compare: --gate-p99 applies to SERVE_BENCH captures "
               "(a bench line has no latency_ms_p99)", file=sys.stderr)
         return 2
+    if args.gate_cost and not all(ledger_kinds):
+        print("bench_compare: --gate-cost applies to compile-plane cost "
+              "ledgers (MXNET_COST_LEDGER JSONL)", file=sys.stderr)
+        return 2
+    if all(ledger_kinds):
+        ledgers = [(f, o["_ledger"]) for f, o in objs]
+        table, regressions = compare_cost(ledgers, args.threshold,
+                                          gate_cost=args.gate_cost)
+        if args.json:
+            print(json.dumps({"baseline": ledgers[0][0], "rows": table,
+                              "threshold_pct": args.threshold,
+                              "regressions": regressions}, indent=1))
+        else:
+            print(render_cost_table(table))
+            for msg in regressions:
+                print("REGRESSION %s" % msg)
+        if regressions:
+            if not args.json:
+                print("bench_compare: %d cost regression(s) beyond %.3g%%"
+                      % (len(regressions), args.threshold), file=sys.stderr)
+            return 1
+        return 0
     if all(serve_kinds):
         try:
             srows = [load_serve(f, o) for f, o in objs]

@@ -12,9 +12,13 @@ accounting XLA cost analysis loses for Pallas custom calls):
    module is loaded), read from a telemetry JSONL event log or a plain
    ``{name: {flops, bytes_accessed}}`` JSON via ``--costs``, or pulled live
    from ``mxnet_tpu.ops.pallas_kernels`` with ``--live-registry``;
-3. optionally an XLA cost-analysis JSON (``--xla-cost``, the dict from
-   ``jitted.lower(...).compile().cost_analysis()`` saved with json.dump)
-   for whole-module flops/bytes context.
+3. optionally whole-module XLA flops/bytes context — preferably from a
+   compile-plane **cost ledger** (``--ledger``, the ``MXNET_COST_LEDGER``
+   JSONL the library writes per compiled executable under
+   ``MXNET_COSTPLANE=1``; ISSUE 13 — totals are summed over the last row
+   per executable key, no hand-saving required), or from a hand-saved
+   cost-analysis JSON (``--xla-cost``, the dict from
+   ``jitted.lower(...).compile().cost_analysis()`` saved with json.dump).
 
 Ops are matched to registered costs by case-insensitive substring (both
 directions, plus each registry entry's aliases).  Registered custom calls
@@ -24,6 +28,7 @@ are always visible — a registered kernel can never be invisible again.
 Usage::
 
     python tools/trace_summary.py profile.json
+    python tools/trace_summary.py profile.json --ledger cost_ledger.jsonl
     python tools/trace_summary.py profile.json --costs telemetry.jsonl \
         --peak-flops 197e12 --peak-bw 819e9 --top 20
     python tools/trace_summary.py profile.json --json   # machine-readable
@@ -134,6 +139,38 @@ def costs_from_file(path):
         if ev.get("kind") == "custom_call_cost" and "name" in ev:
             out[ev["name"]] = _norm_cost(ev)
     return out
+
+
+def _import_bench_compare():
+    import os
+
+    try:
+        import bench_compare
+    except ImportError:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import bench_compare
+    return bench_compare
+
+
+def ledger_totals(path):
+    """Whole-module XLA totals from a compile-plane cost ledger (ISSUE 13):
+    {"flops", "bytes_accessed", "peak_bytes", "rows", "partial_rows"}.
+    Parsing (LAST row per executable key wins — a recompiled key
+    supersedes its earlier rows) is ``bench_compare.load_ledger_file``,
+    the one tool-side definition of a valid ledger row; keys whose
+    backend reported nothing contribute null-safely and are counted in
+    ``partial_rows``."""
+    rows = _import_bench_compare().load_ledger_file(path)
+    fl = [r["flops"] for r in rows.values() if r.get("flops") is not None]
+    by = [r["bytes_accessed"] for r in rows.values()
+          if r.get("bytes_accessed") is not None]
+    pk = [r["peak_bytes"] for r in rows.values()
+          if r.get("peak_bytes") is not None]
+    return {"flops": sum(fl) if fl else None,
+            "bytes_accessed": sum(by) if by else None,
+            "peak_bytes": max(pk) if pk else None,
+            "rows": len(rows),
+            "partial_rows": sum(1 for r in rows.values() if r.get("partial"))}
 
 
 def _import_pallas_kernels():
@@ -281,6 +318,10 @@ def main(argv=None):
     p.add_argument("--xla-cost", default=None,
                    help="saved compile().cost_analysis() JSON for module-"
                         "level totals")
+    p.add_argument("--ledger", default=None,
+                   help="MXNET_COST_LEDGER JSONL (compile plane, ISSUE 13) "
+                        "for module-level totals — supersedes --xla-cost, "
+                        "no hand-saved cost JSON needed")
     p.add_argument("--live-registry", action="store_true",
                    help="also pull traced costs from the in-process Pallas "
                         "registry (imports jax)")
@@ -315,8 +356,18 @@ def main(argv=None):
     rows = summarize(ops, costs, registry_aliases(), args.peak_flops,
                      args.peak_bw)
 
-    xla_totals = None
-    if args.xla_cost:
+    xla_totals = ledger_rows = None
+    if args.ledger:
+        try:
+            lt = ledger_totals(args.ledger)
+        except OSError as e:
+            print("trace_summary: cannot read %s: %s" % (args.ledger, e),
+                  file=sys.stderr)
+            return 2
+        xla_totals = {"flops": lt["flops"],
+                      "bytes_accessed": lt["bytes_accessed"]}
+        ledger_rows = lt
+    elif args.xla_cost:
         with open(args.xla_cost, encoding="utf-8") as f:
             ca = json.load(f)
         xla_totals = {"flops": ca.get("flops"),
@@ -325,6 +376,7 @@ def main(argv=None):
 
     if args.json:
         print(json.dumps({"rows": rows, "xla_totals": xla_totals,
+                          "ledger": ledger_rows,
                           "peak_flops": args.peak_flops,
                           "peak_bw": args.peak_bw,
                           "ranks": ranks}, indent=1))
@@ -339,6 +391,12 @@ def main(argv=None):
              len(costs),
              "" if not seen else "; ranks %s over %d file(s)"
              % (",".join(map(str, seen)), len(args.trace))))
+    if ledger_rows is not None:
+        print("cost ledger: %d executable(s), %d partial row(s)%s"
+              % (ledger_rows["rows"], ledger_rows["partial_rows"],
+                 "" if ledger_rows["peak_bytes"] is None else
+                 "; peak executable %.1f MB"
+                 % (ledger_rows["peak_bytes"] / 1e6)))
     if xla_totals and xla_totals["flops"] is not None:
         reg_fl = sum(r["flops"] or 0 for r in rows)
         print("XLA cost analysis: %.3f GFLOP module total; registered custom "
