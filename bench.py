@@ -22,19 +22,27 @@ import time
 import numpy as np
 
 
-def _emit(payload):
-    """Print the ONE bench JSON line; with MXNET_TELEMETRY enabled, attach
+def _emit(payload, attach_telemetry=True):
+    """Print one bench JSON line; with MXNET_TELEMETRY enabled, attach
     the telemetry block (compile_s, peak_hbm_bytes, data_wait_frac, and —
     when a Module train loop ran — dispatches_per_step, the ISSUE 3 fused
     step's regression surface, plus trainhealth_drain_s, the ISSUE 12
     health plane's whole host-side overhead; see docs/OBSERVABILITY.md)
     and flush the JSONL event log.  The line's schema is linted by
-    ci/check_bench_schema.py."""
+    ci/check_bench_schema.py.
+
+    ``attach_telemetry=False`` is for FOLLOW-UP rows in a multi-row run
+    (the ISSUE 15 per-tier predictor rows): ``telemetry.summary()`` totals
+    process-cumulative counters, so a second row would fold the first
+    row's compile/memory into its own block and bench_compare would
+    mis-attribute fp32 drift to the tier row — per-executable compile
+    cost for twins lives in the costplane ledger instead."""
     from mxnet_tpu import telemetry
 
     if telemetry.enabled():
-        telemetry.sample_memory()
-        payload["telemetry"] = telemetry.summary()
+        if attach_telemetry:
+            telemetry.sample_memory()
+            payload["telemetry"] = telemetry.summary()
         telemetry.event("bench_result", **payload)
         telemetry.flush()
     print(json.dumps(payload))
@@ -234,8 +242,15 @@ def main_predictor():
     ``graph_nodes_pre``/``graph_nodes_post``/``pass_time_s`` and
     ``compile_s`` (the first forward's trace+compile, via note_compile);
     run with MXNET_GRAPH_PASSES=0 to measure the unoptimized plan the
-    passes replace (docs/PERF_NOTES.md "Graph passes")."""
+    passes replace (docs/PERF_NOTES.md "Graph passes").
+
+    With ``MXNET_PRECISION_TIER=bf16|int8`` set (ISSUE 15) a SECOND line
+    follows for that deploy twin (``Predictor.with_precision``) — each
+    line carries the ``tier`` discriminator, so bench_compare diffs
+    fp32-vs-fp32 and twin-vs-twin but never across tiers
+    (docs/PERF_NOTES.md "Precision tiers")."""
     from mxnet_tpu.predictor import Predictor
+    from mxnet_tpu.graph_passes import precision
     from mxnet_tpu.test_utils import deploy_twin_checkpoint
 
     batch = int(os.environ.get("MXNET_BENCH_BATCH", 16))
@@ -251,23 +266,41 @@ def main_predictor():
     from mxnet_tpu import telemetry
 
     pred = Predictor(sym, params, input_shapes)
+    # the baseline row is ALWAYS the fp32 plan: with the tier env set, the
+    # bind above already built the twin, so rebuild the fp32 sibling
+    # explicitly (shared weight buffers either way)
+    tier = precision.tier()
+    if tier:
+        pred = pred.with_precision(None)
     x = rng.rand(batch, 3, image, image).astype(np.float32)
-    t0 = time.perf_counter()
-    pred.forward(data=x)
-    pred.get_output(0)
-    telemetry.note_compile(time.perf_counter() - t0, fn="predictor_fwd")
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        pred.forward(data=x)
-    pred.get_output(0)  # sync the async dispatch chain
-    dt = time.perf_counter() - t0
-    _emit({
-        "metric": "predictor_cnn_infer_samples_per_sec",
-        "value": round(batch * iters / dt, 2),
-        "unit": "samples/s",
-        "vs_baseline": None,
-    })
+    def run_one(p, label):
+        t0 = time.perf_counter()
+        p.forward(data=x)
+        p.get_output(0)
+        telemetry.note_compile(time.perf_counter() - t0,
+                               fn="predictor_fwd_%s" % label)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            p.forward(data=x)
+        p.get_output(0)  # sync the async dispatch chain
+        dt = time.perf_counter() - t0
+        _emit({
+            "metric": "predictor_cnn_infer_samples_per_sec",
+            "value": round(batch * iters / dt, 2),
+            "unit": "samples/s",
+            "vs_baseline": None,
+            "tier": label,
+        }, attach_telemetry=(label == "fp32"))
+
+    run_one(pred, "fp32")
+    if tier:
+        calibration = None
+        if tier == "int8":
+            calibration = precision.calibrate(
+                pred, ({"data": rng.rand(batch, 3, image, image)
+                        .astype(np.float32)} for _ in range(4)))
+        run_one(pred.with_precision(tier, calibration), tier)
 
 
 def main_frcnn():

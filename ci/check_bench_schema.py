@@ -17,7 +17,11 @@ from __future__ import annotations
 import json
 import sys
 
-TOP_KEYS = {"metric", "value", "unit", "vs_baseline", "telemetry"}
+# "tier" (ISSUE 15 precision tiers): the compilation tier the benched
+# plan ran under — optional (captures predating the tier read as fp32);
+# bench_compare diffs same-tier rows only, cross-tier rows display-only
+TOP_KEYS = {"metric", "value", "unit", "vs_baseline", "telemetry", "tier"}
+TIER_VALUES = {"fp32", "bf16", "int8"}
 TEL_REQ_KEYS = {"compile_s", "peak_hbm_bytes", "data_wait_frac"}
 # dispatches_per_step (ISSUE 3 fused Module step), warmup_s (ISSUE 6 AOT
 # cache restart surface), the graph-pass keys (ISSUE 7: plan nodes in/out
@@ -53,7 +57,9 @@ SERVE_OPT_KEYS = {"concurrency", "rate_rps", "batch_fill_mean",
                   "padding_waste_mean", "first_request_ms", "warmup_s",
                   # ISSUE 10 live-ops surface: per-size-class percentiles
                   # + goodput under a --slo-ms target
-                  "latency_by_class", "goodput_rps", "slo_ms"}
+                  "latency_by_class", "goodput_rps", "slo_ms",
+                  # ISSUE 15: the engine's compiled precision tier
+                  "tier"}
 SERVE_MODES = {"closed", "open"}
 
 
@@ -137,6 +143,10 @@ def validate_line(obj, where="<line>"):
     if "vs_baseline" in obj and obj["vs_baseline"] is not None \
             and not _num(obj["vs_baseline"]):
         raise SchemaError("%s: 'vs_baseline' must be a number or null" % where)
+    if "tier" in obj and obj["tier"] not in TIER_VALUES:
+        raise SchemaError("%s: 'tier' must be one of %s (omit for legacy "
+                          "fp32 captures), got %r"
+                          % (where, sorted(TIER_VALUES), obj["tier"]))
     if "telemetry" in obj:
         tel = obj["telemetry"]
         if tel is None:
@@ -271,6 +281,10 @@ def validate_serve_line(obj, where="<line>"):
     if "slo_ms" in obj and (not _num(obj["slo_ms"]) or obj["slo_ms"] <= 0):
         raise SchemaError("%s: 'slo_ms' must be a positive number (omit "
                           "the key when no target was set)" % where)
+    if "tier" in obj and obj["tier"] not in TIER_VALUES:
+        raise SchemaError("%s: 'tier' must be one of %s (omit for legacy "
+                          "fp32 captures), got %r"
+                          % (where, sorted(TIER_VALUES), obj["tier"]))
     if "latency_by_class" in obj:
         bc = obj["latency_by_class"]
         if not isinstance(bc, dict) or not bc:
@@ -374,6 +388,10 @@ def self_test():
          "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
                        "data_wait_frac": 0.0, "xla_flops": None,
                        "xla_peak_bytes": None}},
+        # ISSUE 15: per-tier deploy-twin rows
+        {"metric": "m", "value": 1, "unit": "samples/s", "tier": "fp32"},
+        {"metric": "m", "value": 1, "unit": "samples/s", "tier": "bf16"},
+        {"metric": "m", "value": 1, "unit": "samples/s", "tier": "int8"},
     ]
     bad = [
         {},                                                  # empty
@@ -434,6 +452,10 @@ def self_test():
          "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
                        "data_wait_frac": 0.0,
                        "xla_peak_bytes": -8}},           # negative peak
+        {"metric": "m", "value": 1, "unit": "img/s",
+         "tier": "fp16"},                                # unknown tier
+        {"metric": "m", "value": 1, "unit": "img/s",
+         "tier": None},                                  # null tier (omit it)
     ]
     serve_good = {"mode": "closed", "requests": 10, "completed": 9,
                   "shed": 1, "timeouts": 0, "errors": 0, "shed_rate": 0.1,
@@ -462,6 +484,8 @@ def self_test():
             "1": {"p50_ms": 5.0, "p99_ms": 2.0, "n": 3}}),
         dict(serve_good, latency_by_class={          # zero count
             "1": {"p50_ms": 1.0, "p99_ms": 2.0, "n": 0}}),
+        dict(serve_good, tier="fp16"),               # unknown tier
+        dict(serve_good, tier=None),                 # null tier (omit it)
     ]
     for obj in good:
         validate_line(obj, "self-test good")
@@ -476,6 +500,8 @@ def self_test():
                                  "1": {"p50_ms": 1.5, "p99_ms": 8.0, "n": 40},
                                  "4": {"p50_ms": 2.5, "p99_ms": 9.0, "n": 7}}),
                         "self-test serve good4")
+    validate_serve_line(dict(serve_good, tier="bf16"),
+                        "self-test serve good5")
     for i, obj in enumerate(bad):
         try:
             validate_line(obj, "self-test bad[%d]" % i)

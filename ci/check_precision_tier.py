@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Precision-tier smoke (ISSUE 15) — run from ci/run_tests.sh unit tier.
+
+Asserts the three contracts of the CastPlan-consuming deploy tier
+(``mxnet_tpu/graph_passes/precision.py``):
+
+1. **off path** — ``MXNET_PRECISION_TIER`` unset ⇒ the lowered eval plan
+   IS the structural plan (same object), ``pipeline_fingerprint()``
+   carries no tier segment, and the executor's AOT logical key is
+   byte-identical to a pre-tier build's.
+2. **bf16 twin** — on the deploy-twin checkpoint, the
+   ``Predictor.with_precision("bf16")`` twin (a) meets the tier's declared
+   rtol/atol tolerance contract vs the fp32 predictor on fixed inputs,
+   (b) removes every ``_bn_affine`` node (weight folding), and (c) shows
+   STRICTLY lower XLA ``bytes_accessed`` — and no higher peak bytes — in
+   its compile-plane ledger row than the fp32 sibling (the ISSUE 13 ruler
+   measuring the ISSUE 15 payoff; real CPU-XLA numbers).
+3. **int8 twin** — a ``calibrate()``-d twin meets the int8 tolerance
+   contract and rewrites its conv/FC nodes to int8 compute; an
+   UNCALIBRATED twin leaves every node provably untouched (no ``_int8_*``
+   op in the plan).
+"""
+import os
+import sys
+
+# costplane must be on before mxnet_tpu imports anywhere below; the tier
+# gate itself stays UNSET — twins are built explicitly so this process
+# exercises both sides
+os.environ["MXNET_COSTPLANE"] = "1"
+os.environ.pop("MXNET_PRECISION_TIER", None)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    from mxnet_tpu import graph_passes
+    from mxnet_tpu.graph_passes import precision
+    from mxnet_tpu.predictor import Predictor
+    from mxnet_tpu.telemetry import costplane
+    from mxnet_tpu.test_utils import deploy_twin_checkpoint
+
+    sym, params, shapes = deploy_twin_checkpoint(batch=8, image=32)
+    pred = Predictor(sym, params, shapes)
+    exe = pred._exec
+
+    # -- 1. off path --------------------------------------------------------
+    assert precision.tier() is None
+    fp = graph_passes.pipeline_fingerprint()
+    assert fp is not None and "tier" not in fp, fp
+    assert exe._opt_plan(False) is exe._structural_plan(False), \
+        "tier off must lower the structural plan itself"
+    assert exe._tier_key_parts(False) == (), \
+        "tier off must leave AOT key parts untouched"
+    os.environ["MXNET_PRECISION_TIER"] = "bf16"
+    try:
+        assert "tier=bf16" in graph_passes.pipeline_fingerprint()
+        env_pred = Predictor(sym, params, shapes)
+        assert env_pred.precision_tier == "bf16", \
+            "env gate must build tier twins"
+    finally:
+        del os.environ["MXNET_PRECISION_TIER"]
+    print("check_precision_tier: off-path identity + env gate ok")
+
+    # -- 2. bf16 twin -------------------------------------------------------
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 3, 32, 32).astype(np.float32)
+    out_f = [pred.forward(data=x)[i].asnumpy() for i in range(2)]
+    row_f = [r for r in costplane.rows() if r["site"] == "executor_fwd"][-1]
+
+    twin = pred.with_precision("bf16")
+    plan, _, _ = twin._exec._opt_plan(False)
+    affine = [n.name for n, _ in plan
+              if getattr(n.op, "name", "") == "_bn_affine"]
+    assert not affine, "fold_conv_affine left %s in the bf16 plan" % affine
+    n0 = costplane.row_count()
+    out_b = [twin.forward(data=x)[i].asnumpy() for i in range(2)]
+    rows_b = costplane.rows_since(n0, site="executor_fwd")
+    assert rows_b, "bf16 twin compile produced no ledger row"
+    row_b = rows_b[-1]
+
+    tol = precision.tier_tolerance("bf16")
+    for i, (a, b) in enumerate(zip(out_f, out_b)):
+        assert b.dtype == a.dtype, "head %d dtype drifted: %s" % (i, b.dtype)
+        assert np.allclose(a, b, **tol), \
+            "bf16 twin head %d breaks its tolerance contract: " \
+            "max|Δ|=%.3g (rtol=%g atol=%g)" % (
+                i, float(np.abs(a - b).max()), tol["rtol"], tol["atol"])
+    ba_f, ba_b = row_f["bytes_accessed"], row_b["bytes_accessed"]
+    pk_f, pk_b = row_f["peak_bytes"], row_b["peak_bytes"]
+    assert ba_f is not None and ba_b is not None, \
+        "CPU XLA reported no bytes_accessed — cannot gate the payoff"
+    assert ba_b < ba_f, \
+        "bf16 twin must read strictly fewer bytes: %d !< %d" % (ba_b, ba_f)
+    assert pk_b is None or pk_f is None or pk_b <= pk_f, \
+        "bf16 twin peak bytes grew: %s > %s" % (pk_b, pk_f)
+    print("check_precision_tier: bf16 twin ok (max|Δ|=%.2e; "
+          "bytes_accessed %d -> %d, peak %s -> %s)"
+          % (max(float(np.abs(a - b).max())
+                 for a, b in zip(out_f, out_b)), ba_f, ba_b, pk_f, pk_b))
+
+    # -- 3. int8 twin -------------------------------------------------------
+    table = precision.calibrate(
+        pred, ({"data": rng.rand(8, 3, 32, 32).astype(np.float32)}
+               for _ in range(4)))
+    q = pred.with_precision("int8", calibration=table)
+    planq, _, _ = q._exec._opt_plan(False)
+    q_ops = [getattr(n.op, "name", "") for n, _ in planq]
+    assert any(o.startswith("_int8_") for o in q_ops), \
+        "calibrated int8 twin rewrote nothing: %s" % q_ops
+    out_q = [q.forward(data=x)[i].asnumpy() for i in range(2)]
+    tol = precision.tier_tolerance("int8")
+    for i, (a, b) in enumerate(zip(out_f, out_q)):
+        assert np.allclose(a, b, **tol), \
+            "int8 twin head %d breaks its tolerance contract: " \
+            "max|Δ|=%.3g" % (i, float(np.abs(a - b).max()))
+
+    bare = pred.with_precision("int8")  # no calibration table
+    planb, _, _ = bare._exec._opt_plan(False)
+    assert not any(getattr(n.op, "name", "").startswith("_int8_")
+                   for n, _ in planb), \
+        "uncalibrated int8 twin must leave conv/FC nodes untouched"
+    struct, _, _ = pred._exec._structural_plan(False)
+    # fold still runs (it needs no calibration); everything NOT folded
+    # must be the structural node object itself
+    folded = {n.name for n, _ in planb} - {n.name for n, _ in struct}
+    assert not folded, "int8-without-table invented nodes: %s" % folded
+    print("check_precision_tier: int8 twin ok (calibrated rewrites %d "
+          "conv/FC, uncalibrated untouched)"
+          % sum(1 for o in q_ops if o.startswith("_int8_")))
+    print("check_precision_tier: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -94,6 +94,12 @@ case "$tier" in
     # verdict-class census + blessed-class violation counter and emit a
     # flightrec dump artifact naming the offending parameter group
     ./dev.sh python ci/check_trainhealth.py
+    # precision-tier smoke (ISSUE 15): gate off = structural plans + AOT
+    # keys byte-identical; the bf16 deploy twin must meet its rtol
+    # contract vs fp32 AND show strictly lower ledger bytes_accessed; a
+    # calibrated int8 twin meets tolerance, an uncalibrated one is
+    # provably untouched
+    ./dev.sh python ci/check_precision_tier.py
     # telemetry unit tests (tests/test_telemetry.py) run as part of tests/
     ignore=()
     for f in "${NIGHTLY_FILES[@]}"; do ignore+=(--ignore "$f"); done

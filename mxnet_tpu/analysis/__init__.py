@@ -153,13 +153,24 @@ def _avals_of(dicts, names):
     return out
 
 
-def executor_context(exe, is_train=False):
-    """Build a :class:`GraphContext` over the plan a bound Executor lowers
-    for ``is_train`` — shared by :func:`check_executor` and
-    :func:`precision_plan_executor`."""
+def executor_context(exe, is_train=False, plan="lowered"):
+    """Build a :class:`GraphContext` over a bound Executor's plan for
+    ``is_train`` — shared by :func:`check_executor` and
+    :func:`precision_plan_executor`.
+
+    ``plan="lowered"`` (default) describes what :meth:`Executor._graph_fn`
+    actually evaluates — precision-tier rewrites included (ISSUE 15), so
+    ``check()`` diagnoses the twin a tier executor really compiles.
+    ``plan="structural"`` stops after the standard pipeline — the fp32
+    graph the tier passes rewrite, which is what the CastPlan contract
+    (``precision_plan``) and the tier passes themselves are defined over;
+    the two are identical on executors with no active tier."""
     from ..graph_passes import Graph
 
-    plan, heads, const_env = exe._opt_plan(is_train)
+    if plan == "structural":
+        plan, heads, const_env = exe._structural_plan(is_train)
+    else:
+        plan, heads, const_env = exe._opt_plan(is_train)
     # hand over the raw plan only when the pass pipeline actually produced
     # a different one (gate off ⇒ _opt_plan returns exe._plan itself):
     # the drift check can never fire on an identical plan, and skipping it
@@ -183,10 +194,14 @@ def check_executor(exe, is_train=False):
 def precision_plan_executor(exe, is_train=False):
     """The :class:`numerics.CastPlan` for a bound Executor's plan — the
     implementation behind ``Executor.precision_plan()`` /
-    ``Predictor.precision_plan()`` (ISSUE 11)."""
+    ``Predictor.precision_plan()`` (ISSUE 11).  Always computed over the
+    STRUCTURAL (pre-precision-tier) plan: the CastPlan is the decision
+    artifact the tier passes consume (ISSUE 15), so it must describe the
+    fp32 graph being rewritten, not the rewrite's own output."""
     from . import numerics as _numerics
 
-    return _numerics.precision_plan(executor_context(exe, is_train))
+    return _numerics.precision_plan(
+        executor_context(exe, is_train, plan="structural"))
 
 
 from . import graph_analyzers  # noqa: E402,F401  (registers the analyzers)

@@ -116,9 +116,17 @@ _MXU_ACCUM = frozenset({"dot", "batch_dot", "FullyConnected", "Convolution",
 # stats against bf16 activations is the documented deployment norm, and
 # e.g. LayerNorm upcasts to f32 internally) — exempt from the mixed-dtype
 # and silent-downcast DIAGNOSTICS; their fp32_accum verdict still stands.
+# "_fp32_island" (the ISSUE 15 bf16 tier's reduction wrapper) manages
+# precision BY CONSTRUCTION — upcast in, fp32 accumulate, re-narrow out —
+# and "_precision_cast" is the tier's explicit region-boundary convert.
+# Neither exemption can change a diagnostic on a plan that contains no
+# tier-synthesized node, so NUMERICS_VERSION stays put: tier-off contracts
+# (and their cached executables) are untouched.
 _PRECISION_MANAGED = frozenset({"BatchNorm", "LayerNorm", "InstanceNorm",
-                                "_bn_affine", "LRN", "L2Normalization"})
-_EXPLICIT_CASTS = frozenset({"cast", "Cast", "amp_cast", "amp_multicast"})
+                                "_bn_affine", "LRN", "L2Normalization",
+                                "_fp32_island"})
+_EXPLICIT_CASTS = frozenset({"cast", "Cast", "amp_cast", "amp_multicast",
+                             "_precision_cast"})
 
 
 def _float_bits(dtype):
@@ -280,7 +288,8 @@ _PASSTHROUGH_OPS = frozenset({
     "Flatten", "Reshape", "reshape", "transpose", "SwapAxis", "slice",
     "slice_axis", "slice_like", "SliceChannel", "Crop", "expand_dims",
     "squeeze", "_copy", "identity", "BlockGrad", "stop_gradient", "cast",
-    "Cast", "broadcast_to", "broadcast_axis", "broadcast_like", "tile",
+    "Cast", "_precision_cast",
+    "broadcast_to", "broadcast_axis", "broadcast_like", "tile",
     "repeat", "reverse", "sort", "UpSampling", "Pad", "mean",
     "max", "min", "take", "batch_take", "pick", "where", "depth_to_space",
     "space_to_depth", "gather_nd", "SequenceLast", "SequenceReverse",

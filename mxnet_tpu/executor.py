@@ -48,8 +48,17 @@ class Executor:
         # and raw plans even if MXNET_GRAPH_PASSES flips mid-process (a
         # re-bind — Module.reshape, Predictor.with_shapes — re-reads it)
         self._graph_passes = graph_passes.enabled()
-        self._opt_cache = {}   # is_train -> (plan, head_names, const_env)
+        # precision tier snapshot (ISSUE 15): MXNET_PRECISION_TIER at bind
+        # time, overridable via set_precision_tier (Predictor.with_precision
+        # builds explicit twins that way).  Rides on the pass layer — with
+        # MXNET_GRAPH_PASSES=0 the tier is inert and the plan stays raw.
+        self._precision_tier = graph_passes.precision.tier() \
+            if self._graph_passes else None
+        self._calibration = None  # int8 tier's CalibrationTable, if any
+        self._opt_cache = {}     # is_train -> FINAL (plan, heads, const_env)
+        self._struct_cache = {}  # is_train -> structural (pre-tier) triple
         self._pass_stats = {}  # "train"/"eval" -> graph_passes.optimize stats
+        self._tier_stats = None  # tier-pass rows of the lowered eval plan
         self._plan = self._make_plan()
 
     # -- array plumbing -----------------------------------------------------
@@ -92,20 +101,18 @@ class Executor:
         plan, self._head_names = capture(self._symbol)
         return plan
 
-    def _opt_plan(self, is_train):
-        """The plan :meth:`_graph_fn` evaluates for ``is_train`` —
-        ``(plan, head_names, const_env)``, where ``const_env`` seeds the
-        evaluation env with pass-baked constants (None when nothing baked).
+    def _structural_plan(self, is_train):
+        """The STANDARD pipeline's result for ``is_train`` — ``(plan,
+        head_names, const_env)`` before any precision-tier rewrite.  This
+        is the plan ``precision_plan()`` describes (the CastPlan contract
+        is defined over the fp32 graph the tier rewrites) and the plan
+        :func:`graph_passes.precision.calibrate` replays.
 
         With ``MXNET_GRAPH_PASSES`` off (snapshot at bind) this returns the
         raw captured plan untouched — byte-identical lowering to a build
-        without the pass layer.  Otherwise the registered pipeline
-        (``graph_passes.optimize``) runs once per mode, its result is
-        cached for the executor's lifetime, and node-count/time stats land
-        in :meth:`pass_stats` + the telemetry registry
-        (``graph_nodes_{pre,post}_total`` / ``graph_pass_seconds_total``)."""
+        without the pass layer."""
         is_train = bool(is_train)
-        hit = self._opt_cache.get(is_train)
+        hit = self._struct_cache.get(is_train)
         if hit is None:
             if not self._graph_passes:
                 hit = (self._plan, self._head_names, None)
@@ -120,13 +127,124 @@ class Executor:
                     stats["seconds"], mode=stats["mode"])
                 hit = (list(g.entries), list(g.heads),
                        g.constants or None)
+            self._struct_cache[is_train] = hit
+        return hit
+
+    def _opt_plan(self, is_train):
+        """The plan :meth:`_graph_fn` evaluates for ``is_train`` —
+        ``(plan, head_names, const_env)``, where ``const_env`` seeds the
+        evaluation env with pass-baked constants (None when nothing baked).
+
+        = :meth:`_structural_plan`, plus — on EVAL plans of an executor
+        whose precision tier is set (ISSUE 15) — the tier pass list
+        (``graph_passes.precision``): the CastPlan-driven bf16 rewrite or
+        the calibration-based int8 rewrite, with BN-affine weight folding
+        ahead of either.  Tier unset ⇒ the structural triple verbatim
+        (byte-identical plans, the PR 7 off-path contract); train plans are
+        never tier-rewritten.  Tier pass stats append to
+        :meth:`pass_stats`'s eval row."""
+        is_train = bool(is_train)
+        hit = self._opt_cache.get(is_train)
+        if hit is None:
+            hit = self._structural_plan(is_train)
+            if self._precision_tier and not is_train:
+                from . import graph_passes
+
+                tctx = self._tier_context()
+                if tctx is None:
+                    import warnings
+
+                    warnings.warn(
+                        "MXNET_PRECISION_TIER=%s set but this executor has "
+                        "unbound inputs — no cast plan, precision tier "
+                        "skipped for this plan" % self._precision_tier)
+                else:
+                    g = graph_passes.Graph(hit[0], hit[1], hit[2])
+                    g, rows = graph_passes.precision.apply(
+                        g, self._precision_tier, tctx)
+                    # kept SEPARATE from the cached structural stats (a
+                    # struct-cache hit would otherwise re-append on every
+                    # tier change); pass_stats() composes the two
+                    self._tier_stats = {"passes": rows,
+                                        "nodes_post": g.n_nodes}
+                    hit = (list(g.entries), list(g.heads),
+                           g.constants or None)
             self._opt_cache[is_train] = hit
         return hit
 
+    def _tier_context(self):
+        """Build the :class:`graph_passes.precision.TierContext` the tier
+        passes consume — the structural-plan CastPlan (the exact artifact
+        ``precision_plan(is_train=False)`` returns), bound avals/values,
+        and the int8 calibration table.  None when inputs are unbound (a
+        cast plan over unknown dtypes would be a guess)."""
+        from . import analysis
+        from .analysis import numerics as _numerics
+        from .graph_passes import precision as _precision
+
+        ctx = analysis.executor_context(self, is_train=False,
+                                        plan="structural")
+        if not ctx.has_avals:
+            return None
+        cast_plan = _numerics.precision_plan(ctx)
+        return _precision.TierContext(
+            cast_plan=cast_plan,
+            arg_names=self._arg_names, aux_names=self._aux_names,
+            arg_avals=ctx.arg_avals, aux_avals=ctx.aux_avals,
+            arg_values={n: a._data for n, a in self.arg_dict.items()},
+            aux_values={n: a._data for n, a in self.aux_dict.items()},
+            calibration=self._calibration)
+
+    @property
+    def precision_tier(self):
+        """This executor's precision tier label: ``"bf16"``/``"int8"``, or
+        ``"fp32"`` when no tier is active — the warmup-row /
+        ``Engine.stats()`` discriminator (ISSUE 15)."""
+        return self._precision_tier or "fp32"
+
+    def set_precision_tier(self, tier, calibration=None):
+        """Override the bind-time ``MXNET_PRECISION_TIER`` snapshot —
+        how ``Predictor.with_precision`` builds explicit twins without
+        touching the process environment.  ``tier`` is ``"bf16"``,
+        ``"int8"``, or None/``"fp32"`` (clear); ``calibration`` is the
+        int8 tier's :class:`~.graph_passes.precision.CalibrationTable`.
+        Resets the plan/executable caches, so call it before (or instead
+        of re-doing) the first forward."""
+        from .graph_passes import precision as _precision
+
+        if tier in (None, "fp32"):
+            tier = None
+        elif tier not in _precision.VALID_TIERS:
+            raise ValueError("unknown precision tier %r (valid: %s)"
+                             % (tier, list(_precision.VALID_TIERS)))
+        if tier and not self._graph_passes:
+            raise ValueError(
+                "precision tiers ride on the graph-pass layer — "
+                "MXNET_GRAPH_PASSES=0 executors cannot host a %r twin"
+                % tier)
+        self._precision_tier = tier
+        self._calibration = calibration
+        self._tier_stats = None
+        self._opt_cache.clear()
+        self._fwd_cache.clear()
+        self._bwd_cache.clear()
+
     def pass_stats(self):
         """Per-mode graph-pass results (``{"train"/"eval": stats}``) for
-        the modes this executor has lowered so far; empty with passes off."""
-        return dict(self._pass_stats)
+        the modes this executor has lowered so far; empty with passes off.
+        On an eval plan a precision tier rewrote (ISSUE 15), the tier
+        passes append to the eval row's ``passes`` list and
+        ``nodes_post``/``seconds`` reflect the final plan — composed here
+        so the cached structural stats are never mutated."""
+        out = {m: dict(s) for m, s in self._pass_stats.items()}
+        tier = self._tier_stats
+        if tier is not None and "eval" in out:
+            ev = out["eval"]
+            ev["passes"] = list(ev["passes"]) + list(tier["passes"])
+            ev["nodes_post"] = tier["nodes_post"]
+            ev["seconds"] = round(
+                ev["seconds"] + sum(r["seconds"] for r in tier["passes"]), 6)
+        return out
 
     def check(self, is_train=False):
         """Run the registered graph-IR analyzers (``mxnet_tpu.analysis``,
@@ -146,9 +264,12 @@ class Executor:
         fp32_accum | fp32_only`` verdict per plan node, from the numerics
         analyzer's dtype-flow + interval + sensitivity analysis
         (``analysis.numerics``; docs/ANALYSIS.md has the verdict table).
-        This is the exact contract the ROADMAP item 3 bf16-cast pass
-        consumes; its ``fingerprint()`` changes when and only when the
-        plan or the sensitivity/analyzer registry versions change.
+        This is the exact contract the precision-tier passes consume
+        (``graph_passes/precision.py``, ISSUE 15), so the verdicts are
+        computed over the STRUCTURAL plan — the fp32 graph the tier
+        rewrites — even on an executor whose tier is active; its
+        ``fingerprint()`` changes when and only when the plan or the
+        sensitivity/analyzer registry versions change.
         Static (``jax.eval_shape``) — no compile, no device work; raises
         ``ValueError`` on an executor with unbound inputs."""
         from . import analysis
@@ -231,6 +352,24 @@ class Executor:
 
         return fn
 
+    def _tier_key_parts(self, is_train):
+        """Extra AOT logical-key parts for an active precision tier (ISSUE
+        15): the tier fingerprint (pass names:versions + numerics contract
+        versions) and, for calibrated int8 twins, the calibration-table
+        fingerprint — so two twins of one checkpoint, or one twin across a
+        re-calibration, can never share an executable.  Empty (keys
+        byte-identical to pre-tier builds) when no tier is active or for
+        train plans, which the tier never rewrites."""
+        if not self._precision_tier or is_train:
+            return ()
+        from .graph_passes import precision as _precision
+
+        parts = ("precision_tier",
+                 _precision.tier_fingerprint(self._precision_tier))
+        if self._calibration is not None:
+            parts += (self._calibration.fingerprint(),)
+        return (parts,)
+
     def _compiled(self, is_train):
         import jax
 
@@ -250,7 +389,7 @@ class Executor:
                     fn,
                     ("executor_fwd",
                      compile_cache.symbol_fingerprint(self._symbol),
-                     bool(is_train)),
+                     bool(is_train)) + self._tier_key_parts(is_train),
                     name="executor_fwd", passes_on=self._graph_passes)
             else:
                 from .telemetry import costplane
@@ -265,7 +404,8 @@ class Executor:
                         fn, "executor_fwd",
                         ("executor_fwd",
                          compile_cache.symbol_fingerprint(self._symbol),
-                         bool(is_train), self._graph_passes))
+                         bool(is_train), self._graph_passes)
+                        + self._tier_key_parts(is_train))
             self._fwd_cache[is_train] = fn
         return self._fwd_cache[is_train]
 

@@ -66,10 +66,18 @@ def pipeline():
 def pipeline_fingerprint():
     """Stable identity of the active pipeline for cache keys, or None when
     the gate is off (so disabled builds produce pre-pass-era keys,
-    byte-identical)."""
+    byte-identical).  With ``MXNET_PRECISION_TIER`` set (ISSUE 15) the
+    active tier's pass fingerprint — pass names:versions plus the numerics
+    contract versions — is appended, so tier twins can never share an
+    AOT-cache entry (or an env fingerprint) with fp32 plans; unset keeps
+    the string byte-identical to pre-tier builds."""
     if not enabled():
         return None
-    return "|".join("%s:%d" % (n, v) for n, v, _ in _PASSES)
+    fp = "|".join("%s:%d" % (n, v) for n, v, _ in _PASSES)
+    from . import precision as _precision
+
+    tier_fp = _precision.tier_fingerprint()
+    return fp if tier_fp is None else "%s|%s" % (fp, tier_fp)
 
 
 def optimize(plan, head_names, is_train):
@@ -116,3 +124,6 @@ def node_counts(symbol, is_train=False):
 
 from .ir import Graph, PlanNode, SynthOp, capture  # noqa: E402
 from . import passes  # noqa: E402,F401  (registers the standard pipeline)
+from . import precision  # noqa: E402,F401  (the ISSUE 15 deploy tier —
+#   separate pass list gated on MXNET_PRECISION_TIER, run by the Executor
+#   AFTER this pipeline on eval plans only; never enters _PASSES)

@@ -1,0 +1,733 @@
+"""Precision-tier compilation passes (ISSUE 15) — the CastPlan consumer.
+
+PR 11 shipped the *decision procedure* (``analysis/numerics.py``: per-node
+``bf16_safe | fp32_accum | fp32_only`` verdicts behind the fingerprinted
+``CastPlan`` contract) and PR 13 the *ruler* (the costplane
+bytes_accessed/peak ledger).  This module is the rewrite tier that finally
+spends the verdicts: deployment-only graph passes, in the Relay/TVM
+"trade precision for bandwidth where analysis proves it safe" spirit
+(PAPERS.md 1810.00952, 1802.04799), gated on ``MXNET_PRECISION_TIER``:
+
+``bf16`` tier — ``fold_conv_affine`` then ``bf16_cast``:
+
+* **fold_conv_affine** — a frozen-stats ``_bn_affine`` (ISSUE 7's eval
+  BatchNorm rewrite) whose only consumer is fed by a Convolution /
+  FullyConnected folds into that producer's weights at plan time: the
+  scale/shift computed from the bound gamma/beta/moving stats bakes into a
+  new constant weight (and bias), and the affine node disappears from the
+  plan entirely — the const-fold machinery (``Graph.constants``) carries
+  the folded tensors.
+* **bf16_cast** — consumes the executor's structural-plan CastPlan
+  (``Predictor.precision_plan()`` / ``Executor.precision_plan(is_train=
+  False)``): ``bf16_safe`` regions run in bf16 (inputs cast at region
+  entry, at most ONE cast node per (value, direction) — adjacent safe
+  regions share casts, so the pass never adds more converts than region
+  edges); ``fp32_accum`` contractions (Convolution/FullyConnected) take
+  bf16 operands but accumulate fp32 via ``preferred_element_type``
+  (``accum_dtype`` attr, ops/nn.py) and re-narrow their output;
+  ``fp32_accum`` reductions run inside an fp32-island wrapper (operands
+  upcast in-op, reduce in fp32, output re-narrowed); ``fp32_only`` nodes
+  are untouched and always see fp32 operands.  Plan heads are cast back to
+  their fp32-plan dtypes, so the pass-drift contract (shape_dtype
+  analyzer) holds and twins stay drop-in.
+
+``int8`` tier — ``fold_conv_affine`` then ``int8_rewrite``:
+
+* **int8_rewrite** — calibration-based: :func:`calibrate` replays real
+  batches through the structural eval plan recording per-tensor min/max
+  (the runtime refinement of the numerics interval analysis — observed
+  ranges where the static transfer functions said UNKNOWN); eligible
+  Convolution/FullyConnected nodes (calibrated data input, baked-able
+  weight, verdict not ``fp32_only``) rewrite to symmetric int8: per-channel
+  weight scales + per-tensor activation scale baked as constants, integer
+  conv/dot with int32 accumulation, fp32 dequant at the region exit.
+  Uncalibrated or ``fp32_only`` nodes are left alone — the pass quantizes
+  only what the table covers.
+
+Contracts:
+
+* **off path** — ``MXNET_PRECISION_TIER`` unset ⇒ this module rewrites
+  nothing, ``pipeline_fingerprint()`` and every AOT-cache key stay
+  byte-identical to a build without it (PR 7-style, tested).
+* **fingerprint** — :func:`tier_fingerprint` = the tier's ``name:version``
+  pass list + ``numerics.contract_fingerprint()``; it joins
+  ``pipeline_fingerprint()`` (env-gated path) and the executor's
+  AOT logical key (both paths), so a tier flip, a pass version bump, or a
+  ``SENSITIVITY_VERSION``/``NUMERICS_VERSION`` bump each miss cleanly.
+* **tolerance** — every pass declares rtol/atol vs the fp32 plan
+  (:data:`TOLERANCE`); tests and ``ci/check_precision_tier.py`` hold twins
+  to :func:`tier_tolerance` on fixed inputs, and the bf16 twin must show
+  strictly lower ledger ``bytes_accessed`` than its fp32 sibling.
+* **weights bake at first lowering** — ``fold_conv_affine`` and
+  ``int8_rewrite`` read the executor's *bound* param values when the plan
+  first lowers; mutating weights afterwards (``copy_params_from`` on a
+  live twin) leaves stale baked constants — rebuild the twin
+  (``Predictor.with_precision``) after a weight swap.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+import warnings
+
+import numpy as np
+
+from .ir import Graph, PlanNode, SynthOp, node_attr, node_out_names
+
+__all__ = ["tier", "tier_name", "tier_fingerprint", "tier_passes",
+           "tier_tolerance", "TOLERANCE", "apply", "TierContext",
+           "calibrate", "CalibrationTable"]
+
+VALID_TIERS = ("bf16", "int8")
+
+# per-pass numeric-tolerance contracts vs the fp32 plan — THE acceptance
+# surface: a pass whose rewrite cannot meet its row here must not ship a
+# version bump, it must ship a fix.  Checked in tests/test_precision_tier.py
+# and ci/check_precision_tier.py on the deploy-twin checkpoint.
+TOLERANCE = {
+    # algebraically exact modulo float reassociation (conv(x, W*s) vs
+    # conv(x, W)*s): a few ulps through a conv chain
+    "fold_conv_affine": {"rtol": 1e-4, "atol": 1e-5},
+    # bf16 keeps 8 mantissa bits; fp32 accumulation bounds the drift to
+    # per-op rounding, which compounds through the trunk
+    "bf16_cast": {"rtol": 5e-2, "atol": 5e-2},
+    # 8-bit symmetric quantization of weights AND activations: ~1/127
+    # per tensor, compounded per rewritten contraction
+    "int8_rewrite": {"rtol": 0.25, "atol": 0.1},
+}
+
+_WARNED = set()
+
+
+def _warn_once(key, msg):
+    if key not in _WARNED:
+        _WARNED.add(key)
+        warnings.warn(msg, stacklevel=3)
+
+
+def tier():
+    """The configured precision tier: ``"bf16"`` / ``"int8"``, or None when
+    ``MXNET_PRECISION_TIER`` is unset/``0`` (docs/ENV_VARS.md).  An unknown
+    value warns once and reads as off — a typo must not silently serve a
+    differently-compiled fleet (the ops_server malformed-port stance)."""
+    v = os.environ.get("MXNET_PRECISION_TIER", "").strip()
+    if not v or v == "0":
+        return None
+    if v not in VALID_TIERS:
+        _warn_once(("tier", v),
+                   "MXNET_PRECISION_TIER=%r is not one of %s — precision "
+                   "tier disabled" % (v, list(VALID_TIERS)))
+        return None
+    return v
+
+
+def tier_name(t=None):
+    """Human/report label for a tier value: the tier, or ``"fp32"`` when
+    off — the warmup-row / SERVE_BENCH ``tier`` discriminator."""
+    return (t if t is not None else tier()) or "fp32"
+
+
+def tier_passes(t):
+    """The registered ``(name, version, fn)`` pass list for ``t``, in run
+    order.  Mirrors the standard pipeline's registration-order-is-run-order
+    contract; bump a version on ANY behavior change (it enters
+    :func:`tier_fingerprint` and hence every AOT-cache key)."""
+    return _TIER_PASSES[t]
+
+
+def tier_fingerprint(t=None):
+    """Stable identity of the active tier for cache keys — the tier's
+    ``name:version`` pass list joined with the numerics contract versions
+    (``SENSITIVITY_VERSION``/``NUMERICS_VERSION``), or None when off.  A
+    registry reclassification moves this fingerprint, the AOT key, and
+    ``numerics.contract_fingerprint()`` together (tested), so an executable
+    compiled from an old CastPlan can never be restored."""
+    t = t if t is not None else tier()
+    if not t:
+        return None
+    from ..analysis import numerics
+
+    return "tier=%s|%s|%s" % (
+        t, "|".join("%s:%d" % (n, v) for n, v, _ in _TIER_PASSES[t]),
+        numerics.contract_fingerprint())
+
+
+def tier_tolerance(t):
+    """Composed rtol/atol contract for a whole tier (the loosest row among
+    its passes) — what a twin's outputs are held to vs the fp32 plan."""
+    rows = [TOLERANCE[n] for n, _, _ in _TIER_PASSES[t]]
+    return {"rtol": max(r["rtol"] for r in rows),
+            "atol": max(r["atol"] for r in rows)}
+
+
+class TierContext:
+    """Everything a tier pass may consult (built by ``Executor._opt_plan``
+    at first eval lowering):
+
+    ``cast_plan``    the structural-plan :class:`~..analysis.numerics.
+                     CastPlan` (``Executor.precision_plan(is_train=False)``)
+                     — the verdicts the bf16/int8 rewrites consume;
+    ``arg_names`` / ``aux_names`` / ``arg_avals`` / ``aux_avals``
+                     bound-input order + ShapeDtypeStructs (the abstract
+                     walk the dtype map comes from — same fields as the
+                     analysis ``GraphContext``, so ``_abstract_walk``
+                     accepts this context directly);
+    ``arg_values`` / ``aux_values``
+                     name -> bound array (device ok) for plan-time weight
+                     folding/quantization;
+    ``calibration``  optional :class:`CalibrationTable` for the int8 tier.
+    """
+
+    is_train = False  # tier passes exist for eval plans only
+
+    def __init__(self, cast_plan, arg_names, aux_names, arg_avals,
+                 aux_avals, arg_values, aux_values, calibration=None):
+        self.cast_plan = cast_plan
+        self.arg_names = list(arg_names)
+        self.aux_names = list(aux_names)
+        self.arg_avals = arg_avals
+        self.aux_avals = aux_avals
+        self.arg_values = dict(arg_values)
+        self.aux_values = dict(aux_values)
+        self.calibration = calibration
+        # fold_conv_affine renames a folded affine's output onto its
+        # producer's env name; calibration recorded ranges on the
+        # STRUCTURAL plan, so later passes must look the renamed value up
+        # under its original (affine-output) name: {new name -> old name}
+        self.calib_alias = {}
+
+    def calib_range(self, name):
+        """Calibrated (lo, hi) for an env name, resolved through any
+        fold-pass rename — None without a table or coverage."""
+        if self.calibration is None:
+            return None
+        return self.calibration.range(self.calib_alias.get(name, name))
+
+    def value_of(self, graph, name):
+        """Concrete host value for an env name (baked constant or bound
+        arg/aux), or None when the name is runtime-only."""
+        if name in graph.constants:
+            return np.asarray(graph.constants[name])
+        v = self.arg_values.get(name, self.aux_values.get(name))
+        return None if v is None else np.asarray(v)
+
+
+def apply(graph, t, ctx):
+    """Run tier ``t``'s pass list over ``graph`` -> ``(graph, rows)`` with
+    per-pass node/time stats rows shaped like ``graph_passes.optimize``'s.
+    Pure ``Graph -> Graph`` like the standard pipeline — the caller owns
+    caching and the off-path guarantee."""
+    rows = []
+    for name, version, fn in _TIER_PASSES[t]:
+        t0 = time.perf_counter()
+        n_in = graph.n_nodes
+        graph = fn(graph, ctx)
+        rows.append({"pass": name, "version": version, "nodes_in": n_in,
+                     "nodes_out": graph.n_nodes,
+                     "seconds": round(time.perf_counter() - t0, 6)})
+    if graph.constants:
+        # a later pass can supersede an earlier pass's baked constant
+        # (int8 quantizing a fold-baked fp32 weight): drop constants no
+        # surviving entry or head reads, so the dead fp32 copy doesn't
+        # stay resident per bucket for the twin's lifetime
+        used = set(graph.heads)
+        for _, in_names in graph.entries:
+            used.update(in_names)
+        if any(k not in used for k in graph.constants):
+            graph = Graph(graph.entries, graph.heads,
+                          {k: v for k, v in graph.constants.items()
+                           if k in used})
+    return graph, rows
+
+
+# -- shared plumbing ---------------------------------------------------------
+
+
+class TierOp:
+    """Duck-typed OpDef stand-in for tier-wrapped nodes.  Unlike
+    :class:`~.ir.SynthOp` it carries the WRAPPED op's ``attr_names`` and
+    ``defaults``, so ``node_call_attrs`` / ``node_attr`` / the analyzers
+    keep resolving attrs exactly as they did for the original node."""
+
+    aux = ()
+    aux_update = None
+    mutates = ()
+    inputs_fn = None
+    variadic = False
+    arg_names = ()
+
+    def __init__(self, name, fn, inner=None, attr_names=()):
+        self.name = name
+        self.fn = fn
+        self.attr_names = tuple(getattr(inner, "attr_names", attr_names))
+        self.defaults = dict(getattr(inner, "defaults", {}) or {})
+
+    def __repr__(self):
+        return "TierOp(%s)" % self.name
+
+
+def _cast_fn(x, *, dtype):  # mxlint: traced
+    # the explicit region-boundary convert the whole tier exists to insert
+    return x.astype(dtype)  # mxlint: ignore[implicit-downcast]
+
+
+_CAST_OP = SynthOp("_precision_cast", _cast_fn, attr_names=("dtype",))
+
+
+def _out_dtypes(graph, ctx):
+    """{env name -> numpy dtype} over ``graph`` via one abstract walk
+    (args/aux from avals, constants from their values, node outputs from
+    ``jax.eval_shape``) — the exact dtypes the fp32 plan lowers with."""
+    from ..analysis.graph_analyzers import _abstract_walk
+
+    dts = {}
+    for n, av in list(ctx.arg_avals.items()) + list(ctx.aux_avals.items()):
+        dts[n] = np.dtype(av.dtype)
+    for n, v in graph.constants.items():
+        dts[n] = np.asarray(v).dtype
+
+    def record(node, nm, shape, dtype, in_vals, in_names):
+        dts[nm] = np.dtype(dtype)
+
+    _abstract_walk(graph, ctx, record=record)
+    return dts
+
+
+def _consumers(graph):
+    """{env name -> number of reads} across entries + heads."""
+    n = {}
+    for _, in_names in graph.entries:
+        for nm in in_names:
+            n[nm] = n.get(nm, 0) + 1
+    for h in graph.heads:
+        n[h] = n.get(h, 0) + 1
+    return n
+
+
+def _producers(graph):
+    """{out env name -> (node, in_names)}."""
+    out = {}
+    for node, in_names in graph.entries:
+        for nm in node_out_names(node):
+            out[nm] = (node, in_names)
+    return out
+
+
+# -- pass 1: conv/FC weight folding ------------------------------------------
+
+# producers whose output-channel axis is weight axis 0 and whose data
+# layout puts channels on axis 1 of the output (the _bn_affine axis=1
+# shape) — the only geometry the fold handles
+_FOLDABLE = ("Convolution", "FullyConnected")
+
+
+def _channel_first(node):
+    opname = node.op.name
+    if opname == "FullyConnected":
+        # flattened output is (N, num_hidden): channel axis 1
+        return node_attr(node, "flatten", True)
+    layout = node_attr(node, "layout")
+    return layout is None or (len(layout) > 1 and layout[1] == "C")
+
+
+def fold_conv_affine(graph, ctx):
+    """Fold ``_bn_affine`` scale/shift into the preceding Convolution /
+    FullyConnected weights (plan-time, via baked constants); the affine
+    node is deleted and its consumers re-point at the producer."""
+    prods = _producers(graph)
+    uses = _consumers(graph)
+    rename = {}
+    entries = []
+    consts = dict(graph.constants)
+    replaced = {}  # producer node name -> (PlanNode, new in_names)
+    dropped = set()  # entry indices of folded affine nodes
+
+    for idx, (node, in_names) in enumerate(graph.entries):
+        if getattr(node.op, "name", "") != "_bn_affine" \
+                or node.num_outputs != 1 or len(in_names) != 5:
+            continue
+        data_nm = in_names[0]
+        prod = prods.get(data_nm)
+        if prod is None:
+            continue
+        pnode, pin = prod
+        if getattr(pnode.op, "name", "") not in _FOLDABLE \
+                or pnode.num_outputs != 1 or not _channel_first(pnode) \
+                or pnode.name in replaced:
+            continue
+        if uses.get(data_nm, 0) != 1:
+            # another consumer reads the un-affined producer output —
+            # folding would change what it sees
+            continue
+        ax = node_attr(node, "axis", 1)
+        # the fold rescales weight axis 0 = the producer's CHANNEL axis:
+        # conv outputs are N-D channel-first, where only axis 1 is
+        # channels (-1 would be the trailing spatial dim — and can pass
+        # the length guard whenever C_out equals it); FC outputs are 2-D
+        # (N, num_hidden), where 1 and -1 coincide
+        if getattr(pnode.op, "name", "") == "FullyConnected":
+            if ax not in (1, -1):
+                continue
+        elif ax != 1:
+            continue
+        vals = [ctx.value_of(graph, nm) for nm in in_names[1:]]
+        if any(v is None for v in vals):
+            continue  # unbound affine params: leave the node in place
+        if len(pin) < 2:
+            continue
+        w = ctx.value_of(graph, pin[1])
+        if w is None:
+            continue
+        bias = ctx.value_of(graph, pin[2]) if len(pin) > 2 else None
+        if len(pin) > 2 and bias is None:
+            # the producer HAS a bias but it is a runtime-computed value
+            # (a node output, not a bound arg/const) — folding would
+            # silently drop the bias term from the twin
+            continue
+        gamma, beta, mean, var = (v.astype(np.float32) for v in vals)
+        eps = node_attr(node, "eps", 1e-3)
+        eps = 1e-3 if eps is None else float(eps)
+        if node_attr(node, "fix_gamma", True):
+            gamma = np.ones_like(gamma)
+        scale = gamma / np.sqrt(var + eps)
+        shift = beta - mean * scale
+        if w.shape[0] != scale.shape[0]:
+            continue  # channel mismatch (grouped exotic layout): skip
+        w2 = (w.astype(np.float32)
+              * scale.reshape((-1,) + (1,) * (w.ndim - 1))).astype(w.dtype)
+        b2 = (bias.astype(np.float32) * scale + shift).astype(np.float32) \
+            if bias is not None else shift.astype(np.float32)
+        wc, bc = "%s__folded_weight" % pnode.name, \
+            "%s__folded_bias" % pnode.name
+        consts[wc], consts[bc] = w2, b2
+        attrs = dict(pnode.attrs)
+        attrs["no_bias"] = False
+        new = PlanNode(pnode.op, attrs, pnode.name)
+        replaced[pnode.name] = (new, (pin[0], wc, bc))
+        dropped.add(idx)
+        rename["%s_output" % node.name] = data_nm
+        # downstream consumers now read the affined value under the
+        # producer's name — point calibration lookups at the range the
+        # structural plan recorded for it (the affine output's)
+        ctx.calib_alias[data_nm] = "%s_output" % node.name
+
+    if not replaced:
+        return graph
+    for idx, (node, in_names) in enumerate(graph.entries):
+        if idx in dropped:
+            continue
+        if node.name in replaced and getattr(node.op, "name", "") \
+                in _FOLDABLE:
+            node, in_names = replaced[node.name]
+        entries.append((node, tuple(rename.get(n, n) for n in in_names)))
+    return Graph(entries, (rename.get(h, h) for h in graph.heads), consts)
+
+
+# -- pass 2: the bf16 cast pass ----------------------------------------------
+
+_F32 = np.dtype("float32")
+_BF16 = "bfloat16"
+
+# contractions for which ops/nn.py grew explicit fp32 accumulation
+# (``accum_dtype``): bf16 operands, preferred_element_type=float32, output
+# re-narrowed in-op.  Other fp32_accum ops go through the fp32 island.
+_ACCUM_DTYPE_OPS = ("Convolution", "FullyConnected")
+
+
+def _island_fn(inner):
+    """The fp32-island wrapper: low-precision float operands upcast to
+    fp32 INSIDE the op, the reduction/cancellation chain runs entirely in
+    fp32, and float outputs re-narrow to bf16 at the exit — the jaxpr
+    shows convert(f32) -> reduce(f32) -> convert(bf16), which is the
+    verifiable "keep an fp32 accumulator" contract."""
+
+    def fn(*args, **attrs):  # mxlint: traced
+        import jax.numpy as jnp
+
+        up = [a.astype(jnp.float32)
+              if getattr(getattr(a, "dtype", None), "itemsize", 4) <= 2
+              and jnp.issubdtype(getattr(a, "dtype", np.float32),
+                                 jnp.floating) else a
+              for a in args]
+        res = inner.fn(*up, **attrs)
+        outs = res if isinstance(res, tuple) else (res,)
+        outs = tuple(
+            o.astype(jnp.bfloat16)  # mxlint: ignore[implicit-downcast]
+            if jnp.issubdtype(o.dtype, jnp.floating) else o for o in outs)
+        return outs if isinstance(res, tuple) else outs[0]
+
+    return fn
+
+
+def bf16_cast(graph, ctx):
+    """The CastPlan consumer (module docstring): bf16_safe regions run
+    bf16, fp32_accum keeps fp32 accumulation, fp32_only stays untouched;
+    one cast node max per (value, direction); heads re-widen."""
+    verdicts = {r["node"]: r["verdict"] for r in ctx.cast_plan.rows}
+    dts = _out_dtypes(graph, ctx)
+    holds = {}        # env name -> "bf16" when the rewritten plan narrowed it
+    casts = {}        # (env name, want) -> cast output env name
+    entries = []
+
+    def request(nm, want):
+        """Env name providing ``nm``'s value in ``want`` ("bf16"|"f32");
+        inserts (and caches) at most one cast node per direction."""
+        if dts.get(nm) != _F32:
+            return nm  # non-f32 values never participate
+        have = holds.get(nm, "f32")
+        if have == want:
+            return nm
+        key = (nm, want)
+        hit = casts.get(key)
+        if hit is not None:
+            return hit
+        dtype = _BF16 if want == "bf16" else "float32"
+        cnode = PlanNode(_CAST_OP, {"dtype": dtype},
+                         "%s__to_%s" % (nm, want))
+        out = node_out_names(cnode)[0]
+        entries.append((cnode, (nm,)))
+        casts[key] = out
+        return out
+
+    for node, in_names in graph.entries:
+        opname = getattr(node.op, "name", "")
+        verdict = verdicts.get(node.name)
+        out_nm = node_out_names(node)
+        # a node with no float32 operand to narrow (e.g. a surviving
+        # random_* source) must stay untouched: its output would remain
+        # f32 while the bookkeeping claimed bf16, and a downstream
+        # contraction would see mixed operand dtypes
+        has_f32_in = any(dts.get(n) == _F32 for n in in_names)
+        rewriteable = (verdict in ("bf16_safe", "fp32_accum")
+                       and node.num_outputs == 1 and has_f32_in
+                       and dts.get(out_nm[0]) == _F32
+                       and opname != "_precision_cast")
+        if not rewriteable:
+            # fp32_only / unknown / non-f32: the node must see the fp32
+            # plan's operand dtypes — re-widen anything a safe region
+            # narrowed upstream
+            entries.append((node, tuple(request(n, "f32")
+                                        for n in in_names)))
+            continue
+        if verdict == "bf16_safe":
+            entries.append((node, tuple(request(n, "bf16")
+                                        for n in in_names)))
+        elif opname in _ACCUM_DTYPE_OPS:
+            attrs = dict(node.attrs)
+            attrs["accum_dtype"] = "float32"
+            attrs["out_dtype"] = _BF16
+            entries.append((PlanNode(node.op, attrs, node.name),
+                            tuple(request(n, "bf16") for n in in_names)))
+        else:
+            # fp32 island: operands feed through AS HELD (no boundary cast
+            # nodes) — an fp32 original enters untouched, a bf16 region
+            # value upcasts inside the wrapper, so the island adds zero
+            # graph-level converts either way
+            entries.append((PlanNode(
+                TierOp("_fp32_island", _island_fn(node.op), inner=node.op),
+                dict(node.attrs), node.name, node.num_outputs), in_names))
+        holds[out_nm[0]] = "bf16"
+
+    heads = tuple(request(h, "f32") for h in graph.heads)
+    if not holds and not casts:
+        return graph
+    return Graph(entries, heads, graph.constants)
+
+
+# -- pass 3: calibration-based int8 rewrite ----------------------------------
+
+
+class CalibrationTable:
+    """Observed per-tensor ranges from :func:`calibrate` — ``{env name ->
+    (lo, hi)}`` plus the batch count, fingerprinted so an int8 twin's AOT
+    key moves when (and only when) the calibration data moves."""
+
+    __slots__ = ("ranges", "batches")
+
+    def __init__(self, ranges, batches=0):
+        self.ranges = {str(k): (float(lo), float(hi))
+                       for k, (lo, hi) in ranges.items()}
+        self.batches = int(batches)
+
+    def range(self, name):
+        return self.ranges.get(name)
+
+    def fingerprint(self):
+        blob = json.dumps(
+            {k: [round(v[0], 6), round(v[1], 6)]
+             for k, v in sorted(self.ranges.items())}, sort_keys=True)
+        return "calib-" + hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+    def __repr__(self):
+        return "CalibrationTable(%d tensors, %d batches, %s)" % (
+            len(self.ranges), self.batches, self.fingerprint())
+
+
+def calibrate(predictor, batches):
+    """Record per-tensor min/max over ``batches`` (iterable of
+    ``{input name -> array}``) through the predictor's STRUCTURAL eval plan
+    (tier passes excluded — calibration describes the fp32 graph the int8
+    rewrite will replace) -> :class:`CalibrationTable`.
+
+    This is the runtime refinement of the numerics interval analysis: the
+    static transfer functions bound what they can prove, this records what
+    the deployment's data actually produces.  Evaluation is eager jax on
+    the bound executor (no jit, no plan mutation); feed O(10) representative
+    batches — the table's maxabs drives every activation scale."""
+    from .ir import node_call_attrs
+
+    exe = predictor._exec
+    plan, _heads, const_env = exe._structural_plan(False)
+    import jax
+
+    key = jax.random.PRNGKey(0)
+    lo, hi = {}, {}
+
+    def note(nm, v):
+        arr = np.asarray(v)
+        if arr.dtype.kind != "f" or arr.size == 0:
+            return
+        l, h = float(arr.min()), float(arr.max())
+        if np.isnan(l) or np.isnan(h):
+            return
+        lo[nm] = min(lo.get(nm, l), l)
+        hi[nm] = max(hi.get(nm, h), h)
+
+    n_batches = 0
+    for batch in batches:
+        n_batches += 1
+        env = dict(const_env) if const_env else {}
+        for n, a in exe.arg_dict.items():
+            env[n] = a._data
+        for n, a in exe.aux_dict.items():
+            env[n] = a._data
+        for n, v in batch.items():
+            env[n] = np.asarray(v, np.float32)
+        for node, in_names in plan:
+            attrs = node_call_attrs(node, key, False)
+            res = node.op.fn(*[env[n] for n in in_names], **attrs)
+            outs = res if isinstance(res, tuple) else (res,)
+            if len(outs) > 1 and node.num_outputs == 1:
+                outs = outs[:1]
+            for nm, o in zip(node_out_names(node), outs):
+                env[nm] = o
+        for nm, v in env.items():
+            note(nm, v)
+    return CalibrationTable({k: (lo[k], hi[k]) for k in lo},
+                            batches=n_batches)
+
+
+def _int8_conv_fn(data, wq, wscale, bias=None, **attrs):  # mxlint: traced
+    """Symmetric int8 conv: quantize the activation per-tensor, integer
+    conv with int32 accumulation (the quantized_conv.cc shape —
+    ops/quantization.py), fp32 dequant by a_scale * per-channel w_scale."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.nn import _tup
+
+    a_scale = attrs["a_scale"]
+    s = _tup(attrs.get("stride"), 2)
+    d = _tup(attrs.get("dilate"), 2)
+    p = _tup(attrs.get("pad") if attrs.get("pad") is not None else 0, 2)
+    xq = jnp.clip(jnp.round(data / a_scale), -127.0, 127.0) \
+        .astype(jnp.int8)  # mxlint: ignore[implicit-downcast]
+    out32 = jax.lax.conv_general_dilated(
+        xq.astype(jnp.int32), wq.astype(jnp.int32), window_strides=s,
+        padding=[(pi, pi) for pi in p], rhs_dilation=d,
+        feature_group_count=attrs.get("num_group", 1),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    out = out32.astype(jnp.float32) * (a_scale * wscale.reshape(1, -1, 1, 1))
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def _int8_fc_fn(data, wq, wscale, bias=None, **attrs):  # mxlint: traced
+    """Symmetric int8 dense: per-tensor activation scale, per-channel
+    weight scales, int32 accumulation, fp32 dequant."""
+    import jax
+    import jax.numpy as jnp
+
+    a_scale = attrs["a_scale"]
+    x = data.reshape(data.shape[0], -1) if attrs.get("flatten", True) \
+        else data
+    xq = jnp.clip(jnp.round(x / a_scale), -127.0, 127.0) \
+        .astype(jnp.int8)  # mxlint: ignore[implicit-downcast]
+    out32 = jax.lax.dot_general(
+        xq.astype(jnp.int32), wq.astype(jnp.int32),
+        (((x.ndim - 1,), (1,)), ((), ())))
+    out = out32.astype(jnp.float32) * (a_scale * wscale)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def int8_rewrite(graph, ctx):
+    """Rewrite calibrated Convolution/FullyConnected nodes to int8 compute
+    (module docstring).  Coverage rules: data input calibrated, weight
+    value baked-able, verdict not fp32_only — everything else untouched."""
+    if ctx.calibration is None:
+        return graph
+    verdicts = {r["node"]: r["verdict"] for r in ctx.cast_plan.rows}
+    dts = _out_dtypes(graph, ctx)
+    consts = dict(graph.constants)
+    entries = []
+    changed = False
+
+    for node, in_names in graph.entries:
+        opname = getattr(node.op, "name", "")
+        verdict = verdicts.get(node.name)
+        ok = (opname in _FOLDABLE and node.num_outputs == 1
+              and verdict is not None and verdict != "fp32_only"
+              and _channel_first(node) and len(in_names) >= 2
+              and dts.get(in_names[0]) == _F32)
+        if ok and opname == "Convolution":
+            kern = node_attr(node, "kernel")
+            ok = kern is not None and len(tuple(np.atleast_1d(kern))) == 2 \
+                and node_attr(node, "layout") in (None, "NCHW")
+        # ranges recorded on the structural plan, resolved through any
+        # fold rename — a conv/FC fed by a folded BN quantizes with the
+        # AFFINED activation range, not the pre-BN one
+        rng = ctx.calib_range(in_names[0]) if ok else None
+        w = ctx.value_of(graph, in_names[1]) if ok else None
+        if not ok or rng is None or w is None:
+            entries.append((node, in_names))
+            continue
+        a_max = max(abs(rng[0]), abs(rng[1]))
+        if not np.isfinite(a_max) or a_max <= 0.0:
+            entries.append((node, in_names))
+            continue
+        a_scale = float(a_max / 127.0)
+        wf = w.astype(np.float32)
+        chan_max = np.abs(wf).reshape(wf.shape[0], -1).max(axis=1)
+        chan_max = np.where(chan_max > 0, chan_max, 1.0)
+        w_scale = (chan_max / 127.0).astype(np.float32)
+        wq = np.clip(
+            np.round(wf / w_scale.reshape((-1,) + (1,) * (wf.ndim - 1))),
+            -127, 127).astype(np.int8)
+        wc = "%s__int8_weight" % node.name
+        sc = "%s__int8_scale" % node.name
+        consts[wc], consts[sc] = wq, w_scale
+        fn = _int8_conv_fn if opname == "Convolution" else _int8_fc_fn
+        op = TierOp("_int8_%s" % opname.lower(), fn, inner=node.op)
+        op.attr_names = tuple(op.attr_names) + ("a_scale",)
+        attrs = dict(node.attrs)
+        attrs["a_scale"] = a_scale
+        new_in = (in_names[0], wc, sc) + tuple(in_names[2:3])
+        entries.append((PlanNode(op, attrs, node.name), new_in))
+        changed = True
+
+    if not changed:
+        return graph
+    return Graph(entries, graph.heads, consts)
+
+
+_TIER_PASSES = {
+    "bf16": (("fold_conv_affine", 1, fold_conv_affine),
+             ("bf16_cast", 1, bf16_cast)),
+    "int8": (("fold_conv_affine", 1, fold_conv_affine),
+             ("int8_rewrite", 1, int8_rewrite)),
+}

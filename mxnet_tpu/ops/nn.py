@@ -49,19 +49,36 @@ def _fc_params(attrs, shapes):
 
 
 @register("FullyConnected", inputs_fn=_fc_inputs, infer_params=_fc_params)
-def fully_connected(data, weight, bias=None, *, num_hidden, no_bias=False, flatten=True):
+def fully_connected(data, weight, bias=None, *, num_hidden, no_bias=False,
+                    flatten=True, accum_dtype=None, out_dtype=None):
     """Dense layer (reference src/operator/nn/fully_connected.cc).
 
     weight: (num_hidden, in_dim) — MXNet convention.  data flattened to 2D if
     ``flatten`` else applied to the last axis.  One MXU matmul.
+
+    ``accum_dtype``/``out_dtype`` are the precision-tier hooks (ISSUE 15,
+    graph_passes/precision.py): the bf16 cast pass sets
+    ``accum_dtype="float32"`` so low-precision operands still contract into
+    an fp32 accumulator (``preferred_element_type``), and
+    ``out_dtype="bfloat16"`` re-narrows the result at the op exit.  Unset
+    (every non-tier plan) the lowering is byte-identical to before.
     """
     if flatten:
         x = data.reshape(data.shape[0], -1)
     else:
         x = data
-    out = jnp.matmul(x, weight.T)
+    if accum_dtype is not None:
+        out = jax.lax.dot_general(
+            x, weight, (((x.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.dtype(accum_dtype))
+    else:
+        out = jnp.matmul(x, weight.T)
     if not no_bias and bias is not None:
         out = out + bias
+    if out_dtype is not None:
+        # the precision tier's explicit exit narrowing (the cast IS the
+        # point of the pass that sets this attr)
+        out = out.astype(out_dtype)  # mxlint: ignore[implicit-downcast]
     return out
 
 
@@ -107,18 +124,28 @@ def convolution(
     cudnn_off=False,
     workspace=1024,
     layout=None,
+    accum_dtype=None,
+    out_dtype=None,
 ):
     """N-D convolution (reference src/operator/nn/convolution.cc, im2col.h).
 
     Maps directly to ``lax.conv_general_dilated`` → XLA conv → MXU.  The
     reference's im2col/cuDNN machinery has no TPU analog: XLA tiles the conv
     onto the systolic array itself.
+
+    ``accum_dtype``/``out_dtype``: precision-tier hooks (ISSUE 15) — see
+    ``fully_connected``.  ``accum_dtype`` forces the contraction's
+    ``preferred_element_type`` (eval twins only: an explicit accumulator
+    dtype breaks the conv transpose rule under AD — see the fp32 note
+    below); ``out_dtype`` re-narrows at the op exit.  Unset keeps the
+    lowering byte-identical.
     """
     kernel = _tup(kernel, len(kernel) if hasattr(kernel, "__len__") else 2)
     n = len(kernel)
     stride = _tup(stride, n)
     dilate = _tup(dilate, n)
     pad = _tup(pad, n) if pad is not None else (0,) * n
+    pet = None if accum_dtype is None else jnp.dtype(accum_dtype)
     if (n == 2 and layout in (None, "NCHW")
             and os.environ.get("MXNET_CONV_INTERNAL_LAYOUT") == "NHWC"):
         # experiment knob: run the conv channels-last internally (NCHW kept
@@ -131,10 +158,12 @@ def convolution(
         out = jax.lax.conv_general_dilated(
             xt, wt, window_strides=stride, padding=[(p, p) for p in pad],
             rhs_dilation=dilate, dimension_numbers=dnt,
-            feature_group_count=num_group)
+            feature_group_count=num_group, preferred_element_type=pet)
         out = jnp.transpose(out, (0, 3, 1, 2))
         if not no_bias and bias is not None:
             out = out + bias.reshape(1, -1, 1, 1)
+        if out_dtype is not None:
+            out = out.astype(out_dtype)  # mxlint: ignore[implicit-downcast]
         return out
     dn = jax.lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dims(n, layout))
     out = jax.lax.conv_general_dilated(
@@ -145,16 +174,21 @@ def convolution(
         rhs_dilation=dilate,
         dimension_numbers=dn,
         feature_group_count=num_group,
-        # no preferred_element_type: the MXU accumulates in f32 regardless and
-        # bf16 output storage is the mixed-precision contract; forcing an f32
-        # output also breaks the conv transpose rule under AD (cotangent dtype
-        # mismatch)
+        # default preferred_element_type=None: the MXU accumulates in f32
+        # regardless and bf16 output storage is the mixed-precision
+        # contract; forcing an f32 output also breaks the conv transpose
+        # rule under AD (cotangent dtype mismatch) — only the eval-plan
+        # precision tier (ISSUE 15) sets accum_dtype
+        preferred_element_type=pet,
     )
     if not no_bias and bias is not None:
         c_axis = (layout or "NC").index("C")
         bshape = [1] * out.ndim
         bshape[c_axis] = -1
         out = out + bias.reshape(bshape)
+    if out_dtype is not None:
+        # precision-tier exit narrowing (ISSUE 15): the cast is the point
+        out = out.astype(out_dtype)  # mxlint: ignore[implicit-downcast]
     return out
 
 

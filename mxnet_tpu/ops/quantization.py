@@ -46,7 +46,10 @@ def quantize(data, min_range, max_range, *, out_type="uint8"):
     if out_type == "uint8":
         lo, hi, qrange = _qrange("uint8")
         scale = qrange / (mx_ - mn)
-        q = jnp.clip((data - mn) * scale + 0.5, lo, hi).astype(jnp.uint8)
+        # the quantize OP's whole job is this narrowing (reference
+        # quantize-inl.h); range/scale saturate first
+        q = jnp.clip((data - mn) * scale + 0.5, lo, hi).astype(
+            jnp.uint8)  # mxlint: ignore[implicit-downcast]
         return q, mn.reshape(1), mx_.reshape(1)
     real_range = _maxabs(mn, mx_)
     from .pallas_kernels import quantize_int8_pallas, supported as _pallas_ok
@@ -55,7 +58,9 @@ def quantize(data, min_range, max_range, *, out_type="uint8"):
         q = quantize_int8_pallas(data, real_range)
     else:
         scale = 127.0 / real_range
-        q = (jnp.sign(data) * jnp.minimum(jnp.abs(data) * scale + 0.5, 127.0)).astype(jnp.int8)
+        # symmetric int8 quantize: the cast IS the operator contract,
+        # saturated at +-127 first
+        q = (jnp.sign(data) * jnp.minimum(jnp.abs(data) * scale + 0.5, 127.0)).astype(jnp.int8)  # mxlint: ignore[implicit-downcast]
     return q, (-real_range).reshape(1), real_range.reshape(1)
 
 
@@ -92,7 +97,9 @@ def requantize(data, min_range, max_range, *, min_calib_range=None, max_calib_ra
     else:
         real_out = jnp.max(jnp.abs(fval))
     scale = 127.0 / real_out
-    q = (jnp.sign(fval) * jnp.minimum(jnp.abs(fval) * scale + 0.5, 127.0)).astype(jnp.int8)
+    # int32->int8 requantize: narrowing is the op's documented output
+    # contract, saturated first
+    q = (jnp.sign(fval) * jnp.minimum(jnp.abs(fval) * scale + 0.5, 127.0)).astype(jnp.int8)  # mxlint: ignore[implicit-downcast]
     return q, (-real_out).reshape(1), real_out.reshape(1)
 
 

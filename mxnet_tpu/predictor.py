@@ -216,21 +216,65 @@ class Predictor:
         disturb ``self``; unlike re-calling ``Predictor(...)`` it re-parses
         nothing, and weight device buffers are shared wherever the deploy
         dtype matches the stored dtype (``NDArray._rebind`` keeps the same
-        jax array), so N buckets cost ~1x the weights in HBM."""
+        jax array), so N buckets cost ~1x the weights in HBM.  An explicit
+        precision tier (``with_precision``) carries over, so every bucket
+        of a twin serves the same tier."""
         clone = object.__new__(Predictor)
         clone._init_bound(self._symbol, self._dtype, self._ctx,
                           self._arg_params, self._aux_params, input_shapes)
+        if clone._exec._precision_tier != self._exec._precision_tier \
+                or self._exec._calibration is not None:
+            clone._exec.set_precision_tier(self._exec._precision_tier,
+                                           self._exec._calibration)
         return clone
+
+    def with_precision(self, tier, calibration=None):
+        """The precision-tier twin of this predictor (ISSUE 15): same
+        symbol, same loaded params — weight device buffers shared exactly
+        like ``with_shapes``, so one checkpoint serves fp32 and bf16/int8
+        twins side by side for ~1x the weights in HBM — but the eval plan
+        is rewritten by the ``tier`` pass list (``graph_passes/precision``):
+        ``"bf16"`` = CastPlan-driven bf16 regions with fp32 accumulation,
+        ``"int8"`` = calibration-based int8 conv/FC (pass the
+        :func:`graph_passes.precision.calibrate` table — without one the
+        int8 rewrite has no coverage and leaves every node alone);
+        ``"fp32"``/None = a plain twin with any ambient
+        ``MXNET_PRECISION_TIER`` explicitly cleared.
+
+        The twin's outputs are held to the tier's declared tolerance
+        contract vs this (fp32) predictor
+        (``graph_passes.precision.tier_tolerance``); its AOT-cache keys
+        carry the tier + calibration fingerprints, so twins never share
+        executables with their fp32 sibling."""
+        clone = object.__new__(Predictor)
+        clone._init_bound(self._symbol, self._dtype, self._ctx,
+                          self._arg_params, self._aux_params,
+                          dict(self._input_shapes))
+        clone._exec.set_precision_tier(tier, calibration)
+        return clone
+
+    @property
+    def precision_tier(self):
+        """This predictor's tier label — ``"fp32"``, ``"bf16"``, or
+        ``"int8"`` (the warmup-row / SERVE_BENCH discriminator)."""
+        return self._exec.precision_tier
 
     def reshape(self, input_shapes):
         """Re-specialize to new input shapes (``MXPredReshape``) — a new jit
         signature; weight buffers are reused in place (``Executor.reshape``
         keeps same-shaped arrays; shape-changing weights is an error, same
-        as the reference's shape check)."""
+        as the reference's shape check).  An explicit precision tier
+        (``with_precision``) carries across the re-bind, exactly like
+        ``with_shapes`` — a reshaped twin keeps serving its tier."""
         shapes = dict(self._input_shapes)
         shapes.update(input_shapes)
         self._input_shapes = shapes
-        self._exec = self._exec.reshape(**shapes)
+        old = self._exec
+        self._exec = old.reshape(**shapes)
+        if self._exec._precision_tier != old._precision_tier \
+                or old._calibration is not None:
+            self._exec.set_precision_tier(old._precision_tier,
+                                          old._calibration)
         want = (self._dtype if self._dtype == "bfloat16"
                 else str(np.dtype(self._dtype)))
         for n in self._input_names:

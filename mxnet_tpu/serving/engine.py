@@ -703,6 +703,10 @@ class Engine:
                 "graph_nodes_post": ps["nodes_post"] if ps else None,
                 "check_warnings": checked,
                 "precision_verdicts": verdicts,
+                # the tier this bucket's plan compiled under (ISSUE 15):
+                # "fp32" unless MXNET_PRECISION_TIER rewrote it — always
+                # present, so mixed-tier fleets read straight off /statusz
+                "precision_tier": pred._exec.precision_tier,
                 # XLA-measured cost of this bucket's executable (ISSUE 13;
                 # None with MXNET_COSTPLANE off, on a cache hit, or when
                 # the backend reports nothing — the partial-row contract)
@@ -734,6 +738,11 @@ class Engine:
                if r.get("xla_flops") is not None]
         wpk = [r.get("xla_peak_bytes") for r in report
                if r.get("xla_peak_bytes") is not None]
+        # precision tier across the warmed ladder (ISSUE 15): one value
+        # when every bucket compiled the same tier (the normal case —
+        # buckets snapshot the same gate), "mixed" if a fleet ever serves
+        # heterogeneous twins through one engine
+        tiers = {r.get("precision_tier") or "fp32" for r in report}
         with self._stats_mu:
             self._warmup = {
                 "buckets": len(report),
@@ -751,6 +760,9 @@ class Engine:
                 # cast-plan verdict histogram across all warmed buckets
                 # (ISSUE 11) — same gate, same None-when-off contract
                 "precision_verdicts": verdicts,
+                # the ladder's compiled tier (ISSUE 15; always present)
+                "precision_tier": (tiers.pop() if len(tiers) == 1
+                                   else "mixed"),
                 "xla_flops": sum(wfl) if wfl else None,
                 "xla_peak_bytes": max(wpk) if wpk else None,
                 "total_s": round(total_s, 4)}
@@ -823,6 +835,10 @@ class Engine:
             out["cache_size"] = len(self._cache) + len(self._direct_cache)
         out["ladder"] = [repr(b) for b in
                          self.ladder.signatures(self.sample_shapes)]
+        # the tier this engine's plans compile under (ISSUE 15): "fp32"
+        # unless MXNET_PRECISION_TIER rewrote them — the SERVE_BENCH /
+        # /statusz discriminator (per-bucket values live in the warmup rows)
+        out["precision_tier"] = self._proto._exec.precision_tier
         # live ops plane (ISSUE 10): the streaming SLO block (None when
         # MXNET_SLO is off — the monitor never exists) and the device-loop
         # heartbeat age (None until the loop first ticks).  Both read

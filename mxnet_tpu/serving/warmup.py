@@ -51,7 +51,10 @@ def warmup_engine(engine, buckets=None, verbose=False, max_workers=None):
     (``Predictor.check()``, ISSUE 8; None with ``MXNET_GRAPH_ANALYZERS``
     off) and ``precision_verdicts`` is the bucket plan's cast-plan verdict
     histogram (``Predictor.precision_plan().counts()``, ISSUE 11; same
-    gate, None when off); ``xla_flops`` / ``xla_peak_bytes`` are the
+    gate, None when off); ``precision_tier`` is the tier the bucket's plan
+    compiled under (``"fp32"`` unless ``MXNET_PRECISION_TIER`` rewrote it,
+    ISSUE 15 — always present, so mixed-tier fleets are inspectable from
+    ``/statusz``); ``xla_flops`` / ``xla_peak_bytes`` are the
     XLA-measured cost of the executable this bucket's warm built
     (compile plane, ISSUE 13; None with ``MXNET_COSTPLANE`` off, on a
     cache hit, or when the backend reports nothing).
@@ -109,6 +112,8 @@ def warmup_engine(engine, buckets=None, verbose=False, max_workers=None):
                     "%d fp32_only]" % (v.get("bf16_safe", 0),
                                        v.get("fp32_accum", 0),
                                        v.get("fp32_only", 0))
+            if row.get("precision_tier") not in (None, "fp32"):
+                state += "  [tier: %s]" % row["precision_tier"]
             print("warmup %-28s %s" % (row["bucket"], state))
     total_s = time.perf_counter() - t0
     engine._note_warmup(report, total_s)

@@ -1,0 +1,520 @@
+"""Precision-tier compilation tests (ISSUE 15) —
+``mxnet_tpu/graph_passes/precision.py``: the CastPlan-driven bf16 pass,
+conv/FC weight folding, and the calibration-based int8 rewrite, plus the
+off-path identity and fingerprint-drift contracts."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import compile_cache, graph_passes
+from mxnet_tpu.analysis import numerics
+from mxnet_tpu.graph_passes import precision
+from mxnet_tpu.predictor import Predictor
+from mxnet_tpu.test_utils import deploy_twin_checkpoint
+
+
+@pytest.fixture()
+def deploy_pred():
+    sym, params, shapes = deploy_twin_checkpoint(batch=4, image=16)
+    return Predictor(sym, params, shapes)
+
+
+def _fixed_input(batch=4, image=16, seed=0):
+    return np.random.RandomState(seed).rand(
+        batch, 3, image, image).astype(np.float32)
+
+
+def _outs(pred, x):
+    return [o.asnumpy() for o in pred.forward(data=x)]
+
+
+# -- off path ----------------------------------------------------------------
+
+
+def test_off_path_plan_and_key_identity(deploy_pred, monkeypatch):
+    monkeypatch.delenv("MXNET_PRECISION_TIER", raising=False)
+    exe = deploy_pred._exec
+    assert precision.tier() is None
+    assert exe._precision_tier is None
+    # the lowered plan IS the structural plan (no rebuild, no rewrite)
+    assert exe._opt_plan(False) is exe._structural_plan(False)
+    # AOT logical key carries no tier parts
+    assert exe._tier_key_parts(False) == ()
+    fp = graph_passes.pipeline_fingerprint()
+    assert fp and "tier" not in fp
+
+
+def test_invalid_tier_value_reads_as_off(monkeypatch):
+    monkeypatch.setenv("MXNET_PRECISION_TIER", "fp8")
+    with pytest.warns(UserWarning, match="MXNET_PRECISION_TIER"):
+        assert precision.tier() is None
+
+
+def test_env_gate_builds_twin(monkeypatch):
+    sym, params, shapes = deploy_twin_checkpoint(batch=2, image=16)
+    monkeypatch.setenv("MXNET_PRECISION_TIER", "bf16")
+    pred = Predictor(sym, params, shapes)
+    assert pred.precision_tier == "bf16"
+    assert "tier=bf16" in graph_passes.pipeline_fingerprint()
+    plan, _, _ = pred._exec._opt_plan(False)
+    assert any(getattr(n.op, "name", "") == "_precision_cast"
+               for n, _ in plan)
+
+
+# -- bf16 tier ---------------------------------------------------------------
+
+
+def test_bf16_twin_tolerance_and_shared_buffers(deploy_pred):
+    x = _fixed_input()
+    base = _outs(deploy_pred, x)
+    twin = deploy_pred.with_precision("bf16")
+    assert twin.precision_tier == "bf16"
+    # shared weight buffers: same loaded param NDArrays under both
+    w0 = deploy_pred._arg_params["conv0_weight"]
+    assert twin._arg_params["conv0_weight"] is w0
+    outs = _outs(twin, x)
+    tol = precision.tier_tolerance("bf16")
+    for a, b in zip(base, outs):
+        assert b.dtype == a.dtype  # heads re-widen: drop-in twin
+        np.testing.assert_allclose(a, b, **tol)
+
+
+def test_bf16_fold_removes_bn_affine(deploy_pred):
+    twin = deploy_pred.with_precision("bf16")
+    plan, _, const_env = twin._exec._opt_plan(False)
+    ops = [getattr(n.op, "name", "") for n, _ in plan]
+    assert "_bn_affine" not in ops
+    assert "BatchNorm" not in ops
+    assert any(k.endswith("__folded_weight") for k in (const_env or {}))
+
+
+def test_bf16_fp32_accum_visible_in_jaxpr(deploy_pred):
+    """fp32_accum contract, asserted on the jaxpr: conv/dot eqns with bf16
+    operands must carry preferred_element_type=float32, and every
+    reduce-class island must reduce over f32 operands."""
+    import jax
+
+    twin = deploy_pred.with_precision("bf16")
+    exe = twin._exec
+    args = exe._aot_example_args()
+    jaxpr = jax.make_jaxpr(exe._graph_fn(False))(*args)
+    contractions = []
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name in ("conv_general_dilated", "dot_general"):
+                in_dts = {str(v.aval.dtype) for v in eqn.invars
+                          if hasattr(v, "aval")}
+                if "bfloat16" in in_dts:
+                    contractions.append(eqn)
+            for sub in eqn.params.values():
+                if hasattr(sub, "jaxpr"):
+                    walk(sub.jaxpr)
+    walk(jaxpr.jaxpr)
+    assert contractions, "no bf16 contraction traced — the tier was inert"
+    for eqn in contractions:
+        pet = eqn.params.get("preferred_element_type")
+        assert str(pet) == "float32", \
+            "%s with bf16 operands accumulates in %s" % (
+                eqn.primitive.name, pet)
+        assert str(eqn.outvars[0].aval.dtype) == "float32", \
+            "accumulator output must be f32 before the exit narrowing"
+
+
+def test_bf16_islands_wrap_reductions(deploy_pred):
+    """Non-MXU fp32_accum nodes (avg-pool, L2Norm) become _fp32_island
+    wrappers; fp32_only nodes (the unbounded softmax) stay untouched."""
+    twin = deploy_pred.with_precision("bf16")
+    plan, _, _ = twin._exec._opt_plan(False)
+    by_name = {n.name: getattr(n.op, "name", "") for n, _ in plan}
+    cast_plan = deploy_pred.precision_plan()
+    for row in cast_plan.rows:
+        op = by_name.get(row["node"])
+        if op is None:
+            continue  # folded away
+        if row["verdict"] == "fp32_only":
+            assert op == row["op"], \
+                "fp32_only node %s was rewritten to %s" % (row["node"], op)
+        if row["verdict"] == "fp32_accum" \
+                and row["op"] not in ("Convolution", "FullyConnected"):
+            assert op == "_fp32_island", \
+                "fp32_accum reduction %s (%s) is not islanded: %s" % (
+                    row["node"], row["op"], op)
+
+
+def test_bf16_cast_economy(deploy_pred):
+    """At most one cast node per (value, direction): no duplicate casts of
+    the same env name, no cast feeding another cast (sandwich), and no
+    DEAD cast — every convert the pass inserts is consumed (islands take
+    their operands as held and must not leave orphaned casts behind)."""
+    twin = deploy_pred.with_precision("bf16")
+    plan, heads, _ = twin._exec._opt_plan(False)
+    used = set(heads)
+    for _, in_names in plan:
+        used.update(in_names)
+    cast_srcs = []
+    cast_outs = set()
+    for n, in_names in plan:
+        if getattr(n.op, "name", "") == "_precision_cast":
+            cast_srcs.append((in_names[0], n.attrs["dtype"]))
+            assert in_names[0] not in cast_outs, \
+                "cast sandwich: %s re-casts a cast output" % n.name
+            out = "%s_output" % n.name
+            cast_outs.add(out)
+            assert out in used, "dead cast node %s (never consumed)" % n.name
+    assert len(cast_srcs) == len(set(cast_srcs)), \
+        "duplicate casts of one value: %s" % cast_srcs
+
+
+def test_with_shapes_carries_tier(deploy_pred):
+    twin = deploy_pred.with_precision("bf16")
+    sib = twin.with_shapes({"data": (2, 3, 16, 16)})
+    assert sib.precision_tier == "bf16"
+    plan, _, _ = sib._exec._opt_plan(False)
+    assert any(getattr(n.op, "name", "") == "_precision_cast"
+               for n, _ in plan)
+
+
+def test_train_plans_never_tier_rewritten(deploy_pred):
+    exe = deploy_pred.with_precision("bf16")._exec
+    assert exe._opt_plan(True) is exe._structural_plan(True)
+    assert exe._tier_key_parts(True) == ()
+
+
+# -- int8 tier ---------------------------------------------------------------
+
+
+def test_int8_calibrated_twin_tolerance(deploy_pred):
+    rng = np.random.RandomState(1)
+    x = _fixed_input()
+    base = _outs(deploy_pred, x)
+    table = precision.calibrate(
+        deploy_pred,
+        ({"data": rng.rand(4, 3, 16, 16).astype(np.float32)}
+         for _ in range(3)))
+    assert table.batches == 3 and table.ranges
+    twin = deploy_pred.with_precision("int8", calibration=table)
+    plan, _, const_env = twin._exec._opt_plan(False)
+    q_ops = [getattr(n.op, "name", "") for n, _ in plan
+             if getattr(n.op, "name", "").startswith("_int8_")]
+    assert q_ops, "calibrated int8 twin rewrote nothing"
+    # baked int8 weights really are int8
+    wq = [v for k, v in (const_env or {}).items()
+          if k.endswith("__int8_weight")]
+    assert wq and all(np.asarray(w).dtype == np.int8 for w in wq)
+    outs = _outs(twin, x)
+    tol = precision.tier_tolerance("int8")
+    for a, b in zip(base, outs):
+        assert b.dtype == a.dtype
+        np.testing.assert_allclose(a, b, **tol)
+
+
+def test_int8_uncalibrated_untouched(deploy_pred):
+    twin = deploy_pred.with_precision("int8")  # no table: zero coverage
+    plan, _, _ = twin._exec._opt_plan(False)
+    assert not any(getattr(n.op, "name", "").startswith("_int8_")
+                   for n, _ in plan)
+    # fp32_only nodes are never quantized even when calibrated
+    table = precision.calibrate(
+        twin, [{"data": _fixed_input(seed=2)}])
+    full = twin.with_precision("int8", calibration=table)
+    planf, _, _ = full._exec._opt_plan(False)
+    by_name = {n.name: getattr(n.op, "name", "") for n, _ in planf}
+    for row in full.precision_plan().rows:
+        if row["verdict"] == "fp32_only" and row["node"] in by_name:
+            assert not by_name[row["node"]].startswith("_int8_")
+
+
+def test_int8_after_fold_uses_affined_range(monkeypatch):
+    """A conv/FC fed DIRECTLY by a folded BN must quantize with the
+    affined activation range, not the pre-BN producer's (fold renames the
+    BN output onto the conv's env name; calibration recorded the
+    structural names — the pass resolves through the rename)."""
+    sym_data = mx.sym.var("data")
+    h = mx.sym.Convolution(sym_data, name="c0", kernel=(1, 1), num_filter=4)
+    # gamma scales activations 20x: quantizing with the pre-BN range would
+    # clip the FC input at ~1/20th of its real magnitude
+    h = mx.sym.BatchNorm(h, name="bn0", fix_gamma=False)
+    h = mx.sym.Flatten(h)
+    out = mx.sym.FullyConnected(h, name="fc0", num_hidden=3)
+
+    rng = np.random.RandomState(0)
+    shapes = {"data": (2, 3, 4, 4)}
+    arg_shapes, _, aux_shapes = out.infer_shape(**shapes)
+    params = {}
+    for n, s in zip(out.list_arguments(), arg_shapes):
+        if n != "data":
+            params["arg:" + n] = mx.nd.array(
+                rng.randn(*s).astype(np.float32) * 0.2)
+    params["arg:bn0_gamma"] = mx.nd.array(np.full((4,), 20.0, np.float32))
+    for n, s in zip(out.list_auxiliary_states(), aux_shapes):
+        params["aux:" + n] = mx.nd.array(
+            np.ones(s, np.float32) if n.endswith("_var")
+            else np.zeros(s, np.float32))
+    pred = Predictor(out, params, shapes)
+    x = rng.rand(2, 3, 4, 4).astype(np.float32)
+    base = _outs(pred, x)
+    table = precision.calibrate(
+        pred, ({"data": rng.rand(2, 3, 4, 4).astype(np.float32)}
+               for _ in range(3)))
+    twin = pred.with_precision("int8", calibration=table)
+    plan, _, _ = twin._exec._opt_plan(False)
+    ops = [getattr(n.op, "name", "") for n, _ in plan]
+    assert "_bn_affine" not in ops and "_int8_fullyconnected" in ops
+    outs = _outs(twin, x)
+    tol = precision.tier_tolerance("int8")
+    for a, b in zip(base, outs):
+        np.testing.assert_allclose(a, b, **tol)
+
+
+def test_fold_rejects_negative_axis_on_conv():
+    """_bn_affine axis=-1 over a 4-D conv output scales the WIDTH axis —
+    the fold must refuse even when C_out coincidentally equals the
+    trailing spatial dim (the length guard alone would pass)."""
+    data = mx.sym.var("data")
+    h = mx.sym.Convolution(data, name="c0", kernel=(1, 1), num_filter=4)
+    out = mx.sym.BatchNorm(h, name="bn0", fix_gamma=False, axis=-1)
+    rng = np.random.RandomState(0)
+    shapes = {"data": (2, 3, 4, 4)}  # output (2, 4, 4, 4): C == W == 4
+    arg_shapes, _, aux_shapes = out.infer_shape(**shapes)
+    params = {}
+    for n, s in zip(out.list_arguments(), arg_shapes):
+        if n != "data":
+            params["arg:" + n] = mx.nd.array(
+                rng.randn(*s).astype(np.float32))
+    for n, s in zip(out.list_auxiliary_states(), aux_shapes):
+        params["aux:" + n] = mx.nd.array(
+            np.ones(s, np.float32) if n.endswith("_var")
+            else np.zeros(s, np.float32))
+    pred = Predictor(out, params, shapes)
+    x = rng.rand(2, 3, 4, 4).astype(np.float32)
+    base = _outs(pred, x)
+    twin = pred.with_precision("bf16")
+    plan, _, _ = twin._exec._opt_plan(False)
+    assert "_bn_affine" in [getattr(n.op, "name", "") for n, _ in plan], \
+        "axis=-1 conv affine must NOT fold"
+    outs = _outs(twin, x)
+    tol = precision.tier_tolerance("bf16")
+    for a, b in zip(base, outs):
+        np.testing.assert_allclose(a, b, **tol)
+
+
+def test_fold_refuses_runtime_computed_bias():
+    """A conv/FC whose bias is a NODE OUTPUT (not a bound arg/const) must
+    not fold — folding would silently drop the bias term."""
+    data = mx.sym.var("data")
+    bsrc = mx.sym.var("bsrc")
+    bias = mx.sym.elemwise_mul(bsrc, bsrc, name="bexpr")
+    fc = mx.sym.FullyConnected(data, bias=bias, name="fc0", num_hidden=4)
+    out = mx.sym.BatchNorm(fc, name="bn0", fix_gamma=False)
+    rng = np.random.RandomState(0)
+    shapes = {"data": (2, 5), "bsrc": (4,)}
+    arg_shapes, _, aux_shapes = out.infer_shape(**shapes)
+
+    def bind():
+        exe = out.simple_bind(grad_req="null", **shapes)
+        for n, s in zip(out.list_arguments(), arg_shapes):
+            if n == "data":
+                exe.arg_dict[n][:] = rng2.rand(*s).astype(np.float32)
+            else:
+                exe.arg_dict[n][:] = _seeded(n, s)
+        for n, s in zip(out.list_auxiliary_states(), aux_shapes):
+            exe.aux_dict[n][:] = (np.ones(s, np.float32)
+                                  if n.endswith("_var")
+                                  else np.zeros(s, np.float32))
+        return exe
+
+    def _seeded(n, s):
+        return np.random.RandomState(abs(hash(n)) % 2**31) \
+            .randn(*s).astype(np.float32) * 3.0
+
+    rng2 = np.random.RandomState(1)
+    base_exe = bind()
+    rng2 = np.random.RandomState(1)
+    twin_exe = bind()
+    twin_exe.set_precision_tier("bf16")
+    base = [o.asnumpy() for o in base_exe.forward(is_train=False)]
+    outs = [o.asnumpy() for o in twin_exe.forward(is_train=False)]
+    plan, _, _ = twin_exe._opt_plan(False)
+    assert "_bn_affine" in [getattr(n.op, "name", "") for n, _ in plan], \
+        "runtime-bias conv must NOT fold"
+    tol = precision.tier_tolerance("bf16")
+    for a, b in zip(base, outs):
+        np.testing.assert_allclose(a, b, **tol)
+
+
+def test_int8_prunes_superseded_fold_constant():
+    """int8 quantizing a fold-baked conv weight must drop the dead fp32
+    copy from Graph.constants (no duplicated resident weights)."""
+    data = mx.sym.var("data")
+    h = mx.sym.Convolution(data, name="c0", kernel=(1, 1), num_filter=4)
+    h = mx.sym.BatchNorm(h, name="bn0", fix_gamma=False)
+    out = mx.sym.Activation(h, act_type="relu", name="r0")
+    rng = np.random.RandomState(0)
+    shapes = {"data": (2, 3, 4, 4)}
+    arg_shapes, _, aux_shapes = out.infer_shape(**shapes)
+    params = {}
+    for n, s in zip(out.list_arguments(), arg_shapes):
+        if n != "data":
+            params["arg:" + n] = mx.nd.array(
+                rng.randn(*s).astype(np.float32))
+    for n, s in zip(out.list_auxiliary_states(), aux_shapes):
+        params["aux:" + n] = mx.nd.array(
+            np.ones(s, np.float32) if n.endswith("_var")
+            else np.zeros(s, np.float32))
+    pred = Predictor(out, params, shapes)
+    table = precision.calibrate(
+        pred, [{"data": rng.rand(2, 3, 4, 4).astype(np.float32)}])
+    twin = pred.with_precision("int8", calibration=table)
+    plan, _, const_env = twin._exec._opt_plan(False)
+    assert any(getattr(n.op, "name", "").startswith("_int8_")
+               for n, _ in plan)
+    used = {nm for _, ins in plan for nm in ins}
+    assert "c0__int8_weight" in (const_env or {})
+    assert "c0__folded_weight" not in (const_env or {}), \
+        "superseded fp32 fold constant left resident"
+    assert all(k in used for k in (const_env or {}))
+
+
+def test_reshape_carries_tier(deploy_pred):
+    twin = deploy_pred.with_precision("bf16")
+    twin.reshape({"data": (2, 3, 16, 16)})
+    assert twin.precision_tier == "bf16"
+    plan, _, _ = twin._exec._opt_plan(False)
+    assert any(getattr(n.op, "name", "") == "_precision_cast"
+               for n, _ in plan)
+    x = np.random.RandomState(0).rand(2, 3, 16, 16).astype(np.float32)
+    outs = _outs(twin, x)
+    assert outs[0].shape == (2, 10)
+
+
+def test_pass_stats_stable_across_tier_changes(deploy_pred):
+    """Re-setting the tier (or clearing it) must not duplicate or leak
+    tier pass rows — the cached structural stats are never mutated."""
+    exe = deploy_pred.with_precision("bf16")._exec
+    exe._opt_plan(False)
+    once = [r["pass"] for r in exe.pass_stats()["eval"]["passes"]]
+    exe.set_precision_tier("bf16")
+    exe._opt_plan(False)
+    again = [r["pass"] for r in exe.pass_stats()["eval"]["passes"]]
+    assert once == again, "tier rows duplicated across re-sets"
+    assert once.count("bf16_cast") == 1
+    exe.set_precision_tier(None)
+    cleared = exe.pass_stats()["eval"]
+    assert "bf16_cast" not in [r["pass"] for r in cleared["passes"]]
+    assert cleared["nodes_post"] == len(exe._opt_plan(False)[0])
+
+
+def test_calibration_fingerprint_moves_with_data(deploy_pred):
+    t1 = precision.calibrate(deploy_pred, [{"data": _fixed_input(seed=3)}])
+    t2 = precision.calibrate(deploy_pred, [{"data": _fixed_input(seed=4)}])
+    t1b = precision.calibrate(deploy_pred, [{"data": _fixed_input(seed=3)}])
+    assert t1.fingerprint() == t1b.fingerprint()
+    assert t1.fingerprint() != t2.fingerprint()
+
+
+# -- fingerprints / AOT keys -------------------------------------------------
+
+
+def _exec_key(pred):
+    """The CachedFunction logical key the eval forward would persist
+    under (AOT cache active or not, the key parts are what matter)."""
+    exe = pred._exec
+    return repr(("executor_fwd",
+                 compile_cache.symbol_fingerprint(exe._symbol),
+                 False) + exe._tier_key_parts(False))
+
+
+def test_tier_enters_aot_key_and_calibration_too(deploy_pred):
+    base_key = _exec_key(deploy_pred)
+    b16 = _exec_key(deploy_pred.with_precision("bf16"))
+    assert base_key != b16 and "tier=bf16" in b16
+    table = precision.calibrate(deploy_pred, [{"data": _fixed_input()}])
+    q1 = _exec_key(deploy_pred.with_precision("int8", calibration=table))
+    q2 = _exec_key(deploy_pred.with_precision("int8"))
+    assert q1 != q2 and table.fingerprint() in q1
+
+
+def test_contract_drift_moves_everything_together(deploy_pred, monkeypatch):
+    """ISSUE 15 satellite: bump SENSITIVITY_VERSION and the precision-pass
+    fingerprint, the AOT logical key, and numerics.contract_fingerprint()
+    must all move together — a stale executable misses cleanly."""
+    old_contract = numerics.contract_fingerprint()
+    old_tier_fp = precision.tier_fingerprint("bf16")
+    old_key = _exec_key(deploy_pred.with_precision("bf16"))
+    assert old_contract in old_tier_fp and old_tier_fp in old_key
+
+    monkeypatch.setattr(numerics, "SENSITIVITY_VERSION",
+                        numerics.SENSITIVITY_VERSION + 1)
+    new_contract = numerics.contract_fingerprint()
+    new_tier_fp = precision.tier_fingerprint("bf16")
+    new_key = _exec_key(deploy_pred.with_precision("bf16"))
+    assert new_contract != old_contract
+    assert new_tier_fp != old_tier_fp and new_contract in new_tier_fp
+    assert new_key != old_key and new_tier_fp in new_key
+    # the environment fingerprint's "numerics" entry moves too (the other
+    # half of the clean-miss story)
+    assert compile_cache._env_fingerprint()["numerics"] == new_contract
+
+
+def test_precision_plan_describes_structural_plan(deploy_pred):
+    """The CastPlan contract surface stays defined over the fp32 graph the
+    tier rewrites — identical fingerprints on the twin and its sibling."""
+    twin = deploy_pred.with_precision("bf16")
+    assert twin.precision_plan().fingerprint() \
+        == deploy_pred.precision_plan().fingerprint()
+
+
+def test_pass_stats_append_tier_rows(deploy_pred):
+    twin = deploy_pred.with_precision("bf16")
+    twin._exec._opt_plan(False)
+    passes = [r["pass"] for r in twin.pass_stats()["eval"]["passes"]]
+    assert passes[-2:] == ["fold_conv_affine", "bf16_cast"]
+
+
+def test_set_precision_tier_requires_pass_layer(monkeypatch):
+    monkeypatch.setenv("MXNET_GRAPH_PASSES", "0")
+    sym, params, shapes = deploy_twin_checkpoint(batch=2, image=16)
+    pred = Predictor(sym, params, shapes)
+    with pytest.raises(ValueError, match="graph-pass layer"):
+        pred._exec.set_precision_tier("bf16")
+
+
+# -- serving surface ---------------------------------------------------------
+
+
+def test_warmup_rows_carry_precision_tier():
+    from mxnet_tpu import serving
+    from mxnet_tpu.serving.bucketing import BucketLadder
+    from mxnet_tpu.test_utils import tiny_mlp_checkpoint
+
+    sym, params = tiny_mlp_checkpoint()
+    eng = serving.Engine(sym, params, {"data": (8,)},
+                         ladder=BucketLadder((1, 2)), start=False)
+    try:
+        report = eng.warmup()
+        assert report and all(r["precision_tier"] == "fp32" for r in report)
+        stats = eng.stats()
+        assert stats["warmup"]["precision_tier"] == "fp32"
+        assert stats["precision_tier"] == "fp32"
+    finally:
+        eng.close()
+
+
+def test_warmup_rows_carry_bf16_tier(monkeypatch):
+    from mxnet_tpu import serving
+    from mxnet_tpu.serving.bucketing import BucketLadder
+
+    monkeypatch.setenv("MXNET_PRECISION_TIER", "bf16")
+    sym, params, shapes = deploy_twin_checkpoint(batch=2, image=16)
+    eng = serving.Engine(sym, params, {"data": shapes["data"][1:]},
+                         ladder=BucketLadder((2,)), start=False)
+    try:
+        report = eng.warmup()
+        assert report and all(r["precision_tier"] == "bf16" for r in report)
+        assert eng.stats()["warmup"]["precision_tier"] == "bf16"
+        assert eng.stats()["precision_tier"] == "bf16"
+    finally:
+        eng.close()

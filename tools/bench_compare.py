@@ -61,6 +61,9 @@ def load_bench(path, obj):
                          "directly or under 'parsed')" % path)
     tel = line.get("telemetry") or {}
     return {"file": path, "metric": str(line["metric"]),
+            # precision-tier discriminator (ISSUE 15): captures predating
+            # the tier read as fp32; cross-tier rows never gate
+            "tier": str(line.get("tier") or "fp32"),
             "value": float(line["value"]), "unit": str(line.get("unit", "")),
             "dispatches_per_step": tel.get("dispatches_per_step"),
             "compile_s": tel.get("compile_s"),
@@ -172,6 +175,7 @@ def load_serve(path, obj):
             raise ValueError("%s: not a SERVE_BENCH capture (missing %r)"
                              % (path, req))
     return {"file": path, "mode": str(line["mode"]),
+            "tier": str(line.get("tier") or "fp32"),
             "throughput_rps": line.get("throughput_rps"),
             "goodput_rps": line.get("goodput_rps"),
             "latency_ms_p50": line.get("latency_ms_p50"),
@@ -180,17 +184,19 @@ def load_serve(path, obj):
 
 
 def compare_serve(rows, threshold, gate_p99=False):
-    """→ (table_rows, regressions).  Baseline = rows[0]; only same-MODE
-    rows are compared (a closed-loop capture against an open-loop one is a
+    """→ (table_rows, regressions).  Baseline = rows[0]; only same-MODE,
+    same-TIER rows are compared (a closed-loop capture against an open-loop
+    one — or an fp32 engine against its bf16/int8 twin, ISSUE 15 — is a
     configuration difference, like a metric-name mismatch on the bench
-    axis).  All deltas are shown; only ``--gate-p99`` makes p99 growth
-    beyond the threshold a regression (ISSUE 10, mirroring
-    ``--gate-warmup``): latency tails are noisy across hosts, so the gate
-    is opt-in for pipelines whose runs share a machine + load shape."""
+    axis; cross-tier rows display for context, never gate).  All deltas are
+    shown; only ``--gate-p99`` makes p99 growth beyond the threshold a
+    regression (ISSUE 10, mirroring ``--gate-warmup``): latency tails are
+    noisy across hosts, so the gate is opt-in for pipelines whose runs
+    share a machine + load shape."""
     base = rows[0]
     table, regressions = [], []
     for r in rows:
-        same = r["mode"] == base["mode"]
+        same = r["mode"] == base["mode"] and r["tier"] == base["tier"]
         dt = (_pct(r["throughput_rps"], base["throughput_rps"])
               if same and r is not base else None)
         d50 = (_pct(r["latency_ms_p50"], base["latency_ms_p50"])
@@ -210,12 +216,13 @@ def compare_serve(rows, threshold, gate_p99=False):
 
 
 def render_serve_table(table):
-    cols = ["file", "mode", "rps", "Δrps%", "goodput", "p50_ms", "Δp50%",
-            "p99_ms", "Δp99%", "shed"]
+    cols = ["file", "mode", "tier", "rps", "Δrps%", "goodput", "p50_ms",
+            "Δp50%", "p99_ms", "Δp99%", "shed"]
     out = [cols]
     for r in table:
         mode = r["mode"] + ("" if r["same_mode"] else " (≠ baseline)")
-        out.append([r["file"], mode, _fmt(r["throughput_rps"], "%.4g"),
+        out.append([r["file"], mode, r["tier"],
+                    _fmt(r["throughput_rps"], "%.4g"),
                     _fmt(r["thr_delta_pct"], "%+.1f"),
                     _fmt(r["goodput_rps"], "%.4g"),
                     _fmt(r["latency_ms_p50"], "%.4g"),
@@ -227,7 +234,7 @@ def render_serve_table(table):
     lines = []
     for i, row in enumerate(out):
         lines.append("  ".join(
-            c.ljust(widths[j]) if j < 2 else c.rjust(widths[j])
+            c.ljust(widths[j]) if j < 3 else c.rjust(widths[j])
             for j, c in enumerate(row)))
         if i == 0:
             lines.append("  ".join("-" * w for w in widths))
@@ -364,15 +371,18 @@ def _pct(new, base):
 
 
 def compare(rows, threshold, gate_warmup=False):
-    """→ (table_rows, regressions).  Baseline = rows[0]; only same-metric
-    rows are gated.  ``gate_warmup`` opts the ``warmup_s`` delta into the
-    gate (ISSUE 9): shown-only by default because a cold capture against a
-    warm one is a configuration difference, but a pipeline that pins its
-    cache setup can enforce restart-time regressions too."""
+    """→ (table_rows, regressions).  Baseline = rows[0]; only same-metric,
+    same-TIER rows are gated (ISSUE 15: a bf16/int8 deploy-twin row
+    against an fp32 baseline is a configuration difference — shown for
+    context, never a regression).  ``gate_warmup`` opts the ``warmup_s``
+    delta into the gate (ISSUE 9): shown-only by default because a cold
+    capture against a warm one is a configuration difference, but a
+    pipeline that pins its cache setup can enforce restart-time
+    regressions too."""
     base = rows[0]
     table, regressions = [], []
     for r in rows:
-        same = r["metric"] == base["metric"]
+        same = r["metric"] == base["metric"] and r["tier"] == base["tier"]
         dv = _pct(r["value"], base["value"]) if same and r is not base else None
         dd = (_pct(r["dispatches_per_step"], base["dispatches_per_step"])
               if same and r is not base else None)
@@ -423,13 +433,13 @@ def _fmt_nodes(r):
 
 
 def render_table(table):
-    cols = ["file", "metric", "value", "Δvalue%", "disp/step", "Δdisp%",
-            "compile_s", "Δcompile%", "warmup_s", "Δwarmup%", "nodes",
-            "Δnodes%", "wait_frac"]
+    cols = ["file", "metric", "tier", "value", "Δvalue%", "disp/step",
+            "Δdisp%", "compile_s", "Δcompile%", "warmup_s", "Δwarmup%",
+            "nodes", "Δnodes%", "wait_frac"]
     out = [cols]
     for r in table:
         metric = r["metric"] + ("" if r["same_metric"] else " (≠ baseline)")
-        out.append([r["file"], metric, _fmt(r["value"]),
+        out.append([r["file"], metric, r["tier"], _fmt(r["value"]),
                     _fmt(r["value_delta_pct"], "%+.1f"),
                     _fmt(r["dispatches_per_step"], "%.3g"),
                     _fmt(r["dps_delta_pct"], "%+.1f"),
@@ -444,7 +454,7 @@ def render_table(table):
     lines = []
     for i, row in enumerate(out):
         lines.append("  ".join(
-            c.ljust(widths[j]) if j < 2 else c.rjust(widths[j])
+            c.ljust(widths[j]) if j < 3 else c.rjust(widths[j])
             for j, c in enumerate(row)))
         if i == 0:
             lines.append("  ".join("-" * w for w in widths))

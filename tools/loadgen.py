@@ -283,6 +283,10 @@ def run(engine, shapes, args, mode, first_request_ms=None, warmup_s=None,
         "goodput_rps": round(collector.good / duration, 2)
         if duration else 0.0,
         "slo_ms": collector.slo_ms if collector.slo_ms > 0 else None,
+        # precision-tier discriminator (ISSUE 15): "fp32" unless
+        # MXNET_PRECISION_TIER rewrote this engine's plans — bench_compare
+        # diffs same-tier rows only, cross-tier rows are display-only
+        "tier": stats.get("precision_tier") or "fp32",
     }
     line = {k: v for k, v in line.items() if v is not None}
     print("SERVE_BENCH " + json.dumps(line))
