@@ -59,7 +59,12 @@ SERVE_OPT_KEYS = {"concurrency", "rate_rps", "batch_fill_mean",
                   # + goodput under a --slo-ms target
                   "latency_by_class", "goodput_rps", "slo_ms",
                   # ISSUE 15: the engine's compiled precision tier
-                  "tier"}
+                  "tier",
+                  # ISSUE 16 quality plane: {tier: {p50, p99, n,
+                  # violations}} over shadow-sampled contract fractions —
+                  # absent when MXNET_QUALITYPLANE is off or nothing was
+                  # sampled during the run
+                  "divergence"}
 SERVE_MODES = {"closed", "open"}
 
 
@@ -311,6 +316,38 @@ def validate_serve_line(obj, where="<line>"):
                 raise SchemaError(
                     "%s: latency_by_class[%r] p99 below p50 — percentiles "
                     "swapped?" % (where, k))
+    if "divergence" in obj:
+        div = obj["divergence"]
+        if not isinstance(div, dict) or not div:
+            raise SchemaError(
+                "%s: 'divergence' must be a non-empty object of "
+                "tier -> {p50, p99, n, violations} (omit the key when the "
+                "quality plane is off)" % where)
+        for k, v in div.items():
+            if k not in TIER_VALUES:
+                raise SchemaError(
+                    "%s: divergence tier must be one of %s, got %r"
+                    % (where, sorted(TIER_VALUES), k))
+            if not isinstance(v, dict) \
+                    or set(v) != {"p50", "p99", "n", "violations"}:
+                raise SchemaError(
+                    "%s: divergence[%r] must be an object with exactly "
+                    "{p50, p99, n, violations}" % (where, k))
+            for ck in ("n", "violations"):
+                if not isinstance(v[ck], int) or isinstance(v[ck], bool) \
+                        or v[ck] < 0:
+                    raise SchemaError(
+                        "%s: divergence[%r].%s must be a non-negative int"
+                        % (where, k, ck))
+            for pk in ("p50", "p99"):
+                if not _num(v[pk]) or v[pk] < 0:
+                    raise SchemaError(
+                        "%s: divergence[%r].%s must be a non-negative "
+                        "number" % (where, k, pk))
+            if v["p99"] < v["p50"]:
+                raise SchemaError(
+                    "%s: divergence[%r] p99 below p50 — percentiles "
+                    "swapped?" % (where, k))
 
 
 def validate_capture(path):
@@ -486,6 +523,19 @@ def self_test():
             "1": {"p50_ms": 1.0, "p99_ms": 2.0, "n": 0}}),
         dict(serve_good, tier="fp16"),               # unknown tier
         dict(serve_good, tier=None),                 # null tier (omit it)
+        # ISSUE 16 quality-plane divergence block
+        dict(serve_good, divergence={}),             # empty map (omit it)
+        dict(serve_good, divergence=None),           # null (omit it)
+        dict(serve_good, divergence={                # unknown tier key
+            "fp16": {"p50": 0.1, "p99": 0.2, "n": 4, "violations": 0}}),
+        dict(serve_good, divergence={                # missing violations
+            "bf16": {"p50": 0.1, "p99": 0.2, "n": 4}}),
+        dict(serve_good, divergence={                # p99 < p50
+            "bf16": {"p50": 0.5, "p99": 0.2, "n": 4, "violations": 0}}),
+        dict(serve_good, divergence={                # float count
+            "bf16": {"p50": 0.1, "p99": 0.2, "n": 4.5, "violations": 0}}),
+        dict(serve_good, divergence={                # negative violations
+            "bf16": {"p50": 0.1, "p99": 0.2, "n": 4, "violations": -1}}),
     ]
     for obj in good:
         validate_line(obj, "self-test good")
@@ -502,6 +552,10 @@ def self_test():
                         "self-test serve good4")
     validate_serve_line(dict(serve_good, tier="bf16"),
                         "self-test serve good5")
+    validate_serve_line(dict(serve_good, tier="int8", divergence={
+        "int8": {"p50": 0.004, "p99": 0.09, "n": 17, "violations": 0},
+        "bf16": {"p50": 0.001, "p99": 0.01, "n": 3, "violations": 1}}),
+        "self-test serve good6")
     for i, obj in enumerate(bad):
         try:
             validate_line(obj, "self-test bad[%d]" % i)

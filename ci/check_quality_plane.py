@@ -1,0 +1,344 @@
+#!/usr/bin/env python
+"""Inference quality-plane smoke (ISSUE 16) — run from ci/run_tests.sh
+unit tier.
+
+Three phases, exit 0 only when all pass:
+
+1. **Off path clean.**  With ``MXNET_QUALITYPLANE`` unset, an engine
+   serves with no plane, no shadow thread, no ring, no ``quality``
+   block in ``stats()``, no quality metrics in the registry, and no
+   flightrec dump — and a bf16 twin's AOT logical key is byte-identical
+   to the key the same checkpoint produces with the gate ON (the plane
+   is runtime-only; it must never shift what XLA builds).  A loadgen
+   run records the gate-off SERVE_BENCH baseline (no ``divergence``
+   key).
+2. **bf16 twin in tolerance.**  Gate on with ``MXNET_QUALITY_SAMPLE=1``:
+   every completed bf16 request is shadow-replayed through the fp32
+   sibling; divergence rows must appear, every sampled contract
+   fraction must sit inside ``tier_tolerance("bf16")`` (zero
+   violations), the ``tier_divergence`` histogram must carry samples,
+   and loadgen's SERVE_BENCH line must embed the ``divergence`` block
+   (schema-linted).  The gate-on P99 is compared against phase 1's
+   gate-off P99 under a generous bound — shadow sampling must not
+   inflate the live tail (both lines are printed so CI logs record the
+   comparison).
+3. **Poisoned int8 table trips drift + violation.**  An int8 twin
+   calibrated on inputs 100x smaller than live traffic, on a RAW
+   (non-normalized) head: the per-site drift ratio must trip
+   ``calibration_drift_total``, the tolerance contract must trip
+   ``tier_tolerance_violations_total``, and a throttled
+   ``quality_violation`` flightrec dump must appear naming the tier and
+   bucket.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import shutil
+import sys
+import threading
+import time
+
+FREC_DIR = "/tmp/quality_smoke_frec"
+
+# env BEFORE any mxnet_tpu import: telemetry for the registry feed,
+# flightrec for the violation dump, the quality gate initially UNSET so
+# phase 1 exercises the off path in the same process
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["MXNET_TELEMETRY"] = "1"
+os.environ.setdefault("MXNET_TELEMETRY_FILE", "/tmp/quality_smoke.jsonl")
+os.environ.pop("MXNET_QUALITYPLANE", None)
+os.environ.pop("MXNET_QUALITY_SAMPLE", None)
+os.environ["MXNET_FLIGHTREC_DIR"] = FREC_DIR
+shutil.rmtree(FREC_DIR, ignore_errors=True)
+
+import numpy as np  # noqa: E402
+
+
+def _quality_threads():
+    return [t.name for t in threading.enumerate()
+            if t.name.startswith("mxnet-quality")]
+
+
+def _exec_key(pred):
+    from mxnet_tpu import compile_cache
+
+    exe = pred._exec
+    return repr(("executor_fwd",
+                 compile_cache.symbol_fingerprint(exe._symbol),
+                 False) + exe._tier_key_parts(False))
+
+
+def _raw_head_checkpoint(seed=0):
+    """conv -> relu -> flatten -> FC with NO normalizing head: softmax /
+    L2Norm heads renormalize away int8 quantization error, so only a raw
+    head can demonstrate the tolerance-violation path."""
+    import mxnet_tpu as mx
+
+    data = mx.sym.var("data")
+    h = mx.sym.Convolution(data, name="conv0", kernel=(3, 3), num_filter=8,
+                           pad=(1, 1))
+    h = mx.sym.Activation(h, act_type="relu", name="relu0")
+    h = mx.sym.Flatten(h)
+    out = mx.sym.FullyConnected(h, name="fc1", num_hidden=4)
+    rng = np.random.RandomState(seed)
+    shapes = {"data": (2, 3, 8, 8)}
+    arg_shapes, _, _ = out.infer_shape(**shapes)
+    params = {n: mx.nd.array(rng.randn(*s).astype(np.float32) * 0.1)
+              for n, s in zip(out.list_arguments(), arg_shapes)
+              if n != "data"}
+    return out, params, shapes
+
+
+def _bf16_engine(loadgen_unused=None):
+    from mxnet_tpu.serving import BucketLadder, Engine
+    from mxnet_tpu.test_utils import deploy_twin_checkpoint
+
+    sym, params, _ = deploy_twin_checkpoint(batch=4, image=16)
+    eng = Engine(sym, params, {"data": (3, 16, 16)},
+                 ladder=BucketLadder((1, 2)), max_wait_ms=2.0,
+                 max_queue=256, name="qualcheck")
+    # tier on the proto BEFORE warmup/first dispatch: with_shapes
+    # propagates (tier, calibration) to every bucket twin
+    eng._proto._exec.set_precision_tier("bf16")
+    return eng
+
+
+def _loadgen_line(loadgen, eng, duration=1.0):
+    args = argparse.Namespace(duration=duration, concurrency=2,
+                              sizes=(1, 2), timeout_s=30.0, rate=0.0,
+                              seed=0, slo_ms=0.0)
+    return loadgen.run(eng, {"data": (3, 16, 16)}, args, "closed")
+
+
+def main():
+    from mxnet_tpu.graph_passes import precision
+    from mxnet_tpu.predictor import Predictor
+    from mxnet_tpu.serving import BucketLadder, Engine
+    from mxnet_tpu.telemetry import instrument as tin
+    from mxnet_tpu.telemetry import qualityplane
+    from mxnet_tpu.test_utils import (deploy_twin_checkpoint,
+                                      load_module_by_path,
+                                      tiny_mlp_checkpoint)
+
+    tools = os.path.join(_REPO, "tools")
+    loadgen = load_module_by_path(os.path.join(tools, "loadgen.py"))
+    cbs = load_module_by_path(os.path.join(_REPO, "ci",
+                                           "check_bench_schema.py"))
+    ok = True
+
+    # -- phase 1: off path ---------------------------------------------------
+    if qualityplane.plane() is not None or qualityplane.status() is not None:
+        print("check_quality_plane: OFF path materialized the plane",
+              file=sys.stderr)
+        ok = False
+    sym, params = tiny_mlp_checkpoint()
+    eng = Engine(sym, params, {"data": (8,)}, ladder=BucketLadder((1, 2)),
+                 max_wait_ms=2.0, name="qualoff")
+    try:
+        eng.predict({"data": np.zeros((1, 8), np.float32)})
+        st = eng.stats()
+    finally:
+        eng.close()
+    if st["quality"] is not None:
+        print("check_quality_plane: OFF path stats() grew a quality block",
+              file=sys.stderr)
+        ok = False
+    if _quality_threads():
+        print("check_quality_plane: OFF path started a shadow thread: %s"
+              % _quality_threads(), file=sys.stderr)
+        ok = False
+    if getattr(eng, "_quality", "sentinel") is not None \
+            or hasattr(eng, "_quality_q"):
+        print("check_quality_plane: OFF path allocated quality state",
+              file=sys.stderr)
+        ok = False
+    for m in ("tier_divergence", "tier_tolerance_violations_total",
+              "calibration_drift_total", "quality_shed_total"):
+        if tin.registry().get(m) is not None:
+            print("check_quality_plane: OFF path fed registry metric %r"
+                  % m, file=sys.stderr)
+            ok = False
+    if glob.glob(os.path.join(FREC_DIR, "flightrec-*")):
+        print("check_quality_plane: OFF path wrote a flightrec dump",
+              file=sys.stderr)
+        ok = False
+
+    # AOT-key invariance: same checkpoint, gate off vs on (set below) —
+    # the plane is runtime-only, the logical key must not move
+    dsym, dparams, dshapes = deploy_twin_checkpoint(batch=4, image=16)
+    key_off = _exec_key(
+        Predictor(dsym, dparams, dshapes).with_precision("bf16"))
+
+    # gate-off SERVE_BENCH baseline on the exact phase-2 engine config
+    eng_off = _bf16_engine()
+    try:
+        eng_off.warmup()
+        line_off = _loadgen_line(loadgen, eng_off)
+    finally:
+        eng_off.close()
+    cbs.validate_serve_line(line_off, "gate-off line")
+    if "divergence" in line_off:
+        print("check_quality_plane: OFF path SERVE_BENCH line carries a "
+              "divergence block", file=sys.stderr)
+        ok = False
+    print("check_quality_plane: off path clean (p99 %.3f ms)"
+          % line_off["latency_ms_p99"])
+
+    # -- phase 2: bf16 twin, sampling=1.0 ------------------------------------
+    os.environ["MXNET_QUALITYPLANE"] = "1"
+    os.environ["MXNET_QUALITY_SAMPLE"] = "1.0"
+    qualityplane._reset_for_tests()
+
+    key_on = _exec_key(
+        Predictor(dsym, dparams, dshapes).with_precision("bf16"))
+    if key_on != key_off:
+        print("check_quality_plane: gate shifted the AOT logical key:\n"
+              "  off %s\n  on  %s" % (key_off, key_on), file=sys.stderr)
+        ok = False
+
+    eng_on = _bf16_engine()
+    try:
+        eng_on.warmup()
+        # seed a few shadow samples and wait for the replays so the
+        # loadgen line below deterministically carries the block
+        rng = np.random.RandomState(0)
+        for _ in range(4):
+            eng_on.predict(
+                {"data": rng.rand(1, 3, 16, 16).astype(np.float32)})
+        deadline = time.monotonic() + 60.0
+        q = None
+        while time.monotonic() < deadline:
+            q = eng_on.stats()["quality"]
+            if q and q["rows"] and q["divergence"]:
+                break
+            time.sleep(0.1)
+        line_on = _loadgen_line(loadgen, eng_on)
+    finally:
+        # close() joins the shadow thread: every replay that will ever
+        # happen has happened — the verdicts below are final
+        eng_on.close()
+    q = qualityplane.status()
+    rows = qualityplane.plane().rows()
+    if not q or not q["rows"] or not q["divergence"] or not rows:
+        print("check_quality_plane: gate-on bf16 engine produced no "
+              "divergence rows: %r" % (q,), file=sys.stderr)
+        return 1
+    if "bf16" not in q["divergence"]:
+        print("check_quality_plane: divergence summary missing the bf16 "
+              "tier: %r" % (q["divergence"],), file=sys.stderr)
+        ok = False
+    bad = [r for r in rows
+           if r["violation"] or r["contract_frac"] is None
+           or r["contract_frac"] > 1.0]
+    if bad or q["violations"]:
+        print("check_quality_plane: bf16 twin broke its tolerance "
+              "contract: violations=%s rows=%r"
+              % (q["violations"], bad[:3]), file=sys.stderr)
+        ok = False
+    hist = tin.registry().get("tier_divergence")
+    if hist is None or not any(
+            s["count"] > 0 and s["labels"].get("tier") == "bf16"
+            for s in hist.samples()):
+        print("check_quality_plane: tier_divergence histogram has no "
+              "bf16 samples", file=sys.stderr)
+        ok = False
+    cbs.validate_serve_line(line_on, "gate-on line")
+    if not line_on.get("divergence", {}).get("bf16"):
+        print("check_quality_plane: gate-on SERVE_BENCH line lacks the "
+              "bf16 divergence block: %r" % (line_on.get("divergence"),),
+              file=sys.stderr)
+        ok = False
+    # shadow sampling must not inflate the live tail: generous bound for
+    # a noisy 2-core CI box sharing the replay thread with live dispatch
+    p99_off, p99_on = line_off["latency_ms_p99"], line_on["latency_ms_p99"]
+    if p99_on > 5.0 * p99_off + 100.0:
+        print("check_quality_plane: shadow sampling inflated live p99: "
+              "%.3f ms -> %.3f ms" % (p99_off, p99_on), file=sys.stderr)
+        ok = False
+    print("check_quality_plane: bf16 twin ok (%d rows, p99 contract_frac "
+          "%.3g, violations %d; live p99 %.3f -> %.3f ms)"
+          % (q["rows"], q["divergence"]["bf16"]["p99"],
+             q["violations"], p99_off, p99_on))
+
+    # -- phase 3: poisoned int8 table ----------------------------------------
+    qualityplane._reset_for_tests()
+    rsym, rparams, rshapes = _raw_head_checkpoint()
+    pred = Predictor(rsym, rparams, rshapes)
+    rng = np.random.RandomState(1)
+    # calibrate on inputs 100x smaller than live traffic: every live
+    # activation saturates the baked int8 range
+    table = precision.calibrate(
+        pred, ({"data": rng.rand(2, 3, 8, 8).astype(np.float32) * 0.01}
+               for _ in range(4)))
+    eng3 = Engine(rsym, rparams, {"data": (3, 8, 8)},
+                  ladder=BucketLadder((1, 2)), max_wait_ms=2.0,
+                  name="qualdrift")
+    eng3._proto._exec.set_precision_tier("int8", table)
+    try:
+        eng3.predict({"data": rng.rand(1, 3, 8, 8).astype(np.float32)})
+        deadline = time.monotonic() + 60.0
+        q3 = None
+        while time.monotonic() < deadline:
+            q3 = eng3.stats()["quality"]
+            if q3 and q3["rows"] and q3["violations"] and q3["drift"] \
+                    and any(d["trips"] for d in q3["drift"].values()):
+                break
+            time.sleep(0.1)
+    finally:
+        eng3.close()
+    q3 = qualityplane.status()  # final: shadow thread joined by close()
+    if not q3 or not q3["rows"]:
+        print("check_quality_plane: poisoned int8 engine produced no "
+              "quality rows: %r" % (q3,), file=sys.stderr)
+        return 1
+    if not q3["drift"] or not any(d["trips"] for d in q3["drift"].values()):
+        print("check_quality_plane: poisoned table tripped no drift: %r"
+              % (q3.get("drift"),), file=sys.stderr)
+        ok = False
+    drift = tin.registry().get("calibration_drift_total")
+    if drift is None or not any(s["value"] > 0 for s in drift.samples()):
+        print("check_quality_plane: calibration_drift_total did not fire",
+              file=sys.stderr)
+        ok = False
+    if not q3["violations"]:
+        print("check_quality_plane: poisoned table tripped no tolerance "
+              "violation: %r" % (q3,), file=sys.stderr)
+        ok = False
+    viol = tin.registry().get("tier_tolerance_violations_total")
+    if viol is None or not any(
+            s["value"] > 0 and s["labels"].get("tier") == "int8"
+            for s in viol.samples()):
+        print("check_quality_plane: tier_tolerance_violations_total{int8} "
+              "did not fire", file=sys.stderr)
+        ok = False
+    dumps = glob.glob(
+        os.path.join(FREC_DIR, "flightrec-*-quality_violation.json"))
+    if not dumps:
+        print("check_quality_plane: violation produced no flightrec dump",
+              file=sys.stderr)
+        return 1
+    meta = json.load(open(dumps[0]))["flightrec"]
+    if meta.get("tier") != "int8" or not meta.get("bucket"):
+        print("check_quality_plane: dump does not name tier+bucket: %r"
+              % (meta,), file=sys.stderr)
+        ok = False
+
+    if ok:
+        worst = max((d.get("ratio") or 0.0) for d in q3["drift"].values())
+        print("check_quality_plane: OK — off path clean, bf16 rows in "
+              "tolerance, poisoned int8 drift ratio %.3g tripped, dump %s "
+              "names tier=%s bucket=%s"
+              % (worst, os.path.basename(dumps[0]), meta.get("tier"),
+                 meta.get("bucket")))
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

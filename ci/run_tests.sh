@@ -100,6 +100,15 @@ case "$tier" in
     # calibrated int8 twin meets tolerance, an uncalibrated one is
     # provably untouched
     ./dev.sh python ci/check_precision_tier.py
+    # quality plane smoke (ISSUE 16): gate off = no plane, no shadow
+    # thread, no quality stats, AOT keys gate-invariant; gate on at
+    # sampling=1.0 = the bf16 deploy twin's shadow-sampled divergence rows
+    # all sit inside the tier tolerance with zero violations and the
+    # SERVE_BENCH line embeds the divergence block; a poisoned int8
+    # calibration table (ranges 100x below live traffic) must trip both
+    # the calibration-drift counter and a tolerance-violation flightrec
+    # dump naming the tier and bucket
+    ./dev.sh python ci/check_quality_plane.py
     # telemetry unit tests (tests/test_telemetry.py) run as part of tests/
     ignore=()
     for f in "${NIGHTLY_FILES[@]}"; do ignore+=(--ignore "$f"); done

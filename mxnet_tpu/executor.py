@@ -59,6 +59,7 @@ class Executor:
         self._struct_cache = {}  # is_train -> structural (pre-tier) triple
         self._pass_stats = {}  # "train"/"eval" -> graph_passes.optimize stats
         self._tier_stats = None  # tier-pass rows of the lowered eval plan
+        self._int8_sites = {}  # int8_rewrite's drift-baseline export
         self._plan = self._make_plan()
 
     # -- array plumbing -----------------------------------------------------
@@ -167,6 +168,10 @@ class Executor:
                     # tier change); pass_stats() composes the two
                     self._tier_stats = {"passes": rows,
                                         "nodes_post": g.n_nodes}
+                    # quality plane's drift baseline: the sites this
+                    # twin actually quantized, keyed to the calibration
+                    # table the executable was built from
+                    self._int8_sites = dict(tctx.int8_sites)
                     hit = (list(g.entries), list(g.heads),
                            g.constants or None)
             self._opt_cache[is_train] = hit
@@ -225,6 +230,7 @@ class Executor:
         self._precision_tier = tier
         self._calibration = calibration
         self._tier_stats = None
+        self._int8_sites = {}  # re-stashed at next lowering (new table)
         self._opt_cache.clear()
         self._fwd_cache.clear()
         self._bwd_cache.clear()

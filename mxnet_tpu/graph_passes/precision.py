@@ -197,6 +197,12 @@ class TierContext:
         # STRUCTURAL plan, so later passes must look the renamed value up
         # under its original (affine-output) name: {new name -> old name}
         self.calib_alias = {}
+        # int8_rewrite exports every quantized site here — {site name ->
+        # {input (STRUCTURAL env name, alias-resolved), lo, hi, a_scale}}
+        # — the drift baseline the quality plane compares live activation
+        # ranges against (telemetry/qualityplane.py).  Populated during
+        # apply(), stashed by the executor alongside _tier_stats.
+        self.int8_sites = {}
 
     def calib_range(self, name):
         """Calibrated (lo, hi) for an env name, resolved through any
@@ -619,6 +625,60 @@ def calibrate(predictor, batches):
                             batches=n_batches)
 
 
+def observe_ranges(predictor, batch, names):
+    """Live (lo, hi) for a subset of STRUCTURAL env names on one batch —
+    the quality plane's drift hook (telemetry/qualityplane.py): the same
+    eager structural-plan walk :func:`calibrate` does, restricted to the
+    names int8 sites quantize, so a shadow-sampled batch can be compared
+    against the baked :class:`CalibrationTable` without touching the
+    compiled twin.  Runs off the reply path (shadow thread only).
+    Returns ``{name -> (lo, hi)}`` for the names actually produced."""
+    from .ir import node_call_attrs
+
+    want = set(names)
+    if not want:
+        return {}
+    exe = predictor._exec
+    plan, _heads, const_env = exe._structural_plan(False)
+    import jax
+
+    key = jax.random.PRNGKey(0)
+    env = dict(const_env) if const_env else {}
+    for n, a in exe.arg_dict.items():
+        env[n] = a._data
+    for n, a in exe.aux_dict.items():
+        env[n] = a._data
+    for n, v in batch.items():
+        env[n] = np.asarray(v, np.float32)
+    out = {}
+
+    def note(nm):
+        arr = np.asarray(env[nm])
+        if arr.dtype.kind != "f" or arr.size == 0:
+            return
+        l, h = float(arr.min()), float(arr.max())
+        if not (np.isnan(l) or np.isnan(h)):
+            out[nm] = (l, h)
+
+    for nm in want & set(env):
+        note(nm)
+    pending = want - set(out)
+    for node, in_names in plan:
+        if not pending:
+            break
+        attrs = node_call_attrs(node, key, False)
+        res = node.op.fn(*[env[n] for n in in_names], **attrs)
+        outs = res if isinstance(res, tuple) else (res,)
+        if len(outs) > 1 and node.num_outputs == 1:
+            outs = outs[:1]
+        for nm, o in zip(node_out_names(node), outs):
+            env[nm] = o
+            if nm in pending:
+                note(nm)
+                pending.discard(nm)
+    return out
+
+
 def _int8_conv_fn(data, wq, wscale, bias=None, **attrs):  # mxlint: traced
     """Symmetric int8 conv: quantize the activation per-tensor, integer
     conv with int32 accumulation (the quantized_conv.cc shape —
@@ -701,6 +761,12 @@ def int8_rewrite(graph, ctx):
             entries.append((node, in_names))
             continue
         a_scale = float(a_max / 127.0)
+        # drift-hook export: the quality plane observes live ranges on
+        # the STRUCTURAL plan, so record the alias-resolved input name
+        # the calibrated range was keyed under
+        ctx.int8_sites[node.name] = {
+            "input": ctx.calib_alias.get(in_names[0], in_names[0]),
+            "lo": float(rng[0]), "hi": float(rng[1]), "a_scale": a_scale}
         wf = w.astype(np.float32)
         chan_max = np.abs(wf).reshape(wf.shape[0], -1).max(axis=1)
         chan_max = np.where(chan_max > 0, chan_max, 1.0)

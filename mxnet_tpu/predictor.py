@@ -259,6 +259,19 @@ class Predictor:
         ``"int8"`` (the warmup-row / SERVE_BENCH discriminator)."""
         return self._exec.precision_tier
 
+    @property
+    def int8_sites(self):
+        """The int8 rewrite's drift-baseline export for this predictor's
+        lowered eval plan — ``{site -> {input, lo, hi, a_scale}}`` where
+        ``input`` is the STRUCTURAL env name the site's calibrated range
+        was keyed under (telemetry/qualityplane.py compares live ranges
+        against this).  Empty until the plan lowers (first forward / AOT
+        lower), and for any non-int8 tier.  Re-stashed from the new
+        table when a twin is rebuilt via ``with_precision``, so the
+        quality plane's drift baseline always follows the executable
+        actually serving."""
+        return dict(self._exec._int8_sites)
+
     def reshape(self, input_shapes):
         """Re-specialize to new input shapes (``MXPredReshape``) — a new jit
         signature; weight buffers are reused in place (``Executor.reshape``

@@ -33,7 +33,8 @@ import numpy as np
 
 from ..base import env_flag
 from ..predictor import Predictor
-from ..telemetry import costplane, flightrec, ops_server, slo, tracing
+from ..telemetry import (costplane, flightrec, ops_server, qualityplane,
+                         slo, tracing)
 from .admission import AdmissionController, EngineClosed, ServerBusy
 from .batcher import MicroBatcher, Request
 from .bucketing import BucketLadder, _volume
@@ -44,6 +45,11 @@ __all__ = ["Engine"]
 # cache must be bounded or a shape-varying stream grows executables without
 # limit; ladder signatures are finite by construction and stay pinned.
 _DIRECT_CACHE_MAX = 8
+
+# Shadow-replay (quality plane) queue bound, in batches: live dispatch is
+# strictly higher priority, so under pressure samples are SHED (counted)
+# rather than buffered into memory growth.
+_QUALITY_QUEUE_MAX = 8
 
 
 def _env_float(name, default):
@@ -170,8 +176,25 @@ class Engine:
         # - _slo: streaming latency objectives fed from the reply path
         # - _flightrec: bounded event ring dumped on failure
         self._heartbeat = None
+        # "busy in dispatch" marker (ISSUE 16 satellite): monotonic start
+        # of an in-progress device forward, stamped INSIDE _device_mu and
+        # cleared on exit — lets /healthz staleness distinguish a long
+        # forward (busy, healthy) from a dead loop (not busy, stale).
+        # Single writer per mutex-holder, read lock-free (GIL-atomic).
+        self._busy_since = None
         self._slo = slo.monitor_from_env()
         self._flightrec = flightrec.recorder()
+        # inference quality plane (ISSUE 16): shadow-sampled twin
+        # divergence + calibration drift.  Gate unset ⇒ plane is None,
+        # every hook below is one `is None` check, and no shadow thread/
+        # queue/ring is ever allocated (tests/test_qualityplane.py).
+        self._quality = qualityplane.plane()
+        if self._quality is not None:
+            self._quality_q = collections.deque()
+            self._quality_cv = threading.Condition()
+            self._quality_thread = None  # started lazily at first sample
+            self._quality_ref = {}       # bucket.key -> fp32 sibling
+            self._quality_sites_key = None  # drift-baseline anchor
         if self._slo is not None:
             self._slo.on_breach = self._on_slo_breach
         ops_server.maybe_register(self)
@@ -206,6 +229,11 @@ class Engine:
         self._batcher.close()
         if self._thread is not None:
             self._thread.join(timeout=30.0)
+        qt = getattr(self, "_quality_thread", None)
+        if qt is not None:
+            with self._quality_cv:
+                self._quality_cv.notify_all()
+            qt.join(timeout=5.0)
         ops_server.unregister(self)
 
     def _beat(self):
@@ -463,8 +491,15 @@ class Engine:
                     arrays = self._assemble(reqs, bucket)
                 with tracing.span("execute", compile=int(fresh)):
                     with self._device_mu:
-                        outs = pred.forward(**arrays)
-                        outs = [o.asnumpy() for o in outs]  # sync: completion
+                        # busy marker strictly INSIDE the mutex: a loop
+                        # blocked waiting on _device_mu is NOT busy — a
+                        # frozen engine must still read stale-and-dead
+                        self._busy_since = time.monotonic()
+                        try:
+                            outs = pred.forward(**arrays)
+                            outs = [o.asnumpy() for o in outs]  # sync
+                        finally:
+                            self._busy_since = None
             except Exception:
                 self._uncompile(bucket, fresh)
                 raise
@@ -511,6 +546,112 @@ class Engine:
             self._probe.record_batch(
                 label, fill, waste, dt, queue_waits,
                 in_flight, self._batcher.depth(), latencies=latencies)
+        if self._quality is not None:
+            try:
+                self._quality_observe(reqs, arrays, outs, bucket, label,
+                                      pred)
+            except Exception:
+                pass  # quality observation must never fail a served batch
+
+    # -- quality plane (ISSUE 16) --------------------------------------------
+    def _quality_observe(self, reqs, arrays, outs, bucket, label, pred):
+        """Fold one completed batch into the quality plane (device loop,
+        post-reply): per-tier output-distribution stats over the reply
+        buffers the dispatch already materialized (zero extra device
+        work), then — for twin-served batches only — systematic
+        per-request sampling into the bounded shadow queue.  Never
+        blocks: a full queue sheds the sample and counts it."""
+        q = self._quality
+        tier = pred._exec.precision_tier
+        q.note_outputs(tier, outs)
+        if tier == "fp32":
+            return  # nothing to diverge from
+        offsets, off = [], 0
+        for req in reqs:
+            if q.should_sample():
+                offsets.append((off, req.n))
+            off += req.n
+        if not offsets:
+            return
+        with self._quality_cv:
+            if len(self._quality_q) >= _QUALITY_QUEUE_MAX:
+                q.note_shed(len(offsets))
+                return
+            self._quality_q.append(
+                (bucket, label, tier, arrays, outs, offsets, pred))
+            if self._quality_thread is None:
+                self._quality_thread = threading.Thread(
+                    target=self._quality_worker,
+                    name="mxnet-quality-%s" % self.name, daemon=True)
+                self._quality_thread.start()
+            self._quality_cv.notify()
+
+    def _quality_ref_for(self, bucket, pred):
+        """The fp32 sibling serving this bucket's shapes — built once per
+        bucket off the twin itself (shared weight buffers, so the shadow
+        costs no extra HBM for weights; the tier is explicitly cleared,
+        so an ambient MXNET_PRECISION_TIER cannot leak back in)."""
+        ref = self._quality_ref.get(bucket.key)
+        if ref is None:
+            ref = pred.with_precision(None)
+            self._quality_ref[bucket.key] = ref
+        return ref
+
+    def _quality_worker(self):
+        """Shadow-replay loop: strictly lower priority than live dispatch
+        — defers while the batcher holds queued work, takes ``_device_mu``
+        only around its own forward (never on the reply path), and exits
+        with the engine."""
+        from ..graph_passes import precision as _precision
+
+        q = self._quality
+        while True:
+            with self._quality_cv:
+                while not self._quality_q and not self._closed:
+                    self._quality_cv.wait(0.05)
+                if self._closed:
+                    return
+                item = self._quality_q.popleft()
+            # live work first: yield until the micro-batcher queue drains
+            while not self._closed and self._batcher.depth() > 0:
+                time.sleep(0.001)
+            if self._closed:
+                return
+            try:
+                self._quality_replay(q, item, _precision)
+            except Exception:
+                q.note_shed(len(item[5]))  # quality never crashes serving
+
+    def _quality_replay(self, q, item, _precision):
+        bucket, label, tier, arrays, outs, offsets, pred = item
+        ref = self._quality_ref_for(bucket, pred)
+        sites = pred._exec._int8_sites
+        with self._device_mu:
+            routs = ref.forward(**arrays)
+            routs = [o.asnumpy() for o in routs]
+            live = None
+            if sites:
+                # drift baseline follows the twin actually serving: a
+                # re-calibrated rebuild changes the calibration
+                # fingerprint and re-anchors the plane's baseline here
+                cal = pred._exec._calibration
+                skey = (id(pred._exec),
+                        cal.fingerprint() if cal is not None else None)
+                if skey != self._quality_sites_key:
+                    q.set_drift_baseline(sites)
+                    self._quality_sites_key = skey
+                names = {d["input"] for d in sites.values()}
+                live = _precision.observe_ranges(ref, arrays, names)
+        tol = _precision.tier_tolerance(tier)
+        for off, n in offsets:
+            q.record_divergence(
+                tier, label, [o[off:off + n] for o in outs],
+                [o[off:off + n] for o in routs], tol, engine=self.name)
+        if live:
+            for site, d in sites.items():
+                rng = live.get(d["input"])
+                if rng is not None:
+                    q.observe_site(site, rng[0], rng[1])
 
     @staticmethod
     def _padding_waste(reqs, bucket):
@@ -617,38 +758,50 @@ class Engine:
         cp0 = None
         try:
             with self._device_mu:
-                # compile plane (ISSUE 13): bracket this bucket's compile
-                # with the monotonic row counter INSIDE the device mutex —
-                # the window covers exactly this bucket's finalize + first
-                # forward, and the read below additionally pins rows to
-                # this predictor's executable identity, so a concurrent
-                # compile elsewhere in the process cannot be mis-attributed
-                if costplane.enabled():
-                    cp0 = costplane.row_count()
-                if handle is not None:
-                    info = pred.aot_finalize(handle)
-                    # "cached" = already live in this process (a re-warmup):
-                    # neither a disk restore nor a fresh compile
-                    cache = {"compile": "miss", "disk": "hit"}.get(
-                        info["source"])
-                    lower_s = info.get("lower_s", 0.0)
-                    aot_compile_s = info.get("compile_s", 0.0)
-                outs = pred.forward(
-                    **{n: np.zeros((bucket.batch,) + s, np.float32)
-                       for n, s in bucket.shapes})
-                for o in outs:
-                    o.asnumpy()
-                crows = ()
-                if cp0 is not None:
-                    # still under _device_mu: rows since cp0 that carry
-                    # THIS predictor executable's logical key are this
-                    # bucket's compile (a concurrent train-thread compile
-                    # has a different key and is filtered out)
-                    fwd = pred._exec._fwd_cache.get(False)
-                    want = getattr(fwd, "_key", None)
-                    crows = [r for r in costplane.rows_since(
-                                 cp0, site="executor_fwd")
-                             if want is None or r["logical_key"] == want]
+                # busy marker (ISSUE 16 satellite): a warmup finalize +
+                # first forward can legitimately exceed MXNET_OPS_STALE_S
+                # — mark the mutex-holder busy so /healthz reads
+                # slow-not-dead while this compiles
+                self._busy_since = time.monotonic()
+                try:
+                    # compile plane (ISSUE 13): bracket this bucket's
+                    # compile with the monotonic row counter INSIDE the
+                    # device mutex — the window covers exactly this
+                    # bucket's finalize + first forward, and the read
+                    # below additionally pins rows to this predictor's
+                    # executable identity, so a concurrent compile
+                    # elsewhere in the process cannot be mis-attributed
+                    if costplane.enabled():
+                        cp0 = costplane.row_count()
+                    if handle is not None:
+                        info = pred.aot_finalize(handle)
+                        # "cached" = already live in this process (a
+                        # re-warmup): neither a disk restore nor a fresh
+                        # compile
+                        cache = {"compile": "miss", "disk": "hit"}.get(
+                            info["source"])
+                        lower_s = info.get("lower_s", 0.0)
+                        aot_compile_s = info.get("compile_s", 0.0)
+                    outs = pred.forward(
+                        **{n: np.zeros((bucket.batch,) + s, np.float32)
+                           for n, s in bucket.shapes})
+                    for o in outs:
+                        o.asnumpy()
+                    crows = ()
+                    if cp0 is not None:
+                        # still under _device_mu: rows since cp0 that
+                        # carry THIS predictor executable's logical key
+                        # are this bucket's compile (a concurrent
+                        # train-thread compile has a different key and is
+                        # filtered out)
+                        fwd = pred._exec._fwd_cache.get(False)
+                        want = getattr(fwd, "_key", None)
+                        crows = [r for r in costplane.rows_since(
+                                     cp0, site="executor_fwd")
+                                 if want is None
+                                 or r["logical_key"] == want]
+                finally:
+                    self._busy_since = None
         except Exception:
             self._uncompile(bucket, fresh)
             raise
@@ -760,9 +913,15 @@ class Engine:
                 # cast-plan verdict histogram across all warmed buckets
                 # (ISSUE 11) — same gate, same None-when-off contract
                 "precision_verdicts": verdicts,
-                # the ladder's compiled tier (ISSUE 15; always present)
-                "precision_tier": (tiers.pop() if len(tiers) == 1
+                # the ladder's compiled tier (ISSUE 15; always present) —
+                # the one-value/"mixed" summary string, kept for
+                # compatibility; the per-bucket map below is what the
+                # quality plane / tier router key on (ISSUE 16 satellite)
+                "precision_tier": (set(tiers).pop() if len(tiers) == 1
                                    else "mixed"),
+                "precision_tiers": {
+                    r["bucket"]: r.get("precision_tier") or "fp32"
+                    for r in report},
                 "xla_flops": sum(wfl) if wfl else None,
                 "xla_peak_bytes": max(wpk) if wpk else None,
                 "total_s": round(total_s, 4)}
@@ -837,8 +996,21 @@ class Engine:
                          self.ladder.signatures(self.sample_shapes)]
         # the tier this engine's plans compile under (ISSUE 15): "fp32"
         # unless MXNET_PRECISION_TIER rewrote them — the SERVE_BENCH /
-        # /statusz discriminator (per-bucket values live in the warmup rows)
-        out["precision_tier"] = self._proto._exec.precision_tier
+        # /statusz discriminator.  The per-bucket map (ISSUE 16
+        # satellite) exposes what each BOUND ladder bucket's executor
+        # actually serves, so the quality plane and the future tier
+        # router never re-derive it; the summary string stays one value
+        # ("mixed" when heterogeneous) for compatibility.
+        with self._cache_mu:
+            tier_map = {
+                repr(b): self._cache[b.key]._exec.precision_tier
+                for b in self.ladder.signatures(self.sample_shapes)
+                if b.key in self._cache}
+        out["precision_tiers"] = tier_map
+        tiers = set(tier_map.values())
+        out["precision_tier"] = (tiers.pop() if len(tiers) == 1
+                                 else "mixed" if tiers
+                                 else self._proto._exec.precision_tier)
         # live ops plane (ISSUE 10): the streaming SLO block (None when
         # MXNET_SLO is off — the monitor never exists) and the device-loop
         # heartbeat age (None until the loop first ticks).  Both read
@@ -851,7 +1023,16 @@ class Engine:
         # — the off path is this one env read)
         out["costplane"] = costplane.status() if costplane.enabled() \
             else None
+        # inference quality plane (ISSUE 16): shadow-divergence ring
+        # summary + calibration-drift state — None when
+        # MXNET_QUALITYPLANE is off (the plane never exists)
+        out["quality"] = (self._quality.status()
+                          if self._quality is not None else None)
         hb = self._heartbeat
         out["heartbeat_age_s"] = (round(max(0.0, time.monotonic() - hb), 3)
                                   if hb is not None else None)
+        busy = self._busy_since
+        out["busy_in_dispatch_s"] = (
+            round(max(0.0, time.monotonic() - busy), 3)
+            if busy is not None else None)
         return out

@@ -19,6 +19,7 @@ from .registry import (Counter, Gauge, Histogram, MetricError, Registry,
 from . import flightrec, ops_server, slo  # live ops plane (ISSUE 10)
 from . import trainhealth  # training health plane (ISSUE 12)
 from . import costplane  # compile plane (ISSUE 13)
+from . import qualityplane  # inference quality plane (ISSUE 16)
 from .sinks import (JsonlSink, PrometheusSink, ProfilerSink, Sink,
                     TensorBoardSink, iter_scalar_samples, render_prometheus)
 from .instrument import (ServeProbe, StepProbe, add_sink, array_nbytes,
@@ -35,6 +36,7 @@ from .instrument import (ServeProbe, StepProbe, add_sink, array_nbytes,
 
 __all__ = [
     "tracing", "flightrec", "ops_server", "slo", "trainhealth", "costplane",
+    "qualityplane",
     "Counter", "Gauge", "Histogram", "MetricError", "Registry",
     "DEFAULT_BUCKETS",
     "Sink", "JsonlSink", "PrometheusSink", "ProfilerSink", "TensorBoardSink",

@@ -16,12 +16,17 @@ Endpoints:
   engine's device-loop **heartbeat** (written each loop iteration; the
   batcher's idle wait is bounded so a healthy-idle loop still beats),
   loop-thread aliveness, and queue depth vs capacity.  Stale threshold:
-  ``MXNET_OPS_STALE_S`` (default 5 s; a legitimate forward longer than
-  this will flap health — raise the threshold for huge direct batches).
+  ``MXNET_OPS_STALE_S`` (default 5 s).  A forward legitimately longer
+  than the threshold does NOT flap health: the engine stamps a "busy in
+  dispatch" marker inside the device mutex, so staleness only condemns a
+  loop that is neither beating nor executing (frozen), not one that is
+  slow (ISSUE 16 satellite — the PR 10 flapping caveat, fixed).
 * ``/statusz``  — JSON: per-engine ``Engine.stats()`` (SLO + warmup +
   bucket_stats blocks included), health detail, the training-health block
   (``trainhealth.status()`` — last drained row + per-rank heartbeats,
-  None when ``MXNET_TRAINHEALTH`` is off), and process metadata.
+  None when ``MXNET_TRAINHEALTH`` is off), the inference quality block
+  (``qualityplane.status()`` — shadow divergence + calibration drift,
+  None when ``MXNET_QUALITYPLANE`` is off), and process metadata.
 
 Engines self-register at construction and unregister at ``close()``;
 registration holds only a weak reference, so a dropped engine never stays
@@ -198,10 +203,16 @@ def engine_health(engine, now=None, threshold=None):
     """One engine's liveness verdict (also callable without the server —
     tests and embedders use it directly).
 
-    ok ⇔ device-loop thread alive ∧ heartbeat younger than the stale
-    threshold ∧ queue below capacity.  An engine built with ``start=False``
-    (or already closed) reports not-ok: /healthz is a *readiness* check —
-    "can a request submitted now make progress"."""
+    ok ⇔ device-loop thread alive ∧ (heartbeat younger than the stale
+    threshold ∨ a forward is in flight) ∧ queue below capacity.  The
+    "busy in dispatch" marker (``Engine._busy_since``, stamped strictly
+    inside the device mutex around the forward) is what separates a SLOW
+    loop (mid-forward past the threshold: healthy, still making
+    progress) from a DEAD one (not beating, not executing: 503) — the
+    PR 10 flapping caveat.  A loop frozen *waiting* on the device mutex
+    never reads busy, so a wedged engine still fails.  An engine built
+    with ``start=False`` (or already closed) reports not-ok: /healthz is
+    a *readiness* check — "can a request submitted now make progress"."""
     now = time.monotonic() if now is None else now
     thread = getattr(engine, "_thread", None)
     alive = (thread is not None and thread.is_alive()
@@ -209,16 +220,21 @@ def engine_health(engine, now=None, threshold=None):
     hb = getattr(engine, "_heartbeat", None)
     age = None if hb is None else max(0.0, now - hb)
     limit = stale_s() if threshold is None else threshold
+    busy = getattr(engine, "_busy_since", None)
+    busy_age = None if busy is None else max(0.0, now - busy)
     depth = engine._batcher.depth()
     max_queue = engine.admission.max_queue
     saturated = depth >= max_queue
     with engine._stats_mu:
         warmed = engine._warmup is not None
-    ok = alive and age is not None and age <= limit and not saturated
+    fresh = age is not None and age <= limit
+    ok = alive and (fresh or busy_age is not None) and not saturated
     return {"engine": engine.name, "ok": ok, "loop_alive": alive,
             "heartbeat_age_s": None if age is None else round(age, 3),
             "stale_after_s": limit, "queue_depth": depth,
             "max_queue": max_queue, "saturated": saturated,
+            "busy_in_dispatch": busy_age is not None,
+            "busy_s": None if busy_age is None else round(busy_age, 3),
             "warmed": warmed}
 
 
@@ -230,7 +246,7 @@ def _health():
 
 
 def _statusz():
-    from . import costplane, instrument, trainhealth
+    from . import costplane, instrument, qualityplane, trainhealth
 
     engines = {}
     for e in _live_engines():
@@ -256,10 +272,16 @@ def _statusz():
         cp = costplane.status() if costplane.enabled() else None
     except Exception as ex:
         cp = {"error": repr(ex)}
+    try:
+        # inference quality plane (ISSUE 16): shadow divergence +
+        # calibration drift; None when MXNET_QUALITYPLANE is off
+        qp = qualityplane.status()
+    except Exception as ex:
+        qp = {"error": repr(ex)}
     return {"pid": os.getpid(), "unix_ts": round(time.time(), 6),
             "telemetry_enabled": instrument.enabled(),
             "health": health, "engines": engines, "trainhealth": th,
-            "costplane": cp}
+            "costplane": cp, "quality": qp}
 
 
 # -- handler ------------------------------------------------------------------

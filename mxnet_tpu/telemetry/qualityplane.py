@@ -1,0 +1,559 @@
+"""Inference quality plane — shadow-sampled tier divergence, int8
+calibration-drift detection, and online tolerance-contract validation
+(ISSUE 16 tentpole).
+
+PR 15 ships fp32/bf16/int8 precision twins with *static* per-pass
+tolerance contracts (``graph_passes.precision.tier_tolerance``).  This
+module validates those contracts against **live traffic**, the same way
+the training health plane (ISSUE 12) validates the static CastPlan
+verdicts at runtime: the static contract says what a twin *should* hold
+to, this plane measures what it actually does once real data drifts away
+from the calibration batches.  Three signal sources:
+
+1. **Shadow sampling** — the serving Engine deterministically samples a
+   fraction (``MXNET_QUALITY_SAMPLE``, systematic like
+   ``MXNET_TRACE_SAMPLE``) of completed requests served by a bf16/int8
+   twin and replays them through the fp32 sibling on a background thread
+   that takes the device mutex only between batches — never on the reply
+   path, strictly lower priority than live dispatch, and shedding itself
+   under queue pressure (``quality_shed_total``).  Per-request divergence
+   (max-abs, contract fraction vs :func:`~..graph_passes.precision.
+   tier_tolerance`, top-1 agreement for argmax-shaped heads) lands in
+   ``tier_divergence{tier,metric}`` histograms plus a bounded ring behind
+   ``Engine.stats()["quality"]``; exceeding the contract counts
+   ``tier_tolerance_violations_total{tier}`` and triggers a throttled
+   flight-recorder dump naming the bucket, tier, and offending head.
+
+2. **Calibration drift** — int8 sites (exported by ``int8_rewrite`` onto
+   the TierContext and stashed by the executor) compare a cheap windowed
+   range sketch of live activations (:class:`RangeSketch`, epoch-rotated
+   like ``slo.WindowedQuantile``) against the baked ``CalibrationTable``
+   ranges: per-site ``calibration_drift_ratio`` gauges plus
+   ``calibration_drift_total{site}`` when the live range escapes the
+   calibrated range by ``MXNET_QUALITY_DRIFT``x — the concrete
+   "re-calibrate and rebuild the twin" signal.  The baseline re-anchors
+   whenever the engine (re)binds a twin, so it always tracks the table
+   the serving executable was actually built from.
+
+3. **Per-tier output distribution stats** — mean/std/extremes per head,
+   accumulated host-side over the reply buffers the dispatch loop has
+   already materialized (zero extra device dispatches, trainhealth
+   discipline), so a silent twin regression shows up even between shadow
+   samples.
+
+Gating: :func:`plane` returns None when ``MXNET_QUALITYPLANE`` is unset —
+call sites keep one ``is None`` check, no thread or ring is ever
+allocated, and eval plans / jaxprs / AOT keys are byte-identical to a
+build without this module (the PR 1/4 zero-overhead contract, tested in
+``tests/test_qualityplane.py`` and ``ci/check_quality_plane.py``).
+"""
+from __future__ import annotations
+
+import collections
+import math
+import os
+import threading
+import time
+
+from ..base import env_flag
+
+__all__ = ["enabled", "sample_rate", "drift_threshold", "ring_cap",
+           "compare_outputs", "RangeSketch", "QualityPlane", "plane",
+           "status", "DIVERGENCE_BUCKETS", "DIV_MIN", "DIV_MAX",
+           "DIV_GAMMA", "NSUB"]
+
+# -- divergence sketch geometry ----------------------------------------------
+# Log-bucketed like slo.WindowedQuantile but with its own constants:
+# divergence lives in [~1e-8 .. ~10] (a bf16 twin sits around 1e-3..5e-2,
+# an exploded int8 twin in the 0.1..10 decade), nothing like the latency
+# range, and GAMMA=2 (one bucket per octave) is plenty of resolution for
+# p50/p99 over error magnitudes.
+DIV_MIN = 1e-8
+DIV_MAX = 10.0
+DIV_GAMMA = 2.0
+_N_DIV = int(math.ceil(math.log(DIV_MAX / DIV_MIN) / math.log(DIV_GAMMA))) + 2
+
+# registry histogram buckets for tier_divergence{tier,metric} — decades
+# with extra resolution around the bf16/int8 tolerance contracts
+DIVERGENCE_BUCKETS = (1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 5e-2, 0.1, 0.25,
+                      0.5, 1.0, 2.0, 10.0)
+
+NSUB = 6  # drift-sketch sub-windows (slo.py discipline)
+
+
+def enabled():
+    """``MXNET_QUALITYPLANE`` gate (docs/ENV_VARS.md) — default OFF."""
+    return env_flag("MXNET_QUALITYPLANE")
+
+
+def sample_rate():
+    """Fraction of completed twin-served requests shadow-replayed through
+    the fp32 sibling (``MXNET_QUALITY_SAMPLE``, default 0.1, clamped to
+    [0, 1]) — same parse contract as ``tracing.sample_rate``."""
+    try:
+        r = float(os.environ.get("MXNET_QUALITY_SAMPLE", "0.1"))
+    except ValueError:
+        return 0.1
+    return min(max(r, 0.0), 1.0)
+
+
+def drift_threshold():
+    """Live/calibrated maxabs ratio above which an int8 site counts a
+    calibration drift (``MXNET_QUALITY_DRIFT``, default 1.5 — live
+    activations 50% hotter than anything calibration saw means the
+    activation scale is clipping)."""
+    try:
+        v = float(os.environ.get("MXNET_QUALITY_DRIFT", "1.5"))
+    except ValueError:
+        return 1.5
+    return v if v > 1.0 else 1.5
+
+
+def ring_cap():
+    """Divergence rows kept in-process (``MXNET_QUALITY_RING``)."""
+    try:
+        v = int(os.environ.get("MXNET_QUALITY_RING", "256"))
+    except ValueError:
+        return 256
+    return v if v > 0 else 256
+
+
+def _safe(x):
+    """float(x) when finite else None — the trainhealth JSON-safety rule:
+    every float this plane hands to the JSONL sink or a flightrec dump
+    must be strict JSON (no bare NaN/Infinity tokens)."""
+    x = float(x)
+    return x if math.isfinite(x) else None
+
+
+# -- divergence math (pure, unit-testable) ------------------------------------
+def compare_outputs(live, ref, tol):
+    """Per-request divergence of a twin's outputs ``live`` vs the fp32
+    sibling's ``ref`` (parallel lists of arrays, one per head) under the
+    tier tolerance contract ``{"rtol", "atol"}``.
+
+    Returns ``{"max_abs", "contract_frac", "top1_agree", "head",
+    "heads": [...]}``: ``contract_frac`` is the max over elements of
+    ``|a-b| / (atol + rtol*|b|)`` — the contract is violated exactly when
+    it exceeds 1.0 (the ``np.allclose`` predicate, continuous-ized so a
+    histogram can watch the margin shrink *before* it trips).
+    ``top1_agree`` is the argmax agreement fraction for 2-D heads with
+    more than one column (classification-shaped), None otherwise;
+    ``head`` is the index of the worst head by contract fraction."""
+    import numpy as np
+
+    heads = []
+    for i, (a, b) in enumerate(zip(live, ref)):
+        a = np.asarray(a, np.float64)
+        b = np.asarray(b, np.float64)
+        if a.size == 0 or a.shape != b.shape:
+            heads.append({"head": i, "max_abs": 0.0, "contract_frac": 0.0,
+                          "top1_agree": None})
+            continue
+        diff = np.abs(a - b)
+        max_abs = float(diff.max())
+        denom = tol["atol"] + tol["rtol"] * np.abs(b)
+        frac = float((diff / denom).max())
+        agree = None
+        if a.ndim == 2 and a.shape[1] > 1:
+            agree = float(np.mean(np.argmax(a, axis=1)
+                                  == np.argmax(b, axis=1)))
+        heads.append({"head": i, "max_abs": _safe(max_abs) or 0.0,
+                      "contract_frac": _safe(frac)
+                      if math.isfinite(frac) else float("inf"),
+                      "top1_agree": agree})
+    if not heads:
+        return {"max_abs": 0.0, "contract_frac": 0.0, "top1_agree": None,
+                "head": None, "heads": []}
+    worst = max(heads, key=lambda h: (h["contract_frac"]
+                                      if h["contract_frac"] is not None
+                                      and math.isfinite(h["contract_frac"])
+                                      else float("inf")))
+    agrees = [h["top1_agree"] for h in heads if h["top1_agree"] is not None]
+    return {"max_abs": max(h["max_abs"] for h in heads),
+            "contract_frac": worst["contract_frac"],
+            "top1_agree": min(agrees) if agrees else None,
+            "head": worst["head"], "heads": heads}
+
+
+class _DivergenceSketch:
+    """Cumulative log-bucketed histogram over contract fractions — the
+    per-tier ``{p50, p99, n, violations}`` summary behind SERVE_BENCH's
+    ``divergence`` block.  Cumulative (not windowed): a bench run wants
+    the whole serve's distribution, and the ring already provides
+    recency."""
+
+    __slots__ = ("_counts", "_n", "_violations")
+
+    def __init__(self):
+        self._counts = [0] * _N_DIV
+        self._n = 0
+        self._violations = 0
+
+    def observe(self, v, violation=False):
+        v = float(v)
+        if not math.isfinite(v):
+            i = _N_DIV - 1
+        elif v <= DIV_MIN:
+            i = 0
+        else:
+            i = 1 + int(math.floor(math.log(v / DIV_MIN)
+                                   / math.log(DIV_GAMMA)))
+            i = min(i, _N_DIV - 1)
+        self._counts[i] += 1
+        self._n += 1
+        if violation:
+            self._violations += 1
+
+    def quantile(self, q):
+        if self._n == 0:
+            return None
+        rank = max(0, int(math.ceil(q * self._n)) - 1)
+        seen = 0
+        for i, c in enumerate(self._counts):
+            seen += c
+            if seen > rank:
+                if i == 0:
+                    return DIV_MIN
+                return min(DIV_MIN * (DIV_GAMMA ** i), DIV_MAX)
+        return DIV_MAX
+
+    def summary(self):
+        return {"p50": _safe(self.quantile(0.5)) if self._n else None,
+                "p99": _safe(self.quantile(0.99)) if self._n else None,
+                "n": self._n, "violations": self._violations}
+
+
+class RangeSketch:
+    """Windowed live-activation range: ``NSUB`` epoch-rotated sub-windows
+    (the ``slo.WindowedQuantile`` rotation idiom) each holding a
+    ``[lo, hi]`` pair, so the drift comparison always reflects the last
+    ``window_s`` of traffic and a transient spike ages out instead of
+    pinning the drift gauge forever."""
+
+    __slots__ = ("window_s", "_sub_s", "_subs")
+
+    def __init__(self, window_s=300.0):
+        self.window_s = float(window_s)
+        self._sub_s = max(self.window_s / NSUB, 1e-3)
+        self._subs = {}  # epoch -> [lo, hi]
+
+    def _rotate(self, epoch):
+        floor = epoch - NSUB
+        for e in [e for e in self._subs if e <= floor]:
+            del self._subs[e]
+
+    def observe(self, lo, hi, now=None):
+        now = time.monotonic() if now is None else now
+        e = int(now / self._sub_s)
+        self._rotate(e)
+        s = self._subs.get(e)
+        if s is None:
+            self._subs[e] = [float(lo), float(hi)]
+        else:
+            s[0] = min(s[0], float(lo))
+            s[1] = max(s[1], float(hi))
+
+    def range(self, now=None):
+        """(lo, hi) over the live window, or None when empty."""
+        now = time.monotonic() if now is None else now
+        self._rotate(int(now / self._sub_s))
+        if not self._subs:
+            return None
+        los, his = zip(*self._subs.values())
+        return (min(los), max(his))
+
+
+# -- the host-side plane ------------------------------------------------------
+class QualityPlane:
+    """Per-process quality-signal sink: owns the systematic sampler, the
+    per-tier divergence sketches + bounded ring, the per-site drift
+    state, and the per-(tier, head) output-distribution accumulators;
+    feeds the telemetry registry, the JSONL event log, and the flight
+    recorder.  One per process (mirrors ``trainhealth.HealthPlane``)."""
+
+    def __init__(self, cap=None):
+        self._mu = threading.Lock()
+        self._ring = collections.deque(maxlen=cap or ring_cap())
+        self._rate = sample_rate()
+        self._thresh = drift_threshold()
+        self._n = 0          # completed twin-served requests seen
+        self._sampled = 0
+        self._shed = 0
+        self._violations = 0
+        self._div = {}       # tier -> _DivergenceSketch
+        self._drift = {}     # site -> {input, calib, live, ratio, trips}
+        self._outputs = {}   # (tier, head idx) -> accum dict
+
+    # -- systematic sampler --------------------------------------------------
+    def should_sample(self):
+        """Advance the request counter and decide deterministically —
+        the ``floor(n*rate) > floor((n-1)*rate)`` systematic rule
+        (``tracing.sample_rate`` semantics): exactly ``rate`` of the
+        stream, evenly spaced, reproducible across identical runs."""
+        with self._mu:
+            self._n += 1
+            n = self._n
+        if self._rate <= 0.0:
+            return False
+        take = math.floor(n * self._rate) > math.floor((n - 1) * self._rate)
+        if take:
+            with self._mu:
+                self._sampled += 1
+        return take
+
+    def note_shed(self, n=1):
+        """The shadow queue was full: live dispatch always wins, the
+        sample is dropped and counted — never buffered unboundedly."""
+        with self._mu:
+            self._shed += int(n)
+        from . import instrument
+
+        if instrument.enabled():
+            instrument.registry().counter(
+                "quality_shed_total",
+                "shadow samples dropped because the quality queue was "
+                "full — live dispatch is strictly higher priority").inc(n)
+
+    # -- shadow divergence ---------------------------------------------------
+    def record_divergence(self, tier, bucket, live, ref, tol, engine=None):
+        """Fold one sampled request's twin-vs-fp32 outputs into the
+        plane: sketch + ring + registry histograms; a contract violation
+        counts ``tier_tolerance_violations_total{tier}`` and triggers a
+        throttled flightrec dump naming bucket, tier, and offending
+        head.  Returns the divergence row."""
+        row = compare_outputs(live, ref, tol)
+        frac = row["contract_frac"]
+        violation = bool(frac is None or not math.isfinite(frac)
+                         or frac > 1.0)
+        entry = {"tier": tier, "bucket": bucket,
+                 "max_abs": row["max_abs"],
+                 "contract_frac": _safe(frac) if frac is not None else None,
+                 "top1_agree": row["top1_agree"], "head": row["head"],
+                 "violation": violation, "unix_ts": time.time()}
+        with self._mu:
+            self._ring.append(entry)
+            sk = self._div.get(tier)
+            if sk is None:
+                sk = self._div[tier] = _DivergenceSketch()
+            sk.observe(frac if frac is not None else float("inf"),
+                       violation=violation)
+            if violation:
+                self._violations += 1
+        from . import instrument
+
+        if instrument.enabled():
+            r = instrument.registry()
+            hist = r.histogram(
+                "tier_divergence",
+                "shadow-sampled divergence of a precision twin vs its "
+                "fp32 sibling, per tier and metric (contract_frac > 1 "
+                "is a tolerance-contract violation)",
+                ("tier", "metric"), buckets=DIVERGENCE_BUCKETS)
+            hist.observe(row["max_abs"], tier=tier, metric="max_abs")
+            if entry["contract_frac"] is not None:
+                hist.observe(entry["contract_frac"], tier=tier,
+                             metric="contract_frac")
+            if row["top1_agree"] is not None:
+                hist.observe(max(0.0, 1.0 - row["top1_agree"]), tier=tier,
+                             metric="top1_disagree")
+            if violation:
+                r.counter(
+                    "tier_tolerance_violations_total",
+                    "shadow-sampled requests whose twin-vs-fp32 "
+                    "divergence exceeded the tier's tolerance contract — "
+                    "the static precision contract and live traffic "
+                    "disagree; alert on any nonzero rate",
+                    ("tier",)).inc(tier=tier)
+        instrument.event(
+            "quality", signal="divergence", tier=tier, bucket=bucket,
+            max_abs=entry["max_abs"],
+            contract_frac=entry["contract_frac"],
+            top1_agree=entry["top1_agree"], head=entry["head"],
+            violation=violation, engine=engine)
+        if violation:
+            self._trip_violation(entry, engine)
+        return entry
+
+    def _trip_violation(self, entry, engine):
+        from . import flightrec
+
+        frec = flightrec.recorder()
+        if frec is None:
+            return
+        frec.record("quality_violation", tier=entry["tier"],
+                    bucket=entry["bucket"], head=entry["head"],
+                    contract_frac=entry["contract_frac"])
+        # per-reason 30s throttle is flightrec's own — a violation storm
+        # costs one dump, not one per sampled request
+        frec.dump("quality_violation", auto=True, engine=engine,
+                  bucket=entry["bucket"], tier=entry["tier"],
+                  head=entry["head"],
+                  contract_frac=entry["contract_frac"],
+                  max_abs=entry["max_abs"])
+
+    # -- calibration drift ---------------------------------------------------
+    def set_drift_baseline(self, sites):
+        """(Re)anchor the per-site calibrated ranges — called whenever
+        the engine (re)binds an int8 twin, so after a re-calibration +
+        ``with_precision`` rebuild the live sketches reset and the
+        comparison follows the NEW table, not the one the old executable
+        was built from.  ``sites`` is the executor's stashed
+        ``int8_rewrite`` export: ``{site -> {input, lo, hi, a_scale}}``."""
+        with self._mu:
+            self._drift = {
+                str(s): {"input": d["input"],
+                         "calib": (float(d["lo"]), float(d["hi"])),
+                         "live": RangeSketch(), "ratio": None, "trips": 0}
+                for s, d in sites.items()}
+
+    def drift_sites(self):
+        """{site: structural input name} — what the shadow worker must
+        observe live ranges for."""
+        with self._mu:
+            return {s: d["input"] for s, d in self._drift.items()}
+
+    def observe_site(self, site, lo, hi, now=None):
+        """Fold one sampled batch's live (lo, hi) at an int8 site into
+        its sketch and compare against the calibrated range: ratio =
+        live maxabs / calibrated maxabs.  Above ``drift_threshold()``
+        counts ``calibration_drift_total{site}``.  Returns True when the
+        drift tripped."""
+        with self._mu:
+            d = self._drift.get(site)
+            if d is None:
+                return False
+            d["live"].observe(lo, hi, now=now)
+            rng = d["live"].range(now=now)
+            clo, chi = d["calib"]
+            cmax = max(abs(clo), abs(chi))
+            lmax = max(abs(rng[0]), abs(rng[1])) if rng else 0.0
+            ratio = (lmax / cmax) if cmax > 0 else float("inf")
+            d["ratio"] = _safe(ratio)
+            tripped = ratio > self._thresh
+            if tripped:
+                d["trips"] += 1
+        from . import instrument
+
+        if instrument.enabled():
+            r = instrument.registry()
+            if _safe(ratio) is not None:
+                r.gauge("calibration_drift_ratio",
+                        "live/calibrated activation maxabs ratio at an "
+                        "int8 site (1.0 = live traffic inside the "
+                        "calibrated envelope)", ("site",)).set(
+                    ratio, site=site)
+            if tripped:
+                r.counter(
+                    "calibration_drift_total",
+                    "sampled batches whose live activation range escaped "
+                    "an int8 site's calibrated range by more than "
+                    "MXNET_QUALITY_DRIFT — re-calibrate and rebuild the "
+                    "twin", ("site",)).inc(site=site)
+        if tripped:
+            instrument.event("quality", signal="drift", site=site,
+                             ratio=_safe(ratio), threshold=self._thresh)
+        return tripped
+
+    # -- per-tier output distribution stats ----------------------------------
+    def note_outputs(self, tier, outs):
+        """Accumulate per-head mean/std/extremes from the reply buffers
+        the dispatch loop already materialized (numpy, host-side — zero
+        extra device dispatches).  Streaming merge per (tier, head)."""
+        import numpy as np
+
+        tier = tier or "fp32"
+        for i, o in enumerate(outs):
+            a = np.asarray(o)
+            if a.dtype.kind != "f" or a.size == 0:
+                continue
+            n = int(a.size)
+            s = float(a.sum(dtype=np.float64))
+            ss = float(np.square(a, dtype=np.float64).sum())
+            lo, hi = float(a.min()), float(a.max())
+            key = (tier, i)
+            with self._mu:
+                acc = self._outputs.get(key)
+                if acc is None:
+                    self._outputs[key] = {"n": n, "sum": s, "sumsq": ss,
+                                          "min": lo, "max": hi}
+                else:
+                    acc["n"] += n
+                    acc["sum"] += s
+                    acc["sumsq"] += ss
+                    acc["min"] = min(acc["min"], lo)
+                    acc["max"] = max(acc["max"], hi)
+
+    # -- read surfaces -------------------------------------------------------
+    def divergence_summary(self):
+        """{tier: {p50, p99, n, violations}} over contract fractions —
+        the SERVE_BENCH ``divergence`` block.  Empty dict when nothing
+        was sampled yet."""
+        with self._mu:
+            return {t: sk.summary() for t, sk in self._div.items()}
+
+    def rows(self):
+        with self._mu:
+            return list(self._ring)
+
+    def status(self):
+        """The ``Engine.stats()["quality"]`` / ``/statusz`` block."""
+        with self._mu:
+            div = {t: sk.summary() for t, sk in self._div.items()}
+            drift = {}
+            for s, d in self._drift.items():
+                rng = d["live"].range()
+                drift[s] = {"input": d["input"],
+                            "calib": [d["calib"][0], d["calib"][1]],
+                            "live": [rng[0], rng[1]] if rng else None,
+                            "ratio": d["ratio"], "trips": d["trips"]}
+            outputs = {}
+            for (tier, head), acc in self._outputs.items():
+                n = acc["n"]
+                mean = acc["sum"] / n
+                var = max(0.0, acc["sumsq"] / n - mean * mean)
+                outputs.setdefault(tier, {})[str(head)] = {
+                    "n": n, "mean": _safe(mean),
+                    "std": _safe(math.sqrt(var)),
+                    "min": _safe(acc["min"]), "max": _safe(acc["max"])}
+            return {"seen": self._n, "sampled": self._sampled,
+                    "shed": self._shed, "violations": self._violations,
+                    "rows": len(self._ring),
+                    "sample_rate": self._rate,
+                    "drift_threshold": self._thresh,
+                    "divergence": div if div else None,
+                    "drift": drift if drift else None,
+                    "outputs": outputs if outputs else None}
+
+
+# -- process-global plane (mirrors trainhealth.plane) -------------------------
+_mu = threading.Lock()
+_plane = None
+
+
+def plane():
+    """The process QualityPlane, or None when ``MXNET_QUALITYPLANE`` is
+    unset — the caller's one-check gate."""
+    global _plane
+    if not enabled():
+        return None
+    with _mu:
+        if _plane is None:
+            _plane = QualityPlane()
+        return _plane
+
+
+def status():
+    """``/statusz``/CLI surface: the plane's status dict, or None when
+    the gate is off (distinguishable from an enabled-but-idle plane)."""
+    with _mu:
+        p = _plane
+    if p is None:
+        return None if not enabled() else plane().status()
+    return p.status()
+
+
+def _reset_for_tests():
+    global _plane
+    with _mu:
+        _plane = None

@@ -383,6 +383,64 @@ class TestOpsServer:
         finally:
             eng.close()
 
+    def test_busy_marker_separates_slow_from_dead(self, ops_on):
+        """ISSUE 16 satellite: a stale heartbeat alone no longer fails
+        health when a forward is in flight (``_busy_since``) — only a
+        stale-AND-idle loop reads dead."""
+        eng = _mlp_engine()
+        try:
+            eng.predict({"data": np.zeros((1, 8), np.float32)})
+            # evaluate far in the future so the heartbeat is certainly
+            # stale no matter how the loop's wait cycle interleaves
+            now = time.monotonic() + 100.0
+            h = ops_server.engine_health(eng, now=now, threshold=1.0)
+            assert h["ok"] is False and h["busy_in_dispatch"] is False
+            assert h["busy_s"] is None
+            try:
+                eng._busy_since = now - 50.0  # mid-forward for 50 s
+                h = ops_server.engine_health(eng, now=now, threshold=1.0)
+                assert h["ok"] is True and h["busy_in_dispatch"] is True
+                assert h["busy_s"] == pytest.approx(50.0, abs=0.01)
+            finally:
+                eng._busy_since = None
+        finally:
+            eng.close()
+
+    def test_healthz_stays_200_during_slow_forward(self, ops_on,
+                                                   monkeypatch):
+        """The live half of the PR 10 flapping fix: a forward outlasting
+        MXNET_OPS_STALE_S (1.0 s here) keeps /healthz at 200 while the
+        mutex-frozen variant above still flips 503."""
+        eng = _mlp_engine()
+        try:
+            port = ops_server.port()
+            eng.predict({"data": np.zeros((1, 8), np.float32)})
+            real = eng._predictor_for
+
+            class SlowPred:
+                def __init__(self, inner):
+                    self._inner = inner
+
+                def forward(self, **arrays):
+                    time.sleep(2.5)
+                    return self._inner.forward(**arrays)
+
+                def __getattr__(self, name):
+                    return getattr(self._inner, name)
+
+            monkeypatch.setattr(
+                eng, "_predictor_for",
+                lambda bucket: (lambda p, f: (SlowPred(p), f))(*real(bucket)))
+            fut = eng.submit({"data": np.zeros((1, 8), np.float32)})
+            time.sleep(1.6)  # well past the stale threshold, mid-forward
+            code, body = _get(port, "/healthz")
+            assert code == 200
+            (check,) = json.loads(body)["engines"]
+            assert check["busy_in_dispatch"] is True
+            fut.result(timeout=30)
+        finally:
+            eng.close()
+
     def test_unregister_on_close(self, ops_on):
         eng = _mlp_engine()
         port = ops_server.port()

@@ -437,6 +437,51 @@ def test_tier_enters_aot_key_and_calibration_too(deploy_pred):
     assert q1 != q2 and table.fingerprint() in q1
 
 
+def test_recalibration_moves_table_key_and_drift_baseline(deploy_pred):
+    """ISSUE 16 satellite: a re-calibration moves the CalibrationTable
+    fingerprint, the int8 twin's AOT logical key, AND the quality plane's
+    drift-baseline export together — the serving executable and the live
+    drift comparison can never disagree about which table is current."""
+    t1 = precision.calibrate(deploy_pred, [{"data": _fixed_input(seed=3)}])
+    t2 = precision.calibrate(deploy_pred,
+                             [{"data": _fixed_input(seed=4) * 2.0}])
+    assert t1.fingerprint() != t2.fingerprint()
+    twin1 = deploy_pred.with_precision("int8", calibration=t1)
+    twin2 = twin1.with_precision("int8", calibration=t2)
+    k1, k2 = _exec_key(twin1), _exec_key(twin2)
+    assert k1 != k2
+    assert t1.fingerprint() in k1 and t2.fingerprint() in k2
+
+    # the drift-baseline export is empty until the plan lowers, then keyed
+    # to exactly the ranges of the table the executable was built from
+    assert twin1.int8_sites == {}
+    twin1._exec._opt_plan(False)
+    sites1 = twin1.int8_sites
+    assert sites1
+    for d in sites1.values():
+        assert t1.range(d["input"]) == (d["lo"], d["hi"])
+    # the rebuilt twin re-stashes from the NEW table
+    twin2._exec._opt_plan(False)
+    sites2 = twin2.int8_sites
+    assert set(sites2) == set(sites1)
+    assert any(sites2[s] != sites1[s] for s in sites2)
+    for d in sites2.values():
+        assert t2.range(d["input"]) == (d["lo"], d["hi"])
+
+    # and re-anchoring the plane with the rebuilt twin's export swaps the
+    # calibrated ranges the live sketches compare against
+    from mxnet_tpu.telemetry import qualityplane
+
+    p = qualityplane.QualityPlane()
+    p.set_drift_baseline(sites1)
+    site = next(iter(sites1))
+    assert p.status()["drift"][site]["calib"] \
+        == [sites1[site]["lo"], sites1[site]["hi"]]
+    p.set_drift_baseline(sites2)
+    assert p.status()["drift"][site]["calib"] \
+        == [sites2[site]["lo"], sites2[site]["hi"]]
+
+
 def test_contract_drift_moves_everything_together(deploy_pred, monkeypatch):
     """ISSUE 15 satellite: bump SENSITIVITY_VERSION and the precision-pass
     fingerprint, the AOT logical key, and numerics.contract_fingerprint()

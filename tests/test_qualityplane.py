@@ -1,0 +1,396 @@
+"""Inference quality plane tests (ISSUE 16) —
+``mxnet_tpu/telemetry/qualityplane.py``: env parsing, the systematic
+shadow sampler, the divergence math and violation edge, the windowed
+drift sketch + per-site drift accounting, output-distribution
+accumulators, the bounded ring, the off-path no-op contract, and the
+engine-level shadow-sampling end-to-end path."""
+import math
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.serving import BucketLadder, Engine
+from mxnet_tpu.telemetry import qualityplane
+
+
+def _mlp_engine(**kw):
+    from mxnet_tpu.test_utils import tiny_mlp_checkpoint
+
+    sym, params = tiny_mlp_checkpoint()
+    kw.setdefault("ladder", BucketLadder((1, 2)))
+    kw.setdefault("max_wait_ms", 2.0)
+    kw.setdefault("max_queue", 64)
+    kw.setdefault("name", "qualplane")
+    return Engine(sym, params, {"data": (8,)}, **kw)
+
+
+@pytest.fixture
+def quality_off(monkeypatch):
+    """The zero-overhead off path: every ISSUE 16 gate unset."""
+    for var in ("MXNET_QUALITYPLANE", "MXNET_QUALITY_SAMPLE",
+                "MXNET_QUALITY_DRIFT", "MXNET_QUALITY_RING"):
+        monkeypatch.delenv(var, raising=False)
+    qualityplane._reset_for_tests()
+    yield
+    qualityplane._reset_for_tests()
+
+
+@pytest.fixture
+def quality_on(monkeypatch):
+    monkeypatch.setenv("MXNET_QUALITYPLANE", "1")
+    monkeypatch.setenv("MXNET_QUALITY_SAMPLE", "1.0")
+    for var in ("MXNET_QUALITY_DRIFT", "MXNET_QUALITY_RING"):
+        monkeypatch.delenv(var, raising=False)
+    qualityplane._reset_for_tests()
+    yield
+    qualityplane._reset_for_tests()
+
+
+# -- env parsing --------------------------------------------------------------
+class TestEnvParsing:
+    def test_sample_rate(self, monkeypatch):
+        monkeypatch.delenv("MXNET_QUALITY_SAMPLE", raising=False)
+        assert qualityplane.sample_rate() == 0.1
+        monkeypatch.setenv("MXNET_QUALITY_SAMPLE", "0.25")
+        assert qualityplane.sample_rate() == 0.25
+        monkeypatch.setenv("MXNET_QUALITY_SAMPLE", "7")
+        assert qualityplane.sample_rate() == 1.0   # clamped
+        monkeypatch.setenv("MXNET_QUALITY_SAMPLE", "-3")
+        assert qualityplane.sample_rate() == 0.0
+        monkeypatch.setenv("MXNET_QUALITY_SAMPLE", "lots")
+        assert qualityplane.sample_rate() == 0.1   # malformed: default
+
+    def test_drift_threshold(self, monkeypatch):
+        monkeypatch.delenv("MXNET_QUALITY_DRIFT", raising=False)
+        assert qualityplane.drift_threshold() == 1.5
+        monkeypatch.setenv("MXNET_QUALITY_DRIFT", "3.0")
+        assert qualityplane.drift_threshold() == 3.0
+        # a ratio gate at or below 1.0 would trip on in-envelope traffic
+        monkeypatch.setenv("MXNET_QUALITY_DRIFT", "0.5")
+        assert qualityplane.drift_threshold() == 1.5
+        monkeypatch.setenv("MXNET_QUALITY_DRIFT", "nope")
+        assert qualityplane.drift_threshold() == 1.5
+
+    def test_ring_cap(self, monkeypatch):
+        monkeypatch.delenv("MXNET_QUALITY_RING", raising=False)
+        assert qualityplane.ring_cap() == 256
+        monkeypatch.setenv("MXNET_QUALITY_RING", "8")
+        assert qualityplane.ring_cap() == 8
+        monkeypatch.setenv("MXNET_QUALITY_RING", "-1")
+        assert qualityplane.ring_cap() == 256
+        monkeypatch.setenv("MXNET_QUALITY_RING", "many")
+        assert qualityplane.ring_cap() == 256
+
+
+# -- systematic sampler -------------------------------------------------------
+class TestSampler:
+    def test_floor_rule_even_spacing(self, monkeypatch):
+        monkeypatch.setenv("MXNET_QUALITY_SAMPLE", "0.25")
+        p = qualityplane.QualityPlane()
+        takes = [p.should_sample() for _ in range(100)]
+        assert sum(takes) == 25
+        # floor(n*r) advances exactly at every 4th request: deterministic,
+        # evenly spaced — not a coin flip
+        assert takes == [(i + 1) % 4 == 0 for i in range(100)]
+        st = p.status()
+        assert st["seen"] == 100 and st["sampled"] == 25
+        # reproducible across identical streams
+        p2 = qualityplane.QualityPlane()
+        assert [p2.should_sample() for _ in range(100)] == takes
+
+    def test_rate_edges(self, monkeypatch):
+        monkeypatch.setenv("MXNET_QUALITY_SAMPLE", "0")
+        p = qualityplane.QualityPlane()
+        assert not any(p.should_sample() for _ in range(50))
+        monkeypatch.setenv("MXNET_QUALITY_SAMPLE", "1.0")
+        p = qualityplane.QualityPlane()
+        assert all(p.should_sample() for _ in range(50))
+
+    def test_note_shed(self, quality_off):
+        p = qualityplane.QualityPlane()
+        p.note_shed(3)
+        p.note_shed()
+        assert p.status()["shed"] == 4
+
+
+# -- divergence math ----------------------------------------------------------
+TOL = {"atol": 0.5, "rtol": 0.0}  # denom == 0.5 everywhere: exact fracs
+
+
+class TestCompareOutputs:
+    def test_exact_fracs(self):
+        ref = [np.zeros((2, 3), np.float32)]
+        live = [np.full((2, 3), 0.25, np.float32)]
+        row = qualityplane.compare_outputs(live, ref, TOL)
+        assert row["max_abs"] == pytest.approx(0.25)
+        assert row["contract_frac"] == pytest.approx(0.5)
+        assert row["head"] == 0
+
+    def test_rtol_term(self):
+        ref = [np.array([10.0], np.float64)]
+        live = [np.array([10.2], np.float64)]
+        row = qualityplane.compare_outputs(
+            live, ref, {"atol": 0.0, "rtol": 0.01})
+        # |a-b| / (rtol*|b|) = 0.2 / 0.1
+        assert row["contract_frac"] == pytest.approx(2.0)
+
+    def test_top1_agreement_classification_heads_only(self):
+        ref = [np.array([[0.1, 0.9], [0.8, 0.2]], np.float32)]
+        live = [np.array([[0.1, 0.9], [0.2, 0.8]], np.float32)]  # row 1 flips
+        row = qualityplane.compare_outputs(live, ref, TOL)
+        assert row["top1_agree"] == pytest.approx(0.5)
+        # 1-D head: argmax agreement is not defined
+        row = qualityplane.compare_outputs(
+            [np.zeros(4)], [np.zeros(4)], TOL)
+        assert row["top1_agree"] is None
+
+    def test_worst_head_wins(self):
+        ref = [np.zeros(2), np.zeros(2)]
+        live = [np.full(2, 0.1), np.full(2, 0.4)]
+        row = qualityplane.compare_outputs(live, ref, TOL)
+        assert row["head"] == 1
+        assert row["contract_frac"] == pytest.approx(0.8)
+        assert [h["head"] for h in row["heads"]] == [0, 1]
+
+    def test_degenerate_heads(self):
+        # shape mismatch and empty heads score zero instead of crashing
+        row = qualityplane.compare_outputs(
+            [np.zeros((2, 2)), np.zeros(0)],
+            [np.zeros((3, 2)), np.zeros(0)], TOL)
+        assert row["max_abs"] == 0.0 and row["contract_frac"] == 0.0
+        row = qualityplane.compare_outputs([], [], TOL)
+        assert row["head"] is None and row["heads"] == []
+
+    def test_nonfinite_divergence_is_infinite_frac(self):
+        row = qualityplane.compare_outputs(
+            [np.array([np.nan])], [np.zeros(1)], TOL)
+        assert not math.isfinite(row["contract_frac"])
+
+
+class TestRecordDivergence:
+    def test_violation_edge_is_strictly_above_one(self, quality_off):
+        p = qualityplane.QualityPlane()
+        # frac exactly 1.0: at the contract boundary, NOT a violation
+        e = p.record_divergence("bf16", "b1", [np.array([0.5])],
+                                [np.zeros(1)], TOL)
+        assert e["contract_frac"] == pytest.approx(1.0)
+        assert e["violation"] is False
+        e = p.record_divergence("bf16", "b1", [np.array([0.51])],
+                                [np.zeros(1)], TOL)
+        assert e["violation"] is True
+        # non-finite divergence (NaN output) always violates
+        e = p.record_divergence("bf16", "b1", [np.array([np.nan])],
+                                [np.zeros(1)], TOL)
+        assert e["violation"] is True and e["contract_frac"] is None
+        st = p.status()
+        assert st["violations"] == 2
+        assert st["divergence"]["bf16"]["n"] == 3
+        assert st["divergence"]["bf16"]["violations"] == 2
+
+    def test_sketch_quantiles_and_ring(self, quality_off):
+        p = qualityplane.QualityPlane(cap=4)
+        for _ in range(9):
+            p.record_divergence("bf16", "b1", [np.array([0.005])],
+                                [np.zeros(1)], TOL)  # frac 0.01
+        p.record_divergence("bf16", "b2", [np.array([0.4])],
+                            [np.zeros(1)], TOL)      # frac 0.8
+        s = p.divergence_summary()["bf16"]
+        assert s["n"] == 10 and s["violations"] == 0
+        assert s["p99"] >= s["p50"] > 0
+        # p50 sits in the 0.01 body, p99 reaches the 0.8 tail (log-bucket
+        # quantization: within one GAMMA=2 octave)
+        assert s["p50"] <= 0.04 and s["p99"] >= 0.4
+        # ring is bounded and keeps the newest rows
+        rows = p.rows()
+        assert len(rows) == 4 and p.status()["rows"] == 4
+        assert rows[-1]["bucket"] == "b2"
+        assert all(r["tier"] == "bf16" for r in rows)
+
+
+# -- drift sketch / per-site drift --------------------------------------------
+class TestRangeSketch:
+    def test_merge_and_window(self):
+        s = qualityplane.RangeSketch(window_s=60.0)  # sub-window = 10 s
+        assert s.range(now=0.0) is None
+        s.observe(-1.0, 1.0, now=0.0)
+        s.observe(-2.0, 3.0, now=5.0)   # same sub-window: merges
+        assert s.range(now=5.0) == (-2.0, 3.0)
+        s.observe(0.0, 0.5, now=35.0)
+        assert s.range(now=35.0) == (-2.0, 3.0)
+        # the t=0 spike ages out once its epoch leaves the window; the
+        # t=35 observation survives
+        assert s.range(now=65.0) == (0.0, 0.5)
+        # fully past the window: empty again
+        assert s.range(now=300.0) is None
+
+    def test_memory_bound(self):
+        s = qualityplane.RangeSketch(window_s=60.0)
+        for t in range(500):
+            s.observe(-1.0, 1.0, now=float(t))
+        assert len(s._subs) <= qualityplane.NSUB + 1
+
+
+class TestDrift:
+    SITES = {"conv0_q": {"input": "data", "lo": -1.0, "hi": 1.0,
+                         "a_scale": 1.0 / 127.0}}
+
+    def test_observe_site_against_baseline(self, quality_off):
+        p = qualityplane.QualityPlane()
+        p.set_drift_baseline(self.SITES)
+        assert p.drift_sites() == {"conv0_q": "data"}
+        # live traffic inside the calibrated envelope: no trip.  (Real
+        # monotonic `now` throughout: status() reads the sketch at the
+        # current time, so synthetic epochs would look expired.)
+        assert p.observe_site("conv0_q", -0.5, 0.9) is False
+        d = p.status()["drift"]["conv0_q"]
+        assert d["ratio"] == pytest.approx(0.9) and d["trips"] == 0
+        assert d["calib"] == [-1.0, 1.0] and d["live"] == [-0.5, 0.9]
+        # 5x hotter than calibration: past the 1.5x default threshold
+        assert p.observe_site("conv0_q", -0.2, 5.0) is True
+        d = p.status()["drift"]["conv0_q"]
+        assert d["ratio"] == pytest.approx(5.0) and d["trips"] == 1
+        # unknown site: ignored, never trips
+        assert p.observe_site("nope", 0.0, 99.0) is False
+
+    def test_threshold_from_env(self, monkeypatch):
+        monkeypatch.setenv("MXNET_QUALITY_DRIFT", "10.0")
+        p = qualityplane.QualityPlane()
+        p.set_drift_baseline(self.SITES)
+        assert p.observe_site("conv0_q", -5.0, 5.0) is False
+        assert p.observe_site("conv0_q", -11.0, 11.0) is True
+
+    def test_rebaseline_resets_live_state(self, quality_off):
+        p = qualityplane.QualityPlane()
+        p.set_drift_baseline(self.SITES)
+        assert p.observe_site("conv0_q", -9.0, 9.0) is True
+        # a re-calibrated twin re-anchors: new calib range, fresh sketch,
+        # trip count reset — the comparison follows the NEW table
+        p.set_drift_baseline({"conv0_q": {"input": "data", "lo": -10.0,
+                                          "hi": 10.0, "a_scale": 10 / 127.0}})
+        d = p.status()["drift"]["conv0_q"]
+        assert d["calib"] == [-10.0, 10.0]
+        assert d["live"] is None and d["ratio"] is None and d["trips"] == 0
+        assert p.observe_site("conv0_q", -9.0, 9.0) is False
+
+
+# -- output-distribution accumulators ----------------------------------------
+class TestOutputStats:
+    def test_streaming_merge(self, quality_off):
+        p = qualityplane.QualityPlane()
+        p.note_outputs("bf16", [np.array([1.0, 3.0], np.float32)])
+        p.note_outputs("bf16", [np.array([5.0, 7.0], np.float32)])
+        o = p.status()["outputs"]["bf16"]["0"]
+        assert o["n"] == 4 and o["mean"] == pytest.approx(4.0)
+        assert o["std"] == pytest.approx(np.std([1.0, 3.0, 5.0, 7.0]))
+        assert o["min"] == 1.0 and o["max"] == 7.0
+
+    def test_non_float_and_empty_heads_skipped(self, quality_off):
+        p = qualityplane.QualityPlane()
+        p.note_outputs(None, [np.array([1, 2], np.int32),
+                              np.zeros(0, np.float32)])
+        assert p.status()["outputs"] is None
+        # tier None folds under the fp32 label
+        p.note_outputs(None, [np.ones(2, np.float32)])
+        assert set(p.status()["outputs"]) == {"fp32"}
+
+
+# -- off path -----------------------------------------------------------------
+class TestOffPath:
+    def test_gate_off_no_plane(self, quality_off):
+        assert qualityplane.enabled() is False
+        assert qualityplane.plane() is None
+        assert qualityplane.status() is None
+
+    def test_gate_off_engine_is_noop(self, quality_off):
+        eng = _mlp_engine()
+        try:
+            assert eng._quality is None
+            assert not hasattr(eng, "_quality_q")
+            eng.predict({"data": np.zeros((1, 8), np.float32)})
+            assert eng.stats()["quality"] is None
+            assert not [t for t in threading.enumerate()
+                        if t.name.startswith("mxnet-quality")]
+        finally:
+            eng.close()
+        assert qualityplane.status() is None  # nothing leaked a plane
+
+    def test_gate_is_runtime_only_no_key_or_plan_shift(self, quality_off,
+                                                       monkeypatch):
+        # the plane is pure observation: flipping the gate must not move
+        # the executor's plan or AOT key parts (the byte-identical
+        # contract; ci/check_quality_plane.py proves it on the full
+        # lowered jaxpr)
+        eng = _mlp_engine(start=False)
+        try:
+            exe = eng._proto._exec
+            plan_off = exe._opt_plan(False)
+            parts_off = exe._tier_key_parts(False)
+            monkeypatch.setenv("MXNET_QUALITYPLANE", "1")
+            qualityplane._reset_for_tests()
+            assert exe._opt_plan(False) is plan_off
+            assert exe._tier_key_parts(False) == parts_off
+        finally:
+            eng.close()
+
+
+# -- engine end-to-end --------------------------------------------------------
+class TestEngineShadowSampling:
+    def test_bf16_twin_shadow_divergence(self, quality_on):
+        eng = _mlp_engine(name="qual-e2e")
+        try:
+            eng._proto._exec.set_precision_tier("bf16")
+            eng.warmup()
+            # satellite: per-bucket tier map + warmup rows carry the tier
+            st = eng.stats()
+            assert st["precision_tiers"] and \
+                set(st["precision_tiers"].values()) == {"bf16"}
+            assert st["precision_tier"] == "bf16"
+            for _ in range(6):
+                eng.predict({"data": np.random.RandomState(0)
+                             .rand(1, 8).astype(np.float32)})
+            # rate 1.0: every request is queued for shadow replay; the
+            # worker runs at lower priority — poll for the verdicts
+            deadline = time.monotonic() + 60.0
+            q = qualityplane.status()
+            while time.monotonic() < deadline and not (
+                    q and q["rows"] and q["divergence"]):
+                time.sleep(0.05)
+                q = qualityplane.status()
+            assert q["divergence"] and "bf16" in q["divergence"]
+            assert q["sampled"] >= 1
+            # the engine's stats surface is the same plane
+            sq = eng.stats()["quality"]
+            assert sq is not None and sq["seen"] == q["seen"]
+            # per-tier output stats accumulate on the live path, shadow
+            # or not
+            assert q["outputs"] and "bf16" in q["outputs"]
+        finally:
+            eng.close()
+        # close() joins the shadow thread: verdicts are final.  A bf16
+        # MLP on fp32-computed CPU ops sits far inside its tolerance
+        # contract — zero violations, all rows in-contract.
+        q = qualityplane.status()
+        assert q["violations"] == 0
+        for row in qualityplane.plane().rows():
+            assert row["tier"] == "bf16" and row["violation"] is False
+            assert row["contract_frac"] is not None \
+                and row["contract_frac"] <= 1.0
+        assert not [t for t in threading.enumerate()
+                    if t.name.startswith("mxnet-quality")]
+
+    def test_fp32_engine_never_samples(self, quality_on):
+        eng = _mlp_engine(name="qual-fp32")
+        try:
+            for _ in range(3):
+                eng.predict({"data": np.zeros((1, 8), np.float32)})
+            q = qualityplane.status()
+            # nothing to diverge from: no sampling, no shadow thread —
+            # only the output-distribution stats accumulate
+            assert q["seen"] == 0 and q["sampled"] == 0
+            assert q["divergence"] is None
+            assert q["outputs"] and "fp32" in q["outputs"]
+            assert getattr(eng, "_quality_thread", None) is None
+        finally:
+            eng.close()

@@ -180,10 +180,33 @@ def load_serve(path, obj):
             "goodput_rps": line.get("goodput_rps"),
             "latency_ms_p50": line.get("latency_ms_p50"),
             "latency_ms_p99": float(line["latency_ms_p99"]),
-            "shed_rate": line.get("shed_rate")}
+            "shed_rate": line.get("shed_rate"),
+            # quality plane (ISSUE 16): {tier: {p50, p99, n, violations}}
+            # over shadow-sampled contract fractions; None for captures
+            # predating the plane or taken with MXNET_QUALITYPLANE off
+            "divergence": _norm_divergence(line.get("divergence"))}
 
 
-def compare_serve(rows, threshold, gate_p99=False):
+def _norm_divergence(div):
+    """Normalize a SERVE_BENCH ``divergence`` block → {tier: summary} with
+    float p50/p99 and int n/violations, or None when absent/malformed (an
+    old capture must compare, not crash)."""
+    if not isinstance(div, dict) or not div:
+        return None
+    out = {}
+    for tier, s in div.items():
+        if not isinstance(s, dict):
+            return None
+        try:
+            out[str(tier)] = {"p50": float(s["p50"]), "p99": float(s["p99"]),
+                              "n": int(s["n"]),
+                              "violations": int(s["violations"])}
+        except (KeyError, TypeError, ValueError):
+            return None
+    return out
+
+
+def compare_serve(rows, threshold, gate_p99=False, gate_divergence=False):
     """→ (table_rows, regressions).  Baseline = rows[0]; only same-MODE,
     same-TIER rows are compared (a closed-loop capture against an open-loop
     one — or an fp32 engine against its bf16/int8 twin, ISSUE 15 — is a
@@ -192,7 +215,14 @@ def compare_serve(rows, threshold, gate_p99=False):
     shown; only ``--gate-p99`` makes p99 growth beyond the threshold a
     regression (ISSUE 10, mirroring ``--gate-warmup``): latency tails are
     noisy across hosts, so the gate is opt-in for pipelines whose runs
-    share a machine + load shape."""
+    share a machine + load shape.
+
+    ``--gate-divergence`` (ISSUE 16) gates the quality plane's shadow-
+    divergence block the same opt-in way: for each tier BOTH rows report,
+    p99 contract-fraction growth beyond the threshold, or new tolerance
+    violations where the baseline had none, is a regression.  Rows without
+    divergence (plane off, old capture) are shown, never gated — turning
+    the plane on must not fail the first comparison against history."""
     base = rows[0]
     table, regressions = [], []
     for r in rows:
@@ -203,8 +233,11 @@ def compare_serve(rows, threshold, gate_p99=False):
                if same and r is not base else None)
         d99 = (_pct(r["latency_ms_p99"], base["latency_ms_p99"])
                if same and r is not base else None)
+        ddiv = (_divergence_deltas(r["divergence"], base["divergence"])
+                if same and r is not base else None)
         table.append(dict(r, same_mode=same, thr_delta_pct=dt,
-                          p50_delta_pct=d50, p99_delta_pct=d99))
+                          p50_delta_pct=d50, p99_delta_pct=d99,
+                          divergence_delta=ddiv))
         if r is base or not same:
             continue
         if gate_p99 and d99 is not None and d99 > threshold:
@@ -212,15 +245,61 @@ def compare_serve(rows, threshold, gate_p99=False):
                 "%s: latency_ms_p99 %.4g -> %.4g (+%.1f%% > %g%%, "
                 "--gate-p99)" % (r["file"], base["latency_ms_p99"],
                                  r["latency_ms_p99"], d99, threshold))
+        if gate_divergence and ddiv:
+            for tier, d in sorted(ddiv.items()):
+                if d["p99_delta_pct"] is not None \
+                        and d["p99_delta_pct"] > threshold:
+                    regressions.append(
+                        "%s: divergence[%s] p99 %.4g -> %.4g (+%.1f%% > "
+                        "%g%%, --gate-divergence)"
+                        % (r["file"], tier, base["divergence"][tier]["p99"],
+                           r["divergence"][tier]["p99"], d["p99_delta_pct"],
+                           threshold))
+                if d["new_violations"]:
+                    regressions.append(
+                        "%s: divergence[%s] violations %d -> %d where "
+                        "baseline had none (--gate-divergence)"
+                        % (r["file"], tier,
+                           base["divergence"][tier]["violations"],
+                           r["divergence"][tier]["violations"]))
     return table, regressions
+
+
+def _divergence_deltas(div, base_div):
+    """Per-tier quality deltas for tiers BOTH captures report, or None
+    when either side lacks the block.  ``new_violations`` flags a candidate
+    with violations where the baseline had zero — the contract break the
+    gate exists to catch, independent of percentage math."""
+    if not div or not base_div:
+        return None
+    out = {}
+    for tier in sorted(set(div) & set(base_div)):
+        b, r = base_div[tier], div[tier]
+        out[tier] = {"p99_delta_pct": _pct(r["p99"], b["p99"]),
+                     "new_violations": (b["violations"] == 0
+                                        and r["violations"] > 0)}
+    return out or None
+
+
+def _fmt_divergence(div):
+    """Compact ``tier:p99/violations`` cell for the serve table — one
+    entry per tier the capture measured, ``-`` when the plane was off."""
+    if not div:
+        return "-"
+    return ",".join("%s:%.3g/%d" % (t, div[t]["p99"], div[t]["violations"])
+                    for t in sorted(div))
 
 
 def render_serve_table(table):
     cols = ["file", "mode", "tier", "rps", "Δrps%", "goodput", "p50_ms",
-            "Δp50%", "p99_ms", "Δp99%", "shed"]
+            "Δp50%", "p99_ms", "Δp99%", "shed", "div_p99/viol", "Δdiv%"]
     out = [cols]
     for r in table:
         mode = r["mode"] + ("" if r["same_mode"] else " (≠ baseline)")
+        ddiv = r.get("divergence_delta")
+        ddiv_cell = "-" if not ddiv else ",".join(
+            "%s:%s" % (t, _fmt(d["p99_delta_pct"], "%+.1f"))
+            for t, d in sorted(ddiv.items()))
         out.append([r["file"], mode, r["tier"],
                     _fmt(r["throughput_rps"], "%.4g"),
                     _fmt(r["thr_delta_pct"], "%+.1f"),
@@ -229,7 +308,9 @@ def render_serve_table(table):
                     _fmt(r["p50_delta_pct"], "%+.1f"),
                     _fmt(r["latency_ms_p99"], "%.4g"),
                     _fmt(r["p99_delta_pct"], "%+.1f"),
-                    _fmt(r["shed_rate"], "%.3g")])
+                    _fmt(r["shed_rate"], "%.3g"),
+                    _fmt_divergence(r.get("divergence")),
+                    ddiv_cell])
     widths = [max(len(row[i]) for row in out) for i in range(len(cols))]
     lines = []
     for i, row in enumerate(out):
@@ -488,6 +569,13 @@ def main(argv=None):
                         "growth beyond --threshold (off by default: shown-"
                         "only deltas; requires MXNET_COST_LEDGER JSONL "
                         "captures — ISSUE 13)")
+    p.add_argument("--gate-divergence", action="store_true",
+                   help="fail on SERVE_BENCH quality-plane divergence "
+                        "regressions: per-tier p99 contract-fraction "
+                        "growth beyond --threshold, or new tolerance "
+                        "violations where the baseline had none (off by "
+                        "default; requires SERVE_BENCH captures with a "
+                        "divergence block — ISSUE 16)")
     args = p.parse_args(argv)
     if len(args.files) < 2:
         p.error("need at least two files (baseline + candidates)")
@@ -509,6 +597,11 @@ def main(argv=None):
     if args.gate_p99 and not all(serve_kinds):
         print("bench_compare: --gate-p99 applies to SERVE_BENCH captures "
               "(a bench line has no latency_ms_p99)", file=sys.stderr)
+        return 2
+    if args.gate_divergence and not all(serve_kinds):
+        print("bench_compare: --gate-divergence applies to SERVE_BENCH "
+              "captures (a bench line has no divergence block)",
+              file=sys.stderr)
         return 2
     if args.gate_cost and not all(ledger_kinds):
         print("bench_compare: --gate-cost applies to compile-plane cost "
@@ -538,8 +631,9 @@ def main(argv=None):
         except (ValueError,) as e:
             print("bench_compare: %s" % e, file=sys.stderr)
             return 2
-        table, regressions = compare_serve(srows, args.threshold,
-                                           gate_p99=args.gate_p99)
+        table, regressions = compare_serve(
+            srows, args.threshold, gate_p99=args.gate_p99,
+            gate_divergence=args.gate_divergence)
         if args.json:
             print(json.dumps({"baseline": srows[0]["file"], "rows": table,
                               "threshold_pct": args.threshold,

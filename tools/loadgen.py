@@ -287,6 +287,11 @@ def run(engine, shapes, args, mode, first_request_ms=None, warmup_s=None,
         # MXNET_PRECISION_TIER rewrote this engine's plans — bench_compare
         # diffs same-tier rows only, cross-tier rows are display-only
         "tier": stats.get("precision_tier") or "fp32",
+        # quality plane (ISSUE 16): per-tier shadow-divergence summary
+        # {tier: {p50, p99, n, violations}} over contract fractions —
+        # absent when MXNET_QUALITYPLANE is off or nothing was sampled
+        # (the None-strip below drops the key, like every optional field)
+        "divergence": (stats.get("quality") or {}).get("divergence"),
     }
     line = {k: v for k, v in line.items() if v is not None}
     print("SERVE_BENCH " + json.dumps(line))
