@@ -64,8 +64,18 @@ SERVE_OPT_KEYS = {"concurrency", "rate_rps", "batch_fill_mean",
                   # violations}} over shadow-sampled contract fractions —
                   # absent when MXNET_QUALITYPLANE is off or nothing was
                   # sampled during the run
-                  "divergence"}
+                  "divergence",
+                  # ISSUE 17 router: per-priority-class breakdown
+                  # ({class: {requests, completed, sheds, downgrades,
+                  # p50_ms, p99_ms, goodput_rps[, slo_ms]}}) and the policy
+                  # mode the fronting Router ran — both absent on bare
+                  # Engine runs (--router off)
+                  "priority", "router_policy"}
 SERVE_MODES = {"closed", "open"}
+ROUTER_POLICIES = {"degrade", "shed"}
+PRIORITY_REQ_KEYS = {"requests", "completed", "sheds", "downgrades",
+                     "p50_ms", "p99_ms", "goodput_rps"}
+PRIORITY_OPT_KEYS = {"slo_ms"}
 
 
 class SchemaError(ValueError):
@@ -348,6 +358,59 @@ def validate_serve_line(obj, where="<line>"):
                 raise SchemaError(
                     "%s: divergence[%r] p99 below p50 — percentiles "
                     "swapped?" % (where, k))
+    if "router_policy" in obj and obj["router_policy"] not in ROUTER_POLICIES:
+        raise SchemaError(
+            "%s: 'router_policy' must be one of %s (omit the key when no "
+            "router fronted the run), got %r"
+            % (where, sorted(ROUTER_POLICIES), obj["router_policy"]))
+    if "priority" in obj:
+        pb = obj["priority"]
+        if not isinstance(pb, dict) or not pb:
+            raise SchemaError(
+                "%s: 'priority' must be a non-empty object of priority "
+                "class -> per-class stats (omit the key when no --class-mix "
+                "ran)" % where)
+        for k, v in pb.items():
+            if not isinstance(k, str) or not k:
+                raise SchemaError(
+                    "%s: priority class names must be non-empty strings"
+                    % where)
+            if not isinstance(v, dict):
+                raise SchemaError("%s: priority[%r] must be an object"
+                                  % (where, k))
+            unknown = set(v) - PRIORITY_REQ_KEYS - PRIORITY_OPT_KEYS
+            if unknown:
+                raise SchemaError(
+                    "%s: priority[%r] unknown keys %s (schema: %s + "
+                    "optional %s)" % (where, k, sorted(unknown),
+                                      sorted(PRIORITY_REQ_KEYS),
+                                      sorted(PRIORITY_OPT_KEYS)))
+            missing = PRIORITY_REQ_KEYS - set(v)
+            if missing:
+                raise SchemaError("%s: priority[%r] missing keys %s"
+                                  % (where, k, sorted(missing)))
+            for ck in ("requests", "completed", "sheds", "downgrades"):
+                if not isinstance(v[ck], int) or isinstance(v[ck], bool) \
+                        or v[ck] < 0:
+                    raise SchemaError(
+                        "%s: priority[%r].%s must be a non-negative int"
+                        % (where, k, ck))
+            if v["completed"] > v["requests"]:
+                raise SchemaError("%s: priority[%r] completed > requests"
+                                  % (where, k))
+            for nk in ("p50_ms", "p99_ms", "goodput_rps"):
+                if not _num(v[nk]) or v[nk] < 0:
+                    raise SchemaError(
+                        "%s: priority[%r].%s must be a non-negative number"
+                        % (where, k, nk))
+            if v["p99_ms"] < v["p50_ms"]:
+                raise SchemaError(
+                    "%s: priority[%r] p99 below p50 — percentiles swapped?"
+                    % (where, k))
+            if "slo_ms" in v and (not _num(v["slo_ms"]) or v["slo_ms"] <= 0):
+                raise SchemaError(
+                    "%s: priority[%r].slo_ms must be a positive number "
+                    "(omit when no per-class target was set)" % (where, k))
 
 
 def validate_capture(path):
@@ -536,6 +599,33 @@ def self_test():
             "bf16": {"p50": 0.1, "p99": 0.2, "n": 4.5, "violations": 0}}),
         dict(serve_good, divergence={                # negative violations
             "bf16": {"p50": 0.1, "p99": 0.2, "n": 4, "violations": -1}}),
+        # ISSUE 17 router priority block
+        dict(serve_good, router_policy="static"),    # unknown policy mode
+        dict(serve_good, router_policy=None),        # null (omit it)
+        dict(serve_good, priority={}),               # empty map (omit it)
+        dict(serve_good, priority={"paid": {         # missing downgrades
+            "requests": 5, "completed": 5, "sheds": 0,
+            "p50_ms": 1.0, "p99_ms": 2.0, "goodput_rps": 4.0}}),
+        dict(serve_good, priority={"paid": {         # completed > requests
+            "requests": 5, "completed": 6, "sheds": 0, "downgrades": 0,
+            "p50_ms": 1.0, "p99_ms": 2.0, "goodput_rps": 4.0}}),
+        dict(serve_good, priority={"paid": {         # p99 < p50
+            "requests": 5, "completed": 5, "sheds": 0, "downgrades": 0,
+            "p50_ms": 3.0, "p99_ms": 2.0, "goodput_rps": 4.0}}),
+        dict(serve_good, priority={"paid": {         # float counter
+            "requests": 5, "completed": 4.5, "sheds": 0, "downgrades": 0,
+            "p50_ms": 1.0, "p99_ms": 2.0, "goodput_rps": 4.0}}),
+        dict(serve_good, priority={"paid": {         # zero slo target
+            "requests": 5, "completed": 5, "sheds": 0, "downgrades": 0,
+            "p50_ms": 1.0, "p99_ms": 2.0, "goodput_rps": 4.0,
+            "slo_ms": 0}}),
+        dict(serve_good, priority={"paid": {         # unknown per-class key
+            "requests": 5, "completed": 5, "sheds": 0, "downgrades": 0,
+            "p50_ms": 1.0, "p99_ms": 2.0, "goodput_rps": 4.0,
+            "tier": "bf16"}}),
+        dict(serve_good, priority={"": {             # empty class name
+            "requests": 5, "completed": 5, "sheds": 0, "downgrades": 0,
+            "p50_ms": 1.0, "p99_ms": 2.0, "goodput_rps": 4.0}}),
     ]
     for obj in good:
         validate_line(obj, "self-test good")
@@ -556,6 +646,16 @@ def self_test():
         "int8": {"p50": 0.004, "p99": 0.09, "n": 17, "violations": 0},
         "bf16": {"p50": 0.001, "p99": 0.01, "n": 3, "violations": 1}}),
         "self-test serve good6")
+    validate_serve_line(dict(serve_good, router_policy="degrade", priority={
+        "paid": {"requests": 8, "completed": 8, "sheds": 0,
+                 "downgrades": 0, "p50_ms": 1.2, "p99_ms": 4.0,
+                 "goodput_rps": 5.3, "slo_ms": 50.0},
+        "best_effort": {"requests": 30, "completed": 26, "sheds": 4,
+                        "downgrades": 19, "p50_ms": 2.0, "p99_ms": 9.0,
+                        "goodput_rps": 15.0}}),
+        "self-test serve good7")
+    validate_serve_line(dict(serve_good, router_policy="shed"),
+                        "self-test serve good8")
     for i, obj in enumerate(bad):
         try:
             validate_line(obj, "self-test bad[%d]" % i)

@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""SLO-policy router smoke (ISSUE 17) — run from ci/run_tests.sh unit tier.
+
+Three phases, one process:
+
+1. **Off path**: the router layer is opt-in construction, not ambient
+   state — setting every ``MXNET_ROUTER_*`` variable must not move a
+   Predictor's AOT logical key (the variables are read once, inside
+   ``policy.config_from_env()`` at Router construction, never on the
+   Engine path), and a bare Engine run must emit a SERVE_BENCH line
+   without ``priority``/``router_policy`` keys.
+
+2. **Degrade-first beats shedding**: the acceptance bake-off.  One
+   mixed-priority open-loop overload (tools/loadgen.py in-process, same
+   seed/rate/mix) replayed against three targets — a single Engine, a
+   Router in ``shed`` mode (class-blind queue-overflow shedding, the
+   pre-twin baseline) and a Router in ``degrade`` mode (best-effort
+   traffic rerouted to the bf16 twin pool on overload, shedding last).
+   Degrade mode must STRICTLY beat both baselines on paid-class goodput,
+   hold the paid p99 inside its SLO target, and actually downgrade
+   best-effort traffic (downgrades > 0, tier-labeled replies).  Every
+   line is linted against the SERVE_BENCH schema.
+
+3. **Lock discipline**: the whole run executes under ``MXNET_LOCKCHECK=1``
+   — the router's policy loop, shared SLO monitor and per-tier engine
+   pools must finish with zero recorded violations.
+
+Tuning notes (determinism under CI, not realism): ladder=(1,) caps
+per-request capacity at the dispatch overhead so a modest open-loop rate
+floods any host; max_queue=512 keeps the saturated-FIFO delay far above
+the paid target (≈ queue * service_time ≫ target).  The paid target
+itself budgets for the degrade transient: the flood keeps arriving while
+the policy notices the pressure and flips the route, so the native pool
+must drain ≈ flood_rate * trigger_latency queued best-effort requests
+before paid latency settles — a few hundred ms that lands inside the
+target with margin, while the saturated baselines sit far outside it.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["MXNET_LOCKCHECK"] = "1"
+# per-class SLO: paid tight, best-effort loose — 2 s windows so the burn
+# signal reacts inside the run; the pressure signal triggers the policy
+# regardless of the 1/s SLO evaluation throttle
+PAID_TARGET_MS = 500.0
+BE_TARGET_MS = 1000.0
+os.environ["MXNET_SLO"] = ("paid:p95:%g:2,best_effort:p95:%g:2"
+                           % (PAID_TARGET_MS, BE_TARGET_MS))
+# router knobs: near-instant policy ticks, trigger on a 15%-full native
+# pool (small backlog to drain after the degrade flips), never restore
+# mid-run (the overload never clears while the loadgen floods)
+os.environ["MXNET_ROUTER_POLICY"] = "degrade"
+os.environ["MXNET_ROUTER_INTERVAL_S"] = "0.02"
+os.environ["MXNET_ROUTER_PRESSURE"] = "0.15"
+os.environ["MXNET_ROUTER_HOLD_S"] = "60"
+
+import numpy as np  # noqa: E402
+
+
+LADDER = (1,)
+MAX_QUEUE = 512
+DURATION_S = 3.0
+RATE_RPS = 6000.0
+CLASS_MIX = "paid:0.1,best_effort:0.9"
+
+
+def _exec_key(pred):
+    from mxnet_tpu import compile_cache
+
+    exe = pred._exec
+    return repr(("executor_fwd",
+                 compile_cache.symbol_fingerprint(exe._symbol),
+                 False) + exe._tier_key_parts(False))
+
+
+def _loadgen_args():
+    return argparse.Namespace(
+        duration=DURATION_S, concurrency=2, sizes=(1,), timeout_s=60.0,
+        rate=RATE_RPS, seed=0, slo_ms=0.0,
+        class_slo={"paid": PAID_TARGET_MS, "best_effort": BE_TARGET_MS},
+        class_mix=[("paid", 0.1), ("best_effort", 0.9)], router="off")
+
+
+def _single_engine():
+    from mxnet_tpu.serving import BucketLadder, Engine
+    from mxnet_tpu.test_utils import tiny_mlp_checkpoint
+
+    sym, params = tiny_mlp_checkpoint()
+    return Engine(sym, params, {"data": (8,)}, ladder=BucketLadder(LADDER),
+                  max_wait_ms=1.0, max_queue=MAX_QUEUE, name="rtck-single")
+
+
+def _router(mode):
+    from mxnet_tpu import serving
+    from mxnet_tpu.test_utils import tiny_mlp_checkpoint
+
+    sym, params = tiny_mlp_checkpoint()
+    reg = serving.ModelRegistry()
+    model = reg.register("rtck", sym, params, {"data": (8,)},
+                         tiers=("fp32", "bf16"),
+                         ladder=serving.BucketLadder(LADDER),
+                         max_wait_ms=1.0, max_queue=MAX_QUEUE)
+    return serving.Router(model, replicas=1, policy=mode,
+                          name="rtck-%s" % mode)
+
+
+def _bake(loadgen, cbs, target, label, router_mode="off"):
+    args = _loadgen_args()
+    args.router = router_mode
+    target.warmup()
+    line = loadgen.run(target, {"data": (8,)}, args, "open")
+    cbs.validate_serve_line(line, label)
+    return line
+
+
+def _paid(line):
+    return (line.get("priority") or {}).get("paid") or {}
+
+
+def main():
+    from mxnet_tpu.analysis import lockcheck
+    from mxnet_tpu.predictor import Predictor
+    from mxnet_tpu.test_utils import (load_module_by_path,
+                                      tiny_mlp_checkpoint)
+
+    loadgen = load_module_by_path(os.path.join(_REPO, "tools", "loadgen.py"))
+    cbs = load_module_by_path(os.path.join(_REPO, "ci",
+                                           "check_bench_schema.py"))
+    ok = True
+
+    # -- phase 1: off path ---------------------------------------------------
+    sym, params = tiny_mlp_checkpoint()
+    router_env = {k: os.environ.pop(k) for k in list(os.environ)
+                  if k.startswith("MXNET_ROUTER_")}
+    key_unset = _exec_key(Predictor(sym, params, {"data": (1, 8)}))
+    os.environ.update(router_env)
+    key_set = _exec_key(Predictor(sym, params, {"data": (1, 8)}))
+    if key_set != key_unset:
+        print("check_router: MXNET_ROUTER_* shifted the AOT logical key:\n"
+              "  unset %s\n  set   %s" % (key_unset, key_set),
+              file=sys.stderr)
+        ok = False
+
+    eng = _single_engine()
+    try:
+        line_single = _bake(loadgen, cbs, eng, "single-engine line")
+    finally:
+        eng.close()
+    for k in ("router_policy",):
+        if k in line_single:
+            print("check_router: bare-Engine SERVE_BENCH line carries %r"
+                  % k, file=sys.stderr)
+            ok = False
+    print("check_router: off path clean (single-engine paid goodput "
+          "%.1f rps)" % _paid(line_single).get("goodput_rps", 0.0))
+
+    # -- phase 2: degrade-first vs shed-only vs single -----------------------
+    rt = _router("shed")
+    try:
+        line_shed = _bake(loadgen, cbs, rt, "shed-mode line", "shed")
+        shed_stats = rt.stats()
+    finally:
+        rt.close()
+    rt = _router("degrade")
+    try:
+        line_deg = _bake(loadgen, cbs, rt, "degrade-mode line", "degrade")
+        deg_stats = rt.stats()
+    finally:
+        rt.close()
+
+    paid_single = _paid(line_single).get("goodput_rps", 0.0)
+    paid_shed = _paid(line_shed).get("goodput_rps", 0.0)
+    paid_deg = _paid(line_deg).get("goodput_rps", 0.0)
+    print("check_router: paid goodput rps — single %.1f, shed %.1f, "
+          "degrade %.1f" % (paid_single, paid_shed, paid_deg))
+    if not (paid_deg > paid_shed and paid_deg > paid_single):
+        print("check_router: degrade-first must STRICTLY beat both "
+              "baselines on paid goodput", file=sys.stderr)
+        ok = False
+    paid_p99 = _paid(line_deg).get("p99_ms", float("inf"))
+    if paid_p99 > PAID_TARGET_MS:
+        print("check_router: degrade-mode paid p99 %.1f ms blew the %g ms "
+              "target" % (paid_p99, PAID_TARGET_MS), file=sys.stderr)
+        ok = False
+    be_deg = (line_deg.get("priority") or {}).get("best_effort") or {}
+    if not be_deg.get("downgrades", 0) > 0:
+        print("check_router: degrade mode never downgraded best-effort "
+              "traffic (downgrades=%r)" % be_deg.get("downgrades"),
+              file=sys.stderr)
+        ok = False
+    if line_deg.get("router_policy") != "degrade" \
+            or line_shed.get("router_policy") != "shed":
+        print("check_router: SERVE_BENCH router_policy labels wrong: %r/%r"
+              % (line_deg.get("router_policy"),
+                 line_shed.get("router_policy")), file=sys.stderr)
+        ok = False
+    # shed mode is a policy no-op by contract: no transitions, no
+    # downgrades — its only overload response is admission-queue overflow
+    if shed_stats["router"]["policy_counts"]["degrade"] != 0 \
+            or shed_stats["downgrades"] != 0:
+        print("check_router: shed-only router degraded traffic",
+              file=sys.stderr)
+        ok = False
+    if deg_stats["router"]["policy_counts"]["degrade"] < 1:
+        print("check_router: degrade router recorded no policy transition",
+              file=sys.stderr)
+        ok = False
+
+    # -- phase 3: lock discipline --------------------------------------------
+    bad = lockcheck.violations()
+    if bad:
+        print("check_router: %d lockcheck violation(s):" % len(bad),
+              file=sys.stderr)
+        for v in bad[:10]:
+            print("  %s" % (v,), file=sys.stderr)
+        ok = False
+    else:
+        print("check_router: zero lockcheck violations")
+
+    if not ok:
+        print("check_router: FAIL", file=sys.stderr)
+        return 1
+    print("check_router: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -109,6 +109,14 @@ case "$tier" in
     # the calibration-drift counter and a tolerance-violation flightrec
     # dump naming the tier and bucket
     ./dev.sh python ci/check_quality_plane.py
+    # SLO-policy router smoke (ISSUE 17): MXNET_ROUTER_* must not move
+    # AOT logical keys (off-path invariance); under the same mixed-
+    # priority open-loop overload, degrade-first (best-effort rerouted to
+    # the bf16 twin pool) must STRICTLY beat the single-engine and
+    # shed-only baselines on paid-class goodput, hold the paid p99 target
+    # and label downgraded replies with the serving tier; whole run under
+    # MXNET_LOCKCHECK=1 with zero violations
+    ./dev.sh python ci/check_router.py
     # telemetry unit tests (tests/test_telemetry.py) run as part of tests/
     ignore=()
     for f in "${NIGHTLY_FILES[@]}"; do ignore+=(--ignore "$f"); done

@@ -14,6 +14,13 @@ flows through ``mxnet_tpu.telemetry`` when ``MXNET_TELEMETRY`` is on.
     eng.warmup()
     out = eng.predict({"data": x})        # x: (n, 8)
 
+Above a single engine sits the SLO-policy layer (ISSUE 17): a
+``ModelRegistry`` holds a model's precision-tier twins hot (PR 15 shared
+weights, int8 seed-trace calibration), and a ``Router`` fronts per-tier
+replica pools with priority classes, degrading best-effort traffic to
+the cheaper twin on SLO burn BEFORE any shedding — docs/SERVING.md
+"Router and degradation policy".
+
 Load-test with ``tools/loadgen.py``; docs/SERVING.md has the architecture,
 tuning guide, and the SERVE_BENCH schema.
 """
@@ -22,10 +29,15 @@ from .admission import (AdmissionController, EngineClosed, RequestCancelled,
 from .batcher import MicroBatcher, Request
 from .bucketing import Bucket, BucketLadder, pow2_ladder
 from .engine import Engine
+from .model_registry import ModelRegistry, RegisteredModel
+from .policy import DegradePolicy, PolicyConfig
+from .router import Router, RouterRequest
 from .warmup import warmup_engine
 
 __all__ = [
-    "AdmissionController", "Bucket", "BucketLadder", "Engine", "EngineClosed",
-    "MicroBatcher", "Request", "RequestCancelled", "RequestTimeout",
-    "ServerBusy", "ServingError", "pow2_ladder", "warmup_engine",
+    "AdmissionController", "Bucket", "BucketLadder", "DegradePolicy",
+    "Engine", "EngineClosed", "MicroBatcher", "ModelRegistry",
+    "PolicyConfig", "RegisteredModel", "Request", "RequestCancelled",
+    "RequestTimeout", "Router", "RouterRequest", "ServerBusy",
+    "ServingError", "pow2_ladder", "warmup_engine",
 ]

@@ -98,12 +98,26 @@ class Engine:
     start : bool
         Start the device loop immediately (default).  ``start=False`` lets
         tests and warmup-first deployments queue/compile before serving.
+    proto : Predictor, optional
+        Serve an ALREADY-BUILT predictor instead of loading
+        ``symbol``/``params`` (which may then be None): the engine
+        specializes its buckets off this one via ``with_shapes``, sharing
+        its weight device buffers and carrying its precision tier — how
+        the model registry (ISSUE 17) spins up N replicas of a tier twin
+        without re-loading the checkpoint per replica.
+    slo_monitor : SLOMonitor, optional
+        Share an external monitor instead of building one from
+        ``MXNET_SLO`` — the router feeds every replica into ONE monitor so
+        burn rates aggregate across the fleet.  The engine does not
+        install its ``on_breach`` hook on a shared monitor (the owner
+        wires breach handling once).
     """
 
     def __init__(self, symbol, params, sample_shapes, ladder=None,
                  max_wait_ms=None, max_queue=None, timeout_ms=None,
                  dtype="float32", ctx=None, output_names=None, name="serve",
-                 start=True, max_direct_batch=None):
+                 start=True, max_direct_batch=None, proto=None,
+                 slo_monitor=None):
         from .. import telemetry
 
         self.name = name
@@ -145,9 +159,16 @@ class Engine:
         # accounting is by the separate _compiled set (first forward), so
         # seeding doesn't hide that bucket's one compile.
         proto_bucket = self.ladder.signatures(self.sample_shapes)[0]
-        self._proto = Predictor(symbol, params, proto_bucket.input_shapes(),
-                                ctx=ctx, output_names=output_names,
-                                dtype=dtype)
+        if proto is not None:
+            # registry-built tier twin: respecialize over SHARED weight
+            # buffers (with_shapes carries tier + calibration), so a pool
+            # of replicas costs one checkpoint load total
+            self._proto = proto.with_shapes(proto_bucket.input_shapes())
+        else:
+            self._proto = Predictor(symbol, params,
+                                    proto_bucket.input_shapes(),
+                                    ctx=ctx, output_names=output_names,
+                                    dtype=dtype)
         self._cache = {proto_bucket.key: self._proto}  # ladder sigs, pinned
         self._direct_cache = collections.OrderedDict()  # one-offs, LRU
         self._compiled = set()      # signatures past their first forward
@@ -182,7 +203,8 @@ class Engine:
         # forward (busy, healthy) from a dead loop (not busy, stale).
         # Single writer per mutex-holder, read lock-free (GIL-atomic).
         self._busy_since = None
-        self._slo = slo.monitor_from_env()
+        self._shared_slo = slo_monitor is not None
+        self._slo = slo_monitor if self._shared_slo else slo.monitor_from_env()
         self._flightrec = flightrec.recorder()
         # inference quality plane (ISSUE 16): shadow-sampled twin
         # divergence + calibration drift.  Gate unset ⇒ plane is None,
@@ -195,7 +217,9 @@ class Engine:
             self._quality_thread = None  # started lazily at first sample
             self._quality_ref = {}       # bucket.key -> fp32 sibling
             self._quality_sites_key = None  # drift-baseline anchor
-        if self._slo is not None:
+        if self._slo is not None and not self._shared_slo:
+            # a shared (router-owned) monitor keeps ONE breach hook wired
+            # by its owner; per-replica installs would race to overwrite it
             self._slo.on_breach = self._on_slo_breach
         ops_server.maybe_register(self)
         # lock-discipline checking (ISSUE 8, MXNET_LOCKCHECK=1): swap the
@@ -270,21 +294,32 @@ class Engine:
         self.close()
 
     # -- request path --------------------------------------------------------
-    def submit(self, inputs, timeout=None, klass=None):
+    def submit(self, inputs, timeout=None, klass=None, trace_parent=None):
         """Enqueue one request; returns a future-like ``Request``.
 
         ``inputs``: dict name -> array with leading sample-count dim n>=1.
         ``timeout``: seconds until the request is dropped if still queued
         (overrides the engine default).  ``klass``: request class for SLO
         accounting (``MXNET_SLO`` objectives; None ⇒ "default" — classes
-        change nothing about scheduling in this PR, they only label the
-        latency signal).  Raises ``ServerBusy`` when the queue is at
-        capacity, ``EngineClosed`` after ``close()``.
+        change nothing about an un-routed engine's scheduling, they only
+        label the latency signal; the router maps priorities onto them).
+        ``trace_parent``: a ``tracing.SpanContext`` to join instead of
+        starting a fresh trace — the router's route span passes its
+        context here so one trace covers the router→replica handoff.
+        Raises ``ServerBusy`` when the queue is at capacity,
+        ``EngineClosed`` after ``close()``.  Completed requests carry the
+        serving precision tier as ``req.tier`` (the reply tier-label
+        contract, ISSUE 17).
         """
         # span tracing (MXNET_TRACE, telemetry/tracing.py): the request root
         # lives on a per-trace lane; its context rides on the Request so the
         # device loop's spans flow-link back here across the thread handoff
-        root = tracing.start_trace("request", lane=True, engine=self.name)
+        if trace_parent is not None:
+            root = tracing.span("request", parent=trace_parent, lane=True,
+                                engine=self.name)
+        else:
+            root = tracing.start_trace("request", lane=True,
+                                       engine=self.name)
         try:
             with tracing.span("classify", parent=root):
                 arrays, n, bucket_shapes, direct = self._classify(inputs)
@@ -509,8 +544,13 @@ class Engine:
             total = sum(r.n for r in reqs)
             waste = self._padding_waste(reqs, bucket)
             with tracing.span("reply"):
+                served_tier = pred._exec.precision_tier
                 off = 0
                 for req in reqs:
+                    # reply tier label (ISSUE 17): stamped BEFORE the
+                    # result event so a waiter that wakes on result() can
+                    # immediately read which twin actually served it
+                    req.tier = served_tier
                     req.set_result([o[off:off + req.n] for o in outs])
                     off += req.n
         for r in traced:

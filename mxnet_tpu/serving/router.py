@@ -1,0 +1,430 @@
+"""SLO-policy serving router — degrade precision before shedding
+(ISSUE 17, ROADMAP item 1).
+
+The missing layer between the per-device :class:`Engine` and a latency
+contract: a :class:`Router` fronts per-tier pools of Engine replicas
+built from one :class:`~mxnet_tpu.serving.model_registry.RegisteredModel`
+(precision-tier twins over shared weights, PR 15), threads **priority
+classes** (``paid``/``best_effort``) through admission, and runs a policy
+loop over the live per-class SLO burn rate (``SLOMonitor.burn_rates()``,
+PR 10's signal finally given a consumer) whose FIRST overload response is
+rerouting best-effort traffic to the cheaper twin and whose LAST resort
+is the bounded queues' own shedding:
+
+* every replica pool feeds ONE router-owned SLO monitor, so burn rates
+  aggregate across the fleet;
+* paid traffic keeps the native pool: degrading best-effort both serves
+  it cheaper AND isolates the native queue for paid latency;
+* every reply carries the tier that actually served it (``req.tier``,
+  stamped by the engine reply path — the tier-label contract);
+* downgrade/shed decisions are counted per priority (``stats()``,
+  ``router_*`` telemetry counters) and traced: the route span's context
+  is handed to ``Engine.submit(trace_parent=...)`` so one trace covers
+  the router→replica thread hop (PR 4 flow links);
+* ``stats()`` is Engine-shaped (compiles / precision_tier / quality keys
+  loadgen already reads) plus a ``router`` block, mirrored into
+  ``/statusz`` under ``"routers"``.
+
+Construction is always explicit — no env var conjures a router, so the
+bare-Engine path reads nothing new (the off-path acceptance).  The
+``MXNET_ROUTER_*`` knobs are read once inside ``policy.config_from_env``
+at router construction.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..telemetry import flightrec, ops_server, qualityplane, slo, tracing
+from .admission import EngineClosed, ServerBusy
+from .policy import DegradePolicy, PolicyConfig, config_from_env
+
+__all__ = ["Router", "RouterRequest", "DEFAULT_PRIORITIES"]
+
+DEFAULT_PRIORITIES = ("paid", "best_effort")
+
+# policy-transition history kept for stats()["router"]["transitions"]
+_TRANSITION_RING = 32
+
+
+class RouterRequest:
+    """Future returned by :meth:`Router.submit` — the engine
+    :class:`~mxnet_tpu.serving.batcher.Request` plus the routing facts:
+    which priority the request carried, which tier/engine it was routed
+    to, and (once completed) which tier actually served it."""
+
+    __slots__ = ("_req", "priority", "routed_tier", "engine_name")
+
+    def __init__(self, req, priority, routed_tier, engine_name):
+        self._req = req
+        self.priority = priority
+        self.routed_tier = routed_tier
+        self.engine_name = engine_name
+
+    @property
+    def tier(self):
+        """The serving tier label (reply contract): stamped by the engine
+        reply path at completion; until then, the routed tier."""
+        return getattr(self._req, "tier", self.routed_tier)
+
+    @property
+    def n(self):
+        return self._req.n
+
+    @property
+    def latency_s(self):
+        return self._req.latency_s
+
+    @property
+    def t_done(self):
+        return self._req.t_done
+
+    def result(self, timeout=None):
+        return self._req.result(timeout)
+
+    def done(self):
+        return self._req.done()
+
+    def cancel(self):
+        return self._req.cancel()
+
+
+class Router:
+    """Route requests across a registered model's tier-twin engine pools.
+
+    Parameters
+    ----------
+    model : RegisteredModel
+        The twin set (from :meth:`ModelRegistry.register`).  One Engine
+        pool is built per registered tier; ``model.tiers[0]`` is the
+        native tier, ``model.tiers[1]`` (when present) the degradation
+        target.
+    replicas : int or dict
+        Engines per pool (a ``{tier: n}`` dict sizes pools separately).
+    policy : PolicyConfig or str, optional
+        Policy knobs, or just a mode name; default
+        ``policy.config_from_env()`` (``MXNET_ROUTER_*``, read here
+        once).
+    priorities : sequence of str
+        Known priority classes, most-protected first.  ``protected``
+        priorities are never degraded.
+    slo_monitor : SLOMonitor, optional
+        Explicit shared monitor; default ``slo.monitor_from_env()``
+        (``MXNET_SLO``) — without one the policy falls back to queue
+        pressure alone.
+    start : bool
+        Start replica device loops + the policy loop (default).  With
+        ``start=False`` call :meth:`start` later; :meth:`_policy_tick`
+        can always be driven manually (tests).
+    """
+
+    def __init__(self, model, replicas=1, policy=None, name="router",
+                 priorities=DEFAULT_PRIORITIES, protected=("paid",),
+                 default_priority=None, slo_monitor=None, start=True):
+        from .. import telemetry
+
+        if len(model.tiers) < 2:
+            raise ValueError(
+                "router needs a degradation target: register the model "
+                "with at least two tiers (got %s)" % (model.tiers,))
+        self.name = name
+        self.model = model
+        self.priorities = tuple(priorities)
+        if not self.priorities:
+            raise ValueError("need at least one priority class")
+        self.default_priority = (default_priority
+                                 if default_priority is not None
+                                 else self.priorities[-1])
+        if self.default_priority not in self.priorities:
+            raise ValueError("default_priority %r not in priorities %s"
+                             % (self.default_priority, self.priorities))
+        if isinstance(policy, str):
+            policy = config_from_env(mode=policy)
+        elif policy is None:
+            policy = config_from_env()
+        elif not isinstance(policy, PolicyConfig):
+            raise TypeError("policy must be a PolicyConfig or mode string")
+        self._policy_cfg = policy
+        self._policy = DegradePolicy(policy, self.priorities,
+                                     protected=protected)
+        self._native = model.native_tier
+        self._degrade_tier = model.tiers[1]
+        self._slo = (slo_monitor if slo_monitor is not None
+                     else slo.monitor_from_env())
+        self._flightrec = flightrec.recorder()
+        self._probe = telemetry.router_probe(name)
+        if self._slo is not None:
+            # the fleet shares ONE monitor; the router owns its breach hook
+            self._slo.on_breach = self._on_slo_breach
+        self._mu = threading.Lock()
+        self._route = {p: self._native for p in self.priorities}
+        self._counters = {p: {"requests": 0, "downgrades": 0, "sheds": 0}
+                          for p in self.priorities}
+        self._policy_counts = {"degrade": 0, "restore": 0}
+        self._transitions = []
+        self._last_signals = {}
+        self._closed = False
+        self._wake = threading.Event()
+        self._thread = None
+        # replica pools: tier -> [Engine]; every engine shares the router
+        # monitor (or its absence) and the twin's weight buffers
+        if isinstance(replicas, dict):
+            counts = {t: int(replicas.get(t, 1)) for t in model.tiers}
+        else:
+            counts = {t: int(replicas) for t in model.tiers}
+        self._pools = {}
+        for tier in model.tiers:
+            n = max(1, counts[tier])
+            self._pools[tier] = [
+                model.build_engine(
+                    tier, name="%s-%s-%d" % (name, tier, i),
+                    slo_monitor=self._slo, start=start)
+                for i in range(n)]
+        ops_server.maybe_register_router(self)
+        if start:
+            self.start()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        """Start replica device loops and the policy loop (idempotent)."""
+        if self._closed:
+            raise EngineClosed("router is closed")
+        for pool in self._pools.values():
+            for eng in pool:
+                eng.start()
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._policy_loop, name="mxnet-router-%s" % self.name,
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def close(self):
+        """Stop the policy loop and close every replica engine."""
+        self._closed = True
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+        for pool in self._pools.values():
+            for eng in pool:
+                eng.close()
+        ops_server.unregister_router(self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def warmup(self, max_workers=None):
+        """Pre-compile every pool's ladder (AOT-cache aware, PR 6) ->
+        ``{engine name: per-bucket report list}``."""
+        out = {}
+        for tier in self.model.tiers:
+            for eng in self._pools[tier]:
+                out[eng.name] = eng.warmup(max_workers=max_workers)
+        return out
+
+    def engines(self, tier=None):
+        """Replica engines (one tier's pool, or all)."""
+        if tier is not None:
+            return list(self._pools[tier])
+        return [e for t in self.model.tiers for e in self._pools[t]]
+
+    # -- request path --------------------------------------------------------
+    def submit(self, inputs, timeout=None, klass=None, priority=None):
+        """Route one request; returns a :class:`RouterRequest`.
+
+        ``priority`` picks the routing class (default
+        ``default_priority``; a ``klass`` naming a known priority is
+        used when ``priority`` is omitted, so loadgen-style callers pass
+        one string).  ``klass`` labels SLO accounting and defaults to
+        the priority — per-priority objectives in ``MXNET_SLO`` then
+        just work.  Raises ``ServerBusy`` when the routed pool's
+        admission queue is full (the shed path — counted per priority).
+        """
+        if self._closed:
+            raise EngineClosed("router is closed")
+        prio = priority
+        if prio is None and klass in self._route:
+            prio = klass
+        if prio is None or prio not in self._route:
+            prio = self.default_priority
+        if klass is None:
+            klass = prio
+        # the routing decision span: its context rides into Engine.submit
+        # so the replica's request/queue/dispatch spans join THIS trace
+        # across the thread handoff
+        root = tracing.start_trace("route", lane=True, router=self.name,
+                                   priority=prio)
+        with self._mu:
+            tier = self._route[prio]
+            downgraded = tier != self._native
+            c = self._counters[prio]
+            c["requests"] += 1
+            if downgraded:
+                c["downgrades"] += 1
+        eng = self._pick(self._pools[tier])
+        if root:
+            root.set(tier=tier, engine=eng.name, downgraded=int(downgraded))
+        if self._probe:
+            self._probe.record_route(prio, tier, downgraded)
+        try:
+            req = eng.submit(inputs, timeout=timeout, klass=klass,
+                             trace_parent=root.context() if root else None)
+        except ServerBusy:
+            # the LAST resort fired: the routed pool's bounded queue is
+            # full.  Count it against the priority so the ladder's
+            # "degrade before shed" claim is auditable per class.
+            with self._mu:
+                self._counters[prio]["sheds"] += 1
+            if self._probe:
+                self._probe.record_shed(prio)
+            if self._flightrec is not None:
+                self._flightrec.record("router_shed", router=self.name,
+                                       priority=prio, tier=tier,
+                                       engine=eng.name)
+            if root:
+                root.finish(drop="shed")
+            raise
+        except Exception:
+            if root:
+                root.finish(drop="rejected")
+            raise
+        if root:
+            root.finish()
+        return RouterRequest(req, prio, tier, eng.name)
+
+    def predict(self, inputs, timeout=None, klass=None, priority=None):
+        """Synchronous convenience: submit + wait -> output arrays (the
+        same contract as ``Engine.predict``)."""
+        return self.submit(inputs, timeout=timeout, klass=klass,
+                           priority=priority).result(None)
+
+    @staticmethod
+    def _pick(pool):
+        """Least-loaded replica (queue depth; stable min, so equal-depth
+        pools drain in replica order)."""
+        if len(pool) == 1:
+            return pool[0]
+        return min(pool, key=lambda e: e._batcher.depth())
+
+    # -- policy loop ---------------------------------------------------------
+    def _on_slo_breach(self, objective, value_s):
+        """Shared-monitor breach hook (fired outside the monitor lock):
+        mirror into telemetry + the flight recorder, attributed to the
+        router rather than any single replica."""
+        from .. import telemetry
+
+        telemetry.note_slo_breach(objective.klass, objective.percentile,
+                                  value_s * 1e3, objective.target_s * 1e3)
+        if self._flightrec is not None:
+            self._flightrec.record("slo_breach", router=self.name,
+                                   objective=objective.key(),
+                                   value_ms=round(value_s * 1e3, 3))
+
+    def _signals(self, now):
+        """The policy inputs: max windowed burn rate across objectives
+        (None without a monitor or traffic) + native-pool queue
+        pressure."""
+        burn = None
+        if self._slo is not None:
+            rates = self._slo.burn_rates(now)
+            burns = [r["burn_rate"] for r in rates.values()
+                     if r["burn_rate"] is not None]
+            if burns:
+                burn = max(burns)
+        pressure = 0.0
+        for eng in self._pools[self._native]:
+            cap = float(eng.admission.max_queue) or 1.0
+            pressure = max(pressure, eng._batcher.depth() / cap)
+        return {"burn": burn, "pressure": round(pressure, 4)}
+
+    def _policy_tick(self, now=None):
+        """One policy evaluation (the loop's body; tests drive it with a
+        synthetic clock) -> the applied transitions."""
+        now = time.monotonic() if now is None else now
+        signals = self._signals(now)
+        actions = self._policy.step(signals, now)
+        for action, prio in actions:
+            tier = (self._degrade_tier if action == "degrade"
+                    else self._native)
+            with self._mu:
+                self._route[prio] = tier
+                self._policy_counts[action] += 1
+                self._transitions.append({
+                    "action": action, "priority": prio, "tier": tier,
+                    "burn": signals["burn"],
+                    "pressure": signals["pressure"],
+                    "unix_ts": round(time.time(), 3)})
+                del self._transitions[:-_TRANSITION_RING]
+            if self._probe:
+                self._probe.record_transition(action, prio,
+                                              action == "degrade")
+            if self._flightrec is not None:
+                self._flightrec.record("router_policy", router=self.name,
+                                       action=action, priority=prio,
+                                       tier=tier, burn=signals["burn"],
+                                       pressure=signals["pressure"])
+        self._last_signals = signals
+        return actions
+
+    def _policy_loop(self):
+        interval = max(0.01, self._policy_cfg.interval_s)
+        while not self._closed:
+            self._wake.wait(interval)
+            if self._closed:
+                return
+            try:
+                self._policy_tick()
+            except Exception:
+                pass  # the policy loop must never die under the router
+
+    # -- introspection -------------------------------------------------------
+    def stats(self):
+        """Engine-shaped stats (the keys loadgen/bench readers use) plus
+        the ``router`` block (/statusz ``"routers"`` mirror)."""
+        with self._mu:
+            route = dict(self._route)
+            counters = {p: dict(c) for p, c in self._counters.items()}
+            policy_counts = dict(self._policy_counts)
+            transitions = list(self._transitions)
+        engines = {}
+        compiles = 0
+        submitted = completed = shed = 0
+        for tier in self.model.tiers:
+            for eng in self._pools[tier]:
+                es = eng.stats()
+                compiles += es["compiles"]
+                submitted += es["submitted"]
+                completed += es["completed"]
+                shed += es["shed"]
+                engines[eng.name] = {
+                    "tier": tier, "queue_depth": es["queue_depth"],
+                    "submitted": es["submitted"],
+                    "completed": es["completed"], "shed": es["shed"],
+                    "compiles": es["compiles"]}
+        out = {
+            "submitted": submitted, "completed": completed, "shed": shed,
+            "compiles": compiles,
+            "requests": sum(c["requests"] for c in counters.values()),
+            "downgrades": sum(c["downgrades"] for c in counters.values()),
+            "sheds": sum(c["sheds"] for c in counters.values()),
+            # the native tier: what un-degraded traffic compiles under —
+            # the same discriminator slot Engine.stats() exposes
+            "precision_tier": self._native,
+            "router": {
+                "policy": self._policy.status(now=time.monotonic()),
+                "native_tier": self._native,
+                "degrade_tier": self._degrade_tier,
+                "route": route,
+                "priorities": counters,
+                "transitions": transitions,
+                "policy_counts": policy_counts,
+                "signals": dict(self._last_signals),
+                "replicas": {t: [e.name for e in self._pools[t]]
+                             for t in self.model.tiers}},
+            "engines": engines}
+        out["slo"] = self._slo.status() if self._slo is not None else None
+        out["quality"] = qualityplane.status()
+        return out

@@ -22,7 +22,8 @@ from . import costplane  # compile plane (ISSUE 13)
 from . import qualityplane  # inference quality plane (ISSUE 16)
 from .sinks import (JsonlSink, PrometheusSink, ProfilerSink, Sink,
                     TensorBoardSink, iter_scalar_samples, render_prometheus)
-from .instrument import (ServeProbe, StepProbe, add_sink, array_nbytes,
+from .instrument import (RouterProbe, ServeProbe, StepProbe, add_sink,
+                         array_nbytes,
                          counter, enabled, event, flush, gauge, histogram,
                          instrument_step, interval_s, jsonl_path,
                          note_analysis_finding, note_aot_cache,
@@ -31,8 +32,8 @@ from .instrument import (ServeProbe, StepProbe, add_sink, array_nbytes,
                          note_compile, note_dispatch, note_fused_fallback,
                          note_graph_passes, note_lockcheck_violation,
                          note_nonfinite, note_slo_breach, note_train_step,
-                         registry, sample_memory, serve_probe, step_probe,
-                         summary)
+                         registry, router_probe, sample_memory, serve_probe,
+                         step_probe, summary)
 
 __all__ = [
     "tracing", "flightrec", "ops_server", "slo", "trainhealth", "costplane",
@@ -41,7 +42,8 @@ __all__ = [
     "DEFAULT_BUCKETS",
     "Sink", "JsonlSink", "PrometheusSink", "ProfilerSink", "TensorBoardSink",
     "iter_scalar_samples", "render_prometheus",
-    "ServeProbe", "StepProbe", "add_sink", "array_nbytes", "counter",
+    "RouterProbe", "ServeProbe", "StepProbe", "add_sink", "array_nbytes",
+    "counter",
     "enabled", "event", "flush", "gauge", "histogram", "instrument_step",
     "interval_s", "jsonl_path", "note_analysis_finding", "note_aot_cache",
     "note_autotune_cache",
@@ -49,6 +51,6 @@ __all__ = [
     "note_dispatch", "note_fused_fallback", "note_graph_passes",
     "note_lockcheck_violation", "note_nonfinite", "note_slo_breach",
     "note_train_step",
-    "registry", "sample_memory",
+    "registry", "router_probe", "sample_memory",
     "serve_probe", "step_probe", "summary",
 ]

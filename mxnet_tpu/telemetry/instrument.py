@@ -586,6 +586,56 @@ def serve_probe(engine):
     return ServeProbe(engine) if enabled() else None
 
 
+class RouterProbe:
+    """Per-router serving-policy metrics (ISSUE 17): routed-request /
+    downgrade / shed counters by priority class, policy-transition
+    counter, and a degraded-state gauge per priority.  Same contract as
+    ``ServeProbe``: construct via ``router_probe`` — None when telemetry
+    is off, the router guards with ``if probe:``, and the routing hot
+    path carries zero added work disabled."""
+
+    def __init__(self, router):
+        self.router = router
+        r = registry()
+        self._r = r
+        self.requests = r.counter(
+            "router_requests_total", "requests routed, by priority class",
+            ("router", "priority"))
+        self.downgrades = r.counter(
+            "router_downgrades_total", "requests routed to a cheaper twin "
+            "than their native tier", ("router", "priority", "tier"))
+        self.sheds = r.counter(
+            "router_sheds_total", "requests shed at the routed pool's "
+            "admission gate", ("router", "priority"))
+        self.transitions = r.counter(
+            "router_policy_transitions_total", "policy-loop tier moves "
+            "(degrade / restore edges)", ("router", "action"))
+        self.degraded = r.gauge(
+            "router_degraded", "1 while a priority class is routed below "
+            "its native tier", ("router", "priority"))
+
+    def record_route(self, priority, tier, downgraded):
+        self.requests.inc(router=self.router, priority=priority)
+        if downgraded:
+            self.downgrades.inc(router=self.router, priority=priority,
+                                tier=tier)
+
+    def record_shed(self, priority):
+        self.sheds.inc(router=self.router, priority=priority)
+
+    def record_transition(self, action, priority, degraded_now):
+        self.transitions.inc(router=self.router, action=action)
+        self.degraded.set(1.0 if degraded_now else 0.0,
+                          router=self.router, priority=priority)
+        self._r.event("router_policy", router=self.router, action=action,
+                      priority=priority)
+
+
+def router_probe(router):
+    """RouterProbe for one router, or None with telemetry disabled."""
+    return RouterProbe(router) if enabled() else None
+
+
 # -- bench summary ------------------------------------------------------------
 def summary():
     """The bench.py ``telemetry`` block: compile_s, peak_hbm_bytes,

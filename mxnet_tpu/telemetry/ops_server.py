@@ -26,7 +26,10 @@ Endpoints:
   (``trainhealth.status()`` — last drained row + per-rank heartbeats,
   None when ``MXNET_TRAINHEALTH`` is off), the inference quality block
   (``qualityplane.status()`` — shadow divergence + calibration drift,
-  None when ``MXNET_QUALITYPLANE`` is off), and process metadata.
+  None when ``MXNET_QUALITYPLANE`` is off), per-router routing/policy
+  state (``Router.stats()``, ISSUE 17 — routers register separately and
+  never enter /healthz, which probes device loops they don't have), and
+  process metadata.
 
 Engines self-register at construction and unregister at ``close()``;
 registration holds only a weak reference, so a dropped engine never stays
@@ -45,13 +48,17 @@ import weakref
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 __all__ = ["enabled", "configured_port", "stale_s", "maybe_start",
-           "maybe_register", "register", "unregister", "port", "active",
-           "stop"]
+           "maybe_register", "register", "unregister",
+           "maybe_register_router", "register_router", "unregister_router",
+           "port", "active", "stop"]
 
 _mu = threading.Lock()
 _server = None
 _thread = None
 _engines = []   # weakref.ref list, pruned on read
+_routers = []   # serving routers (ISSUE 17) — separate list: a router has
+                # no device loop/batcher, so engine_health must never see
+                # one; its replica engines self-register above as usual
 _failed = False
 
 
@@ -147,6 +154,43 @@ def maybe_register(engine):
     return p
 
 
+def _live_routers():
+    with _mu:
+        live, out = [], []
+        for ref in _routers:
+            r = ref()
+            if r is not None:
+                live.append(ref)
+                out.append(r)
+        _routers[:] = live
+        return out
+
+
+def register_router(router):
+    """Track a serving router for /statusz (weakly) — ISSUE 17.  Routers
+    stay out of /healthz: they own no device loop, and their replica
+    engines already report liveness individually."""
+    with _mu:
+        if not any(ref() is router for ref in _routers):
+            _routers.append(weakref.ref(router))
+
+
+def unregister_router(router):
+    with _mu:
+        _routers[:] = [ref for ref in _routers
+                       if ref() is not None and ref() is not router]
+
+
+def maybe_register_router(router):
+    """Router entry point: start-if-gated, then register.  One env read
+    when the gate is unset."""
+    p = maybe_start()
+    if p is None:
+        return None
+    register_router(router)
+    return p
+
+
 def port():
     """The actually-bound port (resolves MXNET_OPS_PORT=0), or None."""
     with _mu:
@@ -190,6 +234,7 @@ def stop():
         srv, th = _server, _thread
         _server = _thread = None
         _engines[:] = []
+        _routers[:] = []
         _failed = False
     if srv is not None:
         srv.shutdown()
@@ -259,6 +304,20 @@ def _statusz():
             engines[label] = e.stats()
         except Exception as ex:
             engines[label] = {"error": repr(ex)}
+    # serving routers (ISSUE 17): policy + per-priority routing state —
+    # present only while a router is alive; the empty dict with no router
+    # keeps the /statusz shape stable
+    routers = {}
+    for r in _live_routers():
+        label = r.name
+        i = 1
+        while label in routers:
+            i += 1
+            label = "%s#%d" % (r.name, i)
+        try:
+            routers[label] = r.stats()
+        except Exception as ex:
+            routers[label] = {"error": repr(ex)}
     ok, health = _health()
     try:
         # trainer_stats() mirror (ISSUE 12): last health row + per-rank
@@ -280,8 +339,8 @@ def _statusz():
         qp = {"error": repr(ex)}
     return {"pid": os.getpid(), "unix_ts": round(time.time(), 6),
             "telemetry_enabled": instrument.enabled(),
-            "health": health, "engines": engines, "trainhealth": th,
-            "costplane": cp, "quality": qp}
+            "health": health, "engines": engines, "routers": routers,
+            "trainhealth": th, "costplane": cp, "quality": qp}
 
 
 # -- handler ------------------------------------------------------------------

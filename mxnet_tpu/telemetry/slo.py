@@ -4,8 +4,9 @@ Every latency surface this repo had before was post-hoc: loadgen computes
 ``np.percentile`` over a finished run, the JSONL log is read after the
 fact.  An operator watching a LIVE engine needs the P99 *now*, from inside
 the serving process, at O(1) memory — that signal is the prerequisite for
-SLO-driven shedding (ROADMAP item 1; this PR builds the signal, the
-default policy is unchanged).
+SLO-driven shedding (ROADMAP item 1; ``serving/router.py`` consumes it
+through :meth:`SLOMonitor.burn_rates` — a bare Engine's default shedding
+policy is unchanged).
 
 Three pieces:
 
@@ -322,6 +323,15 @@ class SLOMonitor:
         self._counts = {o.key(): [0, 0] for o in self.objectives}
         self._breached = {o.key(): False for o in self.objectives}
         self._breaches = {o.key(): 0 for o in self.objectives}
+        # last ok->breach edge per objective (monotonic + unix; None until
+        # the first edge) — the "how long ago did this start hurting"
+        # signal policy loops key hysteresis on (ISSUE 17)
+        self._last_breach = {o.key(): None for o in self.objectives}
+        self._last_breach_unix = {o.key(): None for o in self.objectives}
+        # per-objective snapshot of the LAST throttled evaluation: the
+        # policy loop's read path (burn_rates()) serves from this cache, so
+        # a sub-second polling loop never re-walks quantiles
+        self._burn = {}
         self._last_check = 0.0
         self.on_breach = None
 
@@ -432,19 +442,73 @@ class SLOMonitor:
         self._last_check = now
         fired = []
         for o in self.objectives:
-            value, met, n, drops, _ = self._evaluate(o, now)
+            value, met, n, drops, win_good = self._evaluate(o, now)
+            key = o.key()
+            # refresh the burn snapshot piggybacked on the throttled walk:
+            # burn_rates() callers (the router policy loop) read this cache
+            # instead of re-walking quantiles at their own cadence
+            self._burn[key] = {
+                "class": o.klass,
+                "percentile": o.percentile,
+                "target_ms": round(o.target_s * 1e3, 3),
+                "burn_rate": (round((1.0 - win_good) / o.budget_frac, 3)
+                              if win_good is not None else None),
+                "met": met,
+                "window_n": n,
+                "window_drops": drops,
+                "checked_at": now,
+            }
             if met is None:
                 continue
-            key = o.key()
             if not met and not self._breached[key]:
                 self._breached[key] = True
                 self._breaches[key] += 1
+                self._last_breach[key] = now
+                self._last_breach_unix[key] = time.time()
                 fired.append((o, value))
             elif met:
                 self._breached[key] = False
         return fired
 
+    def _burn_snapshot(self, o):
+        """Cached evaluation for one objective (lock held); a default
+        all-None entry before the first throttled walk has run."""
+        snap = self._burn.get(o.key())
+        if snap is not None:
+            return dict(snap)
+        return {"class": o.klass, "percentile": o.percentile,
+                "target_ms": round(o.target_s * 1e3, 3), "burn_rate": None,
+                "met": None, "window_n": 0, "window_drops": 0,
+                "checked_at": None}
+
     # -- surfaces ------------------------------------------------------------
+    def burn_rates(self, now=None):
+        """Cheap per-objective burn-rate read path for policy loops
+        (ISSUE 17): objective key -> the snapshot of the LAST throttled
+        evaluation plus breach bookkeeping.  Within a ``_CHECK_INTERVAL_S``
+        window this returns the cached dicts without touching a single
+        count vector, so a router polling at 4 Hz costs four dict copies
+        per second, not four quantile walks; at most one caller per
+        interval pays the (already-throttled) evaluation, same as any
+        record/status call would.  ``burn_rate`` is None until the first
+        evaluation sees traffic."""
+        now = time.monotonic() if now is None else now
+        with self._mu:
+            fired = self._maybe_check(now)
+            out = {}
+            for o in self.objectives:
+                key = o.key()
+                snap = self._burn_snapshot(o)
+                last = self._last_breach.get(key)
+                snap["breached"] = self._breached[key]
+                snap["breaches"] = self._breaches[key]
+                snap["last_breach_age_s"] = (round(max(0.0, now - last), 3)
+                                             if last is not None else None)
+                snap["last_breach_unix_ts"] = self._last_breach_unix.get(key)
+                out[key] = snap
+        self._fire(fired)
+        return out
+
     def status(self, now=None):
         """The ``Engine.stats()["slo"]`` / ``/statusz`` block.  Status
         reads also run the (throttled) breach-edge check: an outage whose
@@ -481,6 +545,13 @@ class SLOMonitor:
                     "good": good, "bad": bad,
                     "goodput": round(good / total, 6) if total else None,
                     "breaches": self._breaches[o.key()],
+                    # last ok->breach edge (ISSUE 17): age in this clock
+                    # domain plus a wall-clock stamp for cross-process logs;
+                    # None until the objective has breached at least once
+                    "last_breach_age_s": (
+                        round(max(0.0, now - self._last_breach[o.key()]), 3)
+                        if self._last_breach[o.key()] is not None else None),
+                    "last_breach_unix_ts": self._last_breach_unix[o.key()],
                 })
             classes = {}
             for (k, w), e in self._est.items():

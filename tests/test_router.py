@@ -1,0 +1,438 @@
+"""SLO-policy serving router tests (ISSUE 17) —
+``mxnet_tpu/serving/{model_registry,policy,router}.py`` plus the
+``SLOMonitor.burn_rates()`` read path (the satellite API fix): registry
+twin construction and int8 seed-trace calibration, priority routing with
+the reply tier-label contract, the degrade/restore hysteresis state
+machine under a synthetic clock, the off-path invariance guarantees, the
+quality-plane interaction (a router-downgraded request still
+shadow-samples against fp32 under the right tier label) and the
+/statusz ``"routers"`` mirror."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import serving
+from mxnet_tpu import telemetry
+from mxnet_tpu.serving import policy as rpolicy
+from mxnet_tpu.telemetry import instrument as tin
+from mxnet_tpu.telemetry import ops_server, qualityplane, slo
+from mxnet_tpu.test_utils import tiny_mlp_checkpoint
+
+
+def _register(reg=None, name="m", tiers=("fp32", "bf16"), **kw):
+    sym, params = tiny_mlp_checkpoint()
+    kw.setdefault("ladder", serving.BucketLadder((1, 2)))
+    kw.setdefault("max_wait_ms", 2.0)
+    kw.setdefault("max_queue", 64)
+    return (reg or serving.ModelRegistry()).register(
+        name, sym, params, {"data": (8,)}, tiers=tiers, **kw)
+
+
+def _x(n=1, seed=0):
+    return {"data": np.random.RandomState(seed).rand(n, 8)
+            .astype(np.float32)}
+
+
+@pytest.fixture
+def clean_env(monkeypatch):
+    """No ambient router/SLO/telemetry configuration."""
+    for var in ("MXNET_ROUTER_POLICY", "MXNET_ROUTER_BURN_HIGH",
+                "MXNET_ROUTER_BURN_LOW", "MXNET_ROUTER_HOLD_S",
+                "MXNET_ROUTER_INTERVAL_S", "MXNET_ROUTER_PRESSURE",
+                "MXNET_SLO", "MXNET_TELEMETRY"):
+        monkeypatch.delenv(var, raising=False)
+    tin._reset_for_tests()
+    yield
+    tin._reset_for_tests()
+
+
+# -- model registry -----------------------------------------------------------
+class TestModelRegistry:
+    def test_twins_share_weights_and_carry_tiers(self, clean_env):
+        reg = serving.ModelRegistry()
+        model = _register(reg)
+        assert model.tiers == ("fp32", "bf16")
+        assert model.native_tier == "fp32"
+        assert reg.names() == ["m"]
+        # twins come off ONE base predictor: same weight device buffers
+        fp32, bf16 = model.twin("fp32"), model.twin("bf16")
+        assert fp32._exec.precision_tier in (None, "fp32")
+        assert bf16._exec.precision_tier == "bf16"
+        with pytest.raises(KeyError):
+            model.twin("int8")
+        reg.unregister("m")
+        with pytest.raises(KeyError):
+            reg.get("m")
+
+    def test_tier_validation(self, clean_env):
+        with pytest.raises(ValueError):
+            _register(tiers=("fp32", "fp8"))
+        with pytest.raises(ValueError):
+            _register(tiers=("fp32", "bf16", "bf16"))
+        with pytest.raises(ValueError):
+            _register(tiers=())
+
+    def test_int8_without_calibration_refused(self, clean_env):
+        with pytest.raises(ValueError, match="calibration|seed_trace"):
+            _register(tiers=("fp32", "int8"))
+
+    def test_int8_seed_trace_autocalibrates(self, clean_env):
+        model = _register(tiers=("fp32", "int8"),
+                          seed_trace=[_x(2, seed=s) for s in range(3)])
+        assert model.calibration is not None
+        twin = model.twin("int8")
+        assert twin._exec.precision_tier == "int8"
+        # the twin actually serves (the calibrated rewrite compiled)
+        out = twin.forward(**_x(1))
+        assert tuple(out[0].shape) == (1, 4)
+
+    def test_build_engine_respecializes_shared_twin(self, clean_env):
+        model = _register()
+        eng = model.build_engine("bf16", name="reg-bf16", start=True)
+        try:
+            eng.predict(_x(1))
+            assert eng.stats()["precision_tier"] == "bf16"
+        finally:
+            eng.close()
+
+
+# -- routing + tier-label contract --------------------------------------------
+class TestRouterRouting:
+    def test_priority_routes_native_and_labels_tier(self, clean_env):
+        model = _register()
+        r = serving.Router(model, policy="degrade", name="rt-route")
+        try:
+            req = r.submit(_x(1), priority="paid")
+            out = req.result(30.0)
+            assert out[0].shape == (1, 4)
+            assert req.priority == "paid"
+            assert req.routed_tier == "fp32" and req.tier == "fp32"
+            assert req.engine_name.startswith("rt-route-fp32")
+            # klass naming a known priority is the priority (loadgen path)
+            req = r.submit(_x(1), klass="best_effort")
+            req.result(30.0)
+            assert req.priority == "best_effort" and req.tier == "fp32"
+            # unknown klass falls back to the default (least protected)
+            req = r.submit(_x(1), klass="37")
+            req.result(30.0)
+            assert req.priority == "best_effort"
+            st = r.stats()
+            assert st["router"]["priorities"]["paid"]["requests"] == 1
+            assert st["router"]["priorities"]["best_effort"]["requests"] == 2
+            assert st["downgrades"] == 0
+            assert st["precision_tier"] == "fp32"
+            assert st["router"]["route"] == {"paid": "fp32",
+                                             "best_effort": "fp32"}
+        finally:
+            r.close()
+
+    def test_forced_downgrade_serves_cheap_twin(self, clean_env):
+        model = _register()
+        r = serving.Router(model, policy="degrade", name="rt-dg")
+        try:
+            with r._mu:
+                r._route["best_effort"] = r._degrade_tier
+            req = r.submit(_x(1), priority="best_effort")
+            req.result(30.0)
+            assert req.routed_tier == "bf16" and req.tier == "bf16"
+            # protected traffic keeps the native pool
+            req = r.submit(_x(1), priority="paid")
+            req.result(30.0)
+            assert req.tier == "fp32"
+            st = r.stats()
+            assert st["router"]["priorities"]["best_effort"][
+                "downgrades"] == 1
+            assert st["router"]["priorities"]["paid"]["downgrades"] == 0
+        finally:
+            r.close()
+
+    def test_shed_counted_per_priority(self, clean_env):
+        model = _register(max_queue=1, max_wait_ms=50.0)
+        # start=False: no device loop drains the queue, so the second
+        # submit deterministically overflows the bounded admission gate
+        r = serving.Router(model, policy="shed", name="rt-shed",
+                           start=False)
+        try:
+            first = r.submit(_x(1), priority="best_effort")
+            with pytest.raises(serving.ServerBusy):
+                for _ in range(3):
+                    r.submit(_x(1), priority="best_effort")
+            st = r.stats()
+            assert st["router"]["priorities"]["best_effort"]["sheds"] >= 1
+            assert st["sheds"] == st["router"]["priorities"][
+                "best_effort"]["sheds"]
+            first.cancel()
+        finally:
+            r.close()
+
+    def test_needs_degradation_target(self, clean_env):
+        sym, params = tiny_mlp_checkpoint()
+        model = serving.ModelRegistry().register(
+            "solo", sym, params, {"data": (8,)}, tiers=("fp32",))
+        with pytest.raises(ValueError, match="degradation target"):
+            serving.Router(model)
+
+    def test_statusz_mirrors_router_block(self, clean_env):
+        model = _register()
+        r = serving.Router(model, policy="degrade", name="rt-statusz",
+                           start=False)
+        try:
+            ops_server.register_router(r)
+            status = ops_server._statusz()
+            assert "rt-statusz" in status["routers"]
+            blk = status["routers"]["rt-statusz"]["router"]
+            assert blk["policy"]["mode"] == "degrade"
+            assert blk["native_tier"] == "fp32"
+            assert blk["degrade_tier"] == "bf16"
+        finally:
+            r.close()
+        assert "rt-statusz" not in ops_server._statusz()["routers"]
+
+
+# -- policy state machine -----------------------------------------------------
+class TestDegradePolicy:
+    CFG = dict(burn_high=2.0, burn_low=0.5, hold_s=5.0, pressure=0.5)
+
+    def _policy(self, mode="degrade"):
+        cfg = rpolicy.PolicyConfig(mode=mode, **self.CFG)
+        return rpolicy.DegradePolicy(cfg, ("paid", "best_effort"),
+                                     protected=("paid",))
+
+    def test_degrade_on_burn_protects_paid(self):
+        p = self._policy()
+        assert p.step({"burn": 0.1, "pressure": 0.0}, now=0.0) == []
+        acts = p.step({"burn": 3.0, "pressure": 0.0}, now=1.0)
+        assert acts == [("degrade", "best_effort")]  # never paid
+        # already degraded: overload again is a no-op, not a re-degrade
+        assert p.step({"burn": 3.0, "pressure": 0.0}, now=2.0) == []
+
+    def test_degrade_on_pressure_without_monitor(self):
+        p = self._policy()
+        acts = p.step({"burn": None, "pressure": 0.9}, now=0.0)
+        assert acts == [("degrade", "best_effort")]
+
+    def test_hysteresis_band_holds_then_restores(self):
+        p = self._policy()
+        p.step({"burn": 3.0, "pressure": 0.0}, now=0.0)
+        # in-band (below burn_high, above burn_low): hold, no restore ever
+        for t in (1.0, 2.0, 30.0):
+            assert p.step({"burn": 1.0, "pressure": 0.0}, now=t) == []
+        # calm, but not yet for hold_s
+        assert p.step({"burn": 0.1, "pressure": 0.0}, now=31.0) == []
+        assert p.step({"burn": 0.1, "pressure": 0.0}, now=35.0) == []
+        # a blip inside the hold window resets the calm clock
+        assert p.step({"burn": 1.0, "pressure": 0.0}, now=35.5) == []
+        assert p.step({"burn": 0.1, "pressure": 0.0}, now=36.0) == []
+        assert p.step({"burn": 0.1, "pressure": 0.0}, now=40.0) == []
+        acts = p.step({"burn": 0.1, "pressure": 0.0}, now=41.5)
+        assert acts == [("restore", "best_effort")]
+        assert p.degraded == {}
+
+    def test_calm_requires_low_pressure_too(self):
+        p = self._policy()
+        p.step({"burn": None, "pressure": 0.9}, now=0.0)
+        # pressure must fall below half the trigger level, not just below it
+        assert p.step({"burn": None, "pressure": 0.3}, now=1.0) == []
+        assert p.step({"burn": None, "pressure": 0.3}, now=100.0) == []
+        assert p.step({"burn": None, "pressure": 0.1}, now=101.0) == []
+        acts = p.step({"burn": None, "pressure": 0.1}, now=107.0)
+        assert acts == [("restore", "best_effort")]
+
+    def test_shed_mode_is_a_policy_noop(self):
+        p = self._policy(mode="shed")
+        assert p.step({"burn": 99.0, "pressure": 1.0}, now=0.0) == []
+        assert p.degraded == {}
+
+    def test_config_validation_and_env(self, monkeypatch):
+        with pytest.raises(ValueError):
+            rpolicy.PolicyConfig(mode="static")
+        with pytest.raises(ValueError):
+            rpolicy.PolicyConfig(burn_high=1.0, burn_low=2.0)
+        monkeypatch.setenv("MXNET_ROUTER_POLICY", "sideways")
+        monkeypatch.setenv("MXNET_ROUTER_BURN_HIGH", "lots")
+        monkeypatch.setenv("MXNET_ROUTER_PRESSURE", "0.25")
+        cfg = rpolicy.config_from_env()
+        # never-crash contract: unknown mode / malformed float -> defaults
+        assert cfg.mode == "degrade"
+        assert cfg.burn_high == 1.0
+        assert cfg.pressure == 0.25
+
+    def test_router_policy_tick_applies_transitions(self, clean_env):
+        model = _register()
+        # start=False: the test owns the clock — no live loop races it
+        r = serving.Router(model, policy="degrade", name="rt-tick",
+                           start=False)
+        try:
+            r._policy._clear_since = None
+            acts = r._policy.step({"burn": 5.0, "pressure": 0.0}, now=10.0)
+            assert acts == [("degrade", "best_effort")]
+            # the tick path end-to-end (pressure 0 + no monitor = calm,
+            # but hold_s blocks the restore): route stays degraded
+            with r._mu:
+                r._route["best_effort"] = r._degrade_tier
+            r._policy_tick(now=11.0)
+            st = r.stats()
+            assert st["router"]["policy"]["degraded"] == ["best_effort"]
+            assert st["router"]["route"]["best_effort"] == "bf16"
+        finally:
+            r.close()
+
+
+# -- off-path invariance ------------------------------------------------------
+class TestOffPath:
+    def _key(self, pred):
+        from mxnet_tpu import compile_cache
+
+        exe = pred._exec
+        return repr(("executor_fwd",
+                     compile_cache.symbol_fingerprint(exe._symbol),
+                     False) + exe._tier_key_parts(False))
+
+    def test_router_env_never_moves_aot_key(self, clean_env, monkeypatch):
+        from mxnet_tpu.predictor import Predictor
+
+        sym, params = tiny_mlp_checkpoint()
+        key_off = self._key(Predictor(sym, params, {"data": (1, 8)}))
+        monkeypatch.setenv("MXNET_ROUTER_POLICY", "degrade")
+        monkeypatch.setenv("MXNET_ROUTER_PRESSURE", "0.1")
+        monkeypatch.setenv("MXNET_ROUTER_BURN_HIGH", "0.5")
+        key_on = self._key(Predictor(sym, params, {"data": (1, 8)}))
+        assert key_on == key_off
+
+    def test_telemetry_off_no_router_metrics(self, clean_env):
+        assert telemetry.router_probe("nope") is None
+        model = _register()
+        r = serving.Router(model, policy="degrade", name="rt-notelem")
+        try:
+            r.predict(_x(1), priority="paid")
+            assert r._probe is None
+        finally:
+            r.close()
+        for m in ("router_requests_total", "router_downgrades_total",
+                  "router_sheds_total", "router_policy_transitions_total",
+                  "router_degraded"):
+            assert tin.registry().get(m) is None
+
+    def test_telemetry_on_counts_routes(self, clean_env, monkeypatch,
+                                         tmp_path):
+        monkeypatch.setenv("MXNET_TELEMETRY", "1")
+        monkeypatch.setenv("MXNET_TELEMETRY_FILE",
+                           str(tmp_path / "t.jsonl"))
+        tin._reset_for_tests()
+        model = _register()
+        r = serving.Router(model, policy="degrade", name="rt-telem")
+        try:
+            with r._mu:
+                r._route["best_effort"] = r._degrade_tier
+            r.predict(_x(1), priority="paid")
+            r.predict(_x(1), priority="best_effort")
+        finally:
+            r.close()
+        reg = tin.registry()
+        assert reg.total("router_requests_total", 0.0) == 2.0
+        assert reg.total("router_downgrades_total", 0.0) == 1.0
+
+
+# -- burn-rate read path (satellite 2) ---------------------------------------
+class TestBurnRates:
+    def _monitor(self):
+        return slo.SLOMonitor(slo.parse_objectives(
+            "paid:p95:50:2,best_effort:p95:100:2"))
+
+    def test_burn_rates_shape_and_breach_edge(self):
+        m = self._monitor()
+        t0 = 1000.0
+        for i in range(20):
+            m.record(0.200, klass="paid", now=t0 + i * 0.01)  # all late
+        rates = m.burn_rates(now=t0 + 2.0)
+        assert set(rates) == {"paid:p95:50ms", "best_effort:p95:100ms"}
+        paid = rates["paid:p95:50ms"]
+        # every sample blew the 50 ms target: the full error budget burns
+        assert paid["burn_rate"] == pytest.approx(1.0 / 0.05, rel=0.01)
+        assert paid["breached"] is True and paid["breaches"] >= 1
+        # the ok->breach edge fired during the recording window, so its
+        # age is bounded by the synthetic clock span
+        assert 0.0 <= paid["last_breach_age_s"] <= 2.5
+        assert paid["last_breach_unix_ts"] is not None
+        # the idle class never evaluated: all-None snapshot, no breach
+        be = rates["best_effort:p95:100ms"]
+        assert be["burn_rate"] is None and be["breached"] is False
+        assert be["last_breach_age_s"] is None
+        # status() carries the same breach-edge bookkeeping
+        for o in m.status()["objectives"]:
+            assert "last_breach_age_s" in o and "last_breach_unix_ts" in o
+
+    def test_burn_rates_cached_within_throttle(self):
+        m = self._monitor()
+        t0 = 2000.0
+        for i in range(10):
+            m.record(0.010, klass="paid", now=t0 + i * 0.01)
+        r1 = m.burn_rates(now=t0 + 1.5)
+        checked = r1["paid:p95:50ms"]["checked_at"]
+        assert checked is not None
+        # inside the 1/s evaluation throttle: the cached snapshot comes
+        # back without re-walking quantiles (same checked_at stamp)
+        for _ in range(5):
+            m.record(0.010, klass="paid", now=t0 + 1.6)
+        r2 = m.burn_rates(now=t0 + 1.9)
+        assert r2["paid:p95:50ms"]["checked_at"] == checked
+        # past the throttle the snapshot refreshes
+        r3 = m.burn_rates(now=t0 + 3.0)
+        assert r3["paid:p95:50ms"]["checked_at"] > checked
+        # healthy traffic: zero burn
+        assert r3["paid:p95:50ms"]["burn_rate"] == pytest.approx(0.0)
+        assert r3["paid:p95:50ms"]["met"] is True
+
+    def test_router_shares_one_monitor(self, clean_env, monkeypatch):
+        monkeypatch.setenv("MXNET_SLO", "paid:p95:500:2")
+        model = _register()
+        r = serving.Router(model, policy="degrade", name="rt-slo")
+        try:
+            monitors = {id(e._slo) for e in r.engines()}
+            assert monitors == {id(r._slo)}
+            assert all(e._shared_slo for e in r.engines())
+            r.predict(_x(1), priority="paid")
+            rates = r._slo.burn_rates()
+            assert any(k.startswith("paid:p95") for k in rates)
+            sig = r._signals(time.monotonic())
+            assert set(sig) == {"burn", "pressure"}
+        finally:
+            r.close()
+
+
+# -- quality plane interaction (satellite 3) ----------------------------------
+class TestRouterQualityPlane:
+    def test_downgraded_request_shadow_samples_as_bf16(self, clean_env,
+                                                       monkeypatch):
+        monkeypatch.setenv("MXNET_QUALITYPLANE", "1")
+        monkeypatch.setenv("MXNET_QUALITY_SAMPLE", "1.0")
+        qualityplane._reset_for_tests()
+        model = _register()
+        r = serving.Router(model, policy="degrade", name="rt-qual")
+        try:
+            with r._mu:
+                r._route["best_effort"] = r._degrade_tier
+            for i in range(6):
+                req = r.submit(_x(1, seed=i), priority="best_effort")
+                req.result(30.0)
+                assert req.tier == "bf16"
+            deadline = time.monotonic() + 60.0
+            q = qualityplane.status()
+            while time.monotonic() < deadline and not (
+                    q and q["rows"] and q["divergence"]):
+                time.sleep(0.05)
+                q = qualityplane.status()
+            # the downgraded replies landed in tier_divergence under the
+            # tier that SERVED them — not the router's native tier
+            assert q["divergence"] and "bf16" in q["divergence"]
+            assert "fp32" not in (q["divergence"] or {})
+            assert q["sampled"] >= 1
+            # the router's stats surface exposes the same plane
+            assert r.stats()["quality"]["seen"] == q["seen"]
+        finally:
+            r.close()
+            qualityplane._reset_for_tests()
+        assert not [t for t in threading.enumerate()
+                    if t.name.startswith("mxnet-quality")]

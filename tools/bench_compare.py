@@ -184,7 +184,39 @@ def load_serve(path, obj):
             # quality plane (ISSUE 16): {tier: {p50, p99, n, violations}}
             # over shadow-sampled contract fractions; None for captures
             # predating the plane or taken with MXNET_QUALITYPLANE off
-            "divergence": _norm_divergence(line.get("divergence"))}
+            "divergence": _norm_divergence(line.get("divergence")),
+            # router (ISSUE 17): the policy mode the fronting Router ran
+            # and the per-priority-class breakdown; None on bare Engine
+            # captures (--router off)
+            "router_policy": line.get("router_policy"),
+            "priority": _norm_priority(line.get("priority"))}
+
+
+def _norm_priority(pb):
+    """Normalize a SERVE_BENCH ``priority`` block → {class: stats} with the
+    derived downgrade/shed RATES the router table plots, or None when
+    absent/malformed (an old capture must compare, not crash)."""
+    if not isinstance(pb, dict) or not pb:
+        return None
+    out = {}
+    for klass, s in pb.items():
+        if not isinstance(s, dict):
+            return None
+        try:
+            req, done = int(s["requests"]), int(s["completed"])
+            out[str(klass)] = {
+                "requests": req, "completed": done,
+                "sheds": int(s["sheds"]), "downgrades": int(s["downgrades"]),
+                "p50_ms": float(s["p50_ms"]), "p99_ms": float(s["p99_ms"]),
+                "goodput_rps": float(s["goodput_rps"]),
+                "slo_ms": (float(s["slo_ms"])
+                           if s.get("slo_ms") is not None else None),
+                "downgrade_rate": (s["downgrades"] / done) if done else 0.0,
+                "shed_rate": (s["sheds"] / req) if req else 0.0,
+            }
+        except (KeyError, TypeError, ValueError):
+            return None
+    return out
 
 
 def _norm_divergence(div):
@@ -206,38 +238,53 @@ def _norm_divergence(div):
     return out
 
 
-def compare_serve(rows, threshold, gate_p99=False, gate_divergence=False):
+def compare_serve(rows, threshold, gate_p99=False, gate_divergence=False,
+                  gate_goodput=False):
     """→ (table_rows, regressions).  Baseline = rows[0]; only same-MODE,
-    same-TIER rows are compared (a closed-loop capture against an open-loop
-    one — or an fp32 engine against its bf16/int8 twin, ISSUE 15 — is a
-    configuration difference, like a metric-name mismatch on the bench
-    axis; cross-tier rows display for context, never gate).  All deltas are
-    shown; only ``--gate-p99`` makes p99 growth beyond the threshold a
-    regression (ISSUE 10, mirroring ``--gate-warmup``): latency tails are
-    noisy across hosts, so the gate is opt-in for pipelines whose runs
-    share a machine + load shape.
+    same-TIER, same-ROUTER-POLICY rows are compared (a closed-loop capture
+    against an open-loop one — or an fp32 engine against its bf16/int8
+    twin, ISSUE 15, or a degrade-policy router run against a shed-only
+    one, ISSUE 17 — is a configuration difference, like a metric-name
+    mismatch on the bench axis; mismatched rows display for context, never
+    gate).  All deltas are shown; only ``--gate-p99`` makes p99 growth
+    beyond the threshold a regression (ISSUE 10, mirroring
+    ``--gate-warmup``): latency tails are noisy across hosts, so the gate
+    is opt-in for pipelines whose runs share a machine + load shape.
 
     ``--gate-divergence`` (ISSUE 16) gates the quality plane's shadow-
     divergence block the same opt-in way: for each tier BOTH rows report,
     p99 contract-fraction growth beyond the threshold, or new tolerance
     violations where the baseline had none, is a regression.  Rows without
     divergence (plane off, old capture) are shown, never gated — turning
-    the plane on must not fail the first comparison against history."""
+    the plane on must not fail the first comparison against history.
+
+    ``--gate-goodput`` (ISSUE 17, the router axis) mirrors ``--gate-p99``
+    with the sign flipped: goodput is higher-better, so an overall
+    ``goodput_rps`` DROP beyond the threshold — or a per-priority-class
+    drop for any class both captures report — is a regression.  Rows
+    without goodput (no --slo-ms target) or without a priority block (no
+    --class-mix) are shown, never gated."""
     base = rows[0]
     table, regressions = [], []
     for r in rows:
-        same = r["mode"] == base["mode"] and r["tier"] == base["tier"]
+        same = (r["mode"] == base["mode"] and r["tier"] == base["tier"]
+                and r.get("router_policy") == base.get("router_policy"))
         dt = (_pct(r["throughput_rps"], base["throughput_rps"])
               if same and r is not base else None)
         d50 = (_pct(r["latency_ms_p50"], base["latency_ms_p50"])
                if same and r is not base else None)
         d99 = (_pct(r["latency_ms_p99"], base["latency_ms_p99"])
                if same and r is not base else None)
+        dgp = (_pct(r["goodput_rps"], base["goodput_rps"])
+               if same and r is not base else None)
         ddiv = (_divergence_deltas(r["divergence"], base["divergence"])
+                if same and r is not base else None)
+        dpri = (_priority_deltas(r["priority"], base["priority"])
                 if same and r is not base else None)
         table.append(dict(r, same_mode=same, thr_delta_pct=dt,
                           p50_delta_pct=d50, p99_delta_pct=d99,
-                          divergence_delta=ddiv))
+                          goodput_delta_pct=dgp, divergence_delta=ddiv,
+                          priority_delta=dpri))
         if r is base or not same:
             continue
         if gate_p99 and d99 is not None and d99 > threshold:
@@ -245,6 +292,22 @@ def compare_serve(rows, threshold, gate_p99=False, gate_divergence=False):
                 "%s: latency_ms_p99 %.4g -> %.4g (+%.1f%% > %g%%, "
                 "--gate-p99)" % (r["file"], base["latency_ms_p99"],
                                  r["latency_ms_p99"], d99, threshold))
+        if gate_goodput:
+            if dgp is not None and dgp < -threshold:
+                regressions.append(
+                    "%s: goodput_rps %.4g -> %.4g (%.1f%% < -%g%%, "
+                    "--gate-goodput)" % (r["file"], base["goodput_rps"],
+                                         r["goodput_rps"], dgp, threshold))
+            for klass, d in sorted((dpri or {}).items()):
+                if d["goodput_delta_pct"] is not None \
+                        and d["goodput_delta_pct"] < -threshold:
+                    regressions.append(
+                        "%s: priority[%s] goodput_rps %.4g -> %.4g "
+                        "(%.1f%% < -%g%%, --gate-goodput)"
+                        % (r["file"], klass,
+                           base["priority"][klass]["goodput_rps"],
+                           r["priority"][klass]["goodput_rps"],
+                           d["goodput_delta_pct"], threshold))
         if gate_divergence and ddiv:
             for tier, d in sorted(ddiv.items()):
                 if d["p99_delta_pct"] is not None \
@@ -281,6 +344,58 @@ def _divergence_deltas(div, base_div):
     return out or None
 
 
+def _priority_deltas(pri, base_pri):
+    """Per-class goodput deltas for priority classes BOTH captures report,
+    or None when either side lacks the block (bare-Engine capture, no
+    --class-mix)."""
+    if not pri or not base_pri:
+        return None
+    out = {}
+    for klass in sorted(set(pri) & set(base_pri)):
+        out[klass] = {"goodput_delta_pct": _pct(
+            pri[klass]["goodput_rps"], base_pri[klass]["goodput_rps"])}
+    return out or None
+
+
+def render_router_table(table):
+    """Per-policy-mode / per-priority-class breakdown (ISSUE 17) — one row
+    per (capture, class) for every capture that carried a ``priority``
+    block.  The degradation ladder's scoreboard: goodput, the fraction of
+    a class's replies served by a cheaper twin (dg_rate), and the fraction
+    shed at admission."""
+    cols = ["file", "policy", "class", "req", "done", "goodput",
+            "Δgoodput%", "dg_rate", "shed_rate", "p99_ms", "slo_ms"]
+    out = [cols]
+    for r in table:
+        if not r.get("priority"):
+            continue
+        policy = str(r.get("router_policy") or "-") \
+            + ("" if r["same_mode"] else " (≠ baseline)")
+        dpri = r.get("priority_delta") or {}
+        for klass in sorted(r["priority"]):
+            s = r["priority"][klass]
+            out.append([r["file"], policy, klass,
+                        "%d" % s["requests"], "%d" % s["completed"],
+                        _fmt(s["goodput_rps"], "%.4g"),
+                        _fmt(dpri.get(klass, {}).get("goodput_delta_pct"),
+                             "%+.1f"),
+                        _fmt(s["downgrade_rate"], "%.3g"),
+                        _fmt(s["shed_rate"], "%.3g"),
+                        _fmt(s["p99_ms"], "%.4g"),
+                        _fmt(s["slo_ms"], "%.4g")])
+    if len(out) == 1:
+        return ""
+    widths = [max(len(row[i]) for row in out) for i in range(len(cols))]
+    lines = []
+    for i, row in enumerate(out):
+        lines.append("  ".join(
+            c.ljust(widths[j]) if j < 3 else c.rjust(widths[j])
+            for j, c in enumerate(row)))
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
 def _fmt_divergence(div):
     """Compact ``tier:p99/violations`` cell for the serve table — one
     entry per tier the capture measured, ``-`` when the plane was off."""
@@ -291,8 +406,9 @@ def _fmt_divergence(div):
 
 
 def render_serve_table(table):
-    cols = ["file", "mode", "tier", "rps", "Δrps%", "goodput", "p50_ms",
-            "Δp50%", "p99_ms", "Δp99%", "shed", "div_p99/viol", "Δdiv%"]
+    cols = ["file", "mode", "tier", "rps", "Δrps%", "goodput", "Δgood%",
+            "p50_ms", "Δp50%", "p99_ms", "Δp99%", "shed", "div_p99/viol",
+            "Δdiv%"]
     out = [cols]
     for r in table:
         mode = r["mode"] + ("" if r["same_mode"] else " (≠ baseline)")
@@ -304,6 +420,7 @@ def render_serve_table(table):
                     _fmt(r["throughput_rps"], "%.4g"),
                     _fmt(r["thr_delta_pct"], "%+.1f"),
                     _fmt(r["goodput_rps"], "%.4g"),
+                    _fmt(r.get("goodput_delta_pct"), "%+.1f"),
                     _fmt(r["latency_ms_p50"], "%.4g"),
                     _fmt(r["p50_delta_pct"], "%+.1f"),
                     _fmt(r["latency_ms_p99"], "%.4g"),
@@ -569,6 +686,13 @@ def main(argv=None):
                         "growth beyond --threshold (off by default: shown-"
                         "only deltas; requires MXNET_COST_LEDGER JSONL "
                         "captures — ISSUE 13)")
+    p.add_argument("--gate-goodput", action="store_true",
+                   help="fail on SERVE_BENCH goodput_rps DROP beyond "
+                        "--threshold — overall, and per priority class for "
+                        "classes both captures report (off by default, "
+                        "mirroring --gate-p99 with the sign flipped: "
+                        "goodput is higher-better; requires SERVE_BENCH "
+                        "captures — ISSUE 17)")
     p.add_argument("--gate-divergence", action="store_true",
                    help="fail on SERVE_BENCH quality-plane divergence "
                         "regressions: per-tier p99 contract-fraction "
@@ -603,6 +727,10 @@ def main(argv=None):
               "captures (a bench line has no divergence block)",
               file=sys.stderr)
         return 2
+    if args.gate_goodput and not all(serve_kinds):
+        print("bench_compare: --gate-goodput applies to SERVE_BENCH "
+              "captures (a bench line has no goodput_rps)", file=sys.stderr)
+        return 2
     if args.gate_cost and not all(ledger_kinds):
         print("bench_compare: --gate-cost applies to compile-plane cost "
               "ledgers (MXNET_COST_LEDGER JSONL)", file=sys.stderr)
@@ -633,13 +761,18 @@ def main(argv=None):
             return 2
         table, regressions = compare_serve(
             srows, args.threshold, gate_p99=args.gate_p99,
-            gate_divergence=args.gate_divergence)
+            gate_divergence=args.gate_divergence,
+            gate_goodput=args.gate_goodput)
         if args.json:
             print(json.dumps({"baseline": srows[0]["file"], "rows": table,
                               "threshold_pct": args.threshold,
                               "regressions": regressions}, indent=1))
         else:
             print(render_serve_table(table))
+            router = render_router_table(table)
+            if router:
+                print()
+                print(router)
             for msg in regressions:
                 print("REGRESSION %s" % msg)
         if regressions:

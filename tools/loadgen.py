@@ -19,6 +19,16 @@ Two generator modes, the standard pair:
 are drawn from ``--sizes`` (mixed-shape stream exercising the whole bucket
 ladder); ``--smoke`` is the CI preset: tiny MLP, short run, CPU-safe.
 
+Mixed-priority traffic (ISSUE 17): ``--class-mix paid:0.2,best_effort:0.8``
+stamps each request with a priority class drawn at those weights, and
+``--slo-ms`` then also accepts per-priority targets
+(``paid:25,best_effort:100``).  The SERVE_BENCH line gains a ``priority``
+block (per-class requests/completed/``sheds``/``downgrades``/percentiles/
+goodput).  ``--router degrade|shed`` serves through a
+``serving.Router`` over fp32+bf16 twin pools (``--replicas`` engines per
+tier) instead of a bare Engine — ``downgrades`` counts completions whose
+reply tier label differs from the native tier.
+
 Examples::
 
     python tools/loadgen.py --smoke
@@ -26,6 +36,9 @@ Examples::
         --batch-ladder 1,2,4,8 --concurrency 8
     python tools/loadgen.py --symbol m-symbol.json --params m-0000.params \
         --input data:3,224,224 --mode open --rate 50
+    python tools/loadgen.py --mode open --rate 2000 --router degrade \
+        --class-mix paid:0.2,best_effort:0.8 \
+        --slo-ms paid:25,best_effort:100
 """
 from __future__ import annotations
 
@@ -57,18 +70,93 @@ def _tiny_engine(args):
         name="loadgen", start=True), {"data": (8,)}
 
 
-def _file_engine(args):
-    from mxnet_tpu import serving
-
+def _input_shapes(args):
     shapes = {}
     for spec in args.input:
         name, _, dims = spec.partition(":")
         shapes[name] = tuple(int(d) for d in dims.split(",") if d)
+    return shapes
+
+
+def _file_engine(args):
+    from mxnet_tpu import serving
+
+    shapes = _input_shapes(args)
     return serving.Engine(
         args.symbol, args.params, shapes,
         ladder=serving.BucketLadder(args.ladder),
         max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
         name="loadgen", start=True), shapes
+
+
+def _router_target(args):
+    """--router: fp32+bf16 twin pools behind a serving.Router (ISSUE 17).
+    The policy mode comes from the flag (degrade-first vs the shed-only
+    baseline), replica count per tier from --replicas."""
+    from mxnet_tpu import serving
+    from mxnet_tpu.test_utils import tiny_mlp_checkpoint
+
+    if args.symbol:
+        sym, params, shapes = args.symbol, args.params, _input_shapes(args)
+    else:
+        sym, params = tiny_mlp_checkpoint(seed=args.seed)
+        shapes = {"data": (8,)}
+    reg = serving.ModelRegistry()
+    model = reg.register("loadgen", sym, params, shapes,
+                         tiers=("fp32", "bf16"),
+                         ladder=serving.BucketLadder(args.ladder),
+                         max_wait_ms=args.max_wait_ms,
+                         max_queue=args.max_queue)
+    return serving.Router(model, replicas=args.replicas, policy=args.router,
+                          name="loadgen"), shapes
+
+
+def _parse_class_mix(spec):
+    """'paid:0.2,best_effort:0.8' -> normalized [(priority, weight)]."""
+    if not spec:
+        return None
+    mix = []
+    for item in str(spec).split(","):
+        item = item.strip()
+        if not item:
+            continue
+        name, _, w = item.partition(":")
+        mix.append((name.strip(), float(w or 1.0)))
+    total = sum(w for _, w in mix)
+    if not mix or total <= 0:
+        raise ValueError("--class-mix needs positive weights, got %r"
+                         % (spec,))
+    return [(n, w / total) for n, w in mix]
+
+
+def _draw_priority(mix, u):
+    """u in [0,1) -> priority class at the mix's weights."""
+    acc = 0.0
+    for name, w in mix:
+        acc += w
+        if u < acc:
+            return name
+    return mix[-1][0]
+
+
+def _parse_slo(spec):
+    """--slo-ms value -> (scalar_ms, {priority: ms}).  A bare number is
+    the classic single target; 'paid:25,best_effort:100' sets per-priority
+    targets (scalar 0, so unlisted traffic always counts as good)."""
+    s = str(spec if spec is not None else "").strip()
+    if not s:
+        return 0.0, {}
+    if ":" not in s:
+        return float(s), {}
+    out = {}
+    for item in s.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        name, _, v = item.partition(":")
+        if name.strip():
+            out[name.strip()] = float(v)
+    return 0.0, out
 
 
 def _make_request(shapes, sizes, rng):
@@ -84,12 +172,18 @@ class _Collector:
     shapes, class) record per submission attempt, the offline input the
     bucket-ladder tuner replays (``mxnet_tpu.autotune.ladder``)."""
 
-    def __init__(self, trace_log=None, t_origin=None, slo_ms=0.0):
+    def __init__(self, trace_log=None, t_origin=None, slo_ms=0.0,
+                 class_slo=None, native_tier="fp32"):
         self.mu = threading.Lock()
         self.latencies = []
         self.by_class = {}     # size class (str(n)) -> [latencies]
         self.good = 0          # completions meeting --slo-ms (all, if 0)
         self.slo_ms = float(slo_ms or 0.0)
+        self.class_slo = dict(class_slo or {})  # priority -> target ms
+        self.native_tier = native_tier or "fp32"
+        # priority class -> accumulators (ISSUE 17): downgrades counts
+        # completions whose reply tier label differs from the native tier
+        self.by_priority = {}
         self.submitted = 0
         self.shed = 0
         self.timeouts = 0
@@ -98,23 +192,52 @@ class _Collector:
         self.trace_log = trace_log
         self.t_origin = t_origin
 
-    def ok(self, seconds, klass=None, in_window=True):
+    def _prio(self, priority):
+        e = self.by_priority.get(priority)
+        if e is None:
+            e = self.by_priority[priority] = {
+                "latencies": [], "submitted": 0, "sheds": 0,
+                "downgrades": 0, "good": 0}
+        return e
+
+    def slo_for(self, priority):
+        """The goodput target for one completion: the per-priority target
+        when declared, else the scalar --slo-ms."""
+        if priority is not None and priority in self.class_slo:
+            return self.class_slo[priority]
+        return self.slo_ms
+
+    def ok(self, seconds, klass=None, in_window=True, priority=None,
+           tier=None):
         """One completion.  ``klass`` buckets the per-class percentiles
         (ISSUE 10 / ROADMAP item 1: per-class P50/P99 + goodput);
         ``in_window`` gates goodput in the open loop (late-drain
         completions report latency but not phantom goodput, same rule as
-        throughput)."""
+        throughput); ``priority``/``tier`` feed the per-priority block
+        (ISSUE 17 — tier is the reply's served-tier label)."""
         with self.mu:
             self.latencies.append(seconds)
             if klass is not None:
                 self.by_class.setdefault(str(klass), []).append(seconds)
-            if in_window and (self.slo_ms <= 0
-                              or seconds * 1e3 <= self.slo_ms):
+            target = self.slo_for(priority)
+            good = in_window and (target <= 0 or seconds * 1e3 <= target)
+            if good:
                 self.good += 1
+            if priority is not None:
+                e = self._prio(priority)
+                e["latencies"].append(seconds)
+                if good:
+                    e["good"] += 1
+                if tier is not None and tier != self.native_tier:
+                    e["downgrades"] += 1
 
     def count(self, field, n=1):
         with self.mu:
             setattr(self, field, getattr(self, field) + n)
+
+    def prio_count(self, priority, field, n=1):
+        with self.mu:
+            self._prio(priority)[field] += n
 
     def trace(self, inputs, klass):
         """Record one request's trace line (no-op without --save-trace).
@@ -135,21 +258,32 @@ def _run_closed(engine, shapes, args, collector):
     from mxnet_tpu.serving import RequestTimeout, ServerBusy
 
     stop = time.monotonic() + args.duration
+    mix = getattr(args, "class_mix", None)
 
     def worker(seed):
         rng = np.random.default_rng(seed)
         while time.monotonic() < stop:
             req_inputs = _make_request(shapes, args.sizes, rng)
             n = next(iter(req_inputs.values())).shape[0]
+            prio = _draw_priority(mix, rng.random()) if mix else None
             collector.count("submitted")
-            collector.trace(req_inputs, "closed")
+            if prio is not None:
+                collector.prio_count(prio, "submitted")
+            collector.trace(req_inputs, prio or "closed")
             t0 = time.perf_counter()
             try:
-                engine.predict(req_inputs, timeout=args.timeout_s,
-                               klass=str(n))
-                collector.ok(time.perf_counter() - t0, klass=n)
+                # submit + wait (not predict): the Request carries the
+                # reply tier label the priority block's downgrades need
+                req = engine.submit(req_inputs, timeout=args.timeout_s,
+                                    klass=prio or str(n))
+                req.result(None)
+                collector.ok(time.perf_counter() - t0, klass=n,
+                             priority=prio,
+                             tier=getattr(req, "tier", None))
             except ServerBusy:
                 collector.count("shed")
+                if prio is not None:
+                    collector.prio_count(prio, "sheds")
             except RequestTimeout:
                 collector.count("timeouts")
             except Exception:
@@ -171,6 +305,7 @@ def _run_open(engine, shapes, args, collector):
 
     rng = np.random.default_rng(args.seed)
     jitter = random.Random(args.seed)
+    mix = getattr(args, "class_mix", None)
     pending = []
     stop = time.monotonic() + args.duration
     t_start = time.perf_counter()
@@ -182,27 +317,33 @@ def _run_open(engine, shapes, args, collector):
             continue
         # Poisson arrivals: exponential inter-arrival gaps at --rate
         next_fire += jitter.expovariate(args.rate)
+        prio = _draw_priority(mix, jitter.random()) if mix else None
         collector.count("submitted")
+        if prio is not None:
+            collector.prio_count(prio, "submitted")
         req_inputs = _make_request(shapes, args.sizes, rng)
         n = next(iter(req_inputs.values())).shape[0]
-        collector.trace(req_inputs, "open")
+        collector.trace(req_inputs, prio or "open")
         try:
             pending.append((engine.submit(req_inputs, timeout=args.timeout_s,
-                                          klass=str(n)), n))
+                                          klass=prio or str(n)), n, prio))
         except ServerBusy:
             collector.count("shed")
+            if prio is not None:
+                collector.prio_count(prio, "sheds")
     # throughput window CLOSES here: the post-window drain below must not
     # deflate throughput_rps (completed/duration) in the overload regime
     # the open loop exists to measure
     duration = time.perf_counter() - t_start
     window_end = time.monotonic()
     collector.in_window = 0
-    for req, n in pending:
+    for req, n, prio in pending:
         try:
             req.result(timeout=30)
             # latency stamped at completion, not at this (late) harvest
             in_window = req.t_done <= window_end
-            collector.ok(req.latency_s, klass=n, in_window=in_window)
+            collector.ok(req.latency_s, klass=n, in_window=in_window,
+                         priority=prio, tier=getattr(req, "tier", None))
             if in_window:
                 collector.in_window += 1
         except RequestTimeout:
@@ -229,11 +370,39 @@ def _first_request_latencies(engine, shapes, sizes):
     return out
 
 
+def _priority_block(collector, duration):
+    """SERVE_BENCH ``priority`` key: {priority: {requests, completed,
+    sheds, downgrades, p50_ms, p99_ms, goodput_rps[, slo_ms]}}."""
+    out = {}
+    for prio, d in sorted(collector.by_priority.items()):
+        lats = np.asarray(sorted(d["latencies"]), np.float64)
+        entry = {
+            "requests": d["submitted"],
+            "completed": len(lats),
+            "sheds": d["sheds"],
+            "downgrades": d["downgrades"],
+            "p50_ms": round(float(np.percentile(lats, 50)) * 1e3, 3)
+            if len(lats) else 0.0,
+            "p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 3)
+            if len(lats) else 0.0,
+            "goodput_rps": round(d["good"] / duration, 2)
+            if duration else 0.0,
+        }
+        target = collector.slo_for(prio)
+        if target > 0:
+            entry["slo_ms"] = target
+        out[prio] = entry
+    return out
+
+
 def run(engine, shapes, args, mode, first_request_ms=None, warmup_s=None,
         trace_log=None, t_origin=None):
+    stats0 = engine.stats()
     collector = _Collector(trace_log=trace_log, t_origin=t_origin,
-                           slo_ms=getattr(args, "slo_ms", 0.0))
-    compiles_before = engine.stats()["compiles"]
+                           slo_ms=getattr(args, "slo_ms", 0.0),
+                           class_slo=getattr(args, "class_slo", None),
+                           native_tier=stats0.get("precision_tier"))
+    compiles_before = stats0["compiles"]
     runner = _run_closed if mode == "closed" else _run_open
     duration = runner(engine, shapes, args, collector)
     lat = np.asarray(sorted(collector.latencies), np.float64)
@@ -292,6 +461,14 @@ def run(engine, shapes, args, mode, first_request_ms=None, warmup_s=None,
         # absent when MXNET_QUALITYPLANE is off or nothing was sampled
         # (the None-strip below drops the key, like every optional field)
         "divergence": (stats.get("quality") or {}).get("divergence"),
+        # mixed-priority block (ISSUE 17): per-priority outcomes incl. the
+        # degrade-vs-shed split — absent without --class-mix
+        "priority": _priority_block(collector, duration) or None,
+        # which router policy served this line ("degrade"/"shed"; absent
+        # for a bare-engine run) — the bench_compare router-table axis
+        "router_policy": (getattr(args, "router", None)
+                          if getattr(args, "router", "off") not in
+                          (None, "off") else None),
     }
     line = {k: v for k, v in line.items() if v is not None}
     print("SERVE_BENCH " + json.dumps(line))
@@ -315,10 +492,24 @@ def main(argv=None):
     p.add_argument("--max-wait-ms", type=float, default=2.0)
     p.add_argument("--max-queue", type=int, default=512)
     p.add_argument("--timeout-s", type=float, default=10.0)
-    p.add_argument("--slo-ms", type=float, default=0.0,
+    p.add_argument("--slo-ms", default="0",
                    help="latency target for goodput accounting: "
                         "completions slower than this don't count toward "
-                        "goodput_rps (0 = every completion counts)")
+                        "goodput_rps (0 = every completion counts).  With "
+                        "--class-mix, also accepts per-priority targets: "
+                        "'paid:25,best_effort:100'")
+    p.add_argument("--class-mix", default=None,
+                   help="mixed-priority traffic (ISSUE 17): "
+                        "'paid:0.2,best_effort:0.8' draws each request's "
+                        "priority class at those weights and adds the "
+                        "per-priority SERVE_BENCH block")
+    p.add_argument("--router", choices=["off", "degrade", "shed"],
+                   default="off",
+                   help="serve through a serving.Router over fp32+bf16 "
+                        "twin pools with this policy mode (off = bare "
+                        "Engine)")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="engine replicas per tier pool (--router only)")
     p.add_argument("--symbol", help="*-symbol.json (default: built-in MLP)")
     p.add_argument("--params", help="*.params")
     p.add_argument("--input", action="append", default=[],
@@ -337,6 +528,13 @@ def main(argv=None):
     args = p.parse_args(argv)
     args.ladder = tuple(int(x) for x in str(args.ladder).split(",") if x)
     args.sizes = tuple(int(x) for x in str(args.sizes).split(",") if x)
+    try:
+        args.slo_ms, args.class_slo = _parse_slo(args.slo_ms)
+        args.class_mix = _parse_class_mix(args.class_mix)
+    except ValueError as e:
+        p.error(str(e))
+    if args.class_slo and not args.class_mix:
+        p.error("per-priority --slo-ms targets need --class-mix")
     if args.symbol and not args.input:
         p.error("--symbol requires at least one --input name:d1,d2,...")
     if args.smoke:
@@ -346,8 +544,11 @@ def main(argv=None):
         args.rate = 100.0
         args.ladder = (1, 2, 4)
 
-    engine, shapes = (_file_engine(args) if args.symbol
-                      else _tiny_engine(args))
+    if args.router != "off":
+        engine, shapes = _router_target(args)
+    else:
+        engine, shapes = (_file_engine(args) if args.symbol
+                          else _tiny_engine(args))
     try:
         warmup_s = None
         if not args.no_warmup:
