@@ -41,9 +41,13 @@ TEL_REQ_KEYS = {"compile_s", "peak_hbm_bytes", "data_wait_frac"}
 # flops summed (and peak executable bytes maxed) over every executable the
 # process built — null when MXNET_COSTPLANE is off or the backend cannot
 # report (the partial-row contract)
+# trials_saved (ISSUE 18 learned autotuning): measurements the cost model
+# skipped under predict-then-measure (ranked minus measured candidates) —
+# null when no ranked search ran this process
 TEL_OPT_KEYS = {"dispatches_per_step", "warmup_s",
                 "graph_nodes_pre", "graph_nodes_post", "pass_time_s",
-                "autotune_trials", "serve_p50_ms", "serve_p99_ms",
+                "autotune_trials", "trials_saved",
+                "serve_p50_ms", "serve_p99_ms",
                 "analysis_findings", "trainhealth_drain_s",
                 "xla_flops", "xla_peak_bytes"}
 TEL_KEYS = TEL_REQ_KEYS | TEL_OPT_KEYS
@@ -209,12 +213,13 @@ def validate_line(obj, where="<line>"):
             raise SchemaError(
                 "%s: telemetry.pass_time_s must be a non-negative number "
                 "or null" % where)
-        at = tel.get("autotune_trials")
-        if at is not None and (not isinstance(at, int)
-                               or isinstance(at, bool) or at < 0):
-            raise SchemaError(
-                "%s: telemetry.autotune_trials must be a non-negative int "
-                "or null" % where)
+        for k in ("autotune_trials", "trials_saved"):
+            at = tel.get(k)
+            if at is not None and (not isinstance(at, int)
+                                   or isinstance(at, bool) or at < 0):
+                raise SchemaError(
+                    "%s: telemetry.%s must be a non-negative int "
+                    "or null" % (where, k))
         for k in ("serve_p50_ms", "serve_p99_ms", "trainhealth_drain_s"):
             sv = tel.get(k)
             if sv is not None and (not _num(sv) or sv < 0):
@@ -464,6 +469,14 @@ def self_test():
         {"metric": "m", "value": 1, "unit": "samples/s",
          "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
                        "data_wait_frac": 0.0, "autotune_trials": None}},
+        # ISSUE 18 learned autotuning: measurements the cost model skipped
+        {"metric": "m", "value": 1, "unit": "samples/s",
+         "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0, "autotune_trials": 2,
+                       "trials_saved": 3}},
+        {"metric": "m", "value": 1, "unit": "samples/s",
+         "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0, "trials_saved": None}},
         {"metric": "m", "value": 1, "unit": "samples/s",
          "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
                        "data_wait_frac": 0.0, "serve_p50_ms": 2.5,
@@ -528,6 +541,14 @@ def self_test():
          "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
                        "data_wait_frac": 0.0,
                        "autotune_trials": 1.5}},         # float trial count
+        {"metric": "m", "value": 1, "unit": "img/s",
+         "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0,
+                       "trials_saved": -1}},             # negative saved
+        {"metric": "m", "value": 1, "unit": "img/s",
+         "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0,
+                       "trials_saved": 2.5}},            # float saved
         {"metric": "m", "value": 1, "unit": "img/s",
          "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
                        "data_wait_frac": 0.0,

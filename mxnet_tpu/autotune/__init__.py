@@ -17,6 +17,12 @@ Pieces:
 * ``search`` — exhaustive grid for small spaces, greedy coordinate
   descent beyond ``max_trials``; the default is measured first and wins
   ties (adopting a winner can never regress shipped behavior).
+  ``predict_then_measure`` (ISSUE 18) ranks the grid with the learned
+  cost model and measures only the default + top-k.
+* ``costmodel`` — the learned cost model itself (ISSUE 18): pure-numpy
+  ridge over the store's accumulated (config, shape sig, ledger
+  features) → seconds rows; advisory — measurement stays the source of
+  truth.
 * ``store``  — the persistent winner store (``MXNET_AUTOTUNE_CACHE``)
   with compile_cache-style env-fingerprint invalidation: stale or corrupt
   entries are silent misses that re-search overwrites, never crashes.
@@ -30,20 +36,28 @@ it.  ``tools/autotune.py`` is the search/show/clear CLI.
 """
 from __future__ import annotations
 
-from . import ladder, measure, search, space, store
+from . import costmodel, ladder, measure, search, space, store
+from .costmodel import CostModel, model_for, training_rows
 from .ladder import LADDER_KERNEL, ladder_sig, objective, propose
-from .measure import measure_candidate, measurements, time_callable
+from .measure import (failed_measurements, measure_candidate, measurements,
+                      time_callable)
+from .search import predict_then_measure
 from .search import search as run_search
-from .space import TuningSpace, dconv_shape_sig, get_space, register_space, spaces
+from .space import (TuningSpace, dconv_shape_sig, fused_step_sig, get_space,
+                    nms_shape_sig, psroi_shape_sig, quant_shape_sig,
+                    register_space, spaces)
 from .store import (clear, config_for, enabled, entries, lookup, override,
                     record, stats, store_path)
 
 __all__ = [
-    "ladder", "measure", "search", "space", "store",
+    "costmodel", "ladder", "measure", "search", "space", "store",
+    "CostModel", "model_for", "training_rows",
     "LADDER_KERNEL", "ladder_sig", "objective", "propose",
-    "measure_candidate", "measurements", "time_callable", "run_search",
-    "TuningSpace", "dconv_shape_sig", "get_space", "register_space",
-    "spaces",
+    "failed_measurements", "measure_candidate", "measurements",
+    "time_callable", "predict_then_measure", "run_search",
+    "TuningSpace", "dconv_shape_sig", "fused_step_sig", "get_space",
+    "nms_shape_sig", "psroi_shape_sig", "quant_shape_sig",
+    "register_space", "spaces",
     "clear", "config_for", "enabled", "entries", "lookup", "override",
     "record", "stats", "store_path", "tuned_ladder",
 ]

@@ -1,0 +1,272 @@
+"""Learned cost model over the autotune winner store (ISSUE 18).
+
+PR 9's searcher measures every admitted config; PR 13 already persists,
+with every winner, the per-candidate ``(config, shape signature, ledger
+features) -> measured seconds`` rows (``meta.trial_costs``).  This module
+closes the "Learning to Optimize Tensor Programs" loop (PAPERS.md
+1805.08166, value-function variant 2011.14486): a ridge regression in
+pure numpy — no new deps — fit **online** from those accumulated rows,
+used by ``search.predict_then_measure`` to rank a candidate grid so only
+the top-k (plus the hand-tuned default, always) is measured.
+
+Feature design
+--------------
+One row's feature dict merges three groups, every magnitude ``log1p``
+transformed (latencies span decades; linear features would let one huge
+shape dominate the fit):
+
+* ``cfg_<param>``  — the candidate's numeric config params,
+* ``sig_<tok>``    — the numbers parsed out of the shape signature
+  (``"N128-HW32-C16-i4"`` → ``sig_N``/``sig_HW``/``sig_C``/``sig_i``),
+  which is what lets a winner searched at one shape seed predictions at
+  an UNSEEN shape of the same kernel,
+* ``cost_<k>``     — the candidate's measured XLA ledger features
+  (flops / bytes_accessed / temp / peak / compile_s) plus ``cost_drift``,
+  the declared-vs-measured Pallas drift count (``costplane.crosscheck``)
+  — a distrust signal: a kernel whose declared cost model drifted gets
+  its ledger row discounted by the fit rather than trusted blindly,
+
+plus a ``dev_<device kind>`` one-hot so rows from different device
+generations share a fit without sharing an intercept.  At prediction
+time the ledger features of a *never-compiled* candidate are unknown —
+they are imputed with the training-column mean (standard ridge practice)
+so ranking degrades gracefully to the config/shape features instead of
+refusing to predict.
+
+The model is **advisory**: it only chooses which candidates get measured.
+Measurement stays the source of truth — the never-worse contract (default
+measured first, strict-< replacement) is enforced by the searcher, not
+here (docs/ANALYSIS.md).
+
+Everything is keyed per kernel; :func:`training_rows` harvests rows from
+the persistent ``MXNET_AUTOTUNE_CACHE`` store across shapes and device
+kinds (the store-format bump to v2 guarantees every surviving entry
+carries the v2 ``trial_costs`` schema; older stores are silent misses).
+"""
+from __future__ import annotations
+
+import math
+import os
+import re
+
+__all__ = ["CostModel", "model_enabled", "default_top_k", "training_rows",
+           "row_features", "model_for", "MIN_ROWS"]
+
+# below this many stored rows a fit is noise — callers fall back to grid
+MIN_ROWS = 4
+
+_LEDGER_KEYS = ("flops", "bytes_accessed", "temp_bytes", "peak_bytes",
+                "compile_s", "drift")
+
+
+def model_enabled():
+    """``MXNET_AUTOTUNE_MODEL`` gate (default ON — the model is advisory;
+    it cannot regress a winner, only skip measurements)."""
+    from ..base import env_flag
+
+    return env_flag("MXNET_AUTOTUNE_MODEL", default="1")
+
+
+def default_top_k(n_candidates):
+    """Measured-candidate budget for one predict-then-measure search:
+    ``MXNET_AUTOTUNE_TOPK`` when set positive, else a quarter of the grid
+    (min 1) — small enough that the ≤50%-of-grid acceptance holds with
+    the always-measured default included."""
+    try:
+        k = int(os.environ.get("MXNET_AUTOTUNE_TOPK", "0"))
+    except ValueError:
+        k = 0
+    if k > 0:
+        return k
+    return max(1, int(n_candidates) // 4)
+
+
+def _mag(v):
+    """log1p magnitude transform for any numeric feature."""
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return None
+    if not math.isfinite(f):
+        return None
+    return math.log1p(abs(f))
+
+
+def sig_features(sig):
+    """Numbers parsed out of a shape signature: ``"N128-HW32-i4"`` →
+    ``{"sig_N": log1p(128), "sig_HW": log1p(32), "sig_i": log1p(4)}``."""
+    out = {}
+    for m in re.finditer(r"([A-Za-z]+)(\d+)", str(sig or "")):
+        out["sig_" + m.group(1)] = _mag(int(m.group(2)))
+    return out
+
+
+def config_features(config):
+    out = {}
+    for k, v in (config or {}).items():
+        m = _mag(v)
+        if m is not None:
+            out["cfg_" + str(k)] = m
+    return out
+
+
+def cost_features(cost):
+    out = {}
+    for k in _LEDGER_KEYS:
+        v = (cost or {}).get(k)
+        m = _mag(v)
+        if m is not None:
+            out["cost_" + k] = m
+    return out
+
+
+def row_features(sig, config, cost=None, device_kind=None):
+    """One row's merged feature dict (see module docstring)."""
+    out = sig_features(sig)
+    out.update(config_features(config))
+    out.update(cost_features(cost))
+    if device_kind:
+        out["dev_" + str(device_kind)] = 1.0
+    return out
+
+
+def training_rows(kernel=None):
+    """Harvest ``(features, seconds)`` training rows from the persistent
+    store's per-candidate ``meta.trial_costs`` — every shape and device
+    kind, optionally one kernel.  Entries from an older store format are
+    skipped (their trial schema predates v2), and failed-trial sentinels
+    (non-finite / non-positive seconds) are excluded: a candidate whose
+    compile failed must not teach the model a latency."""
+    from . import store
+
+    rows = []
+    for key, ent in store.entries().items():
+        parts = str(key).split("|", 2)
+        if len(parts) != 3 or not isinstance(ent, dict):
+            continue
+        device_kind, kern, sig = parts
+        if kernel is not None and kern != str(kernel):
+            continue
+        env = ent.get("env")
+        if not isinstance(env, dict) or env.get("format") != store._FORMAT:
+            continue
+        meta = ent.get("meta")
+        trials = meta.get("trial_costs") if isinstance(meta, dict) else None
+        for tc in trials or ():
+            if not isinstance(tc, dict):
+                continue
+            cfg, sec = tc.get("config"), tc.get("seconds")
+            if not isinstance(cfg, dict) \
+                    or not isinstance(sec, (int, float)) \
+                    or not math.isfinite(sec) or sec <= 0:
+                continue
+            cost = tc.get("cost")
+            rows.append({"kernel": kern, "device_kind": device_kind,
+                         "sig": sig, "config": dict(cfg),
+                         "seconds": float(sec),
+                         "cost": dict(cost) if isinstance(cost, dict)
+                         else None})
+    return rows
+
+
+class CostModel:
+    """Ridge regression ``features -> log(seconds)`` with quadratic
+    expansion (a linear fit cannot represent the U-shaped block-size
+    curves the kernels actually have), mean-imputation for features a row
+    lacks, and per-column standardization.  Pure numpy, closed form."""
+
+    def __init__(self, ridge=1e-3):
+        self.ridge = float(ridge)
+        self._names = None
+        self._colmean = None
+        self._mu = None
+        self._sd = None
+        self._w = None
+        self._n = 0
+
+    @property
+    def ready(self):
+        return self._w is not None and self._n >= MIN_ROWS
+
+    def fit(self, rows):
+        """Fit from :func:`training_rows`-shaped dicts.  Returns self."""
+        import numpy as np
+
+        feats, y = [], []
+        for r in rows:
+            feats.append(row_features(r.get("sig"), r.get("config"),
+                                      r.get("cost"), r.get("device_kind")))
+            y.append(math.log(max(1e-12, float(r["seconds"]))))
+        if not feats:
+            return self
+        names = sorted(set().union(*feats))
+        if not names:
+            return self
+        A = np.full((len(feats), len(names)), np.nan)
+        for i, f in enumerate(feats):
+            for j, n in enumerate(names):
+                if n in f and f[n] is not None:
+                    A[i, j] = f[n]
+        colmean = np.nanmean(np.where(np.isnan(A), np.nan, A), axis=0)
+        colmean = np.where(np.isnan(colmean), 0.0, colmean)
+        A = np.where(np.isnan(A), colmean, A)
+        Z = np.concatenate([A, A * A], axis=1)
+        mu, sd = Z.mean(axis=0), Z.std(axis=0)
+        sd = np.where(sd == 0, 1.0, sd)
+        X = np.concatenate([(Z - mu) / sd,
+                            np.ones((Z.shape[0], 1))], axis=1)
+        yv = np.asarray(y)
+        lam = self.ridge * np.eye(X.shape[1])
+        lam[-1, -1] = 0.0  # never shrink the intercept
+        try:
+            w = np.linalg.solve(X.T @ X + lam, X.T @ yv)
+        except np.linalg.LinAlgError:
+            w = np.linalg.lstsq(X, yv, rcond=None)[0]
+        self._names, self._colmean = names, colmean
+        self._mu, self._sd, self._w = mu, sd, w
+        self._n = len(feats)
+        return self
+
+    def predict(self, features):
+        """Predicted seconds for one feature dict (``row_features``)."""
+        import numpy as np
+
+        if self._w is None:
+            raise RuntimeError("CostModel.predict before fit")
+        x = np.full(len(self._names), np.nan)
+        for j, n in enumerate(self._names):
+            v = features.get(n)
+            if v is not None:
+                x[j] = v
+        x = np.where(np.isnan(x), self._colmean, x)
+        z = np.concatenate([x, x * x])
+        z = (z - self._mu) / self._sd
+        pred = float(np.concatenate([z, [1.0]]) @ self._w)
+        return math.exp(min(50.0, max(-50.0, pred)))
+
+    def predict_one(self, sig, config, device_kind=None, cost=None):
+        """Predicted seconds for one candidate config at one shape."""
+        return self.predict(row_features(sig, config, cost, device_kind))
+
+    def rank(self, sig, configs, device_kind=None, costs=None):
+        """Configs sorted by predicted seconds, cheapest first (ties break
+        on the canonical config key for determinism)."""
+        costs = costs or {}
+
+        def skey(cfg):
+            return tuple(sorted((str(k), str(v)) for k, v in cfg.items()))
+
+        return sorted(configs,
+                      key=lambda c: (self.predict_one(sig, c, device_kind,
+                                                      costs.get(skey(c))),
+                                     skey(c)))
+
+
+def model_for(kernel):
+    """Fit a kernel's model from the persistent store, or None when the
+    store holds fewer than :data:`MIN_ROWS` usable rows."""
+    rows = training_rows(kernel)
+    if len(rows) < MIN_ROWS:
+        return None
+    m = CostModel().fit(rows)
+    return m if m.ready else None

@@ -18,10 +18,17 @@ import time
 from . import store
 
 __all__ = ["time_callable", "measure_candidate", "measurements",
-           "features_for", "trial_features"]
+           "failed_measurements", "features_for", "trial_features",
+           "FAILED_TRIAL"]
+
+# sentinel score of a candidate whose build/compile/run raised: +inf can
+# never win under the searcher's strict-< contract, so a broken candidate
+# is recorded and skipped instead of aborting the whole search (ISSUE 18)
+FAILED_TRIAL = float("inf")
 
 _mu = threading.Lock()
 _count = [0]
+_failed = [0]
 # (kernel, canonical config) -> measured cost features (compile plane,
 # ISSUE 13): the per-candidate feature vector the learned cost model
 # (ROADMAP item 4) trains on — flops / bytes / peak from the candidate's
@@ -34,6 +41,14 @@ def measurements():
     """Trials measured by this process since import (or the last reset)."""
     with _mu:
         return _count[0]
+
+
+def failed_measurements():
+    """Trials whose candidate raised (sentinel-scored, not counted in
+    :func:`measurements` — the warm-store zero-measurement acceptance
+    counts successful timings only)."""
+    with _mu:
+        return _failed[0]
 
 
 def _feature_key(kernel, config):
@@ -59,6 +74,7 @@ def trial_features():
 def _reset_stats_for_tests():
     with _mu:
         _count[0] = 0
+        _failed[0] = 0
         _features.clear()
 
 
@@ -91,20 +107,35 @@ def measure_candidate(kernel, config, build, args=(), warmup=2, repeat=5):
     AOT compile of the built callable, inside the same config pin) on
     :func:`features_for` — the training set for the learned cost model.
     The extra compile is absorbed by the warmup calls; gate off = one env
-    read, no extra work (tested)."""
-    with store.override(kernel, config):
-        fn = build()
-        from ..telemetry import costplane
+    read, no extra work (tested).
 
-        if costplane.enabled():
-            feats = costplane.candidate_features(fn, args)
-            if feats is not None:
-                with _mu:
-                    _features[_feature_key(kernel, config)] = feats
-        seconds = time_callable(fn, args, warmup=warmup, repeat=repeat)
-    with _mu:
-        _count[0] += 1
+    A candidate that RAISES anywhere on this path — build, compile, or
+    run (a pruned-but-admitted config can still hard-fail Mosaic) — is a
+    **failed trial**, not a search abort: it returns :data:`FAILED_TRIAL`
+    (``+inf``, which can never win under the searcher's strict-<
+    contract), counts on :func:`failed_measurements` plus
+    ``autotune_failed_trials_total{kernel}``, and is scrubbed from the
+    feature set so the learned cost model never trains on it."""
     from .. import telemetry
 
+    try:
+        with store.override(kernel, config):
+            fn = build()
+            from ..telemetry import costplane
+
+            if costplane.enabled():
+                feats = costplane.candidate_features(fn, args)
+                if feats is not None:
+                    with _mu:
+                        _features[_feature_key(kernel, config)] = feats
+            seconds = time_callable(fn, args, warmup=warmup, repeat=repeat)
+    except Exception:
+        with _mu:
+            _failed[0] += 1
+            _features.pop(_feature_key(kernel, config), None)
+        telemetry.note_autotune_trial(kernel, failed=True)
+        return FAILED_TRIAL
+    with _mu:
+        _count[0] += 1
     telemetry.note_autotune_trial(kernel, seconds)
     return seconds

@@ -1,21 +1,24 @@
-"""Grid + greedy config search (ISSUE 9).
+"""Grid + greedy config search, and predict-then-measure (ISSUE 9 / 18).
 
 Even simple measured search over a declared space beats expert constants
 (PAPERS.md 1805.08166) — and for the space sizes our kernels declare
 (tens of configs) an exhaustive grid IS the right searcher.  When the
 constrained grid exceeds ``max_trials``, greedy coordinate descent from
 the default explores one parameter at a time instead.
+:func:`predict_then_measure` (ISSUE 18) replaces exhaustion with a
+learned ranking (``costmodel.CostModel``): the full grid is scored by
+predicted cost, only the top-k is measured.
 
-The never-worse contract: the DEFAULT config is measured first and a
-candidate replaces it only on a strictly lower time — on a tie the
-hand-tuned default stays, so adopting a search result can never regress
-the shipped behavior (acceptance-tested).
+The never-worse contract (BOTH strategies): the DEFAULT config is
+measured first and a candidate replaces it only on a strictly lower
+time — on a tie the hand-tuned default stays, so adopting a search
+result can never regress the shipped behavior (acceptance-tested).
 """
 from __future__ import annotations
 
 import itertools
 
-__all__ = ["search"]
+__all__ = ["search", "predict_then_measure"]
 
 
 def search(space, measure, ctx=None, max_trials=64):
@@ -72,3 +75,61 @@ def search(space, measure, ctx=None, max_trials=64):
                     if best["seconds"] < before:
                         improved = True
     return best["config"], results
+
+
+def predict_then_measure(space, measure, predict, ctx=None, top_k=1,
+                         max_candidates=1024):
+    """Rank the constrained grid by ``predict(config) -> predicted
+    seconds`` and measure only the default plus the ``top_k`` cheapest
+    predictions (ISSUE 18).
+
+    The default is measured FIRST and unconditionally — prediction never
+    gets a veto over the hand-tuned config — and a ranked candidate
+    replaces it only on a strictly lower measured time, so the learned
+    model stays advisory: it decides what gets *measured*, never what
+    wins.  A candidate whose prediction raises ranks last (measured only
+    if budget remains) rather than killing the search.
+
+    → ``(best_config, results, report)``: results as in :func:`search`;
+    report carries ``candidates`` (grid size), ``measured``, and
+    ``saved`` (= candidates − measured, the skipped measurements) — also
+    counted in ``autotune_{predicted,measured}_trials_total{kernel}``
+    when telemetry is on.
+    """
+    ctx = dict(ctx or {})
+    configs = list(itertools.islice(space.iter_configs(**ctx),
+                                    max_candidates))
+    results = []
+    tried = set()
+    best = {"config": None, "seconds": None}
+
+    def key(cfg):
+        return tuple(sorted(cfg.items()))
+
+    def trial(cfg):
+        if key(cfg) in tried:
+            return
+        tried.add(key(cfg))
+        seconds = measure(dict(cfg))
+        results.append({"config": dict(cfg), "seconds": seconds})
+        if best["seconds"] is None or seconds < best["seconds"]:
+            best["config"], best["seconds"] = dict(cfg), seconds
+
+    trial(configs[0])  # the default, always, before any prediction
+    scored = []
+    for cfg in configs[1:]:
+        try:
+            s = float(predict(cfg))
+        except Exception:
+            s = float("inf")
+        scored.append((s, key(cfg), cfg))
+    scored.sort(key=lambda t: (t[0], t[1]))
+    for _, _, cfg in scored[:max(0, int(top_k))]:
+        trial(cfg)
+    report = {"candidates": len(configs), "measured": len(results),
+              "saved": max(0, len(configs) - len(results))}
+    from .. import telemetry
+
+    telemetry.note_autotune_ranked(space.name, predicted=len(configs),
+                                   measured=len(results))
+    return best["config"], results, report

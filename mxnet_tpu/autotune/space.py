@@ -16,13 +16,29 @@ Registered spaces (this module, at import):
   the same ``dconv_bwd_vmem_bytes`` VMEM guard that drives the
   pallas-vs-XLA auto branch: a candidate whose backward working set would
   hard-fail Mosaic is never measured.
+* ``nms_alive_pallas`` — the box-tile size ``tile`` of the blocked greedy
+  NMS kernel (lane-aligned multiples of 128; ``nms_fits_vmem`` prunes
+  tiles whose per-image working set would blow VMEM at the problem's N).
+* ``psroi_abuild_pallas`` — the rois-per-grid-step block ``rb`` of the
+  deformable-PSROI accumulation-matrix kernel, fwd+bwd (the backward is
+  the larger pass; ``abuild_fits_vmem`` prunes on it).
+* ``quantize_int8_pallas`` / ``dequantize_int8_pallas`` — the row-block
+  ``block`` of the tiled elementwise int8 kernels (``quant_fits_vmem``).
+* ``fused_step_layout`` — the one NON-kernel space (ISSUE 18): fused
+  train-step layout knobs, ZeRO-1 on/off × input prefetch depth, measured
+  end-to-end through ``FusedStepper`` on a tiny model by the CLI runner.
+  The constraint prunes ``zero=1`` off-mesh (``MXNET_FUSED_ZERO`` is only
+  consulted on the mesh path).  The winner is adopted by operators (set
+  ``MXNET_FUSED_ZERO`` / ``PrefetchingIter(prefetch_depth=...)`` from the
+  stored config), not by a trace-time dispatch site.
 """
 from __future__ import annotations
 
 import itertools
 
 __all__ = ["TuningSpace", "register_space", "get_space", "spaces",
-           "dconv_shape_sig"]
+           "dconv_shape_sig", "nms_shape_sig", "psroi_shape_sig",
+           "quant_shape_sig", "fused_step_sig"]
 
 _SPACES = {}
 
@@ -138,3 +154,120 @@ register_space(TuningSpace(
     params={"nblk": (32, 64, 128, 256, 512)},
     default={"nblk": 128},
     constraint=_dconv_constraint))
+
+
+# -- nms_alive_pallas ---------------------------------------------------------
+def nms_shape_sig(B, N):
+    """Shape signature of one blocked-NMS problem: images × boxes.  B is
+    kept (unlike dconv's BG) because the whole per-image column block is
+    VMEM-resident — batching changes nothing per grid step, but N drives
+    both padding waste and the fixed-point tile cost."""
+    return "B%d-N%d" % (int(B), int(N))
+
+
+def _nms_constraint(config, N=None, **_):
+    """Lane alignment (every in-kernel slice is over the 128-lane axis)
+    plus the per-image VMEM working-set guard at the problem's N."""
+    from ..ops.pallas_kernels import _LANE, nms_fits_vmem
+
+    tile = int(config["tile"])
+    if tile < _LANE or tile % _LANE:
+        return False
+    if N is None:
+        return True
+    return nms_fits_vmem(int(N), tile=tile)
+
+
+register_space(TuningSpace(
+    "nms_alive_pallas",
+    params={"tile": (128, 256, 512, 1024)},
+    default={"tile": 256},   # the shipped _NMS_TILE
+    constraint=_nms_constraint))
+
+
+# -- psroi_abuild_pallas ------------------------------------------------------
+def psroi_shape_sig(N, S, H, W, itemsize):
+    """Shape signature of one accumulation-matrix build: rois × sample
+    points × bin map dims × the out/grad itemsize (fwd keys on the output
+    dtype, bwd on the cotangent's — both route through the same space)."""
+    return "N%d-S%d-H%d-W%d-i%d" % (int(N), int(S), int(H), int(W),
+                                    int(itemsize))
+
+
+def _abuild_constraint(config, N=None, S=None, H=None, W=None, itemsize=4,
+                       **_):
+    """The candidate's EFFECTIVE block (rb caps at N at the dispatch site)
+    must keep the backward working set inside the shared VMEM budget."""
+    from ..ops.pallas_kernels import abuild_fits_vmem
+
+    if S is None or H is None or W is None:
+        return True
+    rb = int(config["rb"])
+    if rb < 1:
+        return False
+    if N is not None:
+        rb = min(rb, int(N))
+    return abuild_fits_vmem(int(S), int(H), int(W), int(itemsize), rb=rb)
+
+
+register_space(TuningSpace(
+    "psroi_abuild_pallas",
+    params={"rb": (16, 32, 64, 128, 256)},
+    default={"rb": 64},      # the shipped _ABUILD_RB
+    constraint=_abuild_constraint))
+
+
+# -- quantize/dequantize_int8_pallas ------------------------------------------
+def quant_shape_sig(rows, itemsize):
+    """Shape signature of one tiled-elementwise problem: the (rows, 128)
+    flattened tile count plus the INPUT itemsize (quantize reads f32,
+    dequantize reads int8 — different traffic per row)."""
+    return "R%d-i%d" % (int(rows), int(itemsize))
+
+
+def _quant_constraint(config, rows=None, in_itemsize=4, out_itemsize=1,
+                      **_):
+    from ..ops.pallas_kernels import quant_fits_vmem
+
+    block = int(config["block"])
+    if block < 1:
+        return False
+    if rows is not None:
+        block = min(block, int(rows))
+    return quant_fits_vmem(block, int(in_itemsize), int(out_itemsize))
+
+
+register_space(TuningSpace(
+    "quantize_int8_pallas",
+    params={"block": (128, 256, 512, 1024, 2048)},
+    default={"block": 512},  # the shipped min(rows, 512) cap
+    constraint=_quant_constraint))
+
+register_space(TuningSpace(
+    "dequantize_int8_pallas",
+    params={"block": (128, 256, 512, 1024, 2048)},
+    default={"block": 512},
+    constraint=_quant_constraint))
+
+
+# -- fused_step_layout (non-kernel space, ISSUE 18) ---------------------------
+def fused_step_sig(batch, dim, ndev):
+    """Shape signature of one fused-step layout problem: batch × feature
+    dim × device count (the layout trade — ZeRO shards over devices,
+    prefetch hides host staging — is topology-dependent)."""
+    return "B%d-D%d-dev%d" % (int(batch), int(dim), int(ndev))
+
+
+def _fused_layout_constraint(config, mesh=False, **_):
+    """ZeRO-1 only exists on the mesh path (``fused_step.py`` consults
+    ``MXNET_FUSED_ZERO`` solely when the Module carries a mesh), so
+    off-mesh candidates with ``zero=1`` would measure as silent no-ops —
+    prune them instead of letting a meaningless tie pollute the store."""
+    return not int(config.get("zero", 0)) or bool(mesh)
+
+
+register_space(TuningSpace(
+    "fused_step_layout",
+    params={"zero": (0, 1), "prefetch": (0, 1, 2, 4)},
+    default={"zero": 0, "prefetch": 2},  # io.PrefetchingIter's default depth
+    constraint=_fused_layout_constraint))

@@ -33,7 +33,13 @@ from ..base import env_flag
 __all__ = ["enabled", "store_path", "lookup", "record", "entries", "clear",
            "stats", "override", "config_for", "entry_key"]
 
-_FORMAT = 1  # bump to invalidate every persisted winner
+# Bump to invalidate every persisted winner.  v2 (ISSUE 18): entries are
+# the learned cost model's training set — ``meta.trial_costs`` rows carry
+# the widened ledger features (compile_s, declared-vs-measured drift) and
+# failed trials are never persisted.  v1 stores predate that contract, so
+# they are silent misses the next search overwrites (same invalidation
+# matrix as compile_cache; tested in tests/test_autotune.py).
+_FORMAT = 2
 
 _mu = threading.Lock()
 _stats = {"hits": 0, "misses": 0, "errors": 0}

@@ -31,6 +31,21 @@ _MIN_SUBLANES = {jnp.dtype(jnp.float32): 8, jnp.dtype(jnp.bfloat16): 16,
                  jnp.dtype(jnp.int8): 32}
 
 
+def _vmem_limit():
+    """The shared per-grid-step VMEM budget every ``*_fits_vmem`` guard
+    judges against: ``MXNET_DCONV_VMEM_MB`` when set positive, else the
+    calibrated ``_DCONV_VMEM_LIMIT`` (defined with its calibration notes
+    at the dconv section below)."""
+    import os
+
+    try:
+        limit = int(float(os.environ.get("MXNET_DCONV_VMEM_MB", 0))
+                    * (1 << 20))
+    except ValueError:
+        limit = 0
+    return limit if limit > 0 else _DCONV_VMEM_LIMIT
+
+
 # ---------------------------------------------------------------------------
 # Custom-call cost registry (ISSUE 1 observability)
 # ---------------------------------------------------------------------------
@@ -227,16 +242,61 @@ def _dq_kernel(q_ref, scale_ref, out_ref):
     out_ref[:] = q_ref[:].astype(jnp.float32) * scale_ref[0]
 
 
-def _tiled_elementwise(kernel, x, scale, out_dtype, interpret):
+def quant_vmem_bytes(block, in_itemsize, out_itemsize):
+    """Estimated per-grid-step VMEM working set of one tiled elementwise
+    int8 kernel: the (block, 128) input and output tiles (the SMEM scalar
+    is noise).  Shares dconv's calibrated 24 MB budget."""
+    return block * _LANE * (int(in_itemsize) + int(out_itemsize))
+
+
+def quant_fits_vmem(block, in_itemsize, out_itemsize):
+    """True when a candidate row block fits the shared VMEM budget —
+    the autotuner's admission guard for the quantize/dequantize spaces
+    (ISSUE 18), same idiom as ``dconv_fits_vmem``."""
+    return quant_vmem_bytes(block, in_itemsize, out_itemsize) \
+        <= _vmem_limit()
+
+
+def _quant_block(kernel, rows, in_itemsize, out_itemsize):
+    """Row-block size for one tiled-elementwise problem (trace time only,
+    same adoption idiom as ``_dconv_grid``): the hand-tuned default is
+    ``min(rows, 512)``; with ``MXNET_AUTOTUNE`` set a persisted winner for
+    this (device kind, shape signature) overrides it, re-validated against
+    the VMEM guard at adoption time.  Gate unset = one env read and the
+    shipped constant, byte-identical (tested)."""
+    block = min(rows, 512)
+    from ..base import env_flag
+
+    if kernel is not None and env_flag("MXNET_AUTOTUNE"):
+        from .. import autotune
+
+        cfg = autotune.config_for(
+            kernel, autotune.quant_shape_sig(rows, in_itemsize))
+        if cfg:
+            try:
+                adopted = int(cfg["block"])
+            except (KeyError, TypeError, ValueError):
+                adopted = None  # malformed winner: keep the default
+            if adopted is not None and adopted > 0 and quant_fits_vmem(
+                    min(adopted, rows), in_itemsize, out_itemsize):
+                block = min(adopted, rows)
+    return max(1, block)
+
+
+def _tiled_elementwise(kernel, x, scale, out_dtype, interpret, name=None):
     """Shared scaffolding: flatten to (rows, 128) tiles, grid over row
-    blocks, scalar in SMEM — the template for further elementwise kernels."""
+    blocks, scalar in SMEM — the template for further elementwise kernels.
+    ``name`` keys the autotuned row-block lookup (None = the constant)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     shape = x.shape
     flat = x.reshape(-1, _LANE)
     rows = flat.shape[0]
-    block = min(rows, 512)
+    block = _quant_block(name, rows, jnp.dtype(x.dtype).itemsize,
+                         jnp.dtype(out_dtype).itemsize)
+    # normalize any adopted value to a divisor of rows: the kernel is
+    # elementwise, so halving only changes the grid, never the values
     while rows % block:
         block //= 2
     out = pl.pallas_call(
@@ -259,7 +319,8 @@ def quantize_int8_pallas(x, real_range, interpret=False):
     Returns int8 of the same shape."""
     _record_cost("quantize_int8_pallas", cost_quantize_int8(x.shape), x.shape)
     scale = (127.0 / real_range).reshape(1).astype(jnp.float32)
-    return _tiled_elementwise(_q_kernel, x, scale, jnp.int8, interpret)
+    return _tiled_elementwise(_q_kernel, x, scale, jnp.int8, interpret,
+                              name="quantize_int8_pallas")
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -268,7 +329,8 @@ def dequantize_int8_pallas(q, real_range, interpret=False):
     _record_cost("dequantize_int8_pallas", cost_dequantize_int8(q.shape),
                  q.shape)
     scale = (real_range / 127.0).reshape(1).astype(jnp.float32)
-    return _tiled_elementwise(_dq_kernel, q, scale, jnp.float32, interpret)
+    return _tiled_elementwise(_dq_kernel, q, scale, jnp.float32, interpret,
+                              name="dequantize_int8_pallas")
 
 
 # ---------------------------------------------------------------------------
@@ -278,8 +340,54 @@ def dequantize_int8_pallas(q, real_range, interpret=False):
 _NMS_TILE = 256  # multiple of 128 so every lane-dim slice below is aligned
 
 
-def _nms_kernel_factory(nb, thresh, plus_one, use_ids):
-    """Build the kernel body for ``nb`` tiles of ``_NMS_TILE`` boxes.
+def nms_vmem_bytes(N, tile=_NMS_TILE):
+    """Estimated per-grid-step VMEM working set of the blocked NMS kernel
+    (all f32): the whole per-image cols block (8, Np) + alive row (Np),
+    the transposed tile block whose lane dim pads 8→128, and ~3 (T, T)
+    IoU/suppression planes live across the fixed-point iteration.
+    Deliberately overcounts (Mosaic fuses several) — same calibration
+    stance as ``dconv_bwd_vmem_bytes`` against the shared 24 MB budget."""
+    tile = int(tile)
+    np_ = max(1, -(-int(N) // tile)) * tile
+    return 4 * (9 * np_ + tile * _LANE + 3 * tile * tile)
+
+
+def nms_fits_vmem(N, tile=_NMS_TILE):
+    """True when a candidate box-tile size fits the shared VMEM budget —
+    the autotuner's admission guard for the ``nms_alive_pallas`` space
+    (ISSUE 18) and the adoption-time re-check in :func:`_nms_tile`."""
+    return nms_vmem_bytes(N, tile=tile) <= _vmem_limit()
+
+
+def _nms_tile(B, N):
+    """Box-tile size for one NMS problem (trace time only, the
+    ``_dconv_grid`` adoption idiom): hand-tuned ``_NMS_TILE`` unless
+    ``MXNET_AUTOTUNE`` is set and the store holds a winner for this
+    (device kind, B×N signature) — which must still be lane-aligned and
+    re-pass the VMEM guard under the CURRENT budget, else the default
+    stays.  Gate unset = one env read, byte-identical (tested)."""
+    tile = _NMS_TILE
+    from ..base import env_flag
+
+    if env_flag("MXNET_AUTOTUNE"):
+        from .. import autotune
+
+        cfg = autotune.config_for("nms_alive_pallas",
+                                  autotune.nms_shape_sig(B, N))
+        if cfg:
+            try:
+                adopted = int(cfg["tile"])
+            except (KeyError, TypeError, ValueError):
+                adopted = None  # malformed winner: keep the default
+            if adopted is not None and adopted >= _LANE \
+                    and adopted % _LANE == 0 \
+                    and nms_fits_vmem(N, tile=adopted):
+                tile = adopted
+    return tile
+
+
+def _nms_kernel_factory(nb, thresh, plus_one, use_ids, tile=_NMS_TILE):
+    """Build the kernel body for ``nb`` tiles of ``tile`` boxes.
 
     Same greedy semantics as ops/detection.py ``_nms_alive_blocked``
     (reference multi_proposal.cc:221-273): grid step (b, k) settles image
@@ -292,7 +400,7 @@ def _nms_kernel_factory(nb, thresh, plus_one, use_ids):
     """
     import jax.experimental.pallas as pl
 
-    T = _NMS_TILE
+    T = int(tile)
 
     def iou2d(cx1, cy1, cx2, cy2, car, rx1, ry1, rx2, ry2, rar):
         """(T,1) column boxes vs (1,S) row boxes -> (T,S) IoU."""
@@ -377,7 +485,7 @@ def _nms_pallas_batched(boxes, valid, idv, thresh, plus_one, use_ids,
 
     B, N = boxes.shape[:2]
     _record_cost("nms_alive_pallas", cost_nms_alive(B, N), boxes.shape)
-    T = _NMS_TILE
+    T = _nms_tile(B, N)
     nb = max(1, -(-N // T))
     Np = nb * T
     f32 = jnp.float32
@@ -391,7 +499,8 @@ def _nms_pallas_batched(boxes, valid, idv, thresh, plus_one, use_ids,
     colst = jnp.swapaxes(cols, 1, 2)                     # (B, Np, 8)
 
     alive = pl.pallas_call(
-        _nms_kernel_factory(nb, float(thresh), float(plus_one), use_ids),
+        _nms_kernel_factory(nb, float(thresh), float(plus_one), use_ids,
+                            tile=T),
         out_shape=jax.ShapeDtypeStruct((B, 1, Np), f32),
         grid=(B, nb),
         in_specs=[
@@ -483,6 +592,49 @@ def nms_alive_pallas(boxes, valid, ids, *, thresh, plus_one=1.0,
 _ABUILD_RB = 64  # rois per grid step; 64 measured >> 32 (grid overhead)
 
 
+def abuild_vmem_bytes(S, H, W, itemsize, rb=_ABUILD_RB):
+    """Estimated per-grid-step VMEM working set of the abuild BACKWARD
+    kernel (the larger pass): the yv/xv input blocks plus the dy/dx
+    output blocks (all f32, (rb, S, H|W)), and the incoming g block with
+    its f32 upcast ((rb, H, W)).  Shares dconv's calibrated 24 MB
+    budget; overcounting stance as ``dconv_bwd_vmem_bytes``."""
+    return rb * (8 * int(S) * (int(H) + int(W))
+                 + (int(itemsize) + 4) * int(H) * int(W))
+
+
+def abuild_fits_vmem(S, H, W, itemsize, rb=_ABUILD_RB):
+    """True when a candidate roi block fits the shared VMEM budget — the
+    autotuner's admission guard for the ``psroi_abuild_pallas`` space
+    (ISSUE 18) and the adoption-time re-check in :func:`_abuild_rb`."""
+    return abuild_vmem_bytes(S, H, W, itemsize, rb=rb) <= _vmem_limit()
+
+
+def _abuild_rb(N, S, H, W, itemsize):
+    """Roi-block size for one abuild problem (trace time only, the
+    ``_dconv_grid`` adoption idiom): hand-tuned ``_ABUILD_RB`` unless
+    ``MXNET_AUTOTUNE`` holds a winner for this (device kind, shape
+    signature), re-validated against the VMEM guard at its EFFECTIVE
+    size (caps at N).  Gate unset = one env read, byte-identical."""
+    rb = _ABUILD_RB
+    from ..base import env_flag
+
+    if env_flag("MXNET_AUTOTUNE"):
+        from .. import autotune
+
+        cfg = autotune.config_for(
+            "psroi_abuild_pallas",
+            autotune.psroi_shape_sig(N, S, H, W, itemsize))
+        if cfg:
+            try:
+                adopted = int(cfg["rb"])
+            except (KeyError, TypeError, ValueError):
+                adopted = None  # malformed winner: keep the default
+            if adopted is not None and adopted >= 1 and abuild_fits_vmem(
+                    S, H, W, itemsize, rb=min(adopted, N)):
+                rb = adopted
+    return min(rb, N)
+
+
 def _abuild_fwd_kernel_factory(rb, out_dtype):
     def kern(y_ref, x_ref, o_ref):
         for r in range(rb):
@@ -534,7 +686,7 @@ def _abuild_impl(yv, xv, out_dtype, interpret):
         "psroi_abuild_pallas_fwd",
         cost_psroi_abuild_fwd(N, S, H, W, jnp.dtype(out_dtype).itemsize),
         yv.shape)
-    rb = min(_ABUILD_RB, N)
+    rb = _abuild_rb(N, S, H, W, jnp.dtype(out_dtype).itemsize)
     n_pad = -(-N // rb) * rb
     out = pl.pallas_call(
         _abuild_fwd_kernel_factory(rb, out_dtype),
@@ -561,7 +713,7 @@ def _abuild_bwd(out_dtype, interpret, res, g):
     _record_cost("psroi_abuild_pallas_bwd",
                  cost_psroi_abuild_bwd(N, S, H, W, jnp.dtype(g.dtype).itemsize),
                  yv.shape)
-    rb = min(_ABUILD_RB, N)
+    rb = _abuild_rb(N, S, H, W, jnp.dtype(g.dtype).itemsize)
     n_pad = -(-N // rb) * rb
     dy, dx = pl.pallas_call(
         _abuild_bwd_kernel_factory(rb),
@@ -632,15 +784,7 @@ def dconv_fits_vmem(HW, C, itemsize, nblk=_DCONV_NBLK):
     """True when the fused dconv kernel's estimated footprint fits VMEM.
     ``nblk`` lets the autotuner (ISSUE 9) constrain CANDIDATE block sizes
     with the same budget the auto branch enforces for the default."""
-    import os
-
-    try:
-        limit = int(float(os.environ.get("MXNET_DCONV_VMEM_MB", 0)) * (1 << 20))
-    except ValueError:
-        limit = 0
-    if limit <= 0:
-        limit = _DCONV_VMEM_LIMIT
-    return dconv_bwd_vmem_bytes(HW, C, itemsize, nblk=nblk) <= limit
+    return dconv_bwd_vmem_bytes(HW, C, itemsize, nblk=nblk) <= _vmem_limit()
 
 
 def _dconv_factors(y0, y1, x0, x1, ly, lx, H, W):

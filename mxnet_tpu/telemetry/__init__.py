@@ -28,6 +28,7 @@ from .instrument import (RouterProbe, ServeProbe, StepProbe, add_sink,
                          instrument_step, interval_s, jsonl_path,
                          note_analysis_finding, note_aot_cache,
                          note_autotune_cache,
+                         note_autotune_ranked,
                          note_autotune_trial, note_bytes,
                          note_compile, note_dispatch, note_fused_fallback,
                          note_graph_passes, note_lockcheck_violation,
@@ -46,7 +47,7 @@ __all__ = [
     "counter",
     "enabled", "event", "flush", "gauge", "histogram", "instrument_step",
     "interval_s", "jsonl_path", "note_analysis_finding", "note_aot_cache",
-    "note_autotune_cache",
+    "note_autotune_cache", "note_autotune_ranked",
     "note_autotune_trial", "note_bytes", "note_compile",
     "note_dispatch", "note_fused_fallback", "note_graph_passes",
     "note_lockcheck_violation", "note_nonfinite", "note_slo_breach",

@@ -561,13 +561,33 @@ def candidate_features(fn, args):
     per-config feature vector the learned cost model trains on.  → small
     dict or None on ANY problem (a candidate that can't report features
     still gets timed).  The extra compile is absorbed by the measurer's
-    warmup calls; only runs under the gate (caller-checked)."""
+    warmup calls; only runs under the gate (caller-checked).
+
+    ISSUE 18 widened the vector with two model features: ``compile_s``
+    (lower+compile wall seconds — compile cost is itself a latency the
+    ranker should know) and ``drift``, the count of Pallas kernels whose
+    DECLARED totals exceed the candidate's measured module totals inside
+    this trace's bracket (``crosscheck``) — a distrust signal that lets
+    the fit discount ledger rows backed by a drifted cost model.  The
+    bracket degrades to drift=0 when another lower overlaps (same
+    no-cross-attribution contract as compile rows)."""
+    tok = None
     try:
-        compiled = fn.lower(*args).compile()
+        t0 = time.perf_counter()
+        tok = open_trace_bracket()
+        lowered = fn.lower(*args)
+        declared = kernel_delta(tok)  # closes the bracket at trace end
+        tok = None
+        compiled = lowered.compile()
+        compile_s = time.perf_counter() - t0
         feat, _partial = extract(compiled)
         return {"flops": feat["flops"],
                 "bytes_accessed": feat["bytes_accessed"],
                 "temp_bytes": feat["temp_bytes"],
-                "peak_bytes": feat["peak_bytes"]}
+                "peak_bytes": feat["peak_bytes"],
+                "compile_s": round(compile_s, 4),
+                "drift": len(crosscheck(feat, declared))}
     except Exception:
         return None
+    finally:
+        close_trace_bracket(tok)

@@ -299,19 +299,47 @@ def note_aot_cache(kind, reason=None, tier="exec"):
                   ("tier",)).inc(tier=tier)
 
 
-def note_autotune_trial(kernel, seconds=None):
+def note_autotune_trial(kernel, seconds=None, failed=False):
     """Count one measured autotuning trial (autotune/measure.py, ISSUE 9):
     a candidate config built fresh and timed on-device.  A healthy warm
     winner store keeps this at zero across restarts — the persistence
-    acceptance test asserts exactly that."""
+    acceptance test asserts exactly that.  ``failed=True`` (ISSUE 18)
+    counts a candidate whose build/compile raised instead — sentinel-
+    scored by the measurer, excluded from the cost model's training set."""
     if not enabled():
         return
     r = registry()
+    if failed:
+        r.counter("autotune_failed_trials_total",
+                  "autotune candidates whose build/compile raised "
+                  "(sentinel-scored, excluded from the model training set)",
+                  ("kernel",)).inc(kernel=str(kernel))
+        r.event("autotune_trial_failed", kernel=str(kernel))
+        return
     r.counter("autotune_trials_total",
               "autotune candidate configs measured on-device",
               ("kernel",)).inc(kernel=str(kernel))
     r.event("autotune_trial", kernel=str(kernel),
             seconds=None if seconds is None else round(float(seconds), 6))
+
+
+def note_autotune_ranked(kernel, predicted, measured):
+    """Count one predict-then-measure search (autotune/search.py, ISSUE
+    18): ``predicted`` candidate configs were ranked by the learned cost
+    model, ``measured`` of them (default included) actually timed — the
+    difference is the measurement the model saved, surfaced as
+    ``trials_saved`` in :func:`summary`'s bench telemetry block."""
+    if not enabled():
+        return
+    r = registry()
+    r.counter("autotune_predicted_trials_total",
+              "candidate configs ranked by the learned cost model",
+              ("kernel",)).inc(int(predicted), kernel=str(kernel))
+    r.counter("autotune_measured_trials_total",
+              "candidates measured under predict-then-measure",
+              ("kernel",)).inc(int(measured), kernel=str(kernel))
+    r.event("autotune_ranked", kernel=str(kernel),
+            predicted=int(predicted), measured=int(measured))
 
 
 def note_autotune_cache(kind, kernel="?"):
@@ -668,6 +696,10 @@ def summary():
     # autotune surface (ISSUE 9): candidate configs measured this process —
     # null when no search ran (steady state: the winner store answers)
     at_trials = r.total("autotune_trials_total", None)
+    # predict-then-measure surface (ISSUE 18): measurements the learned
+    # cost model saved vs exhaustive grid — null when no ranked search ran
+    at_pred = r.total("autotune_predicted_trials_total", None)
+    at_meas = r.total("autotune_measured_trials_total", None)
     # serving latency surface (ISSUE 10): submit->reply quantiles from the
     # serve_latency_seconds histogram — null when no serving ran
     sp50 = r.hist_quantile("serve_latency_seconds", 0.50, None)
@@ -701,6 +733,8 @@ def summary():
             "pass_time_s": round(gp_s, 4) if gp_s is not None else None,
             "autotune_trials": int(at_trials) if at_trials is not None
             else None,
+            "trials_saved": max(0, int(at_pred - (at_meas or 0)))
+            if at_pred is not None else None,
             "serve_p50_ms": round(sp50 * 1e3, 3) if sp50 is not None
             else None,
             "serve_p99_ms": round(sp99 * 1e3, 3) if sp99 is not None

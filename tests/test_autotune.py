@@ -17,6 +17,7 @@ Coverage demanded by the issue:
 - the ``--gate-warmup`` / ``--prune-baseline`` tool satellites.
 """
 import json
+import math
 import os
 
 import numpy as np
@@ -24,8 +25,10 @@ import pytest
 
 import mxnet_tpu as mx  # noqa: F401  (conftest seeding imports it anyway)
 from mxnet_tpu import autotune
+from mxnet_tpu.autotune import costmodel as cm
 from mxnet_tpu.autotune import ladder as lt
 from mxnet_tpu.autotune import measure as ms
+from mxnet_tpu.autotune import space as sps
 from mxnet_tpu.autotune import store as st
 from mxnet_tpu.telemetry import instrument as tin
 
@@ -482,6 +485,60 @@ class TestCLI:
         assert at.main(["clear"]) == 0
         assert autotune.entries() == {}
 
+    def test_show_features_surface(self, at_on, capsys):
+        at = _load_tool("tools/autotune.py")
+        autotune.record(
+            "dconv_col_pallas", "N64-HW32-C16-i4", {"nblk": 64}, score=1e-4,
+            meta={"strategy": "grid", "grid": 5,
+                  "cost": {"flops": 3.0},
+                  "trial_costs": [{"config": {"nblk": 64}, "seconds": 1e-4,
+                                   "cost": {"flops": 3.0}}]})
+        assert at.main(["show"]) == 0
+        plain = capsys.readouterr().out
+        assert "cost:" not in plain and "trial rows:" not in plain
+        assert at.main(["show", "--features"]) == 0
+        out = capsys.readouterr().out
+        assert 'cost: {"flops": 3.0}' in out
+        assert "trial rows: 1 (strategy=grid, grid=5)" in out
+
+    def test_predict_strategy_in_process(self, at_on, monkeypatch, capsys):
+        """Grid-seed one shape under MXNET_COSTPLANE (the trial rows the
+        model trains on), then a predict search at a FRESH shape measures
+        only default + top-1 and surfaces trials_saved."""
+        monkeypatch.setenv("MXNET_COSTPLANE", "1")
+        at = _load_tool("tools/autotune.py")
+
+        def lines():
+            return [json.loads(l[len("AUTOTUNE "):])
+                    for l in capsys.readouterr().out.splitlines()
+                    if l.startswith("AUTOTUNE ")]
+
+        # two seeded shapes: the runner dedups by EFFECTIVE (N-capped)
+        # nblk, so N64 measures 2 configs and N96 measures 3 — 5 rows
+        for n in ("64", "96"):
+            assert at.main(["search", "--kernel", "dconv_col_pallas",
+                            "--n", n, "--h", "4", "--w", "8", "--c", "16",
+                            "--strategy", "grid",
+                            "--warmup", "0", "--repeat", "1"]) == 0
+            seeded = lines()[-1]
+            assert seeded["strategy"] == "grid" and not seeded["cached"]
+        from mxnet_tpu.autotune import costmodel as cmod
+
+        assert len(cmod.training_rows("dconv_col_pallas")) >= cmod.MIN_ROWS
+        assert at.main(["search", "--kernel", "dconv_col_pallas",
+                        "--n", "128", "--h", "4", "--w", "8", "--c", "16",
+                        "--strategy", "predict",
+                        "--top-k", "1", "--warmup", "0",
+                        "--repeat", "1"]) == 0
+        pred = lines()[-1]
+        assert pred["strategy"] == "predict"
+        assert pred["measurements"] == 2 and pred["grid"] == 3
+        assert pred["trials_saved"] == 1
+        # never-worse: a non-default winner strictly beat the default
+        default_cfg = autotune.get_space("dconv_col_pallas").default
+        assert pred["config"] == default_cfg \
+            or pred["best_s"] < pred["default_s"]
+
 
 # -- tool satellites ----------------------------------------------------------
 class TestToolSatellites:
@@ -539,6 +596,327 @@ class TestToolSatellites:
         assert lint.main([str(src), "--baseline", str(bl),
                           "--prune-baseline"]) == 0
         assert "no stale entries" in capsys.readouterr().out
+
+
+# -- learned cost model (ISSUE 18) --------------------------------------------
+def _synthetic_rows(sigs=(64, 128, 256), nblks=(32, 64, 128, 256)):
+    """Training rows whose latency grows with the block size at every
+    shape — any sane fit must rank small blocks first."""
+    rows = []
+    for n in sigs:
+        for nblk in nblks:
+            rows.append({"kernel": "k", "device_kind": "cpu",
+                         "sig": "N%d-HW32-C16-i4" % n,
+                         "config": {"nblk": nblk},
+                         "seconds": 1e-6 * nblk * (1.0 + n / 512.0),
+                         "cost": None})
+    return rows
+
+
+class TestCostModel:
+    def test_fit_ranks_monotone_cost(self):
+        m = cm.CostModel().fit(_synthetic_rows())
+        assert m.ready
+        ranked = m.rank("N128-HW32-C16-i4",
+                        [{"nblk": b} for b in (256, 32, 128, 64)])
+        assert [c["nblk"] for c in ranked] == [32, 64, 128, 256]
+
+    def test_transfer_to_unseen_shape(self):
+        """Shape-signature features carry the fit to a sig never searched:
+        the model still orders blocks by cost at N512."""
+        m = cm.CostModel().fit(_synthetic_rows(sigs=(64, 128, 256)))
+        unseen = "N512-HW32-C16-i4"
+        assert m.predict_one(unseen, {"nblk": 32}) \
+            < m.predict_one(unseen, {"nblk": 256})
+
+    def test_training_rows_filters_junk(self, at_on):
+        autotune.record("k", "N64-HW32-C16-i4", {"nblk": 64}, score=1e-4,
+                        meta={"trial_costs": [
+                            {"config": {"nblk": 64}, "seconds": 1e-4,
+                             "cost": {"flops": 2.0}},
+                            {"config": {"nblk": 32},
+                             "seconds": float("inf")},   # failed sentinel
+                            {"config": {"nblk": 16}, "seconds": -1.0},
+                            {"config": "junk", "seconds": 1e-4},
+                            "not-a-dict"]})
+        autotune.record("other", "sigY", {"x": 1}, meta={"trial_costs": [
+            {"config": {"x": 1}, "seconds": 2e-4}]})
+        rows = cm.training_rows("k")
+        assert len(rows) == 1
+        assert rows[0]["config"] == {"nblk": 64}
+        assert rows[0]["cost"] == {"flops": 2.0}
+        # no kernel filter: both kernels' usable rows
+        assert len(cm.training_rows()) == 2
+
+    def test_model_for_needs_min_rows(self, at_on):
+        autotune.record("k", "N64-HW32-C16-i4", {"nblk": 64}, meta={
+            "trial_costs": [{"config": {"nblk": b}, "seconds": 1e-6 * b}
+                            for b in (32, 64)]})
+        assert cm.model_for("k") is None  # 2 < MIN_ROWS
+        autotune.record("k", "N128-HW32-C16-i4", {"nblk": 64}, meta={
+            "trial_costs": [{"config": {"nblk": b}, "seconds": 2e-6 * b}
+                            for b in (32, 64, 128)]})
+        m = cm.model_for("k")
+        assert m is not None and m.ready
+
+    def test_default_top_k(self, monkeypatch):
+        monkeypatch.delenv("MXNET_AUTOTUNE_TOPK", raising=False)
+        assert cm.default_top_k(8) == 2
+        assert cm.default_top_k(3) == 1   # never zero
+        monkeypatch.setenv("MXNET_AUTOTUNE_TOPK", "3")
+        assert cm.default_top_k(100) == 3
+        monkeypatch.setenv("MXNET_AUTOTUNE_TOPK", "garbage")
+        assert cm.default_top_k(8) == 2   # unparsable = unset
+
+    def test_model_enabled_gate(self, monkeypatch):
+        monkeypatch.delenv("MXNET_AUTOTUNE_MODEL", raising=False)
+        assert cm.model_enabled()          # default ON (advisory)
+        monkeypatch.setenv("MXNET_AUTOTUNE_MODEL", "0")
+        assert not cm.model_enabled()
+
+
+class TestPredictThenMeasure:
+    def _space(self):
+        return autotune.TuningSpace("k", {"nblk": (32, 64, 128, 256)},
+                                    {"nblk": 128})
+
+    def test_default_first_and_measurement_budget(self):
+        measured = []
+
+        def measure(cfg):
+            measured.append(cfg["nblk"])
+            return 1e-6 * cfg["nblk"]
+
+        best, results, rep = autotune.predict_then_measure(
+            self._space(), measure, lambda c: 1e-6 * c["nblk"], top_k=1)
+        assert measured[0] == 128            # default, before any ranking
+        assert measured == [128, 32]         # + only the top-1 prediction
+        assert rep == {"candidates": 4, "measured": 2, "saved": 2}
+        assert best == {"nblk": 32}
+
+    def test_tie_keeps_default(self):
+        best, results, rep = autotune.predict_then_measure(
+            self._space(), lambda cfg: 1.0, lambda c: c["nblk"], top_k=3)
+        assert best == {"nblk": 128}
+        assert results[0]["config"] == {"nblk": 128}
+
+    def test_strictly_better_candidate_wins(self):
+        best, _, _ = autotune.predict_then_measure(
+            self._space(),
+            lambda cfg: 0.5 if cfg["nblk"] == 32 else 1.0,
+            lambda c: c["nblk"], top_k=1)
+        assert best == {"nblk": 32}
+
+    def test_failed_candidate_never_wins(self):
+        """A ranked candidate whose measurement comes back as the failed
+        sentinel (+inf) can never displace the measured default."""
+        best, results, _ = autotune.predict_then_measure(
+            self._space(),
+            lambda cfg: ms.FAILED_TRIAL if cfg["nblk"] != 128 else 1.0,
+            lambda c: c["nblk"], top_k=2)
+        assert best == {"nblk": 128}
+        assert sum(1 for r in results if math.isinf(r["seconds"])) == 2
+
+    def test_prediction_raise_ranks_last(self):
+        """predict() raising for one candidate must not kill the search —
+        that candidate ranks last and is simply not measured under a small
+        top_k."""
+        measured = []
+
+        def predict(cfg):
+            if cfg["nblk"] == 32:
+                raise RuntimeError("no features for this one")
+            return 1e-6 * cfg["nblk"]
+
+        def measure(cfg):
+            measured.append(cfg["nblk"])
+            return 1.0
+
+        best, _, rep = autotune.predict_then_measure(
+            self._space(), measure, predict, top_k=1)
+        assert 32 not in measured and rep["measured"] == 2
+        assert best == {"nblk": 128}
+
+    def test_counters_and_summary_surface(self, at_on, tel_enabled):
+        autotune.predict_then_measure(
+            self._space(), lambda cfg: 1e-6 * cfg["nblk"],
+            lambda c: c["nblk"], top_k=1)
+        assert _counter_total("autotune_predicted_trials_total",
+                              kernel="k") == 4
+        assert _counter_total("autotune_measured_trials_total",
+                              kernel="k") == 2
+        assert tin.summary()["trials_saved"] == 2
+
+
+class TestStoreFormatBump:
+    def test_format_is_v2(self):
+        # the ISSUE 18 bump: v2 entries guarantee the trial_costs schema
+        assert st._FORMAT == 2
+
+    def test_v1_entry_is_silent_miss_and_no_training_row(self, at_on):
+        autotune.record("k", "s", {"nblk": 64}, meta={"trial_costs": [
+            {"config": {"nblk": 64}, "seconds": 1e-4}]})
+        assert autotune.lookup("k", "s") == {"nblk": 64}
+        assert len(cm.training_rows("k")) == 1
+        with open(at_on) as f:
+            payload = json.load(f)
+        for ent in payload["entries"].values():
+            ent["env"]["format"] = 1   # "restart" onto a pre-v2 store
+        with open(at_on, "w") as f:
+            json.dump(payload, f)
+        st._reset_stats_for_tests()
+        assert autotune.lookup("k", "s") is None   # rejected, not crashed
+        assert autotune.stats()["errors"] == 1
+        assert cm.training_rows("k") == []         # model never sees v1 rows
+        # the re-search overwrites under the current format: whole again
+        autotune.record("k", "s", {"nblk": 32}, meta={"trial_costs": [
+            {"config": {"nblk": 32}, "seconds": 1e-4}]})
+        assert autotune.lookup("k", "s") == {"nblk": 32}
+        assert len(cm.training_rows("k")) == 1
+
+
+# -- the widened space registry (ISSUE 18) ------------------------------------
+class TestNewSpaces:
+    def test_nms_lane_alignment(self):
+        sp = autotune.get_space("nms_alive_pallas")
+        assert not sp.admits({"tile": 100}, N=512)   # not lane-aligned
+        assert sp.admits({"tile": 512}, N=512)
+        assert sp.default == {"tile": 256}
+
+    def test_nms_vmem_prunes_under_shrunk_budget(self, monkeypatch):
+        sp = autotune.get_space("nms_alive_pallas")
+        assert {c["tile"] for c in sp.configs(N=1024)} == {128, 256, 512,
+                                                           1024}
+        # a 4 MB budget rejects the 1024-tile's ~12.5 MB working set
+        monkeypatch.setenv("MXNET_DCONV_VMEM_MB", "4")
+        tiles = {c["tile"] for c in sp.configs(N=1024)}
+        assert 1024 not in tiles and {128, 256, 512} <= tiles
+
+    def test_abuild_vmem_prunes_big_blocks(self):
+        sp = autotune.get_space("psroi_abuild_pallas")
+        # big bin maps: 256 rois/step ≈ 151 MB backward working set
+        rbs = {c["rb"] for c in sp.configs(N=512, S=16, H=256, W=256,
+                                           itemsize=4)}
+        assert 256 not in rbs and 128 not in rbs
+        assert 16 in rbs and 32 in rbs
+        assert 64 in rbs   # the default is always admitted
+        # tiny bin maps admit the whole grid
+        assert len(sp.configs(N=512, S=4, H=7, W=7, itemsize=4)) == 5
+
+    def test_quant_constraint(self):
+        assert not sps._quant_constraint({"block": 0})
+        # uncapped huge block blows the budget...
+        assert not sps._quant_constraint({"block": 1 << 20})
+        # ...but the dispatch site caps at rows, so admission judges the
+        # EFFECTIVE block
+        assert sps._quant_constraint({"block": 1 << 20}, rows=256)
+
+    def test_fused_zero_pruned_off_mesh(self):
+        sp = autotune.get_space("fused_step_layout")
+        off = sp.configs(mesh=False)
+        assert all(c["zero"] == 0 for c in off) and len(off) == 4
+        on = sp.configs(mesh=True)
+        assert len(on) == 8
+        assert off[0] == on[0] == {"zero": 0, "prefetch": 2}  # default first
+
+
+# -- new kernel dispatch wiring (ISSUE 18) ------------------------------------
+class TestNewKernelWiring:
+    def test_off_path_never_reads_store(self, at_off, monkeypatch):
+        from mxnet_tpu.ops import pallas_kernels as pk
+
+        monkeypatch.setattr(st, "lookup",
+                            lambda *a, **k: pytest.fail("store read on the "
+                                                        "off path"))
+        assert pk._nms_tile(1, 512) == pk._NMS_TILE
+        assert pk._abuild_rb(96, 4, 7, 7, 4) == pk._ABUILD_RB
+        assert pk._quant_block("quantize_int8_pallas", 1024, 4, 1) == 512
+        assert pk._quant_block(None, 100, 4, 1) == 100  # un-keyed: rows cap
+
+    def test_nms_tile_adoption_and_revalidation(self, at_on, monkeypatch):
+        from mxnet_tpu.ops import pallas_kernels as pk
+
+        sig = autotune.nms_shape_sig(1, 1024)
+        autotune.record("nms_alive_pallas", sig, {"tile": 1024})
+        assert pk._nms_tile(1, 1024) == 1024
+        # a shrunk budget rejects the same persisted winner at trace time
+        monkeypatch.setenv("MXNET_DCONV_VMEM_MB", "4")
+        assert pk._nms_tile(1, 1024) == pk._NMS_TILE
+        monkeypatch.delenv("MXNET_DCONV_VMEM_MB")
+        # misaligned and malformed winners keep the default
+        autotune.record("nms_alive_pallas", sig, {"tile": 100})
+        assert pk._nms_tile(1, 1024) == pk._NMS_TILE
+        autotune.record("nms_alive_pallas", sig, {"tile": "garbage"})
+        assert pk._nms_tile(1, 1024) == pk._NMS_TILE
+
+    def test_abuild_rb_adoption_caps_at_n(self, at_on):
+        from mxnet_tpu.ops import pallas_kernels as pk
+
+        autotune.record("psroi_abuild_pallas",
+                        autotune.psroi_shape_sig(256, 4, 7, 7, 4),
+                        {"rb": 128})
+        assert pk._abuild_rb(256, 4, 7, 7, 4) == 128
+        autotune.record("psroi_abuild_pallas",
+                        autotune.psroi_shape_sig(96, 4, 7, 7, 4),
+                        {"rb": 128})
+        assert pk._abuild_rb(96, 4, 7, 7, 4) == 96   # effective block
+        autotune.record("psroi_abuild_pallas",
+                        autotune.psroi_shape_sig(96, 4, 7, 7, 4),
+                        {"rb": "garbage"})
+        assert pk._abuild_rb(96, 4, 7, 7, 4) == pk._ABUILD_RB
+
+    def test_quant_block_adoption(self, at_on):
+        from mxnet_tpu.ops import pallas_kernels as pk
+
+        sig = autotune.quant_shape_sig(1024, 4)
+        autotune.record("quantize_int8_pallas", sig, {"block": 256})
+        assert pk._quant_block("quantize_int8_pallas", 1024, 4, 1) == 256
+        autotune.record("quantize_int8_pallas", sig, {"block": -8})
+        assert pk._quant_block("quantize_int8_pallas", 1024, 4, 1) == 512
+
+    def test_quantize_parity_across_blocks(self, at_on):
+        """A tuned row block changes the grid, never the values — and the
+        module-level jit wrapper's cache is cleared so each pin actually
+        retraces (the CLI runner depends on the same idiom)."""
+        import jax.numpy as jnp
+
+        from mxnet_tpu.ops import pallas_kernels as pk
+
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(16, 128).astype(np.float32))
+        got = {}
+        for blk in (2, 16):
+            with autotune.override("quantize_int8_pallas", {"block": blk}):
+                pk.quantize_int8_pallas.clear_cache()
+                q = np.asarray(pk.quantize_int8_pallas(x, 4.0,
+                                                       interpret=True))
+            with autotune.override("dequantize_int8_pallas",
+                                   {"block": blk}):
+                pk.dequantize_int8_pallas.clear_cache()
+                d = np.asarray(pk.dequantize_int8_pallas(
+                    jnp.asarray(q), 4.0, interpret=True))
+            got[blk] = (q, d)
+        pk.quantize_int8_pallas.clear_cache()
+        pk.dequantize_int8_pallas.clear_cache()
+        np.testing.assert_array_equal(got[2][0], got[16][0])
+        np.testing.assert_allclose(got[2][1], got[16][1], rtol=0, atol=0)
+
+    def test_failed_trial_sentinel(self, at_on, tel_enabled):
+        """A candidate whose build raises is a FAILED trial, not a search
+        abort: +inf sentinel, its own counter, no timing counted, and its
+        cost features scrubbed so the model never trains on it."""
+        def bad_build():
+            raise RuntimeError("mosaic said no")
+
+        before = autotune.measurements()
+        t = autotune.measure_candidate("k", {"nblk": 1}, bad_build, (),
+                                       warmup=0, repeat=1)
+        assert t == ms.FAILED_TRIAL and math.isinf(t)
+        assert autotune.measurements() == before     # not a counted timing
+        assert ms.failed_measurements() == 1
+        assert _counter_total("autotune_failed_trials_total",
+                              kernel="k") == 1
+        assert ms.features_for("k", {"nblk": 1}) is None
 
 
 # -- serving bucket stats (ISSUE 9 satellite) ---------------------------------

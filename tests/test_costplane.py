@@ -431,8 +431,11 @@ def test_measure_candidate_features(monkeypatch):
                               warmup=1, repeat=1)
     feats = measure.features_for("cp_test_kernel", cfg)
     assert feats is not None and feats["flops"] > 0
+    # ISSUE 18 widened the trial feature vector: compile_s and the
+    # declared-vs-measured drift count feed the learned cost model
     assert set(feats) == {"flops", "bytes_accessed", "temp_bytes",
-                          "peak_bytes"}
+                          "peak_bytes", "compile_s", "drift"}
+    assert feats["compile_s"] >= 0 and feats["drift"] >= 0
     assert measure.measurements() == 2
     measure._reset_stats_for_tests()
 
