@@ -44,13 +44,18 @@ TEL_REQ_KEYS = {"compile_s", "peak_hbm_bytes", "data_wait_frac"}
 # trials_saved (ISSUE 18 learned autotuning): measurements the cost model
 # skipped under predict-then-measure (ranked minus measured candidates) —
 # null when no ranked search ran this process
+# pod (ISSUE 19 pod observability plane): rank-0 aggregator rollup for a
+# multichip run — {ranks, max_step_lag, ledger_divergences, incidents},
+# all non-negative ints; null/absent when MXNET_POD_METRICS is off or the
+# benched process was not the aggregating rank
 TEL_OPT_KEYS = {"dispatches_per_step", "warmup_s",
                 "graph_nodes_pre", "graph_nodes_post", "pass_time_s",
                 "autotune_trials", "trials_saved",
                 "serve_p50_ms", "serve_p99_ms",
                 "analysis_findings", "trainhealth_drain_s",
-                "xla_flops", "xla_peak_bytes"}
+                "xla_flops", "xla_peak_bytes", "pod"}
 TEL_KEYS = TEL_REQ_KEYS | TEL_OPT_KEYS
+POD_KEYS = {"ranks", "max_step_lag", "ledger_divergences", "incidents"}
 
 # SERVE_BENCH line (tools/loadgen.py, ISSUE 2) — docs/SERVING.md schema
 SERVE_PREFIX = "SERVE_BENCH "
@@ -239,6 +244,26 @@ def validate_line(obj, where="<line>"):
             raise SchemaError(
                 "%s: telemetry serve p99 below p50 — percentiles swapped?"
                 % where)
+        pod = tel.get("pod")
+        if pod is not None:
+            if not isinstance(pod, dict):
+                raise SchemaError(
+                    "%s: telemetry.pod must be an object or null" % where)
+            unknown_pod = set(pod) - POD_KEYS
+            if unknown_pod:
+                raise SchemaError(
+                    "%s: unknown telemetry.pod keys %s (schema: %s)"
+                    % (where, sorted(unknown_pod), sorted(POD_KEYS)))
+            for k, pv in pod.items():
+                if not isinstance(pv, int) or isinstance(pv, bool) \
+                        or pv < 0:
+                    raise SchemaError(
+                        "%s: telemetry.pod.%s must be a non-negative int"
+                        % (where, k))
+            if "ranks" in pod and pod["ranks"] < 1:
+                raise SchemaError(
+                    "%s: telemetry.pod.ranks must be >= 1 (an aggregator "
+                    "always counts itself)" % where)
 
 
 def validate_serve_line(obj, where="<line>"):
@@ -505,6 +530,15 @@ def self_test():
         {"metric": "m", "value": 1, "unit": "samples/s", "tier": "fp32"},
         {"metric": "m", "value": 1, "unit": "samples/s", "tier": "bf16"},
         {"metric": "m", "value": 1, "unit": "samples/s", "tier": "int8"},
+        # ISSUE 19 pod observability: aggregator rollup on multichip rows
+        {"metric": "m", "value": 1, "unit": "samples/s",
+         "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0,
+                       "pod": {"ranks": 2, "max_step_lag": 3,
+                               "ledger_divergences": 0, "incidents": 1}}},
+        {"metric": "m", "value": 1, "unit": "samples/s",
+         "telemetry": {"compile_s": 0.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0, "pod": None}},
     ]
     bad = [
         {},                                                  # empty
@@ -577,6 +611,33 @@ def self_test():
          "tier": "fp16"},                                # unknown tier
         {"metric": "m", "value": 1, "unit": "img/s",
          "tier": None},                                  # null tier (omit it)
+        # ISSUE 19 pod block
+        {"metric": "m", "value": 1, "unit": "img/s",
+         "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0,
+                       "pod": {"ranks": 2.5}}},          # float ranks
+        {"metric": "m", "value": 1, "unit": "img/s",
+         "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0,
+                       "pod": {"ranks": 2,
+                               "ledger_divergences": -1}}},  # negative
+        {"metric": "m", "value": 1, "unit": "img/s",
+         "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0,
+                       "pod": {"ranks": 2, "bogus": 1}}},  # unknown key
+        {"metric": "m", "value": 1, "unit": "img/s",
+         "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0,
+                       "pod": {"ranks": 0}}},            # rankless pod
+        {"metric": "m", "value": 1, "unit": "img/s",
+         "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0,
+                       "pod": {"ranks": 2,
+                               "incidents": True}}},     # bool counter
+        {"metric": "m", "value": 1, "unit": "img/s",
+         "telemetry": {"compile_s": 1.0, "peak_hbm_bytes": None,
+                       "data_wait_frac": 0.0,
+                       "pod": [2]}},                     # wrong type
     ]
     serve_good = {"mode": "closed", "requests": 10, "completed": 9,
                   "shed": 1, "timeouts": 0, "errors": 0, "shed_rate": 0.1,

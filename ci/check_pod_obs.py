@@ -1,0 +1,273 @@
+#!/usr/bin/env python
+"""CI smoke for the pod observability plane (ISSUE 19).
+
+Two phases, exit 0 only when both pass — wired into the unit tier of
+``ci/run_tests.sh``:
+
+1. **Off path clean.**  With ``MXNET_POD_METRICS`` unset (telemetry ON,
+   so the registry is live and would show any leak), a Module fit run
+   creates no plane, no listener thread, no socket, no ``pod_*`` metric
+   series, and ``podz()`` answers ``{"enabled": false}`` — the `is None`
+   zero-overhead contract.
+2. **2-process pod smoke.**  A real ``tools/launch.py -n 2 --launcher
+   local`` fake cluster over ``jax.distributed`` (Gloo handshake only —
+   the pod channel is podplane's own socket, so the CPU backend's
+   missing collectives don't matter): both ranks fit a tiny module;
+   rank 0's ``/podz`` HTTP endpoint must show BOTH ranks' series; a
+   seeded per-rank ledger fingerprint mismatch must trip the divergence
+   counter with correlated (same incident id) flight-recorder dumps on
+   both ranks; and a frozen rank 1 must raise a straggler verdict on
+   rank 0.  The parent then runs ``tools/pod_status.py --collect`` over
+   the two per-rank dump dirs and requires one merged incident timeline,
+   and checks every worker stdout line carries its ``[rank N]`` prefix.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORK = "/tmp/pod_obs_smoke"
+
+WORKER = textwrap.dedent("""
+    import glob, json, os, sys, time, urllib.request
+
+    rank = int(os.environ["MXNET_WORKER_RANK"])
+    base = os.environ["POD_SMOKE_DIR"]
+    os.environ["MXNET_FLIGHTREC_DIR"] = os.path.join(base, "frec_r%d" % rank)
+    os.environ["MXNET_TELEMETRY_FILE"] = os.path.join(
+        base, "tel_r%d.jsonl" % rank)
+    if rank == 0:
+        os.environ["MXNET_OPS_PORT"] = "0"
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import module as mod_mod
+    from mxnet_tpu.io import NDArrayIter
+    from mxnet_tpu.parallel import dist
+    from mxnet_tpu.telemetry import flightrec, ops_server, podplane
+
+    dist.init()
+    assert dist.size() == 2, dist.size()
+
+    pod = podplane.plane()
+    assert pod is not None and pod.rank == rank and pod.size == 2
+    flightrec.record("smoke_warm", rank=rank)  # non-empty ring can dump
+    # seeded fingerprint mismatch: same stable key, different flops — the
+    # divergence detector's job is to notice without a real compile skew
+    pod.seed_ledger("smoke#fwd", flops=1000 * (rank + 1),
+                    bytes_accessed=4096, compile_s=0.1)
+
+    data = mx.sym.var("data")
+    sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=4), name="softmax")
+    mod = mod_mod.Module(sym)
+    rng = np.random.RandomState(rank)
+    it = NDArrayIter(rng.randn(64, 8).astype(np.float32),
+                     rng.randint(0, 4, (64,)).astype(np.float32),
+                     batch_size=8)
+    mod.fit(it, num_epoch=2, optimizer_params={"learning_rate": 0.1})
+
+    if rank == 0:
+        # keep ticking (so rank 0 never reads stale to itself and its
+        # _observe_incidents runs) while waiting for rank 1's pushes
+        deadline = time.monotonic() + 120.0
+        pz = pod.podz()
+        while time.monotonic() < deadline and not (
+                pz["ranks_reporting"] == 2
+                and pz["ledger_divergence_count"] >= 1):
+            pod.tick()
+            time.sleep(0.2)
+            pz = pod.podz()
+        assert pz["ranks_reporting"] == 2, pz
+        assert pz["ledger_divergence_count"] == 1, pz
+        d = pz["ledger_divergences"]["smoke#fwd"]
+        assert sorted(d["ranks"]) == [0, 1], d
+        # both ranks' step series on the aggregated view
+        assert pz["ranks"]["0"]["steps"] == 16
+        assert pz["ranks"]["1"]["steps"] >= 1
+        assert pz["ranks"]["1"]["step_p50_ms"] is not None
+        # ...and over the REAL ops endpoint
+        port = ops_server.port()
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d/podz" % port, timeout=10) as r:
+            over_http = json.loads(r.read())
+        assert set(over_http["ranks"]) == {"0", "1"}, over_http
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d/metrics" % port, timeout=10) as r:
+            metrics = r.read().decode()
+        assert "pod_ledger_divergence_total" in metrics
+        assert 'pod_' in metrics and 'rank="1"' in metrics, \\
+            "no rank-labeled mirrored series on /metrics"
+        # straggler: rank 1 goes quiet (it is sleeping through its
+        # freeze); with MXNET_POD_STRAGGLER_AGE_S=1 the verdict must
+        # flip within a few scrapes
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline \\
+                and not pod.podz()["ranks"]["1"]["straggler"]:
+            pod.tick()
+            time.sleep(0.2)
+        pz = pod.podz()
+        assert pz["ranks"]["1"]["straggler"] is True, pz["ranks"]["1"]
+        assert pz["straggler_verdicts"] >= 1
+        assert "pod_straggler_verdicts_total" in urllib.request.urlopen(
+            "http://127.0.0.1:%d/metrics" % port, timeout=10).read().decode()
+        # the divergence detail dump exists on the aggregating rank
+        assert glob.glob(os.path.join(
+            base, "frec_r0", "*pod_ledger_divergence*.json"))
+        print("RANK0_RESULT ok", flush=True)
+    else:
+        # wait for the incident broadcast (the divergence incident rides
+        # a push response), then freeze so rank 0 sees a straggler
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline \\
+                and pod.push_stats()["incidents_seen"] < 1:
+            pod.tick()
+            time.sleep(0.1)
+        assert pod.push_stats()["incidents_seen"] >= 1, pod.push_stats()
+        dumps = glob.glob(os.path.join(base, "frec_r1",
+                                       "*pod_incident*.json"))
+        assert dumps, "no incident-tagged dump on rank 1"
+        time.sleep(6.0)  # frozen: no pushes -> rank 0's straggler signal
+        print("RANK1_RESULT ok", flush=True)
+    dist.shutdown()
+""")
+
+
+def check_off_path():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["MXNET_TELEMETRY"] = "1"
+    os.environ["MXNET_TELEMETRY_FILE"] = os.path.join(WORK, "off.jsonl")
+    os.environ.pop("MXNET_POD_METRICS", None)
+    os.environ.pop("MXNET_POD_METRICS_ADDR", None)
+
+    import threading
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import module as mod_mod
+    from mxnet_tpu.io import NDArrayIter
+    from mxnet_tpu.telemetry import instrument as tin
+    from mxnet_tpu.telemetry import podplane
+
+    threads_before = {t.name for t in threading.enumerate()}
+    assert podplane.plane() is None
+    assert podplane.podz() == {"enabled": False}
+    assert podplane.status() is None
+
+    data = mx.sym.var("data")
+    sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=4), name="softmax")
+    mod = mod_mod.Module(sym)
+    rng = np.random.RandomState(0)
+    it = NDArrayIter(rng.randn(16, 8).astype(np.float32),
+                     rng.randint(0, 4, (16,)).astype(np.float32),
+                     batch_size=8)
+    mod.fit(it, num_epoch=1, optimizer_params={"learning_rate": 0.1})
+
+    assert podplane.plane() is None
+    names = [m["name"] for m in tin.registry().collect()]
+    polluted = [n for n in names if n.startswith("pod_")]
+    assert not polluted, "off path leaked pod series: %s" % polluted
+    new_threads = {t.name for t in threading.enumerate()} - threads_before
+    assert not any("pod" in n for n in new_threads), new_threads
+    print("off path: no plane, no thread, no pod_* series — ok")
+
+
+def check_two_process():
+    shutil.rmtree(WORK, ignore_errors=True)
+    os.makedirs(WORK, exist_ok=True)
+    worker = os.path.join(WORK, "worker.py")
+    with open(worker, "w") as f:
+        f.write(WORKER)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    pod_port = s.getsockname()[1]
+    s.close()
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=REPO,
+        POD_SMOKE_DIR=WORK,
+        MXNET_POD_METRICS="1",
+        # explicit channel addr: the coordinator-derived default port
+        # could collide on a shared CI host
+        MXNET_POD_METRICS_ADDR="127.0.0.1:%d" % pod_port,
+        MXNET_POD_PUSH_S="0",           # push every step
+        MXNET_POD_STRAGGLER_AGE_S="1",  # freeze detected in ~1 s
+        MXNET_TELEMETRY="1",
+    )
+    env.pop("MXNET_OPS_PORT", None)      # rank 0 sets its own
+    env.pop("MXNET_FLIGHTREC_DIR", None)  # per-rank, set by the worker
+    launch = os.path.join(REPO, "tools", "launch.py")
+    # Gloo inter-process connects can time out on a saturated host —
+    # retry like tests/test_launch_dist.py
+    for attempt in range(3):
+        res = subprocess.run(
+            [sys.executable, launch, "-n", "2", "--launcher", "local",
+             sys.executable, worker],
+            env=env, capture_output=True, text=True, timeout=420)
+        if res.returncode == 0:
+            break
+    assert res.returncode == 0, res.stdout + res.stderr
+    out = res.stdout
+    assert "RANK0_RESULT ok" in out, out
+    assert "RANK1_RESULT ok" in out, out
+    # launcher satellite: every worker line is rank-attributable
+    assert any(line.startswith("[rank 0] ") for line in out.splitlines())
+    assert any(line.startswith("[rank 1] ") for line in out.splitlines())
+    print("2-process: /podz both ranks, divergence + straggler — ok")
+
+    # correlated dumps: one shared incident id across BOTH rank dirs
+    def _ids(rankdir):
+        ids = set()
+        for p in glob.glob(os.path.join(WORK, rankdir, "*.json")):
+            meta = json.load(open(p)).get("flightrec") or {}
+            if meta.get("incident"):
+                ids.add(meta["incident"])
+        return ids
+
+    shared = _ids("frec_r0") & _ids("frec_r1")
+    assert shared, "no shared incident id across rank dumps"
+    print("correlated incident dumps on both ranks: %s — ok"
+          % sorted(shared))
+
+    # pod_status --collect merges the correlated dumps onto one timeline
+    merged_dir = os.path.join(WORK, "merged")
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "pod_status.py"),
+         "--collect", os.path.join(WORK, "frec_r0"),
+         os.path.join(WORK, "frec_r1"), "-o", merged_dir],
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stdout + res.stderr
+    merged = glob.glob(os.path.join(merged_dir, "*.json"))
+    assert merged, res.stdout
+    evs = json.load(open(merged[0]))["traceEvents"]
+    ranks = {e.get("args", {}).get("rank") for e in evs
+             if e.get("ph") != "M"}
+    assert {0, 1} <= ranks, ranks
+    print("pod_status --collect merged %d incident timeline(s) — ok"
+          % len(merged))
+
+
+def main():
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    shutil.rmtree(WORK, ignore_errors=True)
+    os.makedirs(WORK, exist_ok=True)
+    check_two_process()  # subprocesses first: the off-path phase imports
+    check_off_path()     # jax into THIS process, harmless after
+    print("check_pod_obs: all phases passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

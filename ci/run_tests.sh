@@ -117,6 +117,13 @@ case "$tier" in
     # and label downgraded replies with the serving tier; whole run under
     # MXNET_LOCKCHECK=1 with zero violations
     ./dev.sh python ci/check_router.py
+    # pod observability smoke (ISSUE 19): MXNET_POD_METRICS unset leaves
+    # the fit loop with no plane/thread/socket and no pod_* series; a
+    # 2-process launch.py cluster must aggregate both ranks on /podz,
+    # trip the ledger-divergence detector on a seeded fingerprint
+    # mismatch with correlated (shared incident id) flightrec dumps on
+    # both ranks, and raise a straggler verdict when rank 1 freezes
+    ./dev.sh python ci/check_pod_obs.py
     # telemetry unit tests (tests/test_telemetry.py) run as part of tests/
     ignore=()
     for f in "${NIGHTLY_FILES[@]}"; do ignore+=(--ignore "$f"); done

@@ -242,6 +242,10 @@ class BaseModule:
         # metric read has already synced the dispatch, so the drain adds
         # no device round trip.  Gate unset = one env read here, None.
         health = telemetry.trainhealth.plane()
+        # pod observability plane (ISSUE 19, MXNET_POD_METRICS): each
+        # batch feeds the rank's mergeable step histogram and (throttled)
+        # pushes a snapshot to rank 0.  Gate unset = one env read, None.
+        pod = telemetry.podplane.plane()
 
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
@@ -256,7 +260,8 @@ class BaseModule:
             while not end_of_batch:
                 data_batch = next_data_batch
                 t_batch = (time.perf_counter()
-                           if probe or frec is not None else 0.0)
+                           if probe or frec is not None
+                           or pod is not None else 0.0)
                 if monitor is not None:
                     monitor.tic()
                 # span tracing (MXNET_TRACE): each batch is its own sampled
@@ -301,6 +306,8 @@ class BaseModule:
                                 epoch=epoch, step=nbatch)
                 if health is not None:
                     health.drain(self, epoch=epoch, step=nbatch)
+                if pod is not None:
+                    pod.note_step(time.perf_counter() - t_batch)
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:

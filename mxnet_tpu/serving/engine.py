@@ -519,8 +519,21 @@ class Engine:
         for r in traced:
             r._trace_queue.finish(bucket=label)
         t0 = time.perf_counter()
+        # heartbeat on dispatch ENTRY (not only exit): a single forward
+        # longer than MXNET_OPS_STALE_S otherwise leaves the last beat at
+        # the previous batch and /healthz flaps 503 mid-forward
+        self._beat()
         with batch_sp:
-            pred, fresh = self._predictor_for(bucket)
+            # busy across the cold-bucket predictor build/compile: a
+            # first-request bind + XLA compile routinely exceeds the stale
+            # threshold and runs OUTSIDE the device mutex, so without this
+            # marker it reads as dead.  Cleared before the mutex wait — a
+            # loop frozen waiting on _device_mu must still read stale.
+            self._busy_since = time.monotonic()
+            try:
+                pred, fresh = self._predictor_for(bucket)
+            finally:
+                self._busy_since = None
             try:
                 with tracing.span("assemble"):
                     arrays = self._assemble(reqs, bucket)

@@ -20,6 +20,7 @@ from . import flightrec, ops_server, slo  # live ops plane (ISSUE 10)
 from . import trainhealth  # training health plane (ISSUE 12)
 from . import costplane  # compile plane (ISSUE 13)
 from . import qualityplane  # inference quality plane (ISSUE 16)
+from . import podplane  # pod observability plane (ISSUE 19)
 from .sinks import (JsonlSink, PrometheusSink, ProfilerSink, Sink,
                     TensorBoardSink, iter_scalar_samples, render_prometheus)
 from .instrument import (RouterProbe, ServeProbe, StepProbe, add_sink,
@@ -38,7 +39,7 @@ from .instrument import (RouterProbe, ServeProbe, StepProbe, add_sink,
 
 __all__ = [
     "tracing", "flightrec", "ops_server", "slo", "trainhealth", "costplane",
-    "qualityplane",
+    "qualityplane", "podplane",
     "Counter", "Gauge", "Histogram", "MetricError", "Registry",
     "DEFAULT_BUCKETS",
     "Sink", "JsonlSink", "PrometheusSink", "ProfilerSink", "TensorBoardSink",

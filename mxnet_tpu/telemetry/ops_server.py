@@ -21,6 +21,10 @@ Endpoints:
   dispatch" marker inside the device mutex, so staleness only condemns a
   loop that is neither beating nor executing (frozen), not one that is
   slow (ISSUE 16 satellite — the PR 10 flapping caveat, fixed).
+* ``/podz``     — JSON: the pod observability plane (ISSUE 19) —
+  per-rank snapshot table, fleet rollup, ledger divergences, and
+  incident history on the aggregating rank; pusher status on other
+  ranks; ``{"enabled": false}`` when ``MXNET_POD_METRICS`` is off.
 * ``/statusz``  — JSON: per-engine ``Engine.stats()`` (SLO + warmup +
   bucket_stats blocks included), health detail, the training-health block
   (``trainhealth.status()`` — last drained row + per-rank heartbeats,
@@ -291,7 +295,7 @@ def _health():
 
 
 def _statusz():
-    from . import costplane, instrument, qualityplane, trainhealth
+    from . import costplane, instrument, podplane, qualityplane, trainhealth
 
     engines = {}
     for e in _live_engines():
@@ -337,10 +341,16 @@ def _statusz():
         qp = qualityplane.status()
     except Exception as ex:
         qp = {"error": repr(ex)}
+    try:
+        # pod observability plane (ISSUE 19): push/aggregation summary;
+        # None when MXNET_POD_METRICS is off (full view lives at /podz)
+        pp = podplane.status()
+    except Exception as ex:
+        pp = {"error": repr(ex)}
     return {"pid": os.getpid(), "unix_ts": round(time.time(), 6),
             "telemetry_enabled": instrument.enabled(),
             "health": health, "engines": engines, "routers": routers,
-            "trainhealth": th, "costplane": cp, "quality": qp}
+            "trainhealth": th, "costplane": cp, "quality": qp, "pod": pp}
 
 
 # -- handler ------------------------------------------------------------------
@@ -375,10 +385,21 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/statusz":
                 self._send(200, json.dumps(_statusz(), default=str) + "\n",
                            "application/json")
+            elif path == "/podz":
+                # pod observability plane (ISSUE 19): per-rank table +
+                # fleet rollup on rank 0, pusher status elsewhere,
+                # {"enabled": false} when MXNET_POD_METRICS is off — the
+                # path stays routable so probing a non-pod process gets
+                # an answer, not a 404
+                from . import podplane
+
+                self._send(200, json.dumps(podplane.podz(), default=str)
+                           + "\n", "application/json")
             else:
                 self._send(404, json.dumps(
                     {"error": "unknown path %r" % path,
-                     "endpoints": ["/metrics", "/healthz", "/statusz"]})
+                     "endpoints": ["/metrics", "/healthz", "/statusz",
+                                   "/podz"]})
                     + "\n", "application/json")
         except BrokenPipeError:
             pass  # client went away mid-write

@@ -1,0 +1,964 @@
+"""Pod observability plane — cross-rank metric aggregation, ledger-
+divergence detection, and fleet-wide incident correlation (ISSUE 19).
+
+Every observability plane before this one (PR 1 registry, PR 4 tracing,
+PR 10 ops server/SLO/flightrec, PR 12 health plane, PR 13 costplane) is
+process-local: rank 0 sees its own registry plus two lag gauges.  Under
+``MXNET_POD_METRICS=1`` on a ``jax.distributed``-initialized pod this
+module crosses the process boundary:
+
+* every non-zero rank periodically **pushes a compact snapshot** — the
+  registry's counters/gauges, a mergeable log-bucketed step-latency
+  histogram (the ``slo.py`` sub-histogram encoding, so quantiles merge
+  EXACTLY by vector addition), the ``/healthz`` verdict, the freshest
+  engine heartbeat age, the flight-recorder arm state, and the costplane
+  ledger's per-stable-key cost fingerprints (flops / bytes / compile
+  seconds per ``row_key``) — over one persistent stdlib-socket line
+  protocol to rank 0 (``MXNET_POD_METRICS_ADDR``; default derived from
+  ``MXNET_COORDINATOR`` host at coordinator-port + 1000).  Push failures
+  count into ``pod_push_failures_total`` and degrade — a dead aggregator
+  never blocks or fails a training step (the JsonlSink stance).
+* **rank 0 aggregates**: pushed counters/gauges become rank-labeled
+  ``pod_*`` gauge series on the existing registry, the per-rank state
+  feeds a new ``/podz`` ops-server endpoint (per-rank table + fleet
+  rollup + skew stats), a **ledger-divergence detector** fires when two
+  ranks report different cost fingerprints for the SAME stable key
+  (``pod_ledger_divergence_total`` + a flight-recorder dump naming the
+  key and both ranks — ROADMAP item 2's "prove every rank compiled the
+  same program"), and **straggler verdicts** are emitted as
+  edge-triggered events with hysteresis when a rank's step lag or push
+  age crosses ``MXNET_POD_STRAGGLER_LAG`` / ``MXNET_POD_STRAGGLER_AGE_S``
+  (signal only — the checkpoint-and-rejoin policy stays item 2's work).
+* **incident correlation**: a pushed SLO-breach increase, a nonfinite
+  census hit, a ledger divergence, or a push-detected rank death mints a
+  shared incident id on rank 0; the id rides every push *response* back
+  to the fleet, and each rank tags a flight-recorder dump with it —
+  ``tools/pod_status.py`` collects and merges those dumps onto one
+  timeline via the ``trace_merge`` clock-sync machinery.
+
+Stale snapshots are dropped, not merged: each pusher carries a process
+*incarnation* epoch plus a monotonic sequence number, so a restarted rank
+supersedes its old series and a late out-of-order push from the previous
+incarnation counts into ``pod_snapshots_stale_total`` instead of
+clobbering fresh state.
+
+Gating: :func:`plane` returns None when ``MXNET_POD_METRICS`` is unset —
+call sites keep one ``is None`` check, no socket and no thread exist, and
+the fit-loop step path is byte-identical (the PR 1/4/10 zero-overhead
+contract, tested in ``tests/test_podplane.py``).  Pushes happen inline
+from ``note_step`` under a throttle (``MXNET_POD_PUSH_S``) — the
+trainhealth heartbeat discipline; no background pusher thread exists, so
+a rank wedged mid-step stops pushing, which is exactly the straggler /
+death signal rank 0 is listening for.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+
+from ..base import env_flag
+from .slo import NBUCKETS, WindowedQuantile, quantile_of_counts
+
+__all__ = ["enabled", "push_interval_s", "straggler_lag_steps",
+           "straggler_age_s", "death_age_s", "pod_addr", "build_snapshot",
+           "Aggregator", "PodPlane", "plane", "podz", "status",
+           "PROTOCOL_V"]
+
+PROTOCOL_V = 1
+MAX_LINE_BYTES = 4 << 20    # one pushed snapshot line; larger is dropped
+MAX_MIRROR_SERIES = 512     # registry series mirrored per rank (cap)
+MAX_INCIDENTS = 64          # bounded incident history on rank 0
+INCIDENT_BROADCAST = 8      # most recent ids carried per push response
+SOCK_TIMEOUT_S = 2.0        # connect/send/recv bound for one push
+MIN_INCIDENT_S = 30.0       # per (rank, reason) mint throttle
+
+
+def enabled():
+    """``MXNET_POD_METRICS`` gate (docs/ENV_VARS.md) — default OFF."""
+    return env_flag("MXNET_POD_METRICS")
+
+
+def push_interval_s():
+    """Seconds between snapshot pushes (``MXNET_POD_PUSH_S``, default 5).
+    ``0`` pushes on every ``note_step`` (tests/CI)."""
+    try:
+        v = float(os.environ.get("MXNET_POD_PUSH_S", "5"))
+    except ValueError:
+        return 5.0
+    return v if v >= 0 else 5.0
+
+
+def straggler_lag_steps():
+    """Step-lag threshold for the straggler verdict
+    (``MXNET_POD_STRAGGLER_LAG``, default 50 steps behind the fleet
+    head).  Recovery requires dropping below HALF this (hysteresis) so a
+    rank oscillating at the threshold emits one verdict, not a storm."""
+    try:
+        v = int(os.environ.get("MXNET_POD_STRAGGLER_LAG", "50"))
+    except ValueError:
+        return 50
+    return v if v > 0 else 50
+
+
+def straggler_age_s():
+    """Push-age threshold for the straggler verdict
+    (``MXNET_POD_STRAGGLER_AGE_S``; default ``max(15, 3 x push
+    interval)`` so a healthy pusher can never trip it on cadence alone).
+    Recovery threshold is half (hysteresis)."""
+    raw = os.environ.get("MXNET_POD_STRAGGLER_AGE_S", "").strip()
+    if raw:
+        try:
+            v = float(raw)
+            if v > 0:
+                return v
+        except ValueError:
+            pass
+    return max(15.0, 3.0 * push_interval_s())
+
+
+def death_age_s():
+    """Push age past which a previously-pushing rank is presumed dead
+    (mints a ``rank_death`` incident): 3x the straggler age threshold."""
+    return 3.0 * straggler_age_s()
+
+
+def pod_addr():
+    """(host, port) of the rank-0 aggregation channel, or None.
+
+    ``MXNET_POD_METRICS_ADDR`` (``host:port``) wins; otherwise derived
+    from ``MXNET_COORDINATOR`` — the coordinator host (process 0's, which
+    is also where the aggregator lives) at coordinator-port + 1000.  A
+    malformed value returns None: the plane then runs without a channel
+    (rank 0 still aggregates itself; pushers count failures)."""
+    raw = os.environ.get("MXNET_POD_METRICS_ADDR", "").strip()
+    if not raw:
+        coord = os.environ.get("MXNET_COORDINATOR", "").strip()
+        if not coord or ":" not in coord:
+            return None
+        host, _, p = coord.rpartition(":")
+        try:
+            return (host or "127.0.0.1"), int(p) + 1000
+        except ValueError:
+            return None
+    if ":" not in raw:
+        return None
+    host, _, p = raw.rpartition(":")
+    try:
+        return (host or "127.0.0.1"), int(p)
+    except ValueError:
+        return None
+
+
+def _dist():
+    """(rank, world size) — (0, 1) in single-process runs and whenever
+    jax is absent/uninitialized (the trainhealth ``_dist`` stance: the
+    plane must never be the thing that initializes a backend)."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return 0, 1
+    try:
+        import jax
+
+        n = jax.process_count()
+        if n <= 1:
+            return 0, 1
+        return jax.process_index(), n
+    except Exception:
+        return 0, 1
+
+
+# -- snapshot (what one rank ships) -------------------------------------------
+def build_snapshot(rank, size, epoch, seq, steps, step_counts,
+                   extra_ledger=None):
+    """One rank's wire snapshot dict.  Every block degrades independently
+    (a failed surface ships as None/empty) — building a snapshot must
+    never fail the step that triggered it."""
+    from . import costplane, flightrec, instrument, ops_server, trainhealth
+
+    metrics = []
+    try:
+        if instrument.enabled():
+            for m in instrument.registry().collect():
+                if m["type"] == "histogram":
+                    continue  # scalar series only; quantiles ride step_hist
+                for s in m["samples"]:
+                    metrics.append([m["name"], m["type"], s["labels"],
+                                    s["value"]])
+    except Exception:
+        metrics = []
+    healthz, hb_age, slo_breaches = None, None, 0
+    try:
+        engines = ops_server._live_engines()
+        if engines:
+            checks = [ops_server.engine_health(e) for e in engines]
+            healthz = {"ok": all(c["ok"] for c in checks),
+                       "engines": [{"engine": c["engine"], "ok": c["ok"],
+                                    "heartbeat_age_s": c["heartbeat_age_s"]}
+                                   for c in checks]}
+            ages = [c["heartbeat_age_s"] for c in checks
+                    if c["heartbeat_age_s"] is not None]
+            hb_age = min(ages) if ages else None
+            for e in engines:
+                try:
+                    for o in (e.stats().get("slo") or {}).get(
+                            "objectives", ()):
+                        slo_breaches += int(o.get("breaches") or 0)
+                except Exception:
+                    pass
+    except Exception:
+        healthz = None
+    nonfinite = 0
+    try:
+        th = trainhealth.status()
+        if th and not isinstance(th.get("trips"), dict):
+            nonfinite = int(th.get("trips") or 0)
+    except Exception:
+        nonfinite = 0
+    ledger = {}
+    try:
+        if costplane.enabled():
+            for r in costplane.rows():
+                ledger[r["key"]] = [r.get("flops"), r.get("bytes_accessed"),
+                                    r.get("compile_s")]
+    except Exception:
+        ledger = {}
+    if extra_ledger:
+        ledger.update(extra_ledger)
+    return {"v": PROTOCOL_V, "rank": int(rank), "size": int(size),
+            "epoch": round(float(epoch), 6), "seq": int(seq),
+            "unix_ts": round(time.time(), 6), "steps": int(steps),
+            "step_hist": list(step_counts), "metrics": metrics,
+            "healthz": healthz, "heartbeat_age_s": hb_age,
+            "flightrec": flightrec.enabled(), "ledger": ledger,
+            "slo_breaches": int(slo_breaches), "nonfinite": int(nonfinite)}
+
+
+def _fingerprint_differs(a, b):
+    """Two ledger entries ([flops, bytes, compile_s]) disagree on program
+    COST IDENTITY — flops and bytes only.  compile_s is wall time and
+    legitimately differs across hosts; it is carried for the /podz skew
+    stats, never for the divergence verdict."""
+    return list(a[:2]) != list(b[:2])
+
+
+# -- rank-0 aggregation state -------------------------------------------------
+class Aggregator:
+    """Rank 0's fold of every rank's snapshots + the detectors.
+
+    Thread-safe (listener connection threads and the local fit loop both
+    ingest).  Keeps its own plain-int counters so /podz is authoritative
+    even with ``MXNET_TELEMETRY`` off; mirrors into the registry (and the
+    flight recorder / JSONL event stream) only when those gates are on.
+    ``now``/monotonic parameters exist so tests drive a synthetic clock.
+    """
+
+    def __init__(self, size=1, my_rank=0):
+        self._mu = threading.Lock()
+        self.size = int(size)
+        self.my_rank = int(my_rank)
+        self._ranks = {}         # rank -> last accepted snapshot state
+        self._diverged = {}      # ledger key -> divergence detail
+        self._incidents = collections.deque(maxlen=MAX_INCIDENTS)
+        self._last_incident = {}  # (rank, reason) -> monotonic of last mint
+        self._inc_seq = 0
+        self.stale_dropped = 0
+        self.divergences = 0
+        self.straggler_verdicts = 0
+        self.mirror_dropped = 0
+
+    # -- ingest ---------------------------------------------------------------
+    def ingest(self, snap, now=None):
+        """Fold one snapshot → {"ok": bool, "reason": ...}.  A snapshot
+        from an older incarnation (smaller epoch) or an out-of-order push
+        (same epoch, non-increasing seq) is DROPPED with a counter — a
+        restarted rank supersedes its past, never the reverse."""
+        now = time.monotonic() if now is None else now
+        try:
+            rank = int(snap["rank"])
+            epoch = float(snap["epoch"])
+            seq = int(snap["seq"])
+        except (KeyError, TypeError, ValueError):
+            return {"ok": False, "reason": "malformed"}
+        with self._mu:
+            prev = self._ranks.get(rank)
+            if prev is not None:
+                if epoch < prev["epoch"] or (epoch == prev["epoch"]
+                                             and seq <= prev["seq"]):
+                    self.stale_dropped += 1
+                    self._count("pod_snapshots_stale_total",
+                                "pushed snapshots dropped as stale (older "
+                                "incarnation epoch or out-of-order seq)",
+                                rank=str(rank))
+                    return {"ok": False, "reason": "stale"}
+            self._ranks[rank] = {
+                "epoch": epoch, "seq": seq,
+                "unix_ts": snap.get("unix_ts"),
+                "recv_mono": now,
+                "steps": int(snap.get("steps") or 0),
+                "step_hist": list(snap.get("step_hist") or ()),
+                "healthz": snap.get("healthz"),
+                "heartbeat_age_s": snap.get("heartbeat_age_s"),
+                "flightrec": bool(snap.get("flightrec")),
+                "ledger": dict(snap.get("ledger") or {}),
+                "slo_breaches": int(snap.get("slo_breaches") or 0),
+                "nonfinite": int(snap.get("nonfinite") or 0),
+                "metrics": list(snap.get("metrics") or ()),
+                "straggler": (prev or {}).get("straggler", False),
+                "dead": False,
+                "last_slo": (prev or {}).get("last_slo"),
+                "last_nonfinite": (prev or {}).get("last_nonfinite"),
+            }
+        self._mirror(rank, snap)
+        self.detect(now=now)
+        return {"ok": True, "reason": None}
+
+    def _mirror(self, rank, snap):
+        """Pushed counters/gauges → ``pod_<name>{...orig labels, rank}``
+        gauge series on the local registry (counters become gauges: a
+        pushed cumulative resets with its rank's incarnation, so rank 0
+        must never treat it as locally monotonic).  Bounded per rank;
+        overflow counts, never grows without limit."""
+        from . import instrument
+
+        if not instrument.enabled():
+            return
+        r = instrument.registry()
+        n = 0
+        for item in snap.get("metrics") or ():
+            try:
+                name, typ, labels, value = item
+                if typ not in ("counter", "gauge"):
+                    continue
+                n += 1
+                if n > MAX_MIRROR_SERIES:
+                    with self._mu:
+                        self.mirror_dropped += 1
+                    self._count("pod_series_dropped_total",
+                                "pushed series beyond the per-rank mirror "
+                                "cap", rank=str(rank))
+                    break
+                labelnames = tuple(sorted(labels)) + ("rank",)
+                g = r.gauge("pod_" + str(name),
+                            "rank-pushed series (pod plane mirror)",
+                            labelnames)
+                g.set(float(value),
+                      **dict({str(k): str(v) for k, v in labels.items()},
+                             rank=str(rank)))
+            except Exception:
+                with self._mu:
+                    self.mirror_dropped += 1
+        return
+
+    def _count(self, name, help, **labels):
+        from . import instrument
+
+        try:
+            if instrument.enabled():
+                instrument.registry().counter(
+                    name, help, tuple(sorted(labels))).inc(**labels)
+        except Exception:
+            pass
+
+    # -- detectors ------------------------------------------------------------
+    def detect(self, now=None):
+        """Run the divergence / straggler / death detectors over the
+        current per-rank state; mint incidents for new findings.  Called
+        after every ingest and from every /podz read (the slo.py stance:
+        the scrape advances detection when traffic has stopped)."""
+        now = time.monotonic() if now is None else now
+        events, incidents = [], []
+        with self._mu:
+            ranks = self._ranks
+            head = max((st["steps"] for st in ranks.values()), default=0)
+            lag_thr, age_thr = straggler_lag_steps(), straggler_age_s()
+            dead_thr = death_age_s()
+            for rk, st in sorted(ranks.items()):
+                lag = max(0, head - st["steps"])
+                age = max(0.0, now - st["recv_mono"])
+                st["lag"] = lag
+                st["push_age_s"] = round(age, 3)
+                behind = lag >= lag_thr or age >= age_thr
+                recovered = lag <= lag_thr / 2.0 and age <= age_thr / 2.0
+                if behind and not st["straggler"]:
+                    st["straggler"] = True
+                    self.straggler_verdicts += 1
+                    events.append(("straggler", rk, lag, age))
+                elif st["straggler"] and recovered:
+                    st["straggler"] = False
+                    self.straggler_verdicts += 1
+                    events.append(("recovered", rk, lag, age))
+                if age >= dead_thr and not st["dead"]:
+                    st["dead"] = True
+                    incidents.append(("rank_death", rk,
+                                      {"push_age_s": round(age, 3)}))
+                elif st["dead"] and age < dead_thr:
+                    st["dead"] = False
+                # per-rank incident edges: SLO breaches / nonfinite hits
+                # INCREASING since the last accepted snapshot
+                if st["last_slo"] is not None \
+                        and st["slo_breaches"] > st["last_slo"]:
+                    incidents.append(("slo_breach", rk,
+                                      {"breaches": st["slo_breaches"]}))
+                if st["last_nonfinite"] is not None \
+                        and st["nonfinite"] > st["last_nonfinite"]:
+                    incidents.append(("nonfinite", rk,
+                                      {"trips": st["nonfinite"]}))
+                st["last_slo"] = st["slo_breaches"]
+                st["last_nonfinite"] = st["nonfinite"]
+            divergences = self._detect_divergence_locked()
+        for verdict, rk, lag, age in events:
+            self._emit_straggler(verdict, rk, lag, age)
+        for key, detail in divergences:
+            self._emit_divergence(key, detail)
+            incidents.append(("ledger_divergence", detail["ranks"][0],
+                              {"key": key, "ranks": detail["ranks"]}))
+        for reason, rk, meta in incidents:
+            self.mint_incident(reason, rk, now=now, **meta)
+
+    def _detect_divergence_locked(self):
+        """Same stable ledger key, different (flops, bytes) fingerprint on
+        two ranks ⇒ the ranks compiled DIFFERENT programs for the same
+        site+key+shapes.  Each key fires once (per fingerprint pair) —
+        lock held; returns the new findings for emission outside."""
+        found = []
+        ranks = sorted(self._ranks)
+        for i, ra in enumerate(ranks):
+            la = self._ranks[ra]["ledger"]
+            for rb in ranks[i + 1:]:
+                lb = self._ranks[rb]["ledger"]
+                for key in la.keys() & lb.keys():
+                    if key in self._diverged:
+                        continue
+                    if _fingerprint_differs(la[key], lb[key]):
+                        detail = {"ranks": [ra, rb],
+                                  "fingerprints": {str(ra): la[key],
+                                                   str(rb): lb[key]}}
+                        self._diverged[key] = detail
+                        self.divergences += 1
+                        found.append((key, detail))
+        return found
+
+    def _emit_straggler(self, verdict, rank, lag, age):
+        from . import instrument
+
+        self._count("pod_straggler_verdicts_total",
+                    "edge-triggered straggler verdict events (with "
+                    "hysteresis): a rank crossed the lag/push-age "
+                    "threshold, or recovered below half of it",
+                    rank=str(rank), verdict=verdict)
+        try:
+            instrument.event("pod_straggler", rank=int(rank),
+                             verdict=verdict, lag_steps=int(lag),
+                             push_age_s=round(age, 3),
+                             lag_threshold=straggler_lag_steps(),
+                             age_threshold_s=straggler_age_s())
+        except Exception:
+            pass
+        from . import flightrec
+
+        frec = flightrec.recorder()
+        if frec is not None:
+            frec.record("pod_straggler", rank=int(rank), verdict=verdict,
+                        lag_steps=int(lag), push_age_s=round(age, 3))
+
+    def _emit_divergence(self, key, detail):
+        from . import flightrec, instrument
+
+        self._count("pod_ledger_divergence_total",
+                    "stable ledger keys whose cost fingerprint "
+                    "(flops/bytes) differs across ranks — the ranks "
+                    "compiled different programs for the same site+key+"
+                    "shapes; alert on any nonzero rate")
+        try:
+            instrument.event("pod_ledger_divergence", key=key, **detail)
+        except Exception:
+            pass
+        frec = flightrec.recorder()
+        if frec is not None:
+            frec.dump("pod_ledger_divergence", auto=True, key=key,
+                      ranks=detail["ranks"],
+                      fingerprints=detail["fingerprints"])
+
+    # -- incidents ------------------------------------------------------------
+    def mint_incident(self, reason, rank, now=None, **meta):
+        """Create one shared incident id (throttled per (rank, reason) so
+        a sustained breach cannot storm) → the incident dict or None.
+        The id rides every subsequent push response; each rank tags a
+        flight-recorder dump with it (``PodPlane._observe_incidents``)."""
+        now = time.monotonic() if now is None else now
+        with self._mu:
+            last = self._last_incident.get((rank, reason))
+            if last is not None and now - last < MIN_INCIDENT_S:
+                return None
+            self._last_incident[(rank, reason)] = now
+            self._inc_seq += 1
+            inc = {"id": "inc-%s-r%s-%d-%d" % (reason, rank, os.getpid(),
+                                               self._inc_seq),
+                   "reason": str(reason), "rank": int(rank),
+                   "unix_ts": round(time.time(), 6), "meta": meta}
+            self._incidents.append(inc)
+        from . import instrument
+
+        self._count("pod_incidents_total",
+                    "fleet incidents minted (shared ids broadcast on the "
+                    "pod channel; every rank's flight recorder dumps "
+                    "tagged with the id)", reason=str(reason))
+        try:
+            instrument.event("pod_incident", **inc)
+        except Exception:
+            pass
+        return inc
+
+    def incidents(self, limit=None):
+        with self._mu:
+            out = list(self._incidents)
+        return out if limit is None else out[-limit:]
+
+    # -- read surfaces --------------------------------------------------------
+    def fleet_rollup(self):
+        """Cross-rank fold of the pushed scalar series: counters with the
+        SAME name+labels are SUMMED across ranks (never clobbered — two
+        ranks' ``serve_requests_total`` add), gauges report min/max/mean.
+        → {"counters": {series: total}, "gauges": {series: {min,max,mean}}}
+        with ``series`` = ``name{k=v,...}``."""
+        with self._mu:
+            states = [dict(st) for st in self._ranks.values()]
+        counters, gauges = {}, {}
+        for st in states:
+            for item in st.get("metrics") or ():
+                try:
+                    name, typ, labels, value = item
+                    series = "%s{%s}" % (name, ",".join(
+                        "%s=%s" % (k, labels[k]) for k in sorted(labels)))
+                    if typ == "counter":
+                        counters[series] = counters.get(series, 0.0) \
+                            + float(value)
+                    elif typ == "gauge":
+                        g = gauges.setdefault(series, [])
+                        g.append(float(value))
+                except Exception:
+                    continue
+        return {"counters": counters,
+                "gauges": {k: {"min": min(v), "max": max(v),
+                               "mean": sum(v) / len(v)}
+                           for k, v in gauges.items() if v}}
+
+    def merged_step_counts(self):
+        """Vector-sum of every rank's step-latency sub-histogram counts —
+        the exact-merge property the slo.py encoding exists for."""
+        counts = [0] * (NBUCKETS + 2)
+        with self._mu:
+            hists = [st["step_hist"] for st in self._ranks.values()]
+        for h in hists:
+            for i, n in enumerate(h[:len(counts)]):
+                if n:
+                    counts[i] += n
+        return counts
+
+    def podz(self, now=None):
+        """The ``/podz`` JSON block: per-rank table + fleet rollup + skew
+        stats + divergences + incidents.  Reading runs the detectors —
+        the scrape is the heartbeat that advances death/straggler
+        detection when every rank has gone quiet."""
+        self.detect(now=now)
+        with self._mu:
+            per_rank = {}
+            for rk, st in sorted(self._ranks.items()):
+                hist = st["step_hist"]
+                p50 = quantile_of_counts(hist, 0.50) if any(hist) else None
+                p99 = quantile_of_counts(hist, 0.99) if any(hist) else None
+                per_rank[str(rk)] = {
+                    "epoch": st["epoch"], "seq": st["seq"],
+                    "steps": st["steps"], "lag": st.get("lag"),
+                    "push_age_s": st.get("push_age_s"),
+                    "straggler": st["straggler"], "dead": st["dead"],
+                    "healthz_ok": (st["healthz"] or {}).get("ok"),
+                    "heartbeat_age_s": st["heartbeat_age_s"],
+                    "flightrec": st["flightrec"],
+                    "ledger_keys": len(st["ledger"]),
+                    "slo_breaches": st["slo_breaches"],
+                    "nonfinite": st["nonfinite"],
+                    "step_p50_ms": (round(p50 * 1e3, 3)
+                                    if p50 is not None else None),
+                    "step_p99_ms": (round(p99 * 1e3, 3)
+                                    if p99 is not None else None),
+                }
+            diverged = {k: dict(v) for k, v in self._diverged.items()}
+            stale = self.stale_dropped
+            verdicts = self.straggler_verdicts
+            compile_skew = self._compile_skew_locked()
+        merged = self.merged_step_counts()
+        fp50 = quantile_of_counts(merged, 0.50) if any(merged) else None
+        fp99 = quantile_of_counts(merged, 0.99) if any(merged) else None
+        steps = [r["steps"] for r in per_rank.values()]
+        return {
+            "enabled": True, "role": "aggregator", "rank": self.my_rank,
+            "size": self.size, "ranks_reporting": len(per_rank),
+            "ranks": per_rank,
+            "fleet": {"step_p50_ms": (round(fp50 * 1e3, 3)
+                                      if fp50 is not None else None),
+                      "step_p99_ms": (round(fp99 * 1e3, 3)
+                                      if fp99 is not None else None),
+                      "steps_min": min(steps) if steps else None,
+                      "steps_max": max(steps) if steps else None,
+                      "max_step_lag": (max(steps) - min(steps)
+                                       if steps else None),
+                      "rollup": self.fleet_rollup()},
+            "skew": {"compile_s": compile_skew},
+            "ledger_divergences": diverged,
+            "ledger_divergence_count": len(diverged),
+            "stale_dropped": stale,
+            "straggler_verdicts": verdicts,
+            "incidents": self.incidents(),
+            "thresholds": {"straggler_lag_steps": straggler_lag_steps(),
+                           "straggler_age_s": straggler_age_s(),
+                           "death_age_s": death_age_s()},
+        }
+
+    def _compile_skew_locked(self):
+        """Per shared ledger key: max - min compile seconds across ranks
+        (the one fingerprint component EXCLUDED from the divergence
+        verdict, surfaced here instead).  Top 8 by skew."""
+        per_key = {}
+        for st in self._ranks.values():
+            for key, fp in st["ledger"].items():
+                if len(fp) > 2 and fp[2] is not None:
+                    per_key.setdefault(key, []).append(float(fp[2]))
+        skew = {k: round(max(v) - min(v), 4)
+                for k, v in per_key.items() if len(v) > 1}
+        top = sorted(skew.items(), key=lambda kv: -kv[1])[:8]
+        return dict(top)
+
+
+# -- the rank-0 listener ------------------------------------------------------
+class _PodServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class _PodHandler(socketserver.StreamRequestHandler):
+    """One persistent pusher connection: line-in (snapshot JSON), line-out
+    ({ok, reason, incidents}).  Any error ends the connection — the
+    pusher reconnects on its next tick; the server thread never dies."""
+
+    def handle(self):
+        agg = self.server.aggregator
+        while True:
+            try:
+                line = self.rfile.readline(MAX_LINE_BYTES + 1)
+            except OSError:
+                return
+            if not line:
+                return
+            try:
+                if len(line) > MAX_LINE_BYTES:
+                    verdict = {"ok": False, "reason": "oversize"}
+                else:
+                    verdict = agg.ingest(json.loads(line))
+            except Exception:
+                verdict = {"ok": False, "reason": "malformed"}
+            verdict["incidents"] = agg.incidents(limit=INCIDENT_BROADCAST)
+            try:
+                self.wfile.write((json.dumps(verdict, default=str)
+                                  + "\n").encode("utf-8"))
+                self.wfile.flush()
+            except OSError:
+                return
+
+
+# -- the per-process plane ----------------------------------------------------
+class PodPlane:
+    """One process's pod-plane endpoint.
+
+    Rank 0 owns an :class:`Aggregator` plus the listener thread; every
+    rank (0 included) feeds its own step-latency estimator and snapshot
+    builder from ``note_step``.  Non-zero ranks push over one persistent
+    socket under the ``MXNET_POD_PUSH_S`` throttle; rank 0 ingests
+    locally (no socket for its own data).  Every failure path counts and
+    degrades — the plane must never fail or block a training step."""
+
+    def __init__(self, rank=None, size=None, addr=None, start_listener=True):
+        if rank is None or size is None:
+            drank, dsize = _dist()
+            rank = drank if rank is None else rank
+            size = dsize if size is None else size
+        self.rank, self.size = int(rank), int(size)
+        self.addr = pod_addr() if addr is None else addr
+        self.epoch = time.time()  # process incarnation for stale detection
+        self._mu = threading.Lock()
+        self._wq = WindowedQuantile(window_s=300.0)
+        self._steps = 0
+        self._seq = 0
+        self._last_push = None   # monotonic of last tick
+        self._sock = None
+        self._push_failures = 0
+        self._consec_failures = 0
+        self._seen_incidents = set()
+        self._extra_ledger = {}
+        self._listener = None
+        self.aggregator = None
+        if self.rank == 0:
+            self.aggregator = Aggregator(size=self.size, my_rank=0)
+            if start_listener and self.size > 1 and self.addr is not None:
+                self._start_listener()
+
+    # -- rank-0 listener ------------------------------------------------------
+    def _start_listener(self):
+        try:
+            # bind all interfaces: pushers connect cross-host; the addr's
+            # host part is the CONNECT address (rank 0's hostname)
+            srv = _PodServer(("", self.addr[1]), _PodHandler)
+        except OSError as e:
+            import logging
+
+            logging.warning("podplane: cannot bind pod channel port %s "
+                            "(%s) — cross-rank aggregation disabled; "
+                            "pushes from other ranks will count failures",
+                            self.addr[1], e)
+            return
+        srv.aggregator = self.aggregator
+        self._listener = srv
+        t = threading.Thread(target=srv.serve_forever,
+                             name="mxnet-pod-metrics", daemon=True)
+        t.start()
+
+    # -- seeding (CI / embedders) ---------------------------------------------
+    def seed_ledger(self, key, flops=None, bytes_accessed=None,
+                    compile_s=None):
+        """Inject one extra ledger fingerprint into this rank's snapshots
+        (merged over the costplane rows).  The divergence-detector seam:
+        ``ci/check_pod_obs.py`` seeds mismatched fingerprints without
+        needing a real cross-rank compile difference."""
+        with self._mu:
+            self._extra_ledger[str(key)] = [flops, bytes_accessed,
+                                            compile_s]
+
+    # -- the fit-loop hook ----------------------------------------------------
+    def note_step(self, seconds):
+        """One fit-loop batch: observe the step latency into the
+        mergeable window and run the (throttled) snapshot tick.  The off
+        path for this method does not exist — the caller's ``pod is
+        None`` check is the gate."""
+        now = time.monotonic()
+        with self._mu:
+            try:
+                self._wq.observe(float(seconds), now)
+            except (TypeError, ValueError):
+                pass
+            self._steps += 1
+            due = (self._last_push is None
+                   or now - self._last_push >= push_interval_s())
+            if due:
+                self._last_push = now
+        if due:
+            self.tick(now=now)
+
+    def tick(self, now=None):
+        """Build + deliver one snapshot (rank 0: local ingest + detect;
+        others: push over the socket).  Never raises."""
+        now = time.monotonic() if now is None else now
+        try:
+            snap = self._snapshot(now)
+            if self.rank == 0:
+                self.aggregator.ingest(snap, now=now)
+                self._observe_incidents(
+                    self.aggregator.incidents(limit=INCIDENT_BROADCAST))
+            else:
+                self._push(snap)
+        except Exception:
+            with self._mu:
+                self._push_failures += 1
+
+    def _snapshot(self, now):
+        with self._mu:
+            self._seq += 1
+            seq = self._seq
+            steps = self._steps
+            counts = self._wq._merged(now)
+            extra = dict(self._extra_ledger)
+        return build_snapshot(self.rank, self.size, self.epoch, seq, steps,
+                              counts, extra_ledger=extra or None)
+
+    # -- pusher side ----------------------------------------------------------
+    def _connect(self):
+        if self.addr is None:
+            raise OSError("no pod channel address")
+        s = socket.create_connection(self.addr, timeout=SOCK_TIMEOUT_S)
+        s.settimeout(SOCK_TIMEOUT_S)
+        return s
+
+    def _push(self, snap):
+        """One snapshot over the persistent channel; read the response
+        line and act on broadcast incidents.  Failures close the socket,
+        count, and return — the next tick reconnects."""
+        line = (json.dumps(snap, default=str) + "\n").encode("utf-8")
+        try:
+            with self._mu:
+                if self._sock is None:
+                    self._sock = self._connect()
+                sock = self._sock
+            sock.sendall(line)
+            resp = self._read_line(sock)
+        except OSError:
+            with self._mu:
+                self._push_failures += 1
+                self._consec_failures += 1
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+            self._count_failure()
+            return
+        with self._mu:
+            self._consec_failures = 0
+        try:
+            verdict = json.loads(resp) if resp else {}
+        except ValueError:
+            verdict = {}
+        self._observe_incidents(verdict.get("incidents") or ())
+
+    @staticmethod
+    def _read_line(sock):
+        buf = bytearray()
+        while not buf.endswith(b"\n"):
+            chunk = sock.recv(4096)
+            if not chunk:
+                break
+            buf += chunk
+            if len(buf) > MAX_LINE_BYTES:
+                break
+        return bytes(buf)
+
+    def _count_failure(self):
+        from . import instrument
+
+        try:
+            if instrument.enabled():
+                instrument.registry().counter(
+                    "pod_push_failures_total",
+                    "snapshot pushes that failed (connect/send/recv) — "
+                    "the plane degrades, the step path never blocks",
+                    ("rank",)).inc(rank=str(self.rank))
+        except Exception:
+            pass
+
+    # -- incident correlation (every rank) ------------------------------------
+    def _observe_incidents(self, incidents):
+        """Tag a flight-recorder dump with every incident id this rank
+        has not seen yet — the cross-rank correlation handle
+        ``tools/pod_status.py`` collects on."""
+        from . import flightrec
+
+        for inc in incidents:
+            try:
+                iid = inc["id"]
+            except (TypeError, KeyError):
+                continue
+            with self._mu:
+                if iid in self._seen_incidents:
+                    continue
+                self._seen_incidents.add(iid)
+            frec = flightrec.recorder()
+            if frec is not None:
+                frec.record("pod_incident", incident=iid,
+                            reason=inc.get("reason"),
+                            src_rank=inc.get("rank"))
+                frec.dump("pod_incident", incident=iid,
+                          why=inc.get("reason"),
+                          src_rank=inc.get("rank"),
+                          observer_rank=self.rank)
+
+    # -- read surfaces --------------------------------------------------------
+    def push_stats(self):
+        with self._mu:
+            return {"seq": self._seq, "steps": self._steps,
+                    "push_failures": self._push_failures,
+                    "consecutive_failures": self._consec_failures,
+                    "connected": self._sock is not None,
+                    "incidents_seen": len(self._seen_incidents)}
+
+    def podz(self):
+        """This process's /podz block: the full aggregation on rank 0, a
+        pusher-status pointer elsewhere."""
+        if self.aggregator is not None:
+            out = self.aggregator.podz()
+            out["push"] = self.push_stats()
+            return out
+        return {"enabled": True, "role": "pusher", "rank": self.rank,
+                "size": self.size,
+                "aggregator": ("%s:%d" % self.addr
+                               if self.addr is not None else None),
+                "push": self.push_stats()}
+
+    def close(self):
+        with self._mu:
+            sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        srv, self._listener = self._listener, None
+        if srv is not None:
+            srv.shutdown()
+            srv.server_close()
+
+
+# -- process-global plane (mirrors flightrec.recorder) ------------------------
+_mu = threading.Lock()
+_plane = None
+
+
+def plane():
+    """The process PodPlane, or None when ``MXNET_POD_METRICS`` is unset
+    — the caller's one ``is None`` check.  Gate off: one env read, no
+    socket, no thread, nothing allocated."""
+    global _plane
+    if not enabled():
+        return None
+    with _mu:
+        if _plane is None:
+            _plane = PodPlane()
+        return _plane
+
+
+def podz():
+    """The ``/podz`` endpoint body.  ``{"enabled": False}`` when the gate
+    is off — the endpoint stays routable so an operator probing a
+    non-pod process gets an answer, not a 404."""
+    p = plane()
+    if p is None:
+        return {"enabled": False}
+    return p.podz()
+
+
+def status():
+    """``/statusz``-style compact block, or None when the gate is off."""
+    p = plane()
+    if p is None:
+        return None
+    agg = p.aggregator
+    return {"rank": p.rank, "size": p.size,
+            "role": "aggregator" if agg is not None else "pusher",
+            "push": p.push_stats(),
+            "ranks_reporting": (len(agg._ranks) if agg is not None
+                                else None),
+            "divergences": agg.divergences if agg is not None else None,
+            "incidents": (len(agg.incidents()) if agg is not None
+                          else None)}
+
+
+def _reset_for_tests():
+    global _plane
+    with _mu:
+        p, _plane = _plane, None
+    if p is not None:
+        p.close()

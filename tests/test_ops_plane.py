@@ -441,6 +441,34 @@ class TestOpsServer:
         finally:
             eng.close()
 
+    def test_healthz_stays_200_during_slow_cold_compile(self, ops_on,
+                                                        monkeypatch):
+        """ISSUE 19 satellite (the rest of the flap fix): the cold-bucket
+        ``_predictor_for`` build/compile runs BEFORE the device mutex, so
+        the ISSUE 16 busy marker never covered it — a first-request
+        compile outlasting MXNET_OPS_STALE_S flapped 503.  _dispatch now
+        beats on entry and holds the busy marker across the predictor
+        build, so a slow compile reads busy-not-dead."""
+        eng = _mlp_engine()
+        try:
+            port = ops_server.port()
+            real = eng._predictor_for
+
+            def slow_build(bucket):
+                time.sleep(2.5)  # a long XLA compile, pre-mutex
+                return real(bucket)
+
+            monkeypatch.setattr(eng, "_predictor_for", slow_build)
+            fut = eng.submit({"data": np.zeros((1, 8), np.float32)})
+            time.sleep(1.6)  # past MXNET_OPS_STALE_S=1.0, mid-"compile"
+            code, body = _get(port, "/healthz")
+            assert code == 200
+            (check,) = json.loads(body)["engines"]
+            assert check["busy_in_dispatch"] is True
+            fut.result(timeout=30)
+        finally:
+            eng.close()
+
     def test_unregister_on_close(self, ops_on):
         eng = _mlp_engine()
         port = ops_server.port()

@@ -70,7 +70,40 @@ def load_bench(path, obj):
             "data_wait_frac": tel.get("data_wait_frac"),
             "warmup_s": tel.get("warmup_s"),
             "graph_nodes_pre": tel.get("graph_nodes_pre"),
-            "graph_nodes_post": tel.get("graph_nodes_post")}
+            "graph_nodes_post": tel.get("graph_nodes_post"),
+            # pod observability rollup (ISSUE 19): display-only, never
+            # gated — fleet health is a verdict, not a percentage delta
+            "pod": _norm_pod(tel.get("pod"))}
+
+
+def _norm_pod(pod):
+    """Normalize a telemetry ``pod`` block → int-valued dict, or None when
+    absent/malformed (an old or single-process capture must compare, not
+    crash)."""
+    if not isinstance(pod, dict) or not pod:
+        return None
+    out = {}
+    for k in ("ranks", "max_step_lag", "ledger_divergences", "incidents"):
+        v = pod.get(k)
+        if v is not None:
+            try:
+                out[k] = int(v)
+            except (TypeError, ValueError):
+                return None
+    return out or None
+
+
+def _fmt_pod(pod):
+    """Compact pod cell: ``r<ranks>/lag<max>/div<n>/inc<n>`` — ``-`` when
+    the capture carried no pod rollup (plane off, or a pusher rank)."""
+    if not pod:
+        return "-"
+    parts = []
+    for tag, k in (("r", "ranks"), ("lag", "max_step_lag"),
+                   ("div", "ledger_divergences"), ("inc", "incidents")):
+        if k in pod:
+            parts.append("%s%d" % (tag, pod[k]))
+    return "/".join(parts) or "-"
 
 
 # multichip dryrun phases, as printed by __graft_entry__.dryrun_multichip —
@@ -529,7 +562,11 @@ def load_multichip(path, obj):
             "skipped": bool(obj.get("skipped")),
             "n_devices": obj.get("n_devices"),
             "phases": {name for name, marker in MULTICHIP_PHASES
-                       if marker in tail}}
+                       if marker in tail},
+            # pod rollup (ISSUE 19): a driver capture taken with
+            # MXNET_POD_METRICS on carries rank 0's fleet summary —
+            # display-only, never part of the phase/ok gate
+            "pod": _norm_pod(obj.get("pod"))}
 
 
 def compare_multichip(rows):
@@ -553,12 +590,13 @@ def compare_multichip(rows):
 
 
 def render_multichip_table(table):
-    lines = ["file  ok  skipped  n_devices  phases  missing"]
+    lines = ["file  ok  skipped  n_devices  phases  missing  pod"]
     for r in table:
-        lines.append("%s  %s  %s  %s  [%s]  %s" % (
+        lines.append("%s  %s  %s  %s  [%s]  %s  %s" % (
             r["file"], r["ok"], r["skipped"], r["n_devices"],
             ",".join(r["phases"]),
-            ",".join(r["missing_phases"]) or "-"))
+            ",".join(r["missing_phases"]) or "-",
+            _fmt_pod(r.get("pod"))))
     return "\n".join(lines)
 
 
@@ -633,7 +671,7 @@ def _fmt_nodes(r):
 def render_table(table):
     cols = ["file", "metric", "tier", "value", "Δvalue%", "disp/step",
             "Δdisp%", "compile_s", "Δcompile%", "warmup_s", "Δwarmup%",
-            "nodes", "Δnodes%", "wait_frac"]
+            "nodes", "Δnodes%", "wait_frac", "pod"]
     out = [cols]
     for r in table:
         metric = r["metric"] + ("" if r["same_metric"] else " (≠ baseline)")
@@ -647,7 +685,8 @@ def render_table(table):
                     _fmt(r["warmup_delta_pct"], "%+.1f"),
                     _fmt_nodes(r),
                     _fmt(r["nodes_delta_pct"], "%+.1f"),
-                    _fmt(r["data_wait_frac"], "%.3g")])
+                    _fmt(r["data_wait_frac"], "%.3g"),
+                    _fmt_pod(r.get("pod"))])
     widths = [max(len(row[i]) for row in out) for i in range(len(cols))]
     lines = []
     for i, row in enumerate(out):

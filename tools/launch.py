@@ -19,6 +19,10 @@ Every spawned process receives the env contract consumed by
 ``mxnet_tpu.parallel.dist.init()``:
   MXNET_COORDINATOR, MXNET_NUM_WORKERS, MXNET_WORKER_RANK
 (DMLC_* aliases are exported too for scripts reading the reference names).
+Observability env (MXNET_TELEMETRY / MXNET_TRACE / MXNET_FLIGHTREC_DIR /
+MXNET_POD_METRICS*) set on the launcher is propagated to every worker, and
+each worker's stdout/stderr is line-prefixed with ``[rank N]`` so pod logs
+stay attributable (ISSUE 19 satellite).
 """
 from __future__ import annotations
 
@@ -28,6 +32,14 @@ import signal
 import socket
 import subprocess
 import sys
+import threading
+
+# observability env propagated from the launcher to every worker (ISSUE 19
+# satellite): exact names plus one prefix family.  The ssh launcher builds
+# worker env from scratch (base={}), so without this an operator exporting
+# MXNET_TELEMETRY=1 before launch gets silent per-worker no-ops.
+_PROPAGATE_EXACT = ("MXNET_TELEMETRY", "MXNET_TRACE", "MXNET_FLIGHTREC_DIR")
+_PROPAGATE_PREFIX = ("MXNET_POD_METRICS",)
 
 
 def _free_port():
@@ -40,6 +52,9 @@ def _free_port():
 
 def _env_for(rank, n, coordinator, base=None):
     env = dict(base if base is not None else os.environ)
+    for k, v in os.environ.items():
+        if k in _PROPAGATE_EXACT or k.startswith(_PROPAGATE_PREFIX):
+            env.setdefault(k, v)
     env.update({
         "MXNET_COORDINATOR": coordinator,
         "MXNET_NUM_WORKERS": str(n),
@@ -52,19 +67,45 @@ def _env_for(rank, n, coordinator, base=None):
     return env
 
 
+def _pump(stream, rank, out):
+    """Copy one worker's merged stdout/stderr to ``out``, prefixing every
+    line with ``[rank N]`` so interleaved pod logs stay attributable."""
+    prefix = "[rank %d] " % rank
+    for line in iter(stream.readline, ""):
+        out.write(prefix + line)
+        out.flush()
+    stream.close()
+
+
+def _spawn_prefixed(cmd, rank, env=None):
+    """Popen with stderr merged into stdout and a daemon pump thread that
+    rank-prefixes every line.  Line-buffered text mode: a worker writing
+    whole lines (the logging default) is never split mid-line."""
+    p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True, bufsize=1)
+    t = threading.Thread(target=_pump, args=(p.stdout, rank, sys.stdout),
+                         name="launch-pump-%d" % rank, daemon=True)
+    t.start()
+    return p, t
+
+
 def launch_local(n, command, verbose=False):
     """N processes on this host (the reference local tracker)."""
     coordinator = "127.0.0.1:%d" % _free_port()
-    procs = []
+    procs, pumps = [], []
     try:
         for rank in range(n):
-            p = subprocess.Popen(command, env=_env_for(rank, n, coordinator))
+            p, t = _spawn_prefixed(command, rank,
+                                   env=_env_for(rank, n, coordinator))
             procs.append(p)
+            pumps.append(t)
         codes = [p.wait() for p in procs]
     except KeyboardInterrupt:
         for p in procs:
             p.send_signal(signal.SIGTERM)
         raise
+    for t in pumps:
+        t.join(timeout=5.0)
     bad = [(i, c) for i, c in enumerate(codes) if c != 0]
     if bad:
         raise SystemExit("workers failed: %s" % bad)
@@ -83,7 +124,7 @@ def launch_ssh(n, hosts, command, verbose=False, port=None):
     port = port or 29400
     coordinator = "%s:%d" % (hosts[0], port)
     cmd_str = " ".join("'%s'" % c for c in command)
-    procs = []
+    procs, pumps = [], []
     for rank in range(n):
         envs = " ".join(
             "%s=%s" % (k, v)
@@ -93,8 +134,12 @@ def launch_ssh(n, hosts, command, verbose=False, port=None):
                 "cd %s && env %s %s" % (os.getcwd(), envs, cmd_str)]
         if verbose:
             print("launch:", " ".join(full))
-        procs.append(subprocess.Popen(full))
+        p, t = _spawn_prefixed(full, rank)
+        procs.append(p)
+        pumps.append(t)
     codes = [p.wait() for p in procs]
+    for t in pumps:
+        t.join(timeout=5.0)
     bad = [(hosts[i], c) for i, c in enumerate(codes) if c != 0]
     if bad:
         raise SystemExit("workers failed: %s" % bad)

@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Pod observability CLI — render ``/podz`` and correlate incident dumps
+(ISSUE 19).
+
+Two surfaces over the pod plane (``mxnet_tpu/telemetry/podplane.py``):
+
+* **status** — fetch rank 0's ``/podz`` ops endpoint (stdlib urllib, no
+  deps) and render the per-rank table, fleet rollup, ledger divergences,
+  and incident history as aligned text::
+
+      python tools/pod_status.py http://127.0.0.1:9100
+      python tools/pod_status.py http://127.0.0.1:9100 --json   # raw block
+
+* **collect** — walk one flight-recorder directory per rank, group the
+  ``pod_incident``-tagged dumps by their shared incident id, and merge
+  each group onto ONE unix-epoch timeline via the existing
+  ``trace_merge`` clock-sync machinery (each dump embeds a ``clock_sync``
+  record plus its rank), so the 3 a.m. question "what was every rank
+  doing when incident X fired" is one Perfetto load::
+
+      python tools/pod_status.py --collect rank0/frec rank1/frec -o out/
+
+  writes ``out/<incident-id>.json`` per incident (plus a listing of
+  un-correlated ``pod_*`` dumps such as rank 0's ledger-divergence
+  detail dump, which carries the key and both ranks in its metadata).
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import trace_merge  # noqa: E402  (sibling tool, no package)
+
+
+def fetch_podz(url, timeout_s=5.0):
+    """GET <url>/podz → the parsed JSON block."""
+    url = url.rstrip("/")
+    if not url.endswith("/podz"):
+        url += "/podz"
+    with urllib.request.urlopen(url, timeout=timeout_s) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+# -- rendering ----------------------------------------------------------------
+_RANK_COLS = (
+    ("rank", lambda rk, st: rk),
+    ("steps", lambda rk, st: st.get("steps")),
+    ("lag", lambda rk, st: st.get("lag")),
+    ("push_age_s", lambda rk, st: st.get("push_age_s")),
+    ("p50_ms", lambda rk, st: st.get("step_p50_ms")),
+    ("p99_ms", lambda rk, st: st.get("step_p99_ms")),
+    ("healthz", lambda rk, st: {True: "ok", False: "FAIL", None: "-"}
+     [st.get("healthz_ok")]),
+    ("hb_age_s", lambda rk, st: st.get("heartbeat_age_s")),
+    ("frec", lambda rk, st: "arm" if st.get("flightrec") else "-"),
+    ("ledger", lambda rk, st: st.get("ledger_keys")),
+    ("slo", lambda rk, st: st.get("slo_breaches")),
+    ("nonfin", lambda rk, st: st.get("nonfinite")),
+    ("verdict", lambda rk, st: ("DEAD" if st.get("dead")
+                                else "straggler" if st.get("straggler")
+                                else "ok")),
+)
+
+
+def _cell(v):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return "%.3g" % v
+    return str(v)
+
+
+def render_podz(pz):
+    """The /podz block → aligned multi-line text (pure; tested)."""
+    if not pz.get("enabled"):
+        return "pod plane disabled (MXNET_POD_METRICS unset)"
+    lines = []
+    if pz.get("role") == "pusher":
+        push = pz.get("push") or {}
+        lines.append("pod pusher rank %s/%s -> %s"
+                     % (pz.get("rank"), pz.get("size"),
+                        pz.get("aggregator") or "(no channel)"))
+        lines.append("  pushed seq=%s steps=%s failures=%s connected=%s"
+                     % (push.get("seq"), push.get("steps"),
+                        push.get("push_failures"), push.get("connected")))
+        return "\n".join(lines)
+    lines.append("pod aggregator: %s/%s ranks reporting"
+                 % (pz.get("ranks_reporting"), pz.get("size")))
+    rows = [[_cell(fn(rk, st)) for _, fn in _RANK_COLS]
+            for rk, st in sorted((pz.get("ranks") or {}).items(),
+                                 key=lambda kv: int(kv[0]))]
+    headers = [name for name, _ in _RANK_COLS]
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(headers)]
+    lines.append("  " + "  ".join(h.ljust(w)
+                                  for h, w in zip(headers, widths)))
+    for r in rows:
+        lines.append("  " + "  ".join(c.ljust(w)
+                                      for c, w in zip(r, widths)))
+    fleet = pz.get("fleet") or {}
+    lines.append("fleet: p50=%sms p99=%sms steps=[%s..%s] max_lag=%s"
+                 % (_cell(fleet.get("step_p50_ms")),
+                    _cell(fleet.get("step_p99_ms")),
+                    _cell(fleet.get("steps_min")),
+                    _cell(fleet.get("steps_max")),
+                    _cell(fleet.get("max_step_lag"))))
+    div = pz.get("ledger_divergences") or {}
+    lines.append("ledger divergences: %d (stale snapshots dropped: %s, "
+                 "straggler verdicts: %s)"
+                 % (len(div), pz.get("stale_dropped"),
+                    pz.get("straggler_verdicts")))
+    for key, detail in sorted(div.items()):
+        lines.append("  key %s ranks %s: %s"
+                     % (key, detail.get("ranks"),
+                        detail.get("fingerprints")))
+    skew = (pz.get("skew") or {}).get("compile_s") or {}
+    if skew:
+        lines.append("compile_s skew (max-min across ranks, top %d):"
+                     % len(skew))
+        for key, s in skew.items():
+            lines.append("  %s: %ss" % (key, _cell(s)))
+    incs = pz.get("incidents") or []
+    lines.append("incidents: %d" % len(incs))
+    for inc in incs:
+        lines.append("  %s reason=%s rank=%s %s"
+                     % (inc.get("id"), inc.get("reason"), inc.get("rank"),
+                        inc.get("meta") or ""))
+    return "\n".join(lines)
+
+
+# -- incident-dump collection -------------------------------------------------
+def scan_incident_dumps(dirs):
+    """Walk flight-recorder dirs → ({incident_id: [(path, rank)]},
+    [other pod_* dump paths]).  The incident id and the observing rank
+    live in the dump's ``flightrec`` metadata
+    (``PodPlane._observe_incidents`` tags both — a single-host pod run
+    has no jax rank in ``clock_sync``, so the observer rank is what
+    keeps per-rank tracks separable in the merge)."""
+    by_incident, loose = {}, []
+    for d in dirs:
+        for path in sorted(glob.glob(os.path.join(d, "flightrec-*.json"))):
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    meta = (json.load(f).get("flightrec") or {})
+            except (OSError, ValueError):
+                continue
+            iid = meta.get("incident")
+            reason = str(meta.get("reason") or "")
+            if iid:
+                by_incident.setdefault(str(iid), []).append(
+                    (path, meta.get("observer_rank")))
+            elif reason.startswith("pod_"):
+                loose.append(path)
+    return by_incident, loose
+
+
+def collect(dirs, outdir):
+    """Merge each incident's per-rank dumps onto one timeline →
+    ``outdir/<incident-id>.json`` via trace_merge (clock_sync rebase +
+    rank-labeled track groups).  → exit code."""
+    by_incident, loose = scan_incident_dumps(dirs)
+    if not by_incident and not loose:
+        print("no pod incident dumps under: %s" % ", ".join(dirs))
+        return 1
+    os.makedirs(outdir, exist_ok=True)
+    rc = 0
+    for iid, entries in sorted(by_incident.items()):
+        out = os.path.join(outdir, "%s.json" % iid.replace("/", "_"))
+        paths = [p for p, _ in entries]
+        print("incident %s: %d dump(s)" % (iid, len(paths)))
+        argv = paths + ["-o", out]
+        if all(r is not None for _, r in entries):
+            # trace_merge --rank flags are positional per file: only
+            # usable when every dump in the group knows its observer
+            for _, r in entries:
+                argv += ["--rank", str(int(r))]
+        code = trace_merge.main(argv)
+        rc = rc or code
+    for path in loose:
+        print("related (no incident id): %s" % path)
+    return rc
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="render /podz and correlate pod incident dumps")
+    p.add_argument("url", nargs="?",
+                   help="ops-server base URL (e.g. http://host:9100) — "
+                        "renders /podz")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw /podz JSON instead of the table")
+    p.add_argument("--collect", nargs="+", metavar="DIR",
+                   help="flight-recorder dirs (one per rank) — group "
+                        "incident-tagged dumps and merge per incident")
+    p.add_argument("-o", "--output", default="pod_incidents",
+                   help="output directory for --collect merges")
+    args = p.parse_args(argv)
+    if args.collect:
+        return collect(args.collect, args.output)
+    if not args.url:
+        p.error("need an ops-server URL or --collect DIR...")
+    try:
+        pz = fetch_podz(args.url)
+    except (OSError, ValueError) as e:
+        print("pod_status: cannot fetch %s: %s" % (args.url, e),
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(pz, indent=1, default=str))
+    else:
+        print(render_podz(pz))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
