@@ -1,0 +1,373 @@
+#!/usr/bin/env python
+"""CI smoke for pod-scale fused training (ISSUE 20).
+
+Three phases over the same model/data, exit 0 only when all pass —
+wired into the unit tier of ``ci/run_tests.sh``:
+
+1. **Control.**  A single-process 8-device run (mesh ``dp=8``, fused
+   step + ZeRO-1) trains 20 global steps and writes its final params.
+2. **Pod train (launch A).**  ``tools/launch.py -n 2 --launcher local``
+   spawns two processes x 4 virtual devices joined into the SAME
+   8-device dp mesh; each rank feeds only its half of every global
+   batch (``parallel.global_batch_array`` — no host gathering).  Mid-
+   run rank 1 stalls 3.5 s (between the 2 s straggler and 6 s death
+   thresholds): rank 0's detector mints a straggler incident carrying
+   the agreed ``rejoin_step``, BOTH ranks checkpoint-and-rejoin at that
+   boundary through the shared ``MXNET_ELASTIC_DIR``, and the final
+   params must still match the control run — the rebase is
+   value-preserving and the pod run is step-for-step the single-process
+   program.  Asserts the dp collectives were booked as DCN bytes and
+   zero ledger divergences between the ranks' compile fingerprints.
+3. **Pod warm restart (launch B).**  Same dirs, one more epoch: every
+   rank resumes from the durable checkpoint (fast-forwarding the 20
+   restored steps), restores its fused step from its per-rank
+   ``MXNET_AOT_CACHE`` with ``compile_s == 0.0`` and zero tier-1
+   misses, and rank 0 sees both ranks publish NON-empty cost ledgers
+   (the AOT restore path re-publishes the stored fingerprint) with
+   zero divergences — the proof both ranks run the identical compiled
+   program without recompiling anywhere.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORK = "/tmp/pod_train_smoke"
+
+WORKER = textwrap.dedent("""
+    import os, sys, time
+
+    phase = os.environ["POD_TRAIN_PHASE"]      # control | train | warm
+    base = os.environ["POD_TRAIN_DIR"]
+    rank = int(os.environ.get("MXNET_WORKER_RANK", "0"))
+    ndev = 8 if phase == "control" else 4
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=%d"
+                               % ndev)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["MXNET_TELEMETRY_FILE"] = os.path.join(
+        base, "tel_%s_r%d.jsonl" % (phase, rank))
+    if phase != "control":
+        # per-rank AOT cache dir: launch B must restore warm on EVERY
+        # rank from its own store (MXNET_AOT_CACHE itself is propagated
+        # by tools/launch.py; the per-rank suffix is worker-side)
+        os.environ["MXNET_AOT_CACHE"] = os.path.join(base, "aot_r%d" % rank)
+
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import compile_cache
+    from mxnet_tpu import module as mod_mod
+    from mxnet_tpu import parallel
+    from mxnet_tpu.io import NDArrayIter
+    from mxnet_tpu.parallel.mesh import mesh_batch_factor, \\
+        mesh_spans_processes
+    from mxnet_tpu.telemetry import instrument as tin
+
+    GB, DIM, CLASSES, SPE = 16, 8, 4, 10   # global batch, dims, steps/epoch
+
+    def make_data():
+        rng = np.random.RandomState(7)
+        X = rng.randn(SPE * GB, DIM).astype(np.float32)
+        W = rng.randn(DIM, CLASSES).astype(np.float32)
+        y = np.argmax(X @ W, axis=1).astype(np.float32)
+        return X, y
+
+    def build(mesh):
+        data = mx.sym.var("data")
+        x = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+        x = mx.sym.Activation(x, name="relu1", act_type="relu")
+        x = mx.sym.FullyConnected(x, name="fc2", num_hidden=CLASSES)
+        sym = mx.sym.SoftmaxOutput(x, name="softmax")
+        mod = mod_mod.Module(sym, mesh=mesh)
+        lb = GB // mesh_batch_factor(mesh)   # host-local batch rows
+        mod.bind(data_shapes=[("data", (lb, DIM))],
+                 label_shapes=[("softmax_label", (lb,))])
+        rng = np.random.RandomState(3)       # identical init on every rank
+        shapes = {n: a.shape for n, a in mod._exec.arg_dict.items()}
+        arg = {n: mx.nd.array(rng.randn(*shapes[n]).astype(np.float32) * 0.1)
+               for n in sorted(mod._param_names)}
+        return mod, arg
+
+    X, y = make_data()
+    opt_params = {"learning_rate": 0.1, "momentum": 0.9}
+
+    if phase == "control":
+        mx.random.seed(11)
+        mod, arg = build(parallel.make_mesh({"dp": 8}))
+        it = NDArrayIter(X, y, batch_size=GB, label_name="softmax_label")
+        mod.fit(it, num_epoch=2, arg_params=arg, optimizer_params=opt_params)
+        assert mod._fused is not None and mod._fused.zero
+        np.savez(os.path.join(base, "control.npz"),
+                 **{n: v.asnumpy() for n, v in mod.get_params()[0].items()})
+        print("CONTROL_RESULT ok", flush=True)
+        sys.exit(0)
+
+    from mxnet_tpu.parallel import dist
+    from mxnet_tpu.telemetry import podplane
+
+    dist.init()
+    import jax
+    assert dist.size() == 2 and len(jax.devices()) == 8, \\
+        (dist.size(), jax.devices())
+    mesh = parallel.make_mesh({"dp": 8})     # spans both processes
+    assert mesh_spans_processes(mesh)
+    assert mesh_batch_factor(mesh) == 2
+    pod = podplane.plane()
+    assert pod is not None and pod.size == 2
+
+    mod, arg = build(mesh)
+    # each rank feeds its contiguous half of every global batch (default
+    # make_mesh layout: process r's rows sit at global offset r*8)
+    Xl = X.reshape(SPE, GB, DIM)[:, rank * 8:(rank + 1) * 8, :] \\
+        .reshape(-1, DIM)
+    yl = y.reshape(SPE, GB)[:, rank * 8:(rank + 1) * 8].reshape(-1)
+
+    def make_iter():
+        return NDArrayIter(Xl, yl, batch_size=GB // 2,
+                           label_name="softmax_label")
+
+    def assert_parity(mod):
+        ctrl = np.load(os.path.join(base, "control.npz"))
+        args_out, _ = mod.get_params()
+        for n in ctrl.files:
+            np.testing.assert_allclose(args_out[n].asnumpy(), ctrl[n],
+                                       rtol=2e-5, atol=1e-6, err_msg=n)
+
+    mx.random.seed(11)
+    if phase == "train":
+        stalled = []
+
+        def stall_cb(param):
+            # rank 1 stalls once, past the 2 s straggler age and under
+            # the 6 s death age — the detector must call it a straggler
+            if rank == 1 and param.epoch == 0 and param.nbatch == 4 \\
+                    and not stalled:
+                stalled.append(1)
+                time.sleep(3.5)
+
+        mod.fit(make_iter(), num_epoch=2, arg_params=arg,
+                batch_end_callback=stall_cb, optimizer_params=opt_params)
+        assert mod._fused is not None and mod._fused.mesh is not None \\
+            and mod._fused.zero
+        st = mod.elastic_stats()
+        assert st is not None and st["resume_step"] == 0, st
+        # the acceptance gate: the straggler incident triggered one
+        # checkpoint-and-rejoin at the agreed boundary, before the end
+        assert st["rejoins"] == 1 and st["last_rejoin_step"] is not None, st
+        assert st["last_rejoin_step"] < 20, st
+        assert st["steps"][-1] == 20, st      # final step durably saved
+        # ...and the rebase was value-preserving: 20-step parity vs the
+        # single-process control, straggler response included
+        assert_parity(mod)
+        cs = compile_cache.stats()
+        assert cs["misses"] >= 1, cs          # cold: compiled + stored
+        r = tin.registry()
+        assert r.get("train_steps_total").value(path="fused_mesh") == 20
+        assert r.get("module_fused_fallback_total") is None
+        # dp spans processes: the in-step collectives are DCN bytes
+        link = r.get("collective_link_bytes_total")
+        dcn = sum((link.value(link="dcn", op=op) or 0)
+                  for op in ("psum_grads", "reduce_scatter", "allgather"))
+        assert dcn > 0, "no dp collective booked as dcn"
+        assert not any((link.value(link="ici", op=op) or 0)
+                       for op in ("psum_grads", "reduce_scatter",
+                                  "allgather")), "pod dp bytes booked as ici"
+        # ZeRO-1 really sharded: some state leaf holds 1/dp per device
+        sharded = 0
+        for i, n in enumerate(mod._param_names):
+            s = mod._updater.states[i]
+            if s is None:
+                continue
+            for leaf in ([s] if not isinstance(s, (tuple, list)) else s):
+                a = leaf._data
+                if int(np.prod(a.sharding.shard_shape(a.shape))) * 8 \\
+                        == int(np.prod(a.shape)):
+                    sharded += 1
+        assert sharded > 0, "no ZeRO-sharded optimizer state leaf"
+        if rank == 0:
+            pz = pod.podz()
+            assert pz["ranks_reporting"] == 2, pz
+            assert pz["straggler_verdicts"] >= 1, pz
+            incs = [i for i in pz["incidents"]
+                    if i["reason"] == "straggler"]
+            assert incs and incs[0]["meta"].get("rejoin_step") is not None, \\
+                pz["incidents"]
+            assert incs[0]["meta"]["rejoin_step"] == st["last_rejoin_step"]
+            assert pz["ledger_divergence_count"] == 0, \\
+                pz["ledger_divergences"]
+        print("RANK%d_TRAIN ok" % rank, flush=True)
+    else:
+        assert phase == "warm", phase
+        mod.fit(make_iter(), num_epoch=3, arg_params=arg,
+                optimizer_params=opt_params)
+        st = mod.elastic_stats()
+        assert st is not None and st["resume_step"] == 20, st
+        assert st["steps"][-1] == 30, st
+        cs = compile_cache.stats()
+        # THE warm-restart acceptance: every rank restored its compiled
+        # step from its own AOT store — zero fresh tier-1 compiles,
+        # zero seconds spent in XLA compilation
+        assert cs["hits"] >= 1, cs
+        assert cs["misses"] == 0, cs
+        assert cs["compile_s"] == 0.0, cs
+        if rank == 0:
+            pz = pod.podz()
+            assert pz["ranks_reporting"] == 2, pz
+            # the restore path re-published each entry's stored cost
+            # fingerprint, so the cross-rank ledger diff is non-vacuous
+            for rk in ("0", "1"):
+                assert pz["ranks"][rk]["ledger_keys"] >= 1, pz["ranks"][rk]
+            assert pz["ledger_divergence_count"] == 0, \\
+                pz["ledger_divergences"]
+        print("RANK%d_WARM ok" % rank, flush=True)
+    dist.shutdown()
+""")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _check_launcher_propagation():
+    """Satellite: tools/launch.py forwards the AOT/autotune/elastic cache
+    env families into worker env even when built from scratch (ssh path,
+    base={}) — a pod restart must be warm on every rank, not just the
+    launcher's."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import launch
+
+    probe = {"MXNET_AOT_CACHE": "/x/aot", "MXNET_AOT_CACHE_MAX_MB": "64",
+             "MXNET_AUTOTUNE": "1", "MXNET_AUTOTUNE_CACHE": "/x/tune",
+             "MXNET_ELASTIC_DIR": "/x/el"}
+    old = {k: os.environ.get(k) for k in probe}
+    os.environ.update(probe)
+    try:
+        env = launch._env_for(1, 2, "h0:29400", base={})
+        for k, v in probe.items():
+            assert env.get(k) == v, (k, env.get(k))
+        assert env["MXNET_WORKER_RANK"] == "1"
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    print("launcher env propagation (AOT/autotune/elastic families) — ok")
+
+
+def _base_env():
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=REPO,
+        POD_TRAIN_DIR=WORK,
+        # the whole pod story under one env: fused + ZeRO across the
+        # process boundary, costplane ledgers, elastic checkpoints
+        MXNET_MODULE_FUSED_STEP="1",
+        MXNET_FUSED_ZERO="1",
+        # donation off: a donated executable cannot legally restore from
+        # disk on the CPU backend (docs/PERF_NOTES.md) — and launch B's
+        # whole point is the disk restore
+        MXNET_FUSED_DONATE="0",
+        MXNET_COSTPLANE="1",
+        MXNET_TELEMETRY="1",
+        MXNET_ELASTIC_DIR=os.path.join(WORK, "elastic"),
+        # only the rejoin + final saves: keeps the collective-save count
+        # deterministic under the stall
+        MXNET_ELASTIC_SAVE_STEPS="50",
+    )
+    env.pop("MXNET_OPS_PORT", None)
+    env.pop("MXNET_FLIGHTREC_DIR", None)
+    env.pop("MXNET_POD_METRICS", None)
+    env.pop("MXNET_POD_METRICS_ADDR", None)
+    env.pop("MXNET_AOT_CACHE", None)  # per-rank, set by the worker
+    return env
+
+
+def check_control(worker):
+    env = _base_env()
+    env["POD_TRAIN_PHASE"] = "control"
+    # no elastic for the control: its final save would otherwise land in
+    # the shared MXNET_ELASTIC_DIR and launch A would resume from it
+    # instead of training its own 20 steps
+    env.pop("MXNET_ELASTIC_DIR", None)
+    res = subprocess.run([sys.executable, worker], env=env,
+                         capture_output=True, text=True, timeout=420)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "CONTROL_RESULT ok" in res.stdout, res.stdout + res.stderr
+    print("control: 20-step single-process fused+ZeRO run — ok")
+
+
+def _launch2(worker, phase, extra_env):
+    env = _base_env()
+    env["POD_TRAIN_PHASE"] = phase
+    env["MXNET_POD_METRICS"] = "1"
+    env["MXNET_POD_METRICS_ADDR"] = "127.0.0.1:%d" % _free_port()
+    env["MXNET_POD_PUSH_S"] = "0"            # push every step
+    env.update(extra_env)
+    launch = os.path.join(REPO, "tools", "launch.py")
+    # Gloo inter-process connects can time out on a saturated host —
+    # retry like tests/test_launch_dist.py
+    for _ in range(3):
+        res = subprocess.run(
+            [sys.executable, launch, "-n", "2", "--launcher", "local",
+             sys.executable, worker],
+            env=env, capture_output=True, text=True, timeout=420)
+        if res.returncode == 0:
+            break
+    assert res.returncode == 0, res.stdout + res.stderr
+    out = res.stdout
+    marker = phase.upper()
+    assert "RANK0_%s ok" % marker in out, out + res.stderr
+    assert "RANK1_%s ok" % marker in out, out + res.stderr
+    assert any(l.startswith("[rank 0] ") for l in out.splitlines())
+    assert any(l.startswith("[rank 1] ") for l in out.splitlines())
+    return out
+
+
+def check_train(worker):
+    out = _launch2(worker, "train", {
+        # rank 1's 3.5 s stall sits between straggler (2 s) and death
+        # (3x = 6 s) thresholds: a straggler verdict, not a presumed death
+        "MXNET_POD_STRAGGLER_AGE_S": "2",
+    })
+    assert "elastic: straggler incident" in out, out
+    assert "elastic: rejoined from durable checkpoint" in out, out
+    print("launch A: 2-process fused+ZeRO parity with control, straggler "
+          "checkpoint-and-rejoin at the agreed step — ok")
+
+
+def check_warm(worker):
+    out = _launch2(worker, "warm", {
+        "MXNET_POD_STRAGGLER_AGE_S": "30",   # nothing stalls here
+    })
+    assert "elastic: resumed from durable checkpoint" in out, out
+    print("launch B: both ranks AOT-warm (compile_s == 0.0, zero misses), "
+          "resumed at step 20, clean non-empty ledger diff — ok")
+
+
+def main():
+    _check_launcher_propagation()
+    shutil.rmtree(WORK, ignore_errors=True)
+    os.makedirs(WORK, exist_ok=True)
+    worker = os.path.join(WORK, "worker.py")
+    with open(worker, "w") as f:
+        f.write(WORKER)
+    check_control(worker)
+    check_train(worker)
+    check_warm(worker)
+    print("check_pod_train: all phases passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

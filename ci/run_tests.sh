@@ -124,6 +124,15 @@ case "$tier" in
     # mismatch with correlated (shared incident id) flightrec dumps on
     # both ranks, and raise a straggler verdict when rank 1 freezes
     ./dev.sh python ci/check_pod_obs.py
+    # pod-scale fused training smoke (ISSUE 20): a 2-process launch.py
+    # cluster joined into ONE 8-device dp mesh (fused step + ZeRO-1 over
+    # the process boundary, per-rank half-batches, Gloo CPU collectives)
+    # must match the single-process control bit-for-tolerance after a
+    # mid-run straggler checkpoint-and-rejoin through MXNET_ELASTIC_DIR,
+    # book its dp collectives as DCN bytes, and warm-restart from
+    # per-rank AOT caches with zero fresh compiles and a clean non-empty
+    # cross-rank ledger diff
+    ./dev.sh python ci/check_pod_train.py
     # telemetry unit tests (tests/test_telemetry.py) run as part of tests/
     ignore=()
     for f in "${NIGHTLY_FILES[@]}"; do ignore+=(--ignore "$f"); done

@@ -73,7 +73,11 @@ _FORMAT = 1  # bump to invalidate every on-disk entry
 
 _mu = threading.Lock()
 _stats = {"hits": 0, "misses": 0, "errors": 0,
-          "xla_hits": 0, "xla_misses": 0}
+          "xla_hits": 0, "xla_misses": 0,
+          # wall seconds spent in tier-1 XLA compiles this process (ISSUE
+          # 20): a warm restart must read 0.0 here on EVERY rank — the
+          # train-side analog of the serving warmup's aot_compile_s
+          "compile_s": 0.0}
 _activated_dir = None
 _listener_registered = False
 
@@ -284,6 +288,13 @@ def _env_fingerprint(mesh_desc=None):
     fp = {"format": _FORMAT, "jax": jv, "jaxlib": jlv,
           "backend": jax.default_backend(),
           "device_kind": str(devs[0].device_kind), "n_devices": len(devs),
+          # process topology (ISSUE 20): an executable compiled for an
+          # N-process pod encodes cross-host collectives — restoring it in
+          # a job with a different process count (or as the wrong rank
+          # count after an elastic resize) must miss cleanly.  Every rank
+          # of the SAME topology fingerprints identically, which is what
+          # makes N-process warm restarts warm on every rank.
+          "n_processes": jax.process_count(),
           "mesh": mesh_desc,
           "passes": graph_passes.pipeline_fingerprint(),
           # "numerics" (ISSUE 11): the cast-plan contract versions
@@ -441,9 +452,12 @@ class CachedFunction:
         return os.path.join(_exec_dir(), "%s-%s.jx" % (self._name, h[:32]))
 
     def _try_load(self, sig):
-        """Deserialize one entry, or None on ANY problem (mismatched key or
-        environment → ``key_mismatch``; truncated/corrupt/unreadable →
-        ``deserialize``) — the cache must never turn into a crash."""
+        """Deserialize one entry → ``(executable, cost_fingerprint)``, or
+        None on ANY problem (mismatched key or environment →
+        ``key_mismatch``; truncated/corrupt/unreadable → ``deserialize``)
+        — the cache must never turn into a crash.  ``cost_fingerprint``
+        is the flops/bytes identity captured when the entry was stored
+        (None for entries written before it existed)."""
         path = self._path(sig)
         if not os.path.exists(path):
             return None
@@ -460,7 +474,7 @@ class CachedFunction:
             exe = serialize_executable.deserialize_and_load(
                 payload["blob"], payload["in_tree"], payload["out_tree"])
             os.utime(path, None)  # LRU signal for _evict
-            return exe
+            return exe, payload.get("cost")
         except Exception:
             _note("errors", "deserialize")
             return None
@@ -473,11 +487,19 @@ class CachedFunction:
         try:
             from jax.experimental import serialize_executable
 
+            from .telemetry import costplane
+
             blob, in_tree, out_tree = serialize_executable.serialize(compiled)
             payload = {"key": self._key, "sig": self._sig_str(sig),
                        "env": _env_fingerprint(self._mesh_desc),
                        "blob": blob, "in_tree": in_tree,
-                       "out_tree": out_tree}
+                       "out_tree": out_tree,
+                       # cost identity of the program as compiled (ISSUE
+                       # 20): a restore records a ledger row from this, so
+                       # a warm pod restart still proves every rank runs
+                       # the identical program via the cross-rank
+                       # ledger-divergence diff
+                       "cost": costplane.cost_fingerprint(compiled)}
             path = self._path(sig)
             tmp = "%s.tmp.%d" % (path, os.getpid())
             with open(tmp, "wb") as f:
@@ -500,11 +522,19 @@ class CachedFunction:
             if sig in self._exes:
                 return {"sig": sig, "source": "cached", "lower_s": 0.0}
         t0 = time.perf_counter()
-        exe = self._try_load(sig) if self._persist else None
-        if exe is not None:
+        loaded = self._try_load(sig) if self._persist else None
+        if loaded is not None:
+            exe, cost = loaded
             with self._lock:
                 self._exes[sig] = exe
             _note("hits")
+            from .telemetry import costplane
+
+            # warm-restart ledger row (ISSUE 20): compile_s 0.0, cost
+            # identity carried from the entry — the pod divergence
+            # detector can still diff this rank against the fleet
+            costplane.record_restore(self._name, self._key,
+                                     self._sig_str(sig), cost)
             return {"sig": sig, "source": "disk",
                     "lower_s": time.perf_counter() - t0}
         from .telemetry import costplane
@@ -535,6 +565,8 @@ class CachedFunction:
         t0 = time.perf_counter()
         compiled = handle["lowered"].compile()
         compile_s = time.perf_counter() - t0
+        with _mu:
+            _stats["compile_s"] += compile_s
         from .telemetry import costplane
 
         if costplane.enabled():

@@ -75,19 +75,31 @@ class KVStore:
     def _is_dist(self):
         return "dist" in self._type
 
-    def folds_into_fused_step(self):
+    def folds_into_fused_step(self, mesh=None):
         """True when this store's aggregation is subsumed by the in-step dp
         psum of the sharded fused Module train step (ISSUE 5,
-        ``module/fused_step.py``): a local-family store whose only job is
-        summing per-device gradient replicas.  A single-process mesh step
-        produces ONE logical gradient already reduced over dp inside the
-        compiled step, so push/pull would be an identity round-trip.  Stores
-        that do real work per push keep the legacy path: dist types
-        (cross-process DCN aggregation), an installed updater/optimizer
-        (the update itself runs in the store), and gradient compression
-        (quantization + error feedback are push-time side effects)."""
-        return (not self._is_dist and self._updater is None
-                and self._compression is None)
+        ``module/fused_step.py``): a store whose only job is summing
+        per-device gradient replicas.  A single-process mesh step produces
+        ONE logical gradient already reduced over dp inside the compiled
+        step, so push/pull would be an identity round-trip.  Stores that do
+        real work per push keep the legacy path: an installed
+        updater/optimizer (the update itself runs in the store) and gradient
+        compression (quantization + error feedback are push-time side
+        effects).
+
+        Dist types fold too once ``mesh`` SPANS the job's processes (ISSUE
+        20): GSPMD's in-step psum over a process-crossing dp axis IS the
+        cross-host DCN aggregation the dist store would have performed — the
+        fallback only remains for a dist store whose mesh is single-host
+        (its devices see 1/num_workers of the gradient and someone must sum
+        across hosts)."""
+        if self._updater is not None or self._compression is not None:
+            return False
+        if not self._is_dist:
+            return True
+        from .parallel.mesh import mesh_process_count
+
+        return mesh is not None and mesh_process_count(mesh) == self.num_workers
 
     # -- core API ----------------------------------------------------------
     def init(self, key, value):

@@ -48,7 +48,7 @@ def _create_kvstore(kvstore, num_device, arg_params, mesh=None):
         kv = None
     elif isinstance(kvstore, kv_mod.KVStore):
         kv = kvstore
-        if mesh is not None and kv.folds_into_fused_step():
+        if mesh is not None and kv.folds_into_fused_step(mesh):
             # explicit local-family store under a dp mesh: keep the store as
             # the (identity) grad-aggregation layer but let the local
             # updater own the optimizer, so the fused step can absorb the
@@ -65,6 +65,13 @@ def _create_kvstore(kvstore, num_device, arg_params, mesh=None):
             if kvstore == "local":
                 max_size = max(int(__import__("numpy").prod(p.shape)) for p in arg_params.values())
                 update_on_kvstore = max_size < 1024 * 1024 * 16
+            elif mesh is not None and kv.folds_into_fused_step(mesh):
+                # dist spec under a PROCESS-SPANNING mesh (ISSUE 20): the
+                # fused step's GSPMD psum over the host-crossing dp axis IS
+                # the cross-process aggregation, so the local updater owns
+                # the optimizer and the store stays an (idle) identity
+                # layer — same contract as the explicit-instance fold above
+                update_on_kvstore = False
     else:
         raise TypeError("kvstore must be KVStore, str or None")
     if kv is None:

@@ -246,6 +246,17 @@ class BaseModule:
         # batch feeds the rank's mergeable step histogram and (throttled)
         # pushes a snapshot to rank 0.  Gate unset = one env read, None.
         pod = telemetry.podplane.plane()
+        # elastic durable checkpoints + straggler checkpoint-and-rejoin
+        # (ISSUE 20, MXNET_ELASTIC_DIR): periodic collective orbax saves,
+        # resume-and-fast-forward on relaunch, and the podplane incident
+        # response.  Needs the executor/updater seams, so only Module-like
+        # subclasses participate.  Gate unset = one env read, None.
+        from .elastic import controller as _elastic_controller
+
+        elastic = (_elastic_controller()
+                   if getattr(self, "_exec", None) is not None else None)
+        global_step = 0
+        resume_step = elastic.resume(self) if elastic is not None else 0
 
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
@@ -259,6 +270,19 @@ class BaseModule:
                 probe.record_data_wait(time.perf_counter() - t0)
             while not end_of_batch:
                 data_batch = next_data_batch
+                if global_step < resume_step:
+                    # fast-forward: this step already ran before the
+                    # restart (its effect is inside the restored durable
+                    # checkpoint) — advance the deterministic iterator
+                    # without recomputing, so the resumed run sees the
+                    # same batch at the same global step as the original
+                    try:
+                        next_data_batch = next(data_iter)
+                    except StopIteration:
+                        end_of_batch = True
+                    global_step += 1
+                    nbatch += 1
+                    continue
                 t_batch = (time.perf_counter()
                            if probe or frec is not None
                            or pod is not None else 0.0)
@@ -308,6 +332,11 @@ class BaseModule:
                     health.drain(self, epoch=epoch, step=nbatch)
                 if pod is not None:
                     pod.note_step(time.perf_counter() - t_batch)
+                global_step += 1
+                if elastic is not None:
+                    # step-boundary hook: periodic durable save, straggler
+                    # checkpoint-and-rejoin, rank-death fail-fast
+                    elastic.after_step(self, global_step, pod)
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:
@@ -339,6 +368,24 @@ class BaseModule:
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
 
             train_data.reset()
+
+        if elastic is not None:
+            # last-step durable save (force: the interval would usually
+            # skip it) so a relaunch after normal completion fast-forwards
+            # the whole run instead of retraining the tail
+            if elastic._mgr.latest_step() != global_step:
+                elastic._mgr.save(global_step, elastic._tree(self),
+                                  force=True)
+                elastic.saves += 1
+            self._elastic_stats = elastic.stats()
+            elastic.close()
+
+    def elastic_stats(self):
+        """The elastic controller's summary from the last ``fit`` run
+        (ISSUE 20) — ``{dir, resume_step, rejoins, last_rejoin_step,
+        saves, steps}``; None before fit or with ``MXNET_ELASTIC_DIR``
+        unset."""
+        return getattr(self, "_elastic_stats", None)
 
     # -- misc hooks ----------------------------------------------------------
     def prepare(self, data_batch):

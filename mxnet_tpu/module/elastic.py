@@ -1,0 +1,313 @@
+"""Elastic fit-loop controller (ISSUE 20) — durable checkpoints plus the
+straggler checkpoint-and-rejoin response, lifted into ``BaseModule.fit``.
+
+The failure story this closes (ROADMAP item 2, SURVEY §5.3): the
+reference survived worker churn because the parameter server held the
+authoritative state and a relaunched worker pulled it back
+(``is_recovery`` in ps-lite).  Under the fused pod path there is no
+server — state lives sharded across every rank inside one GSPMD
+program, so a single slow or dead rank stalls the whole fleet inside a
+collective.  The controller turns both failure modes into bounded,
+observable events:
+
+* **rank death → fail-fast → resume.**  Every rank writes a durable
+  orbax checkpoint every ``MXNET_ELASTIC_SAVE_STEPS`` global steps
+  (collective, sharded, rotated).  When rank 0's podplane detector
+  presumes a rank dead (push age past ``death_age_s``), the incident
+  rides push responses to every surviving rank and ``after_step``
+  raises — crashing out of a doomed collective beats hanging in it.
+  The relaunch calls ``resume`` before the first step: the latest
+  durable checkpoint reshards onto the (possibly different) mesh via
+  ``CheckpointManager.restore(like=...)`` and fit fast-forwards the
+  data iterator to the restored global step.
+
+* **straggler → checkpoint-and-rejoin.**  A straggler incident carries
+  ``rejoin_step`` (fleet head + ``MXNET_ELASTIC_REJOIN_MARGIN``), a
+  step boundary every lockstepped rank still has ahead of it.  Each
+  rank, on reaching it, force-saves the durable checkpoint, waits for
+  commit, restores it back and rebinds — a value-preserving rebase
+  through durable storage.  Parity holds (restore returns the exact
+  bytes just saved); what the fleet gains is a guaranteed-fresh
+  recovery point plus one agreed boundary where a relaunched or
+  recovered rank can rejoin, instead of silently stalling the
+  collective for the straggler's whole lag.
+
+Gate: ``MXNET_ELASTIC_DIR`` unset ⇒ :func:`controller` returns None and
+fit runs the unchanged loop (one env read — the planes idiom).
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+__all__ = ["controller", "ElasticController", "save_interval_steps",
+           "max_to_keep"]
+
+
+def _env_int(name, default, minimum=1):
+    try:
+        v = int(os.environ.get(name, "") or default)
+    except ValueError:
+        v = default
+    return max(minimum, v)
+
+
+def save_interval_steps():
+    """``MXNET_ELASTIC_SAVE_STEPS`` (default 50): durable-checkpoint
+    interval in global steps.  Collective + async (orbax overlaps the
+    commit with training), so the steady-state cost is the device→host
+    shard copy."""
+    return _env_int("MXNET_ELASTIC_SAVE_STEPS", 50)
+
+
+def max_to_keep():
+    """``MXNET_ELASTIC_KEEP`` (default 3): checkpoints retained."""
+    return _env_int("MXNET_ELASTIC_KEEP", 3)
+
+
+def controller():
+    """→ :class:`ElasticController` when ``MXNET_ELASTIC_DIR`` is set,
+    else None."""
+    path = (os.environ.get("MXNET_ELASTIC_DIR") or "").strip()
+    if not path:
+        return None
+    return ElasticController(path)
+
+
+class ElasticController:
+    """One fit loop's durable-checkpoint + rejoin state machine.
+
+    The checkpoint tree covers exactly what a mid-training restart
+    needs: every trainable param, every aux, and the
+    Updater's optimizer-state leaves, keyed by the fused step's
+    parameter order (``module._param_names``) so a restore commits back
+    through the same ``_rebind``/``_commit_state`` seams the fused step
+    itself uses.  Checkpoint step indices are *global step counts*
+    (completed steps since epoch 0) — identical on every rank under the
+    fused path's lockstep, which is what makes the orbax save
+    collective-safe.
+    """
+
+    def __init__(self, directory):
+        from ..parallel.checkpoint import CheckpointManager
+
+        self._dir = os.path.abspath(directory)
+        self._mgr = CheckpointManager(self._dir, max_to_keep=max_to_keep(),
+                                      save_interval_steps=save_interval_steps())
+        self._log = logging.getLogger("mxnet_tpu.elastic")
+        self._rejoin_step = None
+        self._rejoin_incident = None
+        self.resume_step = 0
+        self.rejoins = 0
+        self.last_rejoin_step = None
+        self.saves = 0
+
+    # -- state tree ----------------------------------------------------------
+    def _tree(self, module):
+        from .fused_step import _state_leaves
+
+        exec_ = module._exec
+        tree = {"arg": {n: exec_.arg_dict[n]._data
+                        for n in module._param_names}}
+        if module._aux_names:
+            # empty subtrees are pruned (orbax rejects empty containers);
+            # models without aux state (no BN) just have no "aux" key
+            tree["aux"] = {n: exec_.aux_dict[n]._data
+                           for n in module._aux_names}
+        upd = getattr(module, "_updater", None)
+        if upd is not None and getattr(upd, "states", None):
+            opt = {}
+            for i, st in upd.states.items():
+                leaves = _state_leaves(st)
+                if leaves:
+                    opt[str(i)] = leaves
+            if opt:
+                tree["opt"] = opt
+        return tree
+
+    def _commit(self, module, restored):
+        from .fused_step import _commit_state
+
+        exec_ = module._exec
+        for n in module._param_names:
+            exec_.arg_dict[n]._rebind(restored["arg"][n])
+        for n in module._aux_names:
+            exec_.aux_dict[n]._rebind(restored["aux"][n])
+        upd = getattr(module, "_updater", None)
+        for key, leaves in (restored.get("opt") or {}).items():
+            _commit_state(upd.states[int(key)], list(leaves))
+
+    def _materialize_opt(self, module):
+        """Create the Updater's lazy optimizer-state slots before building
+        the ``like`` tree — a just-initialized module hasn't run a step
+        yet, but the checkpoint being restored has ``opt`` entries and
+        ``StandardRestore`` needs matching structure.  Mirrors the fused
+        step's own lazy materialization (same index = ``_param_names``
+        order), including the mesh layout: under a mesh the fresh leaves
+        are committed to the exact sharding the fused step pins (ZeRO-1
+        1/dp shards when ``MXNET_FUSED_ZERO`` is on, else replicated), so
+        the orbax restore reshards straight onto process-spanning global
+        arrays and the first step's ``_place`` is a no-op."""
+        upd = getattr(module, "_updater", None)
+        opt = getattr(module, "_optimizer", None)
+        names = getattr(module, "_param_names", None)
+        if upd is None or opt is None or not names:
+            return
+        exec_ = module._exec
+        mesh = getattr(module, "_mesh", None)
+        place = None
+        if mesh is not None:
+            import jax
+
+            from ..parallel import zero_shard_spec
+            from ..parallel.mesh import named_sharding
+            from .fused_step import fused_zero_enabled
+
+            zero = fused_zero_enabled()
+
+            def place(leaf):
+                import numpy as np
+
+                host = np.asarray(leaf._data)
+                sh = (zero_shard_spec(host, mesh) if zero
+                      else named_sharding(mesh))
+                # make_array_from_callback: correct on single-host AND
+                # process-spanning meshes (each process materializes only
+                # its addressable shards)
+                arr = jax.make_array_from_callback(
+                    host.shape, sh, lambda idx: host[idx])
+                return type(leaf)(arr)
+        for i, n in enumerate(names):
+            if i not in upd.states:
+                st = opt.create_state(i, exec_.arg_dict[n])
+                if place is not None and st is not None:
+                    if isinstance(st, (tuple, list)):
+                        st = type(st)(place(leaf) for leaf in st)
+                    else:
+                        st = place(st)
+                upd.states[i] = st
+                upd.states_synced[i] = True
+
+    def _globalize_params(self, module):
+        """Under a mesh, commit every param/aux to the replicated global
+        layout BEFORE the ``like`` tree is built.  ``resume`` runs right
+        after ``init_params``, when the buffers are still host arrays —
+        a ``like`` without shardings would make orbax restore committed
+        single-device arrays, which the fused step cannot legally
+        ``device_put`` onto a process-spanning mesh.  Globalizing first
+        means the restore reshards straight onto the mesh and the first
+        step's ``_place`` is a sharding == no-op."""
+        mesh = getattr(module, "_mesh", None)
+        if mesh is None:
+            return
+        import jax
+        import numpy as np
+
+        from ..parallel.mesh import named_sharding
+
+        repl = named_sharding(mesh)
+        exec_ = module._exec
+
+        def _fix(nd):
+            v = nd._data
+            if getattr(v, "sharding", None) == repl:
+                return
+            if hasattr(v, "is_fully_addressable") and \
+                    not v.is_fully_addressable:
+                return  # already global in some other layout: leave it
+            host = np.asarray(v)
+            nd._rebind(jax.make_array_from_callback(
+                host.shape, repl, lambda idx: host[idx]))
+
+        for n in module._param_names:
+            _fix(exec_.arg_dict[n])
+        for n in module._aux_names:
+            _fix(exec_.aux_dict[n])
+
+    # -- lifecycle -----------------------------------------------------------
+    def resume(self, module):
+        """Restore the latest durable checkpoint into the bound module →
+        the global step to resume from (0 = fresh start).  Restoring via
+        ``like=`` reshards onto the module's current mesh, so a relaunch
+        on a different topology comes back correct or fails loudly on a
+        real shape mismatch — never a silent misassignment."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return 0
+        self._globalize_params(module)
+        self._materialize_opt(module)
+        like = self._tree(module)
+        restored = self._mgr.restore(step=step, like=like)
+        self._commit(module, restored)
+        self.resume_step = int(step)
+        self._log.warning(
+            "elastic: resumed from durable checkpoint %s at global step %d",
+            self._dir, self.resume_step)
+        return self.resume_step
+
+    def after_step(self, module, global_step, pod=None):
+        """Step-boundary hook (``global_step`` = completed steps).  Order
+        matters: consume incidents first (a rejoin order must not be
+        deferred behind a periodic save), then execute a due rejoin,
+        else let the manager's ``save_interval_steps`` decide on the
+        periodic save.  Returns True iff a rejoin rebase ran at this
+        boundary."""
+        if pod is not None and self._rejoin_step is None:
+            inc = pod.pending_rejoin()
+            if inc is not None:
+                if inc.get("reason") == "rank_death":
+                    # fail-fast: the dead rank can't join a collective
+                    # save, and the next fused step would hang on it.
+                    # The durable checkpoint already on disk is the
+                    # recovery point for the relaunch.
+                    raise RuntimeError(
+                        "elastic: rank %s presumed dead (incident %s); "
+                        "failing fast — relaunch resumes from durable "
+                        "checkpoint step %s in %s"
+                        % (inc.get("rank"), inc.get("id"),
+                           self._mgr.latest_step(), self._dir))
+                self._rejoin_step = int(inc["meta"]["rejoin_step"])
+                self._rejoin_incident = inc.get("id")
+                if self._rejoin_step <= global_step:
+                    # observed past the agreed boundary (possible only if
+                    # lockstep was broken, e.g. single-process tests):
+                    # rebase at the very next boundary instead
+                    self._rejoin_step = global_step + 1
+                self._log.warning(
+                    "elastic: straggler incident %s (rank %s, lag %s) — "
+                    "checkpoint-and-rejoin at global step %d",
+                    inc.get("id"), inc.get("rank"),
+                    (inc.get("meta") or {}).get("lag_steps"),
+                    self._rejoin_step)
+        if self._rejoin_step is not None and global_step >= self._rejoin_step:
+            # every rank passes this same agreed boundary (lockstep keeps
+            # the fleet within one step), so the step index below is
+            # identical fleet-wide — the collective-save requirement
+            step = self._rejoin_step
+            self._rejoin_step = None
+            tree = self._tree(module)
+            self._mgr.save(step, tree, force=True)
+            self._mgr.wait_until_finished()
+            self._commit(module, self._mgr.restore(step=step, like=tree))
+            self.rejoins += 1
+            self.last_rejoin_step = step
+            self.saves += 1
+            self._log.warning(
+                "elastic: rejoined from durable checkpoint at global step "
+                "%d (incident %s)", step, self._rejoin_incident)
+            return True
+        if self._mgr.save(global_step, self._tree(module)):
+            self.saves += 1
+        return False
+
+    def stats(self):
+        return {"dir": self._dir, "resume_step": self.resume_step,
+                "rejoins": self.rejoins,
+                "last_rejoin_step": self.last_rejoin_step,
+                "saves": self.saves, "steps": self._mgr.all_steps()}
+
+    def close(self):
+        try:
+            self._mgr.wait_until_finished()
+        finally:
+            self._mgr.close()

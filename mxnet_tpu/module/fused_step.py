@@ -68,6 +68,21 @@ def fused_zero_enabled():
     return env_flag("MXNET_FUSED_ZERO")
 
 
+def fused_donate_enabled():
+    """``MXNET_FUSED_DONATE`` gate (docs/ENV_VARS.md) — default ON.
+
+    ``0`` builds the fused step WITHOUT donated operands.  The use case:
+    restored *donated* executables are skipped on the CPU backend
+    (the donation hazard, ``compile_cache.py`` docstring), so a CPU pod
+    restart re-pays the train-step compile even with ``MXNET_AOT_CACHE``
+    set.  Turning donation off makes the disk restore legal again — the
+    warm-restart CI (``ci/check_pod_train.py``) runs its second launch this
+    way to prove every rank restores the identical executable.  Costs the
+    donation's buffer recycling (params/grads/state copies per step), so
+    keep the default on TPU."""
+    return env_flag("MXNET_FUSED_DONATE", default="1")
+
+
 def fused_ineligible_reason(module):
     """None when the fused path can take this Module's next train step, else
     a short tag naming why not (doubles as the fallback-counter label).
@@ -95,15 +110,20 @@ def fused_ineligible_reason(module):
         return "monitor"
     if module._kvstore is not None or module._update_on_kvstore:
         kv = module._kvstore
-        if kv is not None and kv._is_dist:
-            # cross-process DCN aggregation happens outside the local step
-            return "kvstore_dist"
-        if not (module._mesh is not None and kv is not None
-                and not module._update_on_kvstore
-                and kv.folds_into_fused_step()):
+        folds = (module._mesh is not None and kv is not None
+                 and not module._update_on_kvstore
+                 and kv.folds_into_fused_step(module._mesh))
+        if not folds:
+            if kv is not None and kv._is_dist:
+                # dist store over a single-host mesh: the cross-process DCN
+                # aggregation happens outside the local step.  (Under a
+                # PROCESS-SPANNING mesh dist stores fold — GSPMD's in-step
+                # psum over the host-crossing dp axis is that aggregation.)
+                return "kvstore_dist"
             return "kvstore"
-        # local-family store under a dp mesh: its per-key aggregation IS the
-        # in-step psum — fused path proceeds, the store stays idle
+        # store folded under the dp mesh: its per-key aggregation IS the
+        # in-step psum (ICI single-host, DCN when dp spans processes) —
+        # fused path proceeds, the store stays idle
     if module._updater is None:
         return "no_optimizer"
     if module.inputs_need_grad:
@@ -281,6 +301,7 @@ class FusedStepper:
         self._last_health = None  # (step number, device stats pytree)
         self._mesh = module._mesh
         self._zero = self._mesh is not None and fused_zero_enabled()
+        self._donate = fused_donate_enabled()
         # the executor's bind-time graph-pass snapshot (ISSUE 7): the
         # stepper's step fn closes over the (possibly pass-optimized) train
         # plan, so the snapshot is program identity — it keys the AOT cache
@@ -305,7 +326,8 @@ class FusedStepper:
                 compile_cache.symbol_fingerprint(module._symbol),
                 tuple(self._diff_names), tuple(self._const_names),
                 tuple(self._aux_names), self._hp_sig, self._nancheck,
-                self._zero, self._mesh is not None, "donate:0123")
+                self._zero, self._mesh is not None,
+                "donate:0123" if self._donate else "donate:none")
             if self._health_groups is not None:
                 # appended (not an always-present flag) so gate-off keys
                 # stay byte-identical to pre-trainhealth entries
@@ -371,8 +393,9 @@ class FusedStepper:
 
         if self._step is not None:
             return
+        donate = (0, 1, 2, 3) if self._donate else ()
         if self._mesh is None:
-            self._jit = jax.jit(self._fn, donate_argnums=(0, 1, 2, 3))
+            self._jit = jax.jit(self._fn, donate_argnums=donate)
         else:
             from ..parallel import note_derived
 
@@ -383,22 +406,28 @@ class FusedStepper:
                 out_sh = out_sh + (None,)
             if self._health_groups is not None:
                 out_sh = out_sh + (None,)  # stats pytree: compiler-chosen
-            self._jit = jax.jit(self._fn, donate_argnums=(0, 1, 2, 3),
+            self._jit = jax.jit(self._fn, donate_argnums=donate,
                                 out_shardings=out_sh)
             # declared ONCE per stepper build (not per retrace like the
             # explicit lax collectives — a reshape re-specializes the same
-            # logical collectives, so one declaration per layout is honest)
+            # logical collectives, so one declaration per layout is honest).
+            # mesh= buckets the same bytes by slowest link crossed: dcn when
+            # the dp axis spans processes (pod), ici on a single host.
             if self._zero:
                 # only leaves zero_shard_spec actually splits ride the
                 # reduce-scatter/allgather; non-divisible leaves stay
                 # replicated and their grads are a plain psum
                 split = [v for v, s in zip(diff_vals, grad_sh) if s != repl]
                 whole = [v for v, s in zip(diff_vals, grad_sh) if s == repl]
-                note_derived("reduce_scatter", split)
-                note_derived("allgather", split)
-                note_derived("psum_grads", whole)
+                note_derived("reduce_scatter", split,
+                             mesh=self._mesh, axis=_DP_AXIS)
+                note_derived("allgather", split,
+                             mesh=self._mesh, axis=_DP_AXIS)
+                note_derived("psum_grads", whole,
+                             mesh=self._mesh, axis=_DP_AXIS)
             else:
-                note_derived("psum_grads", diff_vals)
+                note_derived("psum_grads", diff_vals,
+                             mesh=self._mesh, axis=_DP_AXIS)
         if self._aot_key is not None:
             from .. import compile_cache
 
@@ -406,11 +435,12 @@ class FusedStepper:
             # entirely — restored donated executables compute wrong
             # trajectories there (the donation hazard, compile_cache.py
             # docstring) — so a CPU restart re-pays this compile; TPU-class
-            # backends restore normally.  Cache off ⇒ the plain jit above.
+            # backends restore normally.  MXNET_FUSED_DONATE=0 makes the
+            # restore legal everywhere.  Cache off ⇒ the plain jit above.
             self._jit = compile_cache.CachedFunction(
                 self._jit, self._aot_key, name="fused_step",
                 mesh_desc=compile_cache.mesh_descriptor(self._mesh),
-                donated=True, passes_on=self._passes_on)
+                donated=self._donate, passes_on=self._passes_on)
         else:
             from ..telemetry import costplane
 
@@ -429,7 +459,7 @@ class FusedStepper:
                      tuple(self._diff_names), self._hp_sig, self._nancheck,
                      self._zero, self._mesh is not None, self._passes_on,
                      self._health_groups is not None),
-                    donated=True)
+                    donated=self._donate)
         # compile/steady-state accounting (identity when telemetry is off)
         self._step = telemetry.instrument_step(self._jit,
                                                name="module_fused_step")
@@ -455,6 +485,8 @@ class FusedStepper:
                 != self._monitor_attached
                 or (module._mesh is not None
                     and fused_zero_enabled() != self._zero)
+                # donation is executable identity (argnums + AOT key)
+                or fused_donate_enabled() != self._donate
                 # a re-bind whose executor snapshotted a different
                 # MXNET_GRAPH_PASSES state: the cached step fn closes over
                 # the other plan flavor — rebuild instead of mixing

@@ -209,8 +209,13 @@ class Module(BaseModule):
         self._grad_req = grad_req
         assert not (not for_training and inputs_need_grad)
 
-        self._data_shapes = _as_descs(data_shapes)
-        self._label_shapes = _as_descs(label_shapes)
+        # Under a process-spanning mesh the caller (fit's iterator contract)
+        # binds with HOST-LOCAL shapes; the jitted program must see global
+        # ones — every rank traces the same global computation and feeds its
+        # per-host shard (parallel.global_batch_array).  Single-host meshes
+        # scale by 1, keeping the descs byte-identical.
+        self._data_shapes = self._global_descs(_as_descs(data_shapes))
+        self._label_shapes = self._global_descs(_as_descs(label_shapes))
 
         shape_dict = {d.name: d.shape for d in self._data_shapes}
         if self._label_shapes:
@@ -302,7 +307,13 @@ class Module(BaseModule):
         # global batch like the reference (module.py:497 rescale_grad)
         batch_size = self._data_shapes[0].shape[0]
         if kv and "dist" in kv.type:
-            batch_size *= kv.num_workers
+            from ..parallel.mesh import mesh_spans_processes
+
+            # a process-spanning mesh already bound GLOBAL shapes (bind
+            # scaled the iterator-local descs), so the num_workers multiply
+            # would double-count the pod's batch
+            if not mesh_spans_processes(self._mesh):
+                batch_size *= kv.num_workers
         if isinstance(optimizer, str):
             optimizer_params = dict(optimizer_params or {})
             optimizer_params.setdefault("rescale_grad", 1.0 / batch_size)
@@ -350,6 +361,21 @@ class Module(BaseModule):
             DataDesc(n, a.shape) for n, a in zip(self._data_names, data_batch.data)
         ]
 
+    def _global_descs(self, descs):
+        """Scale iterator-local leading dims to the GLOBAL shapes the bound
+        program uses.  Identity (factor 1) everywhere except a mesh whose dp
+        axis spans processes, where each host feeds ``1/factor`` of the
+        batch."""
+        if not descs or self._mesh is None:
+            return descs
+        from ..parallel.mesh import mesh_batch_factor
+
+        factor = mesh_batch_factor(self._mesh)
+        if factor == 1:
+            return descs
+        return [DataDesc(d.name, (d.shape[0] * factor,) + tuple(d.shape[1:]))
+                for d in descs]
+
     def _build_feed(self, data_batch):
         """{arg name: device-ready NDArray} for a shape-matching batch —
         under a mesh every array is committed dp-sharded here (the
@@ -365,7 +391,21 @@ class Module(BaseModule):
             pass
         if self._mesh is not None:
             from ..parallel import shard
+            from ..parallel.mesh import global_batch_array, mesh_spans_processes
 
+            if mesh_spans_processes(self._mesh):
+                import numpy as np
+
+                # pod mesh: this host holds only its shard of the batch —
+                # assemble the global jax.Array from per-device local
+                # buffers (no host gathering, tentpole contract)
+                out = {}
+                for k, v in feed.items():
+                    arr = v.asnumpy() if isinstance(v, nd.NDArray) else np.asarray(v)
+                    spec = ("dp",) + (None,) * (arr.ndim - 1)
+                    out[k] = nd.NDArray(
+                        global_batch_array(arr, self._mesh, spec))
+                return out
             return {
                 k: shard(v if isinstance(v, nd.NDArray) else nd.array(v),
                          ("dp",) + (None,) * (len(v.shape) - 1), mesh=self._mesh)
@@ -387,7 +427,8 @@ class Module(BaseModule):
         example/python-howto/debug_conv.py SimpleData).
         """
         new_descs = self._batch_descs(data_batch)
-        if [d.shape for d in new_descs] != [d.shape for d in self._data_shapes]:
+        if ([d.shape for d in self._global_descs(new_descs)]
+                != [d.shape for d in self._data_shapes]):
             if getattr(data_batch, "provide_label", None):
                 new_labels = _as_descs(data_batch.provide_label)
             elif getattr(data_batch, "label", None) is not None and self._label_shapes:
@@ -422,7 +463,8 @@ class Module(BaseModule):
         if not (self.binded and self.params_initialized):
             return
         descs = self._batch_descs(data_batch)
-        if [d.shape for d in descs] != [d.shape for d in self._data_shapes]:
+        if ([d.shape for d in self._global_descs(descs)]
+                != [d.shape for d in self._data_shapes]):
             self._prestaged = None
             return
         self._prestaged = (data_batch, self._build_feed(data_batch))
@@ -566,7 +608,17 @@ class Module(BaseModule):
         return [self._exec.grad_dict[n] for n in self._data_names]
 
     def update_metric(self, eval_metric, labels):
-        eval_metric.update(labels, self.get_outputs())
+        outputs = self.get_outputs()
+        if self._mesh is not None:
+            from ..parallel.mesh import host_local_rows, mesh_spans_processes
+
+            if mesh_spans_processes(self._mesh):
+                # pod mesh: outputs are global arrays whose rows span other
+                # hosts — score THIS host's block against its local labels
+                # (per-worker metrics, the reference dist_sync semantics)
+                outputs = [nd.array(host_local_rows(o._data))
+                           for o in outputs]
+        eval_metric.update(labels, outputs)
 
     def trainer_stats(self):
         """The PROCESS's last drained trainhealth row (host floats:

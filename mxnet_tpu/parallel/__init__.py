@@ -30,6 +30,13 @@ from .mesh import (
     zero1_shardings,
     zero1_place,
     zero1_state_bytes,
+    mesh_process_count,
+    mesh_spans_processes,
+    mesh_axis_local_size,
+    mesh_axis_spans_processes,
+    mesh_batch_factor,
+    global_batch_array,
+    host_local_rows,
 )
 from .collectives import (allreduce, allgather, reduce_scatter, pmean,
                           psum_scatter, note_derived)
@@ -54,6 +61,13 @@ __all__ = [
     "zero1_shardings",
     "zero1_place",
     "zero1_state_bytes",
+    "mesh_process_count",
+    "mesh_spans_processes",
+    "mesh_axis_local_size",
+    "mesh_axis_spans_processes",
+    "mesh_batch_factor",
+    "global_batch_array",
+    "host_local_rows",
     "allreduce",
     "allgather",
     "reduce_scatter",

@@ -52,6 +52,40 @@ def _abstract_like(like):
         _to_jax_tree(like))
 
 
+def _verify_like_shapes(meta, like_abs):
+    """Fail loudly when ``like`` asks for a different *global* shape than
+    the checkpoint holds.  Orbax silently slices a larger saved array down
+    to a smaller requested shape — an elastic relaunch against the wrong
+    model would restore truncated garbage instead of raising.  Resharding
+    changes layout, never global shape, so any shape disagreement is a
+    real mismatch.  ``meta`` may be None (metadata unavailable) — then the
+    check is skipped and orbax's own structure errors still apply."""
+    import jax
+
+    if meta is None:
+        return
+    mismatched = []
+
+    def _chk(path, m, l):
+        ms = getattr(m, "shape", None)
+        ls = getattr(l, "shape", None)
+        if ms is not None and ls is not None and tuple(ms) != tuple(ls):
+            mismatched.append("%s: saved %s, requested %s"
+                              % (jax.tree_util.keystr(path),
+                                 tuple(ms), tuple(ls)))
+
+    try:
+        jax.tree_util.tree_map_with_path(_chk, meta, like_abs)
+    except (ValueError, TypeError):
+        # tree-structure disagreement: let orbax raise its own (clearer)
+        # structure error from the restore itself
+        return
+    if mismatched:
+        raise ValueError(
+            "checkpoint/like global-shape mismatch (refusing a silently "
+            "truncated restore): " + "; ".join(mismatched))
+
+
 def _checkpointer(use_async=False):
     import orbax.checkpoint as ocp
 
@@ -125,7 +159,13 @@ def restore(path, like=None, mesh=None, rules=None):
     ckptr = _checkpointer()
     try:
         if like is not None:
-            return ckptr.restore(path, _abstract_like(like))
+            like_abs = _abstract_like(like)
+            try:
+                meta = ckptr.metadata(path)
+            except Exception:
+                meta = None
+            _verify_like_shapes(meta, like_abs)
+            return ckptr.restore(path, like_abs)
         out = ckptr.restore(path)
         if mesh is not None:
             from .mesh import shard_params
@@ -170,8 +210,14 @@ class CheckpointManager:
         if step is None:
             raise FileNotFoundError("no checkpoints in %s" % self._mgr.directory)
         if like is not None:
+            like_abs = _abstract_like(like)
+            try:
+                meta = self._mgr.item_metadata(step)
+            except Exception:
+                meta = None
+            _verify_like_shapes(meta, like_abs)
             return self._mgr.restore(
-                step, args=ocp.args.StandardRestore(_abstract_like(like)))
+                step, args=ocp.args.StandardRestore(like_abs))
         return self._mgr.restore(step)
 
     def latest_step(self):

@@ -36,7 +36,7 @@ def _note_bytes(op, tree):
     telemetry.note_bytes("collective_bytes_total", n, op=op)
 
 
-def note_derived(op, tree):
+def note_derived(op, tree, mesh=None, axis="dp"):
     """Record telemetry bytes for a collective GSPMD *derives* from sharding
     annotations rather than an explicit ``lax`` call site — the sharded
     fused Module step (``module/fused_step.py``) declares its in-step grad
@@ -44,8 +44,30 @@ def note_derived(op, tree):
     stepper *build* (one sample per collective layout), a coarser grain
     than the explicit collectives above (one sample per trace): a reshape
     retrace re-specializes the same logical collectives, so it is not
-    re-declared."""
+    re-declared.
+
+    With ``mesh`` given, the same bytes also land in
+    ``collective_link_bytes_total{link, op}`` bucketed by the slowest link
+    the collective's ``axis`` crosses: ``dcn`` when walking that mesh axis
+    crosses a process boundary (pod-spanning dp — the payload rides the
+    data-center network at least once per hop ring), else ``ici``.  The
+    unlabeled ``collective_bytes_total{op}`` series is unchanged, so
+    existing dashboards keep working."""
     _note_bytes(op, tree)
+    if mesh is None:
+        return
+    from .. import telemetry
+
+    if not telemetry.enabled():
+        return
+    import jax
+
+    from .mesh import mesh_axis_spans_processes
+
+    link = "dcn" if mesh_axis_spans_processes(mesh, axis) else "ici"
+    n = sum(telemetry.array_nbytes(leaf)
+            for leaf in jax.tree_util.tree_leaves(tree))
+    telemetry.note_bytes("collective_link_bytes_total", n, link=link, op=op)
 
 
 def allreduce(tree, axis_name="dp"):

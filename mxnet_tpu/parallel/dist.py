@@ -69,6 +69,22 @@ def init(coordinator_address=None, num_processes=None, process_id=None,
         kw["initialization_timeout"] = int(initialization_timeout)
     import jax
 
+    # CPU backend: select the Gloo collectives implementation BEFORE the
+    # backend instantiates — without one the CpuClient rejects every
+    # process-spanning computation ("Multiprocess computations aren't
+    # implemented on the CPU backend"), which would make the pod-mesh
+    # paths (fused step + ZeRO over a 2-process fake cluster, orbax
+    # collective saves) untestable off-TPU.  Gated on an explicit CPU
+    # platform selection so real TPU/GPU pods are untouched; the flag
+    # only affects CPU client creation.
+    plats = (os.environ.get("JAX_PLATFORMS")
+             or os.environ.get("JAX_PLATFORM_NAME") or "")
+    if "cpu" in plats.split(","):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass  # older jax without the option: single-host tests only
+
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

@@ -72,6 +72,132 @@ def make_mesh(axes=None, devices=None, **axis_sizes):
     return Mesh(dev_array, tuple(names))
 
 
+def mesh_process_count(mesh):
+    """Number of distinct JAX processes owning devices of ``mesh``.
+
+    ``1`` for any single-host mesh; ``> 1`` means the mesh spans a pod —
+    collectives over any axis crossing a process boundary ride DCN, batch
+    arrays must be assembled from per-host shards
+    (:func:`global_batch_array`), and a distributed KVStore's grad psum is
+    subsumed by the in-step GSPMD collective
+    (``KVStore.folds_into_fused_step``)."""
+    if mesh is None:
+        return 1
+    return len({d.process_index for d in mesh.devices.flat})
+
+
+def mesh_spans_processes(mesh):
+    """True when ``mesh`` places devices from more than one process."""
+    return mesh_process_count(mesh) > 1
+
+
+def mesh_axis_local_size(mesh, axis="dp"):
+    """Distinct coordinates along ``axis`` held by THIS process's devices.
+
+    For a single-host mesh this equals ``mesh.shape[axis]``; over a pod it
+    is the slice of the axis this host covers — the local-to-global batch
+    scale is ``mesh.shape[axis] / mesh_axis_local_size(mesh, axis)``."""
+    import jax
+
+    if axis not in mesh.axis_names:
+        return 1
+    pos = mesh.axis_names.index(axis)
+    pi = jax.process_index()
+    coords = {idx[pos] for idx, dev in np.ndenumerate(mesh.devices)
+              if dev.process_index == pi}
+    return max(1, len(coords))
+
+
+def mesh_batch_factor(mesh, axis="dp"):
+    """Global-batch over local-batch scale for ``mesh`` along ``axis``.
+
+    ``1`` on a single host; ``n_processes_spanned_by_axis`` over a pod —
+    the factor ``Module`` applies to iterator-local leading dims to get the
+    global shapes the jitted program binds."""
+    if mesh is None or axis not in getattr(mesh, "axis_names", ()):
+        return 1
+    return mesh.shape[axis] // mesh_axis_local_size(mesh, axis)
+
+
+def mesh_axis_spans_processes(mesh, axis="dp"):
+    """True when walking ``axis`` (other coords fixed) crosses a process
+    boundary — the collective over that axis rides DCN, not ICI."""
+    if mesh is None or axis not in getattr(mesh, "axis_names", ()):
+        return False
+    pos = mesh.axis_names.index(axis)
+    devs = np.moveaxis(mesh.devices, pos, 0)
+    flat = devs.reshape(devs.shape[0], -1)
+    for col in range(flat.shape[1]):
+        if len({d.process_index for d in flat[:, col]}) > 1:
+            return True
+    return False
+
+
+def global_batch_array(local, mesh, spec):
+    """Assemble one globally-shaped, mesh-sharded ``jax.Array`` from THIS
+    process's local batch shard — no host ever gathers another host's data.
+
+    ``local`` is the rows this host's data pipeline produced (numpy or
+    array-like); ``spec`` is the partition spec (first entry names the batch
+    axis, canonically ``"dp"``).  The global shape scales the leading dim by
+    :func:`mesh_batch_factor`; per-device buffers are cut from ``local`` in
+    ascending global-offset order (devices sharing an identical leading
+    slice — replicated trailing axes — receive the same chunk) and stitched
+    with ``jax.make_array_from_single_device_arrays``.  With the default
+    ``make_mesh`` layout, process ``r``'s rows land at global offset
+    ``r * local_rows``, so a pod run feeding each rank the matching slice of
+    one logical dataset is bit-identical to the single-process run on the
+    full batch."""
+    import jax
+
+    spec = tuple(spec) if isinstance(spec, (list, tuple)) else (spec,)
+    sh = named_sharding(mesh, *spec)
+    arr = np.asarray(local)
+    axis = spec[0] if spec and spec[0] else "dp"
+    factor = mesh_batch_factor(mesh, axis)
+    if factor == 1:
+        return jax.device_put(arr, sh)
+    global_shape = (arr.shape[0] * factor,) + tuple(arr.shape[1:])
+    idx_map = sh.addressable_devices_indices_map(global_shape)
+    by_start = {}
+    for dev, idx in idx_map.items():
+        lead = idx[0] if idx else slice(None)
+        by_start.setdefault(lead.start or 0, []).append((dev, idx))
+    starts = sorted(by_start)
+    if arr.shape[0] % len(starts):
+        raise ValueError(
+            "local batch of %d rows not divisible over %d local shards"
+            % (arr.shape[0], len(starts)))
+    chunk = arr.shape[0] // len(starts)
+    bufs = []
+    for i, start in enumerate(starts):
+        rows = arr[i * chunk:(i + 1) * chunk]
+        for dev, idx in by_start[start]:
+            piece = rows[(slice(None),) + tuple(idx[1:])] if len(idx) > 1 \
+                else rows
+            bufs.append(jax.device_put(piece, dev))
+    return jax.make_array_from_single_device_arrays(global_shape, sh, bufs)
+
+
+def host_local_rows(x):
+    """This process's contiguous leading-axis block of a (possibly
+    process-spanning) ``jax.Array``, as numpy — the metric/readback
+    counterpart of :func:`global_batch_array`.  A fully-replicated array
+    returns its full value; a dp-sharded one returns exactly the rows this
+    host fed, so per-rank metrics line up with per-rank labels."""
+    import numpy as np
+
+    shards = getattr(x, "addressable_shards", None)
+    if not shards:
+        return np.asarray(x)
+    by_start = {}
+    for s in shards:
+        lead = s.index[0] if s.index else slice(None)
+        by_start.setdefault(lead.start or 0, s)
+    parts = [np.asarray(by_start[k].data) for k in sorted(by_start)]
+    return parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
+
+
 def set_default_mesh(mesh):
     """Install ``mesh`` as the process default (returned by current_mesh())."""
     _state.default = mesh

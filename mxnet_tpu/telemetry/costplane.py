@@ -334,6 +334,61 @@ def record_compile(site, key, sig, compiled, compile_s, tc0=None):
         return None
 
 
+def cost_fingerprint(compiled):
+    """flops/bytes identity of one compiled executable, for persisting
+    alongside an AOT-cache entry (compile_cache ``_store``) → dict or
+    None.  Captured at store time — ``deserialize_and_load`` results may
+    not answer ``cost_analysis`` — so a restore's ledger row carries the
+    program's identity as compiled.  Never raises."""
+    try:
+        feat, _ = extract(compiled)
+        return {"flops": feat.get("flops"),
+                "bytes_accessed": feat.get("bytes_accessed")}
+    except Exception:
+        return None
+
+
+def record_restore(site, key, sig, cost=None):
+    """Ledger row for an executable RESTORED from the AOT cache (ISSUE
+    20): ``compile_s`` 0.0, cost identity from the entry's stored
+    fingerprint.  A warm pod restart thus still publishes per-rank rows
+    the cross-rank ledger-divergence detector can diff — "every rank
+    restored the identical program" becomes checkable, not assumed.
+    ``kind`` is ``"restore"`` so :func:`load_ledger` (a diff of what was
+    *built*) keeps skipping these.  No-op when the gate is off; never
+    raises."""
+    if not enabled():
+        return None
+    try:
+        global _n_rows
+        backend, device_kind = _backend()
+        row = {"kind": "restore", "key": row_key(site, key, sig),
+               "site": str(site), "logical_key": str(key), "sig": str(sig),
+               "backend": backend, "device_kind": device_kind,
+               "fingerprints": _fingerprints(), "compile_s": 0.0,
+               "flops": (cost or {}).get("flops"),
+               "bytes_accessed": (cost or {}).get("bytes_accessed"),
+               "peak_bytes": None,  # totals() reads it on every row
+               "partial": [] if cost else ["cost"],
+               "declared": None, "drift": [],
+               "unix_ts": round(time.time(), 3)}
+        with _mu:
+            _rows.append(row)
+            del _rows[:-_RING_MAX]
+            _n_rows += 1
+        _append_ledger(row)
+        from . import instrument
+
+        if instrument.enabled():
+            instrument.registry().counter(
+                "compile_rows_total",
+                "executables the compile plane recorded",
+                ("site",)).inc(site=row["site"])
+        return row
+    except Exception:
+        return None
+
+
 def _record(site, key, sig, compiled, compile_s, tc0):
     global _n_rows
     feat, partial = extract(compiled)

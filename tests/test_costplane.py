@@ -139,9 +139,14 @@ def test_fused_step_records_row(monkeypatch, tmp_path):
     assert rows[0]["flops"] > 0
 
 
-def test_cached_function_records_compile_not_restore(tmp_path, monkeypatch):
-    """CachedFunction: a fresh XLA compile records a row; a disk restore
-    built nothing and records nothing."""
+def test_cached_function_records_compile_then_restore_row(tmp_path,
+                                                          monkeypatch):
+    """CachedFunction: a fresh XLA compile records a ``compile`` row; a
+    disk restore built nothing but still publishes a ``restore`` row
+    (compile_s 0.0, the entry's STORED cost fingerprint — ISSUE 20: a
+    warm pod restart must give the cross-rank ledger diff something to
+    diff).  ``load_ledger`` keeps skipping restore rows — the persisted
+    ledger remains a record of what was *built*."""
     from mxnet_tpu import compile_cache
 
     monkeypatch.setenv("MXNET_AOT_CACHE", str(tmp_path / "aot"))
@@ -153,12 +158,21 @@ def test_cached_function_records_compile_not_restore(tmp_path, monkeypatch):
     cf = compile_cache.CachedFunction(fn, ("cp", 1), name="cp_t")
     cf(x)
     assert costplane.row_count() == 1
-    assert costplane.rows()[0]["site"] == "cp_t"
-    # second instance, same key: restores from disk — no new row
+    compiled = costplane.rows()[0]
+    assert compiled["site"] == "cp_t" and compiled["kind"] == "compile"
+    # second instance, same key: restores from disk — a restore row, not
+    # a second compile row
     cf2 = compile_cache.CachedFunction(fn, ("cp", 1), name="cp_t")
     info = cf2.prepare(x)
     assert info["source"] == "disk"
-    assert costplane.row_count() == 1
+    assert costplane.row_count() == 2
+    restored = costplane.rows()[1]
+    assert restored["kind"] == "restore"
+    assert restored["key"] == compiled["key"]
+    assert restored["compile_s"] == 0.0
+    assert restored["flops"] == compiled["flops"]
+    assert restored["bytes_accessed"] == compiled["bytes_accessed"]
+    assert [r["kind"] for r in costplane.rows()].count("compile") == 1
 
 
 def test_ledger_roundtrip_last_wins(tmp_path, monkeypatch):

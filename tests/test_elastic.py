@@ -1,0 +1,159 @@
+"""Elastic fit-loop tests (ISSUE 20): durable checkpoints, resume with
+fast-forward, and the straggler checkpoint-and-rejoin / rank-death
+fail-fast responses — single-process; the 2-process end-to-end run is
+``ci/check_pod_train.py``."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import module as mod_mod
+from mxnet_tpu.io import NDArrayIter
+from mxnet_tpu.module import elastic
+
+
+def _make_mod():
+    data = mx.sym.var("data")
+    # explicit layer name: symbol auto-numbering differs between modules
+    # built in one process, and checkpoint keys must match across "runs"
+    sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=4, name="fc"), name="softmax")
+    return mod_mod.Module(sym)
+
+
+def _make_iter():
+    rng = np.random.RandomState(0)
+    return NDArrayIter(rng.randn(16, 8).astype(np.float32),
+                       rng.randint(0, 4, (16,)).astype(np.float32),
+                       batch_size=8)
+
+
+class _FakePod:
+    """pending_rejoin seam only — what after_step consumes."""
+
+    def __init__(self, incidents=()):
+        self._incs = list(incidents)
+
+    def pending_rejoin(self):
+        return self._incs.pop(0) if self._incs else None
+
+
+def test_gate_off_is_none(monkeypatch):
+    monkeypatch.delenv("MXNET_ELASTIC_DIR", raising=False)
+    assert elastic.controller() is None
+    mod = _make_mod()
+    mod.fit(_make_iter(), num_epoch=1,
+            optimizer_params={"learning_rate": 0.1})
+    assert mod.elastic_stats() is None
+
+
+def test_fit_saves_then_resume_fast_forwards(tmp_path, monkeypatch):
+    """Run A trains 4 global steps (2 epochs x 2 batches) with periodic
+    saves; run B on a fresh module resumes from the durable checkpoint,
+    fast-forwards every step without recomputing, and ends with run A's
+    exact final params."""
+    monkeypatch.setenv("MXNET_ELASTIC_DIR", str(tmp_path / "el"))
+    monkeypatch.setenv("MXNET_ELASTIC_SAVE_STEPS", "2")
+    mod_a = _make_mod()
+    mod_a.fit(_make_iter(), num_epoch=2,
+              optimizer_params={"learning_rate": 0.1})
+    stats_a = mod_a.elastic_stats()
+    assert stats_a is not None
+    assert stats_a["resume_step"] == 0
+    assert stats_a["saves"] >= 1
+    assert stats_a["steps"][-1] == 4      # final step durably saved
+    args_a, aux_a = mod_a.get_params()
+
+    mod_b = _make_mod()
+    mod_b.fit(_make_iter(), num_epoch=2,
+              optimizer_params={"learning_rate": 0.1})
+    stats_b = mod_b.elastic_stats()
+    assert stats_b["resume_step"] == 4
+    args_b, _ = mod_b.get_params()
+    assert set(args_b) == set(args_a)
+    for k in args_a:
+        np.testing.assert_array_equal(args_b[k].asnumpy(),
+                                      args_a[k].asnumpy())
+
+
+def test_resume_trains_only_the_tail(tmp_path, monkeypatch):
+    """A relaunch asked for MORE epochs fast-forwards the restored steps
+    and trains only the new tail — params move past the checkpoint."""
+    monkeypatch.setenv("MXNET_ELASTIC_DIR", str(tmp_path / "el2"))
+    mod_a = _make_mod()
+    mod_a.fit(_make_iter(), num_epoch=1,
+              optimizer_params={"learning_rate": 0.1})
+    args_a, _ = mod_a.get_params()
+    assert mod_a.elastic_stats()["steps"][-1] == 2
+
+    mod_b = _make_mod()
+    mod_b.fit(_make_iter(), num_epoch=2,
+              optimizer_params={"learning_rate": 0.1})
+    assert mod_b.elastic_stats()["resume_step"] == 2
+    args_b, _ = mod_b.get_params()
+    moved = any(not np.array_equal(args_b[k].asnumpy(), args_a[k].asnumpy())
+                for k in args_a)
+    assert moved  # epoch 2 really trained
+
+
+def test_straggler_rejoin_is_value_preserving(tmp_path, monkeypatch):
+    """A straggler incident schedules the rebase at its agreed
+    ``rejoin_step``; the rebase force-saves, restores, and leaves every
+    param bit-identical (restore returns the bytes just saved)."""
+    monkeypatch.delenv("MXNET_ELASTIC_DIR", raising=False)
+    mod = _make_mod()
+    mod.fit(_make_iter(), num_epoch=1,
+            optimizer_params={"learning_rate": 0.1})
+    el = elastic.ElasticController(str(tmp_path / "rj"))
+    try:
+        before, _ = mod.get_params()
+        before = {k: v.asnumpy().copy() for k, v in before.items()}
+        inc = {"id": "inc-straggler-r1-1-1", "reason": "straggler",
+               "rank": 1, "meta": {"lag_steps": 3, "rejoin_step": 6}}
+        assert el.after_step(mod, 5, _FakePod([inc])) is False  # scheduled
+        assert el.after_step(mod, 6, _FakePod()) is True        # rebased
+        assert el.rejoins == 1 and el.last_rejoin_step == 6
+        assert el._mgr.latest_step() == 6
+        after, _ = mod.get_params()
+        for k in before:
+            np.testing.assert_array_equal(after[k].asnumpy(), before[k])
+    finally:
+        el.close()
+
+
+def test_rank_death_fails_fast(tmp_path, monkeypatch):
+    monkeypatch.delenv("MXNET_ELASTIC_DIR", raising=False)
+    mod = _make_mod()
+    mod.fit(_make_iter(), num_epoch=1,
+            optimizer_params={"learning_rate": 0.1})
+    el = elastic.ElasticController(str(tmp_path / "dead"))
+    try:
+        inc = {"id": "inc-rank_death-r1-1-1", "reason": "rank_death",
+               "rank": 1, "meta": {"push_age_s": 9.0}}
+        with pytest.raises(RuntimeError, match="presumed dead"):
+            el.after_step(mod, 7, _FakePod([inc]))
+    finally:
+        el.close()
+
+
+def test_pending_rejoin_filters_reasons(monkeypatch):
+    """Podplane hands the elastic loop only straggler-with-rejoin-order
+    and rank_death incidents; observation-only incidents are dropped."""
+    from mxnet_tpu.telemetry import podplane
+
+    monkeypatch.setenv("MXNET_POD_METRICS", "1")
+    monkeypatch.setenv("MXNET_POD_METRICS_ADDR", "127.0.0.1:0")
+    p = podplane.PodPlane(rank=1, size=2, start_listener=False)
+    try:
+        p._observe_incidents([
+            {"id": "i1", "reason": "slo_breach", "rank": 0, "meta": {}},
+            {"id": "i2", "reason": "straggler", "rank": 1,
+             "meta": {"rejoin_step": 12, "lag_steps": 4}},
+            {"id": "i3", "reason": "rank_death", "rank": 0, "meta": {}},
+        ])
+        first = p.pending_rejoin()
+        assert first["id"] == "i2" and first["meta"]["rejoin_step"] == 12
+        second = p.pending_rejoin()
+        assert second["id"] == "i3" and second["reason"] == "rank_death"
+        assert p.pending_rejoin() is None
+    finally:
+        p.close()

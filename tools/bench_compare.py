@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 
 
@@ -553,6 +554,17 @@ def render_cost_table(table):
     return "\n".join(lines)
 
 
+def _multichip_processes(obj, tail):
+    """Process count for one MULTICHIP capture: the explicit ``processes``
+    key when the driver recorded it, else the ``processes=N`` marker
+    ``dryrun_multichip`` prints into the tail, else 1 (every capture
+    predating pod-scale training was single-process)."""
+    if obj.get("processes") is not None:
+        return int(obj["processes"])
+    m = re.search(r"\bprocesses=(\d+)\b", tail)
+    return int(m.group(1)) if m else 1
+
+
 def load_multichip(path, obj):
     """→ normalized row for one parsed MULTICHIP_r*.json capture."""
     if "ok" not in obj:
@@ -561,6 +573,7 @@ def load_multichip(path, obj):
     return {"file": path, "ok": bool(obj.get("ok")),
             "skipped": bool(obj.get("skipped")),
             "n_devices": obj.get("n_devices"),
+            "processes": _multichip_processes(obj, tail),
             "phases": {name for name, marker in MULTICHIP_PHASES
                        if marker in tail},
             # pod rollup (ISSUE 19): a driver capture taken with
@@ -581,6 +594,11 @@ def compare_multichip(rows):
                           missing_phases=missing))
         if r is base or r["skipped"]:
             continue
+        if r["processes"] != base["processes"]:
+            # a 2-process pod capture against a single-process one is a
+            # topology difference, not a regression — display-only, the
+            # same contract as cross-tier bench rows (ISSUE 20)
+            continue
         if base["ok"] and not r["ok"]:
             regressions.append("%s: ok true -> false" % r["file"])
         if missing:
@@ -590,10 +608,11 @@ def compare_multichip(rows):
 
 
 def render_multichip_table(table):
-    lines = ["file  ok  skipped  n_devices  phases  missing  pod"]
+    lines = ["file  ok  skipped  n_devices  processes  phases  missing  pod"]
     for r in table:
-        lines.append("%s  %s  %s  %s  [%s]  %s  %s" % (
+        lines.append("%s  %s  %s  %s  %s  [%s]  %s  %s" % (
             r["file"], r["ok"], r["skipped"], r["n_devices"],
+            r.get("processes", 1),
             ",".join(r["phases"]),
             ",".join(r["missing_phases"]) or "-",
             _fmt_pod(r.get("pod"))))

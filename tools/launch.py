@@ -34,12 +34,17 @@ import subprocess
 import sys
 import threading
 
-# observability env propagated from the launcher to every worker (ISSUE 19
-# satellite): exact names plus one prefix family.  The ssh launcher builds
-# worker env from scratch (base={}), so without this an operator exporting
-# MXNET_TELEMETRY=1 before launch gets silent per-worker no-ops.
+# observability + caching env propagated from the launcher to every worker
+# (ISSUE 19/20 satellites): exact names plus prefix families.  The ssh
+# launcher builds worker env from scratch (base={}), so without this an
+# operator exporting MXNET_TELEMETRY=1 before launch gets silent per-worker
+# no-ops.  The MXNET_AOT_CACHE / MXNET_AUTOTUNE prefixes cover the whole
+# families (…_MAX_MB, …_CACHE, …_MODEL, …_TOPK): an operator pointing the
+# AOT/autotune caches at shared storage must have every rank see them, or
+# a pod restart is warm on rank 0 and cold everywhere else.
 _PROPAGATE_EXACT = ("MXNET_TELEMETRY", "MXNET_TRACE", "MXNET_FLIGHTREC_DIR")
-_PROPAGATE_PREFIX = ("MXNET_POD_METRICS",)
+_PROPAGATE_PREFIX = ("MXNET_POD_METRICS", "MXNET_AOT_CACHE",
+                     "MXNET_AUTOTUNE", "MXNET_ELASTIC")
 
 
 def _free_port():
